@@ -1,0 +1,24 @@
+"""greptimedb_tpu: a TPU-native time-series database framework.
+
+Capability surface modeled on GreptimeDB (see SURVEY.md): SQL + PromQL over
+metrics/logs/events, LSM region storage with WAL + Parquet SSTs, a metadata
+control plane with heartbeats/leases/failover, and streaming continuous
+aggregation — with the columnar scan/aggregate/window hot path executed as
+JAX/XLA/Pallas programs sharded over a TPU mesh.
+
+Layering (top → bottom), mirroring the reference layer map (SURVEY.md §1):
+
+    servers/   wire protocols (HTTP SQL, Prometheus, InfluxDB, ...)
+    cluster/   role assembly: standalone, frontend, datanode, metasrv, flownode
+    query/     SQL + PromQL planning and TPU-backed execution
+    flow/      continuous aggregation with device-resident accumulators
+    meta/      catalog, kv backend, procedures, failure detection
+    storage/   LSM region engine: WAL, memtables, Parquet SSTs, compaction
+    ops/       the device kernel library (segment/window/PromQL kernels)
+    parallel/  mesh + sharding + collectives (the distributed backend)
+    datatypes/ column types bridging Arrow <-> JAX
+"""
+
+from greptimedb_tpu.version import __version__
+
+__all__ = ["__version__"]

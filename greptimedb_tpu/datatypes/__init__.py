@@ -1,0 +1,4 @@
+from greptimedb_tpu.datatypes.types import ConcreteDataType
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema, SemanticType
+
+__all__ = ["ConcreteDataType", "ColumnSchema", "Schema", "SemanticType"]

@@ -1,0 +1,167 @@
+"""Table schemas with semantic column roles.
+
+Equivalent to the reference's `ColumnSchema`/`Schema` with TIME INDEX and
+tag/field semantics (/root/reference/src/datatypes/src/schema/column_schema.rs
+and /root/reference/src/api: SemanticType). The TAG / FIELD / TIMESTAMP split
+is load-bearing for the TPU design: TAG columns are dictionary-encoded on the
+host and become int32 series ids on device; FIELD columns become dense f32/f64
+matrices; the TIMESTAMP column defines the time axis of every device grid.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Iterable
+
+import pyarrow as pa
+
+from greptimedb_tpu.datatypes.types import ConcreteDataType
+from greptimedb_tpu.errors import ColumnNotFoundError, InvalidArgumentError
+
+
+class SemanticType(enum.IntEnum):
+    TAG = 0
+    FIELD = 1
+    TIMESTAMP = 2
+
+
+@dataclass
+class ColumnSchema:
+    name: str
+    data_type: ConcreteDataType
+    semantic_type: SemanticType = SemanticType.FIELD
+    nullable: bool = True
+    default: Any = None
+    # fulltext-index flag, mirroring the reference's fulltext column option.
+    fulltext: bool = False
+    # inverted-index flag for tag pruning.
+    inverted_index: bool = False
+
+    def to_arrow_field(self) -> pa.Field:
+        meta = {
+            b"greptime:semantic_type": str(int(self.semantic_type)).encode(),
+        }
+        return pa.field(
+            self.name, self.data_type.to_arrow(), nullable=self.nullable, metadata=meta
+        )
+
+    @staticmethod
+    def from_arrow_field(f: pa.Field) -> "ColumnSchema":
+        sem = SemanticType.FIELD
+        if f.metadata and b"greptime:semantic_type" in f.metadata:
+            sem = SemanticType(int(f.metadata[b"greptime:semantic_type"]))
+        return ColumnSchema(
+            name=f.name,
+            data_type=ConcreteDataType.from_arrow(f.type),
+            semantic_type=sem,
+            nullable=f.nullable,
+        )
+
+    @property
+    def is_tag(self) -> bool:
+        return self.semantic_type == SemanticType.TAG
+
+    @property
+    def is_field(self) -> bool:
+        return self.semantic_type == SemanticType.FIELD
+
+    @property
+    def is_time_index(self) -> bool:
+        return self.semantic_type == SemanticType.TIMESTAMP
+
+
+@dataclass
+class Schema:
+    """An ordered set of columns with exactly one TIME INDEX."""
+
+    columns: list[ColumnSchema]
+    version: int = 0
+    _index: dict[str, int] = field(default_factory=dict, repr=False)
+
+    def __post_init__(self):
+        self._index = {c.name: i for i, c in enumerate(self.columns)}
+        if len(self._index) != len(self.columns):
+            raise InvalidArgumentError("duplicate column names in schema")
+        ts_cols = [c for c in self.columns if c.is_time_index]
+        if len(ts_cols) > 1:
+            raise InvalidArgumentError("schema must have at most one TIME INDEX column")
+
+    # ---- lookups ------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.columns)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def column(self, name: str) -> ColumnSchema:
+        try:
+            return self.columns[self._index[name]]
+        except KeyError:
+            raise ColumnNotFoundError(f"column not found: {name}") from None
+
+    def column_index(self, name: str) -> int:
+        try:
+            return self._index[name]
+        except KeyError:
+            raise ColumnNotFoundError(f"column not found: {name}") from None
+
+    def maybe_column(self, name: str) -> ColumnSchema | None:
+        i = self._index.get(name)
+        return None if i is None else self.columns[i]
+
+    @property
+    def column_names(self) -> list[str]:
+        return [c.name for c in self.columns]
+
+    @property
+    def time_index(self) -> ColumnSchema:
+        for c in self.columns:
+            if c.is_time_index:
+                return c
+        raise InvalidArgumentError("schema has no TIME INDEX column")
+
+    @property
+    def maybe_time_index(self) -> ColumnSchema | None:
+        for c in self.columns:
+            if c.is_time_index:
+                return c
+        return None
+
+    @property
+    def tag_columns(self) -> list[ColumnSchema]:
+        return [c for c in self.columns if c.is_tag]
+
+    @property
+    def field_columns(self) -> list[ColumnSchema]:
+        return [c for c in self.columns if c.is_field]
+
+    @property
+    def primary_key(self) -> list[str]:
+        return [c.name for c in self.tag_columns]
+
+    # ---- arrow --------------------------------------------------------
+    def to_arrow(self) -> pa.Schema:
+        return pa.schema(
+            [c.to_arrow_field() for c in self.columns],
+            metadata={b"greptime:version": str(self.version).encode()},
+        )
+
+    @staticmethod
+    def from_arrow(s: pa.Schema) -> "Schema":
+        version = 0
+        if s.metadata and b"greptime:version" in s.metadata:
+            version = int(s.metadata[b"greptime:version"])
+        return Schema([ColumnSchema.from_arrow_field(f) for f in s], version=version)
+
+    def project(self, names: Iterable[str]) -> "Schema":
+        return Schema([self.column(n) for n in names], version=self.version)
+
+    def with_column(self, col: ColumnSchema) -> "Schema":
+        return Schema(self.columns + [col], version=self.version + 1)
+
+    def without_column(self, name: str) -> "Schema":
+        self.column(name)  # raise if missing
+        return Schema(
+            [c for c in self.columns if c.name != name], version=self.version + 1
+        )

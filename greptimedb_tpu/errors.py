@@ -1,0 +1,131 @@
+"""Error taxonomy.
+
+Mirrors the reference's `ErrorExt`/`StatusCode` scheme
+(/root/reference/src/common/error/src/status_code.rs) with a flat Python
+exception hierarchy carrying a wire status code.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class StatusCode(enum.IntEnum):
+    SUCCESS = 0
+    UNKNOWN = 1000
+    UNSUPPORTED = 1001
+    UNEXPECTED = 1002
+    INTERNAL = 1003
+    INVALID_ARGUMENTS = 1004
+    CANCELLED = 1005
+    ILLEGAL_STATE = 1006
+
+    INVALID_SYNTAX = 2000
+    PLAN_QUERY = 3000
+    ENGINE_EXECUTE_QUERY = 3001
+
+    TABLE_ALREADY_EXISTS = 4000
+    TABLE_NOT_FOUND = 4001
+    TABLE_COLUMN_NOT_FOUND = 4002
+    TABLE_COLUMN_EXISTS = 4003
+    DATABASE_NOT_FOUND = 4004
+    REGION_NOT_FOUND = 4005
+    REGION_ALREADY_EXISTS = 4006
+    REGION_READONLY = 4007
+    DATABASE_ALREADY_EXISTS = 4010
+
+    STORAGE_UNAVAILABLE = 5000
+    REQUEST_OUTDATED = 5001
+
+    RUNTIME_RESOURCES_EXHAUSTED = 6000
+    RATE_LIMITED = 6001
+
+    USER_NOT_FOUND = 7000
+    UNSUPPORTED_PASSWORD_TYPE = 7001
+    USER_PASSWORD_MISMATCH = 7002
+    AUTH_HEADER_NOT_FOUND = 7003
+    INVALID_AUTH_HEADER = 7004
+    ACCESS_DENIED = 7005
+    PERMISSION_DENIED = 7006
+
+    FLOW_ALREADY_EXISTS = 8000
+    FLOW_NOT_FOUND = 8001
+
+
+class GreptimeError(Exception):
+    """Base error; every subsystem raises a subclass."""
+
+    status_code: StatusCode = StatusCode.INTERNAL
+
+    def __init__(self, msg: str = "", *, code: StatusCode | None = None):
+        super().__init__(msg)
+        if code is not None:
+            self.status_code = code
+
+    @property
+    def message(self) -> str:
+        return str(self)
+
+
+class InvalidSyntaxError(GreptimeError):
+    status_code = StatusCode.INVALID_SYNTAX
+
+
+class PlanError(GreptimeError):
+    status_code = StatusCode.PLAN_QUERY
+
+
+class ExecutionError(GreptimeError):
+    status_code = StatusCode.ENGINE_EXECUTE_QUERY
+
+
+class UnsupportedError(GreptimeError):
+    status_code = StatusCode.UNSUPPORTED
+
+
+class InvalidArgumentError(GreptimeError):
+    status_code = StatusCode.INVALID_ARGUMENTS
+
+
+class TableNotFoundError(GreptimeError):
+    status_code = StatusCode.TABLE_NOT_FOUND
+
+
+class TableAlreadyExistsError(GreptimeError):
+    status_code = StatusCode.TABLE_ALREADY_EXISTS
+
+
+class ColumnNotFoundError(GreptimeError):
+    status_code = StatusCode.TABLE_COLUMN_NOT_FOUND
+
+
+class DatabaseNotFoundError(GreptimeError):
+    status_code = StatusCode.DATABASE_NOT_FOUND
+
+
+class DatabaseAlreadyExistsError(GreptimeError):
+    status_code = StatusCode.DATABASE_ALREADY_EXISTS
+
+
+class RegionNotFoundError(GreptimeError):
+    status_code = StatusCode.REGION_NOT_FOUND
+
+
+class RegionReadonlyError(GreptimeError):
+    status_code = StatusCode.REGION_READONLY
+
+
+class StorageError(GreptimeError):
+    status_code = StatusCode.STORAGE_UNAVAILABLE
+
+
+class FlowNotFoundError(GreptimeError):
+    status_code = StatusCode.FLOW_NOT_FOUND
+
+
+class FlowAlreadyExistsError(GreptimeError):
+    status_code = StatusCode.FLOW_ALREADY_EXISTS
+
+
+class IllegalStateError(GreptimeError):
+    status_code = StatusCode.ILLEGAL_STATE

@@ -1,0 +1,13 @@
+"""Device kernel library.
+
+Everything in here is pure, shape-static jax.numpy (or Pallas) code designed
+for the TPU execution model: no data-dependent shapes, masks instead of
+nulls, segment/prefix/gather formulations instead of per-row loops.
+
+- segment.py : segmented (group-by) reductions for SQL aggregation
+- grid.py    : scatter of (series, ts, value) rows onto dense (S, T) grids
+- window.py  : prefix-sum and gather window kernels over grids
+- promql.py  : PromQL range/instant function semantics on top of window.py
+- topk.py    : top-k/bottom-k selection
+- filter.py  : predicate mask evaluation
+"""

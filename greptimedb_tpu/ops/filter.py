@@ -1,0 +1,44 @@
+"""Predicate evaluation kernels: comparisons/boolean algebra over device
+columns producing row masks (the device half of WHERE pushdown; the host
+half — time-range and tag pruning — lives in storage/ and index/)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_CMP = {
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b,
+    "le": lambda a, b: a <= b,
+    "gt": lambda a, b: a > b,
+    "ge": lambda a, b: a >= b,
+}
+
+
+def compare(op: str, left, right):
+    return _CMP[op](left, right)
+
+
+def combine(op: str, *masks):
+    assert masks
+    out = masks[0]
+    for m in masks[1:]:
+        if op == "and":
+            out = out & m
+        elif op == "or":
+            out = out | m
+        else:
+            raise ValueError(op)
+    return out
+
+
+def between(values, low, high):
+    return (values >= low) & (values <= high)
+
+
+def isin(values, candidates):
+    out = jnp.zeros(values.shape, dtype=bool)
+    for c in candidates:
+        out = out | (values == c)
+    return out

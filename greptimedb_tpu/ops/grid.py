@@ -1,0 +1,104 @@
+"""Dense (series x time) grids from (sid, ts, value) rows.
+
+This is the load-bearing layout decision of the whole TPU design (SURVEY.md
+§5 "long-context" analog): the (series, time) plane is the matrix we shard
+and window over. Rows coming off a storage scan are scattered onto a dense
+grid of T cells of resolution `res`; every PromQL range/instant kernel then
+operates on regular windows of grid cells (ops/window.py).
+
+Cell convention: cell i holds samples with ts in (t0 + (i-1)*res, t0 + i*res]
+— half-open on the left so that PromQL's (start, end] window semantics align
+exactly with cell boundaries whenever `res` divides the query step and range.
+
+Timestamps on device are int32 offsets from t0 in `unit` ticks (unit chosen
+by the host so the whole grid span fits in int32 — avoids int64 on TPU).
+
+When a cell receives multiple samples, the one with the greatest row index
+wins; scans yield rows in (series, ts) order, so that is the latest sample
+(same winner as the reference's last-row dedup,
+/root/reference/src/mito2/src/read/dedup.rs).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class GridSpec:
+    """Host-side description of a device grid."""
+
+    t0: int          # absolute origin timestamp (exclusive lower bound), ms
+    res: int         # cell resolution, ms
+    num_cells: int   # T
+    unit: int        # device ts tick size in ms (1 unless span overflows int32)
+    tps: float       # device ts ticks per second (1000/unit)
+
+    @staticmethod
+    def build(t0: int, res: int, num_cells: int) -> "GridSpec":
+        span = res * num_cells
+        unit = 1
+        while span // unit >= 2**31 - 1:
+            unit *= 2
+        return GridSpec(t0=t0, res=res, num_cells=num_cells, unit=unit,
+                        tps=1000.0 / unit)
+
+    def cell_of(self, ts: np.ndarray | int):
+        """Cell index for absolute ts: ceil((ts - t0) / res), so a sample at
+        exactly a cell boundary belongs to the cell ending there."""
+        return -((-(np.asarray(ts) - self.t0)) // self.res)
+
+    def device_ts(self, ts: np.ndarray) -> np.ndarray:
+        return ((np.asarray(ts) - self.t0) // self.unit).astype(np.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("num_series", "num_cells"))
+def gridify(
+    sid: jax.Array,      # (N,) int32 series ids in [0, num_series)
+    cell: jax.Array,     # (N,) int32 cell index (may be out of range)
+    tsrel: jax.Array,    # (N,) int32 device ts (ticks from t0)
+    values: jax.Array,   # (N,) float
+    mask: jax.Array,     # (N,) bool row validity
+    num_series: int,
+    num_cells: int,
+):
+    """Scatter rows to a dense grid. Returns (vals, has, tsg):
+    vals (S,T) float, has (S,T) bool, tsg (S,T) int32 (0 where empty)."""
+    out, has, tsg = gridify_multi(
+        sid, cell, tsrel, {"v": values}, mask, num_series, num_cells
+    )
+    return out["v"], has, tsg
+
+
+@functools.partial(jax.jit, static_argnames=("num_series", "num_cells"))
+def gridify_multi(
+    sid, cell, tsrel, value_cols: dict, mask, num_series: int, num_cells: int
+):
+    """gridify for several value columns sharing one (sid, cell) scatter —
+    one winner computation, k gathers (the multi-field table case)."""
+    n = sid.shape[0]
+    in_range = mask & (cell >= 0) & (cell < num_cells) & (sid >= 0) & (
+        sid < num_series
+    )
+    flat = jnp.where(
+        in_range, sid * num_cells + cell, jnp.int32(num_series * num_cells)
+    )
+    idx = jnp.arange(n, dtype=jnp.int32)
+    winner = jax.ops.segment_max(
+        jnp.where(in_range, idx, jnp.int32(-1)),
+        flat,
+        num_segments=num_series * num_cells + 1,
+    )[:-1]
+    has = winner >= 0
+    safe = jnp.maximum(winner, 0)
+    shape = (num_series, num_cells)
+    out = {}
+    for name, v in value_cols.items():
+        out[name] = jnp.where(has, v[safe], jnp.zeros((), v.dtype)).reshape(shape)
+    tsg = jnp.where(has, tsrel[safe], jnp.int32(0)).reshape(shape)
+    return out, has.reshape(shape), tsg

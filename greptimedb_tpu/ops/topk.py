@@ -1,0 +1,25 @@
+"""Top-k / bottom-k selection kernels (PromQL topk/bottomk, SQL ORDER BY +
+LIMIT over aggregates)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("k", "largest"))
+def topk(values: jax.Array, mask: jax.Array, k: int, *, largest: bool = True):
+    """Top-k along the last axis with invalid entries excluded.
+    Returns (values, indices, valid)."""
+    dt = values.dtype
+    fill = jnp.asarray(-jnp.inf if largest else jnp.inf, dt)
+    v = jnp.where(mask, values, fill)
+    if not largest:
+        v = -v
+    top_v, top_i = jax.lax.top_k(v, k)
+    if not largest:
+        top_v = -top_v
+    valid = jnp.take_along_axis(mask, top_i, axis=-1)
+    return jnp.where(valid, top_v, 0), top_i, valid

@@ -1,0 +1,451 @@
+"""Window kernels over (series x time) grids.
+
+Replaces the reference's per-series streaming window materialization
+(/root/reference/src/promql/src/extension_plan/range_manipulate.rs and the
+RangeArray ragged view, /root/reference/src/promql/src/range_array.rs) with
+two TPU-friendly formulations:
+
+- prefix path: window aggregates as differences of per-series prefix sums
+  (O(S*T) memory, no per-window gather). Used for sum/count/avg, the
+  extrapolated rate family, changes/resets, first/last/idelta/irate.
+- gather path: materialize (S, J, L) window tensors by gathering L cells per
+  output step. Used for order statistics and sequential folds (min/max/
+  quantile/stddev/holt_winters/deriv/predict_linear).
+
+Window j covers grid cells [lo_j+1 .. hi_j] (samples with ts in
+(t_end_j - range, t_end_j]), matching PromQL's half-open window.
+
+All kernels return (values, present_mask) pairs shaped (S, J).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from greptimedb_tpu.ops.grid import GridSpec
+
+
+@dataclass
+class Windows:
+    """Host-built window description for a range evaluation.
+
+    Built so that every window boundary lands exactly on a cell boundary:
+    res divides step, range and (start - t0)."""
+
+    lo: np.ndarray        # (J,) int32 cell index, window = cells (lo, hi]
+    hi: np.ndarray        # (J,) int32
+    t_end: np.ndarray     # (J,) int32 window end, device ticks from t0
+    range_ticks: int      # window length in device ticks
+    range_seconds: float
+
+    @property
+    def num_steps(self) -> int:
+        return len(self.hi)
+
+    @property
+    def num_cells_per_window(self) -> int:
+        return int(self.hi[0] - self.lo[0])
+
+
+def plan_grid_and_windows(
+    start_ms: int, end_ms: int, step_ms: int, range_ms: int,
+    *, max_cells: int = 4_000_000, data_interval_ms: int | None = None,
+) -> tuple[GridSpec, Windows]:
+    """Choose a grid resolution + origin so windows align with cells.
+
+    res = gcd(step, range[, data_interval]) — windows then cover whole cells
+    exactly. If that produces too many cells, coarsen to a divisor-free fit
+    (approximation documented in ops/grid.py)."""
+    step_ms = max(int(step_ms), 1)
+    range_ms = max(int(range_ms), 1)
+    res = int(np.gcd(step_ms, range_ms))
+    if data_interval_ms and data_interval_ms > 0:
+        res = int(np.gcd(res, int(data_interval_ms)))
+    span = (end_ms - start_ms) + range_ms
+    while span // res > max_cells:
+        res *= 2  # coarsen: sacrifices exact boundary alignment on huge spans
+    t0 = start_ms - range_ms
+    num_cells = -(-span // res)
+    spec = GridSpec.build(t0, res, num_cells)
+    steps = np.arange(start_ms, end_ms + 1, step_ms, dtype=np.int64)
+    hi = np.minimum((steps - t0) // res, num_cells - 1).astype(np.int32)
+    w_cells = max(range_ms // res, 1)
+    lo = np.maximum(hi - w_cells, 0).astype(np.int32)
+    t_end = ((steps - t0) // spec.unit).astype(np.int32)
+    return spec, Windows(
+        lo=lo, hi=hi, t_end=t_end,
+        range_ticks=int(range_ms // spec.unit),
+        range_seconds=range_ms / 1000.0,
+    )
+
+
+# ----------------------------------------------------------------------
+# prefix helpers (all (S, T) -> (S, T+1) or (S, T))
+# ----------------------------------------------------------------------
+
+def _prefix(x: jax.Array) -> jax.Array:
+    """P[:, i] = sum of cells < i; shape (S, T+1)."""
+    c = jnp.cumsum(x, axis=1)
+    return jnp.pad(c, ((0, 0), (1, 0)))
+
+
+def _last_present_idx(has: jax.Array) -> jax.Array:
+    """lastidx[:, i] = greatest cell j <= i with a sample, else -1."""
+    t = has.shape[1]
+    i = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), has.shape)
+    return jax.lax.cummax(jnp.where(has, i, jnp.int32(-1)), axis=1)
+
+
+def _first_present_idx(has: jax.Array) -> jax.Array:
+    """firstidx[:, i] = least cell j >= i with a sample, else T."""
+    t = has.shape[1]
+    rev = jnp.flip(has, axis=1)
+    i = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), has.shape)
+    lp = jax.lax.cummax(jnp.where(rev, i, jnp.int32(-1)), axis=1)
+    return jnp.flip(jnp.int32(t - 1) - lp, axis=1)
+
+
+def _prev_present_idx(lastidx: jax.Array) -> jax.Array:
+    """prev[:, i] = greatest cell j < i with a sample, else -1."""
+    return jnp.pad(lastidx[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+
+
+def _gather_steps(arr: jax.Array, idx: jax.Array) -> jax.Array:
+    """Gather (S, T') array at per-step indices. idx is (J,) -> (S, J) or
+    (S, J) -> (S, J)."""
+    if idx.ndim == 1:
+        return arr[:, idx]
+    return jnp.take_along_axis(arr, idx, axis=1)
+
+
+# ----------------------------------------------------------------------
+# prefix-path kernels
+# ----------------------------------------------------------------------
+
+@jax.jit
+def window_count(has, lo, hi):
+    c = _prefix(has.astype(jnp.int32))
+    return _gather_steps(c, hi + 1) - _gather_steps(c, lo + 1)
+
+
+@jax.jit
+def window_sum(vals, has, lo, hi):
+    p = _prefix(jnp.where(has, vals, jnp.zeros((), vals.dtype)))
+    s = _gather_steps(p, hi + 1) - _gather_steps(p, lo + 1)
+    cnt = window_count(has, lo, hi)
+    return s, cnt > 0
+
+
+@jax.jit
+def window_avg(vals, has, lo, hi):
+    s, _ = window_sum(vals, has, lo, hi)
+    cnt = window_count(has, lo, hi)
+    return s / jnp.maximum(cnt, 1).astype(s.dtype), cnt > 0
+
+
+@jax.jit
+def window_last(vals, has, tsg, lo, hi):
+    """Most recent sample in each window: (value, ts, present)."""
+    li = _gather_steps(_last_present_idx(has), hi)
+    present = li > lo[None, :]
+    safe = jnp.maximum(li, 0)
+    v = jnp.take_along_axis(vals, safe, axis=1)
+    t = jnp.take_along_axis(tsg, safe, axis=1)
+    return v, t, present
+
+
+@jax.jit
+def window_first(vals, has, tsg, lo, hi):
+    fi = _gather_steps(_first_present_idx(has), lo + 1)
+    present = fi <= hi[None, :]
+    t_max = vals.shape[1] - 1
+    safe = jnp.minimum(fi, t_max)
+    v = jnp.take_along_axis(vals, safe, axis=1)
+    t = jnp.take_along_axis(tsg, safe, axis=1)
+    return v, t, present
+
+
+def _pair_indicator(vals, has, pred):
+    """Per-cell indicator over (prev_sample, sample) pairs; pred(prev, cur)."""
+    lastidx = _last_present_idx(has)
+    pl = _prev_present_idx(lastidx)
+    safe = jnp.maximum(pl, 0)
+    prev_val = jnp.take_along_axis(vals, safe, axis=1)
+    pair = has & (pl >= 0)
+    return pair, prev_val
+
+
+@functools.partial(jax.jit, static_argnames=("is_counter", "is_rate"))
+def extrapolated_rate(
+    vals, has, tsg, lo, hi, t_end, range_ticks, tps,
+    *, is_counter: bool, is_rate: bool,
+):
+    """Prometheus rate/increase/delta with the extrapolation rules of
+    functions.go (semantics per /root/reference/src/promql/src/functions/
+    extrapolate_rate.rs:120-205). Returns (value, present) shaped (S, J)."""
+    dt = vals.dtype
+    lastidx = _last_present_idx(has)
+    firstidx = _first_present_idx(has)
+    li = _gather_steps(lastidx, hi)          # (S, J)
+    fi = _gather_steps(firstidx, lo + 1)     # (S, J)
+    t_max = vals.shape[1] - 1
+    li_s = jnp.maximum(li, 0)
+    fi_s = jnp.minimum(fi, t_max)
+    valid = (li > lo[None, :]) & (fi <= hi[None, :]) & (fi < li)
+    v_last = jnp.take_along_axis(vals, li_s, axis=1)
+    v_first = jnp.take_along_axis(vals, fi_s, axis=1)
+    t_last = jnp.take_along_axis(tsg, li_s, axis=1).astype(dt)
+    t_first = jnp.take_along_axis(tsg, fi_s, axis=1).astype(dt)
+
+    delta = v_last - v_first
+    if is_counter:
+        pair, prev_val = _pair_indicator(vals, has, None)
+        drop = jnp.where(pair & (vals < prev_val), prev_val, jnp.zeros((), dt))
+        d = _prefix(drop)
+        corr = _gather_steps(d, hi + 1) - jnp.take_along_axis(d, fi_s + 1, axis=1)
+        delta = delta + corr
+
+    cnt = window_count(has, lo, hi).astype(dt)
+    t_end_f = t_end[None, :].astype(dt)
+    tps = jnp.asarray(tps, dt)
+    dur_start = (t_first - (t_end_f - jnp.asarray(range_ticks, dt))) / tps
+    dur_end = (t_end_f - t_last) / tps
+    sampled = (t_last - t_first) / tps
+    avg_dur = sampled / jnp.maximum(cnt - 1, 1)
+
+    if is_counter:
+        # avoid extrapolating a counter below zero
+        dur_zero = jnp.where(
+            (delta > 0) & (v_first >= 0),
+            sampled * (v_first / jnp.where(delta == 0, 1, delta)),
+            jnp.asarray(jnp.inf, dt),
+        )
+        dur_start = jnp.minimum(dur_start, dur_zero)
+
+    thresh = avg_dur * jnp.asarray(1.1, dt)
+    extr = sampled
+    extr = extr + jnp.where(dur_start < thresh, dur_start, avg_dur / 2)
+    extr = extr + jnp.where(dur_end < thresh, dur_end, avg_dur / 2)
+    factor = extr / jnp.where(sampled == 0, 1, sampled)
+    out = delta * factor
+    if is_rate:
+        out = out / jnp.asarray(range_ticks / tps, dt)
+    return jnp.where(valid, out, jnp.zeros((), dt)), valid
+
+
+@functools.partial(jax.jit, static_argnames=("count_changes",))
+def window_pair_count(vals, has, lo, hi, *, count_changes: bool):
+    """changes() (value differs from previous) or resets() (value drops)
+    over each window. Pairs are (prev sample, sample) with both inside the
+    window. Returns (count_float, present)."""
+    dt = vals.dtype
+    pair, prev_val = _pair_indicator(vals, has, None)
+    if count_changes:
+        ind = pair & (vals != prev_val)
+    else:
+        ind = pair & (vals < prev_val)
+    p = _prefix(ind.astype(jnp.int32))
+    firstidx = _first_present_idx(has)
+    fi = _gather_steps(firstidx, lo + 1)
+    t_max = vals.shape[1] - 1
+    fi_s = jnp.minimum(fi, t_max)
+    in_w = fi <= hi[None, :]
+    cnt = _gather_steps(p, hi + 1) - jnp.take_along_axis(p, fi_s + 1, axis=1)
+    cnt = jnp.where(in_w, cnt, 0)
+    return cnt.astype(dt), in_w
+
+
+@functools.partial(jax.jit, static_argnames=("is_rate",))
+def instant_delta(vals, has, tsg, lo, hi, tps, *, is_rate: bool):
+    """idelta (last two samples' value difference) / irate (per-second,
+    with counter-reset handling)."""
+    dt = vals.dtype
+    lastidx = _last_present_idx(has)
+    pl = _prev_present_idx(lastidx)
+    li = _gather_steps(lastidx, hi)
+    t_max = vals.shape[1] - 1
+    li_s = jnp.maximum(li, 0)
+    # previous present cell strictly before li
+    pi = jnp.take_along_axis(pl, li_s, axis=1)
+    pi_s = jnp.maximum(pi, 0)
+    valid = (li > lo[None, :]) & (pi > lo[None, :]) & (pi >= 0)
+    v1 = jnp.take_along_axis(vals, pi_s, axis=1)
+    v2 = jnp.take_along_axis(vals, li_s, axis=1)
+    t1 = jnp.take_along_axis(tsg, pi_s, axis=1).astype(dt)
+    t2 = jnp.take_along_axis(tsg, li_s, axis=1).astype(dt)
+    if is_rate:
+        dv = jnp.where(v2 < v1, v2, v2 - v1)  # counter reset: use raw value
+        dtm = jnp.maximum(t2 - t1, 1) / jnp.asarray(tps, dt)
+        out = dv / dtm
+    else:
+        out = v2 - v1
+    return jnp.where(valid, out, jnp.zeros((), dt)), valid
+
+
+# ----------------------------------------------------------------------
+# gather-path kernels
+# ----------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num_cells",))
+def gather_windows(vals, has, tsg, hi, num_cells: int):
+    """Materialize (S, J, L) window tensors: cell hi_j - k for k in [0, L).
+    Cells are in reverse time order (k=0 is the window end)."""
+    k = jnp.arange(num_cells, dtype=jnp.int32)
+    idx = hi[None, :, None] - k[None, None, :]        # (1, J, L)
+    ok = idx >= 0
+    idx_s = jnp.maximum(idx, 0)
+    g_vals = jnp.take(vals, idx_s[0], axis=1)          # (S, J, L)
+    g_has = jnp.take(has, idx_s[0], axis=1) & ok[0]
+    g_ts = jnp.take(tsg, idx_s[0], axis=1)
+    return g_vals, g_has, g_ts
+
+
+@functools.partial(jax.jit, static_argnames=("num_cells", "op"))
+def window_minmax(vals, has, tsg, hi, num_cells: int, op: str):
+    g_vals, g_has, _ = gather_windows(vals, has, tsg, hi, num_cells)
+    dt = vals.dtype
+    if op == "min":
+        fill = jnp.asarray(jnp.inf, dt)
+        out = jnp.min(jnp.where(g_has, g_vals, fill), axis=2)
+    else:
+        fill = jnp.asarray(-jnp.inf, dt)
+        out = jnp.max(jnp.where(g_has, g_vals, fill), axis=2)
+    present = jnp.any(g_has, axis=2)
+    return jnp.where(present, out, jnp.zeros((), dt)), present
+
+
+@functools.partial(jax.jit, static_argnames=("num_cells", "sample_var"))
+def window_stdvar(vals, has, tsg, hi, num_cells: int, *, sample_var: bool = False):
+    """Population stddev/stdvar over each window (Prometheus semantics).
+    Returns (var, stddev, present)."""
+    g_vals, g_has, _ = gather_windows(vals, has, tsg, hi, num_cells)
+    dt = vals.dtype
+    n = jnp.sum(g_has, axis=2).astype(dt)
+    n1 = jnp.maximum(n, 1)
+    mean = jnp.sum(jnp.where(g_has, g_vals, 0), axis=2) / n1
+    dev = jnp.where(g_has, g_vals - mean[:, :, None], 0)
+    denom = jnp.maximum(n - 1, 1) if sample_var else n1
+    var = jnp.sum(dev * dev, axis=2) / denom
+    present = n > 0
+    return var, jnp.sqrt(var), present
+
+
+@functools.partial(jax.jit, static_argnames=("num_cells",))
+def window_quantile(vals, has, tsg, hi, num_cells: int, q):
+    """phi-quantile with linear interpolation (Prometheus
+    quantile_over_time). q may be a scalar or (J,) array."""
+    g_vals, g_has, _ = gather_windows(vals, has, tsg, hi, num_cells)
+    dt = vals.dtype
+    fill = jnp.asarray(jnp.inf, dt)
+    sorted_vals = jnp.sort(jnp.where(g_has, g_vals, fill), axis=2)
+    n = jnp.sum(g_has, axis=2)
+    present = n > 0
+    q = jnp.asarray(q, dt)
+    rank = q * jnp.maximum(n - 1, 0).astype(dt)
+    lo_i = jnp.floor(rank).astype(jnp.int32)
+    hi_i = jnp.ceil(rank).astype(jnp.int32)
+    lo_i = jnp.clip(lo_i, 0, num_cells - 1)
+    hi_i = jnp.clip(hi_i, 0, num_cells - 1)
+    v_lo = jnp.take_along_axis(sorted_vals, lo_i[:, :, None], axis=2)[:, :, 0]
+    v_hi = jnp.take_along_axis(sorted_vals, hi_i[:, :, None], axis=2)[:, :, 0]
+    frac = rank - jnp.floor(rank)
+    out = v_lo + (v_hi - v_lo) * frac
+    return jnp.where(present, out, jnp.zeros((), dt)), present
+
+
+@functools.partial(jax.jit, static_argnames=("num_cells",))
+def window_linear_fit(vals, has, tsg, hi, t_end, num_cells: int, tps):
+    """Least-squares line over window samples; t is seconds relative to the
+    window end (small, f32-safe). Returns (slope, intercept_at_end, n)."""
+    g_vals, g_has, g_ts = gather_windows(vals, has, tsg, hi, num_cells)
+    dt = vals.dtype
+    t = (g_ts.astype(dt) - t_end[None, :, None].astype(dt)) / jnp.asarray(tps, dt)
+    m = g_has.astype(dt)
+    n = jnp.sum(m, axis=2)
+    st = jnp.sum(t * m, axis=2)
+    sv = jnp.sum(jnp.where(g_has, g_vals, 0), axis=2)
+    stt = jnp.sum(t * t * m, axis=2)
+    stv = jnp.sum(t * jnp.where(g_has, g_vals, 0), axis=2)
+    n1 = jnp.maximum(n, 1)
+    denom = n1 * stt - st * st
+    slope = (n1 * stv - st * sv) / jnp.where(denom == 0, 1, denom)
+    intercept = (sv - slope * st) / n1
+    return slope, intercept, n
+
+
+@functools.partial(jax.jit, static_argnames=("num_cells",))
+def window_holt_winters(vals, has, tsg, hi, num_cells: int, sf, tf):
+    """Double exponential smoothing (Prometheus holt_winters semantics:
+    s0 = x0, b0 = x1 - x0, then s_i = sf*x_i + (1-sf)*(s+b),
+    b_i = tf*(s_i - s_prev) + (1-tf)*b). Sequential over window samples,
+    vectorized over (S, J) via lax.scan along the window axis."""
+    g_vals, g_has, _ = gather_windows(vals, has, tsg, hi, num_cells)
+    dt = vals.dtype
+    # ascending time order: k = L-1 .. 0
+    xs_vals = jnp.flip(g_vals, axis=2)
+    xs_has = jnp.flip(g_has, axis=2)
+    sf = jnp.asarray(sf, dt)
+    tf = jnp.asarray(tf, dt)
+
+    def step(carry, xs):
+        s, b, x_first, cnt = carry
+        x, present = xs
+        # cnt: number of samples consumed so far
+        new_s1 = x  # when this is the first sample
+        new_b1 = jnp.zeros_like(x)
+        # second sample: s = x, b = x - x_first  (Prometheus init)
+        new_s2 = sf * x + (1 - sf) * (s + b)
+        new_b2 = tf * (new_s2 - s) + (1 - tf) * b
+        s_out = jnp.where(
+            present,
+            jnp.where(cnt == 0, new_s1, jnp.where(cnt == 1, x, new_s2)),
+            s,
+        )
+        b_out = jnp.where(
+            present,
+            jnp.where(cnt == 0, new_b1, jnp.where(cnt == 1, x - x_first, new_b2)),
+            b,
+        )
+        x_first = jnp.where(present & (cnt == 0), x, x_first)
+        cnt = cnt + present.astype(jnp.int32)
+        return (s_out, b_out, x_first, cnt), None
+
+    shape = g_vals.shape[:2]
+    init = (
+        jnp.zeros(shape, dt), jnp.zeros(shape, dt), jnp.zeros(shape, dt),
+        jnp.zeros(shape, jnp.int32),
+    )
+    (s, b, _, cnt), _ = jax.lax.scan(
+        step, init,
+        (jnp.moveaxis(xs_vals, 2, 0), jnp.moveaxis(xs_has, 2, 0)),
+    )
+    present = cnt >= 2
+    return jnp.where(present, s, jnp.zeros((), dt)), present
+
+
+# ----------------------------------------------------------------------
+# instant (lookback) selection
+# ----------------------------------------------------------------------
+
+@jax.jit
+def instant_lookback(vals, has, tsg, hi, t_end, lookback_ticks):
+    """Per step, the most recent sample at or before t_end within the
+    lookback delta — PromQL instant-vector selection (reference:
+    /root/reference/src/promql/src/extension_plan/instant_manipulate.rs)."""
+    dt = vals.dtype
+    lastidx = _last_present_idx(has)
+    li = _gather_steps(lastidx, hi)
+    safe = jnp.maximum(li, 0)
+    v = jnp.take_along_axis(vals, safe, axis=1)
+    t = jnp.take_along_axis(tsg, safe, axis=1)
+    # int32-safe freshness test: ts is <= t_end by construction, so the
+    # difference is small and non-positive.
+    age = t_end[None, :] - t
+    fresh = age < jnp.int32(lookback_ticks)
+    present = (li >= 0) & fresh
+    return jnp.where(present, v, jnp.zeros((), dt)), present

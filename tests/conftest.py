@@ -1,0 +1,36 @@
+"""Test configuration: force an 8-virtual-device CPU platform.
+
+The driver validates multi-chip sharding the same way (see
+__graft_entry__.dryrun_multichip). The axon site hook pins
+jax_platforms="axon,cpu"; overriding the config (not just the env var) is
+required to get CPU here.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# f64 available so golden tests can check semantics at Prometheus precision;
+# the engine's device path stays explicitly f32/int32.
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,169 @@
+"""Grid scatter + window kernels vs numpy references."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from greptimedb_tpu.ops import grid as G
+from greptimedb_tpu.ops import window as W
+
+
+def make_series(rng, s=5, points=200, t0=1_700_000_000_000, interval=10_000,
+                drop=0.15):
+    """Irregular per-series samples: (sid, ts, val) sorted by (sid, ts)."""
+    rows = []
+    for sid in range(s):
+        ts = t0 + np.arange(points) * interval
+        keep = rng.random(points) > drop
+        ts = ts[keep]
+        vals = np.cumsum(rng.random(keep.sum()) * 5)  # counter-ish
+        for t, v in zip(ts, vals):
+            rows.append((sid, t, v))
+    rows.sort()
+    sid = np.array([r[0] for r in rows], dtype=np.int32)
+    ts = np.array([r[1] for r in rows], dtype=np.int64)
+    val = np.array([r[2] for r in rows], dtype=np.float64)
+    return sid, ts, val
+
+
+def test_gridspec_cell_convention():
+    spec = G.GridSpec.build(t0=1000, res=10, num_cells=100)
+    # sample exactly at a cell boundary belongs to the cell ending there
+    assert spec.cell_of(1010) == 1
+    assert spec.cell_of(1011) == 2
+    assert spec.cell_of(1020) == 2
+    assert spec.cell_of(1000) == 0
+    assert spec.cell_of(1001) == 1
+
+
+def test_gridify_last_wins(rng):
+    spec = G.GridSpec.build(t0=0, res=10, num_cells=10)
+    # two samples in the same cell: later row index wins
+    sid = np.array([0, 0], dtype=np.int32)
+    ts = np.array([13, 17], dtype=np.int64)
+    cell = spec.cell_of(ts).astype(np.int32)
+    tsr = spec.device_ts(ts)
+    vals, has, tsg = G.gridify(
+        jnp.array(sid), jnp.array(cell), jnp.array(tsr),
+        jnp.array([1.0, 2.0]), jnp.array([True, True]), 1, 10,
+    )
+    assert np.asarray(has)[0, 2]
+    assert np.asarray(vals)[0, 2] == 2.0
+    assert np.asarray(tsg)[0, 2] == 17
+
+
+def test_gridify_roundtrip(rng):
+    sid, ts, val = make_series(rng)
+    t0 = int(ts.min()) - 1
+    res = 10_000
+    num_cells = int((ts.max() - t0 + res - 1) // res) + 1
+    spec = G.GridSpec.build(t0, res, num_cells)
+    cell = spec.cell_of(ts).astype(np.int32)
+    tsr = spec.device_ts(ts)
+    mask = np.ones(len(sid), dtype=bool)
+    vals, has, tsg = G.gridify(
+        jnp.array(sid), jnp.array(cell), jnp.array(tsr), jnp.array(val),
+        jnp.array(mask), 5, num_cells,
+    )
+    vals, has, tsg = map(np.asarray, (vals, has, tsg))
+    assert has.sum() == len(sid)  # no collisions at this res
+    for i in rng.choice(len(sid), 50):
+        s, c = sid[i], cell[i]
+        assert has[s, c]
+        assert vals[s, c] == val[i]
+        assert tsg[s, c] == tsr[i]
+
+
+@pytest.fixture
+def gridded(rng):
+    sid, ts, val = make_series(rng)
+    start = int(ts.min()) + 300_000
+    end = start + 1_000_000
+    step, rng_ms = 60_000, 300_000
+    spec, windows = W.plan_grid_and_windows(start, end, step, rng_ms,
+                                            data_interval_ms=10_000)
+    cell = spec.cell_of(ts).astype(np.int32)
+    tsr = spec.device_ts(ts)
+    mask = np.ones(len(sid), dtype=bool)
+    vals, has, tsg = G.gridify(
+        jnp.array(sid), jnp.array(cell), jnp.array(tsr), jnp.array(val),
+        jnp.array(mask), 5, spec.num_cells,
+    )
+    return (sid, ts, val), spec, windows, (vals, has, tsg)
+
+
+def window_samples(rows, spec, windows, s, j):
+    """Reference: samples of series s with ts in (t_end - range, t_end]."""
+    sid, ts, val = rows
+    t_end_ms = spec.t0 + int(windows.t_end[j]) * spec.unit
+    t_lo_ms = t_end_ms - windows.range_ticks * spec.unit
+    sel = (sid == s) & (ts > t_lo_ms) & (ts <= t_end_ms)
+    return ts[sel], val[sel]
+
+
+def test_window_count_sum_avg(gridded):
+    rows, spec, windows, (vals, has, tsg) = gridded
+    lo, hi = jnp.array(windows.lo), jnp.array(windows.hi)
+    cnt = np.asarray(W.window_count(has, lo, hi))
+    ssum, _ = W.window_sum(vals, has, lo, hi)
+    ssum = np.asarray(ssum)
+    for s in range(5):
+        for j in range(0, windows.num_steps, 3):
+            wts, wv = window_samples(rows, spec, windows, s, j)
+            assert cnt[s, j] == len(wts), (s, j)
+            np.testing.assert_allclose(ssum[s, j], wv.sum(), rtol=1e-12)
+
+
+def test_window_first_last(gridded):
+    rows, spec, windows, (vals, has, tsg) = gridded
+    lo, hi = jnp.array(windows.lo), jnp.array(windows.hi)
+    lv, lt, lp = W.window_last(vals, has, tsg, lo, hi)
+    fv, ft, fp = W.window_first(vals, has, tsg, lo, hi)
+    lv, lp, fv, fp = map(np.asarray, (lv, lp, fv, fp))
+    for s in range(5):
+        for j in range(windows.num_steps):
+            wts, wv = window_samples(rows, spec, windows, s, j)
+            if len(wts):
+                assert lp[s, j] and fp[s, j]
+                assert lv[s, j] == wv[-1]
+                assert fv[s, j] == wv[0]
+            else:
+                assert not lp[s, j] and not fp[s, j]
+
+
+def test_window_minmax_quantile(gridded):
+    rows, spec, windows, (vals, has, tsg) = gridded
+    hi = jnp.array(windows.hi)
+    l_cells = windows.num_cells_per_window
+    mn, mp = W.window_minmax(vals, has, tsg, hi, l_cells, "min")
+    mx, _ = W.window_minmax(vals, has, tsg, hi, l_cells, "max")
+    md, qp = W.window_quantile(vals, has, tsg, hi, l_cells, 0.5)
+    mn, mx, md, mp = map(np.asarray, (mn, mx, md, mp))
+    for s in range(5):
+        for j in range(0, windows.num_steps, 4):
+            wts, wv = window_samples(rows, spec, windows, s, j)
+            if len(wts):
+                np.testing.assert_allclose(mn[s, j], wv.min(), rtol=1e-12)
+                np.testing.assert_allclose(mx[s, j], wv.max(), rtol=1e-12)
+                np.testing.assert_allclose(
+                    md[s, j], np.quantile(wv, 0.5), rtol=1e-9
+                )
+
+
+def test_instant_lookback(gridded):
+    rows, spec, windows, (vals, has, tsg) = gridded
+    sid, ts, val = rows
+    hi = jnp.array(windows.hi)
+    t_end = jnp.array(windows.t_end)
+    lookback = 300_000 // spec.unit
+    v, p = W.instant_lookback(vals, has, tsg, hi, t_end, lookback)
+    v, p = np.asarray(v), np.asarray(p)
+    for s in range(5):
+        for j in range(windows.num_steps):
+            t_end_ms = spec.t0 + int(windows.t_end[j]) * spec.unit
+            sel = (sid == s) & (ts <= t_end_ms) & (ts > t_end_ms - 300_000)
+            if sel.any():
+                assert p[s, j]
+                np.testing.assert_allclose(v[s, j], val[sel][-1], rtol=1e-12)
+            else:
+                assert not p[s, j]

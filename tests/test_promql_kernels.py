@@ -1,0 +1,281 @@
+"""PromQL function kernels vs a straight-line numpy port of Prometheus
+semantics (functions.go extrapolatedRate et al)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from greptimedb_tpu.ops import grid as G
+from greptimedb_tpu.ops import promql as P
+from greptimedb_tpu.ops import window as W
+
+
+def ref_extrapolated_rate(ts_ms, vals, t_end_ms, range_ms, is_counter, is_rate):
+    """Numpy reference for Prometheus extrapolatedRate."""
+    t_start_ms = t_end_ms - range_ms
+    sel = (ts_ms > t_start_ms) & (ts_ms <= t_end_ms)
+    ts_w, v_w = ts_ms[sel], vals[sel]
+    if len(ts_w) < 2:
+        return None
+    result = v_w[-1] - v_w[0]
+    if is_counter:
+        for a, b in zip(v_w[:-1], v_w[1:]):
+            if b < a:
+                result += a
+    dur_start = (ts_w[0] - t_start_ms) / 1000.0
+    dur_end = (t_end_ms - ts_w[-1]) / 1000.0
+    sampled = (ts_w[-1] - ts_w[0]) / 1000.0
+    avg_dur = sampled / (len(ts_w) - 1)
+    if is_counter and result > 0 and v_w[0] >= 0:
+        dur_zero = sampled * (v_w[0] / result)
+        dur_start = min(dur_start, dur_zero)
+    thresh = avg_dur * 1.1
+    extr = sampled
+    extr += dur_start if dur_start < thresh else avg_dur / 2
+    extr += dur_end if dur_end < thresh else avg_dur / 2
+    factor = extr / sampled
+    out = result * factor
+    if is_rate:
+        out /= range_ms / 1000.0
+    return out
+
+
+def build(rng, *, reset=False, s=4, points=150):
+    t0 = 1_700_000_000_000
+    rows = []
+    for sid in range(s):
+        ts = t0 + np.arange(points) * 10_000 + sid * 1000
+        keep = rng.random(points) > 0.2
+        ts = ts[keep]
+        inc = rng.random(keep.sum()) * 5
+        vals = np.cumsum(inc)
+        if reset:
+            # inject counter resets
+            cut = len(vals) // 2
+            vals[cut:] = np.cumsum(inc[cut:])
+        rows.extend((sid, int(t), float(v)) for t, v in zip(ts, vals))
+    rows.sort()
+    sid = np.array([r[0] for r in rows], dtype=np.int32)
+    ts = np.array([r[1] for r in rows], dtype=np.int64)
+    val = np.array([r[2] for r in rows], dtype=np.float64)
+
+    start = t0 + 400_000
+    end = t0 + 1_200_000
+    step, range_ms = 30_000, 120_000
+    spec, windows = W.plan_grid_and_windows(start, end, step, range_ms,
+                                            data_interval_ms=1000)
+    cell = spec.cell_of(ts).astype(np.int32)
+    tsr = spec.device_ts(ts)
+    vals_g, has, tsg = G.gridify(
+        jnp.array(sid), jnp.array(cell), jnp.array(tsr), jnp.array(val),
+        jnp.array(np.ones(len(sid), bool)), s, spec.num_cells,
+    )
+    steps_ms = np.arange(start, end + 1, step)
+    return (sid, ts, val), spec, windows, (vals_g, has, tsg), steps_ms, range_ms
+
+
+@pytest.mark.parametrize("fn,is_counter,is_rate", [
+    ("rate", True, True), ("increase", True, False), ("delta", False, False),
+])
+@pytest.mark.parametrize("reset", [False, True])
+def test_extrapolated_rate(rng, fn, is_counter, is_rate, reset):
+    rows, spec, windows, gridded, steps_ms, range_ms = build(rng, reset=reset)
+    sid, ts, val = rows
+    out, present = P.eval_range_function(fn, *gridded, windows, spec)
+    out, present = np.asarray(out), np.asarray(present)
+    checked = 0
+    for s in range(4):
+        m = sid == s
+        for j, t_end in enumerate(steps_ms):
+            want = ref_extrapolated_rate(ts[m], val[m], t_end, range_ms,
+                                         is_counter, is_rate)
+            if want is None:
+                assert not present[s, j]
+            else:
+                assert present[s, j]
+                np.testing.assert_allclose(out[s, j], want, rtol=1e-9)
+                checked += 1
+    assert checked > 50
+
+
+def test_changes_resets(rng):
+    rows, spec, windows, gridded, steps_ms, range_ms = build(rng, reset=True)
+    sid, ts, val = rows
+    for fn in ("changes", "resets"):
+        out, present = P.eval_range_function(fn, *gridded, windows, spec)
+        out = np.asarray(out)
+        for s in range(4):
+            m = sid == s
+            for j, t_end in enumerate(steps_ms):
+                sel = (ts[m] > t_end - range_ms) & (ts[m] <= t_end)
+                wv = val[m][sel]
+                if len(wv) == 0:
+                    continue
+                pairs = list(zip(wv[:-1], wv[1:]))
+                if fn == "changes":
+                    want = sum(1 for a, b in pairs if b != a)
+                else:
+                    want = sum(1 for a, b in pairs if b < a)
+                np.testing.assert_allclose(out[s, j], want)
+
+
+def test_idelta_irate(rng):
+    rows, spec, windows, gridded, steps_ms, range_ms = build(rng)
+    sid, ts, val = rows
+    for fn in ("idelta", "irate"):
+        out, present = P.eval_range_function(fn, *gridded, windows, spec)
+        out, present = np.asarray(out), np.asarray(present)
+        for s in range(4):
+            m = sid == s
+            for j, t_end in enumerate(steps_ms):
+                sel = (ts[m] > t_end - range_ms) & (ts[m] <= t_end)
+                wts, wv = ts[m][sel], val[m][sel]
+                if len(wv) < 2:
+                    assert not present[s, j]
+                    continue
+                assert present[s, j]
+                if fn == "idelta":
+                    want = wv[-1] - wv[-2]
+                else:
+                    dv = wv[-1] if wv[-1] < wv[-2] else wv[-1] - wv[-2]
+                    want = dv / ((wts[-1] - wts[-2]) / 1000.0)
+                np.testing.assert_allclose(out[s, j], want, rtol=1e-9)
+
+
+def test_deriv_predict_linear(rng):
+    rows, spec, windows, gridded, steps_ms, range_ms = build(rng)
+    sid, ts, val = rows
+    out, present = P.eval_range_function("deriv", *gridded, windows, spec)
+    pred, _ = P.eval_range_function(
+        "predict_linear", *gridded, windows, spec, args=(600.0,)
+    )
+    out, pred, present = np.asarray(out), np.asarray(pred), np.asarray(present)
+    for s in range(4):
+        m = sid == s
+        for j, t_end in enumerate(steps_ms):
+            sel = (ts[m] > t_end - range_ms) & (ts[m] <= t_end)
+            wts, wv = ts[m][sel], val[m][sel]
+            if len(wv) < 2:
+                assert not present[s, j]
+                continue
+            t = (wts - t_end) / 1000.0
+            slope, intercept = np.polyfit(t, wv, 1)
+            np.testing.assert_allclose(out[s, j], slope, rtol=1e-6)
+            np.testing.assert_allclose(
+                pred[s, j], intercept + slope * 600.0, rtol=1e-6
+            )
+
+
+def test_holt_winters(rng):
+    rows, spec, windows, gridded, steps_ms, range_ms = build(rng)
+    sid, ts, val = rows
+    sf, tf = 0.3, 0.2
+    out, present = P.eval_range_function(
+        "holt_winters", *gridded, windows, spec, args=(sf, tf)
+    )
+    out, present = np.asarray(out), np.asarray(present)
+    for s in range(4):
+        m = sid == s
+        for j, t_end in enumerate(steps_ms[::4]):
+            jj = j * 4
+            sel = (ts[m] > t_end - range_ms) & (ts[m] <= t_end)
+            wv = val[m][sel]
+            if len(wv) < 2:
+                assert not present[s, jj]
+                continue
+            sm, b = wv[1], wv[1] - wv[0]
+            for x in wv[2:]:
+                prev = sm
+                sm = sf * x + (1 - sf) * (sm + b)
+                b = tf * (sm - prev) + (1 - tf) * b
+            np.testing.assert_allclose(out[s, jj], sm, rtol=1e-9)
+
+
+def test_aggr_over_time_family(rng):
+    rows, spec, windows, gridded, steps_ms, range_ms = build(rng)
+    sid, ts, val = rows
+    fams = {
+        "sum_over_time": np.sum, "avg_over_time": np.mean,
+        "min_over_time": np.min, "max_over_time": np.max,
+        "stddev_over_time": lambda x: np.std(x),
+        "stdvar_over_time": lambda x: np.var(x),
+        "last_over_time": lambda x: x[-1],
+        "count_over_time": len,
+    }
+    for fn, ref in fams.items():
+        out, present = P.eval_range_function(fn, *gridded, windows, spec)
+        out, present = np.asarray(out), np.asarray(present)
+        for s in range(4):
+            m = sid == s
+            for j, t_end in enumerate(steps_ms[::5]):
+                jj = j * 5
+                sel = (ts[m] > t_end - range_ms) & (ts[m] <= t_end)
+                wv = val[m][sel]
+                if len(wv) == 0:
+                    assert not present[s, jj], fn
+                    continue
+                np.testing.assert_allclose(
+                    out[s, jj], ref(wv), rtol=1e-8, err_msg=fn
+                )
+
+
+def test_quantile_over_time(rng):
+    rows, spec, windows, gridded, steps_ms, range_ms = build(rng)
+    sid, ts, val = rows
+    out, present = P.eval_range_function(
+        "quantile_over_time", *gridded, windows, spec, args=(0.9,)
+    )
+    out = np.asarray(out)
+    for s in range(4):
+        m = sid == s
+        for j, t_end in enumerate(steps_ms[::5]):
+            jj = j * 5
+            sel = (ts[m] > t_end - range_ms) & (ts[m] <= t_end)
+            wv = val[m][sel]
+            if len(wv):
+                np.testing.assert_allclose(
+                    out[s, jj], np.quantile(wv, 0.9), rtol=1e-9
+                )
+
+
+def test_histogram_quantile():
+    le = jnp.array([0.1, 0.5, 1.0, np.inf])
+    # one histogram: 10 obs <= 0.1, 30 <= 0.5, 60 <= 1.0, 100 total
+    buckets = jnp.array([[10.0, 30.0, 60.0, 100.0]])
+    mask = jnp.ones((1, 4), dtype=bool)
+    out, ok = P.histogram_quantile(le, buckets, mask, 0.5)
+    # rank = 50 -> bucket 2 (0.5, 1.0], frac = (50-30)/30
+    want = 0.5 + (1.0 - 0.5) * (50 - 30) / 30
+    np.testing.assert_allclose(np.asarray(out)[0], want, rtol=1e-12)
+    assert bool(np.asarray(ok)[0])
+    # q=0.05 -> rank 5 in first bucket, interpolate from 0
+    out, _ = P.histogram_quantile(le, buckets, mask, 0.05)
+    np.testing.assert_allclose(np.asarray(out)[0], 0.1 * 5 / 10, rtol=1e-12)
+    # q in +inf bucket -> highest finite bound
+    out, _ = P.histogram_quantile(le, buckets, mask, 0.99)
+    np.testing.assert_allclose(np.asarray(out)[0], 1.0)
+
+
+def test_aggregate_across_series(rng):
+    s, j, g = 12, 7, 3
+    vals = jnp.array(rng.normal(size=(s, j)))
+    present = jnp.array(rng.random((s, j)) > 0.3)
+    gids = jnp.array(rng.integers(0, g, s).astype(np.int32))
+    for op in ("sum", "avg", "min", "max", "count", "stddev"):
+        out, ok = P.aggregate_across_series(vals, present, gids, g, op)
+        out, ok = np.asarray(out), np.asarray(ok)
+        vn, pn, gn = np.asarray(vals), np.asarray(present), np.asarray(gids)
+        for gi in range(g):
+            for jj in range(j):
+                col = vn[(gn == gi), jj]
+                m = pn[(gn == gi), jj]
+                sel = col[m]
+                if len(sel) == 0:
+                    assert not ok[gi, jj]
+                    continue
+                ref = {
+                    "sum": np.sum, "avg": np.mean, "min": np.min,
+                    "max": np.max, "count": len, "stddev": np.std,
+                }[op](sel)
+                np.testing.assert_allclose(out[gi, jj], ref, rtol=1e-9,
+                                           err_msg=op)

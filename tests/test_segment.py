@@ -1,0 +1,135 @@
+"""Segment (group-by) kernels vs numpy references."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from greptimedb_tpu.ops import segment as S
+
+
+@pytest.fixture
+def data(rng):
+    n, g = 1000, 17
+    seg = rng.integers(0, g, n).astype(np.int32)
+    vals = rng.normal(size=n).astype(np.float64)
+    mask = rng.random(n) > 0.1
+    return seg, vals, mask, g
+
+
+def ref_agg(seg, vals, mask, g, fn, empty=0.0):
+    out = np.full(g, empty, dtype=np.float64)
+    for i in range(g):
+        sel = (seg == i) & mask
+        if sel.any():
+            out[i] = fn(vals[sel])
+    return out
+
+
+def test_seg_sum(data):
+    seg, vals, mask, g = data
+    got = np.asarray(S.seg_sum(jnp.array(vals), jnp.array(seg), jnp.array(mask), g))
+    np.testing.assert_allclose(got, ref_agg(seg, vals, mask, g, np.sum), rtol=1e-12)
+
+
+def test_seg_count(data):
+    seg, vals, mask, g = data
+    got = np.asarray(S.seg_count(jnp.array(seg), jnp.array(mask), g))
+    want = np.array([((seg == i) & mask).sum() for i in range(g)])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_seg_min_max(data):
+    seg, vals, mask, g = data
+    gmin = np.asarray(S.seg_min(jnp.array(vals), jnp.array(seg), jnp.array(mask), g))
+    gmax = np.asarray(S.seg_max(jnp.array(vals), jnp.array(seg), jnp.array(mask), g))
+    np.testing.assert_allclose(
+        gmin, ref_agg(seg, vals, mask, g, np.min, empty=np.inf), rtol=1e-12
+    )
+    np.testing.assert_allclose(
+        gmax, ref_agg(seg, vals, mask, g, np.max, empty=-np.inf), rtol=1e-12
+    )
+
+
+def test_seg_mean_var(data):
+    seg, vals, mask, g = data
+    mean, cnt = S.seg_mean(jnp.array(vals), jnp.array(seg), jnp.array(mask), g)
+    want = ref_agg(seg, vals, mask, g, np.mean)
+    present = np.asarray(cnt) > 0
+    np.testing.assert_allclose(np.asarray(mean)[present], want[present], rtol=1e-10)
+
+    var, _ = S.seg_var(jnp.array(vals), jnp.array(seg), jnp.array(mask), g)
+    wantv = ref_agg(seg, vals, mask, g, lambda x: np.var(x))
+    np.testing.assert_allclose(np.asarray(var)[present], wantv[present], rtol=1e-8)
+
+
+def test_seg_last_first(data):
+    seg, vals, mask, g = data
+    last, lp = S.seg_last(jnp.array(vals), jnp.array(seg), jnp.array(mask), g)
+    first, fp = S.seg_last(
+        jnp.array(vals), jnp.array(seg), jnp.array(mask), g, take_first=True
+    )
+    for i in range(g):
+        idx = np.nonzero((seg == i) & mask)[0]
+        if len(idx):
+            assert lp[i] and fp[i]
+            assert last[i] == vals[idx[-1]]
+            assert first[i] == vals[idx[0]]
+        else:
+            assert not lp[i] and not fp[i]
+
+
+def test_seg_argmax(data):
+    seg, vals, mask, g = data
+    am = np.asarray(
+        S.seg_argmax(jnp.array(vals), jnp.array(seg), jnp.array(mask), g)
+    )
+    for i in range(g):
+        idx = np.nonzero((seg == i) & mask)[0]
+        if len(idx):
+            assert vals[am[i]] == vals[idx].max()
+        else:
+            assert am[i] == -1
+
+
+def test_combine_split_codes():
+    c1 = jnp.array([0, 1, 2, 1], dtype=jnp.int32)
+    c2 = jnp.array([3, 0, 2, 2], dtype=jnp.int32)
+    code, total = S.combine_codes([c1, c2], [3, 4])
+    assert total == 12
+    np.testing.assert_array_equal(np.asarray(code), [3, 4, 10, 6])
+    back = S.split_codes(np.asarray(code), [3, 4])
+    np.testing.assert_array_equal(back[0], np.asarray(c1))
+    np.testing.assert_array_equal(back[1], np.asarray(c2))
+
+
+def test_sort_groups(rng):
+    n = 500
+    a = rng.integers(0, 5, n).astype(np.int32)
+    b = rng.integers(0, 7, n).astype(np.int32)
+    mask = rng.random(n) > 0.2
+    order, seg_ids, starts, ng = S.sort_groups([jnp.array(a), jnp.array(b)],
+                                               jnp.array(mask))
+    order, seg_ids, starts = map(np.asarray, (order, seg_ids, starts))
+    ng = int(ng)
+    want_groups = {(int(x), int(y)) for x, y in zip(a[mask], b[mask])}
+    assert ng == len(want_groups)
+    # each valid sorted row's (a,b) must be constant within a segment
+    sa, sb, sm = a[order], b[order], mask[order]
+    seen = {}
+    for i in range(n):
+        if not sm[i]:
+            continue
+        key = seg_ids[i]
+        if key in seen:
+            assert seen[key] == (sa[i], sb[i])
+        else:
+            seen[key] = (sa[i], sb[i])
+    assert len(seen) == ng
+    # aggregate through the sorted segmentation equals a pandas-style groupby
+    vals = rng.normal(size=n)
+    sv = jnp.array(vals[order])
+    agg = np.asarray(S.seg_sum(sv, jnp.array(seg_ids), jnp.array(sm), n))
+    got = {seen[k]: agg[k] for k in seen}
+    for key, total in got.items():
+        sel = (a == key[0]) & (b == key[1]) & mask
+        np.testing.assert_allclose(total, vals[sel].sum(), rtol=1e-12)
