@@ -1,0 +1,3 @@
+"""Flagship device programs ("models") — complete, jit-compiled query
+pipelines used by benchmarks, __graft_entry__, and the physical planner as
+fused fast paths for recognized query shapes."""

@@ -1,0 +1,22 @@
+"""Mesh, shardings and collective kernels — the TPU-native distributed
+communication backend (SURVEY.md §2.7).
+
+The reference scales out with region fan-out over Arrow Flight and merges
+partial results at the frontend (MergeScanExec,
+/root/reference/src/query/src/dist_plan/merge_scan.rs). Here the same roles
+map onto a jax.sharding.Mesh:
+
+- 'shard' axis: series/tag-space sharding — the analog of table regions
+  placed on datanodes (data parallel over the series axis).
+- 'time' axis: time-block sharding — the analog of PartitionRange splitting
+  (sequence parallel over the time axis, with ring halo exchange for
+  windows that cross block boundaries).
+
+Partial per-shard aggregates recombine with psum/pmin/pmax over ICI instead
+of Flight gather; cross-slice/host traffic stays on the RPC plane
+(cluster/rpc.py).
+"""
+
+from greptimedb_tpu.parallel.mesh import AXIS_SHARD, AXIS_TIME, make_mesh
+
+__all__ = ["AXIS_SHARD", "AXIS_TIME", "make_mesh"]

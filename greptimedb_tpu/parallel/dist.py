@@ -1,0 +1,116 @@
+"""Distributed query kernels: shard_map programs with explicit collectives.
+
+The three distribution patterns of the reference, re-expressed over ICI
+(SURVEY.md §2.7 mapping):
+
+1. dist_segment_agg  — commutative aggregate push-down + merge: each series
+   shard computes full-width partial aggregates, psum/pmin/pmax recombines
+   (replaces MergeScanExec + frontend final-aggregate).
+2. halo_exchange     — ring transfer of window-tail cells between adjacent
+   time shards (replaces PartitionRange overlap handling; the sequence-
+   parallel primitive for windows crossing block boundaries).
+3. dist_topk         — per-shard top-k, all_gather, re-select (replaces
+   frontend sort+limit over gathered partials).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from greptimedb_tpu.ops import segment as S
+from greptimedb_tpu.parallel.mesh import AXIS_SHARD, AXIS_TIME
+
+
+def dist_segment_agg(mesh: Mesh, op: str, num_segments: int):
+    """Build a shard_map'd segmented aggregate: rows sharded over AXIS_SHARD,
+    output replicated. op in {sum, count, min, max, mean}."""
+
+    def local(values, seg, mask):
+        if op == "sum":
+            part = S.seg_sum(values, seg, mask, num_segments)
+            return jax.lax.psum(part, AXIS_SHARD)
+        if op == "count":
+            part = S.seg_count(seg, mask, num_segments)
+            return jax.lax.psum(part, AXIS_SHARD)
+        if op == "min":
+            part = S.seg_min(values, seg, mask, num_segments)
+            return jax.lax.pmin(part, AXIS_SHARD)
+        if op == "max":
+            part = S.seg_max(values, seg, mask, num_segments)
+            return jax.lax.pmax(part, AXIS_SHARD)
+        if op == "mean":
+            s = jax.lax.psum(S.seg_sum(values, seg, mask, num_segments),
+                             AXIS_SHARD)
+            c = jax.lax.psum(S.seg_count(seg, mask, num_segments), AXIS_SHARD)
+            return s / jnp.maximum(c, 1).astype(s.dtype)
+        raise ValueError(op)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(AXIS_SHARD), P(AXIS_SHARD), P(AXIS_SHARD)),
+        out_specs=P(),
+        check_rep=False,
+    )
+
+
+def halo_exchange_prev(x: jax.Array, halo: int, axis_name: str = AXIS_TIME):
+    """Prepend the last `halo` cells of the previous time shard (zeros for
+    the first shard). x is the local (S, T_local) block inside shard_map;
+    returns (S, halo + T_local)."""
+    n = jax.lax.axis_size(axis_name)
+    tail = x[:, -halo:]
+    # ring shift: device i receives from i-1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    prev_tail = jax.lax.ppermute(tail, axis_name, perm)
+    idx = jax.lax.axis_index(axis_name)
+    prev_tail = jnp.where(idx == 0, jnp.zeros_like(prev_tail), prev_tail)
+    return jnp.concatenate([prev_tail, x], axis=1)
+
+
+def dist_topk(mesh: Mesh, k: int, *, largest: bool = True):
+    """Distributed top-k over a sharded 1-D value array: local top-k,
+    all_gather the candidates, re-select. Returns (values, global_indices)."""
+
+    def local(values, mask):
+        n_local = values.shape[0]
+        fill = jnp.asarray(-jnp.inf if largest else jnp.inf, values.dtype)
+        v = jnp.where(mask, values, fill)
+        vv = v if largest else -v
+        loc_v, loc_i = jax.lax.top_k(vv, min(k, n_local))
+        shard = jax.lax.axis_index(AXIS_SHARD)
+        glob_i = loc_i + shard * n_local
+        all_v = jax.lax.all_gather(loc_v, AXIS_SHARD).reshape(-1)
+        all_i = jax.lax.all_gather(glob_i, AXIS_SHARD).reshape(-1)
+        top_v, sel = jax.lax.top_k(all_v, k)
+        if not largest:
+            top_v = -top_v
+        return top_v, all_i[sel]
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(AXIS_SHARD), P(AXIS_SHARD)),
+        out_specs=(P(), P()),
+        check_rep=False,
+    )
+
+
+def shard_rows_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for row-oriented scan outputs: rows split over AXIS_SHARD."""
+    return NamedSharding(mesh, P(AXIS_SHARD))
+
+
+def grid_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (S, T) grids: series over AXIS_SHARD, time over
+    AXIS_TIME."""
+    return NamedSharding(mesh, P(AXIS_SHARD, AXIS_TIME))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,33 @@
+"""Device mesh construction for the query engine."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+AXIS_SHARD = "shard"   # series axis (region/data parallel analog)
+AXIS_TIME = "time"     # time-block axis (sequence parallel analog)
+
+
+def make_mesh(
+    devices: list | None = None,
+    *,
+    time_parallel: int = 1,
+) -> Mesh:
+    """Build a (shard, time) mesh over the available devices.
+
+    time_parallel devices are dedicated to time-block parallelism; the rest
+    shard the series axis. time_parallel=1 degenerates to pure series
+    sharding (the common case for aggregate-heavy workloads)."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    assert n % time_parallel == 0, (n, time_parallel)
+    grid = np.asarray(devices).reshape(n // time_parallel, time_parallel)
+    return Mesh(grid, (AXIS_SHARD, AXIS_TIME))
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                (AXIS_SHARD, AXIS_TIME))

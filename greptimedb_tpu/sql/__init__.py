@@ -1,0 +1,6 @@
+"""SQL dialect: hand-written lexer + recursive-descent parser.
+
+Capability counterpart of the reference's sqlparser-rs based dialect
+(/root/reference/src/sql/src/parser.rs): CREATE TABLE with TIME INDEX and
+tag PRIMARY KEY, range queries (ALIGN), TQL, flows, SHOW/DESCRIBE/EXPLAIN,
+and the DML/DQL core."""

@@ -1,0 +1,794 @@
+"""Recursive-descent SQL parser over sql.lexer tokens.
+
+Statement surface mirrors the reference's dialect
+(/root/reference/src/sql/src/parser.rs and statements/): CREATE TABLE with
+TIME INDEX + PRIMARY KEY tag semantics, RANGE queries via ALIGN, TQL, flows,
+views, COPY, SHOW/DESCRIBE/EXPLAIN, USE, and the DML core.
+"""
+
+from __future__ import annotations
+
+import re
+
+from greptimedb_tpu.datatypes.types import ConcreteDataType
+from greptimedb_tpu.errors import InvalidSyntaxError
+from greptimedb_tpu.sql import ast as A
+from greptimedb_tpu.sql.lexer import Tok, Token, tokenize
+
+_INTERVAL_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)\s*(nanosecond|microsecond|millisecond|second|minute|"
+    r"hour|day|week|month|year|ns|us|ms|s|m|h|d|w|y)s?\s*$",
+    re.IGNORECASE,
+)
+
+_UNIT_MS = {
+    "nanosecond": 1e-6, "ns": 1e-6,
+    "microsecond": 1e-3, "us": 1e-3,
+    "millisecond": 1.0, "ms": 1.0,
+    "second": 1000.0, "s": 1000.0,
+    "minute": 60_000.0, "m": 60_000.0,
+    "hour": 3_600_000.0, "h": 3_600_000.0,
+    "day": 86_400_000.0, "d": 86_400_000.0,
+    "week": 604_800_000.0, "w": 604_800_000.0,
+    "month": 2_592_000_000.0, "year": 31_536_000_000.0, "y": 31_536_000_000.0,
+}
+
+
+def parse_interval_ms(text: str) -> int:
+    """'5 minutes', '1h', '30s', also compound '1 hour 30 minutes'."""
+    total = 0.0
+    parts = re.findall(
+        r"(\d+(?:\.\d+)?)\s*([a-zA-Z]+)", text
+    )
+    if not parts:
+        raise InvalidSyntaxError(f"bad interval: {text!r}")
+    for num, unit in parts:
+        unit = unit.lower().rstrip("s") if unit.lower() not in ("s", "ns", "us", "ms") else unit.lower()
+        if unit not in _UNIT_MS:
+            unit2 = unit + "s" if unit + "s" in _UNIT_MS else None
+            if unit2 is None:
+                raise InvalidSyntaxError(f"bad interval unit: {unit!r}")
+            unit = unit2
+        total += float(num) * _UNIT_MS[unit]
+    return int(total)
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # ---- token helpers ------------------------------------------------
+    def peek(self, ahead: int = 0) -> Token:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != Tok.EOF:
+            self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == Tok.IDENT and t.upper in kws
+
+    def eat_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str):
+        if not self.eat_kw(kw):
+            raise InvalidSyntaxError(
+                f"expected {kw} at {self.peek().pos}: got {self.peek().text!r}"
+            )
+
+    def at_op(self, op: str) -> bool:
+        t = self.peek()
+        return t.kind == Tok.OP and t.text == op
+
+    def eat_op(self, op: str) -> bool:
+        if self.at_op(op):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        if not self.eat_op(op):
+            raise InvalidSyntaxError(
+                f"expected {op!r} at {self.peek().pos}: got {self.peek().text!r}"
+            )
+
+    def ident(self) -> str:
+        t = self.next()
+        if t.kind not in (Tok.IDENT, Tok.QIDENT):
+            raise InvalidSyntaxError(f"expected identifier at {t.pos}")
+        return t.text
+
+    def qualified_name(self) -> str:
+        parts = [self.ident()]
+        while self.eat_op("."):
+            parts.append(self.ident())
+        return ".".join(parts)
+
+    # ---- entry --------------------------------------------------------
+    @staticmethod
+    def parse_sql(sql: str) -> list[A.Statement]:
+        p = Parser(sql)
+        stmts = []
+        while p.peek().kind != Tok.EOF:
+            stmts.append(p.statement())
+            while p.eat_op(";"):
+                pass
+        return stmts
+
+    def statement(self) -> A.Statement:
+        t = self.peek()
+        if t.kind != Tok.IDENT:
+            raise InvalidSyntaxError(f"expected statement at {t.pos}")
+        kw = t.upper
+        if kw == "SELECT":
+            return self.select()
+        if kw == "CREATE":
+            return self.create()
+        if kw == "DROP":
+            return self.drop()
+        if kw == "INSERT":
+            return self.insert()
+        if kw == "DELETE":
+            return self.delete()
+        if kw == "SHOW":
+            return self.show()
+        if kw in ("DESCRIBE", "DESC"):
+            self.next()
+            self.eat_kw("TABLE")
+            return A.DescribeTable(self.qualified_name())
+        if kw == "EXPLAIN":
+            self.next()
+            analyze = self.eat_kw("ANALYZE")
+            self.eat_kw("VERBOSE")
+            return A.Explain(self.statement(), analyze=analyze)
+        if kw == "USE":
+            self.next()
+            return A.Use(self.ident())
+        if kw == "TQL":
+            return self.tql()
+        if kw == "ALTER":
+            return self.alter()
+        if kw == "TRUNCATE":
+            self.next()
+            self.eat_kw("TABLE")
+            return A.TruncateTable(self.qualified_name())
+        if kw == "COPY":
+            return self.copy()
+        raise InvalidSyntaxError(f"unsupported statement {t.text!r} at {t.pos}")
+
+    # ---- DDL ----------------------------------------------------------
+    def create(self) -> A.Statement:
+        self.expect_kw("CREATE")
+        if self.eat_kw("DATABASE") or self.eat_kw("SCHEMA"):
+            ine = self._if_not_exists()
+            return A.CreateDatabase(self.ident(), if_not_exists=ine)
+        if self.at_kw("OR"):
+            self.next()
+            self.expect_kw("REPLACE")
+            self.expect_kw("VIEW")
+            name = self.qualified_name()
+            self.expect_kw("AS")
+            return A.CreateView(name, self.select(), or_replace=True)
+        if self.eat_kw("VIEW"):
+            name = self.qualified_name()
+            self.expect_kw("AS")
+            return A.CreateView(name, self.select())
+        if self.eat_kw("FLOW"):
+            ine = self._if_not_exists()
+            name = self.qualified_name()
+            self.expect_kw("SINK")
+            self.expect_kw("TO")
+            sink = self.qualified_name()
+            expire = None
+            if self.eat_kw("EXPIRE"):
+                self.expect_kw("AFTER")
+                expire = parse_interval_ms(self._interval_text()) // 1000
+            comment = None
+            if self.eat_kw("COMMENT"):
+                comment = self.next().text
+            self.expect_kw("AS")
+            return A.CreateFlow(name, sink, self.select(), if_not_exists=ine,
+                                expire_after_s=expire, comment=comment)
+        if self.eat_kw("TABLE"):
+            return self.create_table()
+        if self.eat_kw("EXTERNAL"):
+            self.expect_kw("TABLE")
+            return self.create_table(external=True)
+        raise InvalidSyntaxError(f"unsupported CREATE at {self.peek().pos}")
+
+    def _if_not_exists(self) -> bool:
+        if self.at_kw("IF"):
+            self.next()
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def create_table(self, external: bool = False) -> A.CreateTable:
+        ine = self._if_not_exists()
+        name = self.qualified_name()
+        columns: list[A.ColumnDef] = []
+        time_index: str | None = None
+        primary_keys: list[str] = []
+        if self.eat_op("("):
+            while not self.at_op(")"):
+                if self.at_kw("TIME"):
+                    self.next()
+                    self.expect_kw("INDEX")
+                    self.expect_op("(")
+                    time_index = self.ident()
+                    self.expect_op(")")
+                elif self.at_kw("PRIMARY"):
+                    self.next()
+                    self.expect_kw("KEY")
+                    self.expect_op("(")
+                    primary_keys.append(self.ident())
+                    while self.eat_op(","):
+                        primary_keys.append(self.ident())
+                    self.expect_op(")")
+                else:
+                    columns.append(self.column_def())
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
+        for c in columns:
+            if c.time_index and time_index is None:
+                time_index = c.name
+            if c.primary_key and c.name not in primary_keys:
+                primary_keys.append(c.name)
+        engine = "file" if external else "mito"
+        options: dict = {}
+        partition_cols: list[str] = []
+        partitions: list[A.Expr] = []
+        while True:
+            if self.eat_kw("ENGINE"):
+                self.expect_op("=")
+                engine = self.ident()
+            elif self.at_kw("PARTITION"):
+                self.next()
+                self.expect_kw("ON")
+                self.expect_kw("COLUMNS")
+                self.expect_op("(")
+                partition_cols.append(self.ident())
+                while self.eat_op(","):
+                    partition_cols.append(self.ident())
+                self.expect_op(")")
+                self.expect_op("(")
+                depth = 1
+                # partition exprs parsed as generic expressions separated
+                # by commas at depth 1
+                while depth > 0 and self.peek().kind != Tok.EOF:
+                    if self.at_op(")") and depth == 1:
+                        break
+                    partitions.append(self.expr())
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+            elif self.eat_kw("WITH"):
+                self.expect_op("(")
+                while not self.at_op(")"):
+                    key = self.next().text
+                    self.expect_op("=")
+                    val = self.next().text
+                    options[key.lower()] = val
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+            else:
+                break
+        return A.CreateTable(
+            name=name, columns=columns, time_index=time_index,
+            primary_keys=primary_keys, if_not_exists=ine, engine=engine,
+            options=options, partitions=partitions,
+            partition_columns=partition_cols,
+        )
+
+    def column_def(self) -> A.ColumnDef:
+        name = self.ident()
+        dtype = self.data_type()
+        col = A.ColumnDef(name=name, data_type=dtype)
+        while True:
+            if self.eat_kw("NOT"):
+                self.expect_kw("NULL")
+                col.nullable = False
+            elif self.eat_kw("NULL"):
+                col.nullable = True
+            elif self.at_kw("DEFAULT"):
+                self.next()
+                e = self.expr()
+                col.default = e.value if isinstance(e, A.Literal) else e
+            elif self.at_kw("PRIMARY"):
+                self.next()
+                self.expect_kw("KEY")
+                col.primary_key = True
+            elif self.at_kw("TIME"):
+                self.next()
+                self.expect_kw("INDEX")
+                col.time_index = True
+                col.nullable = False
+            elif self.at_kw("FULLTEXT"):
+                self.next()
+                if self.eat_op("("):  # FULLTEXT(with options)
+                    while not self.eat_op(")"):
+                        self.next()
+                col.fulltext = True
+            elif self.at_kw("COMMENT"):
+                self.next()
+                self.next()
+            else:
+                break
+        return col
+
+    def data_type(self) -> ConcreteDataType:
+        base = self.ident().lower()
+        if self.eat_op("("):
+            arg = self.next().text
+            self.expect_op(")")
+            base = f"{base}({arg})"
+        if self.at_kw("UNSIGNED"):
+            self.next()
+            base = f"{base} unsigned"
+        return ConcreteDataType.from_name(base)
+
+    def drop(self) -> A.Statement:
+        self.expect_kw("DROP")
+        if self.eat_kw("DATABASE") or self.eat_kw("SCHEMA"):
+            ie = self._if_exists()
+            return A.DropDatabase(self.ident(), if_exists=ie)
+        if self.eat_kw("FLOW"):
+            ie = self._if_exists()
+            return A.DropFlow(self.qualified_name(), if_exists=ie)
+        if self.eat_kw("VIEW"):
+            ie = self._if_exists()
+            return A.DropView(self.qualified_name(), if_exists=ie)
+        self.eat_kw("TABLE")
+        ie = self._if_exists()
+        names = [self.qualified_name()]
+        while self.eat_op(","):
+            names.append(self.qualified_name())
+        return A.DropTable(names, if_exists=ie)
+
+    def _if_exists(self) -> bool:
+        if self.at_kw("IF"):
+            self.next()
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def alter(self) -> A.AlterTable:
+        self.expect_kw("ALTER")
+        self.expect_kw("TABLE")
+        name = self.qualified_name()
+        if self.eat_kw("ADD"):
+            self.eat_kw("COLUMN")
+            col = self.column_def()
+            return A.AlterTable(name, "add_column", column=col)
+        if self.eat_kw("DROP"):
+            self.eat_kw("COLUMN")
+            return A.AlterTable(name, "drop_column",
+                                old_name=self.ident())
+        if self.eat_kw("RENAME"):
+            self.eat_kw("TO")
+            return A.AlterTable(name, "rename", new_name=self.ident())
+        raise InvalidSyntaxError(f"unsupported ALTER at {self.peek().pos}")
+
+    def copy(self) -> A.Copy:
+        self.expect_kw("COPY")
+        table = self.qualified_name()
+        if self.eat_kw("TO"):
+            direction = "to"
+        else:
+            self.expect_kw("FROM")
+            direction = "from"
+        path = self.next().text
+        fmt = "parquet"
+        options: dict = {}
+        if self.eat_kw("WITH"):
+            self.expect_op("(")
+            while not self.at_op(")"):
+                key = self.next().text.lower()
+                self.expect_op("=")
+                val = self.next().text
+                options[key] = val
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
+            fmt = options.get("format", fmt).lower()
+        return A.Copy(table, direction, path, format=fmt, options=options)
+
+    # ---- TQL ----------------------------------------------------------
+    def tql(self) -> A.Tql:
+        self.expect_kw("TQL")
+        t = self.next()
+        kind = t.upper.lower()
+        if kind not in ("eval", "evaluate", "explain", "analyze"):
+            raise InvalidSyntaxError(f"unsupported TQL {t.text!r}")
+        if kind == "evaluate":
+            kind = "eval"
+        self.expect_op("(")
+        start = self.expr()
+        self.expect_op(",")
+        end = self.expr()
+        self.expect_op(",")
+        step = self.expr()
+        lookback = None
+        if self.eat_op(","):
+            lookback = self.expr()
+        self.expect_op(")")
+        # the rest of the statement text is the raw PromQL query
+        t0 = self.peek()
+        query = self.sql[t0.pos:].strip().rstrip(";")
+        # consume remaining tokens
+        while self.peek().kind != Tok.EOF and not self.at_op(";"):
+            self.next()
+        return A.Tql(kind=kind, start=start, end=end, step=step,
+                     query=query, lookback=lookback)
+
+    # ---- DML ----------------------------------------------------------
+    def insert(self) -> A.Insert:
+        self.expect_kw("INSERT")
+        self.expect_kw("INTO")
+        table = self.qualified_name()
+        columns: list[str] = []
+        if self.eat_op("("):
+            columns.append(self.ident())
+            while self.eat_op(","):
+                columns.append(self.ident())
+            self.expect_op(")")
+        if self.at_kw("SELECT"):
+            return A.Insert(table, columns, [], select=self.select())
+        self.expect_kw("VALUES")
+        values: list[list[A.Expr]] = []
+        while True:
+            self.expect_op("(")
+            row = [self.expr()]
+            while self.eat_op(","):
+                row.append(self.expr())
+            self.expect_op(")")
+            values.append(row)
+            if not self.eat_op(","):
+                break
+        return A.Insert(table, columns, values)
+
+    def delete(self) -> A.Delete:
+        self.expect_kw("DELETE")
+        self.expect_kw("FROM")
+        table = self.qualified_name()
+        where = self.expr() if self.eat_kw("WHERE") else None
+        return A.Delete(table, where)
+
+    # ---- SHOW ---------------------------------------------------------
+    def show(self) -> A.Statement:
+        self.expect_kw("SHOW")
+        full = self.eat_kw("FULL")
+        if self.eat_kw("DATABASES") or self.eat_kw("SCHEMAS"):
+            like = None
+            if self.eat_kw("LIKE"):
+                like = self.next().text
+            return A.ShowDatabases(like=like)
+        if self.eat_kw("TABLES"):
+            like = None
+            db = None
+            if self.eat_kw("FROM") or self.eat_kw("IN"):
+                db = self.ident()
+            if self.eat_kw("LIKE"):
+                like = self.next().text
+            return A.ShowTables(like=like, database=db, full=full)
+        if self.eat_kw("FLOWS"):
+            return A.ShowFlows()
+        if self.eat_kw("CREATE"):
+            self.expect_kw("TABLE")
+            return A.ShowCreateTable(self.qualified_name())
+        raise InvalidSyntaxError(f"unsupported SHOW at {self.peek().pos}")
+
+    # ---- SELECT -------------------------------------------------------
+    def select(self) -> A.Select:
+        self.expect_kw("SELECT")
+        distinct = self.eat_kw("DISTINCT")
+        items = [self.select_item()]
+        while self.eat_op(","):
+            items.append(self.select_item())
+        from_table = None
+        if self.eat_kw("FROM"):
+            from_table = self.qualified_name()
+        where = self.expr() if self.eat_kw("WHERE") else None
+        range_clause = None
+        if self.at_kw("ALIGN"):
+            range_clause = self.align_clause()
+        group_by: list[A.Expr] = []
+        if self.eat_kw("GROUP"):
+            self.expect_kw("BY")
+            group_by.append(self.expr())
+            while self.eat_op(","):
+                group_by.append(self.expr())
+        having = self.expr() if self.eat_kw("HAVING") else None
+        if range_clause is None and self.at_kw("ALIGN"):
+            range_clause = self.align_clause()
+        order_by: list[A.OrderItem] = []
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by.append(self.order_item())
+            while self.eat_op(","):
+                order_by.append(self.order_item())
+        limit = offset = None
+        if self.eat_kw("LIMIT"):
+            limit = int(self.next().text)
+        if self.eat_kw("OFFSET"):
+            offset = int(self.next().text)
+        return A.Select(
+            items=items, from_table=from_table, where=where,
+            group_by=group_by, having=having, order_by=order_by,
+            limit=limit, offset=offset, range_clause=range_clause,
+            distinct=distinct,
+        )
+
+    def align_clause(self) -> A.RangeClause:
+        self.expect_kw("ALIGN")
+        align_ms = parse_interval_ms(self._interval_text())
+        to = None
+        if self.eat_kw("TO"):
+            to = self.next().text
+        by = None
+        if self.eat_kw("BY"):
+            self.expect_op("(")
+            by = [self.expr()]
+            while self.eat_op(","):
+                by.append(self.expr())
+            self.expect_op(")")
+        fill = None
+        if self.eat_kw("FILL"):
+            fill = self.next().text.lower()
+        return A.RangeClause(align_ms=align_ms, to=to, by=by, fill=fill)
+
+    def _interval_text(self) -> str:
+        t = self.next()
+        if t.kind in (Tok.STRING, Tok.NUMBER, Tok.IDENT):
+            # '5m' | '5 minutes' | 5m (ident-number mix)
+            if t.kind == Tok.NUMBER and self.peek().kind == Tok.IDENT:
+                return t.text + self.next().text
+            return t.text
+        raise InvalidSyntaxError(f"expected interval at {t.pos}")
+
+    def select_item(self) -> A.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return A.SelectItem(A.Star())
+        e = self.expr()
+        alias = None
+        if self.eat_kw("AS"):
+            alias = self.ident()
+        elif self.peek().kind in (Tok.IDENT, Tok.QIDENT) and not self.at_kw(
+            "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET",
+            "ALIGN", "UNION", "FILL", "BY", "TO",
+        ):
+            alias = self.ident()
+        return A.SelectItem(e, alias)
+
+    def order_item(self) -> A.OrderItem:
+        e = self.expr()
+        asc = True
+        if self.eat_kw("DESC"):
+            asc = False
+        else:
+            self.eat_kw("ASC")
+        nulls_first = None
+        if self.eat_kw("NULLS"):
+            if self.eat_kw("FIRST"):
+                nulls_first = True
+            else:
+                self.expect_kw("LAST")
+                nulls_first = False
+        return A.OrderItem(e, asc, nulls_first)
+
+    # ---- expressions (precedence climbing) ----------------------------
+    def expr(self) -> A.Expr:
+        return self.or_expr()
+
+    def or_expr(self) -> A.Expr:
+        left = self.and_expr()
+        while self.at_kw("OR"):
+            self.next()
+            left = A.BinaryOp("or", left, self.and_expr())
+        return left
+
+    def and_expr(self) -> A.Expr:
+        left = self.not_expr()
+        while self.at_kw("AND"):
+            self.next()
+            left = A.BinaryOp("and", left, self.not_expr())
+        return left
+
+    def not_expr(self) -> A.Expr:
+        if self.at_kw("NOT"):
+            self.next()
+            return A.UnaryOp("not", self.not_expr())
+        return self.cmp_expr()
+
+    def cmp_expr(self) -> A.Expr:
+        left = self.add_expr()
+        t = self.peek()
+        if t.kind == Tok.OP and t.text in ("=", "!=", "<>", "<", "<=", ">",
+                                           ">=", "=~", "!~"):
+            self.next()
+            op = {"<>": "!=", "=~": "like"}.get(t.text, t.text)
+            return A.BinaryOp(op, left, self.add_expr())
+        if self.at_kw("LIKE"):
+            self.next()
+            return A.BinaryOp("like", left, self.add_expr())
+        if self.at_kw("BETWEEN"):
+            self.next()
+            low = self.add_expr()
+            self.expect_kw("AND")
+            return A.Between(left, low, self.add_expr())
+        if self.at_kw("IN"):
+            self.next()
+            self.expect_op("(")
+            items = [self.expr()]
+            while self.eat_op(","):
+                items.append(self.expr())
+            self.expect_op(")")
+            return A.InList(left, items)
+        if self.at_kw("NOT"):
+            save = self.i
+            self.next()
+            if self.eat_kw("BETWEEN"):
+                low = self.add_expr()
+                self.expect_kw("AND")
+                return A.Between(left, low, self.add_expr(), negated=True)
+            if self.eat_kw("IN"):
+                self.expect_op("(")
+                items = [self.expr()]
+                while self.eat_op(","):
+                    items.append(self.expr())
+                self.expect_op(")")
+                return A.InList(left, items, negated=True)
+            if self.eat_kw("LIKE"):
+                return A.UnaryOp(
+                    "not", A.BinaryOp("like", left, self.add_expr())
+                )
+            self.i = save
+        if self.at_kw("IS"):
+            self.next()
+            negated = self.eat_kw("NOT")
+            self.expect_kw("NULL")
+            return A.IsNull(left, negated=negated)
+        return left
+
+    def add_expr(self) -> A.Expr:
+        left = self.mul_expr()
+        while True:
+            t = self.peek()
+            if t.kind == Tok.OP and t.text in ("+", "-", "||"):
+                self.next()
+                left = A.BinaryOp(t.text, left, self.mul_expr())
+            else:
+                return left
+
+    def mul_expr(self) -> A.Expr:
+        left = self.unary()
+        while True:
+            t = self.peek()
+            if t.kind == Tok.OP and t.text in ("*", "/", "%"):
+                self.next()
+                left = A.BinaryOp(t.text, left, self.unary())
+            else:
+                return left
+
+    def unary(self) -> A.Expr:
+        if self.at_op("-"):
+            self.next()
+            return A.UnaryOp("-", self.unary())
+        if self.at_op("+"):
+            self.next()
+            return self.unary()
+        return self.postfix()
+
+    def postfix(self) -> A.Expr:
+        e = self.primary()
+        while self.eat_op("::"):
+            e = A.Cast(e, self.data_type())
+        return e
+
+    def primary(self) -> A.Expr:
+        t = self.peek()
+        if t.kind == Tok.NUMBER:
+            self.next()
+            text = t.text
+            if "." in text or "e" in text or "E" in text:
+                return A.Literal(float(text))
+            return A.Literal(int(text))
+        if t.kind == Tok.STRING:
+            self.next()
+            if _INTERVAL_RE.match(t.text):
+                return A.IntervalLit(parse_interval_ms(t.text), t.text)
+            return A.Literal(t.text)
+        if self.eat_op("("):
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if self.at_op("*"):
+            self.next()
+            return A.Star()
+        if t.kind in (Tok.IDENT, Tok.QIDENT):
+            up = t.upper
+            if up == "NULL":
+                self.next()
+                return A.Literal(None)
+            if up == "TRUE":
+                self.next()
+                return A.Literal(True)
+            if up == "FALSE":
+                self.next()
+                return A.Literal(False)
+            if up == "INTERVAL":
+                self.next()
+                return A.IntervalLit(
+                    parse_interval_ms(self._interval_text()), "interval"
+                )
+            if up == "CASE":
+                return self.case_expr()
+            if up == "CAST":
+                self.next()
+                self.expect_op("(")
+                e = self.expr()
+                self.expect_kw("AS")
+                to = self.data_type()
+                self.expect_op(")")
+                return A.Cast(e, to)
+            name = self.qualified_name()
+            if self.at_op("("):
+                return self.func_call(name)
+            if "." in name:
+                parts = name.rsplit(".", 1)
+                return A.Column(parts[1], table=parts[0])
+            return A.Column(name)
+        raise InvalidSyntaxError(
+            f"unexpected token {t.text!r} at {t.pos}"
+        )
+
+    def case_expr(self) -> A.Expr:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.at_kw("WHEN"):
+            operand = self.expr()
+        whens = []
+        while self.eat_kw("WHEN"):
+            cond = self.expr()
+            self.expect_kw("THEN")
+            whens.append((cond, self.expr()))
+        else_ = None
+        if self.eat_kw("ELSE"):
+            else_ = self.expr()
+        self.expect_kw("END")
+        return A.Case(operand, whens, else_)
+
+    def func_call(self, name: str) -> A.Expr:
+        self.expect_op("(")
+        distinct = self.eat_kw("DISTINCT")
+        args: list[A.Expr] = []
+        order_by: list[A.OrderItem] = []
+        if not self.at_op(")"):
+            args.append(self.expr())
+            while self.eat_op(","):
+                args.append(self.expr())
+            if self.eat_kw("ORDER"):
+                self.expect_kw("BY")
+                order_by.append(self.order_item())
+                while self.eat_op(","):
+                    order_by.append(self.order_item())
+        self.expect_op(")")
+        return A.FuncCall(name.lower(), args, distinct=distinct,
+                          order_by=order_by)
+
+
+def parse_sql(sql: str) -> list[A.Statement]:
+    return Parser.parse_sql(sql)

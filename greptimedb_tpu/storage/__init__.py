@@ -1,0 +1,16 @@
+"""LSM region storage engine.
+
+Capability counterpart of the reference's mito2 engine
+(/root/reference/src/mito2/): WAL -> memtable -> Parquet SST flush ->
+TWCS compaction, with a versioned manifest and region-level scan API that
+feeds the device kernels.
+
+Differences from the reference, by TPU-first design:
+- the series registry (tag tuple -> int32 sid) replaces mcmp primary-key
+  encoding; sids are what ship to the device,
+- scans return columnar numpy bundles ready for gridify/segment kernels
+  rather than row iterators,
+- host-side concurrency is a small thread pool (the build machine is
+  1-core; the actor-per-worker model of mito2 worker.rs stays, at reduced
+  width).
+"""

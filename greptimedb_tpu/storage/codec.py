@@ -1,0 +1,44 @@
+"""Binary codec for columnar write batches (WAL payloads, RPC frames).
+
+msgpack envelope with raw numpy buffers — the host-plane wire format
+(capability analog of the reference's Arrow IPC payloads on Flight,
+/root/reference/src/common/grpc/src/flight.rs). Strings travel as lists.
+"""
+
+from __future__ import annotations
+
+import msgpack
+import numpy as np
+
+
+def _pack_array(arr: np.ndarray):
+    if arr.dtype == object:
+        return {"k": "obj", "v": [None if x is None else str(x) for x in arr]}
+    return {
+        "k": "np",
+        "d": arr.dtype.str,
+        "s": list(arr.shape),
+        "v": arr.tobytes(),
+    }
+
+
+def _unpack_array(obj) -> np.ndarray:
+    if obj["k"] == "obj":
+        return np.asarray(obj["v"], dtype=object)
+    return np.frombuffer(obj["v"], dtype=np.dtype(obj["d"])).reshape(obj["s"]).copy()
+
+
+def encode_columns(columns: dict[str, np.ndarray], meta: dict | None = None) -> bytes:
+    return msgpack.packb(
+        {
+            "meta": meta or {},
+            "cols": {name: _pack_array(arr) for name, arr in columns.items()},
+        },
+        use_bin_type=True,
+    )
+
+
+def decode_columns(data: bytes) -> tuple[dict[str, np.ndarray], dict]:
+    obj = msgpack.unpackb(data, raw=False, strict_map_key=False)
+    cols = {name: _unpack_array(a) for name, a in obj["cols"].items()}
+    return cols, obj.get("meta", {})

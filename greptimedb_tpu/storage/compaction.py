@@ -1,0 +1,75 @@
+"""Time-window compaction (TWCS).
+
+Capability counterpart of /root/reference/src/mito2/src/compaction/twcs.rs:
+SSTs are assigned to time windows by their max timestamp; when a window
+accumulates more than `trigger_files` level-0 files, they merge (read,
+dedup, rewrite) into one higher-level file, swapped atomically through the
+manifest.
+"""
+
+from __future__ import annotations
+
+import uuid
+from collections import defaultdict
+
+from greptimedb_tpu.storage.memtable import _concat_rows
+from greptimedb_tpu.storage.region import Region, dedup_rows
+from greptimedb_tpu.storage.sst import read_sst, write_sst
+
+
+def pick_compaction(region: Region) -> list | None:
+    """Pick one window's worth of files to merge, or None."""
+    opts = region.meta.options
+    window = max(opts.compaction_window_ms, 1)
+    by_window: dict[int, list] = defaultdict(list)
+    for meta in region.manifest.state.ssts:
+        if meta.level == 0:
+            by_window[meta.ts_max // window].append(meta)
+    for _win, files in sorted(by_window.items()):
+        if len(files) >= opts.compaction_trigger_files:
+            return files
+    return None
+
+
+def compact_once(region: Region) -> bool:
+    """Run one compaction if triggered. Returns True if work was done.
+
+    Tombstones are KEPT in the merged output (drop_deletes=False): a delete
+    may shadow rows in files outside this merge set (e.g. an older level-1
+    file of the same window); scan-time dedup drops them. The manifest
+    commit re-validates the picked files under the region lock so a
+    concurrent truncate/compact can't resurrect removed data."""
+    with region._lock:
+        files = pick_compaction(region)
+    if not files:
+        return False
+    chunks = []
+    for meta in files:
+        r = read_sst(region.store, meta,
+                     field_names=region.meta.field_names)
+        if r is not None:
+            chunks.append(r)
+    if not chunks:
+        return False
+    rows = _concat_rows(chunks, region.meta.field_names) \
+        if len(chunks) > 1 else chunks[0]
+    if not region.meta.options.append_mode:
+        rows = dedup_rows(rows, merge_mode=region.meta.options.merge_mode,
+                          drop_deletes=False)
+    file_id = uuid.uuid4().hex
+    new_path = f"{region.prefix}/sst/{file_id}.parquet"
+    new_meta = write_sst(region.store, new_path, file_id, rows, level=1)
+    with region._lock:
+        live = {m.file_id for m in region.manifest.state.ssts}
+        if not all(m.file_id in live for m in files):
+            # lost a race with truncate/another compaction: abort
+            region.store.delete(new_path)
+            return False
+        region.manifest.commit({
+            "kind": "compact",
+            "remove_files": [m.file_id for m in files],
+            "add_ssts": [new_meta.to_json()],
+        })
+    for m in files:
+        region.store.delete(m.path)
+    return True

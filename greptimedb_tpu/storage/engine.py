@@ -1,0 +1,138 @@
+"""The region engine: region lifecycle + write-buffer management +
+background maintenance.
+
+Capability counterpart of /root/reference/src/mito2/src/engine.rs +
+flush.rs (WriteBufferManagerImpl global budget, FlushScheduler) + the
+worker actor model (worker.rs) — with a single background maintenance
+thread, sized for this 1-core host; the API is region-id-keyed exactly like
+RegionEngine::handle_request.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+from greptimedb_tpu.errors import RegionNotFoundError
+from greptimedb_tpu.storage.compaction import compact_once
+from greptimedb_tpu.storage.object_store import FsObjectStore, ObjectStore
+from greptimedb_tpu.storage.region import Region, RegionMetadata
+
+
+@dataclass
+class EngineConfig:
+    data_root: str = "./greptimedb_tpu_data"
+    global_write_buffer_bytes: int = 1024 * 1024 * 1024
+    enable_background: bool = True
+    background_interval_s: float = 5.0
+
+
+class TsdbEngine:
+    def __init__(self, config: EngineConfig | None = None,
+                 store: ObjectStore | None = None):
+        self.config = config or EngineConfig()
+        self.store = store or FsObjectStore(self.config.data_root)
+        self._regions: dict[int, Region] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._bg: threading.Thread | None = None
+        if self.config.enable_background:
+            self._bg = threading.Thread(
+                target=self._background_loop, daemon=True,
+                name="engine-maintenance",
+            )
+            self._bg.start()
+
+    # ---- lifecycle ----------------------------------------------------
+    def create_region(self, meta: RegionMetadata) -> Region:
+        with self._lock:
+            assert meta.region_id not in self._regions, meta.region_id
+            region = self._open(meta)
+            self._regions[meta.region_id] = region
+            return region
+
+    def open_region(self, meta: RegionMetadata) -> Region:
+        """Open (possibly existing) region, replaying its WAL."""
+        with self._lock:
+            if meta.region_id in self._regions:
+                return self._regions[meta.region_id]
+            region = self._open(meta)
+            self._regions[meta.region_id] = region
+            return region
+
+    def _open(self, meta: RegionMetadata) -> Region:
+        wal_dir = os.path.join(
+            self.config.data_root, "wal", f"region_{meta.region_id}"
+        )
+        return Region(meta, self.store, wal_dir)
+
+    def close_region(self, region_id: int):
+        with self._lock:
+            region = self._regions.pop(region_id, None)
+        if region:
+            region.flush()
+            region.close()
+
+    def drop_region(self, region_id: int):
+        with self._lock:
+            region = self._regions.pop(region_id, None)
+        if region:
+            region.close()
+            for meta in region.manifest.state.ssts:
+                self.store.delete(meta.path)
+            for m in self.store.list(region.prefix + "/"):
+                self.store.delete(m.path)
+            import shutil
+
+            shutil.rmtree(region.wal.root, ignore_errors=True)
+
+    def region(self, region_id: int) -> Region:
+        with self._lock:
+            try:
+                return self._regions[region_id]
+            except KeyError:
+                raise RegionNotFoundError(
+                    f"region {region_id} not found"
+                ) from None
+
+    def regions(self) -> list[Region]:
+        with self._lock:
+            return list(self._regions.values())
+
+    # ---- maintenance --------------------------------------------------
+    def maybe_flush(self):
+        """Flush regions over their own threshold, plus the largest ones
+        while the global write-buffer budget is exceeded."""
+        regions = self.regions()
+        for r in regions:
+            if r.should_flush:
+                r.flush()
+        total = sum(r.memtable.bytes for r in regions)
+        if total > self.config.global_write_buffer_bytes:
+            for r in sorted(regions, key=lambda r: -r.memtable.bytes):
+                if total <= self.config.global_write_buffer_bytes:
+                    break
+                total -= r.memtable.bytes
+                r.flush()
+
+    def run_maintenance(self):
+        self.maybe_flush()
+        for r in self.regions():
+            compact_once(r)
+
+    def _background_loop(self):
+        while not self._stop.wait(self.config.background_interval_s):
+            try:
+                self.run_maintenance()
+            except Exception:  # pragma: no cover - keep the loop alive
+                import traceback
+
+                traceback.print_exc()
+
+    def close(self):
+        self._stop.set()
+        if self._bg:
+            self._bg.join(timeout=10)
+        for rid in list(self._regions):
+            self.close_region(rid)

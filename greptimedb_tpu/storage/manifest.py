@@ -1,0 +1,137 @@
+"""Region manifest: versioned action log + periodic checkpoints.
+
+Capability counterpart of /root/reference/src/mito2/src/manifest/manager.rs
+(action log, Checkpointer every checkpoint_distance versions). State tracked
+per region: SST list, flushed WAL entry id, series-registry snapshot,
+truncation marker, schema version.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from greptimedb_tpu.storage.object_store import ObjectStore
+from greptimedb_tpu.storage.sst import SstMeta
+
+
+@dataclass
+class ManifestState:
+    ssts: list[SstMeta] = field(default_factory=list)
+    flushed_entry_id: int = -1
+    truncated_entry_id: int = -1
+    series_snapshot: dict | None = None
+    schema: dict | None = None
+    committed_sequence: int = 0
+
+    def to_json(self) -> dict:
+        return {
+            "ssts": [s.to_json() for s in self.ssts],
+            "flushed_entry_id": self.flushed_entry_id,
+            "truncated_entry_id": self.truncated_entry_id,
+            "series_snapshot": self.series_snapshot,
+            "schema": self.schema,
+            "committed_sequence": self.committed_sequence,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "ManifestState":
+        return ManifestState(
+            ssts=[SstMeta.from_json(s) for s in d.get("ssts", [])],
+            flushed_entry_id=d.get("flushed_entry_id", -1),
+            truncated_entry_id=d.get("truncated_entry_id", -1),
+            series_snapshot=d.get("series_snapshot"),
+            schema=d.get("schema"),
+            committed_sequence=d.get("committed_sequence", 0),
+        )
+
+
+def apply_action(state: ManifestState, action: dict) -> None:
+    kind = action["kind"]
+    if kind == "flush":
+        state.ssts.extend(SstMeta.from_json(s) for s in action["add_ssts"])
+        state.flushed_entry_id = action["flushed_entry_id"]
+        state.committed_sequence = action.get(
+            "committed_sequence", state.committed_sequence
+        )
+        if action.get("series_snapshot") is not None:
+            state.series_snapshot = action["series_snapshot"]
+    elif kind == "compact":
+        removed = set(action["remove_files"])
+        state.ssts = [s for s in state.ssts if s.file_id not in removed]
+        state.ssts.extend(SstMeta.from_json(s) for s in action["add_ssts"])
+    elif kind == "truncate":
+        state.ssts = []
+        state.truncated_entry_id = action["truncated_entry_id"]
+        state.series_snapshot = action.get("series_snapshot",
+                                           state.series_snapshot)
+    elif kind == "alter":
+        state.schema = action["schema"]
+    elif kind == "edit":
+        # generic edit: replace any field
+        for k, v in action.get("set", {}).items():
+            setattr(state, k, v)
+    else:
+        raise ValueError(f"unknown manifest action: {kind}")
+
+
+class RegionManifest:
+    """Action files <prefix>/<version>.json; checkpoint at
+    <prefix>/_checkpoint.json covering versions <= its `version`."""
+
+    def __init__(self, store: ObjectStore, prefix: str,
+                 *, checkpoint_distance: int = 16):
+        self.store = store
+        self.prefix = prefix.rstrip("/")
+        self.checkpoint_distance = checkpoint_distance
+        self.state = ManifestState()
+        self.version = -1
+        self._ckpt_version = -1
+        self._load()
+
+    def _path(self, version: int) -> str:
+        return f"{self.prefix}/{version:012d}.json"
+
+    @property
+    def _ckpt_path(self) -> str:
+        return f"{self.prefix}/_checkpoint.json"
+
+    def _load(self):
+        if self.store.exists(self._ckpt_path):
+            obj = json.loads(self.store.read(self._ckpt_path))
+            self.state = ManifestState.from_json(obj["state"])
+            self.version = self._ckpt_version = obj["version"]
+        for meta in self.store.list(self.prefix + "/"):
+            name = meta.path.rsplit("/", 1)[-1]
+            if not name.endswith(".json") or name.startswith("_"):
+                continue
+            v = int(name[:-5])
+            if v <= self.version:
+                continue
+            action = json.loads(self.store.read(meta.path))
+            apply_action(self.state, action)
+            self.version = v
+
+    def commit(self, action: dict) -> int:
+        """Persist one action and apply it; maybe checkpoint."""
+        v = self.version + 1
+        self.store.write(self._path(v), json.dumps(action).encode())
+        apply_action(self.state, action)
+        self.version = v
+        if v - self._ckpt_version >= self.checkpoint_distance:
+            self.checkpoint()
+        return v
+
+    def checkpoint(self):
+        self.store.write(
+            self._ckpt_path,
+            json.dumps({"version": self.version,
+                        "state": self.state.to_json()}).encode(),
+        )
+        # drop covered action files
+        for meta in self.store.list(self.prefix + "/"):
+            name = meta.path.rsplit("/", 1)[-1]
+            if name.endswith(".json") and not name.startswith("_"):
+                if int(name[:-5]) <= self.version:
+                    self.store.delete(meta.path)
+        self._ckpt_version = self.version

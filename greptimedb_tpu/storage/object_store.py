@@ -1,0 +1,136 @@
+"""Object store abstraction (capability of /root/reference/src/object-store,
+which wraps opendal). Backends: local fs and in-memory (tests). The API is
+the minimal surface the engine needs: whole-object read/write/delete/list
+plus ranged reads for Parquet footers."""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+
+
+@dataclass
+class ObjectMeta:
+    path: str
+    size: int
+
+
+class ObjectStore:
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> list[ObjectMeta]:
+        raise NotImplementedError
+
+    # local filesystem path for libraries that need one (pyarrow); memory
+    # backend raises.
+    def local_path(self, path: str) -> str:
+        raise NotImplementedError
+
+
+class FsObjectStore(ObjectStore):
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _abs(self, path: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, path.lstrip("/")))
+        assert p.startswith(self.root), f"path escapes root: {path}"
+        return p
+
+    def read(self, path: str) -> bytes:
+        with open(self._abs(path), "rb") as f:
+            return f.read()
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        with open(self._abs(path), "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    def write(self, path: str, data: bytes) -> None:
+        p = self._abs(path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+
+    def delete(self, path: str) -> None:
+        try:
+            os.remove(self._abs(path))
+        except FileNotFoundError:
+            pass
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._abs(path))
+
+    def list(self, prefix: str) -> list[ObjectMeta]:
+        base = self._abs(prefix)
+        out: list[ObjectMeta] = []
+        if not os.path.isdir(base):
+            return out
+        for dirpath, _dirs, files in os.walk(base):
+            for name in files:
+                if name.endswith(".tmp"):
+                    continue
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, self.root)
+                out.append(ObjectMeta(rel.replace(os.sep, "/"),
+                                      os.path.getsize(full)))
+        out.sort(key=lambda m: m.path)
+        return out
+
+    def local_path(self, path: str) -> str:
+        return self._abs(path)
+
+
+class MemoryObjectStore(ObjectStore):
+    def __init__(self):
+        self._data: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def read(self, path: str) -> bytes:
+        with self._lock:
+            return self._data[path]
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        with self._lock:
+            return self._data[path][offset:offset + length]
+
+    def write(self, path: str, data: bytes) -> None:
+        with self._lock:
+            self._data[path] = bytes(data)
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            self._data.pop(path, None)
+
+    def exists(self, path: str) -> bool:
+        with self._lock:
+            return path in self._data
+
+    def list(self, prefix: str) -> list[ObjectMeta]:
+        with self._lock:
+            return sorted(
+                (ObjectMeta(p, len(d)) for p, d in self._data.items()
+                 if p.startswith(prefix)),
+                key=lambda m: m.path,
+            )
+
+    def local_path(self, path: str) -> str:
+        raise NotImplementedError("memory store has no local paths")

@@ -1,0 +1,330 @@
+"""A storage region: the LSM unit (WAL + memtable + SSTs + manifest).
+
+Capability counterpart of the reference's MitoRegion + RegionWorkerLoop
+write/flush/scan handlers (/root/reference/src/mito2/src/worker/handle_write.rs,
+read/scan_region.rs). Writes hit the WAL first, then the memtable; scans
+merge memtable + pruned SSTs and dedup by (sid, ts) keeping the highest
+sequence — the last-row dedup of read/dedup.rs — then honor deletes.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from greptimedb_tpu.errors import RegionReadonlyError
+from greptimedb_tpu.storage import codec
+from greptimedb_tpu.storage.manifest import RegionManifest
+from greptimedb_tpu.storage.memtable import (
+    OP_DELETE,
+    OP_PUT,
+    ColumnarRows,
+    Memtable,
+    _concat_rows,
+    _slice_rows,
+)
+from greptimedb_tpu.storage.object_store import ObjectStore
+from greptimedb_tpu.storage.series import SeriesRegistry
+from greptimedb_tpu.storage.sst import SstMeta, read_sst, write_sst
+from greptimedb_tpu.storage.wal import RegionWal
+
+
+@dataclass
+class RegionOptions:
+    memtable_window_ms: int | None = 2 * 3600 * 1000
+    flush_rows: int = 2_000_000
+    flush_bytes: int = 256 * 1024 * 1024
+    wal_sync: bool = False
+    compaction_window_ms: int = 2 * 3600 * 1000
+    compaction_trigger_files: int = 4
+    merge_mode: str = "last_row"   # or "last_non_null"
+    append_mode: bool = False      # append-only tables skip dedup
+    ttl_ms: int | None = None
+
+
+@dataclass
+class RegionMetadata:
+    region_id: int
+    table: str
+    tag_names: list[str]
+    field_names: list[str]
+    ts_name: str
+    options: RegionOptions = field(default_factory=RegionOptions)
+
+
+@dataclass
+class ScanResult:
+    """Columnar scan output ready for the device bridge."""
+
+    rows: ColumnarRows | None
+    registry: SeriesRegistry
+    field_names: list[str]
+
+    @property
+    def num_rows(self) -> int:
+        return 0 if self.rows is None else len(self.rows)
+
+
+class Region:
+    def __init__(
+        self,
+        meta: RegionMetadata,
+        store: ObjectStore,
+        wal_dir: str,
+        *,
+        prefix: str | None = None,
+    ):
+        self.meta = meta
+        self.store = store
+        self.prefix = prefix or f"data/region_{meta.region_id}"
+        self.wal = RegionWal(wal_dir, sync=meta.options.wal_sync)
+        self.manifest = RegionManifest(store, f"{self.prefix}/manifest")
+        self.series = (
+            SeriesRegistry.restore(self.manifest.state.series_snapshot)
+            if self.manifest.state.series_snapshot
+            else SeriesRegistry(meta.tag_names)
+        )
+        self.memtable = Memtable(meta.field_names,
+                                 window_ms=meta.options.memtable_window_ms)
+        self._frozen: list[Memtable] = []
+        self._seq = self.manifest.state.committed_sequence
+        self._lock = threading.RLock()
+        self.writable = True
+        self._replay()
+
+    # ------------------------------------------------------------------
+    # write path
+    # ------------------------------------------------------------------
+    def write(
+        self,
+        tag_columns: dict[str, np.ndarray],
+        ts: np.ndarray,
+        fields: dict[str, np.ndarray],
+        *,
+        field_valid: dict[str, np.ndarray] | None = None,
+        op: int = OP_PUT,
+        skip_wal: bool = False,
+    ) -> int:
+        """Append rows. tag_columns: name -> object array of strings.
+        Returns the assigned base sequence."""
+        if not self.writable:
+            raise RegionReadonlyError(f"region {self.meta.region_id} readonly")
+        n = len(ts)
+        with self._lock:
+            base_seq = self._seq
+            self._seq += n
+            if not skip_wal:
+                payload = codec.encode_columns(
+                    {"__ts": np.asarray(ts, np.int64),
+                     **{f"__tag_{k}": np.asarray(v, object)
+                        for k, v in tag_columns.items()},
+                     **{f"__f_{k}": np.asarray(v) for k, v in fields.items()},
+                     **({f"__v_{k}": np.asarray(v, bool)
+                         for k, v in (field_valid or {}).items()})},
+                    meta={"op": op, "base_seq": base_seq},
+                )
+                self.wal.append(payload)
+            self._apply_rows(tag_columns, ts, fields, field_valid, op, base_seq)
+            return base_seq
+
+    def _apply_rows(self, tag_columns, ts, fields, field_valid, op, base_seq):
+        n = len(ts)
+        sids = self.series.intern_rows(
+            [np.asarray(tag_columns[name], object)
+             for name in self.meta.tag_names]
+        )
+        full_fields = {}
+        valids = dict(field_valid) if field_valid else {}
+        for name in self.meta.field_names:
+            if name in fields:
+                full_fields[name] = np.asarray(fields[name])
+            else:
+                full_fields[name] = np.zeros(n, dtype=np.float64)
+                valids[name] = np.zeros(n, dtype=bool)
+        rows = ColumnarRows(
+            sid=sids,
+            ts=np.asarray(ts, np.int64),
+            seq=np.arange(base_seq, base_seq + n, dtype=np.uint64),
+            op=np.full(n, op, dtype=np.uint8),
+            fields=full_fields,
+            field_valid=valids or None,
+        )
+        self.memtable.append(rows)
+
+    def delete(self, tag_columns: dict[str, np.ndarray], ts: np.ndarray) -> int:
+        return self.write(tag_columns, ts, {}, op=OP_DELETE)
+
+    def _replay(self):
+        """Re-apply WAL entries after the flushed id (open/catchup,
+        /root/reference/src/mito2/src/worker/handle_catchup.rs analog)."""
+        from_id = self.manifest.state.flushed_entry_id + 1
+        for entry in self.wal.replay(from_id):
+            cols, meta = codec.decode_columns(entry.payload)
+            ts = cols.pop("__ts")
+            tags = {}
+            fields = {}
+            valids = {}
+            for k, v in cols.items():
+                if k.startswith("__tag_"):
+                    tags[k[6:]] = v
+                elif k.startswith("__f_"):
+                    fields[k[4:]] = v
+                elif k.startswith("__v_"):
+                    valids[k[4:]] = v
+            base_seq = meta["base_seq"]
+            self._apply_rows(tags, ts, fields, valids or None,
+                             meta["op"], base_seq)
+            self._seq = max(self._seq, base_seq + len(ts))
+
+    # ------------------------------------------------------------------
+    # flush
+    # ------------------------------------------------------------------
+    @property
+    def should_flush(self) -> bool:
+        o = self.meta.options
+        return (self.memtable.rows >= o.flush_rows
+                or self.memtable.bytes >= o.flush_bytes)
+
+    def flush(self) -> SstMeta | None:
+        """Freeze the memtable, write an SST, commit manifest, trim WAL."""
+        with self._lock:
+            if self.memtable.is_empty:
+                return None
+            frozen = self.memtable
+            self.memtable = Memtable(
+                self.meta.field_names,
+                window_ms=self.meta.options.memtable_window_ms,
+            )
+            self._frozen.append(frozen)
+            flushed_entry_id = self.wal.next_entry_id - 1
+            seq_now = self._seq
+        rows = frozen.scan()
+        file_id = uuid.uuid4().hex
+        meta = write_sst(
+            self.store, f"{self.prefix}/sst/{file_id}.parquet", file_id, rows
+        )
+        with self._lock:
+            self.manifest.commit({
+                "kind": "flush",
+                "add_ssts": [meta.to_json()],
+                "flushed_entry_id": flushed_entry_id,
+                "committed_sequence": seq_now,
+                "series_snapshot": self.series.snapshot(),
+            })
+            self._frozen.remove(frozen)
+            self.wal.obsolete(flushed_entry_id)
+        return meta
+
+    # ------------------------------------------------------------------
+    # scan
+    # ------------------------------------------------------------------
+    def scan(
+        self,
+        *,
+        ts_min: int | None = None,
+        ts_max: int | None = None,
+        field_names: list[str] | None = None,
+        sids: np.ndarray | None = None,
+        raw: bool = False,
+    ) -> ScanResult:
+        """Merged + deduped scan. Output rows sorted by (sid, ts)."""
+        if self.meta.options.ttl_ms is not None and ts_min is None:
+            import time as _time
+
+            ts_min = int(_time.time() * 1000) - self.meta.options.ttl_ms
+        names = (field_names if field_names is not None
+                 else self.meta.field_names)
+        chunks: list[ColumnarRows] = []
+        with self._lock:
+            ssts = list(self.manifest.state.ssts)
+            tables = [self.memtable] + list(self._frozen)
+        for meta in ssts:
+            r = read_sst(self.store, meta, ts_min=ts_min, ts_max=ts_max,
+                         field_names=names, sids=sids)
+            if r is not None:
+                chunks.append(r)
+        for mt in tables:
+            r = mt.scan(ts_min, ts_max, names)
+            if r is not None:
+                if sids is not None:
+                    sel = np.isin(r.sid, sids)
+                    r = _slice_rows(r, sel) if not sel.all() else r
+                if len(r):
+                    chunks.append(r)
+        if not chunks:
+            return ScanResult(None, self.series, names)
+        rows = _concat_rows(chunks, names) if len(chunks) > 1 else chunks[0]
+        if not raw and not self.meta.options.append_mode:
+            rows = dedup_rows(rows, merge_mode=self.meta.options.merge_mode)
+        else:
+            order = np.lexsort((rows.seq, rows.ts, rows.sid))
+            rows = _slice_rows(rows, order)
+        return ScanResult(rows, self.series, names)
+
+    # ------------------------------------------------------------------
+    def truncate(self):
+        with self._lock:
+            entry_id = self.wal.next_entry_id - 1
+            self.memtable = Memtable(
+                self.meta.field_names,
+                window_ms=self.meta.options.memtable_window_ms,
+            )
+            self._frozen.clear()
+            for s in self.manifest.state.ssts:
+                self.store.delete(s.path)
+            self.manifest.commit({
+                "kind": "truncate",
+                "truncated_entry_id": entry_id,
+                "series_snapshot": self.series.snapshot(),
+            })
+            self.wal.obsolete(entry_id)
+
+    def close(self):
+        self.wal.close()
+
+
+def dedup_rows(rows: ColumnarRows, *, merge_mode: str = "last_row",
+               drop_deletes: bool = True) -> ColumnarRows:
+    """Sort by (sid, ts, seq); keep the highest-seq row per (sid, ts); drop
+    rows whose winner is a delete. last_non_null additionally back-fills
+    null fields from older duplicates of the same key
+    (/root/reference/src/mito2/src/read/dedup.rs semantics)."""
+    order = np.lexsort((rows.seq, rows.ts, rows.sid))
+    r = _slice_rows(rows, order)
+    n = len(r)
+    if n == 0:
+        return r
+    key_change = np.empty(n, dtype=bool)
+    key_change[0] = True
+    key_change[1:] = (r.sid[1:] != r.sid[:-1]) | (r.ts[1:] != r.ts[:-1])
+    # winner of each key-run = its last row (highest seq)
+    last_of_run = np.empty(n, dtype=bool)
+    last_of_run[:-1] = key_change[1:]
+    last_of_run[-1] = True
+
+    if merge_mode == "last_non_null" and r.field_valid is not None:
+        # propagate newest-non-null per field within each key-run
+        run_id = np.cumsum(key_change) - 1
+        for name, vals in r.fields.items():
+            valid = r.field_valid[name]
+            # iterate runs only where the winner has a null (rare path)
+            winners = np.nonzero(last_of_run)[0]
+            for w in winners[~valid[last_of_run]]:
+                rid = run_id[w]
+                i = w - 1
+                while i >= 0 and run_id[i] == rid:
+                    if valid[i]:
+                        vals[w] = vals[i]
+                        valid[w] = True
+                        break
+                    i -= 1
+    keep = last_of_run
+    if drop_deletes:
+        # only safe when the caller merged every file that can hold this
+        # key (scan-time); compaction keeps tombstones so deletes still
+        # shadow rows in files outside the merge set.
+        keep = keep & (r.op != OP_DELETE)
+    return _slice_rows(r, keep)

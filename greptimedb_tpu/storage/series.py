@@ -1,0 +1,145 @@
+"""Per-region series registry: tag-value tuples -> dense int32 series ids.
+
+The TPU-first replacement for the reference's mcmp primary-key encoding
+(/root/reference/src/mito2/src/row_converter.rs:54): instead of an
+order-preserving byte encoding of tags, every distinct tag combination gets
+a dense sid. Sids are what SSTs store and what the device kernels group by;
+tag strings live only here. The registry is persisted through the manifest
+(storage/manifest.py) so SSTs stay decodable after restart.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from greptimedb_tpu.datatypes.batch import Dictionary
+
+
+class SeriesRegistry:
+    def __init__(self, tag_names: list[str]):
+        self.tag_names = list(tag_names)
+        self.dicts = [Dictionary() for _ in tag_names]
+        self._series: dict[tuple, int] = {}
+        self._rows: list[tuple] = []
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    @property
+    def num_series(self) -> int:
+        return len(self._rows)
+
+    def intern_rows(self, tag_columns: list[np.ndarray]) -> np.ndarray:
+        """Map N rows of tag values to sids, creating new series on demand.
+        tag_columns are object arrays aligned with tag_names."""
+        assert len(tag_columns) == len(self.tag_names)
+        n = len(tag_columns[0]) if tag_columns else 0
+        with self._lock:
+            if not tag_columns:
+                # tagless table: single series 0
+                if not self._rows:
+                    self._series[()] = 0
+                    self._rows.append(())
+                return np.zeros(n, dtype=np.int32)
+            codes = [d.intern_array(c) for d, c in zip(self.dicts, tag_columns)]
+            series = self._series
+            rows = self._rows
+            stacked = np.stack(codes, axis=1)
+            # dict work only on distinct tag combinations (same pattern as
+            # Dictionary.intern_array): unique rows, then expand
+            uniq, inv = np.unique(stacked, axis=0, return_inverse=True)
+            uniq_sids = np.empty(len(uniq), dtype=np.int32)
+            for i, row in enumerate(uniq):
+                key = tuple(int(c) for c in row)
+                sid = series.get(key)
+                if sid is None:
+                    sid = len(rows)
+                    series[key] = sid
+                    rows.append(key)
+                uniq_sids[i] = sid
+            return uniq_sids[np.ravel(inv)]
+
+    def lookup_series(self, tags: dict[str, str]) -> int | None:
+        """Exact-match lookup of one series by full tag set."""
+        key = []
+        for name, d in zip(self.tag_names, self.dicts):
+            c = d.lookup(tags.get(name, ""))
+            if c is None:
+                return None
+            key.append(c)
+        return self._series.get(tuple(key))
+
+    def tag_codes(self, tag_name: str) -> np.ndarray:
+        """Per-sid code of one tag column: (num_series,) int32."""
+        i = self.tag_names.index(tag_name)
+        if not self._rows or not self.tag_names:
+            return np.zeros(len(self._rows), dtype=np.int32)
+        return np.asarray([r[i] for r in self._rows], dtype=np.int32)
+
+    def tag_values(self, tag_name: str) -> np.ndarray:
+        """Per-sid decoded value of one tag column: (num_series,) object."""
+        i = self.tag_names.index(tag_name)
+        d = self.dicts[i]
+        return np.asarray([d.decode(r[i]) for r in self._rows], dtype=object)
+
+    def series_tags(self, sid: int) -> dict[str, str]:
+        row = self._rows[sid]
+        return {
+            name: d.decode(code)
+            for name, d, code in zip(self.tag_names, self.dicts, row)
+        }
+
+    def match_sids(self, matchers: list[tuple[str, str, object]]) -> np.ndarray:
+        """Sids whose tags satisfy all matchers (op in {eq, ne, re, nre};
+        value is str or compiled regex). Host-side series pruning — the
+        capability analog of inverted-index applier pruning."""
+        n = len(self._rows)
+        keep = np.ones(n, dtype=bool)
+        for name, op, value in matchers:
+            if name not in self.tag_names:
+                # a missing tag behaves as the empty string on every series
+                if op == "eq":
+                    keep &= value == ""
+                elif op == "ne":
+                    keep &= value != ""
+                elif op == "re":
+                    keep &= bool(value.fullmatch(""))
+                elif op == "nre":
+                    keep &= not value.fullmatch("")
+                continue
+            vals = self.tag_values(name)
+            if op == "eq":
+                keep &= vals == value
+            elif op == "ne":
+                keep &= vals != value
+            elif op == "re":
+                keep &= np.asarray(
+                    [bool(value.fullmatch(str(v))) for v in vals]
+                )
+            elif op == "nre":
+                keep &= np.asarray(
+                    [not value.fullmatch(str(v)) for v in vals]
+                )
+            else:
+                raise ValueError(op)
+        return np.nonzero(keep)[0].astype(np.int32)
+
+    # ---- persistence --------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "tag_names": self.tag_names,
+                "dicts": [d.values for d in self.dicts],
+                "rows": [[int(c) for c in r] for r in self._rows],
+            }
+
+    @staticmethod
+    def restore(obj: dict) -> "SeriesRegistry":
+        reg = SeriesRegistry(obj["tag_names"])
+        reg.dicts = [Dictionary(vals) for vals in obj["dicts"]]
+        reg._rows = [tuple(r) for r in obj["rows"]]
+        reg._series = {r: i for i, r in enumerate(reg._rows)}
+        return reg

@@ -1,0 +1,177 @@
+"""Parquet SST files.
+
+Capability counterpart of the reference's SST layer
+(/root/reference/src/mito2/src/sst/parquet/{writer,reader,format}.rs).
+Internal schema (format.rs:25-43 analog, TPU-first):
+
+    __series int32   dense region-local series id (replaces the mcmp
+                     __primary_key dictionary)
+    __ts     int64   time index, ms
+    __seq    uint64  write sequence (dedup: higher wins)
+    __op     uint8   0=put 1=delete
+    <fields...>      field columns with Arrow validity
+
+Rows inside an SST are sorted by (__series, __ts, __seq). Readers prune row
+groups by __ts and __series min/max statistics before decoding — the
+min-max stage of the reference's pruning order (reader.rs:363-377); the
+inverted-index stage lives in index/ and prunes sids before scan.
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from greptimedb_tpu.storage.memtable import ColumnarRows
+from greptimedb_tpu.storage.object_store import ObjectStore
+
+SERIES_COL = "__series"
+TS_COL = "__ts"
+SEQ_COL = "__seq"
+OP_COL = "__op"
+_INTERNAL = (SERIES_COL, TS_COL, SEQ_COL, OP_COL)
+
+
+@dataclass
+class SstMeta:
+    file_id: str
+    path: str
+    rows: int
+    ts_min: int
+    ts_max: int
+    sid_max: int
+    size_bytes: int
+    level: int = 0
+
+    def to_json(self) -> dict:
+        return self.__dict__.copy()
+
+    @staticmethod
+    def from_json(d: dict) -> "SstMeta":
+        return SstMeta(**d)
+
+
+def sort_rows(rows: ColumnarRows) -> ColumnarRows:
+    order = np.lexsort((rows.seq, rows.ts, rows.sid))
+    from greptimedb_tpu.storage.memtable import _slice_rows
+
+    return _slice_rows(rows, order)
+
+
+def write_sst(
+    store: ObjectStore,
+    path: str,
+    file_id: str,
+    rows: ColumnarRows,
+    *,
+    row_group_rows: int = 256 * 1024,
+    level: int = 0,
+) -> SstMeta:
+    """Write sorted rows as one Parquet object; returns its metadata."""
+    rows = sort_rows(rows)
+    arrays = {
+        SERIES_COL: pa.array(rows.sid, pa.int32()),
+        TS_COL: pa.array(rows.ts, pa.int64()),
+        SEQ_COL: pa.array(rows.seq, pa.uint64()),
+        OP_COL: pa.array(rows.op, pa.uint8()),
+    }
+    for name, vals in rows.fields.items():
+        mask = None
+        if rows.field_valid is not None and name in rows.field_valid:
+            mask = ~rows.field_valid[name]
+        arrays[name] = pa.array(vals, mask=mask)
+    table = pa.table(arrays)
+    buf = io.BytesIO()
+    pq.write_table(
+        table, buf, row_group_size=row_group_rows, compression="zstd",
+        write_statistics=True,
+    )
+    data = buf.getvalue()
+    store.write(path, data)
+    return SstMeta(
+        file_id=file_id,
+        path=path,
+        rows=len(rows),
+        ts_min=int(rows.ts.min()) if len(rows) else 0,
+        ts_max=int(rows.ts.max()) if len(rows) else 0,
+        sid_max=int(rows.sid.max()) if len(rows) else -1,
+        size_bytes=len(data),
+        level=level,
+    )
+
+
+def read_sst(
+    store: ObjectStore,
+    meta: SstMeta,
+    *,
+    ts_min: int | None = None,
+    ts_max: int | None = None,
+    field_names: list[str] | None = None,
+    sids: np.ndarray | None = None,
+) -> ColumnarRows | None:
+    """Read an SST with row-group pruning by __ts stats, then row-filter to
+    the exact range (and optional sid set)."""
+    if ts_min is not None and meta.ts_max < ts_min:
+        return None
+    if ts_max is not None and meta.ts_min > ts_max:
+        return None
+    data = store.read(meta.path)
+    pf = pq.ParquetFile(io.BytesIO(data))
+    md = pf.metadata
+    schema_names = pf.schema_arrow.names
+    wanted_fields = (
+        field_names if field_names is not None
+        else [n for n in schema_names if n not in _INTERNAL]
+    )
+    cols = list(_INTERNAL) + [n for n in wanted_fields if n in schema_names]
+
+    ts_idx = schema_names.index(TS_COL)
+    groups = []
+    for g in range(md.num_row_groups):
+        st = md.row_group(g).column(ts_idx).statistics
+        if st is not None and st.has_min_max:
+            if ts_min is not None and st.max < ts_min:
+                continue
+            if ts_max is not None and st.min > ts_max:
+                continue
+        groups.append(g)
+    if not groups:
+        return None
+    table = pf.read_row_groups(groups, columns=cols)
+
+    sid = np.asarray(table.column(SERIES_COL))
+    ts = np.asarray(table.column(TS_COL))
+    seq = np.asarray(table.column(SEQ_COL))
+    op = np.asarray(table.column(OP_COL))
+    sel = np.ones(len(sid), dtype=bool)
+    if ts_min is not None:
+        sel &= ts >= ts_min
+    if ts_max is not None:
+        sel &= ts <= ts_max
+    if sids is not None:
+        sel &= np.isin(sid, sids)
+    if not sel.any():
+        return None
+
+    fields = {}
+    valids = {}
+    has_nulls = False
+    for name in wanted_fields:
+        if name not in schema_names:
+            continue
+        col = table.column(name)
+        if col.null_count:
+            has_nulls = True
+            valids[name] = np.asarray(col.is_valid())[sel]
+            col = col.fill_null(0)
+        else:
+            valids[name] = np.ones(int(sel.sum()), dtype=bool)
+        fields[name] = np.asarray(col)[sel]
+    return ColumnarRows(
+        sid=sid[sel], ts=ts[sel], seq=seq[sel], op=op[sel],
+        fields=fields, field_valid=valids if has_nulls else None,
+    )

@@ -1,0 +1,379 @@
+"""Storage engine: WAL, memtable, SST, region, flush/replay, compaction."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.storage import codec
+from greptimedb_tpu.storage.compaction import compact_once
+from greptimedb_tpu.storage.engine import EngineConfig, TsdbEngine
+from greptimedb_tpu.storage.memtable import ColumnarRows, Memtable
+from greptimedb_tpu.storage.object_store import FsObjectStore, MemoryObjectStore
+from greptimedb_tpu.storage.region import (
+    Region,
+    RegionMetadata,
+    RegionOptions,
+    dedup_rows,
+)
+from greptimedb_tpu.storage.sst import read_sst, write_sst
+from greptimedb_tpu.storage.wal import RegionWal
+
+
+# ----------------------------------------------------------------------
+# codec
+# ----------------------------------------------------------------------
+
+def test_codec_roundtrip(rng):
+    cols = {
+        "a": rng.normal(size=10),
+        "b": rng.integers(0, 100, 10).astype(np.int64),
+        "s": np.asarray(["x", "y", "z"] * 3 + ["w"], dtype=object),
+    }
+    data = codec.encode_columns(cols, meta={"op": 1})
+    back, meta = codec.decode_columns(data)
+    assert meta["op"] == 1
+    np.testing.assert_array_equal(back["a"], cols["a"])
+    np.testing.assert_array_equal(back["b"], cols["b"])
+    assert list(back["s"]) == list(cols["s"])
+
+
+# ----------------------------------------------------------------------
+# WAL
+# ----------------------------------------------------------------------
+
+def test_wal_append_replay(tmp_path):
+    wal = RegionWal(str(tmp_path / "wal"))
+    ids = [wal.append(f"entry{i}".encode()) for i in range(10)]
+    assert ids == list(range(10))
+    entries = wal.replay()
+    assert [e.entry_id for e in entries] == ids
+    assert entries[3].payload == b"entry3"
+    assert wal.replay(from_id=7) == entries[7:]
+    wal.close()
+    # reopen recovers next id
+    wal2 = RegionWal(str(tmp_path / "wal"))
+    assert wal2.next_entry_id == 10
+    wal2.close()
+
+
+def test_wal_torn_tail(tmp_path):
+    wal = RegionWal(str(tmp_path / "wal"))
+    for i in range(5):
+        wal.append(f"e{i}".encode())
+    wal.close()
+    # corrupt: truncate mid-record
+    segs = wal._segments()
+    with open(segs[-1], "r+b") as f:
+        f.truncate(f.seek(0, 2) - 3)
+    wal2 = RegionWal(str(tmp_path / "wal"))
+    entries = wal2.replay()
+    assert [e.entry_id for e in entries] == [0, 1, 2, 3]
+    # appends continue after the torn record...
+    assert wal2.append(b"recovered") == 4
+    wal2.close()
+    # ...and are still readable on the NEXT replay (torn bytes must have
+    # been truncated at recovery, not appended past)
+    wal3 = RegionWal(str(tmp_path / "wal"))
+    entries = wal3.replay()
+    assert [e.entry_id for e in entries] == [0, 1, 2, 3, 4]
+    assert entries[-1].payload == b"recovered"
+    wal3.close()
+
+
+def test_wal_obsolete(tmp_path):
+    wal = RegionWal(str(tmp_path / "wal"), segment_bytes=64)
+    for i in range(20):
+        wal.append(f"entry-{i:04d}".encode())
+    nsegs = len(wal._segments())
+    assert nsegs > 1
+    wal.obsolete(10)
+    assert len(wal._segments()) < nsegs
+    remaining = wal.replay()
+    assert remaining[-1].entry_id == 19
+    # all entries > 10 still present
+    ids = [e.entry_id for e in remaining]
+    assert set(range(11, 20)) <= set(ids)
+    wal.close()
+
+
+# ----------------------------------------------------------------------
+# memtable
+# ----------------------------------------------------------------------
+
+def _rows(sid, ts, seq, vals, op=0):
+    n = len(sid)
+    return ColumnarRows(
+        sid=np.asarray(sid, np.int32), ts=np.asarray(ts, np.int64),
+        seq=np.asarray(seq, np.uint64), op=np.full(n, op, np.uint8),
+        fields={"v": np.asarray(vals, np.float64)},
+    )
+
+
+def test_memtable_scan_window(rng):
+    mt = Memtable(["v"], window_ms=1000)
+    mt.append(_rows([0, 0, 1], [100, 1500, 2500], [0, 1, 2], [1.0, 2.0, 3.0]))
+    assert mt.rows == 3
+    assert mt.time_range() == (100, 2500)
+    r = mt.scan(ts_min=1000, ts_max=2000)
+    assert len(r) == 1 and r.fields["v"][0] == 2.0
+    r = mt.scan()
+    assert len(r) == 3
+
+
+# ----------------------------------------------------------------------
+# SST
+# ----------------------------------------------------------------------
+
+def test_sst_roundtrip_prune(tmp_path, rng):
+    store = FsObjectStore(str(tmp_path))
+    n = 10_000
+    rows = _rows(
+        rng.integers(0, 50, n), rng.integers(0, 1_000_000, n),
+        np.arange(n), rng.normal(size=n),
+    )
+    meta = write_sst(store, "sst/a.parquet", "a", rows, row_group_rows=1000)
+    assert meta.rows == n
+    r = read_sst(store, meta)
+    assert len(r) == n
+    # sorted by (sid, ts, seq)
+    assert np.all(np.diff(r.sid) >= 0)
+    # range read returns exactly the matching rows
+    r2 = read_sst(store, meta, ts_min=100_000, ts_max=200_000)
+    want = ((rows.ts >= 100_000) & (rows.ts <= 200_000)).sum()
+    assert len(r2) == want
+    assert r2.ts.min() >= 100_000 and r2.ts.max() <= 200_000
+    # time range entirely outside -> None
+    assert read_sst(store, meta, ts_min=2_000_000) is None
+    # sid filter
+    r3 = read_sst(store, meta, sids=np.asarray([3, 7]))
+    assert set(np.unique(r3.sid)) <= {3, 7}
+
+
+def test_sst_null_fields(tmp_path):
+    store = MemoryObjectStore()
+    rows = ColumnarRows(
+        sid=np.zeros(4, np.int32), ts=np.arange(4, dtype=np.int64),
+        seq=np.arange(4, dtype=np.uint64), op=np.zeros(4, np.uint8),
+        fields={"v": np.asarray([1.0, 2.0, 3.0, 4.0])},
+        field_valid={"v": np.asarray([True, False, True, False])},
+    )
+    meta = write_sst(store, "x.parquet", "x", rows)
+    r = read_sst(store, meta)
+    np.testing.assert_array_equal(r.field_valid["v"],
+                                  [True, False, True, False])
+
+
+# ----------------------------------------------------------------------
+# dedup
+# ----------------------------------------------------------------------
+
+def test_dedup_last_row():
+    rows = _rows([0, 0, 0, 1], [10, 10, 20, 10], [1, 5, 2, 3],
+                 [1.0, 99.0, 2.0, 3.0])
+    out = dedup_rows(rows)
+    assert len(out) == 3
+    # (0,10) keeps seq 5 -> 99.0
+    assert out.fields["v"][0] == 99.0
+
+
+def test_dedup_delete_wins():
+    rows = _rows([0, 0], [10, 10], [1, 2], [1.0, 0.0])
+    rows.op[1] = 1  # delete with higher seq
+    out = dedup_rows(rows)
+    assert len(out) == 0
+
+
+def test_dedup_last_non_null():
+    rows = ColumnarRows(
+        sid=np.zeros(2, np.int32), ts=np.asarray([10, 10], np.int64),
+        seq=np.asarray([1, 2], np.uint64), op=np.zeros(2, np.uint8),
+        fields={"a": np.asarray([7.0, 0.0]), "b": np.asarray([1.0, 2.0])},
+        field_valid={"a": np.asarray([True, False]),
+                     "b": np.asarray([True, True])},
+    )
+    out = dedup_rows(rows, merge_mode="last_non_null")
+    assert len(out) == 1
+    assert out.fields["a"][0] == 7.0 and out.field_valid["a"][0]
+    assert out.fields["b"][0] == 2.0
+
+
+# ----------------------------------------------------------------------
+# region
+# ----------------------------------------------------------------------
+
+@pytest.fixture
+def region(tmp_path):
+    meta = RegionMetadata(
+        region_id=1, table="cpu", tag_names=["host", "dc"],
+        field_names=["usage", "load"], ts_name="ts",
+        options=RegionOptions(wal_sync=False),
+    )
+    store = FsObjectStore(str(tmp_path / "data"))
+    r = Region(meta, store, str(tmp_path / "wal"))
+    yield r
+    r.close()
+
+
+def _write_cpu(region, hosts, ts, usage, load=None):
+    n = len(ts)
+    region.write(
+        {"host": np.asarray(hosts, object),
+         "dc": np.asarray(["dc1"] * n, object)},
+        np.asarray(ts, np.int64),
+        {"usage": np.asarray(usage, np.float64),
+         "load": np.asarray(load if load is not None else usage, np.float64)},
+    )
+
+
+def test_region_write_scan(region):
+    _write_cpu(region, ["a", "b", "a"], [100, 100, 200], [1.0, 2.0, 3.0])
+    res = region.scan()
+    assert res.num_rows == 3
+    r = res.rows
+    # series registry maps sids back to tags
+    tags = [res.registry.series_tags(int(s)) for s in r.sid]
+    hosts = [t["host"] for t in tags]
+    assert sorted(zip(hosts, r.ts.tolist())) == [
+        ("a", 100), ("a", 200), ("b", 100)
+    ]
+
+
+def test_region_overwrite_and_delete(region):
+    _write_cpu(region, ["a"], [100], [1.0])
+    _write_cpu(region, ["a"], [100], [9.0])       # overwrite same (series, ts)
+    res = region.scan()
+    assert res.num_rows == 1 and res.rows.fields["usage"][0] == 9.0
+    region.delete({"host": np.asarray(["a"], object),
+                   "dc": np.asarray(["dc1"], object)},
+                  np.asarray([100], np.int64))
+    assert region.scan().num_rows == 0
+
+
+def test_region_flush_and_replay(tmp_path):
+    meta = RegionMetadata(
+        region_id=2, table="cpu", tag_names=["host"],
+        field_names=["v"], ts_name="ts",
+    )
+    store = FsObjectStore(str(tmp_path / "data"))
+    r = Region(meta, store, str(tmp_path / "wal"))
+    r.write({"host": np.asarray(["a", "b"], object)},
+            np.asarray([1, 2], np.int64), {"v": np.asarray([1.0, 2.0])})
+    r.flush()
+    assert len(r.manifest.state.ssts) == 1
+    # unflushed rows live only in WAL+memtable
+    r.write({"host": np.asarray(["c"], object)},
+            np.asarray([3], np.int64), {"v": np.asarray([3.0])})
+    sid_c = int(r.scan().rows.sid[-1])
+    r.close()
+
+    # reopen: flushed from SST, unflushed replayed from WAL, same sids
+    r2 = Region(meta, store, str(tmp_path / "wal"))
+    res = r2.scan()
+    assert res.num_rows == 3
+    assert int(res.rows.sid[-1]) == sid_c
+    assert res.registry.series_tags(sid_c) == {"host": "c"}
+    np.testing.assert_allclose(np.sort(res.rows.fields["v"]), [1, 2, 3])
+    r2.close()
+
+
+def test_region_scan_prunes_by_time(region):
+    _write_cpu(region, ["a"] * 100, list(range(0, 10_000, 100)),
+               np.arange(100, dtype=float))
+    region.flush()
+    res = region.scan(ts_min=5000, ts_max=6000)
+    assert res.num_rows == 11
+    assert res.rows.ts.min() >= 5000 and res.rows.ts.max() <= 6000
+
+
+def test_region_truncate(region):
+    _write_cpu(region, ["a"], [1], [1.0])
+    region.flush()
+    _write_cpu(region, ["a"], [2], [2.0])
+    region.truncate()
+    assert region.scan().num_rows == 0
+    # new writes work after truncate
+    _write_cpu(region, ["a"], [3], [3.0])
+    assert region.scan().num_rows == 1
+
+
+# ----------------------------------------------------------------------
+# compaction
+# ----------------------------------------------------------------------
+
+def test_compaction_merges_window(tmp_path):
+    meta = RegionMetadata(
+        region_id=3, table="t", tag_names=["h"], field_names=["v"],
+        ts_name="ts",
+        options=RegionOptions(compaction_trigger_files=3,
+                              compaction_window_ms=1_000_000),
+    )
+    store = FsObjectStore(str(tmp_path / "data"))
+    r = Region(meta, store, str(tmp_path / "wal"))
+    for i in range(3):
+        r.write({"h": np.asarray(["x"], object)},
+                np.asarray([100 + i], np.int64),
+                {"v": np.asarray([float(i)])})
+        r.flush()
+    assert len(r.manifest.state.ssts) == 3
+    assert compact_once(r)
+    assert len(r.manifest.state.ssts) == 1
+    assert r.manifest.state.ssts[0].level == 1
+    res = r.scan()
+    assert res.num_rows == 3
+    # old files physically deleted
+    assert len(store.list(r.prefix + "/sst/")) == 1
+    r.close()
+
+
+def test_compaction_keeps_tombstones(tmp_path):
+    """A delete must still shadow a put living in an older level-1 file
+    after only level-0 files are compacted."""
+    meta = RegionMetadata(
+        region_id=4, table="t", tag_names=["h"], field_names=["v"],
+        ts_name="ts",
+        options=RegionOptions(compaction_trigger_files=3,
+                              compaction_window_ms=1_000_000),
+    )
+    store = FsObjectStore(str(tmp_path / "data"))
+    r = Region(meta, store, str(tmp_path / "wal"))
+    tags = {"h": np.asarray(["x"], object)}
+    # put lands in a level-1 file
+    for i in range(3):
+        r.write(tags, np.asarray([100], np.int64), {"v": np.asarray([float(i)])})
+        r.flush()
+    assert compact_once(r)
+    assert r.manifest.state.ssts[0].level == 1
+    # delete + two filler flushes trigger a second L0-only compaction
+    r.delete(tags, np.asarray([100], np.int64))
+    r.flush()
+    for i in range(2):
+        r.write(tags, np.asarray([200 + i], np.int64),
+                {"v": np.asarray([9.0])})
+        r.flush()
+    assert compact_once(r)
+    # the deleted row must NOT resurrect
+    res = r.scan()
+    assert 100 not in res.rows.ts.tolist()
+    r.close()
+
+
+# ----------------------------------------------------------------------
+# engine
+# ----------------------------------------------------------------------
+
+def test_engine_lifecycle(tmp_path):
+    eng = TsdbEngine(EngineConfig(data_root=str(tmp_path),
+                                  enable_background=False))
+    meta = RegionMetadata(region_id=10, table="t", tag_names=["h"],
+                          field_names=["v"], ts_name="ts")
+    r = eng.create_region(meta)
+    r.write({"h": np.asarray(["a"], object)}, np.asarray([1], np.int64),
+            {"v": np.asarray([1.0])})
+    eng.maybe_flush()  # below thresholds: no flush
+    assert len(r.manifest.state.ssts) == 0
+    eng.close_region(10)  # flushes on close
+    r2 = eng.open_region(meta)
+    assert r2.scan().num_rows == 1
+    eng.drop_region(10)
+    with pytest.raises(Exception):
+        eng.region(10)
+    eng.close()
