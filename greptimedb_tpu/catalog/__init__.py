@@ -1,0 +1,4 @@
+from greptimedb_tpu.catalog.manager import CatalogManager, TableInfo
+from greptimedb_tpu.catalog.table import Table, TableScanData
+
+__all__ = ["CatalogManager", "TableInfo", "Table", "TableScanData"]

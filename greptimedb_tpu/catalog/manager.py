@@ -1,0 +1,338 @@
+"""Catalog manager: databases -> tables, persisted as JSON metadata.
+
+Capability counterpart of the reference's catalog + table-metadata layer
+(/root/reference/src/catalog/src/kvbackend/, src/common/meta/src/key/): table
+schemas (with TAG/FIELD/TIME INDEX semantics), table-id allocation, and the
+table -> region mapping, persisted through the object store so a restart
+recovers the full catalog and reopens every region (WAL replay included).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field as dc_field
+
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema, SemanticType
+from greptimedb_tpu.datatypes.types import ConcreteDataType
+from greptimedb_tpu.errors import (
+    DatabaseNotFoundError,
+    InvalidArgumentError,
+    TableAlreadyExistsError,
+    TableNotFoundError,
+)
+from greptimedb_tpu.catalog.table import Table
+from greptimedb_tpu.storage.engine import TsdbEngine
+from greptimedb_tpu.storage.region import RegionMetadata, RegionOptions
+
+DEFAULT_CATALOG = "greptime"
+DEFAULT_SCHEMA = "public"
+CATALOG_PATH = "meta/catalog.json"
+
+# region ids pack (table_id, region_seq) like the reference's RegionId
+# (/root/reference/src/store-api/src/storage/descriptors.rs).
+_REGION_SHIFT = 10
+
+
+@dataclass
+class TableInfo:
+    table_id: int
+    name: str
+    database: str
+    schema: Schema
+    engine: str = "mito"
+    options: dict = dc_field(default_factory=dict)
+    num_regions: int = 1
+    created_ms: int = 0
+
+    def region_ids(self) -> list[int]:
+        return [
+            (self.table_id << _REGION_SHIFT) | i for i in range(self.num_regions)
+        ]
+
+    # ---- json ---------------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "table_id": self.table_id,
+            "name": self.name,
+            "database": self.database,
+            "engine": self.engine,
+            "options": self.options,
+            "num_regions": self.num_regions,
+            "created_ms": self.created_ms,
+            "columns": [
+                {
+                    "name": c.name,
+                    "type": c.data_type.name,
+                    "semantic": int(c.semantic_type),
+                    "nullable": c.nullable,
+                    "default": c.default,
+                    "fulltext": c.fulltext,
+                    "inverted_index": c.inverted_index,
+                }
+                for c in self.schema.columns
+            ],
+            "schema_version": self.schema.version,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "TableInfo":
+        cols = [
+            ColumnSchema(
+                name=c["name"],
+                data_type=ConcreteDataType.from_name(c["type"]),
+                semantic_type=SemanticType(c["semantic"]),
+                nullable=c.get("nullable", True),
+                default=c.get("default"),
+                fulltext=c.get("fulltext", False),
+                inverted_index=c.get("inverted_index", False),
+            )
+            for c in d["columns"]
+        ]
+        return TableInfo(
+            table_id=d["table_id"],
+            name=d["name"],
+            database=d["database"],
+            schema=Schema(cols, version=d.get("schema_version", 0)),
+            engine=d.get("engine", "mito"),
+            options=d.get("options", {}),
+            num_regions=d.get("num_regions", 1),
+            created_ms=d.get("created_ms", 0),
+        )
+
+
+def region_options_from_table(options: dict) -> RegionOptions:
+    """SQL WITH(...) options -> region options (TTL, append_mode, merge_mode,
+    compaction windows — the table-option surface of
+    /root/reference/src/mito2/src/region/options.rs)."""
+    opts = RegionOptions()
+    if "ttl" in options:
+        from greptimedb_tpu.sql.parser import parse_interval_ms
+
+        opts.ttl_ms = parse_interval_ms(str(options["ttl"]))
+    if str(options.get("append_mode", "")).lower() in ("true", "1"):
+        opts.append_mode = True
+    if "merge_mode" in options:
+        opts.merge_mode = str(options["merge_mode"])
+    if "compaction.twcs.time_window" in options:
+        from greptimedb_tpu.sql.parser import parse_interval_ms
+
+        opts.compaction_window_ms = parse_interval_ms(
+            str(options["compaction.twcs.time_window"])
+        )
+    return opts
+
+
+class CatalogManager:
+    def __init__(self, engine: TsdbEngine):
+        self.engine = engine
+        self.store = engine.store
+        self._lock = threading.RLock()
+        self._databases: dict[str, dict[str, Table]] = {}
+        self._next_table_id = 1024
+        self._load()
+        if DEFAULT_SCHEMA not in self._databases:
+            self._databases[DEFAULT_SCHEMA] = {}
+            self._persist()
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+    def _load(self):
+        if not self.store.exists(CATALOG_PATH):
+            return
+        doc = json.loads(self.store.read(CATALOG_PATH))
+        self._next_table_id = doc.get("next_table_id", 1024)
+        for db_name, tables in doc.get("databases", {}).items():
+            db = self._databases.setdefault(db_name, {})
+            for tdoc in tables:
+                info = TableInfo.from_json(tdoc)
+                db[info.name] = self._open_table(info)
+
+    def _persist(self):
+        doc = {
+            "next_table_id": self._next_table_id,
+            "databases": {
+                db: [t.info.to_json() for t in tables.values()]
+                for db, tables in self._databases.items()
+            },
+        }
+        self.store.write(CATALOG_PATH, json.dumps(doc).encode())
+
+    def _open_table(self, info: TableInfo) -> Table:
+        regions = []
+        opts = region_options_from_table(info.options)
+        for rid in info.region_ids():
+            meta = RegionMetadata(
+                region_id=rid,
+                table=info.name,
+                tag_names=[c.name for c in info.schema.tag_columns],
+                field_names=[c.name for c in info.schema.field_columns],
+                ts_name=info.schema.time_index.name,
+                options=opts,
+            )
+            regions.append(self.engine.open_region(meta))
+        return Table(info, regions)
+
+    # ------------------------------------------------------------------
+    # databases
+    # ------------------------------------------------------------------
+    def create_database(self, name: str, *, if_not_exists: bool = False):
+        with self._lock:
+            if name in self._databases:
+                if if_not_exists:
+                    return
+                raise InvalidArgumentError(f"database already exists: {name}")
+            self._databases[name] = {}
+            self._persist()
+
+    def drop_database(self, name: str, *, if_exists: bool = False):
+        with self._lock:
+            if name not in self._databases:
+                if if_exists:
+                    return
+                raise DatabaseNotFoundError(f"database not found: {name}")
+            if name == DEFAULT_SCHEMA:
+                raise InvalidArgumentError("cannot drop the public database")
+            for tname in list(self._databases[name]):
+                self.drop_table(name, tname)
+            del self._databases[name]
+            self._persist()
+
+    def database_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._databases)
+
+    def has_database(self, name: str) -> bool:
+        with self._lock:
+            return name in self._databases
+
+    # ------------------------------------------------------------------
+    # tables
+    # ------------------------------------------------------------------
+    def create_table(
+        self,
+        database: str,
+        name: str,
+        schema: Schema,
+        *,
+        engine: str = "mito",
+        options: dict | None = None,
+        num_regions: int = 1,
+        if_not_exists: bool = False,
+    ) -> Table:
+        with self._lock:
+            db = self._db(database)
+            if name in db:
+                if if_not_exists:
+                    return db[name]
+                raise TableAlreadyExistsError(f"table already exists: {name}")
+            schema.time_index  # raises unless a TIME INDEX exists
+            info = TableInfo(
+                table_id=self._next_table_id,
+                name=name,
+                database=database,
+                schema=schema,
+                engine=engine,
+                options=options or {},
+                num_regions=max(1, num_regions),
+                created_ms=int(time.time() * 1000),
+            )
+            self._next_table_id += 1
+            table = self._open_table(info)
+            db[name] = table
+            self._persist()
+            return table
+
+    def drop_table(self, database: str, name: str, *, if_exists: bool = False):
+        with self._lock:
+            db = self._db(database)
+            table = db.pop(name, None)
+            if table is None:
+                if if_exists:
+                    return
+                raise TableNotFoundError(f"table not found: {name}")
+            for rid in table.info.region_ids():
+                self.engine.drop_region(rid)
+            self._persist()
+
+    def table(self, database: str, name: str) -> Table:
+        with self._lock:
+            db = self._db(database)
+            try:
+                return db[name]
+            except KeyError:
+                raise TableNotFoundError(
+                    f"table not found: {database}.{name}"
+                ) from None
+
+    def maybe_table(self, database: str, name: str) -> Table | None:
+        with self._lock:
+            return self._databases.get(database, {}).get(name)
+
+    def table_names(self, database: str) -> list[str]:
+        with self._lock:
+            return sorted(self._db(database))
+
+    def all_tables(self) -> list[Table]:
+        with self._lock:
+            return [
+                t for db in self._databases.values() for t in db.values()
+            ]
+
+    # ------------------------------------------------------------------
+    # alter
+    # ------------------------------------------------------------------
+    def alter_add_column(self, database: str, name: str, col: ColumnSchema):
+        with self._lock:
+            table = self.table(database, name)
+            if col.semantic_type == SemanticType.TIMESTAMP:
+                raise InvalidArgumentError("cannot add a TIME INDEX column")
+            if col.semantic_type == SemanticType.TAG:
+                raise InvalidArgumentError(
+                    "adding TAG columns is not supported (series identity)"
+                )
+            table.info.schema = table.info.schema.with_column(col)
+            for region in table.regions:
+                if col.name not in region.meta.field_names:
+                    region.meta.field_names.append(col.name)
+                    region.memtable.field_names.append(col.name)
+            self._persist()
+
+    def alter_drop_column(self, database: str, name: str, col_name: str):
+        with self._lock:
+            table = self.table(database, name)
+            col = table.info.schema.column(col_name)
+            if not col.is_field:
+                raise InvalidArgumentError(
+                    "only FIELD columns can be dropped"
+                )
+            table.info.schema = table.info.schema.without_column(col_name)
+            for region in table.regions:
+                if col_name in region.meta.field_names:
+                    region.meta.field_names.remove(col_name)
+                if col_name in region.memtable.field_names:
+                    region.memtable.field_names.remove(col_name)
+            self._persist()
+
+    def rename_table(self, database: str, old: str, new: str):
+        with self._lock:
+            db = self._db(database)
+            if new in db:
+                raise TableAlreadyExistsError(f"table already exists: {new}")
+            table = db.pop(old, None)
+            if table is None:
+                raise TableNotFoundError(f"table not found: {old}")
+            table.info.name = new
+            db[new] = table
+            self._persist()
+
+    # ------------------------------------------------------------------
+    def _db(self, database: str) -> dict[str, Table]:
+        try:
+            return self._databases[database]
+        except KeyError:
+            raise DatabaseNotFoundError(
+                f"database not found: {database}"
+            ) from None

@@ -1,0 +1,162 @@
+"""information_schema virtual tables.
+
+Counterpart of /root/reference/src/catalog/src/system_schema/
+information_schema/: tables, columns, region_statistics, flows — generated
+on demand from the catalog, then run through the normal query planner so
+WHERE/ORDER BY/aggregates work on them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from greptimedb_tpu.errors import TableNotFoundError
+from greptimedb_tpu.query.executor import Col, DictSource, QueryResult
+from greptimedb_tpu.query.expr import eval_expr
+from greptimedb_tpu.query.planner import item_name, plan_select
+from greptimedb_tpu.sql import ast as A
+
+
+def _tables_doc(inst) -> dict[str, list]:
+    rows = {
+        "table_catalog": [], "table_schema": [], "table_name": [],
+        "table_type": [], "table_id": [], "engine": [], "region_count": [],
+    }
+    for db in inst.catalog.database_names():
+        for name in inst.catalog.table_names(db):
+            t = inst.catalog.table(db, name)
+            rows["table_catalog"].append("greptime")
+            rows["table_schema"].append(db)
+            rows["table_name"].append(name)
+            rows["table_type"].append("BASE TABLE")
+            rows["table_id"].append(t.info.table_id)
+            rows["engine"].append(t.info.engine)
+            rows["region_count"].append(t.info.num_regions)
+    return rows
+
+
+def _columns_doc(inst) -> dict[str, list]:
+    rows = {
+        "table_catalog": [], "table_schema": [], "table_name": [],
+        "column_name": [], "data_type": [], "semantic_type": [],
+        "is_nullable": [],
+    }
+    for db in inst.catalog.database_names():
+        for name in inst.catalog.table_names(db):
+            t = inst.catalog.table(db, name)
+            for c in t.schema.columns:
+                rows["table_catalog"].append("greptime")
+                rows["table_schema"].append(db)
+                rows["table_name"].append(name)
+                rows["column_name"].append(c.name)
+                rows["data_type"].append(c.data_type.name)
+                rows["semantic_type"].append(
+                    "TIMESTAMP" if c.is_time_index
+                    else ("TAG" if c.is_tag else "FIELD")
+                )
+                rows["is_nullable"].append("Yes" if c.nullable else "No")
+    return rows
+
+
+def _region_statistics_doc(inst) -> dict[str, list]:
+    rows = {
+        "region_id": [], "table_id": [], "region_rows": [],
+        "memtable_size": [], "sst_size": [], "sst_num": [],
+    }
+    for t in inst.catalog.all_tables():
+        for r in t.regions:
+            rows["region_id"].append(r.meta.region_id)
+            rows["table_id"].append(t.info.table_id)
+            rows["region_rows"].append(
+                r.memtable.rows + sum(m.rows for m in r.manifest.state.ssts)
+            )
+            rows["memtable_size"].append(r.memtable.bytes)
+            rows["sst_size"].append(
+                sum(m.size_bytes for m in r.manifest.state.ssts)
+            )
+            rows["sst_num"].append(len(r.manifest.state.ssts))
+    return rows
+
+
+def _schemata_doc(inst) -> dict[str, list]:
+    names = inst.catalog.database_names()
+    return {
+        "catalog_name": ["greptime"] * len(names),
+        "schema_name": names,
+    }
+
+
+def _flows_doc(inst) -> dict[str, list]:
+    rows = {"flow_name": [], "source_table": [], "sink_table": [],
+            "processed_rows": []}
+    if inst.flows is not None:
+        for f in inst.flows.flow_infos():
+            rows["flow_name"].append(f["name"])
+            rows["source_table"].append(f["source_table"])
+            rows["sink_table"].append(f["sink_table"])
+            rows["processed_rows"].append(f["processed_rows"])
+    return rows
+
+
+_PROVIDERS = {
+    "tables": _tables_doc,
+    "columns": _columns_doc,
+    "region_statistics": _region_statistics_doc,
+    "schemata": _schemata_doc,
+    "flows": _flows_doc,
+}
+
+
+def query_information_schema(inst, stmt: A.Select, ctx) -> QueryResult:
+    name = stmt.from_table
+    if "." in name:
+        name = name.split(".", 1)[1]
+    name = name.lower()
+    provider = _PROVIDERS.get(name)
+    if provider is None:
+        raise TableNotFoundError(f"information_schema.{name}")
+    doc = provider(inst)
+    cols = {}
+    n = len(next(iter(doc.values()))) if doc else 0
+    for k, vals in doc.items():
+        if vals and isinstance(vals[0], (int, np.integer)):
+            cols[k] = Col(np.asarray(vals, np.int64))
+        else:
+            cols[k] = Col(np.asarray(vals, object))
+    src = DictSource(cols, n)
+
+    plan = plan_select(stmt, ts_name=None, tag_names=[],
+                       all_columns=list(doc.keys()))
+    if plan.kind != "plain":
+        raise TableNotFoundError(
+            "aggregates over information_schema are not supported yet"
+        )
+    if plan.scan.residual is not None and n:
+        cond = eval_expr(plan.scan.residual, src)
+        mask = cond.values.astype(bool) & cond.valid_mask
+        cols = {
+            k: Col(c.values[mask],
+                   None if c.validity is None else c.validity[mask])
+            for k, c in cols.items()
+        }
+        src = DictSource(cols, int(mask.sum()))
+    names = [nm for _, nm in plan.items]
+    out = [eval_expr(e, src) for e, _ in plan.items]
+    from greptimedb_tpu.query.executor import (
+        _distinct_indices,
+        _slice_result,
+        _sort_indices,
+    )
+
+    if plan.distinct:
+        out = _slice_result(out, _distinct_indices(out))
+    if plan.order_by:
+        order_cols = [eval_expr(o.expr, src) for o in plan.order_by]
+        idx = _sort_indices(order_cols, [o.asc for o in plan.order_by],
+                            [o.nulls_first for o in plan.order_by])
+        out = _slice_result(out, idx)
+    if plan.offset or plan.limit is not None:
+        off = plan.offset or 0
+        end = None if plan.limit is None else off + plan.limit
+        out = _slice_result(out, slice(off, end))
+    return QueryResult(names, out)

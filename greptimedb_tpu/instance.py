@@ -1,0 +1,564 @@
+"""Standalone instance: the statement executor over catalog + query engine.
+
+Capability counterpart of the reference's frontend Instance + operator
+StatementExecutor (/root/reference/src/frontend/src/instance.rs:111,
+src/operator/src/statement.rs:130-312): one entry point that parses SQL,
+dispatches every statement kind, routes DML to storage, and runs queries
+through the planner/executor. Protocol servers (HTTP/gRPC) call into this.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from greptimedb_tpu.catalog import CatalogManager
+from greptimedb_tpu.catalog.manager import region_options_from_table
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema, SemanticType
+from greptimedb_tpu.datatypes.types import ConcreteDataType
+from greptimedb_tpu.errors import (
+    DatabaseNotFoundError,
+    ExecutionError,
+    InvalidArgumentError,
+    PlanError,
+    TableNotFoundError,
+    UnsupportedError,
+)
+from greptimedb_tpu.query.executor import Col, QueryEngine, QueryResult
+from greptimedb_tpu.query.expr import eval_const, parse_ts_literal
+from greptimedb_tpu.query.planner import plan_select
+from greptimedb_tpu.session import QueryContext
+from greptimedb_tpu.sql import ast as A
+from greptimedb_tpu.sql.parser import parse_sql
+from greptimedb_tpu.storage.engine import EngineConfig, TsdbEngine
+
+
+class Output:
+    """Statement execution result: either affected rows or a result set."""
+
+    def __init__(self, *, affected_rows: int | None = None,
+                 result: QueryResult | None = None):
+        self.affected_rows = affected_rows
+        self.result = result
+
+    @staticmethod
+    def rows(n: int) -> "Output":
+        return Output(affected_rows=n)
+
+    @staticmethod
+    def records(r: QueryResult) -> "Output":
+        return Output(result=r)
+
+
+def _result_from_lists(names: list[str], columns: list[list]) -> QueryResult:
+    cols = []
+    for vals in columns:
+        validity = np.asarray([v is not None for v in vals], bool)
+        if all(isinstance(v, (int, np.integer)) or v is None for v in vals):
+            arr = np.asarray([0 if v is None else v for v in vals], np.int64)
+        elif all(isinstance(v, (int, float, np.floating)) or v is None
+                 for v in vals):
+            arr = np.asarray(
+                [0.0 if v is None else float(v) for v in vals], np.float64
+            )
+        else:
+            arr = np.asarray(["" if v is None else v for v in vals], object)
+        cols.append(Col(arr, None if validity.all() else validity))
+    return QueryResult(names, cols)
+
+
+class Standalone:
+    """Single-process database instance (frontend + datanode + flownode in
+    one, like `greptime standalone start`,
+    /root/reference/src/cmd/src/standalone.rs:432)."""
+
+    def __init__(self, data_root: str = "./greptimedb_tpu_data", *,
+                 engine_config: EngineConfig | None = None,
+                 prefer_device: bool | None = None):
+        cfg = engine_config or EngineConfig(data_root=data_root,
+                                            enable_background=False)
+        self.engine = TsdbEngine(cfg)
+        self.catalog = CatalogManager(self.engine)
+        self.query_engine = QueryEngine(prefer_device=prefer_device)
+        self.flows = None  # wired by flow.FlowManager when enabled
+        self._procedures = []
+
+    def close(self):
+        if self.flows is not None:
+            self.flows.stop()
+        self.engine.close()
+
+    # ------------------------------------------------------------------
+    # entry points
+    # ------------------------------------------------------------------
+    def execute_sql(self, sql: str, ctx: QueryContext | None = None
+                    ) -> list[Output]:
+        ctx = ctx or QueryContext()
+        outputs = []
+        for stmt in parse_sql(sql):
+            outputs.append(self.execute_statement(stmt, ctx))
+        return outputs
+
+    def sql(self, sql: str, ctx: QueryContext | None = None) -> QueryResult:
+        """Convenience: single-statement query returning a result set."""
+        outs = self.execute_sql(sql, ctx)
+        out = outs[-1]
+        if out.result is None:
+            return _result_from_lists(
+                ["affected_rows"], [[out.affected_rows or 0]]
+            )
+        return out.result
+
+    # ------------------------------------------------------------------
+    def execute_statement(self, stmt: A.Statement, ctx: QueryContext
+                          ) -> Output:
+        if isinstance(stmt, A.Select):
+            return Output.records(self._select(stmt, ctx))
+        if isinstance(stmt, A.Insert):
+            return Output.rows(self._insert(stmt, ctx))
+        if isinstance(stmt, A.Delete):
+            return Output.rows(self._delete(stmt, ctx))
+        if isinstance(stmt, A.CreateTable):
+            self._create_table(stmt, ctx)
+            return Output.rows(0)
+        if isinstance(stmt, A.CreateDatabase):
+            self.catalog.create_database(
+                stmt.name, if_not_exists=stmt.if_not_exists
+            )
+            return Output.rows(1)
+        if isinstance(stmt, A.DropDatabase):
+            self.catalog.drop_database(stmt.name, if_exists=stmt.if_exists)
+            return Output.rows(0)
+        if isinstance(stmt, A.DropTable):
+            for name in stmt.names:
+                db, tname = self._resolve(name, ctx)
+                self.catalog.drop_table(db, tname, if_exists=stmt.if_exists)
+            return Output.rows(0)
+        if isinstance(stmt, A.TruncateTable):
+            db, tname = self._resolve(stmt.name, ctx)
+            self.catalog.table(db, tname).truncate()
+            return Output.rows(0)
+        if isinstance(stmt, A.AlterTable):
+            return Output.rows(self._alter(stmt, ctx))
+        if isinstance(stmt, A.Use):
+            if not self.catalog.has_database(stmt.database):
+                raise DatabaseNotFoundError(
+                    f"database not found: {stmt.database}"
+                )
+            ctx.database = stmt.database
+            return Output.rows(0)
+        if isinstance(stmt, A.ShowDatabases):
+            return Output.records(self._show_databases(stmt))
+        if isinstance(stmt, A.ShowTables):
+            return Output.records(self._show_tables(stmt, ctx))
+        if isinstance(stmt, A.ShowCreateTable):
+            return Output.records(self._show_create_table(stmt, ctx))
+        if isinstance(stmt, A.DescribeTable):
+            return Output.records(self._describe(stmt, ctx))
+        if isinstance(stmt, A.Explain):
+            return Output.records(self._explain(stmt, ctx))
+        if isinstance(stmt, A.Tql):
+            return Output.records(self._tql(stmt, ctx))
+        if isinstance(stmt, A.CreateFlow):
+            return self._create_flow(stmt, ctx)
+        if isinstance(stmt, A.DropFlow):
+            return self._drop_flow(stmt, ctx)
+        if isinstance(stmt, A.ShowFlows):
+            return Output.records(self._show_flows())
+        raise UnsupportedError(
+            f"statement not supported yet: {type(stmt).__name__}"
+        )
+
+    # ------------------------------------------------------------------
+    # DDL
+    # ------------------------------------------------------------------
+    def _create_table(self, stmt: A.CreateTable, ctx: QueryContext):
+        db, name = self._resolve(stmt.name, ctx)
+        cols = []
+        pk = set(stmt.primary_keys)
+        for cd in stmt.columns:
+            if cd.time_index or (stmt.time_index == cd.name):
+                sem = SemanticType.TIMESTAMP
+            elif cd.primary_key or cd.name in pk:
+                sem = SemanticType.TAG
+            else:
+                sem = SemanticType.FIELD
+            if sem == SemanticType.TAG and not cd.data_type.is_string():
+                # numeric tags are legal in the reference; stored as strings
+                # in the series registry (dense-sid design), decoded on read.
+                pass
+            cols.append(ColumnSchema(
+                name=cd.name, data_type=cd.data_type, semantic_type=sem,
+                nullable=cd.nullable and sem == SemanticType.FIELD,
+                default=cd.default, fulltext=cd.fulltext,
+            ))
+        schema = Schema(cols)
+        num_regions = 1
+        if stmt.partitions:
+            num_regions = max(1, len(stmt.partitions))
+        elif "num_regions" in stmt.options:
+            num_regions = int(stmt.options.pop("num_regions"))
+        self.catalog.create_table(
+            db, name, schema, engine=stmt.engine, options=stmt.options,
+            num_regions=num_regions, if_not_exists=stmt.if_not_exists,
+        )
+
+    def _alter(self, stmt: A.AlterTable, ctx: QueryContext) -> int:
+        db, name = self._resolve(stmt.name, ctx)
+        if stmt.action == "add_column":
+            cd = stmt.column
+            sem = SemanticType.TAG if cd.primary_key else SemanticType.FIELD
+            self.catalog.alter_add_column(db, name, ColumnSchema(
+                name=cd.name, data_type=cd.data_type, semantic_type=sem,
+                nullable=True, default=cd.default,
+            ))
+        elif stmt.action == "drop_column":
+            self.catalog.alter_drop_column(db, name, stmt.old_name)
+        elif stmt.action == "rename":
+            self.catalog.rename_table(db, name, stmt.new_name)
+        else:
+            raise UnsupportedError(f"ALTER action: {stmt.action}")
+        return 0
+
+    # ------------------------------------------------------------------
+    # DML
+    # ------------------------------------------------------------------
+    def _insert(self, stmt: A.Insert, ctx: QueryContext) -> int:
+        db, name = self._resolve(stmt.table, ctx)
+        table = self.catalog.table(db, name)
+        schema = table.schema
+        if stmt.select is not None:
+            res = self._select(stmt.select, ctx)
+            cols = stmt.columns or res.names
+            data = {
+                c: np.asarray(col.values)
+                for c, col in zip(cols, res.cols)
+            }
+            valid = {
+                c: col.valid_mask
+                for c, col in zip(cols, res.cols)
+            }
+            return self._write_columns(table, data, valid)
+
+        cols = stmt.columns or schema.column_names
+        n = len(stmt.values)
+        raw = {c: [] for c in cols}
+        for row in stmt.values:
+            if len(row) != len(cols):
+                raise InvalidArgumentError(
+                    f"INSERT row has {len(row)} values, expected {len(cols)}"
+                )
+            for c, e in zip(cols, row):
+                raw[c].append(eval_const(e))
+        data = {}
+        valid = {}
+        for c, vals in raw.items():
+            col_schema = schema.column(c)
+            arr, v = _coerce_insert(vals, col_schema.data_type)
+            data[c] = arr
+            valid[c] = v
+        written = self._write_columns(table, data, valid)
+        self._notify_flows(db, name, table, data, valid)
+        return written
+
+    def _write_columns(self, table, data: dict, valid: dict) -> int:
+        schema = table.schema
+        ts_name = schema.time_index.name
+        if ts_name not in data:
+            raise InvalidArgumentError(
+                f"INSERT missing TIME INDEX column {ts_name}"
+            )
+        n = len(data[ts_name])
+        tags = {}
+        fields = {}
+        fvalid = {}
+        for cname, arr in data.items():
+            cs = schema.column(cname)
+            if cs.is_time_index:
+                continue
+            if cs.is_tag:
+                tags[cname] = np.asarray(
+                    ["" if v is None else str(v) for v in arr], object
+                )
+            else:
+                fields[cname] = arr
+                if cname in valid and not valid[cname].all():
+                    fvalid[cname] = valid[cname]
+        ts = np.asarray(data[ts_name], np.int64)
+        return table.write(tags, ts, fields, field_valid=fvalid or None)
+
+    def _delete(self, stmt: A.Delete, ctx: QueryContext) -> int:
+        db, name = self._resolve(stmt.table, ctx)
+        table = self.catalog.table(db, name)
+        # select the matching (tags, ts) then write tombstones
+        sel = A.Select(
+            items=[A.SelectItem(A.Column(t)) for t in table.tag_names]
+            + [A.SelectItem(A.Column(table.ts_name))],
+            from_table=stmt.table, where=stmt.where,
+        )
+        res = self._select(sel, ctx)
+        if res.num_rows == 0:
+            return 0
+        tags = {
+            t: np.asarray(res.cols[i].values, object)
+            for i, t in enumerate(table.tag_names)
+        }
+        ts = np.asarray(res.cols[-1].values, np.int64)
+        table.delete(tags, ts)
+        return len(ts)
+
+    def _notify_flows(self, db, name, table, data, valid):
+        if self.flows is not None:
+            self.flows.on_insert(db, name, table, data, valid)
+
+    # ------------------------------------------------------------------
+    # queries
+    # ------------------------------------------------------------------
+    def _select(self, stmt: A.Select, ctx: QueryContext) -> QueryResult:
+        table = None
+        ts_name = None
+        tag_names: list[str] = []
+        all_columns = None
+        if stmt.from_table:
+            if self._is_information_schema(stmt.from_table, ctx):
+                return self._query_information_schema(stmt, ctx)
+            db, name = self._resolve(stmt.from_table, ctx)
+            table = self.catalog.table(db, name)
+            ts_name = table.ts_name
+            tag_names = table.tag_names
+            all_columns = table.schema.column_names
+        plan = plan_select(
+            stmt, ts_name=ts_name, tag_names=tag_names,
+            all_columns=all_columns,
+        )
+        return self.query_engine.execute(plan, table)
+
+    def plan(self, stmt: A.Select, ctx: QueryContext):
+        table = None
+        ts_name, tag_names, all_columns = None, [], None
+        if stmt.from_table:
+            db, name = self._resolve(stmt.from_table, ctx)
+            table = self.catalog.table(db, name)
+            ts_name, tag_names = table.ts_name, table.tag_names
+            all_columns = table.schema.column_names
+        return plan_select(stmt, ts_name=ts_name, tag_names=tag_names,
+                           all_columns=all_columns), table
+
+    def _explain(self, stmt: A.Explain, ctx: QueryContext) -> QueryResult:
+        if not isinstance(stmt.statement, A.Select):
+            raise UnsupportedError("EXPLAIN supports SELECT only")
+        plan, _ = self.plan(stmt.statement, ctx)
+        lines = plan.explain_lines()
+        if stmt.analyze:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            res = self._select(stmt.statement, ctx)
+            dt = (_time.perf_counter() - t0) * 1000
+            lines.append(f"  Metrics: rows={res.num_rows} elapsed={dt:.3f}ms")
+        return _result_from_lists(["plan"], [lines])
+
+    def _tql(self, stmt: A.Tql, ctx: QueryContext) -> QueryResult:
+        try:
+            from greptimedb_tpu.promql.engine import PromEngine
+        except ImportError as e:
+            raise UnsupportedError(f"TQL requires the promql module: {e}")
+
+        start = _tql_time(stmt.start)
+        end = _tql_time(stmt.end)
+        step_ms = _tql_interval(stmt.step)
+        lookback_ms = (
+            _tql_interval(stmt.lookback) if stmt.lookback is not None
+            else 300_000
+        )
+        engine = PromEngine(self, ctx)
+        if stmt.kind == "explain":
+            from greptimedb_tpu.promql.parser import parse_promql
+
+            return _result_from_lists(
+                ["plan"], [[repr(parse_promql(stmt.query))]]
+            )
+        return engine.query_range_result(
+            stmt.query, start, end, step_ms, lookback_ms=lookback_ms
+        )
+
+    # ------------------------------------------------------------------
+    # SHOW / DESCRIBE
+    # ------------------------------------------------------------------
+    def _show_databases(self, stmt: A.ShowDatabases) -> QueryResult:
+        names = self.catalog.database_names()
+        if stmt.like:
+            from greptimedb_tpu.query.expr import like_to_regex
+
+            rx = like_to_regex(stmt.like)
+            names = [n for n in names if rx.fullmatch(n)]
+        return _result_from_lists(["Database"], [names])
+
+    def _show_tables(self, stmt: A.ShowTables, ctx: QueryContext
+                     ) -> QueryResult:
+        db = stmt.database or ctx.database
+        names = self.catalog.table_names(db)
+        if stmt.like:
+            from greptimedb_tpu.query.expr import like_to_regex
+
+            rx = like_to_regex(stmt.like)
+            names = [n for n in names if rx.fullmatch(n)]
+        return _result_from_lists(["Tables"], [names])
+
+    def _describe(self, stmt: A.DescribeTable, ctx: QueryContext
+                  ) -> QueryResult:
+        db, name = self._resolve(stmt.name, ctx)
+        table = self.catalog.table(db, name)
+        names, types, keys, nulls, defaults, semantics = [], [], [], [], [], []
+        for c in table.schema.columns:
+            names.append(c.name)
+            types.append(_sql_type_name(c.data_type))
+            keys.append("PRI" if c.is_tag or c.is_time_index else "")
+            nulls.append("YES" if c.nullable else "NO")
+            defaults.append("" if c.default is None else str(c.default))
+            semantics.append(
+                "TIMESTAMP" if c.is_time_index
+                else ("TAG" if c.is_tag else "FIELD")
+            )
+        return _result_from_lists(
+            ["Column", "Type", "Key", "Null", "Default", "Semantic Type"],
+            [names, types, keys, nulls, defaults, semantics],
+        )
+
+    def _show_create_table(self, stmt: A.ShowCreateTable, ctx: QueryContext
+                           ) -> QueryResult:
+        db, name = self._resolve(stmt.name, ctx)
+        table = self.catalog.table(db, name)
+        lines = [f"CREATE TABLE IF NOT EXISTS `{name}` ("]
+        defs = []
+        for c in table.schema.columns:
+            d = f"  `{c.name}` {_sql_type_name(c.data_type)}"
+            if not c.nullable:
+                d += " NOT NULL"
+            defs.append(d)
+        ts = table.schema.time_index.name
+        defs.append(f"  TIME INDEX (`{ts}`)")
+        if table.tag_names:
+            defs.append(
+                "  PRIMARY KEY (" +
+                ", ".join(f"`{t}`" for t in table.tag_names) + ")"
+            )
+        lines.append(",\n".join(defs))
+        lines.append(")")
+        lines.append(f"ENGINE={table.info.engine}")
+        if table.info.options:
+            opts = ", ".join(
+                f"{k!r}={v!r}" for k, v in table.info.options.items()
+            )
+            lines.append(f"WITH({opts})")
+        return _result_from_lists(
+            ["Table", "Create Table"], [[name], ["\n".join(lines)]]
+        )
+
+    # ------------------------------------------------------------------
+    # information_schema
+    # ------------------------------------------------------------------
+    def _is_information_schema(self, name: str, ctx: QueryContext) -> bool:
+        if "." in name:
+            return name.split(".", 1)[0].lower() == "information_schema"
+        return ctx.database.lower() == "information_schema"
+
+    def _query_information_schema(self, stmt: A.Select, ctx: QueryContext
+                                  ) -> QueryResult:
+        from greptimedb_tpu.information_schema import query_information_schema
+
+        return query_information_schema(self, stmt, ctx)
+
+    # ------------------------------------------------------------------
+    # flows (wired by flow.FlowManager; stubs raise otherwise)
+    # ------------------------------------------------------------------
+    def enable_flows(self):
+        if self.flows is None:
+            try:
+                from greptimedb_tpu.flow import FlowManager
+            except ImportError as e:
+                raise UnsupportedError(
+                    f"flows require the flow module: {e}"
+                )
+            self.flows = FlowManager(self)
+        return self.flows
+
+    def _create_flow(self, stmt: A.CreateFlow, ctx: QueryContext) -> Output:
+        self.enable_flows()
+        self.flows.create_flow(stmt, ctx)
+        return Output.rows(0)
+
+    def _drop_flow(self, stmt: A.DropFlow, ctx: QueryContext) -> Output:
+        self.enable_flows()
+        self.flows.drop_flow(stmt.name, if_exists=stmt.if_exists)
+        return Output.rows(0)
+
+    def _show_flows(self) -> QueryResult:
+        if self.flows is None:
+            return _result_from_lists(["Flows"], [[]])
+        return _result_from_lists(["Flows"], [self.flows.flow_names()])
+
+    # ------------------------------------------------------------------
+    def _resolve(self, name: str, ctx: QueryContext) -> tuple[str, str]:
+        if "." in name:
+            db, t = name.split(".", 1)
+            return db, t
+        return ctx.database, name
+
+
+def _coerce_insert(vals: list, dt: ConcreteDataType):
+    n = len(vals)
+    validity = np.asarray([v is not None for v in vals], bool)
+    if dt.is_timestamp():
+        out = np.zeros(n, np.int64)
+        for i, v in enumerate(vals):
+            if v is None:
+                continue
+            out[i] = parse_ts_literal(v) if isinstance(v, str) else int(v)
+        return out, validity
+    if dt.is_string():
+        return (
+            np.asarray(["" if v is None else str(v) for v in vals], object),
+            validity,
+        )
+    np_t = dt.to_numpy()
+    out = np.zeros(n, np_t)
+    for i, v in enumerate(vals):
+        if v is None:
+            continue
+        out[i] = v
+    return out, validity
+
+
+def _sql_type_name(dt: ConcreteDataType) -> str:
+    names = {
+        "int8": "TINYINT", "int16": "SMALLINT", "int32": "INT",
+        "int64": "BIGINT", "uint8": "TINYINT UNSIGNED",
+        "uint16": "SMALLINT UNSIGNED", "uint32": "INT UNSIGNED",
+        "uint64": "BIGINT UNSIGNED", "float32": "FLOAT", "float64": "DOUBLE",
+        "string": "STRING", "binary": "VARBINARY", "bool": "BOOLEAN",
+        "timestamp_s": "TIMESTAMP(0)", "timestamp_ms": "TIMESTAMP(3)",
+        "timestamp_us": "TIMESTAMP(6)", "timestamp_ns": "TIMESTAMP(9)",
+        "date": "DATE", "json": "JSON",
+    }
+    return names.get(dt.name, dt.name.upper())
+
+
+def _tql_time(e: A.Expr) -> int:
+    v = eval_const(e)
+    if isinstance(v, str):
+        try:
+            return int(float(v) * 1000)
+        except ValueError:
+            return parse_ts_literal(v)
+    return int(float(v) * 1000)
+
+
+def _tql_interval(e: A.Expr) -> int:
+    if isinstance(e, A.IntervalLit):
+        return e.ms
+    v = eval_const(e)
+    if isinstance(v, str):
+        from greptimedb_tpu.sql.parser import parse_interval_ms
+
+        return parse_interval_ms(v)
+    return int(float(v) * 1000)

@@ -1,0 +1,3 @@
+from greptimedb_tpu.query.executor import QueryEngine
+
+__all__ = ["QueryEngine"]

@@ -1,0 +1,255 @@
+"""Grouped reductions with a host (numpy) and a device (TPU) path.
+
+The executor routes every GROUP BY through here. Small row counts run
+vectorized numpy on the host (device launch latency would dominate); large
+row counts ship (values, segment-ids, mask) to the device and run the
+jit'd segment kernels from ops/segment.py — the TPU replacement for the
+reference's hash-aggregate operators (SURVEY.md §2.2 src/query).
+
+Shapes are bucketed (rows to powers of two, segments to powers of two) so
+jit traces are reused across queries.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from greptimedb_tpu.datatypes.batch import bucket_size, pad_to
+from greptimedb_tpu.errors import UnsupportedError
+
+DEVICE_THRESHOLD = 262_144  # rows below this stay on host
+
+
+def _pad_group_count(g: int) -> int:
+    b = 1
+    while b < g:
+        b *= 2
+    return b
+
+
+# ----------------------------------------------------------------------
+# host path
+# ----------------------------------------------------------------------
+
+def _host_reduce(op: str, values, valid, gid, g: int, q: float | None,
+                 order_ts=None):
+    """One aggregate over host arrays. values may be None for count(*).
+    Returns (out_values, out_valid)."""
+    n = len(gid)
+    ones = np.ones(g)
+    if op == "count":
+        if values is None:
+            cnt = np.bincount(gid, minlength=g)
+        else:
+            cnt = np.bincount(gid[valid], minlength=g)
+        return cnt.astype(np.int64), None
+    if op == "count_distinct":
+        if n == 0:
+            return np.zeros(g, np.int64), None
+        vv = values[valid]
+        gg = gid[valid]
+        if vv.dtype == object:
+            vv = vv.astype(str)
+        pairs = np.unique(
+            np.stack([gg.astype(np.int64),
+                      np.unique(vv, return_inverse=True)[1].astype(np.int64)]),
+            axis=1,
+        )
+        return np.bincount(pairs[0], minlength=g).astype(np.int64), None
+
+    v = values.astype(np.float64, copy=False)
+    vm = np.where(valid, v, 0.0)
+    cnt = np.bincount(gid[valid], minlength=g)
+    present = cnt > 0
+    if op == "sum":
+        s = np.bincount(gid, weights=vm, minlength=g)
+        return s, present
+    if op == "mean":
+        s = np.bincount(gid, weights=vm, minlength=g)
+        return s / np.maximum(cnt, 1), present
+    if op in ("min", "max"):
+        fill = np.inf if op == "min" else -np.inf
+        out = np.full(g, fill)
+        ufunc = np.minimum if op == "min" else np.maximum
+        ufunc.at(out, gid[valid], v[valid])
+        return np.where(present, out, 0.0), present
+    if op in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+        s = np.bincount(gid, weights=vm, minlength=g)
+        mean = s / np.maximum(cnt, 1)
+        dev = np.where(valid, v - mean[gid], 0.0)
+        s2 = np.bincount(gid, weights=dev * dev, minlength=g)
+        ddof = 1 if op.endswith("_samp") else 0
+        var = s2 / np.maximum(cnt - ddof, 1)
+        ok = cnt > ddof
+        if op.startswith("stddev"):
+            return np.sqrt(var), ok
+        return var, ok
+    if op in ("first_value", "last_value"):
+        ts = order_ts if order_ts is not None else np.arange(n)
+        idx = np.arange(n)
+        order = np.lexsort((idx, ts))
+        order = order[valid[order]]
+        if op == "first_value":
+            order = order[::-1]
+        out = np.zeros(g, dtype=v.dtype)
+        # later assignments win: for last_value ascending order leaves the
+        # latest timestamp; for first_value the earliest.
+        out[gid[order]] = v[order]
+        return out, present
+    if op == "quantile":
+        assert q is not None
+        order = np.lexsort((v, gid))
+        order = order[valid[order]]
+        gg = gid[order]
+        vv = v[order]
+        starts = np.zeros(g, np.int64)
+        np.cumsum(np.bincount(gg, minlength=g), out=starts)
+        starts = np.concatenate([[0], starts[:-1]])
+        rank = q * np.maximum(cnt - 1, 0)
+        lo = np.floor(rank).astype(np.int64)
+        hi = np.ceil(rank).astype(np.int64)
+        frac = rank - lo
+        safe_take = lambda i: vv[np.minimum(starts + i, max(len(vv) - 1, 0))] if len(vv) else np.zeros(g)
+        v_lo = safe_take(lo)
+        v_hi = safe_take(hi)
+        out = v_lo + (v_hi - v_lo) * frac
+        return np.where(present, out, 0.0), present
+    raise UnsupportedError(f"aggregate op: {op}")
+
+
+# ----------------------------------------------------------------------
+# device path
+# ----------------------------------------------------------------------
+
+# first_value/last_value stay on host: epoch-ms timestamps do not survive
+# the device's int32/f32 downcast (wrapping + 131s granularity), and the
+# host pass is a single lexsort anyway.
+_DEVICE_OPS = {"count", "sum", "mean", "min", "max", "var_pop", "var_samp",
+               "stddev_pop", "stddev_samp"}
+
+
+def _device_reduce_many(specs, values: dict, gid, valid, g: int, ts):
+    """Run several aggregates sharing one segmentation on device in one jit
+    program. specs: list of (name, op, value_key|None). Returns
+    {name: (np values, np valid|None)}."""
+    import jax.numpy as jnp
+
+    from greptimedb_tpu.ops import segment as seg
+
+    n = len(gid)
+    nb = bucket_size(n)
+    gb = _pad_group_count(g)
+    dev_vals = {
+        k: jnp.asarray(pad_to(v.astype(np.float64, copy=False), nb))
+        for k, v in values.items()
+    }
+    d_gid = jnp.asarray(pad_to(gid.astype(np.int32), nb))
+    d_mask = jnp.asarray(pad_to(valid, nb, fill=False))
+    d_ts = jnp.asarray(pad_to(ts.astype(np.int64), nb)) if ts is not None else None
+
+    out = {}
+    cnt_cache = None
+
+    def count_of(vkey):
+        m = d_mask if vkey is None else d_mask
+        return seg.seg_count(d_gid, m, gb)
+
+    for name, op, vkey in specs:
+        if op == "count":
+            res = seg.seg_count(d_gid, d_mask, gb)
+            out[name] = (np.asarray(res)[:g].astype(np.int64), None)
+            continue
+        v = dev_vals[vkey]
+        if cnt_cache is None:
+            cnt_cache = seg.seg_count(d_gid, d_mask, gb)
+        cnt_np = np.asarray(cnt_cache)[:g].astype(np.float64)
+        present = cnt_np > 0
+        if op in ("sum", "mean"):
+            # TPU accumulates in f32 (x64 stays off). Shifted accumulation:
+            # subtract a per-segment mean estimate, sum the residuals in
+            # f32, recombine in f64 on host — error drops from O(n·eps) to
+            # O(sqrt(n)·eps·std).
+            mean32, _ = seg.seg_mean(v, d_gid, d_mask, gb)
+            import jax.numpy as _jnp
+
+            resid = seg.seg_sum(v - mean32[d_gid], d_gid, d_mask, gb)
+            s = (np.asarray(resid)[:g].astype(np.float64)
+                 + np.asarray(mean32)[:g].astype(np.float64) * cnt_np)
+            if op == "sum":
+                out[name] = (s, present)
+            else:
+                out[name] = (s / np.maximum(cnt_np, 1), present)
+        elif op == "min":
+            res = seg.seg_min(v, d_gid, d_mask, gb)
+            out[name] = (np.asarray(res)[:g], present)
+        elif op == "max":
+            res = seg.seg_max(v, d_gid, d_mask, gb)
+            out[name] = (np.asarray(res)[:g], present)
+        elif op in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+            ddof = 1 if op.endswith("_samp") else 0
+            var, cnt = seg.seg_var(v, d_gid, d_mask, gb, ddof=ddof)
+            var = np.asarray(var)[:g]
+            ok = np.asarray(cnt)[:g] > ddof
+            if op.startswith("stddev"):
+                out[name] = (np.sqrt(var), ok)
+            else:
+                out[name] = (var, ok)
+        else:  # pragma: no cover - guarded by _DEVICE_OPS
+            raise UnsupportedError(op)
+    return out
+
+
+# ----------------------------------------------------------------------
+# dispatch
+# ----------------------------------------------------------------------
+
+def grouped_reduce(
+    specs: list,
+    values: dict,
+    gid: np.ndarray,
+    valid_map: dict,
+    g: int,
+    *,
+    ts: np.ndarray | None = None,
+    prefer_device: bool | None = None,
+) -> dict:
+    """specs: list of (out_name, op, value_key|None, q|None). values: key ->
+    per-row array. valid_map: key -> bool array (all-valid if missing).
+    Returns {out_name: (np array len g, valid|None)}."""
+    n = len(gid)
+    all_valid = np.ones(n, dtype=bool)
+    use_device = prefer_device
+    if use_device is None:
+        use_device = n >= DEVICE_THRESHOLD
+    device_ok = use_device and all(
+        op in _DEVICE_OPS
+        and (vk is None or values[vk].dtype != object)
+        for _, op, vk, _ in specs
+    )
+    out = {}
+    if device_ok:
+        dev_specs = []
+        for name, op, vk, q in specs:
+            dev_specs.append((name, op, vk))
+        # device path needs one shared validity; split per distinct validity
+        groups: dict[int, list] = {}
+        for name, op, vk in dev_specs:
+            vmask = valid_map.get(vk) if vk else None
+            key = id(vmask) if vmask is not None else 0
+            groups.setdefault(key, []).append((name, op, vk, vmask))
+        for _, items in groups.items():
+            vmask = items[0][3]
+            mask = vmask if vmask is not None else all_valid
+            res = _device_reduce_many(
+                [(n_, o_, v_) for n_, o_, v_, _ in items],
+                values, gid, mask, g, ts,
+            )
+            out.update(res)
+        return out
+    for name, op, vk, q in specs:
+        v = values[vk] if vk is not None else None
+        mask = valid_map.get(vk) if vk else None
+        if mask is None:
+            mask = all_valid
+        out[name] = _host_reduce(op, v, mask, gid, g, q, order_ts=ts)
+    return out

@@ -1,0 +1,19 @@
+"""Per-connection query context.
+
+Counterpart of the reference's session layer
+(/root/reference/src/session/src/context.rs QueryContext): current
+catalog/schema, timezone, and channel; threaded through every statement.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class QueryContext:
+    database: str = "public"
+    timezone: str = "UTC"
+    channel: str = "http"
+    username: str = ""
+    extensions: dict = field(default_factory=dict)

@@ -61,6 +61,16 @@ class FuncCall(Expr):
 
 
 @dataclass
+class RangeFunc(Expr):
+    """`agg(x) RANGE '10s' [FILL v]` — per-item range window in a RANGE
+    select (reference: src/query/src/range_select/plan.rs RangeFn)."""
+
+    func: "FuncCall"
+    range_ms: int
+    fill: str | None = None
+
+
+@dataclass
 class Cast(Expr):
     operand: Expr
     to: ConcreteDataType

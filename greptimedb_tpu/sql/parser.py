@@ -786,8 +786,17 @@ class Parser:
                 while self.eat_op(","):
                     order_by.append(self.order_item())
         self.expect_op(")")
-        return A.FuncCall(name.lower(), args, distinct=distinct,
-                          order_by=order_by)
+        fc = A.FuncCall(name.lower(), args, distinct=distinct,
+                        order_by=order_by)
+        if self.at_kw("RANGE"):
+            self.next()
+            range_ms = parse_interval_ms(self._interval_text())
+            fill = None
+            if self.at_kw("FILL"):
+                self.next()
+                fill = self.next().text.lower()
+            return A.RangeFunc(fc, range_ms, fill)
+        return fc
 
 
 def parse_sql(sql: str) -> list[A.Statement]:

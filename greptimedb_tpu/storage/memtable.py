@@ -143,12 +143,22 @@ def _concat_rows(chunks: list[ColumnarRows], names: list[str]) -> ColumnarRows:
     fields = {}
     valids = {}
     any_valid = any(c.field_valid is not None for c in chunks)
+    # a chunk may predate an ALTER ADD COLUMN: fill the missing field with
+    # invalid zeros so old SSTs/memtable chunks stay scannable.
+    any_missing = any(name not in c.fields for c in chunks for name in names)
+    any_valid = any_valid or any_missing
     for name in names:
-        fields[name] = np.concatenate([c.fields[name] for c in chunks])
+        have = [c for c in chunks if name in c.fields]
+        dt = have[0].fields[name].dtype if have else np.dtype(np.float64)
+        fields[name] = np.concatenate([
+            c.fields[name] if name in c.fields else np.zeros(len(c), dt)
+            for c in chunks
+        ])
         if any_valid:
             valids[name] = np.concatenate([
-                c.field_valid[name] if c.field_valid is not None
-                else np.ones(len(c), bool)
+                (c.field_valid[name]
+                 if c.field_valid is not None and name in c.field_valid
+                 else np.full(len(c), name in c.fields, bool))
                 for c in chunks
             ])
     return ColumnarRows(

@@ -256,7 +256,13 @@ class Region:
                     chunks.append(r)
         if not chunks:
             return ScanResult(None, self.series, names)
-        rows = _concat_rows(chunks, names) if len(chunks) > 1 else chunks[0]
+        # always normalize through _concat_rows: it back-fills fields that a
+        # chunk written before an ALTER ADD COLUMN does not have.
+        only = chunks[0] if len(chunks) == 1 else None
+        if only is not None and all(n in only.fields for n in names):
+            rows = only
+        else:
+            rows = _concat_rows(chunks, names)
         if not raw and not self.meta.options.append_mode:
             rows = dedup_rows(rows, merge_mode=self.meta.options.merge_mode)
         else:

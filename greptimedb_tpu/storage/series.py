@@ -93,9 +93,9 @@ class SeriesRegistry:
         }
 
     def match_sids(self, matchers: list[tuple[str, str, object]]) -> np.ndarray:
-        """Sids whose tags satisfy all matchers (op in {eq, ne, re, nre};
-        value is str or compiled regex). Host-side series pruning — the
-        capability analog of inverted-index applier pruning."""
+        """Sids whose tags satisfy all matchers (op in {eq, ne, in, nin, re,
+        nre}; value is str, list[str], or compiled regex). Host-side series
+        pruning — the capability analog of inverted-index applier pruning."""
         n = len(self._rows)
         keep = np.ones(n, dtype=bool)
         for name, op, value in matchers:
@@ -105,6 +105,10 @@ class SeriesRegistry:
                     keep &= value == ""
                 elif op == "ne":
                     keep &= value != ""
+                elif op == "in":
+                    keep &= "" in value
+                elif op == "nin":
+                    keep &= "" not in value
                 elif op == "re":
                     keep &= bool(value.fullmatch(""))
                 elif op == "nre":
@@ -115,6 +119,10 @@ class SeriesRegistry:
                 keep &= vals == value
             elif op == "ne":
                 keep &= vals != value
+            elif op == "in":
+                keep &= np.isin(vals.astype(str), list(value))
+            elif op == "nin":
+                keep &= ~np.isin(vals.astype(str), list(value))
             elif op == "re":
                 keep &= np.asarray(
                     [bool(value.fullmatch(str(v))) for v in vals]

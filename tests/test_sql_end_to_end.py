@@ -1,0 +1,372 @@
+"""End-to-end SQL tests over the standalone instance (create/insert/query),
+modeled on the reference's sqlness golden cases
+(/root/reference/tests/cases/standalone/common/)."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.session import QueryContext
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    s = Standalone(str(tmp_path / "data"))
+    yield s
+    s.close()
+
+
+def setup_cpu(inst, rows=None):
+    inst.sql(
+        "CREATE TABLE cpu (host STRING, region STRING, usage_user DOUBLE, "
+        "usage_system DOUBLE, ts TIMESTAMP TIME INDEX, "
+        "PRIMARY KEY (host, region))"
+    )
+    if rows is None:
+        rows = [
+            ("h1", "us-west", 10.0, 1.0, 1000),
+            ("h1", "us-west", 20.0, 2.0, 2000),
+            ("h2", "us-west", 30.0, 3.0, 1000),
+            ("h2", "us-east", 40.0, 4.0, 2000),
+            ("h3", "us-east", 50.0, 5.0, 3000),
+        ]
+    values = ", ".join(
+        f"('{h}', '{r}', {u}, {s}, {t})" for h, r, u, s, t in rows
+    )
+    inst.sql(
+        "INSERT INTO cpu (host, region, usage_user, usage_system, ts) "
+        f"VALUES {values}"
+    )
+
+
+def test_create_insert_select_star(inst):
+    setup_cpu(inst)
+    res = inst.sql("SELECT * FROM cpu ORDER BY ts, host")
+    assert res.names == ["host", "region", "usage_user", "usage_system", "ts"]
+    assert res.num_rows == 5
+    rows = res.rows()
+    assert rows[0][0] == "h1" and rows[0][4] == 1000
+
+
+def test_projection_and_arithmetic(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT host, usage_user + usage_system AS total FROM cpu "
+        "WHERE ts = 1000 ORDER BY host"
+    )
+    assert res.names == ["host", "total"]
+    assert res.rows() == [["h1", 11.0], ["h2", 33.0]]
+
+
+def test_where_tag_pruning(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT host, usage_user FROM cpu WHERE region = 'us-east' "
+        "ORDER BY usage_user"
+    )
+    assert res.rows() == [["h2", 40.0], ["h3", 50.0]]
+
+
+def test_where_time_range(inst):
+    setup_cpu(inst)
+    res = inst.sql("SELECT count(*) FROM cpu WHERE ts >= 2000")
+    assert res.rows() == [[3]]
+
+
+def test_global_aggregate(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT count(*), sum(usage_user), min(usage_user), max(usage_user), "
+        "avg(usage_user) FROM cpu"
+    )
+    assert res.rows() == [[5, 150.0, 10.0, 50.0, 30.0]]
+
+
+def test_group_by_tag(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT region, avg(usage_user) AS a FROM cpu GROUP BY region "
+        "ORDER BY region"
+    )
+    assert res.rows() == [["us-east", 45.0], ["us-west", 20.0]]
+
+
+def test_group_by_two_tags_and_having(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT host, region, max(usage_user) AS m FROM cpu "
+        "GROUP BY host, region HAVING m > 15 ORDER BY m DESC"
+    )
+    assert res.rows() == [
+        ["h3", "us-east", 50.0], ["h2", "us-east", 40.0],
+        ["h2", "us-west", 30.0], ["h1", "us-west", 20.0],
+    ]
+
+
+def test_group_by_time_bucket(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT date_trunc('second', ts) AS sec, count(*) AS c FROM cpu "
+        "GROUP BY sec ORDER BY sec"
+    )
+    assert res.rows() == [[1000, 2], [2000, 2], [3000, 1]]
+
+
+def test_post_aggregate_arithmetic(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT region, max(usage_user) - min(usage_user) AS spread "
+        "FROM cpu GROUP BY region ORDER BY region"
+    )
+    assert res.rows() == [["us-east", 10.0], ["us-west", 20.0]]
+
+
+def test_order_limit_offset(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT host, usage_user FROM cpu ORDER BY usage_user DESC "
+        "LIMIT 2 OFFSET 1"
+    )
+    assert res.rows() == [["h2", 40.0], ["h2", 30.0]]
+
+
+def test_distinct(inst):
+    setup_cpu(inst)
+    res = inst.sql("SELECT DISTINCT region FROM cpu ORDER BY region")
+    assert res.rows() == [["us-east"], ["us-west"]]
+
+
+def test_count_distinct(inst):
+    setup_cpu(inst)
+    res = inst.sql("SELECT count(DISTINCT host) FROM cpu")
+    assert res.rows() == [[3]]
+
+
+def test_last_value(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT host, last_value(usage_user) AS l FROM cpu "
+        "GROUP BY host ORDER BY host"
+    )
+    assert res.rows() == [["h1", 20.0], ["h2", 40.0], ["h3", 50.0]]
+
+
+def test_case_and_functions(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT host, CASE WHEN usage_user >= 30 THEN 'hot' ELSE 'cold' END "
+        "AS temp FROM cpu WHERE ts = 1000 ORDER BY host"
+    )
+    assert res.rows() == [["h1", "cold"], ["h2", "hot"]]
+
+
+def test_update_semantics_last_write_wins(inst):
+    setup_cpu(inst)
+    inst.sql(
+        "INSERT INTO cpu (host, region, usage_user, usage_system, ts) "
+        "VALUES ('h1', 'us-west', 99.0, 9.0, 1000)"
+    )
+    res = inst.sql(
+        "SELECT usage_user FROM cpu WHERE host = 'h1' AND ts = 1000"
+    )
+    assert res.rows() == [[99.0]]
+
+
+def test_delete(inst):
+    setup_cpu(inst)
+    inst.sql("DELETE FROM cpu WHERE host = 'h1'")
+    res = inst.sql("SELECT count(*) FROM cpu")
+    assert res.rows() == [[3]]
+
+
+def test_flush_and_restart_recovers(tmp_path):
+    inst = Standalone(str(tmp_path / "data"))
+    setup_cpu(inst)
+    for t in inst.catalog.all_tables():
+        t.flush()
+    inst.sql(
+        "INSERT INTO cpu (host, region, usage_user, usage_system, ts) "
+        "VALUES ('h4', 'eu', 60.0, 6.0, 4000)"
+    )  # stays in WAL/memtable
+    inst.close()
+
+    inst2 = Standalone(str(tmp_path / "data"))
+    res = inst2.sql("SELECT count(*), max(usage_user) FROM cpu")
+    assert res.rows() == [[6, 60.0]]
+    inst2.close()
+
+
+def test_range_query_basic(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT ts, host, max(usage_user) RANGE '1s' FROM cpu "
+        "ALIGN '1s' BY (host) ORDER BY ts, host"
+    )
+    rows = res.rows()
+    # windows [t, t+1s): h1 has samples at 1000, 2000
+    assert [r for r in rows if r[1] == "h1"] == [
+        [1000, "h1", 10.0], [2000, "h1", 20.0],
+    ]
+
+
+def test_range_query_wider_window(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT ts, host, sum(usage_user) RANGE '2s' FROM cpu "
+        "ALIGN '1s' BY (host) ORDER BY ts, host"
+    )
+    rows = [r for r in res.rows() if r[1] == "h1"]
+    # h1 samples: 1000->10, 2000->20. Window [0,2000) = 10;
+    # [1000,3000) = 30; [2000,4000) = 20
+    assert rows == [[0, "h1", 10.0], [1000, "h1", 30.0], [2000, "h1", 20.0]]
+
+
+def test_range_fill_prev(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT ts, host, max(usage_user) RANGE '1s' FILL PREV FROM cpu "
+        "ALIGN '1s' BY (host) ORDER BY ts, host"
+    )
+    rows = [r for r in res.rows() if r[1] == "h1"]
+    # h1: 1000, 2000 present, 3000 filled from 2000
+    assert rows == [[1000, "h1", 10.0], [2000, "h1", 20.0],
+                    [3000, "h1", 20.0]]
+
+
+def test_show_and_describe(inst):
+    setup_cpu(inst)
+    res = inst.sql("SHOW TABLES")
+    assert res.rows() == [["cpu"]]
+    res = inst.sql("DESCRIBE TABLE cpu")
+    cols = [r[0] for r in res.rows()]
+    assert cols == ["host", "region", "usage_user", "usage_system", "ts"]
+    sem = {r[0]: r[5] for r in res.rows()}
+    assert sem["host"] == "TAG" and sem["ts"] == "TIMESTAMP"
+    assert sem["usage_user"] == "FIELD"
+
+
+def test_show_create_table(inst):
+    setup_cpu(inst)
+    res = inst.sql("SHOW CREATE TABLE cpu")
+    ddl = res.rows()[0][1]
+    assert "TIME INDEX" in ddl and "PRIMARY KEY" in ddl
+
+
+def test_information_schema(inst):
+    setup_cpu(inst)
+    res = inst.sql(
+        "SELECT table_name, engine FROM information_schema.tables "
+        "WHERE table_schema = 'public'"
+    )
+    assert res.rows() == [["cpu", "mito"]]
+    res = inst.sql(
+        "SELECT column_name, semantic_type FROM information_schema.columns "
+        "WHERE table_name = 'cpu' AND semantic_type = 'TAG' "
+        "ORDER BY column_name"
+    )
+    assert res.rows() == [["host", "TAG"], ["region", "TAG"]]
+
+
+def test_alter_add_drop_column(inst):
+    setup_cpu(inst)
+    inst.sql("ALTER TABLE cpu ADD COLUMN usage_idle DOUBLE")
+    inst.sql(
+        "INSERT INTO cpu (host, region, usage_user, usage_system, usage_idle,"
+        " ts) VALUES ('h1', 'us-west', 1.0, 1.0, 98.0, 5000)"
+    )
+    res = inst.sql(
+        "SELECT usage_idle FROM cpu WHERE ts = 5000"
+    )
+    assert res.rows() == [[98.0]]
+    # old rows read as NULL
+    res = inst.sql("SELECT count(usage_idle) FROM cpu")
+    assert res.rows() == [[1]]
+    inst.sql("ALTER TABLE cpu DROP COLUMN usage_idle")
+    res = inst.sql("SELECT * FROM cpu WHERE ts = 5000")
+    assert "usage_idle" not in res.names
+
+
+def test_multi_region_table(inst):
+    inst.sql(
+        "CREATE TABLE dist (host STRING, val DOUBLE, ts TIMESTAMP TIME INDEX,"
+        " PRIMARY KEY (host)) WITH (num_regions = '4')"
+    )
+    values = ", ".join(
+        f"('h{i % 16}', {float(i)}, {1000 + i})" for i in range(100)
+    )
+    inst.sql(f"INSERT INTO dist (host, val, ts) VALUES {values}")
+    table = inst.catalog.table("public", "dist")
+    assert len(table.regions) == 4
+    assert sum(r.memtable.rows for r in table.regions) == 100
+    res = inst.sql("SELECT count(*), sum(val) FROM dist")
+    assert res.rows() == [[100, float(sum(range(100)))]]
+    res = inst.sql(
+        "SELECT host, count(*) AS c FROM dist GROUP BY host ORDER BY host"
+    )
+    assert res.num_rows == 16
+
+
+def test_string_field_column(inst):
+    inst.sql(
+        "CREATE TABLE logs (app STRING, message STRING, level STRING, "
+        "ts TIMESTAMP TIME INDEX, PRIMARY KEY (app))"
+    )
+    inst.sql(
+        "INSERT INTO logs (app, message, level, ts) VALUES "
+        "('web', 'boot ok', 'info', 1000), "
+        "('web', 'disk full', 'error', 2000), "
+        "('db', 'conn lost', 'error', 3000)"
+    )
+    res = inst.sql(
+        "SELECT app, message FROM logs WHERE level = 'error' ORDER BY ts"
+    )
+    assert res.rows() == [["web", "disk full"], ["db", "conn lost"]]
+    res = inst.sql(
+        "SELECT level, count(*) AS c FROM logs GROUP BY level ORDER BY level"
+    )
+    assert res.rows() == [["error", 2], ["info", 1]]
+
+
+def test_tableless_select(inst):
+    res = inst.sql("SELECT 1 + 1, 'x'")
+    assert res.rows() == [[2, "x"]]
+
+
+def test_explain(inst):
+    setup_cpu(inst)
+    res = inst.sql("EXPLAIN SELECT region, max(usage_user) FROM cpu "
+                   "WHERE host = 'h1' AND ts > 0 GROUP BY region")
+    text = "\n".join(r[0] for r in res.rows())
+    assert "Aggregate" in text and "matchers" in text
+
+
+def test_use_database(inst):
+    ctx = QueryContext()
+    inst.execute_sql("CREATE DATABASE metrics", ctx)
+    inst.execute_sql("USE metrics", ctx)
+    assert ctx.database == "metrics"
+    inst.execute_sql(
+        "CREATE TABLE m1 (v DOUBLE, ts TIMESTAMP TIME INDEX)", ctx
+    )
+    assert inst.catalog.table_names("metrics") == ["m1"]
+
+
+def test_device_aggregation_matches_host(inst):
+    # same query through host and device paths must agree
+    setup_cpu(inst)
+    import copy
+
+    host_engine = inst.query_engine
+    res_host = inst.sql(
+        "SELECT region, sum(usage_user), count(*) FROM cpu GROUP BY region "
+        "ORDER BY region"
+    )
+    from greptimedb_tpu.query.executor import QueryEngine
+
+    inst.query_engine = QueryEngine(prefer_device=True)
+    res_dev = inst.sql(
+        "SELECT region, sum(usage_user), count(*) FROM cpu GROUP BY region "
+        "ORDER BY region"
+    )
+    inst.query_engine = host_engine
+    assert res_host.rows() == res_dev.rows()
