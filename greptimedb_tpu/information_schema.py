@@ -148,13 +148,15 @@ def query_information_schema(inst, stmt: A.Select, ctx) -> QueryResult:
         _sort_indices,
     )
 
-    if plan.distinct:
-        out = _slice_result(out, _distinct_indices(out))
+    # sort before distinct: _distinct_indices keeps first occurrences in
+    # (sorted) row order, so the sort survives dedup
     if plan.order_by:
         order_cols = [eval_expr(o.expr, src) for o in plan.order_by]
         idx = _sort_indices(order_cols, [o.asc for o in plan.order_by],
                             [o.nulls_first for o in plan.order_by])
         out = _slice_result(out, idx)
+    if plan.distinct:
+        out = _slice_result(out, _distinct_indices(out))
     if plan.offset or plan.limit is not None:
         off = plan.offset or 0
         end = None if plan.limit is None else off + plan.limit

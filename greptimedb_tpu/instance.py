@@ -237,7 +237,9 @@ class Standalone:
                 c: col.valid_mask
                 for c, col in zip(cols, res.cols)
             }
-            return self._write_columns(table, data, valid)
+            written = self._write_columns(table, data, valid)
+            self._notify_flows(db, name, table, data, valid)
+            return written
 
         cols = stmt.columns or schema.column_names
         n = len(stmt.values)

@@ -70,7 +70,10 @@ def plan_grid_and_windows(
     while span // res > max_cells:
         res *= 2  # coarsen: sacrifices exact boundary alignment on huge spans
     t0 = start_ms - range_ms
-    num_cells = -(-span // res)
+    # cells are (t0+(i-1)res, t0+i*res]; a sample at exactly end_ms maps to
+    # cell span//res, so the grid needs span//res + 1 cells (cell 0 holds
+    # only ts == t0, which every window's half-open lower bound excludes).
+    num_cells = span // res + 1
     spec = GridSpec.build(t0, res, num_cells)
     steps = np.arange(start_ms, end_ms + 1, step_ms, dtype=np.int64)
     hi = np.minimum((steps - t0) // res, num_cells - 1).astype(np.int32)

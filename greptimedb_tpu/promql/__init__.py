@@ -1,0 +1,4 @@
+from greptimedb_tpu.promql.engine import PromEngine
+from greptimedb_tpu.promql.parser import parse_promql
+
+__all__ = ["PromEngine", "parse_promql"]

@@ -504,7 +504,27 @@ class QueryEngine:
 
         nrows = len(gidx)
         gsrc = DictSource(out_cols, nrows)
+        if plan.having is not None:
+            cond = eval_expr(plan.having, gsrc)
+            hmask = cond.values.astype(bool) & cond.valid_mask
+            out_cols = {
+                k: Col(c.values[hmask],
+                       None if c.validity is None else c.validity[hmask])
+                for k, c in out_cols.items()
+            }
+            nrows = int(hmask.sum())
+            gsrc = DictSource(out_cols, nrows)
         cols = [eval_expr(e, gsrc) for e, _ in plan.post_items]
+        if plan.distinct:
+            didx = _distinct_indices(cols)
+            cols = _slice_result(cols, didx)
+            out_cols = {
+                k: Col(c.values[didx],
+                       None if c.validity is None else c.validity[didx])
+                for k, c in out_cols.items()
+            }
+            nrows = len(didx)
+            gsrc = DictSource(out_cols, nrows)
         if not plan.order_by:
             # deterministic default order: (ts, group keys)
             order_cols = [out_cols["__ts"]] + [

@@ -223,12 +223,21 @@ def _eval_binary(e: A.BinaryOp, src: ColumnSource) -> Col:
                 out = av >= bv
         return Col(np.asarray(out, dtype=bool), validity)
     if op == "like":
-        pattern = _const_str(e.right, src, b)
-        rx = like_to_regex(pattern)
-        vals = np.asarray(
-            [bool(rx.fullmatch(str(v))) for v in a.values], dtype=bool
-        )
-        return Col(vals, a.validity)
+        if isinstance(e.right, A.Literal) and isinstance(e.right.value, str):
+            rx = like_to_regex(e.right.value)
+            vals = np.asarray(
+                [bool(rx.fullmatch(str(v))) for v in a.values], dtype=bool
+            )
+        else:
+            # per-row pattern (LIKE against a column)
+            vals = np.asarray(
+                [
+                    bool(like_to_regex(str(p)).fullmatch(str(v)))
+                    for v, p in zip(a.values, b.values)
+                ],
+                dtype=bool,
+            )
+        return Col(vals, validity)
     if op == "||":
         av, bv = a.values.astype(object), b.values.astype(object)
         return Col(
@@ -331,12 +340,6 @@ def _eval_case(e: A.Case, src: ColumnSource) -> Col:
     else:
         validity = validity & decided
     return Col(result, None if validity.all() else validity)
-
-
-def _const_str(e: A.Expr, src: ColumnSource, evaluated: Col) -> str:
-    if isinstance(e, A.Literal) and isinstance(e.value, str):
-        return e.value
-    return str(evaluated.values[0])
 
 
 def eval_const(e: A.Expr):

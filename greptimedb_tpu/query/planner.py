@@ -359,7 +359,9 @@ class _Rewriter:
 def _resolve_alias(e: A.Expr, items: list[A.SelectItem]) -> A.Expr:
     """GROUP BY / ORDER BY / HAVING may reference select aliases (anywhere
     in the expression) or 1-based positions (top level only)."""
-    if isinstance(e, A.Literal) and isinstance(e.value, int):
+    if isinstance(e, A.Literal) and isinstance(e.value, int) and not (
+        isinstance(e.value, bool)
+    ):
         idx = e.value - 1
         if 0 <= idx < len(items):
             return items[idx].expr
@@ -561,12 +563,16 @@ def _plan_range(
                     o.nulls_first)
         for o in stmt.order_by
     ]
+    having = None
+    if stmt.having is not None:
+        having = rewrite_range(_resolve_alias(stmt.having, items))
     if not range_items:
         raise PlanError("RANGE query has no `agg(x) RANGE '...'` items")
     return SelectPlan(
         kind="range", table_name=stmt.from_table, scan=scan, keys=keys,
-        range_items=range_items, post_items=post_items,
+        range_items=range_items, post_items=post_items, having=having,
         order_by=order_by, limit=stmt.limit, offset=stmt.offset,
+        distinct=stmt.distinct,
         align_ms=rc.align_ms, align_to=align_to, fill=rc.fill,
         ts_out_name=ts_out,
     )

@@ -150,10 +150,6 @@ def _device_reduce_many(specs, values: dict, gid, valid, g: int, ts):
     out = {}
     cnt_cache = None
 
-    def count_of(vkey):
-        m = d_mask if vkey is None else d_mask
-        return seg.seg_count(d_gid, m, gb)
-
     for name, op, vkey in specs:
         if op == "count":
             res = seg.seg_count(d_gid, d_mask, gb)
@@ -170,8 +166,6 @@ def _device_reduce_many(specs, values: dict, gid, valid, g: int, ts):
             # f32, recombine in f64 on host — error drops from O(n·eps) to
             # O(sqrt(n)·eps·std).
             mean32, _ = seg.seg_mean(v, d_gid, d_mask, gb)
-            import jax.numpy as _jnp
-
             resid = seg.seg_sum(v - mean32[d_gid], d_gid, d_mask, gb)
             s = (np.asarray(resid)[:g].astype(np.float64)
                  + np.asarray(mean32)[:g].astype(np.float64) * cnt_np)

@@ -134,7 +134,8 @@ class Region:
         n = len(ts)
         sids = self.series.intern_rows(
             [np.asarray(tag_columns[name], object)
-             for name in self.meta.tag_names]
+             for name in self.meta.tag_names],
+            n=n,
         )
         full_fields = {}
         valids = dict(field_valid) if field_valid else {}

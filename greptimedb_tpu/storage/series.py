@@ -32,11 +32,16 @@ class SeriesRegistry:
     def num_series(self) -> int:
         return len(self._rows)
 
-    def intern_rows(self, tag_columns: list[np.ndarray]) -> np.ndarray:
+    def intern_rows(self, tag_columns: list[np.ndarray],
+                    n: int | None = None) -> np.ndarray:
         """Map N rows of tag values to sids, creating new series on demand.
-        tag_columns are object arrays aligned with tag_names."""
+        tag_columns are object arrays aligned with tag_names. For tagless
+        tables pass `n` explicitly (every row maps to series 0)."""
         assert len(tag_columns) == len(self.tag_names)
-        n = len(tag_columns[0]) if tag_columns else 0
+        if tag_columns:
+            n = len(tag_columns[0])
+        elif n is None:
+            n = 0
         with self._lock:
             if not tag_columns:
                 # tagless table: single series 0

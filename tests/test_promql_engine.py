@@ -1,0 +1,418 @@
+"""PromQL engine tests: parser + evaluator over the standalone instance,
+validated against hand-computed Prometheus semantics (the golden-case role
+of /root/reference/tests/cases/standalone/common/tql/)."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.promql.engine import PromEngine, ScalarValue, VectorValue
+from greptimedb_tpu.promql.parser import (
+    Agg,
+    Binary,
+    Call,
+    NumberLit,
+    VectorSelector,
+    parse_promql,
+    parse_duration_ms,
+)
+
+
+# ----------------------------------------------------------------------
+# parser
+# ----------------------------------------------------------------------
+
+def test_parse_duration():
+    assert parse_duration_ms("5m") == 300_000
+    assert parse_duration_ms("1h30m") == 5_400_000
+    assert parse_duration_ms("250ms") == 250
+    assert parse_duration_ms("2d") == 172_800_000
+
+
+def test_parse_selector():
+    e = parse_promql('http_requests{job="api", code=~"5.."}[5m]')
+    assert isinstance(e, VectorSelector)
+    assert e.name == "http_requests"
+    assert e.range_ms == 300_000
+    assert [(m.name, m.op, m.value) for m in e.matchers] == [
+        ("job", "=", "api"), ("code", "=~", "5.."),
+    ]
+
+
+def test_parse_rate_and_agg():
+    e = parse_promql('sum by (host) (rate(cpu_seconds[1m]))')
+    assert isinstance(e, Agg)
+    assert e.op == "sum" and e.grouping == ["host"] and not e.without
+    assert isinstance(e.expr, Call) and e.expr.name == "rate"
+
+
+def test_parse_binary_precedence():
+    e = parse_promql("a + b * c")
+    assert isinstance(e, Binary) and e.op == "+"
+    assert isinstance(e.rhs, Binary) and e.rhs.op == "*"
+
+
+def test_parse_offset_and_bool():
+    e = parse_promql("foo offset 5m > bool 2")
+    assert isinstance(e, Binary) and e.bool_mod
+    assert e.lhs.offset_ms == 300_000
+
+
+def test_parse_on_group_left():
+    e = parse_promql("a * on(host) group_left(extra) b")
+    assert e.matching.on and e.matching.labels == ["host"]
+    assert e.matching.group == "left"
+    assert e.matching.include == ["extra"]
+
+
+# ----------------------------------------------------------------------
+# engine fixtures
+# ----------------------------------------------------------------------
+
+@pytest.fixture()
+def inst(tmp_path):
+    s = Standalone(str(tmp_path / "data"))
+    yield s
+    s.close()
+
+
+T0 = 1_700_000_000_000  # aligned base
+
+
+def setup_counter(inst):
+    """Counter series: host h1 increases 10/s, h2 increases 20/s, 15s
+    samples over 10 minutes."""
+    inst.sql(
+        "CREATE TABLE http_requests (host STRING, job STRING, "
+        "greptime_value DOUBLE, ts TIMESTAMP TIME INDEX, "
+        "PRIMARY KEY (host, job))"
+    )
+    table = inst.catalog.table("public", "http_requests")
+    n = 41  # 10 min / 15s + 1
+    ts = T0 + np.arange(n) * 15_000
+    for host, rate in (("h1", 10.0), ("h2", 20.0)):
+        table.write(
+            {"host": np.full(n, host, object),
+             "job": np.full(n, "api", object)},
+            ts,
+            {"greptime_value": np.arange(n) * 15.0 * rate},
+        )
+    return ts
+
+
+def setup_gauge(inst):
+    inst.sql(
+        "CREATE TABLE mem_used (host STRING, greptime_value DOUBLE, "
+        "ts TIMESTAMP TIME INDEX, PRIMARY KEY (host))"
+    )
+    table = inst.catalog.table("public", "mem_used")
+    n = 21
+    ts = T0 + np.arange(n) * 30_000
+    table.write(
+        {"host": np.full(n, "h1", object)}, ts,
+        {"greptime_value": 100.0 + 10.0 * np.sin(np.arange(n))},
+    )
+    table.write(
+        {"host": np.full(n, "h2", object)}, ts,
+        {"greptime_value": np.full(n, 50.0)},
+    )
+    return ts
+
+
+def q(inst, promql, start, end, step):
+    eng = PromEngine(inst)
+    val, ev = eng.query_range(promql, start, end, step)
+    return val, ev
+
+
+# ----------------------------------------------------------------------
+# engine: selectors and range functions
+# ----------------------------------------------------------------------
+
+def test_instant_selector_lookback(inst):
+    setup_gauge(inst)
+    val, ev = q(inst, "mem_used", T0 + 60_000, T0 + 120_000, 30_000)
+    assert isinstance(val, VectorValue)
+    assert val.num_series == 2
+    assert val.present.all()
+    h2 = [i for i, l in enumerate(val.labels) if l.get("host") == "h2"][0]
+    np.testing.assert_allclose(val.values[h2], 50.0)
+
+
+def test_selector_matcher_filters(inst):
+    setup_gauge(inst)
+    val, _ = q(inst, 'mem_used{host="h2"}', T0 + 60_000, T0 + 60_000, 1000)
+    assert val.num_series == 1
+    assert val.labels[0]["host"] == "h2"
+
+
+def test_rate_counter(inst):
+    setup_counter(inst)
+    val, _ = q(
+        inst, "rate(http_requests[1m])", T0 + 120_000, T0 + 300_000, 60_000
+    )
+    assert val.num_series == 2
+    for i, lab in enumerate(val.labels):
+        want = 10.0 if lab["host"] == "h1" else 20.0
+        assert val.present[i].all()
+        np.testing.assert_allclose(val.values[i], want, rtol=1e-5)
+
+
+def test_increase(inst):
+    setup_counter(inst)
+    val, _ = q(
+        inst, "increase(http_requests[2m])", T0 + 180_000, T0 + 300_000,
+        60_000,
+    )
+    for i, lab in enumerate(val.labels):
+        want = (1200.0 if lab["host"] == "h1" else 2400.0)
+        np.testing.assert_allclose(val.values[i], want, rtol=1e-5)
+
+
+def test_avg_over_time(inst):
+    setup_gauge(inst)
+    val, _ = q(
+        inst, "avg_over_time(mem_used[2m])", T0 + 300_000, T0 + 300_000, 1000
+    )
+    h2 = [i for i, l in enumerate(val.labels) if l.get("host") == "h2"][0]
+    np.testing.assert_allclose(val.values[h2], 50.0)
+    h1 = 1 - h2
+    # window (180s, 300s]: samples at 210,240,270,300s -> sin(7..10)
+    want = np.mean(100.0 + 10.0 * np.sin(np.arange(7, 11)))
+    np.testing.assert_allclose(val.values[h1], want, rtol=1e-5)
+
+
+def test_min_max_over_time(inst):
+    setup_gauge(inst)
+    vmin, _ = q(inst, "min_over_time(mem_used[5m])",
+                T0 + 300_000, T0 + 300_000, 1000)
+    vmax, _ = q(inst, "max_over_time(mem_used[5m])",
+                T0 + 300_000, T0 + 300_000, 1000)
+    h1min = [i for i, l in enumerate(vmin.labels) if l["host"] == "h1"][0]
+    h1max = [i for i, l in enumerate(vmax.labels) if l["host"] == "h1"][0]
+    xs = 100.0 + 10.0 * np.sin(np.arange(1, 11))
+    np.testing.assert_allclose(vmin.values[h1min], xs.min(), rtol=1e-6)
+    np.testing.assert_allclose(vmax.values[h1max], xs.max(), rtol=1e-6)
+
+
+def test_delta_gauge(inst):
+    setup_gauge(inst)
+    val, _ = q(inst, "delta(mem_used[2m])", T0 + 300_000, T0 + 300_000, 1000)
+    h2 = [i for i, l in enumerate(val.labels) if l["host"] == "h2"][0]
+    np.testing.assert_allclose(val.values[h2], 0.0, atol=1e-6)
+
+
+def test_changes_resets(inst):
+    inst.sql(
+        "CREATE TABLE flip (greptime_value DOUBLE, ts TIMESTAMP TIME INDEX)"
+    )
+    t = inst.catalog.table("public", "flip")
+    ts = T0 + np.arange(10) * 1000
+    vals = np.asarray([1.0, 1.0, 2.0, 1.0, 1.0, 3.0, 3.0, 0.0, 0.0, 5.0])
+    t.write({}, ts, {"greptime_value": vals})
+    val, _ = q(inst, "changes(flip[10s])", T0 + 9_000, T0 + 9_000, 1000)
+    # pairs fully inside window: changes at 2,1,3,0,5 transitions = 5
+    assert val.values[0][0] == 5.0
+    val, _ = q(inst, "resets(flip[10s])", T0 + 9_000, T0 + 9_000, 1000)
+    assert val.values[0][0] == 2.0  # 2->1 and 3->0
+
+
+# ----------------------------------------------------------------------
+# engine: aggregation
+# ----------------------------------------------------------------------
+
+def test_sum_aggregation(inst):
+    setup_gauge(inst)
+    val, _ = q(inst, "sum(mem_used)", T0 + 60_000, T0 + 120_000, 30_000)
+    assert val.num_series == 1 and val.labels[0] == {}
+    h1_vals = 100.0 + 10.0 * np.sin(np.arange(2, 5))
+    np.testing.assert_allclose(val.values[0], h1_vals + 50.0, rtol=1e-5)
+
+
+def test_sum_by(inst):
+    setup_counter(inst)
+    val, _ = q(
+        inst, "sum by (host) (rate(http_requests[1m]))",
+        T0 + 120_000, T0 + 120_000, 1000,
+    )
+    assert val.num_series == 2
+    by_host = {l["host"]: val.values[i][0] for i, l in enumerate(val.labels)}
+    np.testing.assert_allclose(by_host["h1"], 10.0, rtol=1e-5)
+    np.testing.assert_allclose(by_host["h2"], 20.0, rtol=1e-5)
+
+
+def test_avg_without(inst):
+    setup_gauge(inst)
+    val, _ = q(
+        inst, "avg without (host) (mem_used)",
+        T0 + 120_000, T0 + 120_000, 1000,
+    )
+    assert val.num_series == 1
+    want = (100.0 + 10.0 * np.sin(4) + 50.0) / 2
+    np.testing.assert_allclose(val.values[0][0], want, rtol=1e-6)
+
+
+def test_topk(inst):
+    setup_gauge(inst)
+    val, _ = q(inst, "topk(1, mem_used)", T0 + 120_000, T0 + 120_000, 1000)
+    assert val.num_series == 1
+    assert val.labels[0]["host"] == "h1"  # 100+10sin(4) ≈ 92.4 > 50
+
+
+def test_quantile_agg(inst):
+    setup_gauge(inst)
+    val, _ = q(
+        inst, "quantile(0.5, mem_used)", T0 + 120_000, T0 + 120_000, 1000
+    )
+    h1 = 100.0 + 10.0 * np.sin(4)
+    want = (h1 + 50.0) / 2  # median of two = midpoint
+    np.testing.assert_allclose(val.values[0][0], want, rtol=1e-6)
+
+
+def test_count_and_group(inst):
+    setup_gauge(inst)
+    val, _ = q(inst, "count(mem_used)", T0 + 120_000, T0 + 120_000, 1000)
+    assert val.values[0][0] == 2.0
+
+
+# ----------------------------------------------------------------------
+# engine: binary operators
+# ----------------------------------------------------------------------
+
+def test_vector_scalar_arith(inst):
+    setup_gauge(inst)
+    val, _ = q(inst, "mem_used / 2", T0 + 120_000, T0 + 120_000, 1000)
+    by_host = {l["host"]: val.values[i][0] for i, l in enumerate(val.labels)}
+    np.testing.assert_allclose(by_host["h2"], 25.0)
+
+
+def test_vector_scalar_filter(inst):
+    setup_gauge(inst)
+    val, _ = q(inst, "mem_used > 60", T0 + 120_000, T0 + 120_000, 1000)
+    present_hosts = [
+        val.labels[i]["host"] for i in range(val.num_series)
+        if val.present[i][0]
+    ]
+    assert present_hosts == ["h1"]
+
+
+def test_vector_scalar_bool(inst):
+    setup_gauge(inst)
+    val, _ = q(inst, "mem_used > bool 60", T0 + 120_000, T0 + 120_000, 1000)
+    by_host = {l["host"]: val.values[i][0] for i, l in enumerate(val.labels)}
+    assert by_host == {"h1": 1.0, "h2": 0.0}
+
+
+def test_vector_vector_matching(inst):
+    setup_gauge(inst)
+    val, _ = q(inst, "mem_used + mem_used", T0 + 120_000, T0 + 120_000, 1000)
+    by_host = {l["host"]: val.values[i][0] for i, l in enumerate(val.labels)}
+    np.testing.assert_allclose(by_host["h2"], 100.0)
+
+
+def test_scalar_scalar(inst):
+    val, _ = q(inst, "2 + 3 * 4", T0, T0, 1000)
+    assert isinstance(val, ScalarValue)
+    assert val.values[0] == 14.0
+
+
+def test_set_ops(inst):
+    setup_gauge(inst)
+    val, _ = q(
+        inst, 'mem_used and mem_used{host="h1"}',
+        T0 + 120_000, T0 + 120_000, 1000,
+    )
+    assert [l["host"] for l in val.labels
+            if val.present[val.labels.index(l)][0]] == ["h1"]
+    val, _ = q(
+        inst, 'mem_used unless mem_used{host="h1"}',
+        T0 + 120_000, T0 + 120_000, 1000,
+    )
+    present = [val.labels[i]["host"] for i in range(val.num_series)
+               if val.present[i][0]]
+    assert present == ["h2"]
+
+
+# ----------------------------------------------------------------------
+# engine: functions
+# ----------------------------------------------------------------------
+
+def test_math_function(inst):
+    setup_gauge(inst)
+    val, _ = q(inst, "abs(mem_used - 100)", T0 + 120_000, T0 + 120_000, 1000)
+    by_host = {l["host"]: val.values[i][0] for i, l in enumerate(val.labels)}
+    np.testing.assert_allclose(by_host["h2"], 50.0)
+
+
+def test_histogram_quantile(inst):
+    inst.sql(
+        "CREATE TABLE latency_bucket (le STRING, greptime_value DOUBLE, "
+        "ts TIMESTAMP TIME INDEX, PRIMARY KEY (le))"
+    )
+    t = inst.catalog.table("public", "latency_bucket")
+    ts = np.asarray([T0])
+    # cumulative: 10 below 0.1, 60 below 0.5, 100 below 1, 100 total
+    for le, c in (("0.1", 10.0), ("0.5", 60.0), ("1", 100.0),
+                  ("+Inf", 100.0)):
+        t.write({"le": np.asarray([le], object)}, ts,
+                {"greptime_value": np.asarray([c])})
+    val, _ = q(
+        inst, "histogram_quantile(0.5, latency_bucket)", T0, T0, 1000
+    )
+    assert val.num_series == 1
+    # rank 50: bucket (0.1, 0.5], interpolate (50-10)/(60-10) = 0.8
+    np.testing.assert_allclose(val.values[0][0], 0.1 + 0.4 * 0.8, rtol=1e-6)
+
+
+def test_absent(inst):
+    setup_gauge(inst)
+    val, _ = q(
+        inst, 'absent(mem_used{host="nope"})', T0 + 60_000, T0 + 60_000,
+        1000,
+    )
+    assert val.num_series == 1
+    assert val.labels[0] == {"host": "nope"}
+    assert val.values[0][0] == 1.0
+
+
+def test_label_replace(inst):
+    setup_gauge(inst)
+    val, _ = q(
+        inst,
+        'label_replace(mem_used, "node", "$1", "host", "(h.)")',
+        T0 + 60_000, T0 + 60_000, 1000,
+    )
+    assert all(l["node"] == l["host"] for l in val.labels)
+
+
+def test_offset(inst):
+    setup_gauge(inst)
+    # at T0+300s, offset 2m reads the value at T0+180s
+    val, _ = q(
+        inst, 'mem_used{host="h1"} offset 2m', T0 + 300_000, T0 + 300_000,
+        1000,
+    )
+    want = 100.0 + 10.0 * np.sin(6)  # sample at 180s
+    np.testing.assert_allclose(val.values[0][0], want, rtol=1e-6)
+
+
+def test_subquery_max_of_rate(inst):
+    setup_counter(inst)
+    val, _ = q(
+        inst, "max_over_time(rate(http_requests[1m])[5m:1m])",
+        T0 + 420_000, T0 + 420_000, 1000,
+    )
+    by_host = {l["host"]: val.values[i][0] for i, l in enumerate(val.labels)}
+    np.testing.assert_allclose(by_host["h1"], 10.0, rtol=1e-4)
+    np.testing.assert_allclose(by_host["h2"], 20.0, rtol=1e-4)
+
+
+def test_tql_eval_through_sql(inst):
+    setup_gauge(inst)
+    res = inst.sql(
+        f"TQL EVAL ({(T0 + 60_000) // 1000}, {(T0 + 120_000) // 1000}, "
+        f"'30s') mem_used{{host=\"h2\"}}"
+    )
+    assert res.names[0] == "ts" and "value" in res.names
+    assert res.num_rows == 3
+    assert all(r[1] == 50.0 for r in res.rows())
