@@ -289,15 +289,29 @@ class CatalogManager:
             table = self.table(database, name)
             if col.semantic_type == SemanticType.TIMESTAMP:
                 raise InvalidArgumentError("cannot add a TIME INDEX column")
-            if col.semantic_type == SemanticType.TAG:
+            existing = table.info.schema.maybe_column(col.name)
+            if existing is not None:
+                # idempotent: concurrent protocol auto-widen may race the
+                # check-then-alter; same name + semantic is a no-op
+                if existing.semantic_type == col.semantic_type:
+                    return
                 raise InvalidArgumentError(
-                    "adding TAG columns is not supported (series identity)"
+                    f"column {col.name!r} exists with a different semantic"
                 )
             table.info.schema = table.info.schema.with_column(col)
+            if col.semantic_type == SemanticType.TAG:
+                # existing series read "" for the new tag; sids stay stable
+                for region in table.regions:
+                    with region._lock:
+                        region.series.add_tag(col.name)
+                        region.meta.tag_names.append(col.name)
+                self._persist()
+                return
             for region in table.regions:
-                if col.name not in region.meta.field_names:
-                    region.meta.field_names.append(col.name)
-                    region.memtable.field_names.append(col.name)
+                with region._lock:
+                    if col.name not in region.meta.field_names:
+                        region.meta.field_names.append(col.name)
+                        region.memtable.field_names.append(col.name)
             self._persist()
 
     def alter_drop_column(self, database: str, name: str, col_name: str):

@@ -36,14 +36,20 @@ class TableScanData:
 
 def _route_rows(tag_cols: list[np.ndarray], n_rows: int, n_regions: int) -> np.ndarray:
     """Stable per-row region index from the tag tuple (crc32 of the joined
-    tag strings, computed once per distinct combination)."""
+    tag strings, computed once per distinct combination).
+
+    Empty tag values are EXCLUDED from the key: a series written before an
+    ALTER ADD TAG reads "" for the new tag and must keep routing to the
+    same region, or overwrite dedup and deletes would split across regions.
+    Collisions between different series only affect placement, never
+    identity."""
     if n_regions <= 1 or not tag_cols:
         return np.zeros(n_rows, dtype=np.int32)
     stacked = np.stack([c.astype(object) for c in tag_cols], axis=1)
     uniq, inv = np.unique(stacked.astype(str), axis=0, return_inverse=True)
     dest = np.empty(len(uniq), dtype=np.int32)
     for i, row in enumerate(uniq):
-        key = "\x00".join(row)
+        key = "\x00".join(v for v in row if v != "")
         dest[i] = zlib.crc32(key.encode()) % n_regions
     return dest[np.ravel(inv)]
 

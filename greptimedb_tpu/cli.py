@@ -1,0 +1,117 @@
+"""Process entry point: `python -m greptimedb_tpu.cli standalone start`.
+
+Counterpart of /root/reference/src/cmd/src/bin/greptime.rs subcommands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="greptimedb-tpu")
+    sub = ap.add_subparsers(dest="role", required=True)
+
+    standalone = sub.add_parser("standalone")
+    s_sub = standalone.add_subparsers(dest="cmd", required=True)
+    start = s_sub.add_parser("start")
+    start.add_argument("--data-home", default="./greptimedb_tpu_data")
+    start.add_argument("--http-addr", default="127.0.0.1:4000")
+    start.add_argument("--no-flows", action="store_true")
+
+    repl = sub.add_parser("cli")
+    repl.add_argument("--data-home", default="./greptimedb_tpu_data")
+
+    args = ap.parse_args(argv)
+    if args.role == "standalone":
+        return _start_standalone(args)
+    if args.role == "cli":
+        return _repl(args)
+    ap.error("unknown role")
+
+
+def _start_standalone(args):
+    from greptimedb_tpu.instance import Standalone
+    from greptimedb_tpu.servers.http import HttpServer
+    from greptimedb_tpu.storage.engine import EngineConfig
+
+    host, _, port = args.http_addr.rpartition(":")
+    inst = Standalone(
+        engine_config=EngineConfig(
+            data_root=args.data_home, enable_background=True,
+        )
+    )
+    if not args.no_flows:
+        try:
+            inst.enable_flows()
+        except Exception:
+            pass
+    server = HttpServer(inst, addr=host or "127.0.0.1",
+                        port=int(port)).start()
+    print(
+        f"greptimedb-tpu standalone listening on http://{server.addr}:"
+        f"{server.port}", flush=True,
+    )
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        server.stop()
+        inst.close()
+    return 0
+
+
+def _repl(args):
+    from greptimedb_tpu.instance import Standalone
+
+    inst = Standalone(args.data_home)
+    print("greptimedb-tpu REPL; end statements with ';', \\q to quit")
+    buf = []
+    while True:
+        try:
+            line = input("greptime> " if not buf else "      -> ")
+        except EOFError:
+            break
+        if line.strip() in ("\\q", "exit", "quit"):
+            break
+        buf.append(line)
+        if not line.rstrip().endswith(";"):
+            continue
+        sql = "\n".join(buf)
+        buf = []
+        try:
+            res = inst.sql(sql.rstrip(";"))
+            _print_result(res)
+        except Exception as e:
+            print(f"error: {e}")
+    inst.close()
+    return 0
+
+
+def _print_result(res):
+    if not res.names:
+        print("OK")
+        return
+    widths = [
+        max(len(str(n)), *(len(str(r[i])) for r in res.rows()), 1)
+        if res.num_rows else len(str(n))
+        for i, n in enumerate(res.names)
+    ]
+    def fmt(row):
+        return " | ".join(str(v).ljust(w) for v, w in zip(row, widths))
+    print(fmt(res.names))
+    print("-+-".join("-" * w for w in widths))
+    for row in res.rows():
+        print(fmt(row))
+    print(f"({res.num_rows} rows)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

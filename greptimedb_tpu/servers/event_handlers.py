@@ -1,0 +1,55 @@
+"""Log-event ingest endpoints (/v1/events/*).
+
+Counterpart of /root/reference/src/servers/src/http/event.rs: pipeline
+upload + log ingest. Wired to the pipeline module when present.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+
+
+def handle(handler, instance, method: str, path: str):
+    try:
+        from greptimedb_tpu.pipeline import PipelineManager
+    except ImportError:
+        return handler._error(501, "pipeline module not available")
+    mgr = PipelineManager.get(instance)
+    parsed = urllib.parse.urlparse(handler.path)
+    params = {k: v[-1] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+    db = params.get("db", "public")
+
+    if path.startswith("/v1/events/pipelines/"):
+        name = path.removeprefix("/v1/events/pipelines/")
+        if method == "POST":
+            body = handler._body().decode()
+            mgr.upsert_pipeline(name, body)
+            return handler._json(200, {"name": name, "status": "created"})
+        if method == "GET":
+            p = mgr.get_pipeline(name)
+            if p is None:
+                return handler._error(404, f"pipeline {name} not found")
+            return handler._json(200, {"name": name, "pipeline": p.source})
+        return handler._error(405, method)
+
+    if path == "/v1/events/logs":
+        table = params.get("table")
+        pipeline_name = params.get("pipeline_name", "greptime_identity")
+        if not table:
+            return handler._error(400, "missing table parameter")
+        body = handler._body()
+        try:
+            payload = json.loads(body)
+        except json.JSONDecodeError:
+            payload = [
+                {"message": line}
+                for line in body.decode("utf-8", "replace").splitlines()
+                if line
+            ]
+        if isinstance(payload, dict):
+            payload = [payload]
+        n = mgr.ingest(db, table, pipeline_name, payload)
+        return handler._json(200, {"rows": n})
+
+    handler._error(404, f"no route: {path}")

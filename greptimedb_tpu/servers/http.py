@@ -1,0 +1,482 @@
+"""HTTP protocol server.
+
+Capability counterpart of /root/reference/src/servers/src/http/ (axum app):
+- POST /v1/sql                         SQL in GreptimeDB JSON envelope
+- POST /v1/promql, GET/POST /v1/prometheus/api/v1/{query,query_range,
+  labels,label/<n>/values,series}      Prometheus HTTP API
+- POST /v1/influxdb/write, /v1/influxdb/api/v2/write   line protocol
+- POST /v1/prometheus/write|read      remote write/read (snappy protobuf)
+- GET  /metrics                        self metrics exposition
+- GET  /health, /status                liveness + build info
+
+Stdlib ThreadingHTTPServer: the host plane is IO-bound glue; the device
+does the math.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import threading
+import time
+import traceback
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.promql.engine import (
+    PromEngine,
+    ScalarValue,
+    VectorValue,
+)
+from greptimedb_tpu.servers import influx, prom_store
+from greptimedb_tpu.session import QueryContext
+from greptimedb_tpu.telemetry import global_registry
+from greptimedb_tpu.version import __version__
+
+_REQS = global_registry.counter(
+    "greptime_servers_http_requests_total", "HTTP requests", ("path", "code")
+)
+_LATENCY = global_registry.histogram(
+    "greptime_servers_http_latency_seconds", "HTTP latency", ("path",)
+)
+_INGEST_ROWS = global_registry.counter(
+    "greptime_servers_ingest_rows_total", "Rows ingested", ("api",)
+)
+
+
+def _type_name(tn: str) -> str:
+    names = {
+        "int8": "Int8", "int16": "Int16", "int32": "Int32", "int64": "Int64",
+        "uint8": "UInt8", "uint16": "UInt16", "uint32": "UInt32",
+        "uint64": "UInt64", "float32": "Float32", "float64": "Float64",
+        "string": "String", "bool": "Boolean", "binary": "Binary",
+        "timestamp_s": "TimestampSecond",
+        "timestamp_ms": "TimestampMillisecond",
+        "timestamp_us": "TimestampMicrosecond",
+        "timestamp_ns": "TimestampNanosecond",
+        "date": "Date", "json": "Json",
+    }
+    return names.get(tn, tn)
+
+
+def result_to_json(res) -> dict:
+    schema = {
+        "column_schemas": [
+            {"name": n, "data_type": _type_name(res.type_name(i))}
+            for i, n in enumerate(res.names)
+        ]
+    }
+    return {"records": {"schema": schema, "rows": res.rows(),
+                        "total_rows": res.num_rows}}
+
+
+class HttpServer:
+    def __init__(self, instance, *, addr: str = "127.0.0.1", port: int = 4000):
+        self.instance = instance
+        self.addr = addr
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+    def start(self):
+        handler = _make_handler(self.instance)
+        self._httpd = ThreadingHTTPServer((self.addr, self.port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="http-server"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def _make_handler(instance):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # silence default stderr logging
+        def log_message(self, *args):
+            pass
+
+        # ------------------------------------------------------------------
+        def _send(self, code: int, body: bytes,
+                  content_type: str = "application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            _REQS.labels(self._route(), str(code)).inc()
+
+        def _route(self) -> str:
+            return urllib.parse.urlparse(self.path).path
+
+        def _json(self, code: int, obj):
+            self._send(code, json.dumps(obj).encode())
+
+        def _error(self, code: int, msg: str):
+            self._json(code, {"error": msg, "code": code})
+
+        def _body(self) -> bytes:
+            ln = int(self.headers.get("Content-Length") or 0)
+            data = self.rfile.read(ln) if ln else b""
+            if self.headers.get("Content-Encoding") == "gzip":
+                data = gzip.decompress(data)
+            return data
+
+        def _params(self) -> dict:
+            q = urllib.parse.urlparse(self.path).query
+            return self._merge_qs({}, urllib.parse.parse_qs(q))
+
+        @staticmethod
+        def _merge_qs(params: dict, parsed: dict) -> dict:
+            # repeatable keys (match[]) keep ALL values as a list
+            for k, v in parsed.items():
+                if k.endswith("[]"):
+                    params.setdefault(k, [])
+                    params[k] = list(params[k]) + v
+                else:
+                    params[k] = v[-1]
+            return params
+
+        def _form(self) -> dict:
+            body = self._body()
+            ctype = self.headers.get("Content-Type", "")
+            params = self._params()
+            if "application/x-www-form-urlencoded" in ctype:
+                self._merge_qs(params, urllib.parse.parse_qs(body.decode()))
+            elif body and "json" in ctype:
+                try:
+                    params.update(json.loads(body))
+                except json.JSONDecodeError:
+                    pass
+            elif body:
+                self._raw_body = body
+            return params
+
+        # ------------------------------------------------------------------
+        def do_GET(self):
+            self._dispatch("GET")
+
+        def do_POST(self):
+            self._dispatch("POST")
+
+        def _dispatch(self, method: str):
+            path = self._route()
+            t0 = time.perf_counter()
+            try:
+                self._route_request(method, path)
+            except GreptimeError as e:
+                self._error(400, str(e))
+            except BrokenPipeError:
+                pass
+            except Exception as e:
+                traceback.print_exc()
+                self._error(500, f"internal error: {e}")
+            finally:
+                _LATENCY.labels(path).observe(time.perf_counter() - t0)
+
+        def _route_request(self, method: str, path: str):
+            if path in ("/health", "/ready", "/-/healthy", "/-/ready"):
+                return self._json(200, {})
+            if path == "/status":
+                return self._json(200, {
+                    "source_time": "", "commit": "", "branch": "",
+                    "rustc_version": "n/a (python/jax)",
+                    "hostname": "localhost", "version": __version__,
+                })
+            if path == "/metrics":
+                return self._send(
+                    200, global_registry.render().encode(),
+                    "text/plain; version=0.0.4",
+                )
+            if path == "/v1/sql":
+                return self._handle_sql()
+            if path == "/v1/promql":
+                return self._handle_promql_range(self._form())
+            if path.startswith("/v1/prometheus/api/v1/"):
+                return self._handle_prom_api(
+                    path.removeprefix("/v1/prometheus/api/v1/")
+                )
+            if path == "/v1/prometheus/write":
+                return self._handle_remote_write()
+            if path == "/v1/prometheus/read":
+                return self._handle_remote_read()
+            if path in ("/v1/influxdb/write", "/v1/influxdb/api/v2/write",
+                        "/influxdb/write"):
+                return self._handle_influx_write()
+            if path == "/v1/events/pipelines" or path.startswith(
+                "/v1/events"
+            ):
+                return self._handle_events(method, path)
+            self._error(404, f"no route: {path}")
+
+        # ------------------------------------------------------------------
+        def _handle_sql(self):
+            params = self._form()
+            sql = params.get("sql")
+            if not sql:
+                return self._error(400, "missing sql parameter")
+            db = params.get("db", "public")
+            ctx = QueryContext(database=db)
+            t0 = time.perf_counter()
+            outputs = instance.execute_sql(sql, ctx)
+            elapsed = (time.perf_counter() - t0) * 1000
+            out_json = []
+            for o in outputs:
+                if o.result is not None:
+                    out_json.append(result_to_json(o.result))
+                else:
+                    out_json.append({"affectedrows": o.affected_rows or 0})
+            self._json(200, {
+                "output": out_json,
+                "execution_time_ms": round(elapsed, 3),
+            })
+
+        # ------------------------------------------------------------------
+        def _handle_prom_api(self, endpoint: str):
+            params = self._form()
+            db = params.get("db", "public")
+            ctx = QueryContext(database=db)
+            engine = PromEngine(instance, ctx)
+            if endpoint == "query_range":
+                return self._handle_promql_range(params)
+            if endpoint == "query":
+                q = params.get("query", "")
+                t = _parse_prom_time(params.get("time"), time.time())
+                try:
+                    val, ev = engine.query_instant(q, t)
+                except GreptimeError as e:
+                    return self._prom_error(str(e))
+                return self._json(200, _prom_instant_json(val, ev))
+            if endpoint == "labels":
+                names = {"__name__"}
+                for match in _match_params(params):
+                    table = _match_table(instance, db, match)
+                    if table:
+                        names.update(table.tag_names)
+                if not _match_params(params):
+                    for t in instance.catalog.all_tables():
+                        names.update(t.tag_names)
+                return self._json(
+                    200, {"status": "success", "data": sorted(names)}
+                )
+            if endpoint.startswith("label/") and endpoint.endswith("/values"):
+                label = endpoint[len("label/"):-len("/values")]
+                values = set()
+                if label == "__name__":
+                    for t in instance.catalog.all_tables():
+                        values.add(t.name)
+                else:
+                    tables = [
+                        _match_table(instance, db, m)
+                        for m in _match_params(params)
+                    ] or instance.catalog.all_tables()
+                    for t in tables:
+                        if t is None or label not in t.tag_names:
+                            continue
+                        for region in t.regions:
+                            idx = region.series.tag_names.index(label)
+                            values.update(
+                                v for v in region.series.dicts[idx].values
+                                if v != ""
+                            )
+                return self._json(
+                    200, {"status": "success", "data": sorted(values)}
+                )
+            if endpoint == "series":
+                out = []
+                start = _parse_prom_time(params.get("start"), 0)
+                end = _parse_prom_time(params.get("end"), time.time())
+                for match in _match_params(params):
+                    try:
+                        val, ev = engine.query_instant(
+                            match, end, lookback_ms=max(end - start, 1),
+                        )
+                    except GreptimeError:
+                        continue
+                    if isinstance(val, VectorValue):
+                        for i, lab in enumerate(val.labels):
+                            if val.present[i].any():
+                                out.append(lab)
+                return self._json(200, {"status": "success", "data": out})
+            if endpoint == "format_query":
+                return self._json(200, {
+                    "status": "success", "data": params.get("query", ""),
+                })
+            self._error(404, f"prometheus api: {endpoint}")
+
+        def _handle_promql_range(self, params):
+            db = params.get("db", "public")
+            engine = PromEngine(instance, QueryContext(database=db))
+            q = params.get("query", "")
+            now = time.time()
+            start = _parse_prom_time(params.get("start"), now - 300)
+            end = _parse_prom_time(params.get("end"), now)
+            step_s = params.get("step", "60")
+            try:
+                step_ms = P_parse_step_ms(step_s)
+                val, ev = engine.query_range(q, start, end, step_ms)
+            except GreptimeError as e:
+                return self._prom_error(str(e))
+            self._json(200, _prom_matrix_json(val, ev))
+
+        def _prom_error(self, msg: str):
+            self._json(400, {
+                "status": "error", "errorType": "bad_data", "error": msg,
+            })
+
+        # ------------------------------------------------------------------
+        def _handle_remote_write(self):
+            params = self._params()
+            db = params.get("db", "public")
+            body = self._body()
+            compressed = "snappy" in (
+                self.headers.get("Content-Encoding") or "snappy"
+            )
+            series, samples = prom_store.remote_write(
+                instance, body, db=db, compressed=compressed,
+            )
+            _INGEST_ROWS.labels("prom_remote_write").inc(samples)
+            self._send(204, b"")
+
+        def _handle_remote_read(self):
+            params = self._params()
+            db = params.get("db", "public")
+            resp = prom_store.remote_read(instance, self._body(), db=db)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-protobuf")
+            self.send_header("Content-Encoding", "snappy")
+            self.send_header("Content-Length", str(len(resp)))
+            self.end_headers()
+            self.wfile.write(resp)
+            _REQS.labels(self._route(), "200").inc()
+
+        def _handle_influx_write(self):
+            params = self._params()
+            db = params.get("db", params.get("bucket", "public"))
+            precision = params.get("precision", "ns")
+            body = self._body().decode("utf-8", "replace")
+            rows = influx.write_lines(
+                instance, body, db=db, precision=precision,
+            )
+            _INGEST_ROWS.labels("influx_line").inc(rows)
+            self._send(204, b"")
+
+        def _handle_events(self, method: str, path: str):
+            from greptimedb_tpu.servers import event_handlers
+
+            event_handlers.handle(self, instance, method, path)
+
+    return Handler
+
+
+# ----------------------------------------------------------------------
+# prometheus json shaping
+# ----------------------------------------------------------------------
+
+def _parse_prom_time(v, default) -> int:
+    """RFC3339 or unix seconds -> ms."""
+    if v is None or v == "":
+        return int(float(default) * 1000)
+    try:
+        return int(float(v) * 1000)
+    except ValueError:
+        from greptimedb_tpu.query.expr import parse_ts_literal
+
+        return parse_ts_literal(v)
+
+
+def P_parse_step_ms(v) -> int:
+    try:
+        return max(int(float(v) * 1000), 1)
+    except (TypeError, ValueError):
+        from greptimedb_tpu.promql.parser import parse_duration_ms
+
+        return max(parse_duration_ms(str(v)), 1)
+
+
+def _fmt_sample(x: float) -> str:
+    if x != x:
+        return "NaN"
+    if x in (float("inf"), float("-inf")):
+        return "+Inf" if x > 0 else "-Inf"
+    return repr(float(x))
+
+
+def _prom_matrix_json(val, ev) -> dict:
+    if isinstance(val, ScalarValue):
+        values = [
+            [t / 1000.0, _fmt_sample(v)]
+            for t, v in zip(ev.step_ts.tolist(), val.values.tolist())
+        ]
+        return {"status": "success",
+                "data": {"resultType": "matrix",
+                         "result": [{"metric": {}, "values": values}]}}
+    result = []
+    step_s = ev.step_ts / 1000.0
+    for i, lab in enumerate(val.labels):
+        idx = np.nonzero(val.present[i])[0]
+        if len(idx) == 0:
+            continue
+        result.append({
+            "metric": lab,
+            "values": [
+                [float(step_s[j]), _fmt_sample(float(val.values[i, j]))]
+                for j in idx
+            ],
+        })
+    return {"status": "success",
+            "data": {"resultType": "matrix", "result": result}}
+
+
+def _prom_instant_json(val, ev) -> dict:
+    t = float(ev.step_ts[-1]) / 1000.0
+    if isinstance(val, ScalarValue):
+        return {"status": "success",
+                "data": {"resultType": "scalar",
+                         "result": [t, _fmt_sample(float(val.values[-1]))]}}
+    result = []
+    for i, lab in enumerate(val.labels):
+        if not val.present[i][-1]:
+            continue
+        result.append({
+            "metric": lab,
+            "value": [t, _fmt_sample(float(val.values[i, -1]))],
+        })
+    return {"status": "success",
+            "data": {"resultType": "vector", "result": result}}
+
+
+def _match_params(params: dict) -> list[str]:
+    out = []
+    v = params.get("match[]")
+    if isinstance(v, list):
+        out.extend(v)
+    elif v is not None:
+        out.append(v)
+    if "match" in params:
+        out.append(params["match"])
+    return out
+
+
+def _match_table(instance, db: str, match: str):
+    from greptimedb_tpu.promql.parser import parse_promql, VectorSelector
+
+    try:
+        sel = parse_promql(match)
+    except GreptimeError:
+        return None
+    if isinstance(sel, VectorSelector) and sel.name:
+        return instance.catalog.maybe_table(db, sel.name)
+    return None

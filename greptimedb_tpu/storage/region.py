@@ -87,6 +87,10 @@ class Region:
             if self.manifest.state.series_snapshot
             else SeriesRegistry(meta.tag_names)
         )
+        # reconcile: tags added (ALTER/auto-alter) after the last snapshot
+        for t in meta.tag_names:
+            if t not in self.series.tag_names:
+                self.series.add_tag(t)
         self.memtable = Memtable(meta.field_names,
                                  window_ms=meta.options.memtable_window_ms)
         self._frozen: list[Memtable] = []
@@ -133,7 +137,8 @@ class Region:
     def _apply_rows(self, tag_columns, ts, fields, field_valid, op, base_seq):
         n = len(ts)
         sids = self.series.intern_rows(
-            [np.asarray(tag_columns[name], object)
+            [np.asarray(tag_columns[name], object) if name in tag_columns
+             else np.full(n, "", object)
              for name in self.meta.tag_names],
             n=n,
         )

@@ -67,6 +67,24 @@ class SeriesRegistry:
                 uniq_sids[i] = sid
             return uniq_sids[np.ravel(inv)]
 
+    def add_tag(self, name: str) -> None:
+        """Add a tag column; existing series get "" for it. Sids are stable
+        (the dense-sid design makes schema evolution free — the reference's
+        metric engine gets this via its tsid hash, engine/put.rs:139).
+
+        Mutation order matters for lock-free readers (tag_values/
+        series_tags index dicts by tag_names.index): rows and dicts are
+        widened BEFORE the name becomes resolvable."""
+        with self._lock:
+            if name in self.tag_names:
+                return
+            d = Dictionary()
+            empty = d.intern("")
+            self._rows = [r + (empty,) for r in self._rows]
+            self._series = {r: i for i, r in enumerate(self._rows)}
+            self.dicts.append(d)
+            self.tag_names.append(name)
+
     def lookup_series(self, tags: dict[str, str]) -> int | None:
         """Exact-match lookup of one series by full tag set."""
         key = []

@@ -1,0 +1,10 @@
+from greptimedb_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    global_registry,
+)
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "global_registry"]

@@ -1,0 +1,236 @@
+"""Prometheus-style in-process metrics.
+
+Capability counterpart of the reference's per-crate Prometheus registries
+(/root/reference/src/*/src/metrics.rs + the /metrics endpoint,
+src/servers/src/metrics_handler.rs): counters, gauges, histograms with
+labels, rendered in the text exposition format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: tuple[str, ...]):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._children: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values: str):
+        key = tuple(str(v) for v in values)
+        if len(key) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected {len(self.label_names)} labels"
+            )
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_child()
+                self._children[key] = child
+            return child
+
+    def _snapshot(self) -> list[tuple[tuple, object]]:
+        with self._lock:
+            return list(self._children.items())
+
+    def _default(self):
+        return self.labels()
+
+
+def _fmt_labels(names, values) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{v}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+class _CounterChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self.value += amount
+
+
+class Counter(_Metric):
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0):
+        self._default().inc(amount)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        for key, c in self._snapshot():
+            out.append(
+                f"{self.name}{_fmt_labels(self.label_names, key)} {c.value}"
+            )
+        return out
+
+
+class _GaugeChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float):
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0):
+        self.inc(-amount)
+
+
+class Gauge(_Metric):
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, v: float):
+        self._default().set(v)
+
+    def inc(self, amount: float = 1.0):
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0):
+        self._default().dec(amount)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        for key, c in self._snapshot():
+            out.append(
+                f"{self.name}{_fmt_labels(self.label_names, key)} {c.value}"
+            )
+        return out
+
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0,
+)
+
+
+class _HistogramChild:
+    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+
+    def __init__(self, buckets):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.total = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        with self._lock:
+            self.total += v
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+
+    def time(self):
+        return _Timer(self)
+
+
+class _Timer:
+    def __init__(self, child):
+        self.child = child
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.child.observe(time.perf_counter() - self.t0)
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_, label_names=(), buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(buckets)
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v: float):
+        self._default().observe(v)
+
+    def time(self):
+        return self._default().time()
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        for key, c in self._snapshot():
+            cum = 0
+            for b, n in zip(self.buckets, c.counts):
+                cum = max(cum, n)
+                lab = _fmt_labels(
+                    self.label_names + ("le",), key + (repr(float(b)),)
+                )
+                out.append(f"{self.name}_bucket{lab} {n}")
+            lab = _fmt_labels(self.label_names + ("le",), key + ("+Inf",))
+            out.append(f"{self.name}_bucket{lab} {c.count}")
+            out.append(
+                f"{self.name}_sum{_fmt_labels(self.label_names, key)} "
+                f"{c.total}"
+            )
+            out.append(
+                f"{self.name}_count{_fmt_labels(self.label_names, key)} "
+                f"{c.count}"
+            )
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name, help_="", labels=()) -> Counter:
+        return self._get(name, lambda: Counter(name, help_, tuple(labels)))
+
+    def gauge(self, name, help_="", labels=()) -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_, tuple(labels)))
+
+    def histogram(self, name, help_="", labels=(),
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._get(
+            name, lambda: Histogram(name, help_, tuple(labels), buckets)
+        )
+
+    def _get(self, name, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            return m
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+global_registry = MetricsRegistry()

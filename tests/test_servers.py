@@ -1,0 +1,350 @@
+"""Protocol server tests: HTTP SQL, Prometheus API, InfluxDB line protocol,
+Prometheus remote write/read, metrics (the protocol-tests role of
+/root/reference/tests-integration/tests/http.rs)."""
+
+import json
+import struct
+import urllib.request
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.servers import snappy
+from greptimedb_tpu.servers.http import HttpServer
+from greptimedb_tpu.servers.influx import parse_line, write_lines
+from greptimedb_tpu.servers.prom_store import (
+    _field_bytes,
+    _field_double,
+    _field_varint,
+    parse_write_request,
+)
+
+
+@pytest.fixture()
+def server(tmp_path):
+    inst = Standalone(str(tmp_path / "data"))
+    srv = HttpServer(inst, port=0).start()
+    yield srv
+    srv.stop()
+    inst.close()
+
+
+def _req(srv, path, data=None, headers=None, method=None):
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    req = urllib.request.Request(
+        url, data=data, headers=headers or {}, method=method
+    )
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, resp.read(), dict(resp.headers)
+
+
+def _sql(srv, sql, db="public"):
+    import urllib.parse
+
+    body = urllib.parse.urlencode({"sql": sql, "db": db}).encode()
+    status, data, _ = _req(
+        srv, "/v1/sql", body,
+        {"Content-Type": "application/x-www-form-urlencoded"}, "POST",
+    )
+    assert status == 200
+    return json.loads(data)
+
+
+# ----------------------------------------------------------------------
+# snappy
+# ----------------------------------------------------------------------
+
+def test_snappy_roundtrip():
+    data = b"hello world " * 100 + bytes(range(256))
+    assert snappy.decompress(snappy.compress(data)) == data
+
+
+def test_snappy_copy_decode():
+    # handcrafted block with a copy: "abcdabcd"
+    # varint 8, literal len-4 'abcd' (tag (3)<<2=12), copy1 len4 off4
+    block = bytes([8, 12]) + b"abcd" + bytes([0b001, 4])
+    assert snappy.decompress(block) == b"abcdabcd"
+
+
+# ----------------------------------------------------------------------
+# line protocol
+# ----------------------------------------------------------------------
+
+def test_parse_line_basic():
+    m, tags, fields, ts = parse_line(
+        "cpu,host=h1,region=us usage_user=10.5,usage_idle=88i 1700000000000"
+    )
+    assert m == "cpu"
+    assert tags == {"host": "h1", "region": "us"}
+    assert fields == {"usage_user": 10.5, "usage_idle": 88}
+    assert ts == "1700000000000"
+
+
+def test_parse_line_escapes_and_strings():
+    m, tags, fields, ts = parse_line(
+        'weird\\ name,tag\\,1=a\\ b msg="hello, \\"world\\"",ok=t'
+    )
+    assert m == "weird name"
+    assert tags == {"tag,1": "a b"}
+    assert fields["msg"] == 'hello, "world"'
+    assert fields["ok"] is True
+    assert ts is None
+
+
+def test_write_lines_auto_create(tmp_path):
+    inst = Standalone(str(tmp_path / "d"))
+    n = write_lines(
+        inst,
+        "cpu,host=h1 usage=10 1700000000000000000\n"
+        "cpu,host=h2 usage=20 1700000001000000000\n"
+        "mem,host=h1 used=512i 1700000000000000000\n",
+        precision="ns",
+    )
+    assert n == 3
+    res = inst.sql("SELECT host, usage FROM cpu ORDER BY host")
+    assert res.rows() == [["h1", 10.0], ["h2", 20.0]]
+    res = inst.sql("SELECT used FROM mem")
+    assert res.rows() == [[512]]
+    # widen with a new tag + field
+    write_lines(
+        inst, "cpu,host=h3,dc=east usage=30,temp=70 1700000002000000000",
+        precision="ns",
+    )
+    res = inst.sql("SELECT host, dc, temp FROM cpu WHERE host = 'h3'")
+    assert res.rows() == [["h3", "east", 70.0]]
+    # old rows read empty tag, null field
+    res = inst.sql("SELECT count(temp) FROM cpu")
+    assert res.rows() == [[1]]
+    inst.close()
+
+
+# ----------------------------------------------------------------------
+# HTTP SQL
+# ----------------------------------------------------------------------
+
+def test_http_sql_roundtrip(server):
+    out = _sql(server, "CREATE TABLE t (host STRING, v DOUBLE, "
+                       "ts TIMESTAMP TIME INDEX, PRIMARY KEY(host))")
+    assert out["output"][0] == {"affectedrows": 0}
+    _sql(server, "INSERT INTO t VALUES ('a', 1.5, 1000), ('b', 2.5, 2000)")
+    out = _sql(server, "SELECT host, v FROM t ORDER BY host")
+    rec = out["output"][0]["records"]
+    assert [c["name"] for c in rec["schema"]["column_schemas"]] == [
+        "host", "v",
+    ]
+    assert [c["data_type"] for c in rec["schema"]["column_schemas"]] == [
+        "String", "Float64",
+    ]
+    assert rec["rows"] == [["a", 1.5], ["b", 2.5]]
+    assert "execution_time_ms" in out
+
+
+def test_http_sql_error(server):
+    import urllib.parse, urllib.error
+
+    body = urllib.parse.urlencode({"sql": "SELECT FROM"}).encode()
+    try:
+        _req(server, "/v1/sql", body,
+             {"Content-Type": "application/x-www-form-urlencoded"}, "POST")
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "error" in json.loads(e.read())
+
+
+# ----------------------------------------------------------------------
+# InfluxDB over HTTP
+# ----------------------------------------------------------------------
+
+def test_http_influx_write_and_query(server):
+    body = (
+        "cpu,host=h1 usage=42 1700000000000\n"
+        "cpu,host=h2 usage=43 1700000000000\n"
+    ).encode()
+    status, _, _ = _req(
+        server, "/v1/influxdb/write?precision=ms", body, {}, "POST"
+    )
+    assert status == 204
+    out = _sql(server, "SELECT host, usage FROM cpu ORDER BY host")
+    assert out["output"][0]["records"]["rows"] == [
+        ["h1", 42.0], ["h2", 43.0],
+    ]
+
+
+# ----------------------------------------------------------------------
+# Prometheus API
+# ----------------------------------------------------------------------
+
+def _setup_prom_data(server):
+    _sql(server, "CREATE TABLE up (job STRING, greptime_value DOUBLE, "
+                 "ts TIMESTAMP TIME INDEX, PRIMARY KEY (job))")
+    _sql(server,
+         "INSERT INTO up VALUES ('api', 1.0, 1700000000000), "
+         "('db', 0.0, 1700000000000), ('api', 1.0, 1700000060000), "
+         "('db', 1.0, 1700000060000)")
+
+
+def test_prom_query_range(server):
+    _setup_prom_data(server)
+    status, data, _ = _req(
+        server,
+        "/v1/prometheus/api/v1/query_range?query=up&start=1700000000"
+        "&end=1700000060&step=60",
+    )
+    assert status == 200
+    out = json.loads(data)
+    assert out["status"] == "success"
+    assert out["data"]["resultType"] == "matrix"
+    by_job = {
+        r["metric"]["job"]: r["values"] for r in out["data"]["result"]
+    }
+    assert by_job["api"] == [[1700000000.0, "1.0"], [1700000060.0, "1.0"]]
+
+
+def test_prom_instant_query(server):
+    _setup_prom_data(server)
+    status, data, _ = _req(
+        server,
+        "/v1/prometheus/api/v1/query?query=sum(up)&time=1700000060",
+    )
+    out = json.loads(data)
+    assert out["data"]["resultType"] == "vector"
+    assert out["data"]["result"][0]["value"][1] == "2.0"
+
+
+def test_prom_labels_and_values(server):
+    _setup_prom_data(server)
+    _, data, _ = _req(server, "/v1/prometheus/api/v1/labels")
+    labels = json.loads(data)["data"]
+    assert "job" in labels and "__name__" in labels
+    _, data, _ = _req(
+        server, "/v1/prometheus/api/v1/label/__name__/values"
+    )
+    assert "up" in json.loads(data)["data"]
+    _, data, _ = _req(server, "/v1/prometheus/api/v1/label/job/values")
+    assert json.loads(data)["data"] == ["api", "db"]
+
+
+def test_prom_series(server):
+    _setup_prom_data(server)
+    _, data, _ = _req(
+        server,
+        "/v1/prometheus/api/v1/series?match[]=up&start=1699999990"
+        "&end=1700000070",
+    )
+    out = json.loads(data)["data"]
+    jobs = sorted(s["job"] for s in out)
+    assert jobs == ["api", "db"]
+
+
+# ----------------------------------------------------------------------
+# remote write / read
+# ----------------------------------------------------------------------
+
+def _make_write_request():
+    def label(name, value):
+        return _field_bytes(
+            1, _field_bytes(1, name.encode()) + _field_bytes(2, value.encode())
+        )
+
+    def sample(value, ts):
+        return _field_bytes(2, _field_double(1, value) + _field_varint(2, ts))
+
+    def ts_msg(labels, samples):
+        return _field_bytes(1, b"".join(labels) + b"".join(samples))
+
+    return (
+        ts_msg(
+            [label("__name__", "http_total"), label("job", "api")],
+            [sample(100.0, 1700000000000), sample(110.0, 1700000015000)],
+        )
+        + ts_msg(
+            [label("__name__", "http_total"), label("job", "web")],
+            [sample(200.0, 1700000000000)],
+        )
+    )
+
+
+def test_parse_write_request():
+    req = _make_write_request()
+    series = parse_write_request(req)
+    assert len(series) == 2
+    labels, samples = series[0]
+    assert labels == {"__name__": "http_total", "job": "api"}
+    assert samples == [(100.0, 1700000000000), (110.0, 1700000015000)]
+
+
+def test_remote_write_http(server):
+    body = snappy.compress(_make_write_request())
+    status, _, _ = _req(
+        server, "/v1/prometheus/write", body,
+        {"Content-Encoding": "snappy"}, "POST",
+    )
+    assert status == 204
+    out = _sql(server, "SELECT job, greptime_value FROM http_total "
+                       "ORDER BY job, ts")
+    assert out["output"][0]["records"]["rows"] == [
+        ["api", 100.0], ["api", 110.0], ["web", 200.0],
+    ]
+    # and it is queryable through PromQL
+    status, data, _ = _req(
+        server,
+        "/v1/prometheus/api/v1/query?query=http_total&time=1700000015",
+    )
+    res = json.loads(data)["data"]["result"]
+    assert {r["metric"]["job"] for r in res} == {"api", "web"}
+
+
+def test_remote_read_http(server):
+    body = snappy.compress(_make_write_request())
+    _req(server, "/v1/prometheus/write", body,
+         {"Content-Encoding": "snappy"}, "POST")
+    # ReadRequest: query { start=1, end=17000001000000, matcher __name__ }
+    matcher = _field_bytes(3, (
+        _field_varint(1, 0) + _field_bytes(2, b"__name__")
+        + _field_bytes(3, b"http_total")
+    ))
+    query = _field_bytes(1, (
+        _field_varint(1, 1) + _field_varint(2, 1700000100000) + matcher
+    ))
+    status, data, headers = _req(
+        server, "/v1/prometheus/read", snappy.compress(query), {}, "POST"
+    )
+    assert status == 200
+    resp = snappy.decompress(data)
+    # results(1) -> timeseries(1) -> labels(1)/samples(2)
+    from greptimedb_tpu.servers.prom_store import _iter_fields
+
+    n_series = 0
+    values = []
+    for f, w, v in _iter_fields(resp):
+        assert f == 1
+        for f2, w2, v2 in _iter_fields(v):
+            n_series += 1
+            for f3, w3, v3 in _iter_fields(v2):
+                if f3 == 2:
+                    for f4, w4, v4 in _iter_fields(v3):
+                        if f4 == 1:
+                            values.append(struct.unpack("<d", v4)[0])
+    assert n_series == 2
+    assert sorted(values) == [100.0, 110.0, 200.0]
+
+
+# ----------------------------------------------------------------------
+# observability endpoints
+# ----------------------------------------------------------------------
+
+def test_metrics_endpoint(server):
+    _sql(server, "SELECT 1")
+    status, data, _ = _req(server, "/metrics")
+    assert status == 200
+    text = data.decode()
+    assert "greptime_servers_http_requests_total" in text
+
+
+def test_health_and_status(server):
+    status, data, _ = _req(server, "/health")
+    assert status == 200
+    status, data, _ = _req(server, "/status")
+    assert json.loads(data)["version"]
