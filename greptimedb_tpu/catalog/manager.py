@@ -292,11 +292,13 @@ class CatalogManager:
             existing = table.info.schema.maybe_column(col.name)
             if existing is not None:
                 # idempotent: concurrent protocol auto-widen may race the
-                # check-then-alter; same name + semantic is a no-op
-                if existing.semantic_type == col.semantic_type:
+                # check-then-alter; an identical column is a no-op
+                if (existing.semantic_type == col.semantic_type
+                        and existing.data_type == col.data_type):
                     return
                 raise InvalidArgumentError(
-                    f"column {col.name!r} exists with a different semantic"
+                    f"column {col.name!r} already exists as "
+                    f"{existing.data_type.name}"
                 )
             table.info.schema = table.info.schema.with_column(col)
             if col.semantic_type == SemanticType.TAG:

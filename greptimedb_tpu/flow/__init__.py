@@ -1,0 +1,3 @@
+from greptimedb_tpu.flow.manager import FlowManager
+
+__all__ = ["FlowManager"]

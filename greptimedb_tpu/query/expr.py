@@ -400,7 +400,8 @@ def format_expr(e: A.Expr) -> str:
             return "NULL"
         return str(e.value)
     if isinstance(e, A.IntervalLit):
-        return e.raw
+        # INTERVAL form round-trips compound intervals ('1 hour 30 minutes')
+        return f"INTERVAL '{e.raw}'"
     if isinstance(e, A.Column):
         return e.name
     if isinstance(e, A.Star):

@@ -118,8 +118,26 @@ def _make_handler(instance):
             self.wfile.write(body)
             _REQS.labels(self._route(), str(code)).inc()
 
-        def _route(self) -> str:
+        _KNOWN_ROUTES = (
+            "/health", "/ready", "/status", "/metrics", "/v1/sql",
+            "/v1/promql", "/v1/prometheus/api/v1/", "/v1/prometheus/write",
+            "/v1/prometheus/read", "/v1/influxdb/", "/influxdb/",
+            "/v1/events",
+        )
+
+        def _raw_path(self) -> str:
             return urllib.parse.urlparse(self.path).path
+
+        def _route(self) -> str:
+            """Metric-label-safe route: unknown paths collapse to 'other'
+            (unbounded label cardinality would leak memory per 404)."""
+            path = self._raw_path()
+            for r in self._KNOWN_ROUTES:
+                if path == r:
+                    return path
+                if r.endswith("/") and path.startswith(r):
+                    return r + "*"
+            return "other"
 
         def _json(self, code: int, obj):
             self._send(code, json.dumps(obj).encode())
@@ -160,8 +178,6 @@ def _make_handler(instance):
                     params.update(json.loads(body))
                 except json.JSONDecodeError:
                     pass
-            elif body:
-                self._raw_body = body
             return params
 
         # ------------------------------------------------------------------
@@ -172,7 +188,7 @@ def _make_handler(instance):
             self._dispatch("POST")
 
         def _dispatch(self, method: str):
-            path = self._route()
+            path = self._raw_path()
             t0 = time.perf_counter()
             try:
                 self._route_request(method, path)
@@ -184,7 +200,9 @@ def _make_handler(instance):
                 traceback.print_exc()
                 self._error(500, f"internal error: {e}")
             finally:
-                _LATENCY.labels(path).observe(time.perf_counter() - t0)
+                _LATENCY.labels(self._route()).observe(
+                    time.perf_counter() - t0
+                )
 
         def _route_request(self, method: str, path: str):
             if path in ("/health", "/ready", "/-/healthy", "/-/ready"):

@@ -264,17 +264,30 @@ def ensure_table(instance, db: str, name: str, tag_keys: list[str],
         return instance.catalog.create_table(
             db, name, Schema(cols), if_not_exists=True,
         )
-    # widen: add unseen tags/fields
+    # widen: add unseen tags/fields; a name clash across semantics is an
+    # error, not a silent drop
     schema = table.schema
     for k in tag_keys:
-        if k not in schema:
+        existing = schema.maybe_column(k)
+        if existing is None:
             instance.catalog.alter_add_column(db, name, ColumnSchema(
                 k, ConcreteDataType.string(), SemanticType.TAG,
             ))
+        elif not existing.is_tag:
+            raise LineProtocolError(
+                f"{name}.{k} is a {existing.semantic_type.name} column, "
+                "cannot write it as a tag"
+            )
     for k, t in field_types.items():
-        if k not in schema:
+        existing = schema.maybe_column(k)
+        if existing is None:
             instance.catalog.alter_add_column(db, name, ColumnSchema(
                 k, t, SemanticType.FIELD,
             ))
+        elif not existing.is_field:
+            raise LineProtocolError(
+                f"{name}.{k} is a {existing.semantic_type.name} column, "
+                "cannot write it as a field"
+            )
         schema = table.schema
     return table

@@ -231,19 +231,37 @@ def remote_read(instance, body: bytes, *, db: str = "public") -> bytes:
     queries = parse_read_request(data)
     query_results = []
     for start, end, matchers in queries:
-        metric = None
+        name_matchers = []
         reg_matchers = []
         for mtype, name, value in matchers:
-            if name == "__name__" and mtype == 0:
-                metric = value
+            if name == "__name__":
+                name_matchers.append((mtype, value))
                 continue
             op = {0: "eq", 1: "ne", 2: "re", 3: "nre"}[mtype]
             val = _re.compile(value) if mtype in (2, 3) else value
             reg_matchers.append((name, op, val))
+        # resolve metric names: EQ narrows to one, RE/NEQ/NRE filter all
+        metrics = [
+            t.name for t in instance.catalog.all_tables()
+            if t.info.database == db
+        ]
+        for mtype, value in name_matchers:
+            if mtype == 0:
+                metrics = [m for m in metrics if m == value]
+            elif mtype == 1:
+                metrics = [m for m in metrics if m != value]
+            else:
+                rx = _re.compile(value)
+                hit = lambda m: bool(rx.fullmatch(m))
+                metrics = [
+                    m for m in metrics
+                    if (hit(m) if mtype == 2 else not hit(m))
+                ]
         timeseries = []
-        table = (instance.catalog.maybe_table(db, metric)
-                 if metric else None)
-        if table is not None and VALUE_FIELD in table.schema:
+        for metric in metrics:
+            table = instance.catalog.maybe_table(db, metric)
+            if table is None or VALUE_FIELD not in table.schema:
+                continue
             scan = table.scan(
                 ts_min=start, ts_max=end, field_names=[VALUE_FIELD],
                 matchers=reg_matchers or None,

@@ -731,9 +731,8 @@ class Parser:
                 return A.Literal(False)
             if up == "INTERVAL":
                 self.next()
-                return A.IntervalLit(
-                    parse_interval_ms(self._interval_text()), "interval"
-                )
+                text = self._interval_text()
+                return A.IntervalLit(parse_interval_ms(text), text)
             if up == "CASE":
                 return self.case_expr()
             if up == "CAST":

@@ -179,9 +179,9 @@ class Histogram(_Metric):
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
         for key, c in self._snapshot():
-            cum = 0
+            # observe() increments every bucket with v <= bound, so counts
+            # are already cumulative as the exposition format requires
             for b, n in zip(self.buckets, c.counts):
-                cum = max(cum, n)
                 lab = _fmt_labels(
                     self.label_names + ("le",), key + (repr(float(b)),)
                 )

@@ -1,0 +1,183 @@
+"""Flow (continuous aggregation) tests — the sqlness flow-case role of
+/root/reference/tests/cases/standalone/common/flow/."""
+
+import time
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    s = Standalone(str(tmp_path / "data"))
+    s.enable_flows()
+    s.flows.tick_interval_s = 3600  # manual flushes in tests
+    yield s
+    s.close()
+
+
+def _setup_source(inst):
+    inst.sql(
+        "CREATE TABLE requests (host STRING, status STRING, latency DOUBLE, "
+        "ts TIMESTAMP TIME INDEX, PRIMARY KEY (host, status))"
+    )
+
+
+def test_create_flow_and_aggregate(inst):
+    _setup_source(inst)
+    inst.sql(
+        "CREATE FLOW req_stats SINK TO req_summary AS "
+        "SELECT date_bin('1 minute', ts) AS time_window, host, "
+        "count(*) AS total, avg(latency) AS avg_latency "
+        "FROM requests GROUP BY time_window, host"
+    )
+    assert inst.flows.flow_names() == ["req_stats"]
+
+    inst.sql(
+        "INSERT INTO requests VALUES "
+        "('h1', '200', 10.0, 1700000000000), "
+        "('h1', '200', 20.0, 1700000010000), "
+        "('h2', '500', 30.0, 1700000020000), "
+        "('h1', '200', 40.0, 1700000070000)"
+    )
+    inst.flows.flush_all()
+    res = inst.sql(
+        "SELECT time_window, host, total, avg_latency FROM req_summary "
+        "ORDER BY time_window, host"
+    )
+    assert res.rows() == [
+        [1699999980000, "h1", 2, 15.0],
+        [1699999980000, "h2", 1, 30.0],
+        [1700000040000, "h1", 1, 40.0],
+    ]
+
+
+def test_flow_incremental_updates(inst):
+    _setup_source(inst)
+    inst.sql(
+        "CREATE FLOW agg SINK TO sums AS "
+        "SELECT date_bin('1 minute', ts) AS w, host, sum(latency) AS s "
+        "FROM requests GROUP BY w, host"
+    )
+    inst.sql("INSERT INTO requests VALUES ('h1', '200', 5.0, 1700000000000)")
+    inst.flows.flush_all()
+    res = inst.sql("SELECT s FROM sums WHERE host = 'h1'")
+    assert res.rows() == [[5.0]]
+    # incremental: second insert into the SAME window updates the row
+    inst.sql("INSERT INTO requests VALUES ('h1', '200', 7.0, 1700000030000)")
+    inst.flows.flush_all()
+    res = inst.sql("SELECT s FROM sums WHERE host = 'h1'")
+    assert res.rows() == [[12.0]]
+
+
+def test_flow_with_where_filter(inst):
+    _setup_source(inst)
+    inst.sql(
+        "CREATE FLOW errors SINK TO error_counts AS "
+        "SELECT date_bin('1 minute', ts) AS w, host, count(*) AS errors "
+        "FROM requests WHERE status = '500' GROUP BY w, host"
+    )
+    inst.sql(
+        "INSERT INTO requests VALUES "
+        "('h1', '200', 1.0, 1700000000000), "
+        "('h1', '500', 2.0, 1700000010000), "
+        "('h1', '500', 3.0, 1700000020000)"
+    )
+    inst.flows.flush_all()
+    res = inst.sql("SELECT host, errors FROM error_counts")
+    assert res.rows() == [["h1", 2]]
+
+
+def test_flow_min_max(inst):
+    _setup_source(inst)
+    inst.sql(
+        "CREATE FLOW mm SINK TO minmax AS "
+        "SELECT host, min(latency) AS lo, max(latency) AS hi "
+        "FROM requests GROUP BY host"
+    )
+    inst.sql(
+        "INSERT INTO requests VALUES ('h1', '200', 3.0, 1700000000000), "
+        "('h1', '200', 9.0, 1700000010000)"
+    )
+    inst.flows.flush_all()
+    res = inst.sql("SELECT host, lo, hi FROM minmax")
+    assert res.rows() == [["h1", 3.0, 9.0]]
+
+
+def test_flow_show_and_drop(inst):
+    _setup_source(inst)
+    inst.sql("CREATE FLOW f1 SINK TO s1 AS "
+             "SELECT host, count(*) AS c FROM requests GROUP BY host")
+    res = inst.sql("SHOW FLOWS")
+    assert res.rows() == [["f1"]]
+    res = inst.sql(
+        "SELECT flow_name, source_table, sink_table "
+        "FROM information_schema.flows"
+    )
+    assert res.rows() == [["f1", "requests", "s1"]]
+    inst.sql("DROP FLOW f1")
+    assert inst.flows.flow_names() == []
+
+
+def test_flow_survives_restart(tmp_path):
+    inst = Standalone(str(tmp_path / "data"))
+    inst.enable_flows()
+    inst.flows.tick_interval_s = 3600
+    _setup_source(inst)
+    inst.sql(
+        "CREATE FLOW agg SINK TO sums AS "
+        "SELECT date_bin('1 minute', ts) AS w, host, sum(latency) AS s "
+        "FROM requests GROUP BY w, host"
+    )
+    inst.sql("INSERT INTO requests VALUES ('h1', '200', 5.0, 1700000000000)")
+    inst.flows.flush_all()
+    inst.close()
+
+    inst2 = Standalone(str(tmp_path / "data"))
+    inst2.enable_flows()
+    inst2.flows.tick_interval_s = 3600
+    assert inst2.flows.flow_names() == ["agg"]
+    # new inserts keep flowing into the sink after restart
+    inst2.sql("INSERT INTO requests VALUES ('h2', '200', 8.0, 1700000005000)")
+    inst2.flows.flush_all()
+    res = inst2.sql("SELECT host, s FROM sums ORDER BY host")
+    rows = res.rows()
+    assert ["h2", 8.0] in rows
+    inst2.close()
+
+
+def test_flow_through_influx_ingest(inst):
+    from greptimedb_tpu.servers.influx import write_lines
+
+    _setup_source(inst)
+    inst.sql(
+        "CREATE FLOW agg SINK TO sums AS "
+        "SELECT host, sum(latency) AS s FROM requests GROUP BY host"
+    )
+    write_lines(
+        inst,
+        "requests,host=h9,status=200 latency=4.5 1700000000000\n"
+        "requests,host=h9,status=200 latency=5.5 1700000001000\n",
+        precision="ms",
+    )
+    inst.flows.flush_all()
+    res = inst.sql("SELECT host, s FROM sums")
+    assert res.rows() == [["h9", 10.0]]
+
+
+def test_flow_tagless_global_aggregate(inst):
+    _setup_source(inst)
+    inst.sql(
+        "CREATE FLOW tot SINK TO totals AS "
+        "SELECT count(*) AS n, sum(latency) AS s FROM requests "
+        "GROUP BY status"
+    )
+    inst.sql(
+        "INSERT INTO requests VALUES ('h1', '200', 1.0, 1700000000000), "
+        "('h2', '200', 2.0, 1700000000000)"
+    )
+    inst.flows.flush_all()
+    res = inst.sql("SELECT n, s FROM totals")
+    assert res.rows() == [[2, 3.0]]
