@@ -284,17 +284,24 @@ class CatalogManager:
     # ------------------------------------------------------------------
     # alter
     # ------------------------------------------------------------------
-    def alter_add_column(self, database: str, name: str, col: ColumnSchema):
+    def alter_add_column(self, database: str, name: str, col: ColumnSchema,
+                         *, if_not_exists: bool = False):
+        """if_not_exists: protocol auto-widen mode — a same-semantic column
+        is a no-op even when the inferred data type differs (the first
+        writer's type wins; an int64/float64 inference race must not fail a
+        whole ingest batch). Explicit SQL ALTER stays strict."""
         with self._lock:
             table = self.table(database, name)
             if col.semantic_type == SemanticType.TIMESTAMP:
                 raise InvalidArgumentError("cannot add a TIME INDEX column")
             existing = table.info.schema.maybe_column(col.name)
             if existing is not None:
-                # idempotent: concurrent protocol auto-widen may race the
-                # check-then-alter; an identical column is a no-op
-                if (existing.semantic_type == col.semantic_type
-                        and existing.data_type == col.data_type):
+                if existing.semantic_type != col.semantic_type:
+                    raise InvalidArgumentError(
+                        f"column {col.name!r} already exists as a "
+                        f"{existing.semantic_type.name} column"
+                    )
+                if if_not_exists or existing.data_type == col.data_type:
                     return
                 raise InvalidArgumentError(
                     f"column {col.name!r} already exists as "

@@ -397,10 +397,10 @@ class FlowManager:
             out_cols[a.key] = Col(
                 vals, None if present.all() else present
             )
-        gsrc = DictSource(out_cols, g)
-        names = [nm for _, nm in plan.post_items]
-        results = [eval_expr(e, gsrc) for e, _ in plan.post_items]
         try:
+            gsrc = DictSource(out_cols, g)
+            names = [nm for _, nm in plan.post_items]
+            results = [eval_expr(e, gsrc) for e, _ in plan.post_items]
             self._write_sink(flow, names, results, out_cols)
         except Exception:
             # keep the updates flushable: re-mark the groups dirty
@@ -437,7 +437,10 @@ class FlowManager:
                 if col.validity is not None:
                     fvalid[nm] = col.validity
         if ts is None:
-            ts = np.full(n, now_ms, np.int64)
+            # placeholder time index (constant 0): writeback must UPSERT
+            # per group key via last-write-wins dedup, never append — the
+            # reference's __ts_placeholder semantics
+            ts = np.zeros(n, np.int64)
         if "update_at" in sink.schema:
             fields["update_at"] = np.full(n, now_ms, np.int64)
         sink.write(tags, ts, fields, field_valid=fvalid or None)
@@ -474,8 +477,15 @@ class FlowManager:
                   else ConcreteDataType.float64())
             cols.append(ColumnSchema(nm, dt, SemanticType.FIELD))
         if not have_ts:
+            # non-windowed flow: constant-0 placeholder TIME INDEX makes
+            # writeback an upsert; update_at (a FIELD) carries freshness
             cols.append(ColumnSchema(
                 "update_at", ConcreteDataType.timestamp_millisecond(),
+                SemanticType.FIELD,
+            ))
+            cols.append(ColumnSchema(
+                "__ts_placeholder",
+                ConcreteDataType.timestamp_millisecond(),
                 SemanticType.TIMESTAMP, nullable=False,
             ))
         return self.instance.catalog.create_table(

@@ -1,0 +1,89 @@
+"""Phi-accrual failure detection.
+
+Semantics of /root/reference/src/meta-srv/src/failure_detector.rs:8-26 (a
+port of Akka's PhiAccrualFailureDetector): heartbeat inter-arrival times
+feed a normal model; phi(now) = -log10(P(no heartbeat for this long));
+crossing the threshold marks the peer suspect.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+
+
+class PhiAccrualFailureDetector:
+    def __init__(
+        self,
+        *,
+        threshold: float = 8.0,
+        min_std_deviation_ms: float = 100.0,
+        acceptable_heartbeat_pause_ms: float = 10_000.0,
+        first_heartbeat_estimate_ms: float = 1_000.0,
+        max_sample_size: int = 1_000,
+    ):
+        self.threshold = threshold
+        self.min_std_deviation_ms = min_std_deviation_ms
+        self.acceptable_pause_ms = acceptable_heartbeat_pause_ms
+        self.first_estimate_ms = first_heartbeat_estimate_ms
+        self._intervals: deque[float] = deque(maxlen=max_sample_size)
+        self._sum = 0.0
+        self._sum2 = 0.0
+        self.last_heartbeat_ms: float | None = None
+
+    def heartbeat(self, now_ms: float) -> None:
+        last = self.last_heartbeat_ms
+        self.last_heartbeat_ms = now_ms
+        if last is None:
+            # seed the model like the reference: mean = first estimate,
+            # stddev = estimate / 4
+            est = self.first_estimate_ms
+            self._push(est - est / 4)
+            self._push(est + est / 4)
+            return
+        self._push(now_ms - last)
+
+    def _push(self, interval: float) -> None:
+        if len(self._intervals) == self._intervals.maxlen:
+            old = self._intervals[0]
+            self._sum -= old
+            self._sum2 -= old * old
+        self._intervals.append(interval)
+        self._sum += interval
+        self._sum2 += interval * interval
+
+    @property
+    def mean(self) -> float:
+        n = len(self._intervals)
+        return self._sum / n if n else 0.0
+
+    @property
+    def std_deviation(self) -> float:
+        n = len(self._intervals)
+        if n == 0:
+            return self.min_std_deviation_ms
+        var = max(self._sum2 / n - self.mean ** 2, 0.0)
+        return max(math.sqrt(var), self.min_std_deviation_ms)
+
+    def phi(self, now_ms: float) -> float:
+        if self.last_heartbeat_ms is None:
+            return 0.0
+        elapsed = now_ms - self.last_heartbeat_ms
+        mean = self.mean + self.acceptable_pause_ms
+        std = self.std_deviation
+        y = (elapsed - mean) / std
+        # saturate: the cubic in the exponent overflows exp() past |y|~21,
+        # and the probabilities are already pinned at 0/1 well before that
+        y = max(min(y, 18.0), -18.0)
+        # logistic approximation of the normal CDF (as in Akka/reference)
+        e = math.exp(-y * (1.5976 + 0.070566 * y * y))
+        if elapsed > mean:
+            p = e / (1.0 + e)
+        else:
+            p = 1.0 - 1.0 / (1.0 + e)
+        if p < 1e-300:
+            p = 1e-300
+        return -math.log10(p)
+
+    def is_available(self, now_ms: float) -> bool:
+        return self.phi(now_ms) < self.threshold

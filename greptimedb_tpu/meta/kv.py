@@ -1,0 +1,125 @@
+"""Key-value metadata backends.
+
+Capability counterpart of /root/reference/src/common/meta/src/kv_backend/
+(etcd, memory, raft-engine backends behind one KvBackend trait with txn
+support): get/put/range/delete plus compare-and-put, which is what the
+metadata layer, procedure store, and election need. An external etcd can
+slot in behind the same interface later.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class KvBackend:
+    def get(self, key: str) -> bytes | None:
+        raise NotImplementedError
+
+    def put(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def range(self, prefix: str) -> list[tuple[str, bytes]]:
+        raise NotImplementedError
+
+    def compare_and_put(self, key: str, expect: bytes | None,
+                        value: bytes) -> bool:
+        """Atomic: put iff current value == expect (None == absent)."""
+        raise NotImplementedError
+
+    # convenience
+    def get_json(self, key: str):
+        v = self.get(key)
+        return None if v is None else json.loads(v)
+
+    def put_json(self, key: str, obj) -> None:
+        self.put(key, json.dumps(obj).encode())
+
+
+class MemoryKv(KvBackend):
+    def __init__(self):
+        self._data: dict[str, bytes] = {}
+        self._lock = threading.RLock()
+
+    def get(self, key):
+        with self._lock:
+            return self._data.get(key)
+
+    def put(self, key, value):
+        with self._lock:
+            self._data[key] = bytes(value)
+
+    def delete(self, key):
+        with self._lock:
+            return self._data.pop(key, None) is not None
+
+    def range(self, prefix):
+        with self._lock:
+            return sorted(
+                (k, v) for k, v in self._data.items()
+                if k.startswith(prefix)
+            )
+
+    def compare_and_put(self, key, expect, value):
+        with self._lock:
+            cur = self._data.get(key)
+            if cur != expect:
+                return False
+            self._data[key] = bytes(value)
+            return True
+
+
+class FsKv(KvBackend):
+    """Durable kv over one JSON file with atomic rename commits — the
+    standalone-mode analog of the reference's raft-engine kv backend
+    (src/log-store/src/raft_engine/backend.rs)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._mem = MemoryKv()
+        self._lock = threading.RLock()
+        if os.path.exists(path):
+            with open(path) as f:
+                for k, v in json.load(f).items():
+                    self._mem.put(k, bytes.fromhex(v))
+        else:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def _persist(self):
+        doc = {k: v.hex() for k, v in self._mem.range("")}
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def get(self, key):
+        return self._mem.get(key)
+
+    def put(self, key, value):
+        with self._lock:
+            self._mem.put(key, value)
+            self._persist()
+
+    def delete(self, key):
+        with self._lock:
+            out = self._mem.delete(key)
+            if out:
+                self._persist()
+            return out
+
+    def range(self, prefix):
+        return self._mem.range(prefix)
+
+    def compare_and_put(self, key, expect, value):
+        with self._lock:
+            ok = self._mem.compare_and_put(key, expect, value)
+            if ok:
+                self._persist()
+            return ok

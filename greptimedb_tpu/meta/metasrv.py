@@ -1,0 +1,287 @@
+"""Metasrv: cluster brain — node registry, region routes, leases,
+heartbeat mailbox, failure detection and failover.
+
+Capability counterpart of /root/reference/src/meta-srv/src/: the heartbeat
+handler pipeline (handler/*.rs), region-lease grants
+(region_lease_handler.rs), placement selectors (selector/), the
+RegionSupervisor consulting per-(node,region) phi-accrual detectors and
+triggering region migration (region/supervisor.rs:123-392), and the region
+migration procedure state machine (procedure/region_migration/*:
+open_candidate -> downgrade_leader -> upgrade_candidate ->
+update_metadata).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+from greptimedb_tpu.errors import IllegalStateError
+from greptimedb_tpu.meta.failure_detector import PhiAccrualFailureDetector
+from greptimedb_tpu.meta.kv import KvBackend
+from greptimedb_tpu.meta.procedure import Procedure, ProcedureManager, Status
+
+ROUTE_PREFIX = "__route/"
+LEASE_SECS = 10.0
+
+
+@dataclass
+class NodeInfo:
+    node_id: int
+    last_heartbeat_ms: float = 0.0
+    region_stats: dict = field(default_factory=dict)  # region_id -> stats
+    alive: bool = True
+
+    @property
+    def load(self) -> int:
+        return sum(
+            s.get("rows", 0) for s in self.region_stats.values()
+        )
+
+
+class Selector:
+    """Region placement policy (selector/{round_robin,load_based}.rs)."""
+
+    def __init__(self, kind: str = "round_robin"):
+        self.kind = kind
+        self._rr = 0
+
+    def select(self, nodes: list[NodeInfo], n: int) -> list[int]:
+        alive = [nd for nd in nodes if nd.alive]
+        if not alive:
+            raise IllegalStateError("no alive datanodes")
+        out = []
+        if self.kind == "load_based":
+            ranked = sorted(alive, key=lambda nd: nd.load)
+            for i in range(n):
+                out.append(ranked[i % len(ranked)].node_id)
+            return out
+        for _ in range(n):
+            out.append(alive[self._rr % len(alive)].node_id)
+            self._rr += 1
+        return out
+
+
+class Metasrv:
+    def __init__(self, kv: KvBackend, *, selector: str = "round_robin",
+                 phi_threshold: float = 8.0):
+        self.kv = kv
+        self.selector = Selector(selector)
+        self.nodes: dict[int, NodeInfo] = {}
+        self.detectors: dict[int, PhiAccrualFailureDetector] = {}
+        self.procedures = ProcedureManager(kv)
+        self.maintenance_mode = False
+        self.phi_threshold = phi_threshold
+        self._mailbox: dict[int, list[dict]] = {}
+        self._lock = threading.RLock()
+        self._failover_cb = None  # set by the cluster: (region, from, to)
+        self._load_routes()
+
+    # ------------------------------------------------------------------
+    # node lifecycle + heartbeats
+    # ------------------------------------------------------------------
+    def register_node(self, node_id: int):
+        with self._lock:
+            self.nodes[node_id] = NodeInfo(node_id)
+            self.detectors[node_id] = PhiAccrualFailureDetector(
+                threshold=self.phi_threshold
+            )
+            self._mailbox.setdefault(node_id, [])
+
+    def heartbeat(self, node_id: int, region_stats: dict,
+                  now_ms: float | None = None) -> list[dict]:
+        """Handler pipeline: keep lease, collect stats, feed detector,
+        drain mailbox instructions (returned in the heartbeat response as
+        in the reference's mailbox design)."""
+        now_ms = now_ms if now_ms is not None else time.time() * 1000
+        with self._lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                self.register_node(node_id)
+                node = self.nodes[node_id]
+            node.last_heartbeat_ms = now_ms
+            node.region_stats = region_stats
+            node.alive = True
+            self.detectors[node_id].heartbeat(now_ms)
+            instructions = self._mailbox.get(node_id, [])
+            self._mailbox[node_id] = []
+            # region lease grant: every region this node leads
+            leases = [
+                rid for rid, nid in self._all_routes().items()
+                if nid == node_id
+            ]
+            return instructions + [{
+                "type": "grant_lease",
+                "regions": leases,
+                "lease_secs": LEASE_SECS,
+            }]
+
+    def send_instruction(self, node_id: int, instruction: dict):
+        with self._lock:
+            self._mailbox.setdefault(node_id, []).append(instruction)
+
+    # ------------------------------------------------------------------
+    # routes
+    # ------------------------------------------------------------------
+    def allocate_regions(self, region_ids: list[int]) -> dict[int, int]:
+        """Place new regions on nodes via the selector; persist routes."""
+        with self._lock:
+            chosen = self.selector.select(
+                list(self.nodes.values()), len(region_ids)
+            )
+            routes = {}
+            for rid, nid in zip(region_ids, chosen):
+                self.kv.put_json(ROUTE_PREFIX + str(rid), nid)
+                routes[rid] = nid
+            return routes
+
+    def route_of(self, region_id: int) -> int | None:
+        v = self.kv.get_json(ROUTE_PREFIX + str(region_id))
+        return v
+
+    def update_route(self, region_id: int, node_id: int):
+        self.kv.put_json(ROUTE_PREFIX + str(region_id), node_id)
+
+    def remove_routes(self, region_ids: list[int]):
+        for rid in region_ids:
+            self.kv.delete(ROUTE_PREFIX + str(rid))
+
+    def _all_routes(self) -> dict[int, int]:
+        return {
+            int(k[len(ROUTE_PREFIX):]): json.loads(v)
+            for k, v in self.kv.range(ROUTE_PREFIX)
+        }
+
+    def _load_routes(self):
+        pass  # routes live in kv; nothing to warm
+
+    # ------------------------------------------------------------------
+    # supervision / failover
+    # ------------------------------------------------------------------
+    def tick(self, now_ms: float | None = None) -> list[str]:
+        """RegionSupervisor tick: check detectors, fail over regions led
+        by suspect nodes. Returns submitted procedure ids."""
+        if self.maintenance_mode:
+            return []
+        now_ms = now_ms if now_ms is not None else time.time() * 1000
+        suspects = []
+        with self._lock:
+            for nid, det in self.detectors.items():
+                node = self.nodes[nid]
+                if node.alive and not det.is_available(now_ms):
+                    node.alive = False
+                    suspects.append(nid)
+        out = []
+        for nid in suspects:
+            out.extend(self.failover_node(nid))
+        return out
+
+    def failover_node(self, node_id: int) -> list[str]:
+        routes = self._all_routes()
+        owned = [rid for rid, nid in routes.items() if nid == node_id]
+        proc_ids = []
+        for rid in owned:
+            try:
+                target = self.selector.select(
+                    [nd for nd in self.nodes.values()
+                     if nd.node_id != node_id],
+                    1,
+                )[0]
+            except IllegalStateError:
+                continue
+            proc = RegionMigrationProcedure(
+                region_id=rid, from_node=node_id, to_node=target,
+                reason="failover",
+            )
+            proc_ids.append(self.procedures.submit(proc, self._ctx()))
+        return proc_ids
+
+    def migrate_region(self, region_id: int, to_node: int,
+                       timeout: float = 30.0):
+        """Manual migration (admin function migrate_region analog)."""
+        from_node = self.route_of(region_id)
+        if from_node is None:
+            raise IllegalStateError(f"region {region_id} has no route")
+        proc = RegionMigrationProcedure(
+            region_id=region_id, from_node=from_node, to_node=to_node,
+            reason="manual",
+        )
+        meta = self.procedures.submit_and_wait(
+            proc, self._ctx(), timeout=timeout
+        )
+        if meta.state != "done":
+            raise IllegalStateError(
+                f"migration failed: {meta.state} {meta.error}"
+            )
+
+    def _ctx(self):
+        return self
+
+
+class RegionMigrationProcedure(Procedure):
+    """open_candidate -> downgrade_leader -> upgrade_candidate ->
+    update_metadata (procedure/region_migration/*.rs state machine)."""
+
+    type_name = "RegionMigration"
+
+    STATES = ("open_candidate", "downgrade_leader", "upgrade_candidate",
+              "update_metadata", "done")
+
+    def __init__(self, *, region_id: int, from_node: int, to_node: int,
+                 reason: str = "manual", state: str = "open_candidate"):
+        self.region_id = region_id
+        self.from_node = from_node
+        self.to_node = to_node
+        self.reason = reason
+        self.state = state
+
+    def dump(self) -> dict:
+        return {
+            "region_id": self.region_id, "from_node": self.from_node,
+            "to_node": self.to_node, "reason": self.reason,
+            "state": self.state,
+        }
+
+    @classmethod
+    def restore(cls, data: dict) -> "RegionMigrationProcedure":
+        return cls(**data)
+
+    def execute(self, metasrv: Metasrv) -> Status:
+        cluster = getattr(metasrv, "cluster", None)
+        if cluster is None:
+            raise IllegalStateError("metasrv has no cluster attached")
+        if self.state == "open_candidate":
+            cluster.open_region_on(self.to_node, self.region_id,
+                                   writable=False)
+            self.state = "downgrade_leader"
+            return Status.executing()
+        if self.state == "downgrade_leader":
+            # graceful: flush the leader so the candidate sees all data;
+            # on failover the old node is dead and this is a no-op
+            cluster.downgrade_region_on(self.from_node, self.region_id)
+            self.state = "upgrade_candidate"
+            return Status.executing()
+        if self.state == "upgrade_candidate":
+            cluster.upgrade_region_on(self.to_node, self.region_id)
+            self.state = "update_metadata"
+            return Status.executing()
+        if self.state == "update_metadata":
+            metasrv.update_route(self.region_id, self.to_node)
+            cluster.close_region_on(self.from_node, self.region_id)
+            self.state = "done"
+            return Status.done({
+                "region_id": self.region_id, "to_node": self.to_node,
+            })
+        raise IllegalStateError(f"bad state {self.state}")
+
+    def rollback(self, metasrv: Metasrv) -> None:
+        cluster = getattr(metasrv, "cluster", None)
+        if cluster is None:
+            return
+        # abort: drop the half-opened candidate, keep the original route
+        try:
+            cluster.close_region_on(self.to_node, self.region_id)
+        except Exception:
+            pass

@@ -1,0 +1,191 @@
+"""Persistent procedure (saga) framework.
+
+Capability counterpart of /root/reference/src/common/procedure/src/
+procedure.rs:33-110 + local runner: multi-step operations (DDL, region
+migration) run as state machines whose state is dumped to the kv store
+after every persisting step, so a crashed node resumes or rolls back on
+restart. Status mirrors the reference's Executing{persist}/Suspended/Done.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+import uuid
+from dataclasses import dataclass
+
+from greptimedb_tpu.errors import IllegalStateError
+from greptimedb_tpu.meta.kv import KvBackend
+
+PROC_PREFIX = "__procedure/"
+
+
+@dataclass
+class Status:
+    kind: str                  # executing | suspended | done | poisoned
+    persist: bool = False
+    output: object = None
+
+    @staticmethod
+    def executing(*, persist: bool = True) -> "Status":
+        return Status("executing", persist=persist)
+
+    @staticmethod
+    def done(output=None) -> "Status":
+        return Status("done", output=output)
+
+    @staticmethod
+    def suspended() -> "Status":
+        return Status("suspended", persist=True)
+
+
+class Procedure:
+    """Subclass with: type_name (class attr), execute(ctx) -> Status,
+    dump() -> dict, and classmethod restore(data: dict). Optional
+    rollback(ctx)."""
+
+    type_name: str = ""
+
+    def execute(self, ctx) -> Status:
+        raise NotImplementedError
+
+    def dump(self) -> dict:
+        raise NotImplementedError
+
+    def rollback(self, ctx) -> None:
+        pass
+
+    @classmethod
+    def restore(cls, data: dict) -> "Procedure":
+        raise NotImplementedError
+
+
+@dataclass
+class ProcedureMeta:
+    proc_id: str
+    type_name: str
+    state: str                 # running | done | failed | rolled_back
+    error: str | None = None
+    output: object = None
+
+
+class ProcedureManager:
+    """Runs procedures on worker threads with retry/backoff, persisting
+    state between steps (LocalManager analog,
+    /root/reference/src/common/procedure/src/local/)."""
+
+    def __init__(self, kv: KvBackend, *, max_retries: int = 3,
+                 retry_delay_s: float = 0.05):
+        self.kv = kv
+        self.max_retries = max_retries
+        self.retry_delay_s = retry_delay_s
+        self._loaders: dict[str, type[Procedure]] = {}
+        self._metas: dict[str, ProcedureMeta] = {}
+        self._events: dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+
+    def register_loader(self, type_name: str, cls: type[Procedure]):
+        self._loaders[type_name] = cls
+
+    # ------------------------------------------------------------------
+    def submit(self, procedure: Procedure, ctx=None) -> str:
+        proc_id = uuid.uuid4().hex
+        meta = ProcedureMeta(proc_id, procedure.type_name, "running")
+        ev = threading.Event()
+        with self._lock:
+            self._metas[proc_id] = meta
+            self._events[proc_id] = ev
+        self._persist_state(proc_id, procedure, "running")
+        t = threading.Thread(
+            target=self._run, args=(proc_id, procedure, ctx, ev),
+            daemon=True, name=f"procedure-{procedure.type_name}",
+        )
+        t.start()
+        return proc_id
+
+    def wait(self, proc_id: str, timeout: float = 30.0) -> ProcedureMeta:
+        ev = self._events.get(proc_id)
+        if ev is None or not ev.wait(timeout):
+            raise IllegalStateError(f"procedure {proc_id} did not finish")
+        return self._metas[proc_id]
+
+    def submit_and_wait(self, procedure: Procedure, ctx=None,
+                        timeout: float = 30.0) -> ProcedureMeta:
+        return self.wait(self.submit(procedure, ctx), timeout)
+
+    # ------------------------------------------------------------------
+    def _run(self, proc_id: str, procedure: Procedure, ctx,
+             ev: threading.Event):
+        meta = self._metas[proc_id]
+        retries = 0
+        try:
+            while True:
+                try:
+                    status = procedure.execute(ctx)
+                except Exception as e:
+                    retries += 1
+                    if retries > self.max_retries:
+                        meta.state = "failed"
+                        meta.error = f"{e}\n{traceback.format_exc()}"
+                        try:
+                            procedure.rollback(ctx)
+                            meta.state = "rolled_back"
+                        except Exception:
+                            traceback.print_exc()
+                        self._finish(proc_id)
+                        return
+                    time.sleep(self.retry_delay_s * (2 ** (retries - 1)))
+                    continue
+                retries = 0
+                if status.kind == "done":
+                    meta.state = "done"
+                    meta.output = status.output
+                    self._finish(proc_id)
+                    return
+                if status.persist:
+                    self._persist_state(proc_id, procedure, "running")
+                if status.kind == "suspended":
+                    time.sleep(self.retry_delay_s)
+        finally:
+            ev.set()
+
+    def _persist_state(self, proc_id: str, procedure: Procedure,
+                       state: str):
+        self.kv.put_json(PROC_PREFIX + proc_id, {
+            "type_name": procedure.type_name,
+            "state": state,
+            "data": procedure.dump(),
+        })
+
+    def _finish(self, proc_id: str):
+        self.kv.delete(PROC_PREFIX + proc_id)
+
+    # ------------------------------------------------------------------
+    def recover(self, ctx=None) -> list[str]:
+        """Resume procedures left 'running' by a crash (the crash-resume
+        path of the reference's procedure store)."""
+        resumed = []
+        for key, raw in self.kv.range(PROC_PREFIX):
+            doc = json.loads(raw)
+            cls = self._loaders.get(doc["type_name"])
+            if cls is None:
+                continue
+            proc = cls.restore(doc["data"])
+            proc_id = key[len(PROC_PREFIX):]
+            meta = ProcedureMeta(proc_id, proc.type_name, "running")
+            ev = threading.Event()
+            with self._lock:
+                self._metas[proc_id] = meta
+                self._events[proc_id] = ev
+            threading.Thread(
+                target=self._run, args=(proc_id, proc, ctx, ev),
+                daemon=True,
+            ).start()
+            resumed.append(proc_id)
+        return resumed
+
+    def list_procedures(self) -> list[ProcedureMeta]:
+        with self._lock:
+            return list(self._metas.values())

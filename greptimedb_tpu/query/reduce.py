@@ -27,6 +27,15 @@ def _pad_group_count(g: int) -> int:
     return b
 
 
+def dev_block_ids(n: int, blocks: int):
+    """(n,) int32 device array mapping row index -> block in [0, blocks).
+    Device iota — nothing cached, nothing shipped from host."""
+    import jax.numpy as jnp
+
+    per = -(-n // blocks)
+    return jnp.arange(n, dtype=jnp.int32) // jnp.int32(per)
+
+
 # ----------------------------------------------------------------------
 # host path
 # ----------------------------------------------------------------------
@@ -161,14 +170,22 @@ def _device_reduce_many(specs, values: dict, gid, valid, g: int, ts):
         cnt_np = np.asarray(cnt_cache)[:g].astype(np.float64)
         present = cnt_np > 0
         if op in ("sum", "mean"):
-            # TPU accumulates in f32 (x64 stays off). Shifted accumulation:
-            # subtract a per-segment mean estimate, sum the residuals in
-            # f32, recombine in f64 on host — error drops from O(n·eps) to
-            # O(sqrt(n)·eps·std).
-            mean32, _ = seg.seg_mean(v, d_gid, d_mask, gb)
-            resid = seg.seg_sum(v - mean32[d_gid], d_gid, d_mask, gb)
-            s = (np.asarray(resid)[:g].astype(np.float64)
-                 + np.asarray(mean32)[:g].astype(np.float64) * cnt_np)
+            # TPU accumulates in f32 (x64 stays off). Blocked hierarchical
+            # sum: f32 partials over (group x block) sub-segments, combined
+            # in f64 on host — accumulation error shrinks by the block
+            # factor (f32 scatter-add error is linear in partial
+            # magnitude).
+            # spend a ~1M-segment budget on blocks: smaller per-partial
+            # element counts keep f32 rounding error negligible even for
+            # contiguous (sorted-by-group) row layouts
+            blocks = max(1, min(nb, (1 << 20) // gb))
+            d_block = dev_block_ids(nb, blocks)
+            seg2 = d_gid * jnp.int32(blocks) + d_block
+            partials = seg.seg_sum(v, seg2, d_mask, gb * blocks)
+            s = (
+                np.asarray(partials).astype(np.float64)
+                .reshape(gb, blocks)[:g].sum(axis=1)
+            )
             if op == "sum":
                 out[name] = (s, present)
             else:

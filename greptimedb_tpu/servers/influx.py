@@ -272,7 +272,7 @@ def ensure_table(instance, db: str, name: str, tag_keys: list[str],
         if existing is None:
             instance.catalog.alter_add_column(db, name, ColumnSchema(
                 k, ConcreteDataType.string(), SemanticType.TAG,
-            ))
+            ), if_not_exists=True)
         elif not existing.is_tag:
             raise LineProtocolError(
                 f"{name}.{k} is a {existing.semantic_type.name} column, "
@@ -283,7 +283,7 @@ def ensure_table(instance, db: str, name: str, tag_keys: list[str],
         if existing is None:
             instance.catalog.alter_add_column(db, name, ColumnSchema(
                 k, t, SemanticType.FIELD,
-            ))
+            ), if_not_exists=True)
         elif not existing.is_field:
             raise LineProtocolError(
                 f"{name}.{k} is a {existing.semantic_type.name} column, "

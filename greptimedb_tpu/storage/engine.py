@@ -26,6 +26,11 @@ class EngineConfig:
     global_write_buffer_bytes: int = 1024 * 1024 * 1024
     enable_background: bool = True
     background_interval_s: float = 5.0
+    # WAL location override. Default: <data_root>/wal (node-local, like the
+    # raft-engine WAL). Point it at shared storage for the remote-WAL
+    # deployment shape (the reference's Kafka WAL,
+    # src/log-store/src/kafka/), which makes region failover lossless.
+    wal_root: str | None = None
 
 
 class TsdbEngine:
@@ -62,9 +67,10 @@ class TsdbEngine:
             return region
 
     def _open(self, meta: RegionMetadata) -> Region:
-        wal_dir = os.path.join(
-            self.config.data_root, "wal", f"region_{meta.region_id}"
+        wal_root = self.config.wal_root or os.path.join(
+            self.config.data_root, "wal"
         )
+        wal_dir = os.path.join(wal_root, f"region_{meta.region_id}")
         return Region(meta, self.store, wal_dir)
 
     def close_region(self, region_id: int):

@@ -1,0 +1,245 @@
+"""Cluster control-plane tests: kv, procedures, phi-accrual detection,
+region placement, migration, failover (the role of
+/root/reference/tests-integration/src/cluster.rs +
+tests/region_migration.rs)."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.cluster import Cluster
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema, SemanticType
+from greptimedb_tpu.datatypes.types import ConcreteDataType
+from greptimedb_tpu.meta.failure_detector import PhiAccrualFailureDetector
+from greptimedb_tpu.meta.kv import FsKv, MemoryKv
+from greptimedb_tpu.meta.procedure import Procedure, ProcedureManager, Status
+from greptimedb_tpu.query.executor import QueryEngine
+from greptimedb_tpu.query.planner import plan_select
+from greptimedb_tpu.sql.parser import parse_sql
+
+
+def _schema():
+    return Schema([
+        ColumnSchema("host", ConcreteDataType.string(), SemanticType.TAG,
+                     nullable=False),
+        ColumnSchema("v", ConcreteDataType.float64(), SemanticType.FIELD),
+        ColumnSchema("ts", ConcreteDataType.timestamp_millisecond(),
+                     SemanticType.TIMESTAMP, nullable=False),
+    ])
+
+
+def _write_rows(table, n=100, hosts=8):
+    tags = {"host": np.asarray([f"h{i % hosts}" for i in range(n)], object)}
+    ts = (1_700_000_000_000 + np.arange(n) * 1000).astype(np.int64)
+    table.write(tags, ts, {"v": np.arange(n, dtype=np.float64)})
+
+
+def _count_sum(table):
+    stmt = parse_sql("SELECT count(*), sum(v) FROM t")[0]
+    plan = plan_select(stmt, ts_name="ts", tag_names=["host"],
+                       all_columns=["host", "v", "ts"])
+    res = QueryEngine().execute(plan, table)
+    return res.rows()[0]
+
+
+# ----------------------------------------------------------------------
+# kv + procedures
+# ----------------------------------------------------------------------
+
+def test_fskv_durability(tmp_path):
+    path = str(tmp_path / "kv.json")
+    kv = FsKv(path)
+    kv.put("a", b"1")
+    kv.put_json("b", {"x": 2})
+    assert kv.compare_and_put("a", b"1", b"2")
+    assert not kv.compare_and_put("a", b"1", b"3")
+    kv2 = FsKv(path)
+    assert kv2.get("a") == b"2"
+    assert kv2.get_json("b") == {"x": 2}
+    assert [k for k, _ in kv2.range("")] == ["a", "b"]
+
+
+class _StepProc(Procedure):
+    type_name = "Step"
+
+    def __init__(self, steps=3, done=0, fail_at=None):
+        self.steps = steps
+        self.done_steps = done
+        self.fail_at = fail_at
+        self.rolled_back = False
+
+    def execute(self, ctx) -> Status:
+        if self.fail_at is not None and self.done_steps == self.fail_at:
+            raise RuntimeError("injected failure")
+        self.done_steps += 1
+        if self.done_steps >= self.steps:
+            return Status.done(self.done_steps)
+        return Status.executing()
+
+    def dump(self):
+        return {"steps": self.steps, "done": self.done_steps}
+
+    def rollback(self, ctx):
+        self.rolled_back = True
+
+    @classmethod
+    def restore(cls, data):
+        return cls(steps=data["steps"], done=data["done"])
+
+
+def test_procedure_success_and_failure():
+    kv = MemoryKv()
+    mgr = ProcedureManager(kv, max_retries=1, retry_delay_s=0.01)
+    meta = mgr.submit_and_wait(_StepProc(3))
+    assert meta.state == "done" and meta.output == 3
+    assert kv.range("__procedure/") == []  # cleaned up
+
+    proc = _StepProc(3, fail_at=1)
+    meta = mgr.submit_and_wait(proc)
+    assert meta.state == "rolled_back"
+    assert proc.rolled_back
+
+
+def test_procedure_crash_recovery():
+    kv = MemoryKv()
+    mgr = ProcedureManager(kv)
+    mgr.register_loader("Step", _StepProc)
+    # simulate a crash mid-procedure: persist state manually
+    kv.put_json("__procedure/abc", {
+        "type_name": "Step", "state": "running",
+        "data": {"steps": 3, "done": 1},
+    })
+    resumed = mgr.recover()
+    assert resumed == ["abc"]
+    meta = mgr.wait("abc")
+    assert meta.state == "done" and meta.output == 3
+
+
+# ----------------------------------------------------------------------
+# phi-accrual detector
+# ----------------------------------------------------------------------
+
+def test_phi_detector_basics():
+    det = PhiAccrualFailureDetector(acceptable_heartbeat_pause_ms=0.0)
+    t = 0.0
+    for _ in range(20):
+        det.heartbeat(t)
+        t += 1000.0
+    # at the expected next-arrival time: healthy (phi ~ 0.3)
+    assert det.phi(t) < 1.0
+    assert det.is_available(t)
+    # long silence: suspect (zero-variance intervals floor sigma at 100ms,
+    # so even 2s of silence is far outside the model)
+    assert det.phi(t + 60_000) > det.threshold
+    assert not det.is_available(t + 60_000)
+
+
+# ----------------------------------------------------------------------
+# cluster
+# ----------------------------------------------------------------------
+
+def test_cluster_create_write_query(tmp_path):
+    c = Cluster(str(tmp_path / "c"), n_datanodes=3)
+    table = c.create_table("public", "t", _schema(), num_regions=3)
+    dist = c.region_distribution()
+    assert sum(len(v) for v in dist.values()) == 3
+    # regions spread across nodes (round robin over 3 nodes)
+    assert all(len(v) == 1 for v in dist.values())
+    _write_rows(table, 100)
+    cnt, s = _count_sum(c.table("public", "t"))
+    assert cnt == 100 and s == float(sum(range(100)))
+    c.shutdown()
+
+
+def test_cluster_restart_recovers(tmp_path):
+    root = str(tmp_path / "c")
+    c = Cluster(root, n_datanodes=2)
+    table = c.create_table("public", "t", _schema(), num_regions=2)
+    _write_rows(table, 50)
+    c.shutdown()
+
+    c2 = Cluster(root, n_datanodes=2)
+    cnt, s = _count_sum(c2.table("public", "t"))
+    assert cnt == 50 and s == float(sum(range(50)))
+    c2.shutdown()
+
+
+def test_manual_region_migration(tmp_path):
+    c = Cluster(str(tmp_path / "c"), n_datanodes=2)
+    table = c.create_table("public", "t", _schema(), num_regions=1)
+    _write_rows(table, 40)
+    rid = table.info.region_ids()[0]
+    src = c.metasrv.route_of(rid)
+    dst = 1 - src
+    c.metasrv.migrate_region(rid, dst)
+    assert c.metasrv.route_of(rid) == dst
+    # data fully readable from the new node (flushed by downgrade)
+    cnt, s = _count_sum(c.table("public", "t"))
+    assert cnt == 40 and s == float(sum(range(40)))
+    # old node no longer hosts it
+    assert not c.datanodes[src].has_region(rid)
+    c.shutdown()
+
+
+def test_failover_after_crash(tmp_path):
+    c = Cluster(str(tmp_path / "c"), n_datanodes=3,
+                phi_threshold=3.0)
+    table = c.create_table("public", "t", _schema(), num_regions=3)
+    _write_rows(table, 90)
+    # flush so the shared store has the data (local-WAL deployment)
+    for r in table.regions:
+        r.flush()
+
+    t0 = 1_000_000.0
+    for i in range(10):
+        c.heartbeat_all(t0 + i * 1000)
+    victim = c.metasrv.route_of(table.info.region_ids()[0])
+    c.datanodes[victim].crash()
+    # victim misses heartbeats; others stay healthy right up to the tick
+    for i in range(10, 22):
+        c.heartbeat_all(t0 + i * 1000)
+    procs = c.supervise(t0 + 22_000)
+    assert procs, "failover should trigger"
+    for pid in procs:
+        meta = c.metasrv.procedures.wait(pid)
+        assert meta.state == "done"
+    # all routes now avoid the dead node
+    for rid in table.info.region_ids():
+        assert c.metasrv.route_of(rid) != victim
+    cnt, s = _count_sum(c.table("public", "t"))
+    assert cnt == 90 and s == float(sum(range(90)))
+    c.shutdown()
+
+
+def test_failover_with_shared_wal_keeps_unflushed(tmp_path):
+    c = Cluster(str(tmp_path / "c"), n_datanodes=2,
+                phi_threshold=3.0, shared_wal=True)
+    table = c.create_table("public", "t", _schema(), num_regions=1)
+    _write_rows(table, 25)  # NOT flushed: lives in WAL + memtable only
+
+    t0 = 1_000_000.0
+    for i in range(10):
+        c.heartbeat_all(t0 + i * 1000)
+    rid = table.info.region_ids()[0]
+    victim = c.metasrv.route_of(rid)
+    c.datanodes[victim].crash()
+    for i in range(10, 22):
+        c.heartbeat_all(t0 + i * 1000)
+    procs = c.supervise(t0 + 22_000)
+    for pid in procs:
+        assert c.metasrv.procedures.wait(pid).state == "done"
+    # shared WAL replays the victim's unflushed rows on the survivor
+    cnt, s = _count_sum(c.table("public", "t"))
+    assert cnt == 25 and s == float(sum(range(25)))
+    c.shutdown()
+
+
+def test_load_based_selector(tmp_path):
+    c = Cluster(str(tmp_path / "c"), n_datanodes=2, selector="load_based")
+    t1 = c.create_table("public", "a", _schema(), num_regions=2)
+    _write_rows(t1, 100)
+    c.heartbeat_all()
+    t2 = c.create_table("public", "b", _schema(), num_regions=2)
+    dist = c.region_distribution()
+    # both nodes host two regions each (placement balanced)
+    assert sorted(len(v) for v in dist.values()) == [2, 2]
+    c.shutdown()

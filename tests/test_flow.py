@@ -181,3 +181,18 @@ def test_flow_tagless_global_aggregate(inst):
     inst.flows.flush_all()
     res = inst.sql("SELECT n, s FROM totals")
     assert res.rows() == [[2, 3.0]]
+
+
+def test_flow_non_windowed_upserts_not_appends(inst):
+    _setup_source(inst)
+    inst.sql(
+        "CREATE FLOW agg SINK TO sums AS "
+        "SELECT host, sum(latency) AS s FROM requests GROUP BY host"
+    )
+    inst.sql("INSERT INTO requests VALUES ('h1', '200', 5.0, 1700000000000)")
+    inst.flows.flush_all()
+    inst.sql("INSERT INTO requests VALUES ('h1', '200', 7.0, 1700000030000)")
+    inst.flows.flush_all()
+    res = inst.sql("SELECT host, s FROM sums")
+    # one row per group — each flush overwrites (upsert), never appends
+    assert res.rows() == [["h1", 12.0]]
