@@ -1,0 +1,106 @@
+"""Pluggable user authentication.
+
+Capability counterpart of /root/reference/src/auth/ (UserProvider trait,
+user_provider.rs:36, with static and watch-file providers): the HTTP server
+consults a provider for Basic-auth credentials when one is configured.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import threading
+
+from greptimedb_tpu.errors import GreptimeError
+
+
+class AccessDeniedError(GreptimeError):
+    pass
+
+
+class UserProvider:
+    def authenticate(self, username: str, password: str) -> bool:
+        raise NotImplementedError
+
+
+class StaticUserProvider(UserProvider):
+    """`user=pwd` pairs, the static_user_provider analog. Values may be
+    plain or `sha256:<hex>`."""
+
+    def __init__(self, users: dict[str, str]):
+        self._users = dict(users)
+
+    @staticmethod
+    def from_option(opt: str) -> "StaticUserProvider":
+        """'user1=pwd1,user2=pwd2'"""
+        users = {}
+        for pair in opt.split(","):
+            if not pair.strip():
+                continue
+            k, _, v = pair.partition("=")
+            users[k.strip()] = v.strip()
+        return StaticUserProvider(users)
+
+    def authenticate(self, username: str, password: str) -> bool:
+        want = self._users.get(username)
+        if want is None:
+            return False
+        if want.startswith("sha256:"):
+            return (
+                hashlib.sha256(password.encode()).hexdigest()
+                == want[len("sha256:"):]
+            )
+        return password == want
+
+
+class WatchFileUserProvider(UserProvider):
+    """Reloads `user=pwd` lines from a file when its mtime changes
+    (watch_file_user_provider analog)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._mtime = 0.0
+        self._inner = StaticUserProvider({})
+        self._lock = threading.Lock()
+        self._maybe_reload()
+
+    def _maybe_reload(self):
+        try:
+            mtime = os.path.getmtime(self.path)
+        except OSError:
+            return
+        with self._lock:
+            if mtime == self._mtime:
+                return
+            users = {}
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith("#"):
+                        k, _, v = line.partition("=")
+                        users[k.strip()] = v.strip()
+            self._inner = StaticUserProvider(users)
+            self._mtime = mtime
+
+    def authenticate(self, username: str, password: str) -> bool:
+        self._maybe_reload()
+        return self._inner.authenticate(username, password)
+
+
+def check_basic_auth(header: str | None, provider: UserProvider | None
+                     ) -> str | None:
+    """Returns the authenticated username (or None when no provider is
+    configured); raises AccessDeniedError on bad credentials."""
+    if provider is None:
+        return None
+    if not header or not header.startswith("Basic "):
+        raise AccessDeniedError("missing Authorization header")
+    try:
+        raw = base64.b64decode(header[len("Basic "):]).decode()
+        user, _, pwd = raw.partition(":")
+    except Exception:
+        raise AccessDeniedError("malformed Authorization header") from None
+    if not provider.authenticate(user, pwd):
+        raise AccessDeniedError(f"invalid credentials for {user!r}")
+    return user

@@ -146,8 +146,10 @@ class CatalogManager:
         self._next_table_id = doc.get("next_table_id", 1024)
         for db_name, tables in doc.get("databases", {}).items():
             db = self._databases.setdefault(db_name, {})
-            for tdoc in tables:
-                info = TableInfo.from_json(tdoc)
+            infos = [TableInfo.from_json(t) for t in tables]
+            # physical (mito) tables first: logical metric tables resolve
+            # their shared physical table during open
+            for info in sorted(infos, key=lambda i: i.engine == "metric"):
                 db[info.name] = self._open_table(info)
 
     def _persist(self):
@@ -161,6 +163,8 @@ class CatalogManager:
         self.store.write(CATALOG_PATH, json.dumps(doc).encode())
 
     def _open_table(self, info: TableInfo) -> Table:
+        if info.engine == "metric":
+            return self._open_metric_table(info)
         regions = []
         opts = region_options_from_table(info.options)
         for rid in info.region_ids():
@@ -244,6 +248,15 @@ class CatalogManager:
             db[name] = table
             self._persist()
             return table
+
+    def _open_metric_table(self, info: TableInfo):
+        """Logical metric-engine table: a view over the shared physical
+        table (see metric_engine.py)."""
+        from greptimedb_tpu import metric_engine as ME
+
+        physical = ME.ensure_physical_table(self, info.database)
+        ME.widen_physical_for(self, info.database, physical, info.schema)
+        return ME.LogicalTable(info, physical)
 
     def drop_table(self, database: str, name: str, *, if_exists: bool = False):
         with self._lock:

@@ -212,6 +212,10 @@ class ConcreteDataType:
             return ConcreteDataType.string()
         if pa.types.is_binary(dt) or pa.types.is_large_binary(dt):
             return ConcreteDataType.binary()
+        if pa.types.is_float64(dt):
+            return ConcreteDataType.float64()
+        if pa.types.is_float32(dt):
+            return ConcreteDataType.float32()
         try:
             return ConcreteDataType(TypeId(str(dt)))
         except ValueError as e:

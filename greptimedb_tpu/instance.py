@@ -164,9 +164,63 @@ class Standalone:
             return self._drop_flow(stmt, ctx)
         if isinstance(stmt, A.ShowFlows):
             return Output.records(self._show_flows())
+        if isinstance(stmt, A.Copy):
+            return Output.rows(self._copy(stmt, ctx))
         raise UnsupportedError(
             f"statement not supported yet: {type(stmt).__name__}"
         )
+
+    # ------------------------------------------------------------------
+    # COPY TO/FROM (reference: src/operator/src/statement/copy_table_*.rs
+    # + src/common/datasource format readers/writers)
+    # ------------------------------------------------------------------
+    def _copy(self, stmt: A.Copy, ctx: QueryContext) -> int:
+        import pyarrow as pa
+
+        db, name = self._resolve(stmt.table, ctx)
+        table = self.catalog.table(db, name)
+        fmt = stmt.format
+        if stmt.direction == "to":
+            res = self._select(A.Select(
+                items=[A.SelectItem(A.Star())], from_table=stmt.table,
+            ), ctx)
+            arrays = {}
+            for i, n in enumerate(res.names):
+                col = res.cols[i]
+                cs = table.schema.maybe_column(n)
+                mask = None if col.validity is None else ~col.validity
+                if cs is not None and cs.data_type.is_timestamp():
+                    arrays[n] = pa.array(
+                        col.values.astype("datetime64[ms]"), mask=mask
+                    )
+                else:
+                    arrays[n] = pa.array(col.values, mask=mask)
+            pa_table = pa.table(arrays)
+            return _write_format(pa_table, stmt.path, fmt)
+        # COPY FROM
+        pa_table = _read_format(stmt.path, fmt)
+        data = {}
+        valid = {}
+        from greptimedb_tpu.datatypes.batch import HostColumn
+
+        for n in pa_table.column_names:
+            if n not in table.schema:
+                continue
+            hc = HostColumn.from_arrow(n, pa_table.column(n))
+            vals = hc.values
+            if hc.data_type.is_timestamp():
+                # normalize to ms regardless of the file's inferred unit;
+                # divide first (ns ticks * 1000 would overflow int64)
+                tps = hc.data_type.ticks_per_second
+                if tps >= 1000:
+                    vals = vals // (tps // 1000)
+                else:
+                    vals = vals * (1000 // tps)
+            data[n] = vals
+            valid[n] = hc.valid_mask
+        written = self._write_columns(table, data, valid)
+        self._notify_flows(db, name, table, data, valid)
+        return written
 
     # ------------------------------------------------------------------
     # DDL
@@ -543,6 +597,45 @@ def _sql_type_name(dt: ConcreteDataType) -> str:
         "date": "DATE", "json": "JSON",
     }
     return names.get(dt.name, dt.name.upper())
+
+
+def _write_format(pa_table, path: str, fmt: str) -> int:
+    import pyarrow as pa
+
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(pa_table, path)
+    elif fmt == "csv":
+        import pyarrow.csv as pacsv
+
+        pacsv.write_csv(pa_table, path)
+    elif fmt == "json":
+        import json as _json
+
+        rows = pa_table.to_pylist()
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(_json.dumps(r, default=str) + "\n")
+    else:
+        raise UnsupportedError(f"COPY format {fmt}")
+    return pa_table.num_rows
+
+
+def _read_format(path: str, fmt: str):
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path)
+    if fmt == "csv":
+        import pyarrow.csv as pacsv
+
+        return pacsv.read_csv(path)
+    if fmt == "json":
+        import pyarrow.json as pajson
+
+        return pajson.read_json(path)
+    raise UnsupportedError(f"COPY format {fmt}")
 
 
 def _tql_time(e: A.Expr) -> int:

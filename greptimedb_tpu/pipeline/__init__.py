@@ -1,0 +1,4 @@
+from greptimedb_tpu.pipeline.manager import PipelineManager
+from greptimedb_tpu.pipeline.etl import Pipeline
+
+__all__ = ["PipelineManager", "Pipeline"]

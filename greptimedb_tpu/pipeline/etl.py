@@ -1,0 +1,424 @@
+"""Log ETL pipelines (YAML-defined).
+
+Capability counterpart of /root/reference/src/pipeline/src/etl.rs (+
+etl/processor/*.rs, etl/transform/): a YAML document declares an ordered
+processor chain (dissect/regex/date/gsub/csv/...) over ingested JSON log
+events, then a transform section types the resulting fields into table
+columns (tag/field/time index).
+
+Example:
+
+    processors:
+      - dissect:
+          fields: [message]
+          patterns: ['%{ip} - %{user} [%{ts}] "%{method} %{path}"']
+      - date:
+          fields: [ts]
+          formats: ['%d/%b/%Y:%H:%M:%S']
+    transform:
+      - fields: [ip, method, path]
+        type: string
+        index: tag
+      - fields: [user]
+        type: string
+      - fields: [ts]
+        type: time
+        index: timestamp
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import re
+import time
+import urllib.parse
+
+import yaml
+
+from greptimedb_tpu.errors import InvalidArgumentError
+
+
+class PipelineError(InvalidArgumentError):
+    pass
+
+
+# ----------------------------------------------------------------------
+# processors
+# ----------------------------------------------------------------------
+
+class Processor:
+    def process(self, event: dict) -> dict | None:
+        raise NotImplementedError
+
+
+def _fields_of(cfg) -> list[str]:
+    f = cfg.get("fields") or ([cfg["field"]] if "field" in cfg else [])
+    if isinstance(f, str):
+        f = [f]
+    return f
+
+
+class DissectProcessor(Processor):
+    """'%{key} %{key2}' pattern splitting (dissect.rs analog — simplified:
+    literal separators between %{...} captures)."""
+
+    def __init__(self, cfg: dict):
+        self.fields = _fields_of(cfg)
+        self.patterns = [
+            self._compile(p) for p in cfg.get("patterns", [])
+        ]
+        self.ignore_missing = cfg.get("ignore_missing", False)
+
+    @staticmethod
+    def _compile(pattern: str) -> tuple[re.Pattern, list[str]]:
+        keys = []
+        rx = []
+        pos = 0
+        for m in re.finditer(r"%\{([^}]*)\}", pattern):
+            rx.append(re.escape(pattern[pos:m.start()]))
+            key = m.group(1)
+            if key.startswith("?"):   # named skip
+                rx.append(r".*?")
+            elif key == "":
+                rx.append(r".*?")
+            else:
+                keys.append(key)
+                rx.append(f"(?P<{re.escape(key)}>.*?)")
+            pos = m.end()
+        rx.append(re.escape(pattern[pos:]))
+        return re.compile("^" + "".join(rx) + "$"), keys
+
+    def process(self, event: dict) -> dict:
+        for f in self.fields:
+            v = event.get(f)
+            if v is None:
+                if self.ignore_missing:
+                    continue
+                raise PipelineError(f"dissect: missing field {f!r}")
+            for rx, keys in self.patterns:
+                m = rx.match(str(v))
+                if m:
+                    event.update(m.groupdict())
+                    break
+        return event
+
+
+class RegexProcessor(Processor):
+    def __init__(self, cfg: dict):
+        self.fields = _fields_of(cfg)
+        self.patterns = [re.compile(p) for p in cfg.get("patterns", [])]
+        self.ignore_missing = cfg.get("ignore_missing", False)
+
+    def process(self, event: dict) -> dict:
+        for f in self.fields:
+            v = event.get(f)
+            if v is None:
+                continue
+            for rx in self.patterns:
+                m = rx.search(str(v))
+                if m:
+                    for k, val in m.groupdict().items():
+                        if val is not None:
+                            event[f"{f}_{k}"] = val
+                    break
+        return event
+
+
+class DateProcessor(Processor):
+    def __init__(self, cfg: dict):
+        self.fields = _fields_of(cfg)
+        self.formats = cfg.get("formats", [])
+        self.timezone = cfg.get("timezone", "UTC")
+        self.ignore_missing = cfg.get("ignore_missing", False)
+
+    def process(self, event: dict) -> dict:
+        for f in self.fields:
+            v = event.get(f)
+            if v is None:
+                if self.ignore_missing:
+                    continue
+                raise PipelineError(f"date: missing field {f!r}")
+            event[f] = self._parse(str(v))
+        return event
+
+    def _tzinfo(self):
+        if self.timezone in ("UTC", "utc", "", None):
+            return _dt.timezone.utc
+        try:
+            from zoneinfo import ZoneInfo
+
+            return ZoneInfo(self.timezone)
+        except Exception:
+            return _dt.timezone.utc
+
+    def _parse(self, s: str) -> int:
+        for fmt in self.formats:
+            try:
+                dt = _dt.datetime.strptime(s, fmt)
+            except ValueError:
+                continue
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=self._tzinfo())
+            return int(dt.timestamp() * 1000)
+        from greptimedb_tpu.query.expr import parse_ts_literal
+
+        return parse_ts_literal(s)
+
+
+class EpochProcessor(Processor):
+    def __init__(self, cfg: dict):
+        self.fields = _fields_of(cfg)
+        self.resolution = cfg.get("resolution", "ms")
+        self.ignore_missing = cfg.get("ignore_missing", False)
+
+    def process(self, event: dict) -> dict:
+        scale = {"s": 1000.0, "sec": 1000.0, "second": 1000.0,
+                 "ms": 1.0, "milli": 1.0, "millisecond": 1.0,
+                 "us": 1e-3, "micro": 1e-3, "microsecond": 1e-3,
+                 "ns": 1e-6, "nano": 1e-6, "nanosecond": 1e-6}[
+            self.resolution
+        ]
+        for f in self.fields:
+            v = event.get(f)
+            if v is None:
+                continue
+            event[f] = int(float(v) * scale)
+        return event
+
+
+class GsubProcessor(Processor):
+    def __init__(self, cfg: dict):
+        self.fields = _fields_of(cfg)
+        self.pattern = re.compile(cfg["pattern"])
+        self.replacement = cfg.get("replacement", "")
+
+    def process(self, event: dict) -> dict:
+        for f in self.fields:
+            v = event.get(f)
+            if v is not None:
+                event[f] = self.pattern.sub(self.replacement, str(v))
+        return event
+
+
+class LetterProcessor(Processor):
+    def __init__(self, cfg: dict):
+        self.fields = _fields_of(cfg)
+        self.method = cfg.get("method", "lower")
+
+    def process(self, event: dict) -> dict:
+        fn = {"lower": str.lower, "upper": str.upper,
+              "capital": str.capitalize}[self.method]
+        for f in self.fields:
+            v = event.get(f)
+            if v is not None:
+                event[f] = fn(str(v))
+        return event
+
+
+class CsvProcessor(Processor):
+    def __init__(self, cfg: dict):
+        self.fields = _fields_of(cfg)
+        self.separator = cfg.get("separator", ",")
+        self.target_fields = cfg.get("target_fields", [])
+        if isinstance(self.target_fields, str):
+            self.target_fields = [
+                t.strip() for t in self.target_fields.split(",")
+            ]
+
+    def process(self, event: dict) -> dict:
+        import csv as _csv
+        import io
+
+        for f in self.fields:
+            v = event.get(f)
+            if v is None:
+                continue
+            row = next(
+                _csv.reader(io.StringIO(str(v)),
+                            delimiter=self.separator),
+                [],
+            )
+            for name, val in zip(self.target_fields, row):
+                event[name] = val
+        return event
+
+
+class JoinProcessor(Processor):
+    def __init__(self, cfg: dict):
+        self.fields = _fields_of(cfg)
+        self.separator = cfg.get("separator", ",")
+
+    def process(self, event: dict) -> dict:
+        for f in self.fields:
+            v = event.get(f)
+            if isinstance(v, list):
+                event[f] = self.separator.join(str(x) for x in v)
+        return event
+
+
+class UrlEncodingProcessor(Processor):
+    def __init__(self, cfg: dict):
+        self.fields = _fields_of(cfg)
+        self.method = cfg.get("method", "decode")
+
+    def process(self, event: dict) -> dict:
+        for f in self.fields:
+            v = event.get(f)
+            if v is None:
+                continue
+            if self.method == "decode":
+                event[f] = urllib.parse.unquote(str(v))
+            else:
+                event[f] = urllib.parse.quote(str(v))
+        return event
+
+
+class JsonPathProcessor(Processor):
+    def __init__(self, cfg: dict):
+        self.fields = _fields_of(cfg)
+        self.json_path = cfg.get("json_path", "$")
+
+    def process(self, event: dict) -> dict:
+        for f in self.fields:
+            v = event.get(f)
+            if v is None:
+                continue
+            try:
+                doc = json.loads(v) if isinstance(v, str) else v
+            except json.JSONDecodeError:
+                continue
+            path = [p for p in self.json_path.lstrip("$.").split(".") if p]
+            for p in path:
+                if isinstance(doc, dict):
+                    doc = doc.get(p)
+            event[f] = doc
+        return event
+
+
+_PROCESSORS = {
+    "dissect": DissectProcessor,
+    "regex": RegexProcessor,
+    "date": DateProcessor,
+    "epoch": EpochProcessor,
+    "gsub": GsubProcessor,
+    "letter": LetterProcessor,
+    "csv": CsvProcessor,
+    "join": JoinProcessor,
+    "urlencoding": UrlEncodingProcessor,
+    "json_path": JsonPathProcessor,
+}
+
+
+# ----------------------------------------------------------------------
+# transforms (typing into columns)
+# ----------------------------------------------------------------------
+
+_TYPES = {
+    "string": "string", "int8": "int8", "int16": "int16", "int32": "int32",
+    "int64": "int64", "uint8": "uint8", "uint16": "uint16",
+    "uint32": "uint32", "uint64": "uint64", "float32": "float32",
+    "float64": "float64", "boolean": "bool", "bool": "bool",
+    "time": "timestamp_ms", "timestamp": "timestamp_ms",
+    "epoch": "timestamp_ms",
+}
+
+
+class TransformRule:
+    def __init__(self, cfg: dict):
+        self.fields = _fields_of(cfg)
+        self.type = _TYPES.get(str(cfg.get("type", "string")).lower(),
+                               "string")
+        self.index = cfg.get("index")          # tag | timestamp | fulltext
+        self.on_failure = cfg.get("on_failure", "ignore")
+
+    def convert(self, v):
+        if v is None:
+            return None
+        try:
+            if self.type == "string":
+                return str(v)
+            if self.type == "bool":
+                return bool(v)
+            if self.type.startswith("timestamp"):
+                return int(v)
+            if self.type.startswith(("int", "uint")):
+                return int(float(v))
+            return float(v)
+        except (TypeError, ValueError):
+            if self.on_failure == "ignore":
+                return None
+            raise PipelineError(
+                f"cannot convert {v!r} to {self.type}"
+            ) from None
+
+
+class Pipeline:
+    def __init__(self, source: str):
+        self.source = source
+        doc = yaml.safe_load(source) or {}
+        self.processors: list[Processor] = []
+        for item in doc.get("processors", []) or []:
+            (name, cfg), = item.items()
+            cls = _PROCESSORS.get(name)
+            if cls is None:
+                raise PipelineError(f"unknown processor: {name}")
+            self.processors.append(cls(cfg or {}))
+        self.transforms = [
+            TransformRule(t) for t in doc.get("transform", []) or []
+        ]
+
+    def run(self, events: list[dict]) -> list[dict]:
+        """Apply processors; returns transformed typed rows."""
+        out = []
+        for raw in events:
+            event = dict(raw)
+            for p in self.processors:
+                event = p.process(event)
+                if event is None:
+                    break
+            if event is None:
+                continue
+            if self.transforms:
+                row = {}
+                for t in self.transforms:
+                    for f in t.fields:
+                        row[f] = t.convert(event.get(f))
+                out.append(row)
+            else:
+                out.append(event)
+        return out
+
+    def column_specs(self) -> list[tuple[str, str, str | None]]:
+        """(name, type, index) per output column; empty if identity."""
+        specs = []
+        for t in self.transforms:
+            for f in t.fields:
+                specs.append((f, t.type, t.index))
+        return specs
+
+
+class IdentityPipeline(Pipeline):
+    """greptime_identity: JSON fields map 1:1 to columns, types inferred,
+    a greptime_timestamp column is added (event.rs identity semantics)."""
+
+    def __init__(self):
+        self.source = "greptime_identity"
+        self.processors = []
+        self.transforms = []
+
+    def run(self, events: list[dict]) -> list[dict]:
+        now = int(time.time() * 1000)
+        out = []
+        for i, raw in enumerate(events):
+            row = {}
+            for k, v in raw.items():
+                if isinstance(v, (dict, list)):
+                    row[k] = json.dumps(v)
+                else:
+                    row[k] = v
+            # distinct per-event timestamps: identical (series, ts) rows
+            # would collapse under last-write-wins dedup
+            row.setdefault("greptime_timestamp", now + i)
+            out.append(row)
+        return out

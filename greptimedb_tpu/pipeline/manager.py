@@ -1,0 +1,202 @@
+"""Pipeline storage + log ingest.
+
+Counterpart of /root/reference/src/pipeline/src/manager/: pipelines are
+versioned documents persisted via the object store, looked up by name at
+ingest time; ingested rows auto-create/widen the target log table (string
+columns default to FIELDs; `index: tag` makes TAGs; `index: timestamp`
+names the TIME INDEX).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema, SemanticType
+from greptimedb_tpu.datatypes.types import ConcreteDataType
+from greptimedb_tpu.errors import InvalidArgumentError
+from greptimedb_tpu.pipeline.etl import IdentityPipeline, Pipeline
+
+PIPELINES_PATH = "meta/pipelines.json"
+
+
+_get_lock = threading.Lock()
+
+
+class PipelineManager:
+    @classmethod
+    def get(cls, instance) -> "PipelineManager":
+        """One manager per instance, attached to it (no global registry —
+        the manager dies with the instance)."""
+        mgr = getattr(instance, "_pipeline_manager", None)
+        if mgr is None:
+            with _get_lock:
+                mgr = getattr(instance, "_pipeline_manager", None)
+                if mgr is None:
+                    mgr = cls(instance)
+                    instance._pipeline_manager = mgr
+        return mgr
+
+    def __init__(self, instance):
+        self.instance = instance
+        self._pipelines: dict[str, Pipeline] = {}
+        self._lock = threading.RLock()
+        self._load()
+
+    # ------------------------------------------------------------------
+    def _load(self):
+        store = self.instance.engine.store
+        if not store.exists(PIPELINES_PATH):
+            return
+        for name, src in json.loads(store.read(PIPELINES_PATH)).items():
+            try:
+                self._pipelines[name] = Pipeline(src)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def _persist(self):
+        doc = {name: p.source for name, p in self._pipelines.items()}
+        self.instance.engine.store.write(
+            PIPELINES_PATH, json.dumps(doc).encode()
+        )
+
+    # ------------------------------------------------------------------
+    def upsert_pipeline(self, name: str, source: str) -> Pipeline:
+        p = Pipeline(source)  # validate
+        with self._lock:
+            self._pipelines[name] = p
+            self._persist()
+        return p
+
+    def get_pipeline(self, name: str) -> Pipeline | None:
+        if name == "greptime_identity":
+            return IdentityPipeline()
+        with self._lock:
+            return self._pipelines.get(name)
+
+    def delete_pipeline(self, name: str):
+        with self._lock:
+            self._pipelines.pop(name, None)
+            self._persist()
+
+    def pipeline_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._pipelines)
+
+    # ------------------------------------------------------------------
+    def ingest(self, db: str, table_name: str, pipeline_name: str,
+               events: list[dict]) -> int:
+        pipeline = self.get_pipeline(pipeline_name)
+        if pipeline is None:
+            raise InvalidArgumentError(
+                f"pipeline not found: {pipeline_name}"
+            )
+        rows = pipeline.run(events)
+        if not rows:
+            return 0
+        specs = pipeline.column_specs()
+        return self._write_rows(db, table_name, rows, specs)
+
+    def _write_rows(self, db: str, table_name: str, rows: list[dict],
+                    specs: list[tuple[str, str, str | None]]) -> int:
+        # infer columns: explicit specs first, else from the data
+        if specs:
+            ts_name = next(
+                (n for n, t, idx in specs if idx == "timestamp"), None
+            )
+            tag_names = [n for n, t, idx in specs if idx == "tag"]
+            col_types = {n: t for n, t, idx in specs}
+        else:
+            ts_name = "greptime_timestamp"
+            tag_names = []
+            col_types = {}
+            for row in rows:
+                for k, v in row.items():
+                    if k in col_types or k == ts_name:
+                        continue
+                    if isinstance(v, bool):
+                        col_types[k] = "bool"
+                    elif isinstance(v, int):
+                        col_types[k] = "int64"
+                    elif isinstance(v, float):
+                        col_types[k] = "float64"
+                    else:
+                        col_types[k] = "string"
+            col_types[ts_name] = "timestamp_ms"
+        if ts_name is None:
+            raise InvalidArgumentError(
+                "pipeline transform has no `index: timestamp` column"
+            )
+
+        from greptimedb_tpu.servers.influx import ensure_table
+
+        field_types = {
+            n: ConcreteDataType.from_name(t)
+            for n, t in col_types.items()
+            if n != ts_name and n not in tag_names
+        }
+        table = self.instance.catalog.maybe_table(db, table_name)
+        if table is None:
+            cols = [
+                ColumnSchema(n, ConcreteDataType.string(),
+                             SemanticType.TAG, nullable=False)
+                for n in tag_names
+            ]
+            for n, t in field_types.items():
+                cols.append(ColumnSchema(n, t, SemanticType.FIELD))
+            cols.append(ColumnSchema(
+                ts_name, ConcreteDataType.timestamp_millisecond(),
+                SemanticType.TIMESTAMP, nullable=False,
+            ))
+            if not self.instance.catalog.has_database(db):
+                self.instance.catalog.create_database(
+                    db, if_not_exists=True
+                )
+            table = self.instance.catalog.create_table(
+                db, table_name, Schema(cols), if_not_exists=True,
+            )
+        else:
+            table = ensure_table(
+                self.instance, db, table_name, tag_names, field_types,
+            )
+
+        n = len(rows)
+        now_ms = int(time.time() * 1000)
+        ts = np.asarray(
+            [now_ms if row.get(ts_name) is None else row[ts_name]
+             for row in rows],
+            np.int64,
+        )
+        tags = {
+            t: np.asarray(
+                ["" if row.get(t) is None else str(row.get(t))
+                 for row in rows], object,
+            )
+            for t in tag_names
+        }
+        fields = {}
+        valid = {}
+        for name, dt in field_types.items():
+            vals = [row.get(name) for row in rows]
+            validity = np.asarray([v is not None for v in vals], bool)
+            if dt.is_string():
+                arr = np.asarray(
+                    ["" if v is None else str(v) for v in vals], object
+                )
+            else:
+                arr = np.zeros(n, dt.to_numpy())
+                for i, v in enumerate(vals):
+                    if v is not None:
+                        arr[i] = v
+            fields[name] = arr
+            if not validity.all():
+                valid[name] = validity
+        table.write(tags, ts, fields, field_valid=valid or None)
+        data = {ts_name: ts, **tags, **fields}
+        self.instance._notify_flows(db, table_name, table, data, valid)
+        return n

@@ -297,9 +297,16 @@ class PromEngine:
         s = len(uniq_sids)
         s_pad = _series_bucket(s)
         labels = []
+        visible = set(table.tag_names)
         for sid in uniq_sids:
             lab = dict(data.registry.series_tags(int(sid)))
-            lab = {k: v for k, v in lab.items() if v != ""}
+            # only the table's own tags, and never internal (__table_id)
+            # columns — a metric-engine logical scan returns the physical
+            # registry
+            lab = {
+                k: v for k, v in lab.items()
+                if v != "" and k in visible and not k.startswith("__")
+            }
             lab["__name__"] = table.name
             labels.append(lab)
 

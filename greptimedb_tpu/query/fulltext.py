@@ -1,0 +1,143 @@
+"""Fulltext match evaluation for `matches(column, query)`.
+
+Query grammar per the reference's matches function (src/common/function/
+src/scalars/matches.rs, backed by tantivy query syntax): terms are ANDed
+with AND / OR / NOT (also +term / -term), "quoted phrases" match as
+substrings, parentheses group. Matching is case-insensitive on
+word-tokenized text.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from greptimedb_tpu.errors import InvalidArgumentError
+
+_TOKEN_RE = re.compile(r'"[^"]*"|\(|\)|\S+')
+_WORD_RE = re.compile(r"[a-z0-9_]+")
+
+
+def _tokenize_text(text: str) -> set[str]:
+    return set(_WORD_RE.findall(text.lower()))
+
+
+class _Node:
+    def eval(self, words: set[str], text: str) -> bool:
+        raise NotImplementedError
+
+
+class _Term(_Node):
+    def __init__(self, term: str):
+        self.term = term.lower()
+
+    def eval(self, words, text):
+        return self.term in words
+
+
+class _Phrase(_Node):
+    def __init__(self, phrase: str):
+        self.phrase = phrase.lower()
+
+    def eval(self, words, text):
+        return self.phrase in text
+
+
+class _Not(_Node):
+    def __init__(self, inner: _Node):
+        self.inner = inner
+
+    def eval(self, words, text):
+        return not self.inner.eval(words, text)
+
+
+class _Bin(_Node):
+    def __init__(self, op: str, nodes: list[_Node]):
+        self.op = op
+        self.nodes = nodes
+
+    def eval(self, words, text):
+        if self.op == "and":
+            return all(n.eval(words, text) for n in self.nodes)
+        return any(n.eval(words, text) for n in self.nodes)
+
+
+def _parse_query(query: str) -> _Node:
+    tokens = _TOKEN_RE.findall(query)
+    pos = 0
+
+    def parse_or():
+        nonlocal pos
+        nodes = [parse_and()]
+        while pos < len(tokens) and tokens[pos].upper() == "OR":
+            pos += 1
+            nodes.append(parse_and())
+        return nodes[0] if len(nodes) == 1 else _Bin("or", nodes)
+
+    def parse_and():
+        nonlocal pos
+        nodes = [parse_unary()]
+        while pos < len(tokens):
+            t = tokens[pos]
+            if t.upper() == "AND":
+                pos += 1
+                nodes.append(parse_unary())
+            elif t.upper() == "OR" or t == ")":
+                break
+            else:
+                nodes.append(parse_unary())  # implicit AND
+        return nodes[0] if len(nodes) == 1 else _Bin("and", nodes)
+
+    def parse_unary():
+        nonlocal pos
+        if pos >= len(tokens):
+            raise InvalidArgumentError(f"bad matches() query: {query!r}")
+        t = tokens[pos]
+        if t.upper() == "NOT" or t == "-" or t.startswith("-"):
+            if t.upper() == "NOT" or t == "-":
+                pos += 1
+                return _Not(parse_unary())
+            pos += 1
+            return _Not(_make_leaf(t[1:]))
+        if t == "(":
+            pos += 1
+            node = parse_or()
+            if pos >= len(tokens) or tokens[pos] != ")":
+                raise InvalidArgumentError(f"unbalanced parens: {query!r}")
+            pos += 1
+            return node
+        pos += 1
+        if t.startswith("+"):
+            t = t[1:]
+        return _make_leaf(t)
+
+    def _make_leaf(t: str) -> _Node:
+        if t.startswith('"') and t.endswith('"'):
+            return _Phrase(t[1:-1])
+        return _Term(t)
+
+    node = parse_or()
+    if pos != len(tokens):
+        raise InvalidArgumentError(f"trailing tokens in query: {query!r}")
+    return node
+
+
+def eval_matches_term(values: np.ndarray, term: str) -> np.ndarray:
+    """Literal term match with non-alphanumeric boundaries (the reference's
+    matches_term): the term itself is never parsed as a query."""
+    rx = re.compile(
+        r"(?<![a-zA-Z0-9_])" + re.escape(term) + r"(?![a-zA-Z0-9_])"
+    )
+    return np.asarray(
+        [bool(rx.search(str(v))) for v in values], dtype=bool
+    )
+
+
+def eval_matches(values: np.ndarray, query: str) -> np.ndarray:
+    node = _parse_query(query)
+    out = np.zeros(len(values), dtype=bool)
+    for i, v in enumerate(values):
+        text = str(v).lower()
+        out[i] = node.eval(_tokenize_text(text), text)
+    return out

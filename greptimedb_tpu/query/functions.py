@@ -275,7 +275,7 @@ def eval_scalar_function(e: A.FuncCall, src: ColumnSource) -> Col:
         return Col(
             np.asarray([str(v).strip() for v in c.values], object), c.validity
         )
-    if name in ("regexp_match", "matches"):
+    if name == "regexp_match":
         import re as _re
 
         c = eval_expr(args[0], src)
@@ -284,6 +284,23 @@ def eval_scalar_function(e: A.FuncCall, src: ColumnSource) -> Col:
             np.asarray([bool(rx.search(str(v))) for v in c.values], bool),
             c.validity,
         )
+    if name == "matches":
+        # fulltext query over a string column: terms with AND/OR/NOT and
+        # "quoted phrases" (reference: common-function scalars matches +
+        # the tantivy-backed fulltext index, src/index/src/fulltext_index/)
+        from greptimedb_tpu.query.fulltext import eval_matches
+
+        c = eval_expr(args[0], src)
+        query = str(_const_arg(args[1]))
+        return Col(eval_matches(c.values, query), c.validity)
+    if name == "matches_term":
+        # literal term occurrence with non-alphanumeric boundaries — the
+        # term is NOT parsed as a query
+        from greptimedb_tpu.query.fulltext import eval_matches_term
+
+        c = eval_expr(args[0], src)
+        term = str(_const_arg(args[1]))
+        return Col(eval_matches_term(c.values, term), c.validity)
     if name == "starts_with":
         c = eval_expr(args[0], src)
         prefix = str(_const_arg(args[1]))

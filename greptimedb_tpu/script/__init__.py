@@ -1,0 +1,3 @@
+from greptimedb_tpu.script.engine import PyEngine, copr
+
+__all__ = ["PyEngine", "copr"]

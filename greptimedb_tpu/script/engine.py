@@ -1,0 +1,190 @@
+"""Python coprocessors.
+
+Capability counterpart of /root/reference/src/script/src/python/ (the
+`@copr` decorated scripts run by an embedded RustPython/PyO3 over
+RecordBatches, engine.rs:345, ffi_types/copr.rs:300-344). Here the host
+language IS Python, so coprocessor vectors are handed over zero-copy as
+jax arrays — a script's arithmetic runs on the TPU via jit instead of an
+embedded interpreter.
+
+    @copr(args=["cpu", "mem"], returns=["load"],
+          sql="select cpu, mem from host_metrics")
+    def load(cpu, mem):
+        return cpu * 0.6 + mem * 0.4
+
+Scripts are stored through the object store (the reference keeps them in a
+`scripts` system table, src/script/src/table.rs) and recompiled on boot.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+
+from greptimedb_tpu.errors import InvalidArgumentError, UnsupportedError
+from greptimedb_tpu.query.executor import Col, QueryResult
+
+SCRIPTS_PATH = "meta/scripts.json"
+
+
+def copr(*, args: list[str] | None = None, returns: list[str],
+         sql: str | None = None, backend: str = "jax"):
+    """Coprocessor annotation (the reference's @copr/@coprocessor)."""
+
+    def wrap(fn):
+        fn.__copr_meta__ = {
+            "args": args or [], "returns": returns, "sql": sql,
+            "backend": backend,
+        }
+        return fn
+
+    return wrap
+
+
+coprocessor = copr
+
+
+class CompiledScript:
+    def __init__(self, name: str, source: str):
+        self.name = name
+        self.source = source
+        namespace: dict = {"copr": copr, "coprocessor": copr, "np": np}
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            namespace["jax"] = jax
+            namespace["jnp"] = jnp
+        except ImportError:  # pragma: no cover
+            pass
+        exec(compile(source, f"<script {name}>", "exec"), namespace)
+        self.entry = None
+        for v in namespace.values():
+            if callable(v) and hasattr(v, "__copr_meta__"):
+                self.entry = v
+        if self.entry is None:
+            raise InvalidArgumentError(
+                f"script {name!r} has no @copr-annotated function"
+            )
+        self.meta = self.entry.__copr_meta__
+
+
+class PyEngine:
+    """Compiles + runs coprocessor scripts against the instance."""
+
+    def __init__(self, instance):
+        self.instance = instance
+        self._scripts: dict[str, CompiledScript] = {}
+        self._lock = threading.RLock()
+        self._load()
+
+    # ------------------------------------------------------------------
+    def _load(self):
+        store = self.instance.engine.store
+        if not store.exists(SCRIPTS_PATH):
+            return
+        for name, src in json.loads(store.read(SCRIPTS_PATH)).items():
+            try:
+                self._scripts[name] = CompiledScript(name, src)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def _persist(self):
+        doc = {name: s.source for name, s in self._scripts.items()}
+        self.instance.engine.store.write(
+            SCRIPTS_PATH, json.dumps(doc).encode()
+        )
+
+    # ------------------------------------------------------------------
+    def insert_script(self, name: str, source: str) -> CompiledScript:
+        s = CompiledScript(name, source)
+        with self._lock:
+            self._scripts[name] = s
+            self._persist()
+        return s
+
+    def script_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._scripts)
+
+    def delete_script(self, name: str):
+        with self._lock:
+            self._scripts.pop(name, None)
+            self._persist()
+
+    # ------------------------------------------------------------------
+    def run_script(self, name: str, *, params: dict | None = None,
+                   ctx=None) -> QueryResult:
+        with self._lock:
+            script = self._scripts.get(name)
+        if script is None:
+            raise InvalidArgumentError(f"script not found: {name}")
+        return self.run_compiled(script, params=params, ctx=ctx)
+
+    def run_inline(self, source: str, *, params: dict | None = None,
+                   ctx=None) -> QueryResult:
+        return self.run_compiled(
+            CompiledScript("<inline>", source), params=params, ctx=ctx
+        )
+
+    def run_compiled(self, script: CompiledScript, *,
+                     params: dict | None = None, ctx=None) -> QueryResult:
+        meta = script.meta
+        arg_values = []
+        if meta["sql"]:
+            from greptimedb_tpu.session import QueryContext
+
+            res = self.instance.sql(meta["sql"], ctx or QueryContext())
+            for arg in meta["args"]:
+                if arg not in res.names:
+                    raise InvalidArgumentError(
+                        f"query does not produce column {arg!r}"
+                    )
+                col = res.column(arg)
+                arg_values.append(self._to_vector(col, meta["backend"]))
+        else:
+            params = params or {}
+            for arg in meta["args"]:
+                if arg not in params:
+                    raise InvalidArgumentError(f"missing param {arg!r}")
+                arg_values.append(params[arg])
+        out = script.entry(*arg_values)
+        return self._to_result(out, meta["returns"])
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _to_vector(col: Col, backend: str):
+        v = col.values
+        if v.dtype == object or backend == "numpy":
+            return v
+        import jax.numpy as jnp
+
+        return jnp.asarray(v)
+
+    @staticmethod
+    def _to_result(out, returns: list[str]) -> QueryResult:
+        if not isinstance(out, tuple):
+            out = (out,)
+        if len(out) != len(returns):
+            raise UnsupportedError(
+                f"script returned {len(out)} values, declared "
+                f"{len(returns)}"
+            )
+        cols = []
+        n = None
+        arrays = []
+        for v in out:
+            a = np.asarray(v)
+            if a.ndim == 0:
+                a = a[None]
+            arrays.append(a)
+            n = max(n or 0, len(a))
+        for a in arrays:
+            if len(a) == 1 and n > 1:
+                a = np.broadcast_to(a, (n,)).copy()
+            cols.append(Col(a))
+        return QueryResult(list(returns), cols)

@@ -74,16 +74,18 @@ def result_to_json(res) -> dict:
 
 
 class HttpServer:
-    def __init__(self, instance, *, addr: str = "127.0.0.1", port: int = 4000):
+    def __init__(self, instance, *, addr: str = "127.0.0.1", port: int = 4000,
+                 user_provider=None):
         self.instance = instance
         self.addr = addr
         self.port = port
+        self.user_provider = user_provider
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
     # ------------------------------------------------------------------
     def start(self):
-        handler = _make_handler(self.instance)
+        handler = _make_handler(self.instance, self.user_provider)
         self._httpd = ThreadingHTTPServer((self.addr, self.port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
@@ -100,7 +102,7 @@ class HttpServer:
             self._thread.join(timeout=5)
 
 
-def _make_handler(instance):
+def _make_handler(instance, user_provider=None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -191,6 +193,37 @@ def _make_handler(instance):
             path = self._raw_path()
             t0 = time.perf_counter()
             try:
+                if user_provider is not None and path not in (
+                    "/health", "/ready", "/-/healthy", "/-/ready",
+                ):
+                    from greptimedb_tpu.auth import (
+                        AccessDeniedError,
+                        check_basic_auth,
+                    )
+
+                    try:
+                        check_basic_auth(
+                            self.headers.get("Authorization"),
+                            user_provider,
+                        )
+                    except AccessDeniedError as e:
+                        body = json.dumps(
+                            {"error": str(e), "code": 401}
+                        ).encode()
+                        self.send_response(401)
+                        self.send_header(
+                            "WWW-Authenticate", 'Basic realm="greptime"'
+                        )
+                        self.send_header(
+                            "Content-Type", "application/json"
+                        )
+                        self.send_header(
+                            "Content-Length", str(len(body))
+                        )
+                        self.end_headers()
+                        self.wfile.write(body)
+                        _REQS.labels(self._route(), "401").inc()
+                        return
                 self._route_request(method, path)
             except GreptimeError as e:
                 self._error(400, str(e))
@@ -237,7 +270,42 @@ def _make_handler(instance):
                 "/v1/events"
             ):
                 return self._handle_events(method, path)
+            if path == "/v1/scripts":
+                return self._handle_scripts()
+            if path == "/v1/run-script":
+                return self._handle_run_script()
             self._error(404, f"no route: {path}")
+
+        _engine_lock = threading.Lock()
+
+        def _script_engine(self):
+            eng = getattr(instance, "_py_engine", None)
+            if eng is None:
+                with self._engine_lock:
+                    eng = getattr(instance, "_py_engine", None)
+                    if eng is None:
+                        from greptimedb_tpu.script import PyEngine
+
+                        eng = PyEngine(instance)
+                        instance._py_engine = eng
+            return eng
+
+        def _handle_scripts(self):
+            params = self._params()
+            name = params.get("name")
+            if not name:
+                return self._error(400, "missing name parameter")
+            source = self._body().decode()
+            self._script_engine().insert_script(name, source)
+            self._json(200, {"name": name, "status": "compiled"})
+
+        def _handle_run_script(self):
+            params = self._params()
+            name = params.get("name")
+            if not name:
+                return self._error(400, "missing name parameter")
+            res = self._script_engine().run_script(name)
+            self._json(200, {"output": [result_to_json(res)]})
 
         # ------------------------------------------------------------------
         def _handle_sql(self):
