@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import base64
 import hashlib
+import hmac
 import os
 import threading
 
@@ -47,11 +48,11 @@ class StaticUserProvider(UserProvider):
         if want is None:
             return False
         if want.startswith("sha256:"):
-            return (
-                hashlib.sha256(password.encode()).hexdigest()
-                == want[len("sha256:"):]
+            return hmac.compare_digest(
+                hashlib.sha256(password.encode()).hexdigest().encode(),
+                want[len("sha256:"):].encode(),
             )
-        return password == want
+        return hmac.compare_digest(password.encode(), want.encode())
 
 
 class WatchFileUserProvider(UserProvider):

@@ -803,6 +803,17 @@ def _vector_vector(e: Binary, lhs: VectorValue, rhs: VectorValue
                 f"'one' side for key {dict(k)}"
             )
         one_index[k] = i
+    if many_side is None:
+        # one-to-one: duplicate keys on the other side are equally illegal
+        seen: set[tuple] = set()
+        for lab in many.labels:
+            k = _match_key(lab, m)
+            if k in seen and k in one_index:
+                raise ExecutionError(
+                    "many-to-many vector matching: duplicate series on "
+                    f"both sides for key {dict(k)}"
+                )
+            seen.add(k)
     labels, vals, pres = [], [], []
     for i, lab in enumerate(many.labels):
         k = _match_key(lab, m)

@@ -260,12 +260,13 @@ class _Parser:
         return out
 
     def unary(self) -> PromExpr:
+        # unary +/- binds between '*' and '^' (Prometheus: -1^2 == -(1^2))
         if self.at("-"):
             self.next()
-            return Unary("-", self.unary())
+            return Unary("-", self.expr(_PRECEDENCE["^"]))
         if self.at("+"):
             self.next()
-            return self.unary()
+            return self.expr(_PRECEDENCE["^"])
         return self.postfix()
 
     def postfix(self) -> PromExpr:

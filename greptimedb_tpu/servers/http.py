@@ -75,17 +75,25 @@ def result_to_json(res) -> dict:
 
 class HttpServer:
     def __init__(self, instance, *, addr: str = "127.0.0.1", port: int = 4000,
-                 user_provider=None):
+                 user_provider=None, enable_scripts: bool = False):
         self.instance = instance
         self.addr = addr
         self.port = port
         self.user_provider = user_provider
+        # scripts compile arbitrary Python with exec() in the server
+        # process (the reference isolates coprocessors in an embedded
+        # RustPython VM, src/script/src/python/engine.rs:345). Off by
+        # default; enabling requires an authenticating user provider.
+        if enable_scripts and user_provider is None:
+            raise ValueError("enable_scripts requires a user_provider")
+        self.enable_scripts = enable_scripts
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
     # ------------------------------------------------------------------
     def start(self):
-        handler = _make_handler(self.instance, self.user_provider)
+        handler = _make_handler(self.instance, self.user_provider,
+                                enable_scripts=self.enable_scripts)
         self._httpd = ThreadingHTTPServer((self.addr, self.port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
@@ -102,7 +110,7 @@ class HttpServer:
             self._thread.join(timeout=5)
 
 
-def _make_handler(instance, user_provider=None):
+def _make_handler(instance, user_provider=None, *, enable_scripts=False):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -271,8 +279,12 @@ def _make_handler(instance, user_provider=None):
             ):
                 return self._handle_events(method, path)
             if path == "/v1/scripts":
+                if not enable_scripts:
+                    return self._json(403, {"error": "scripts disabled"})
                 return self._handle_scripts()
             if path == "/v1/run-script":
+                if not enable_scripts:
+                    return self._json(403, {"error": "scripts disabled"})
                 return self._handle_run_script()
             self._error(404, f"no route: {path}")
 
@@ -353,6 +365,8 @@ def _make_handler(instance, user_provider=None):
                         names.update(table.tag_names)
                 if not _match_params(params):
                     for t in instance.catalog.all_tables():
+                        if t.info.database != db:
+                            continue
                         names.update(t.tag_names)
                 return self._json(
                     200, {"status": "success", "data": sorted(names)}
@@ -362,12 +376,16 @@ def _make_handler(instance, user_provider=None):
                 values = set()
                 if label == "__name__":
                     for t in instance.catalog.all_tables():
-                        values.add(t.name)
+                        if t.info.database == db:
+                            values.add(t.name)
                 else:
                     tables = [
                         _match_table(instance, db, m)
                         for m in _match_params(params)
-                    ] or instance.catalog.all_tables()
+                    ] or [
+                        t for t in instance.catalog.all_tables()
+                        if t.info.database == db
+                    ]
                     for t in tables:
                         if t is None or label not in t.tag_names:
                             continue
