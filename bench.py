@@ -1,18 +1,31 @@
-"""Driver benchmark: TSBS double-groupby-all on one TPU chip.
+"""Driver benchmark: TSBS double-groupby-all THROUGH THE SQL ENGINE.
 
-Workload (BASELINE.md): mean of all 10 cpu fields GROUP BY (hostname, hour)
-over 12h of 10s-interval data for 4000 hosts — 172.8M samples resident in
-HBM (the hot-cache analog of the reference's page-cache-hot datanode). The
-reference CPU datanode answers this in 1625.33 ms (local Ryzen baseline).
+Workload (BASELINE.md, docs/benchmarks/tsbs/v0.9.1.md:39 in the reference):
+mean of all 10 cpu fields GROUP BY (hostname, hour) over 12h of 10s-interval
+data for 4000 hosts. The reference CPU datanode answers this in 1625.33 ms
+over its page-cache-hot storage.
 
-Measurement notes: the dev tunnel to the chip has ~70 ms fixed round-trip
-latency per program launch + readback (with several-ms jitter), and async
-dispatch makes naive wall-clock timing meaningless. So the query runs N
-times sequentially *inside one device program* (lax.scan with the carry
-threaded into the mask so LICM cannot hoist the body), a scalar is read
-back, and per-query latency is the SLOPE between two iteration counts —
-fixed overhead cancels exactly. Sanity floor: 708MB of HBM traffic per
-query bounds latency below ~0.86 ms at v5e's ~819GB/s.
+Unlike round 1 (which timed a bare kernel over synthetic arrays), this
+bench runs the real path: rows are ingested through `Table.write` into the
+storage engine, and the query is issued as SQL through
+`Standalone.sql()` — parse -> plan -> device grid cache
+(query/device_range.py) -> one XLA program over HBM-resident cell states ->
+columnar result assembly. The first query builds the device cache (the
+page-cache-warm analog); steady-state latency is what's measured, matching
+how TSBS measures the reference (repeated queries against a warm datanode).
+
+Measurement note (same dev-tunnel correction as round 1, now applied to the
+full SQL path): the chip here is attached through a network tunnel with
+~90 ms round-trip latency and ~12 MB/s device->host bandwidth; the
+reference numbers were measured with client and server on one machine
+(loopback, GB/s). A co-located v5e moves the 1.9 MB result over PCIe in
+<1 ms. So the bench measures, in the same process, (a) raw end-to-end
+wall-clock per query and (b) the tunnel floor — a no-op jit program reading
+back an identical-shaped result buffer from HBM, which costs RTT + transfer
+but no compute and no SQL work. Reported latency = (a) - (b): everything
+the database does (parse, plan, cache lookup, device compute, assembly)
+plus a real host-side result copy, minus only the dev-harness wire. Both
+raw numbers are printed on stderr for auditability.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -20,84 +33,137 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import shutil
 import sys
+import tempfile
 import time
 
 import numpy as np
 
 BASELINE_MS = 1625.33  # docs/benchmarks/tsbs/v0.9.1.md:39 (local)
-ITERS_LO = 8
-ITERS_HI = 72
+
+HOSTS = 4000
+CELLS = 12 * 360          # 12h at 10s
+INTERVAL_MS = 10_000
+FIELD_NAMES = [
+    "usage_user", "usage_system", "usage_idle", "usage_nice",
+    "usage_iowait", "usage_irq", "usage_softirq", "usage_steal",
+    "usage_guest", "usage_guest_nice",
+]
+RUNS = 12
 
 
 def main():
-    import jax
-    import jax.numpy as jnp
+    from greptimedb_tpu.instance import Standalone
 
-    from greptimedb_tpu.models import tsbs
+    tmp = tempfile.mkdtemp(prefix="gtpu_bench_")
+    try:
+        inst = Standalone(tmp, prefer_device=True)
+        cols = ", ".join(f"{f} double" for f in FIELD_NAMES)
+        inst.execute_sql(
+            f"create table cpu (ts timestamp time index, "
+            f"hostname string primary key, {cols})"
+        )
+        table = inst.catalog.table("public", "cpu")
 
-    F, S = 10, 4000
-    T = 12 * 360            # 12h at 10s
-    CPB = 360               # 1h buckets
-    K = 10
+        rng = np.random.default_rng(7)
+        hostnames = np.asarray(
+            [f"host_{i}" for i in range(HOSTS)], dtype=object
+        )
+        t_load = time.perf_counter()
+        rows_total = 0
+        batch_cells = 360  # one hour per batch
+        for b in range(CELLS // batch_cells):
+            ts_block = (
+                np.arange(b * batch_cells, (b + 1) * batch_cells,
+                          dtype=np.int64) * INTERVAL_MS
+            )
+            ts = np.tile(ts_block, HOSTS)
+            hosts = np.repeat(hostnames, batch_cells)
+            n = len(ts)
+            fields = {
+                f: (rng.random(n, dtype=np.float32) * 100.0).astype(
+                    np.float64
+                )
+                for f in FIELD_NAMES
+            }
+            table.write({"hostname": hosts}, ts, fields, skip_wal=True)
+            rows_total += n
+        load_s = time.perf_counter() - t_load
+        print(
+            f"# ingested {rows_total} rows x {len(FIELD_NAMES)} fields "
+            f"in {load_s:.1f}s ({rows_total / load_s:,.0f} rows/s)",
+            file=sys.stderr,
+        )
 
-    rng = np.random.default_rng(7)
-    fields = jnp.asarray(rng.random((F, S, T), dtype=np.float32) * 100.0)
-    has = jnp.asarray(rng.random((S, T)) > 0.01)
+        items = ", ".join(
+            f"avg({f}) RANGE '1h'" for f in FIELD_NAMES
+        )
+        query = (
+            f"SELECT ts, hostname, {items} FROM cpu "
+            f"ALIGN '1h' BY (hostname)"
+        )
 
-    def query(fields, has):
-        means, _present = tsbs.double_groupby(fields, has, CPB)
-        score = jnp.sum(means, axis=(0, 2))
-        top_v, top_i = jax.lax.top_k(score, K)
-        return means, top_v, top_i
+        # warm-up: builds the device grid cache + compiles the program
+        t_warm = time.perf_counter()
+        res = inst.sql(query)
+        warm_s = time.perf_counter() - t_warm
+        assert inst.query_engine.last_exec_path == "device", (
+            "flagship query must run on the device path"
+        )
+        assert res.num_rows == HOSTS * 12, res.num_rows
+        means = np.asarray(res.cols[2].values, dtype=np.float64)
+        assert np.isfinite(means).all() and 40 < means.mean() < 60
+        print(f"# warm-up (cache build + compile): {warm_s:.1f}s",
+              file=sys.stderr)
 
-    import functools
+        # tunnel floor: identical-shape result readback, zero compute/SQL.
+        # The tunnel's throughput drifts over a process's lifetime, so the
+        # floor is measured INTERLEAVED with the queries (floor_i, wall_i
+        # pairs) and the reported number is the median pairwise difference.
+        import jax
+        import jax.numpy as jnp
 
-    @functools.partial(jax.jit, static_argnames=("iters",))
-    def run_many(fields, has, iters: int):
-        def body(carry, _):
-            # thread the carry into `has` so XLA cannot hoist the
-            # loop-invariant query out of the scan (LICM); costs one pass
-            # over the 17MB mask vs the 691MB payload.
-            h = has & (carry > jnp.float32(-1e30))
-            _means, top_v, top_i = query(fields, h)
-            return carry + top_v[0] + top_i[-1].astype(jnp.float32), None
+        shape = (len(FIELD_NAMES), HOSTS, 12)
+        resident = jnp.zeros(shape, jnp.float32) + 1.0
+        resident.block_until_ready()
 
-        acc, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
-        return acc
+        @jax.jit
+        def null_result(x):
+            return x * 1.0000001
 
-    # correctness + compile warm-up
-    means = np.asarray(query(fields, has)[0])
-    assert means.shape == (F, S, T // CPB) and np.isfinite(means).all()
-    _ = float(run_many(fields, has, ITERS_LO))
-    _ = float(run_many(fields, has, ITERS_HI))
-
-    def timed(iters):
-        best = float("inf")
-        for _ in range(5):
+        _ = np.asarray(null_result(resident))
+        lat, floor, diffs = [], [], []
+        for _ in range(RUNS):
             t0 = time.perf_counter()
-            _ = float(run_many(fields, has, iters))  # readback -> completion
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    t_lo = timed(ITERS_LO)
-    t_hi = timed(ITERS_HI)
-    ms = max(t_hi - t_lo, 1e-9) / (ITERS_HI - ITERS_LO) * 1000.0
-
-    gbps = (fields.nbytes + has.size) / (ms / 1000.0) / 1e9
-    print(
-        f"# double-groupby-all: {ms:.3f} ms/query over "
-        f"{F * S * T / 1e6:.1f}M samples ({gbps:.0f} GB/s effective) on "
-        f"{jax.devices()[0]}; t({ITERS_LO})={t_lo * 1000:.1f}ms "
-        f"t({ITERS_HI})={t_hi * 1000:.1f}ms",
-        file=sys.stderr,
-    )
-    print(json.dumps({
-        "metric": "tsbs_double_groupby_all_latency",
-        "value": round(ms, 3),
-        "unit": "ms",
-        "vs_baseline": round(BASELINE_MS / ms, 2),
-    }))
+            _ = np.asarray(null_result(resident))
+            f_ms = (time.perf_counter() - t0) * 1000
+            t0 = time.perf_counter()
+            r = inst.sql(query)
+            w_ms = (time.perf_counter() - t0) * 1000
+            assert r.num_rows == HOSTS * 12
+            floor.append(f_ms)
+            lat.append(w_ms)
+            diffs.append(w_ms - f_ms)
+        print(f"# per-query wall ms (incl. tunnel): "
+              f"{[f'{x:.1f}' for x in lat]}", file=sys.stderr)
+        print(f"# tunnel floor ms (RTT + {np.prod(shape) * 4 / 1e6:.1f}MB "
+              f"readback, no compute): {[f'{x:.1f}' for x in floor]}",
+              file=sys.stderr)
+        diffs.sort()
+        med_wall = sorted(lat)[len(lat) // 2]
+        adj = max(diffs[len(diffs) // 2], 0.1)
+        print(f"# median pairwise (wall - floor) = {adj:.1f}ms database "
+              f"time/query (wall median {med_wall:.1f}ms)", file=sys.stderr)
+        print(json.dumps({
+            "metric": "tsbs_double_groupby_all_sql_ms",
+            "value": round(adj, 3),
+            "unit": "ms",
+            "vs_baseline": round(BASELINE_MS / adj, 2),
+        }))
+        inst.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 if __name__ == "__main__":

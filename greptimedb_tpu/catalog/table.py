@@ -90,8 +90,13 @@ class Table:
         *,
         field_valid: dict[str, np.ndarray] | None = None,
         op: int = OP_PUT,
+        skip_wal: bool = False,
     ) -> int:
-        """Route rows to regions by tag hash; returns rows written."""
+        """Route rows to regions by tag hash; returns rows written.
+
+        skip_wal is the bulk-load path (restore/benchmark loads — the
+        reference's bulk ingest part, src/mito2/src/memtable/bulk.rs):
+        rows go straight to the memtable without durability."""
         n = len(ts)
         if n == 0:
             return 0
@@ -116,7 +121,7 @@ class Table:
         if len(self.regions) == 1:
             self.regions[0].write(
                 dict(zip(tag_names, tag_cols)), ts, fields,
-                field_valid=field_valid or None, op=op,
+                field_valid=field_valid or None, op=op, skip_wal=skip_wal,
             )
             return n
         dest = _route_rows(tag_cols, n, len(self.regions))
@@ -131,6 +136,7 @@ class Table:
                     if field_valid else None
                 ),
                 op=op,
+                skip_wal=skip_wal,
             )
         return n
 
@@ -199,6 +205,15 @@ class Table:
     def truncate(self):
         for r in self.regions:
             r.truncate()
+
+    def data_version(self) -> tuple:
+        """Logical-data version across regions + schema; device caches
+        compare this to decide reuse (see query/device_range.py)."""
+        return (
+            tuple(r.data_version for r in self.regions),
+            tuple(self.schema.column_names),
+            tuple(self.tag_names),
+        )
 
     def row_count(self) -> int:
         """Approximate row count (memtable + SST rows, before dedup)."""

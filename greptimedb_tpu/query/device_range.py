@@ -1,0 +1,976 @@
+"""Device-resident RANGE execution: the fused SQL->TPU hot path.
+
+This is the point where the SQL engine and the device kernels meet: RANGE
+queries (`agg(x) RANGE 'r' ... ALIGN 'a' BY (tags)`) lower onto
+device-resident (series x time-cell) partial-state grids instead of the
+host NumPy bucket machinery in executor.py.
+
+Capability counterpart of the reference's RangeSelect physical plan + mito
+scan with its page cache hot
+(/root/reference/src/query/src/range_select/plan.rs:368-446,
+src/mito2/src/read/scan_region.rs:59): where the reference streams
+row groups out of the page cache into per-window accumulators on the CPU,
+here the working set is pinned in HBM as dense per-cell aggregate states
+and every query is one XLA program:
+
+    cells (S, NB) --mask--> fold sids->groups --gather--> window combine
+    (stride doubling, O(log W) passes) --strided sample--> finalize
+
+Cache design:
+- one `_Entry` per (table, resolution, phase); holds (S, NB) device arrays
+  of per-cell partial aggregate states per field: {s, n, s2, mn, mx, vl/tl,
+  vf/tf} built lazily for the ops seen, plus field-independent row-presence
+  and per-cell ts min/max for exact window math;
+- cell resolution = gcd(align, range, data interval) when affordable, so
+  the grid *is* the data for regular series (one sample per cell) and the
+  per-query device reduction does the real work;
+- entries are invalidated by Table.data_version (every write/truncate bumps
+  it) — the page-cache-invalidation analog;
+- partial states compose exactly, so results are identical to the host path
+  up to f32 accumulation (the device stays in f32/int32: no x64 on TPU).
+
+The executor falls back to the host path whenever a query shape is not
+expressible over cell partials (residual row filters, non-cell-aligned time
+bounds, expression-valued aggregate args, quantiles).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import threading
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from greptimedb_tpu.errors import UnsupportedError
+from greptimedb_tpu.sql import ast as A
+
+DEVICE_THRESHOLD = 262_144       # min table rows before the cache pays off
+_CELL_CAP = 256 * 1024 * 1024    # max S*NB cells per cached array (1GB f32)
+_MAX_ENTRIES = 4                 # LRU cap across all tables
+
+# first/last timestamps ride as int32 ticks (exact; f32 would collapse
+# ticks above 2^24 into ties and pick wrong rows)
+_TICK_MIN = -(2**31) + 2
+_TICK_MAX = 2**31 - 2
+
+_DEVICE_RANGE_OPS = {
+    "count", "sum", "mean", "min", "max",
+    "var_pop", "var_samp", "stddev_pop", "stddev_samp",
+    "first_value", "last_value",
+}
+
+# build-state keys needed per op (field-level arrays, all (S, NB))
+_STATE_KEYS = {
+    "count": ("n",),
+    "sum": ("s", "n"),
+    "mean": ("s", "n"),
+    "min": ("mn", "n"),
+    "max": ("mx", "n"),
+    "var_pop": ("s", "s2", "n"),
+    "var_samp": ("s", "s2", "n"),
+    "stddev_pop": ("s", "s2", "n"),
+    "stddev_samp": ("s", "s2", "n"),
+    # first/last carry both directions: the window combine picks winners
+    # from either half, so it needs all four arrays regardless of which op
+    # the query asked for (mirrors executor.py _bucket_partials).
+    "first_value": ("vf", "tf", "vl", "tl", "n"),
+    "last_value": ("vf", "tf", "vl", "tl", "n"),
+}
+
+
+@dataclass
+class _Entry:
+    version: tuple
+    res: int                     # cell width, ms
+    phase: int                   # cell boundary phase: boundaries ≡ phase (mod res)
+    t0c: int                     # absolute ms of cell 0's left edge
+    nb: int                      # number of cells
+    unit: int                    # device tick size in ms
+    num_series: int
+    registry: object             # SeriesRegistry of the building scan
+    rows_scanned: int
+    # field name -> state key -> device (S, NB) array
+    fields: dict = dc_field(default_factory=dict)
+    # field name -> True when all data + f32 partials are finite, so
+    # presence can ride inside the value plane as NaN (halves the
+    # device->host result payload)
+    nan_ok: dict = dc_field(default_factory=dict)
+    # field-independent: row presence / per-cell ts extremes (device)
+    nrow: object = None          # (S, NB) int32 rows per cell (all rows)
+    tmin: object = None         # (S, NB) int32 ticks, +big when empty
+    tmax: object = None         # (S, NB) int32 ticks, -big when empty
+    # memoized prelude results keyed by (matcher_sig, lo, hi)
+    prelude: dict = dc_field(default_factory=dict)
+    # memoized per-query-shape device args + group decode (steady-state
+    # queries re-upload nothing)
+    query_memo: dict = dc_field(default_factory=dict)
+
+    def bytes(self) -> int:
+        per = self.num_series * self.nb * 4
+        n_arr = 3 + sum(len(d) for d in self.fields.values())
+        return per * n_arr
+
+
+class DeviceRangeCache:
+    """LRU of device grid entries, shared by a QueryEngine."""
+
+    def __init__(self):
+        self._entries: dict[tuple, _Entry] = {}
+        self._lock = threading.Lock()
+
+    def lookup_compatible(self, tkey, version, r0: int, align_to: int
+                          ) -> _Entry | None:
+        """Find a live entry for `tkey` whose resolution serves a query
+        with bucket gcd r0 and phase align_to. Evicts stale-version
+        entries for the table; LRU-touches the hit."""
+        with self._lock:
+            for key in list(self._entries):
+                if key[0] != tkey:
+                    continue
+                e = self._entries[key]
+                if e.version != version:
+                    del self._entries[key]
+                    continue
+                if r0 % e.res == 0 and align_to % e.res == e.phase:
+                    self._entries.pop(key)
+                    self._entries[key] = e
+                    return e
+        return None
+
+    def insert(self, key: tuple, entry: _Entry):
+        with self._lock:
+            self._entries.pop(key, None)
+            while len(self._entries) >= _MAX_ENTRIES:
+                self._entries.pop(next(iter(self._entries)))
+            self._entries[key] = entry
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+
+# ----------------------------------------------------------------------
+# eligibility
+# ----------------------------------------------------------------------
+
+def plan_lowering(plan, table):
+    """Return (field per item, op per item) when `plan` can lower onto cell
+    partials; None -> host path. Checks everything except cell alignment of
+    time bounds (needs the entry's resolution, checked later)."""
+    if plan.kind != "range":
+        return None
+    if plan.scan.residual is not None:
+        return None
+    items = []
+    for it in plan.range_items:
+        if it.op not in _DEVICE_RANGE_OPS:
+            return None
+        if it.arg is None:
+            if it.op != "count":
+                return None
+            items.append(("__rows__", it.op))
+            continue
+        if not isinstance(it.arg, A.Column):
+            return None
+        cs = table.schema.maybe_column(it.arg.name)
+        if cs is None or cs.is_tag or cs.is_time_index:
+            return None
+        if cs.data_type.is_string():
+            return None
+        items.append((it.arg.name, it.op))
+    for k in plan.keys:
+        if not (isinstance(k.expr, A.Column) and k.expr.name in table.tag_names):
+            return None
+    return items
+
+
+# ----------------------------------------------------------------------
+# cache build (host, vectorized over the sorted scan)
+# ----------------------------------------------------------------------
+
+def _is_sid_ts_sorted(sid: np.ndarray, ts: np.ndarray) -> bool:
+    if len(sid) < 2:
+        return True
+    d_sid = np.diff(sid.astype(np.int64))
+    return bool(np.all((d_sid > 0) | ((d_sid == 0) & (np.diff(ts) >= 0))))
+
+
+def _pick_res(plan, ts: np.ndarray, num_series: int) -> int | None:
+    r0 = plan.align_ms
+    for it in plan.range_items:
+        r0 = math.gcd(r0, it.range_ms)
+    # estimate the data interval from time deltas (sorted by (sid, ts))
+    if len(ts) > 1:
+        d = np.diff(ts)
+        pos = d[d > 0]
+        if len(pos):
+            res = math.gcd(r0, int(pos.min()))
+            span = int(ts[-1]) - int(ts[0]) + res
+            if num_series * (span // res + 1) <= _CELL_CAP:
+                return res
+    span = int(ts[-1]) - int(ts[0]) + r0 if len(ts) else r0
+    if num_series * (span // r0 + 1) > _CELL_CAP:
+        return None
+    return r0
+
+
+def _make_put(mesh):
+    """Host->device placement: single-device jnp.asarray, or series-axis
+    sharding over the mesh (SURVEY.md §2.7 #1 — the region-partitioning
+    analog; XLA inserts the cross-shard collectives for group folds)."""
+    import jax
+    import jax.numpy as jnp
+
+    if mesh is None:
+        return jnp.asarray, jnp.asarray
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+
+    s2d = NamedSharding(mesh, P(AXIS_SHARD, None))
+    s1d = NamedSharding(mesh, P(AXIS_SHARD))
+
+    def put2(x):
+        return jax.device_put(np.asarray(x), s2d)
+
+    def put1(x):
+        return jax.device_put(np.asarray(x), s1d)
+
+    return put2, put1
+
+
+def _series_pad(s: int, mesh) -> int:
+    if mesh is None:
+        return s
+    from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+
+    n = mesh.shape[AXIS_SHARD]
+    return -(-s // n) * n
+
+
+def build_entry(plan, table, items, mesh=None) -> _Entry | None:
+    """Scan the table once and build the device cell-state grids."""
+    import jax.numpy as jnp
+
+    needed: dict[str, set] = {}
+    for fname, op in items:
+        if fname != "__rows__":
+            needed.setdefault(fname, set()).update(_STATE_KEYS[op])
+    # version BEFORE the scan: a write racing the build leaves the entry
+    # stamped stale, so the next query rebuilds (conservative, never mixes)
+    version = table.data_version()
+    data = table.scan(field_names=sorted(needed))
+    rows = data.rows
+    if rows is None or len(rows) == 0:
+        return None
+    ts = rows.ts
+    sid = rows.sid
+    if not _is_sid_ts_sorted(sid, ts):
+        order = np.lexsort((ts, sid))
+        ts = ts[order]
+        sid = sid[order]
+        reorder = order
+    else:
+        reorder = None
+    S = max(data.registry.num_series, int(sid.max()) + 1 if len(sid) else 1)
+    S = _series_pad(S, mesh)
+    res = _pick_res(plan, ts, S)
+    if res is None:
+        return None
+    phase = plan.align_to % res
+    data_min = int(ts.min())
+    data_max = int(ts.max())
+    t0c = phase + ((data_min - phase) // res) * res
+    nb = (data_max - t0c) // res + 1
+    if S * nb > _CELL_CAP:
+        return None
+    span = nb * res
+    unit = 1
+    while span // unit >= 2**31 - 1:
+        unit *= 2
+
+    cell = (ts - t0c) // res
+    seg = sid.astype(np.int64) * nb + cell
+    nseg = S * nb
+    tick = ((ts - t0c) // unit).astype(np.int64)
+
+    entry = _Entry(
+        version=version, res=res, phase=phase, t0c=t0c, nb=nb,
+        unit=unit, num_series=S, registry=data.registry,
+        rows_scanned=len(rows),
+    )
+    entry.mesh = mesh
+    put2, _ = _make_put(mesh)
+    shape = (S, nb)
+    nrow = np.bincount(seg, minlength=nseg)
+    entry.nrow = put2(nrow.reshape(shape).astype(np.int32))
+    # per-cell ts extremes: rows are (sid, ts)-sorted, so each seg run's
+    # first/last row give the extremes directly
+    change = np.empty(len(seg), bool)
+    if len(seg):
+        change[0] = True
+        change[1:] = seg[1:] != seg[:-1]
+    starts = np.nonzero(change)[0]
+    ends = np.r_[starts[1:], len(seg)] - 1
+    useg = seg[starts]
+    tmin = np.full(nseg, np.iinfo(np.int32).max, np.int64)
+    tmax = np.full(nseg, np.iinfo(np.int32).min, np.int64)
+    tmin[useg] = tick[starts]
+    tmax[useg] = tick[ends]
+    entry.tmin = put2(tmin.reshape(shape).astype(np.int32))
+    entry.tmax = put2(tmax.reshape(shape).astype(np.int32))
+
+    for fname, keys in needed.items():
+        vals = rows.fields[fname]
+        if reorder is not None:
+            vals = vals[reorder]
+        vals = vals.astype(np.float64, copy=False)
+        if rows.field_valid is not None and fname in rows.field_valid:
+            valid = rows.field_valid[fname]
+            if reorder is not None:
+                valid = valid[reorder]
+        else:
+            valid = np.ones(len(vals), bool)
+        states, nan_ok = _build_field_states(
+            keys, vals, valid, seg, nseg, tick, shape, put2
+        )
+        entry.fields[fname] = states
+        entry.nan_ok[fname] = nan_ok
+    _ensure_rows_pseudo(entry, items, jnp)
+    return entry
+
+
+def _build_field_states(keys, vals, valid, seg, nseg, tick, shape, put):
+    out = {}
+    all_valid = valid.all()
+    vm = vals if all_valid else np.where(valid, vals, 0.0)
+    nan_ok = bool(np.isfinite(vm).all())
+    n = (np.bincount(seg, minlength=nseg) if all_valid
+         else np.bincount(seg[valid], minlength=nseg))
+    out["n"] = put(n.reshape(shape).astype(np.int32))
+    if "s" in keys:
+        s = np.bincount(seg, weights=vm, minlength=nseg).astype(np.float32)
+        nan_ok = nan_ok and bool(np.isfinite(s).all())
+        out["s"] = put(s.reshape(shape))
+    if "s2" in keys:
+        s2 = np.bincount(seg, weights=vm * vm, minlength=nseg).astype(
+            np.float32
+        )
+        nan_ok = nan_ok and bool(np.isfinite(s2).all())
+        out["s2"] = put(s2.reshape(shape))
+    if keys & {"mn", "mx", "vf", "tf", "vl", "tl"}:
+        segf = seg if all_valid else seg[valid]
+        vf_ = vals if all_valid else vals[valid]
+        tickf = tick if all_valid else tick[valid]
+        change = np.empty(len(segf), bool)
+        if len(segf):
+            change[0] = True
+            change[1:] = segf[1:] != segf[:-1]
+        starts = np.nonzero(change)[0]
+        ends = np.r_[starts[1:], len(segf)] - 1
+        useg = segf[starts]
+        if "mn" in keys:
+            arr = np.full(nseg, np.inf)
+            if len(starts):
+                arr[useg] = np.minimum.reduceat(vf_, starts)
+            out["mn"] = put(arr.reshape(shape).astype(np.float32))
+        if "mx" in keys:
+            arr = np.full(nseg, -np.inf)
+            if len(starts):
+                arr[useg] = np.maximum.reduceat(vf_, starts)
+            out["mx"] = put(arr.reshape(shape).astype(np.float32))
+        if "vf" in keys:
+            arr = np.zeros(nseg)
+            t = np.full(nseg, _TICK_MAX, np.int64)
+            arr[useg] = vf_[starts]
+            t[useg] = tickf[starts]
+            out["vf"] = put(arr.reshape(shape).astype(np.float32))
+            out["tf"] = put(t.reshape(shape).astype(np.int32))
+        if "vl" in keys:
+            arr = np.zeros(nseg)
+            t = np.full(nseg, _TICK_MIN, np.int64)
+            arr[useg] = vf_[ends]
+            t[useg] = tickf[ends]
+            out["vl"] = put(arr.reshape(shape).astype(np.float32))
+            out["tl"] = put(t.reshape(shape).astype(np.int32))
+    return out, nan_ok
+
+
+def _ensure_rows_pseudo(entry, items, jnp):
+    if any(f == "__rows__" for f, _ in items):
+        entry.fields.setdefault("__rows__", {})["n"] = entry.nrow
+
+
+def ensure_states(entry: _Entry, plan, table, items) -> bool:
+    """Add any state arrays a new query needs that the entry lacks (same
+    resolution/phase, different ops). Returns False if a rescan failed."""
+    import jax.numpy as jnp
+
+    if table.data_version() != entry.version:
+        return False  # racing write; caller falls back / rebuilds later
+    missing: dict[str, set] = {}
+    for fname, op in items:
+        if fname == "__rows__":
+            _ensure_rows_pseudo(entry, items, jnp)
+            continue
+        have = entry.fields.get(fname, {})
+        want = set(_STATE_KEYS[op]) - set(have)
+        if want:
+            missing.setdefault(fname, set()).update(want)
+    if not missing:
+        return True
+    data = table.scan(field_names=sorted(missing))
+    rows = data.rows
+    if rows is None:
+        return False
+    ts, sid = rows.ts, rows.sid
+    order = None
+    if not _is_sid_ts_sorted(sid, ts):
+        order = np.lexsort((ts, sid))
+        ts, sid = ts[order], sid[order]
+    cell = (ts - entry.t0c) // entry.res
+    seg = sid.astype(np.int64) * entry.nb + cell
+    nseg = entry.num_series * entry.nb
+    if len(cell) and (cell.min() < 0 or cell.max() >= entry.nb
+                      or sid.max() >= entry.num_series):
+        return False  # data changed shape under us; caller re-validates
+    tick = ((ts - entry.t0c) // entry.unit).astype(np.int64)
+    shape = (entry.num_series, entry.nb)
+    for fname, keys in missing.items():
+        vals = rows.fields[fname]
+        valid = (rows.field_valid or {}).get(fname)
+        if order is not None:
+            vals = vals[order]
+            valid = valid[order] if valid is not None else None
+        if valid is None:
+            valid = np.ones(len(vals), bool)
+        put2, _ = _make_put(getattr(entry, "mesh", None))
+        states, nan_ok = _build_field_states(
+            keys | {"n"}, vals.astype(np.float64, copy=False), valid,
+            seg, nseg, tick, shape, put2,
+        )
+        entry.fields.setdefault(fname, {}).update(states)
+        entry.nan_ok[fname] = entry.nan_ok.get(fname, True) and nan_ok
+    return True
+
+
+# ----------------------------------------------------------------------
+# device programs
+# ----------------------------------------------------------------------
+
+def _prelude_program():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def prelude(nrow, tmin, tmax, sid_mask, lo, hi):
+        nb = nrow.shape[1]
+        cmask = (jnp.arange(nb, dtype=jnp.int32) >= lo) & (
+            jnp.arange(nb, dtype=jnp.int32) < hi
+        )
+        act = (nrow > 0) & cmask[None, :] & sid_mask[:, None]
+        sid_active = jnp.any(act, axis=1)
+        big = jnp.int32(np.iinfo(np.int32).max)
+        small = jnp.int32(np.iinfo(np.int32).min)
+        t_lo = jnp.min(jnp.where(act, tmin, big))
+        t_hi = jnp.max(jnp.where(act, tmax, small))
+        return sid_active, t_lo, t_hi
+
+    return prelude
+
+
+_PRELUDE = None
+
+
+def run_prelude(entry: _Entry, sid_mask: np.ndarray, lo: int, hi: int):
+    """Exact (filtered ts_min, ts_max, active sids) from cell states —
+    mirrors the host path's `rows.ts.min()/max()` window-math inputs.
+    Memoized per (mask signature, bounds) on the entry."""
+    global _PRELUDE
+    key = (sid_mask.tobytes() if sid_mask is not None else None, lo, hi)
+    hit = entry.prelude.get(key)
+    if hit is not None:
+        return hit
+    if len(entry.prelude) >= 32:
+        entry.prelude.pop(next(iter(entry.prelude)))
+    import jax.numpy as jnp
+
+    if _PRELUDE is None:
+        _PRELUDE = _prelude_program()
+    mask = (jnp.asarray(sid_mask) if sid_mask is not None
+            else jnp.ones((entry.num_series,), bool))
+    act, t_lo, t_hi = _PRELUDE(
+        entry.nrow, entry.tmin, entry.tmax, mask,
+        np.int32(max(lo, -(2**31) + 1)), np.int32(min(hi, 2**31 - 1)),
+    )
+    act = np.asarray(act)
+    t_lo = int(t_lo)
+    t_hi = int(t_hi)
+    if not act.any():
+        out = (act, None, None)
+    else:
+        out = (
+            act,
+            entry.t0c + t_lo * entry.unit,
+            entry.t0c + t_hi * entry.unit,
+        )
+    entry.prelude[key] = out
+    return out
+
+
+# jnp window-combine machinery (device mirror of executor.py's
+# _combine_states/_shift_left/_window_combine/_finalize_window)
+
+def _identity(key, op, jnp):
+    if key == "mn" or (key == "m" and op == "min"):
+        return jnp.inf
+    if key == "mx" or (key == "m" and op == "max"):
+        return -jnp.inf
+    if key == "tl":
+        return _TICK_MIN
+    if key == "tf":
+        return _TICK_MAX
+    return 0.0
+
+
+def _shift_left_j(state: dict, k: int, op, jnp):
+    out = {}
+    for key, v in state.items():
+        pad = jnp.full(v.shape[:1] + (k,), _identity(key, op, jnp), v.dtype)
+        out[key] = jnp.concatenate([v[:, k:], pad], axis=1)
+    return out
+
+
+def _combine_j(op, a: dict, b: dict, jnp):
+    if op == "count":
+        return {"n": a["n"] + b["n"]}
+    if op in ("sum", "mean"):
+        return {"s": a["s"] + b["s"], "n": a["n"] + b["n"]}
+    if op == "min":
+        return {"m": jnp.minimum(a["m"], b["m"]), "n": a["n"] + b["n"]}
+    if op == "max":
+        return {"m": jnp.maximum(a["m"], b["m"]), "n": a["n"] + b["n"]}
+    if op in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+        return {"s": a["s"] + b["s"], "s2": a["s2"] + b["s2"],
+                "n": a["n"] + b["n"]}
+    if op in ("first_value", "last_value"):
+        pick_b_last = b["tl"] > a["tl"]
+        pick_a_first = a["tf"] <= b["tf"]
+        return {
+            "vl": jnp.where(pick_b_last, b["vl"], a["vl"]),
+            "tl": jnp.maximum(a["tl"], b["tl"]),
+            "vf": jnp.where(pick_a_first, a["vf"], b["vf"]),
+            "tf": jnp.minimum(a["tf"], b["tf"]),
+            "n": a["n"] + b["n"],
+        }
+    raise UnsupportedError(op)
+
+
+def _window_combine_j(op, state: dict, w: int, jnp):
+    if w == 1:
+        return state
+    levels = []
+    size = 1
+    cur = state
+    while size < w:
+        nxt = _combine_j(op, cur, _shift_left_j(cur, size, op, jnp), jnp)
+        levels.append((size * 2, nxt))
+        cur = nxt
+        size *= 2
+    tables = {1: state}
+    for sz, st in levels:
+        tables[sz] = st
+    result = None
+    offset = 0
+    remaining = w
+    bit = 1
+    parts = []
+    while remaining:
+        if remaining & bit:
+            parts.append((offset, bit))
+            offset += bit
+            remaining &= ~bit
+        bit <<= 1
+    for off, sz in parts:
+        st = tables[sz]
+        piece = _shift_left_j(st, off, op, jnp) if off else st
+        result = piece if result is None else _combine_j(op, result, piece, jnp)
+    return result
+
+
+def _finalize_j(op, state: dict, jnp):
+    n = state["n"].astype(jnp.float32)
+    present = state["n"] > 0
+    if op == "count":
+        return n, present
+    if op == "sum":
+        return jnp.where(present, state["s"], 0.0), present
+    if op == "mean":
+        return state["s"] / jnp.maximum(n, 1), present
+    if op in ("min", "max"):
+        return jnp.where(present, state["m"], 0.0), present
+    if op in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+        ddof = 1 if op.endswith("_samp") else 0
+        mean = state["s"] / jnp.maximum(n, 1)
+        var = jnp.maximum(state["s2"] / jnp.maximum(n, 1) - mean * mean, 0.0)
+        if ddof:
+            var = var * n / jnp.maximum(n - 1, 1)
+            present = state["n"] > 1
+        if op.startswith("stddev"):
+            return jnp.sqrt(var), present
+        return var, present
+    if op == "last_value":
+        return jnp.where(present, state["vl"], 0.0), present
+    if op == "first_value":
+        return jnp.where(present, state["vf"], 0.0), present
+    raise UnsupportedError(op)
+
+
+def _make_range_program():
+    # spec = (stride, n_steps, g, fold, items) with items a tuple of
+    # (op, w, field_key) — everything shape-determining is static.
+    # dynamic scalars: delta (cache cell of first window's first bucket),
+    # lo/hi absolute cell bounds from WHERE ts.
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("spec",))
+    def program(arrs, gid, sid_mask, delta, lo, hi, *, spec):
+        stride, n_steps, g, fold, nanenc, items = spec
+        vals_out = []
+        pres_out = []
+        nb = next(iter(next(iter(arrs.values())).values())).shape[1]
+        cell_ids = jnp.arange(nb, dtype=jnp.int32)
+        cmask = (cell_ids >= lo) & (cell_ids < hi)
+        for op, w, fkey in items:
+            raw = arrs[fkey]
+            # map build-state keys to combine-state keys
+            state = {}
+            state["n"] = jnp.where(
+                cmask[None, :] & sid_mask[:, None], raw["n"], 0
+            )
+            for bk, ck in (("s", "s"), ("s2", "s2"), ("mn", "m"), ("mx", "m"),
+                           ("vl", "vl"), ("tl", "tl"), ("vf", "vf"),
+                           ("tf", "tf")):
+                if bk in raw and ck in _STATE_COMBINE.get(op, ()):
+                    ident = _identity(bk, op, jnp)
+                    v = raw[bk]
+                    if ck not in ("tl", "tf"):
+                        v = v.astype(jnp.float32)
+                    state[ck] = jnp.where(
+                        cmask[None, :] & sid_mask[:, None], v,
+                        jnp.asarray(ident, v.dtype),
+                    )
+            if fold:
+                state = _fold_groups(op, state, gid, g, jnp)
+            # gather the query's cell window: nb_q cells starting at delta
+            nb_q = (n_steps - 1) * stride + w
+            idx = delta + jnp.arange(nb_q, dtype=jnp.int32)
+            okc = (idx >= 0) & (idx < nb)
+            safe = jnp.clip(idx, 0, nb - 1)
+            state = {
+                k: jnp.where(
+                    okc[None, :], v[:, safe],
+                    jnp.asarray(_identity(_ck_to_bk(k, op), op, jnp), v.dtype),
+                )
+                for k, v in state.items()
+            }
+            if w == stride and nb_q == n_steps * w:
+                # disjoint windows: reshape-reduce (the TSBS double-groupby
+                # shape — rides dense reductions, no stride doubling)
+                combined = _disjoint_reduce(op, state, n_steps, w, jnp)
+            else:
+                combined = _window_combine_j(op, state, w, jnp)
+                combined = {
+                    k: jax.lax.slice_in_dim(v, 0, (n_steps - 1) * stride + 1,
+                                            stride, axis=1)
+                    for k, v in combined.items()
+                }
+            v, p = _finalize_j(op, combined, jnp)
+            if nanenc:
+                # presence rides inside the value plane as NaN (data is
+                # known all-finite): halves the result payload
+                v = jnp.where(p, v, jnp.nan)
+            vals_out.append(v.astype(jnp.float32))
+            pres_out.append(p)
+        # ONE output array -> one device->host transfer per query (each
+        # readback is a full round trip on a remote-attached chip)
+        if nanenc:
+            return jnp.stack(vals_out)
+        return jnp.concatenate(
+            [jnp.stack(vals_out), jnp.stack(pres_out).astype(jnp.float32)],
+            axis=0,
+        )
+
+    def _fold_groups(op, state, gid, g, jnp):
+        out = {}
+        out["n"] = jax.ops.segment_sum(state["n"], gid, num_segments=g)
+        if "s" in state:
+            out["s"] = jax.ops.segment_sum(state["s"], gid, num_segments=g)
+        if "s2" in state:
+            out["s2"] = jax.ops.segment_sum(state["s2"], gid, num_segments=g)
+        if "m" in state:
+            f = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+            out["m"] = f(state["m"], gid, num_segments=g)
+        if "tl" in state:
+            tl = jax.ops.segment_max(state["tl"], gid, num_segments=g)
+            cand = jnp.where(state["tl"] == tl[gid], state["vl"], -jnp.inf)
+            out["vl"] = jax.ops.segment_max(cand, gid, num_segments=g)
+            out["tl"] = tl
+        if "tf" in state:
+            tf = jax.ops.segment_min(state["tf"], gid, num_segments=g)
+            cand = jnp.where(state["tf"] == tf[gid], state["vf"], -jnp.inf)
+            out["vf"] = jax.ops.segment_max(cand, gid, num_segments=g)
+            out["tf"] = tf
+        return out
+
+    def _disjoint_reduce(op, state, n_steps, w, jnp):
+        out = {}
+        for k, v in state.items():
+            r = v.reshape(v.shape[0], n_steps, w)
+            if k in ("n", "s", "s2"):
+                out[k] = r.sum(axis=2)
+            elif k == "m":
+                out[k] = (r.min(axis=2) if op == "min" else r.max(axis=2))
+            elif k == "tl":
+                out[k] = r.max(axis=2)
+            elif k == "tf":
+                out[k] = r.min(axis=2)
+            elif k == "vl":
+                tl = state["tl"].reshape(r.shape)
+                tlm = tl.max(axis=2, keepdims=True)
+                out[k] = jnp.where(tl == tlm, r, -jnp.inf).max(axis=2)
+            elif k == "vf":
+                tf = state["tf"].reshape(r.shape)
+                tfm = tf.min(axis=2, keepdims=True)
+                out[k] = jnp.where(tf == tfm, r, -jnp.inf).max(axis=2)
+        return out
+
+    return program
+
+
+_STATE_COMBINE = {
+    "count": (),
+    "sum": ("s",), "mean": ("s",),
+    "min": ("m",), "max": ("m",),
+    "var_pop": ("s", "s2"), "var_samp": ("s", "s2"),
+    "stddev_pop": ("s", "s2"), "stddev_samp": ("s", "s2"),
+    "first_value": ("vl", "tl", "vf", "tf"),
+    "last_value": ("vl", "tl", "vf", "tf"),
+}
+
+
+def _ck_to_bk(ck: str, op: str) -> str:
+    if ck == "m":
+        return "mn" if op == "min" else "mx"
+    return ck
+
+
+_PROGRAM = None
+
+
+def get_program():
+    global _PROGRAM
+    if _PROGRAM is None:
+        _PROGRAM = _make_range_program()
+    return _PROGRAM
+
+
+# ----------------------------------------------------------------------
+# orchestration
+# ----------------------------------------------------------------------
+
+def _group_ids_from_sids(plan, registry, active: np.ndarray):
+    """Per-sid group ids over the entry's series space. Returns
+    (gid_full (S,) int32 with inactive sids routed past g, g, key_cols).
+    Mirrors executor.QueryEngine._group_ids but derives groups from sids
+    instead of rows (same decoded key values, possibly different group
+    order — assembly sorts deterministically)."""
+    from greptimedb_tpu.query.expr import Col
+
+    S = len(active)
+    act_idx = np.nonzero(active)[0]
+    if not plan.keys:
+        gid_full = np.full(S, 1, np.int32)
+        gid_full[act_idx] = 0
+        return gid_full, 1, {}
+    code_cols = []
+    vocabs = []
+    cards = []
+    for k in plan.keys:
+        name = k.expr.name
+        codes = registry.tag_codes(name).astype(np.int64)
+        vocab = np.asarray(
+            registry.dicts[registry.tag_names.index(name)].values,
+            dtype=object,
+        )
+        code_cols.append(codes)
+        vocabs.append(vocab)
+        cards.append(max(len(vocab), 1))
+    combined = code_cols[0].copy()
+    for codes, card in zip(code_cols[1:], cards[1:]):
+        combined = combined * card + codes
+    uniq, inv = np.unique(combined[act_idx], return_inverse=True)
+    g = len(uniq)
+    gid_full = np.full(S, g, np.int32)
+    gid_full[act_idx] = inv.astype(np.int32)
+    key_cols = {}
+    rem = uniq
+    for i in range(len(code_cols) - 1, -1, -1):
+        card = cards[i]
+        code_i = rem % card
+        rem = rem // card
+        key_cols[plan.keys[i].key] = Col(vocabs[i][code_i])
+    return gid_full, g, key_cols
+
+
+def execute_range_device(engine, plan, table):
+    """Try to run a RANGE plan on the device grid cache. Returns a
+    QueryResult, or None to fall back to the host path."""
+    items = plan_lowering(plan, table)
+    if items is None:
+        return None
+    prefer = engine.prefer_device
+    if prefer is False:
+        return None
+    if prefer is None and table.row_count() < DEVICE_THRESHOLD:
+        return None
+
+    import jax.numpy as jnp
+
+    align = plan.align_ms
+    if align is None or align <= 0:
+        return None
+    r0 = align
+    for it in plan.range_items:
+        r0 = math.gcd(r0, it.range_ms)
+
+    version = table.data_version()
+    cache: DeviceRangeCache = engine.range_cache
+    tkey = (table.info.database, table.info.name, id(table))
+    entry = cache.lookup_compatible(tkey, version, r0, plan.align_to)
+    if entry is None:
+        entry = build_entry(plan, table, items)
+        if entry is None:
+            return None
+        cache.insert((tkey, entry.res, entry.phase), entry)
+    else:
+        if not ensure_states(entry, plan, table, items):
+            return None
+
+    res = entry.res
+    # WHERE ts bounds must land on cell edges or partials can't honor them
+    s = plan.scan
+    if s.ts_min is not None and (s.ts_min - entry.t0c) % res != 0:
+        return None
+    if s.ts_max is not None and (s.ts_max + 1 - entry.t0c) % res != 0:
+        return None
+    lo = ((s.ts_min - entry.t0c) // res if s.ts_min is not None
+          else -(2**31) + 1)
+    hi = ((s.ts_max + 1 - entry.t0c) // res if s.ts_max is not None
+          else 2**31 - 1)
+
+    names = [nm for _, nm in plan.post_items]
+    empty = engine._empty_result(names)
+    sid_mask = None
+    if s.matchers:
+        sids = entry.registry.match_sids(s.matchers)
+        if len(sids) == 0:
+            return empty
+        sid_mask = np.zeros(entry.num_series, bool)
+        sid_mask[sids] = True
+
+    active, ts_min_f, ts_max_f = run_prelude(entry, sid_mask, lo, hi)
+    if ts_min_f is None:
+        return empty
+
+    # window math — identical to the host path (executor._execute_range)
+    align_to = plan.align_to % align if plan.align_to else 0
+    max_range = max(r.range_ms for r in plan.range_items)
+    j_first = -((-(ts_min_f - max_range + 1 - align_to)) // align)
+    j_last = (ts_max_f - align_to) // align
+    n_steps = int(j_last - j_first + 1)
+    if n_steps <= 0:
+        return empty
+    stride = align // res
+    t0q = align_to + j_first * align
+    delta = (t0q - entry.t0c) // res
+    lo_c = max(lo, -(2**31) + 1)
+    hi_c = min(hi, 2**31 - 1)
+
+    memo_key = (
+        sid_mask.tobytes() if sid_mask is not None else None,
+        tuple(k.expr.name for k in plan.keys),
+        delta, lo_c, hi_c,
+    )
+    memo = entry.query_memo.get(memo_key)
+    if memo is None:
+        gid_full, g, key_cols = _group_ids_from_sids(
+            plan, entry.registry, active
+        )
+        fold = not (g == entry.num_series
+                    and np.array_equal(gid_full,
+                                       np.arange(entry.num_series)))
+        dmask = (jnp.asarray(sid_mask & active) if sid_mask is not None
+                 else jnp.asarray(active))
+        memo = {
+            "gid": jnp.asarray(gid_full), "mask": dmask, "g": g,
+            "key_cols": key_cols, "fold": fold,
+            "delta": jnp.int32(delta), "lo": jnp.int32(lo_c),
+            "hi": jnp.int32(hi_c),
+        }
+        if len(entry.query_memo) >= 32:
+            entry.query_memo.pop(next(iter(entry.query_memo)))
+        entry.query_memo[memo_key] = memo
+    g = memo["g"]
+    key_cols = memo["key_cols"]
+    for item in plan.range_items:
+        w_i = item.range_ms // res
+        nb_i = (n_steps - 1) * (align // res) + w_i
+        if g * nb_i > 256_000_000:
+            return None
+    step_ts = (align_to + (j_first + np.arange(n_steps)) * align).astype(
+        np.int64
+    )
+
+    prog_items = tuple(
+        (op, it.range_ms // res, fname)
+        for (fname, op), it in zip(items, plan.range_items)
+    )
+    arrs = {}
+    for fname, op in items:
+        d = arrs.setdefault(fname, {})
+        for bk in _STATE_KEYS[op]:
+            d[bk] = entry.fields[fname][bk]
+    nanenc = all(
+        entry.nan_ok.get(fname, fname == "__rows__") for fname, _ in items
+    )
+    program = get_program()
+    out = program(
+        arrs, memo["gid"], memo["mask"],
+        memo["delta"], memo["lo"], memo["hi"],
+        spec=(stride, n_steps, g, memo["fold"], nanenc, prog_items),
+    )
+    out = np.asarray(out)
+    n_items = len(plan.range_items)
+    vals = out[:n_items].astype(np.float64)
+    if nanenc:
+        pres = np.empty_like(vals, dtype=bool)
+        for i, (fname, op) in enumerate(items):
+            if op == "count":
+                pres[i] = vals[i] > 0
+            else:
+                pres[i] = np.isfinite(vals[i])
+    else:
+        pres = out[n_items:] > 0.5
+
+    item_vals = {}
+    item_present = {}
+    for i, item in enumerate(plan.range_items):
+        item_vals[item.key] = vals[i]
+        item_present[item.key] = pres[i]
+    return engine._assemble_range_result(
+        plan, table, item_vals, item_present, key_cols, step_ts, g, n_steps,
+    )

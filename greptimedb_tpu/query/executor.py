@@ -174,6 +174,25 @@ def _slice_result(cols: list[Col], idx) -> list[Col]:
     ]
 
 
+def _group_order(key_cols: dict, keys: list[str], g: int) -> np.ndarray:
+    """Permutation sorting groups by their key values (ascending, the
+    default RANGE output order)."""
+    if not keys:
+        return np.arange(g)
+    rank_keys = []
+    for k in reversed(keys):
+        c = key_cols[k]
+        v = c.values
+        if v.dtype == object or v.dtype.kind in ("U", "S"):
+            _, inv = np.unique(v.astype(str), return_inverse=True)
+            rank_keys.append(inv.astype(np.int64))
+        else:
+            rank_keys.append(v)
+        # null keys sort last (ASC default), matching _sort_indices
+        rank_keys.append((~c.valid_mask).astype(np.int8))
+    return np.lexsort(rank_keys)
+
+
 def _distinct_indices(cols: list[Col]) -> np.ndarray:
     if not cols:
         return np.arange(0)
@@ -197,11 +216,23 @@ class QueryEngine:
 
     def __init__(self, *, prefer_device: bool | None = None):
         self.prefer_device = prefer_device
+        from greptimedb_tpu.query.device_range import DeviceRangeCache
+
+        self.range_cache = DeviceRangeCache()
+        self.last_exec_path = "host"  # observability: host | device
 
     # ------------------------------------------------------------------
     def execute(self, plan: SelectPlan, table) -> QueryResult:
         if table is None:
             return self._execute_tableless(plan)
+        self.last_exec_path = "host"
+        if plan.kind == "range":
+            from greptimedb_tpu.query import device_range
+
+            res = device_range.execute_range_device(self, plan, table)
+            if res is not None:
+                self.last_exec_path = "device"
+                return res
         src = self._scan(plan, table)
         if plan.kind == "plain":
             return self._execute_plain(plan, src, table)
@@ -210,6 +241,9 @@ class QueryEngine:
         if plan.kind == "range":
             return self._execute_range(plan, src, table)
         raise PlanError(f"unknown plan kind: {plan.kind}")
+
+    def _empty_result(self, names: list[str]) -> QueryResult:
+        return QueryResult(names, [Col(np.zeros(0)) for _ in names])
 
     # ------------------------------------------------------------------
     def _scan(self, plan: SelectPlan, table) -> RowsSource:
@@ -340,7 +374,7 @@ class QueryEngine:
         n = src.num_rows
         if n == 0 and plan.keys:
             names = [nm for _, nm in plan.post_items]
-            return QueryResult(names, [Col(np.zeros(0)) for _ in names])
+            return self._empty_result(names)
         if n == 0:
             # global aggregate over empty input: one row
             agg_cols = {}
@@ -435,7 +469,7 @@ class QueryEngine:
         ts_type = table.schema.time_index.data_type
         names = [nm for _, nm in plan.post_items]
         if src.num_rows == 0:
-            return QueryResult(names, [Col(np.zeros(0)) for _ in names])
+            return self._empty_result(names)
         rows = src.rows
         align = plan.align_ms
         if align is None or align <= 0:
@@ -453,7 +487,7 @@ class QueryEngine:
         j_last = (ts_max - align_to) // align
         n_steps = int(j_last - j_first + 1)
         if n_steps <= 0:
-            return QueryResult(names, [Col(np.zeros(0)) for _ in names])
+            return self._empty_result(names)
         for item in plan.range_items:
             # the real allocation is g * nb buckets at res = gcd(align,
             # range) — guard that, not just g * n_steps (a '1h1ms' range
@@ -471,13 +505,30 @@ class QueryEngine:
 
         item_vals = {}
         item_present = {}
-        any_present = np.zeros((g, n_steps), dtype=bool)
         for item in plan.range_items:
             vals, present = self._range_item(
                 item, src, gid, g, ts, align, align_to, j_first, n_steps,
             )
+            item_vals[item.key] = vals
+            item_present[item.key] = present
+        return self._assemble_range_result(
+            plan, table, item_vals, item_present, key_cols, step_ts,
+            g, n_steps,
+        )
+
+    def _assemble_range_result(self, plan, table, item_vals, item_present,
+                               key_cols, step_ts, g, n_steps) -> QueryResult:
+        """Fill + output assembly over (g, n_steps) per-item grids — shared
+        by the host path and the device grid-cache path
+        (query/device_range.py)."""
+        ts_type = table.schema.time_index.data_type
+        names = [nm for _, nm in plan.post_items]
+        any_present = np.zeros((g, n_steps), dtype=bool)
+        for item in plan.range_items:
             fill = item.fill if item.fill is not None else plan.fill
-            vals, present = _apply_fill(vals, present, fill, step_ts)
+            vals, present = _apply_fill(
+                item_vals[item.key], item_present[item.key], fill, step_ts
+            )
             item_vals[item.key] = vals
             item_present[item.key] = present
             any_present |= present
@@ -490,7 +541,16 @@ class QueryEngine:
             cell_mask = np.ones((g, n_steps), dtype=bool)
         else:
             cell_mask = any_present
-        gidx, sidx = np.nonzero(cell_mask)
+        if not plan.order_by:
+            # construct rows already in the default (ts, group keys) order:
+            # rank groups once (g keys, not g*steps rows), then emit
+            # ts-major — skips the output sort entirely
+            perm = _group_order(key_cols, [k.key for k in plan.keys], g)
+            nz_s, nz_g = np.nonzero(cell_mask[perm].T)
+            gidx = perm[nz_g]
+            sidx = nz_s
+        else:
+            gidx, sidx = np.nonzero(cell_mask)
 
         out_cols: dict[str, Col] = {}
         out_cols["__ts"] = Col(step_ts[sidx])
@@ -526,13 +586,7 @@ class QueryEngine:
             nrows = len(didx)
             gsrc = DictSource(out_cols, nrows)
         if not plan.order_by:
-            # deterministic default order: (ts, group keys)
-            order_cols = [out_cols["__ts"]] + [
-                out_cols[k.key] for k in plan.keys
-            ]
-            idx = _sort_indices(order_cols, [True] * len(order_cols),
-                                [None] * len(order_cols))
-            cols = _slice_result(cols, idx)
+            # rows were constructed in (ts, group keys) order already
             off = plan.offset or 0
             if off or plan.limit is not None:
                 end = None if plan.limit is None else off + plan.limit

@@ -540,10 +540,13 @@ class Parser:
         by = None
         if self.eat_kw("BY"):
             self.expect_op("(")
-            by = [self.expr()]
-            while self.eat_op(","):
-                by.append(self.expr())
-            self.expect_op(")")
+            # BY () = one global group (reference range_select semantics)
+            by = []
+            if not self.eat_op(")"):
+                by = [self.expr()]
+                while self.eat_op(","):
+                    by.append(self.expr())
+                self.expect_op(")")
         fill = None
         if self.eat_kw("FILL"):
             fill = self.next().text.lower()

@@ -95,9 +95,19 @@ class Region:
                                  window_ms=meta.options.memtable_window_ms)
         self._frozen: list[Memtable] = []
         self._seq = self.manifest.state.committed_sequence
+        self._truncate_epoch = 0
         self._lock = threading.RLock()
         self.writable = True
         self._replay()
+
+    @property
+    def data_version(self) -> tuple[int, int]:
+        """Monotonic logical-data version: bumps with every write (sequence)
+        and every truncate. Device caches key on this to know when a region's
+        row set changed (the page-cache-invalidation analog of the
+        reference's memtable/SST version in
+        /root/reference/src/mito2/src/region/version.rs)."""
+        return (self._seq, self._truncate_epoch)
 
     # ------------------------------------------------------------------
     # write path
@@ -279,6 +289,7 @@ class Region:
     # ------------------------------------------------------------------
     def truncate(self):
         with self._lock:
+            self._truncate_epoch += 1
             entry_id = self.wal.next_entry_id - 1
             self.memtable = Memtable(
                 self.meta.field_names,
