@@ -160,6 +160,12 @@ def main():
             "value": round(adj, 3),
             "unit": "ms",
             "vs_baseline": round(BASELINE_MS / adj, 2),
+            # auditability (ADVICE r2): raw end-to-end wall including the
+            # dev-tunnel RTT/readback, and the measured no-compute floor
+            "raw_wall_ms_median": round(med_wall, 3),
+            "tunnel_floor_ms_median": round(
+                sorted(floor)[len(floor) // 2], 3
+            ),
         }))
         inst.close()
     finally:

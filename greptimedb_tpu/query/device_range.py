@@ -48,12 +48,13 @@ from greptimedb_tpu.sql import ast as A
 
 DEVICE_THRESHOLD = 262_144       # min table rows before the cache pays off
 _CELL_CAP = 256 * 1024 * 1024    # max S*NB cells per cached array (1GB f32)
-_MAX_ENTRIES = 4                 # LRU cap across all tables
+_MAX_ENTRIES = 8                 # LRU entry-count cap across all tables
+_BYTE_BUDGET = 4 * 1024**3       # LRU byte cap across all cached entries
 
-# first/last timestamps ride as int32 ticks (exact; f32 would collapse
-# ticks above 2^24 into ties and pick wrong rows)
-_TICK_MIN = -(2**31) + 2
-_TICK_MAX = 2**31 - 2
+# Timestamps ride as exact (cell index, intra-cell ms offset) int32 pairs:
+# cell < nb <= _CELL_CAP and intra < res < 2^31, so both halves are exact
+# where a single int32/f32 tick would lose precision on long spans.
+_I32_MAX = 2**31 - 1
 
 _DEVICE_RANGE_OPS = {
     "count", "sum", "mean", "min", "max",
@@ -75,8 +76,9 @@ _STATE_KEYS = {
     # first/last carry both directions: the window combine picks winners
     # from either half, so it needs all four arrays regardless of which op
     # the query asked for (mirrors executor.py _bucket_partials).
-    "first_value": ("vf", "tf", "vl", "tl", "n"),
-    "last_value": ("vf", "tf", "vl", "tl", "n"),
+    # "if"/"il" are the intra-cell ms offsets of the first/last row.
+    "first_value": ("vf", "if", "vl", "il", "n"),
+    "last_value": ("vf", "if", "vl", "il", "n"),
 }
 
 
@@ -87,7 +89,6 @@ class _Entry:
     phase: int                   # cell boundary phase: boundaries ≡ phase (mod res)
     t0c: int                     # absolute ms of cell 0's left edge
     nb: int                      # number of cells
-    unit: int                    # device tick size in ms
     num_series: int
     registry: object             # SeriesRegistry of the building scan
     rows_scanned: int
@@ -99,8 +100,8 @@ class _Entry:
     nan_ok: dict = dc_field(default_factory=dict)
     # field-independent: row presence / per-cell ts extremes (device)
     nrow: object = None          # (S, NB) int32 rows per cell (all rows)
-    tmin: object = None         # (S, NB) int32 ticks, +big when empty
-    tmax: object = None         # (S, NB) int32 ticks, -big when empty
+    imin: object = None          # (S, NB) int32 intra-cell offset of min ts
+    imax: object = None          # (S, NB) int32 intra-cell offset of max ts
     # memoized prelude results keyed by (matcher_sig, lo, hi)
     prelude: dict = dc_field(default_factory=dict)
     # memoized per-query-shape device args + group decode (steady-state
@@ -109,16 +110,25 @@ class _Entry:
 
     def bytes(self) -> int:
         per = self.num_series * self.nb * 4
-        n_arr = 3 + sum(len(d) for d in self.fields.values())
+        # "__rows__" aliases entry.nrow (already in the 3 base arrays)
+        n_arr = 3 + sum(
+            len(d) for f, d in self.fields.items() if f != "__rows__"
+        )
         return per * n_arr
 
 
 class DeviceRangeCache:
-    """LRU of device grid entries, shared by a QueryEngine."""
+    """LRU of device grid entries, shared by a QueryEngine.
 
-    def __init__(self):
+    Budgeted two ways: entry count (_MAX_ENTRIES) and total device bytes
+    across entries (_BYTE_BUDGET) — an entry holds 3 + sum-of-field-state
+    arrays, so byte accounting (entry.bytes()), not array-element caps,
+    bounds HBM use."""
+
+    def __init__(self, byte_budget: int = _BYTE_BUDGET):
         self._entries: dict[tuple, _Entry] = {}
         self._lock = threading.Lock()
+        self.byte_budget = byte_budget
 
     def lookup_compatible(self, tkey, version, r0: int, align_to: int
                           ) -> _Entry | None:
@@ -142,9 +152,19 @@ class DeviceRangeCache:
     def insert(self, key: tuple, entry: _Entry):
         with self._lock:
             self._entries.pop(key, None)
-            while len(self._entries) >= _MAX_ENTRIES:
-                self._entries.pop(next(iter(self._entries)))
+            total = sum(e.bytes() for e in self._entries.values())
+            total += entry.bytes()
+            while self._entries and (
+                len(self._entries) >= _MAX_ENTRIES
+                or total > self.byte_budget
+            ):
+                victim = self._entries.pop(next(iter(self._entries)))
+                total -= victim.bytes()
             self._entries[key] = entry
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(e.bytes() for e in self._entries.values())
 
     def clear(self):
         with self._lock:
@@ -250,7 +270,8 @@ def _series_pad(s: int, mesh) -> int:
     return -(-s // n) * n
 
 
-def build_entry(plan, table, items, mesh=None) -> _Entry | None:
+def build_entry(plan, table, items, mesh=None,
+                byte_budget: int = _BYTE_BUDGET) -> _Entry | None:
     """Scan the table once and build the device cell-state grids."""
     import jax.numpy as jnp
 
@@ -277,7 +298,9 @@ def build_entry(plan, table, items, mesh=None) -> _Entry | None:
     S = max(data.registry.num_series, int(sid.max()) + 1 if len(sid) else 1)
     S = _series_pad(S, mesh)
     res = _pick_res(plan, ts, S)
-    if res is None:
+    if res is None or res >= _I32_MAX:
+        # res >= 2^31 ms (~25-day cells) would overflow the exact int32
+        # intra-cell offsets; such queries fall back to the host path.
         return None
     phase = plan.align_to % res
     data_min = int(ts.min())
@@ -286,19 +309,20 @@ def build_entry(plan, table, items, mesh=None) -> _Entry | None:
     nb = (data_max - t0c) // res + 1
     if S * nb > _CELL_CAP:
         return None
-    span = nb * res
-    unit = 1
-    while span // unit >= 2**31 - 1:
-        unit *= 2
+    # projected device bytes for the full entry must fit the cache budget
+    n_arr = 3 + sum(len(k) for k in needed.values())
+    if S * nb * 4 * n_arr > byte_budget:
+        return None
 
     cell = (ts - t0c) // res
     seg = sid.astype(np.int64) * nb + cell
     nseg = S * nb
-    tick = ((ts - t0c) // unit).astype(np.int64)
+    # exact intra-cell ms offset (0 <= intra < res < 2^31)
+    intra = (ts - t0c - cell * res).astype(np.int64)
 
     entry = _Entry(
         version=version, res=res, phase=phase, t0c=t0c, nb=nb,
-        unit=unit, num_series=S, registry=data.registry,
+        num_series=S, registry=data.registry,
         rows_scanned=len(rows),
     )
     entry.mesh = mesh
@@ -315,12 +339,12 @@ def build_entry(plan, table, items, mesh=None) -> _Entry | None:
     starts = np.nonzero(change)[0]
     ends = np.r_[starts[1:], len(seg)] - 1
     useg = seg[starts]
-    tmin = np.full(nseg, np.iinfo(np.int32).max, np.int64)
-    tmax = np.full(nseg, np.iinfo(np.int32).min, np.int64)
-    tmin[useg] = tick[starts]
-    tmax[useg] = tick[ends]
-    entry.tmin = put2(tmin.reshape(shape).astype(np.int32))
-    entry.tmax = put2(tmax.reshape(shape).astype(np.int32))
+    imin = np.zeros(nseg, np.int64)
+    imax = np.zeros(nseg, np.int64)
+    imin[useg] = intra[starts]
+    imax[useg] = intra[ends]
+    entry.imin = put2(imin.reshape(shape).astype(np.int32))
+    entry.imax = put2(imax.reshape(shape).astype(np.int32))
 
     for fname, keys in needed.items():
         vals = rows.fields[fname]
@@ -334,7 +358,7 @@ def build_entry(plan, table, items, mesh=None) -> _Entry | None:
         else:
             valid = np.ones(len(vals), bool)
         states, nan_ok = _build_field_states(
-            keys, vals, valid, seg, nseg, tick, shape, put2
+            keys, vals, valid, seg, nseg, intra, shape, put2
         )
         entry.fields[fname] = states
         entry.nan_ok[fname] = nan_ok
@@ -342,7 +366,7 @@ def build_entry(plan, table, items, mesh=None) -> _Entry | None:
     return entry
 
 
-def _build_field_states(keys, vals, valid, seg, nseg, tick, shape, put):
+def _build_field_states(keys, vals, valid, seg, nseg, intra, shape, put):
     out = {}
     all_valid = valid.all()
     vm = vals if all_valid else np.where(valid, vals, 0.0)
@@ -360,10 +384,10 @@ def _build_field_states(keys, vals, valid, seg, nseg, tick, shape, put):
         )
         nan_ok = nan_ok and bool(np.isfinite(s2).all())
         out["s2"] = put(s2.reshape(shape))
-    if keys & {"mn", "mx", "vf", "tf", "vl", "tl"}:
+    if keys & {"mn", "mx", "vf", "if", "vl", "il"}:
         segf = seg if all_valid else seg[valid]
         vf_ = vals if all_valid else vals[valid]
-        tickf = tick if all_valid else tick[valid]
+        intraf = intra if all_valid else intra[valid]
         change = np.empty(len(segf), bool)
         if len(segf):
             change[0] = True
@@ -383,18 +407,18 @@ def _build_field_states(keys, vals, valid, seg, nseg, tick, shape, put):
             out["mx"] = put(arr.reshape(shape).astype(np.float32))
         if "vf" in keys:
             arr = np.zeros(nseg)
-            t = np.full(nseg, _TICK_MAX, np.int64)
+            t = np.zeros(nseg, np.int64)
             arr[useg] = vf_[starts]
-            t[useg] = tickf[starts]
+            t[useg] = intraf[starts]
             out["vf"] = put(arr.reshape(shape).astype(np.float32))
-            out["tf"] = put(t.reshape(shape).astype(np.int32))
+            out["if"] = put(t.reshape(shape).astype(np.int32))
         if "vl" in keys:
             arr = np.zeros(nseg)
-            t = np.full(nseg, _TICK_MIN, np.int64)
+            t = np.zeros(nseg, np.int64)
             arr[useg] = vf_[ends]
-            t[useg] = tickf[ends]
+            t[useg] = intraf[ends]
             out["vl"] = put(arr.reshape(shape).astype(np.float32))
-            out["tl"] = put(t.reshape(shape).astype(np.int32))
+            out["il"] = put(t.reshape(shape).astype(np.int32))
     return out, nan_ok
 
 
@@ -403,7 +427,8 @@ def _ensure_rows_pseudo(entry, items, jnp):
         entry.fields.setdefault("__rows__", {})["n"] = entry.nrow
 
 
-def ensure_states(entry: _Entry, plan, table, items) -> bool:
+def ensure_states(entry: _Entry, plan, table, items,
+                  byte_budget: int = _BYTE_BUDGET) -> bool:
     """Add any state arrays a new query needs that the entry lacks (same
     resolution/phase, different ops). Returns False if a rescan failed."""
     import jax.numpy as jnp
@@ -421,7 +446,19 @@ def ensure_states(entry: _Entry, plan, table, items) -> bool:
             missing.setdefault(fname, set()).update(want)
     if not missing:
         return True
+    # growing the entry in place must respect the same HBM budget that
+    # gated its construction
+    add = entry.num_series * entry.nb * 4 * sum(
+        len(k | {"n"}) for k in missing.values()
+    )
+    if entry.bytes() + add > byte_budget:
+        return False
     data = table.scan(field_names=sorted(missing))
+    if table.data_version() != entry.version:
+        # a write raced the rescan: the new states would include rows the
+        # old states lack — refuse the mixed entry (caller falls back; the
+        # next query rebuilds against the new version)
+        return False
     rows = data.rows
     if rows is None:
         return False
@@ -436,7 +473,7 @@ def ensure_states(entry: _Entry, plan, table, items) -> bool:
     if len(cell) and (cell.min() < 0 or cell.max() >= entry.nb
                       or sid.max() >= entry.num_series):
         return False  # data changed shape under us; caller re-validates
-    tick = ((ts - entry.t0c) // entry.unit).astype(np.int64)
+    intra = (ts - entry.t0c - cell * entry.res).astype(np.int64)
     shape = (entry.num_series, entry.nb)
     for fname, keys in missing.items():
         vals = rows.fields[fname]
@@ -449,7 +486,7 @@ def ensure_states(entry: _Entry, plan, table, items) -> bool:
         put2, _ = _make_put(getattr(entry, "mesh", None))
         states, nan_ok = _build_field_states(
             keys | {"n"}, vals.astype(np.float64, copy=False), valid,
-            seg, nseg, tick, shape, put2,
+            seg, nseg, intra, shape, put2,
         )
         entry.fields.setdefault(fname, {}).update(states)
         entry.nan_ok[fname] = entry.nan_ok.get(fname, True) and nan_ok
@@ -465,23 +502,33 @@ def _prelude_program():
     import jax.numpy as jnp
 
     @jax.jit
-    def prelude(nrow, tmin, tmax, sid_mask, lo, hi):
+    def prelude(nrow, imin, imax, sid_mask, lo, hi):
         nb = nrow.shape[1]
-        cmask = (jnp.arange(nb, dtype=jnp.int32) >= lo) & (
-            jnp.arange(nb, dtype=jnp.int32) < hi
-        )
+        cells = jnp.arange(nb, dtype=jnp.int32)
+        cmask = (cells >= lo) & (cells < hi)
         act = (nrow > 0) & cmask[None, :] & sid_mask[:, None]
         sid_active = jnp.any(act, axis=1)
-        big = jnp.int32(np.iinfo(np.int32).max)
-        small = jnp.int32(np.iinfo(np.int32).min)
-        t_lo = jnp.min(jnp.where(act, tmin, big))
-        t_hi = jnp.max(jnp.where(act, tmax, small))
-        return sid_active, t_lo, t_hi
+        colact = jnp.any(act, axis=0)
+        big = jnp.int32(_I32_MAX)
+        # global min ts lives in the first active cell (cells are
+        # time-ordered), global max in the last: two exact int32 stages
+        c_lo = jnp.min(jnp.where(colact, cells, big))
+        c_hi = jnp.max(jnp.where(colact, cells, -1))
+        i_lo = jnp.min(jnp.where(act & (cells[None, :] == c_lo), imin, big))
+        i_hi = jnp.max(jnp.where(act & (cells[None, :] == c_hi), imax, -1))
+        return sid_active, c_lo, i_lo, c_hi, i_hi
 
     return prelude
 
 
 _PRELUDE = None
+
+
+def _clamp_i32(v: int) -> int:
+    """Cell bounds from WHERE ts can land arbitrarily far outside the
+    grid; clamping both directions is lossless (comparisons only see
+    cells in [0, nb))."""
+    return max(-(2**31) + 1, min(int(v), 2**31 - 1))
 
 
 def run_prelude(entry: _Entry, sid_mask: np.ndarray, lo: int, hi: int):
@@ -501,20 +548,18 @@ def run_prelude(entry: _Entry, sid_mask: np.ndarray, lo: int, hi: int):
         _PRELUDE = _prelude_program()
     mask = (jnp.asarray(sid_mask) if sid_mask is not None
             else jnp.ones((entry.num_series,), bool))
-    act, t_lo, t_hi = _PRELUDE(
-        entry.nrow, entry.tmin, entry.tmax, mask,
-        np.int32(max(lo, -(2**31) + 1)), np.int32(min(hi, 2**31 - 1)),
+    act, c_lo, i_lo, c_hi, i_hi = _PRELUDE(
+        entry.nrow, entry.imin, entry.imax, mask,
+        np.int32(_clamp_i32(lo)), np.int32(_clamp_i32(hi)),
     )
     act = np.asarray(act)
-    t_lo = int(t_lo)
-    t_hi = int(t_hi)
     if not act.any():
         out = (act, None, None)
     else:
         out = (
             act,
-            entry.t0c + t_lo * entry.unit,
-            entry.t0c + t_hi * entry.unit,
+            entry.t0c + int(c_lo) * entry.res + int(i_lo),
+            entry.t0c + int(c_hi) * entry.res + int(i_hi),
         )
     entry.prelude[key] = out
     return out
@@ -528,10 +573,12 @@ def _identity(key, op, jnp):
         return jnp.inf
     if key == "mx" or (key == "m" and op == "max"):
         return -jnp.inf
-    if key == "tl":
-        return _TICK_MIN
-    if key == "tf":
-        return _TICK_MAX
+    if key == "cl":
+        return -1          # "no cell": loses every last-cell max
+    if key == "cf":
+        return _I32_MAX    # "no cell": loses every first-cell min
+    if key in ("il", "if"):
+        return 0           # intra offsets are tie-broken under cl/cf
     return 0.0
 
 
@@ -556,13 +603,22 @@ def _combine_j(op, a: dict, b: dict, jnp):
         return {"s": a["s"] + b["s"], "s2": a["s2"] + b["s2"],
                 "n": a["n"] + b["n"]}
     if op in ("first_value", "last_value"):
-        pick_b_last = b["tl"] > a["tl"]
-        pick_a_first = a["tf"] <= b["tf"]
+        # exact (cell, intra) lexicographic timestamp compare; within one
+        # combine a and b come from distinct cells, so cl/cf ties only
+        # happen between empty halves (where the value is irrelevant)
+        pick_b_last = (b["cl"] > a["cl"]) | (
+            (b["cl"] == a["cl"]) & (b["il"] > a["il"])
+        )
+        pick_a_first = (a["cf"] < b["cf"]) | (
+            (a["cf"] == b["cf"]) & (a["if"] <= b["if"])
+        )
         return {
             "vl": jnp.where(pick_b_last, b["vl"], a["vl"]),
-            "tl": jnp.maximum(a["tl"], b["tl"]),
+            "il": jnp.where(pick_b_last, b["il"], a["il"]),
+            "cl": jnp.maximum(a["cl"], b["cl"]),
             "vf": jnp.where(pick_a_first, a["vf"], b["vf"]),
-            "tf": jnp.minimum(a["tf"], b["tf"]),
+            "if": jnp.where(pick_a_first, a["if"], b["if"]),
+            "cf": jnp.minimum(a["cf"], b["cf"]),
             "n": a["n"] + b["n"],
         }
     raise UnsupportedError(op)
@@ -652,12 +708,12 @@ def _make_range_program():
                 cmask[None, :] & sid_mask[:, None], raw["n"], 0
             )
             for bk, ck in (("s", "s"), ("s2", "s2"), ("mn", "m"), ("mx", "m"),
-                           ("vl", "vl"), ("tl", "tl"), ("vf", "vf"),
-                           ("tf", "tf")):
+                           ("vl", "vl"), ("il", "il"), ("vf", "vf"),
+                           ("if", "if")):
                 if bk in raw and ck in _STATE_COMBINE.get(op, ()):
                     ident = _identity(bk, op, jnp)
                     v = raw[bk]
-                    if ck not in ("tl", "tf"):
+                    if ck not in ("il", "if"):
                         v = v.astype(jnp.float32)
                     state[ck] = jnp.where(
                         cmask[None, :] & sid_mask[:, None], v,
@@ -677,6 +733,13 @@ def _make_range_program():
                 )
                 for k, v in state.items()
             }
+            if op in ("first_value", "last_value"):
+                # cell keys for the lexicographic (cell, intra) ts compare;
+                # window position is monotone in absolute cell index
+                pres = state["n"] > 0
+                pos = jnp.arange(nb_q, dtype=jnp.int32)[None, :]
+                state["cl"] = jnp.where(pres, pos, -1)
+                state["cf"] = jnp.where(pres, pos, _I32_MAX)
             if w == stride and nb_q == n_steps * w:
                 # disjoint windows: reshape-reduce (the TSBS double-groupby
                 # shape — rides dense reductions, no stride doubling)
@@ -714,38 +777,66 @@ def _make_range_program():
         if "m" in state:
             f = jax.ops.segment_min if op == "min" else jax.ops.segment_max
             out["m"] = f(state["m"], gid, num_segments=g)
-        if "tl" in state:
-            tl = jax.ops.segment_max(state["tl"], gid, num_segments=g)
-            cand = jnp.where(state["tl"] == tl[gid], state["vl"], -jnp.inf)
-            out["vl"] = jax.ops.segment_max(cand, gid, num_segments=g)
-            out["tl"] = tl
-        if "tf" in state:
-            tf = jax.ops.segment_min(state["tf"], gid, num_segments=g)
-            cand = jnp.where(state["tf"] == tf[gid], state["vf"], -jnp.inf)
-            out["vf"] = jax.ops.segment_max(cand, gid, num_segments=g)
-            out["tf"] = tf
+        # first/last across sids within one cell: winner = (ts, sid)
+        # lexicographic, matching the host path's deterministic rule
+        # (max ts then max sid for last; min ts then min sid for first).
+        # The winner is unique, so its value is extracted by a masked
+        # segment_sum — exact for any float value incl. ±inf/NaN.
+        def fold_extreme(v_arr, t_arr, pick_max):
+            has = state["n"] > 0
+            sid = jnp.arange(
+                state["n"].shape[0], dtype=jnp.int32
+            )[:, None]
+            seg_ext = jax.ops.segment_max if pick_max else jax.ops.segment_min
+            t_id = -1 if pick_max else _I32_MAX
+            t = jnp.where(has, t_arr, t_id)
+            win_t = seg_ext(t, gid, num_segments=g)
+            tie = has & (t == win_t[gid])
+            sid_w = seg_ext(jnp.where(tie, sid, t_id), gid, num_segments=g)
+            win = tie & (sid == sid_w[gid])
+            v = jax.ops.segment_sum(
+                jnp.where(win, v_arr, 0.0), gid, num_segments=g
+            )
+            return v, jnp.clip(win_t, 0, _I32_MAX - 1)
+
+        if "il" in state:
+            out["vl"], out["il"] = fold_extreme(
+                state["vl"], state["il"], pick_max=True
+            )
+        if "if" in state:
+            out["vf"], out["if"] = fold_extreme(
+                state["vf"], state["if"], pick_max=False
+            )
         return out
 
     def _disjoint_reduce(op, state, n_steps, w, jnp):
         out = {}
+        if op in ("first_value", "last_value"):
+            G = state["n"].shape[0]
+            n_r = state["n"].reshape(G, n_steps, w)
+            has = n_r > 0
+            pos = jnp.arange(w, dtype=jnp.int32)[None, None, :]
+            # cells within a window carry distinct time ranges, so the
+            # last/first present cell is the exact winner (no value ties)
+            am_l = jnp.argmax(jnp.where(has, pos, -1), axis=2, keepdims=True)
+            am_f = jnp.argmin(
+                jnp.where(has, pos, _I32_MAX), axis=2, keepdims=True
+            )
+            for k, v in state.items():
+                r = v.reshape(G, n_steps, w)
+                if k == "n":
+                    out[k] = r.sum(axis=2)
+                elif k in ("vl", "il", "cl"):
+                    out[k] = jnp.take_along_axis(r, am_l, axis=2)[..., 0]
+                elif k in ("vf", "if", "cf"):
+                    out[k] = jnp.take_along_axis(r, am_f, axis=2)[..., 0]
+            return out
         for k, v in state.items():
             r = v.reshape(v.shape[0], n_steps, w)
             if k in ("n", "s", "s2"):
                 out[k] = r.sum(axis=2)
             elif k == "m":
                 out[k] = (r.min(axis=2) if op == "min" else r.max(axis=2))
-            elif k == "tl":
-                out[k] = r.max(axis=2)
-            elif k == "tf":
-                out[k] = r.min(axis=2)
-            elif k == "vl":
-                tl = state["tl"].reshape(r.shape)
-                tlm = tl.max(axis=2, keepdims=True)
-                out[k] = jnp.where(tl == tlm, r, -jnp.inf).max(axis=2)
-            elif k == "vf":
-                tf = state["tf"].reshape(r.shape)
-                tfm = tf.min(axis=2, keepdims=True)
-                out[k] = jnp.where(tf == tfm, r, -jnp.inf).max(axis=2)
         return out
 
     return program
@@ -757,8 +848,8 @@ _STATE_COMBINE = {
     "min": ("m",), "max": ("m",),
     "var_pop": ("s", "s2"), "var_samp": ("s", "s2"),
     "stddev_pop": ("s", "s2"), "stddev_samp": ("s", "s2"),
-    "first_value": ("vl", "tl", "vf", "tf"),
-    "last_value": ("vl", "tl", "vf", "tf"),
+    "first_value": ("vl", "il", "vf", "if"),
+    "last_value": ("vl", "il", "vf", "if"),
 }
 
 
@@ -852,12 +943,14 @@ def execute_range_device(engine, plan, table):
     tkey = (table.info.database, table.info.name, id(table))
     entry = cache.lookup_compatible(tkey, version, r0, plan.align_to)
     if entry is None:
-        entry = build_entry(plan, table, items)
+        entry = build_entry(plan, table, items,
+                            byte_budget=cache.byte_budget)
         if entry is None:
             return None
         cache.insert((tkey, entry.res, entry.phase), entry)
     else:
-        if not ensure_states(entry, plan, table, items):
+        if not ensure_states(entry, plan, table, items,
+                             byte_budget=cache.byte_budget):
             return None
 
     res = entry.res
@@ -897,8 +990,10 @@ def execute_range_device(engine, plan, table):
     stride = align // res
     t0q = align_to + j_first * align
     delta = (t0q - entry.t0c) // res
-    lo_c = max(lo, -(2**31) + 1)
-    hi_c = min(hi, 2**31 - 1)
+    if not (-(2**31) < delta < 2**31):
+        return None  # query window absurdly far from the data grid
+    lo_c = _clamp_i32(lo)
+    hi_c = _clamp_i32(hi)
 
     memo_key = (
         sid_mask.tobytes() if sid_mask is not None else None,

@@ -623,7 +623,9 @@ class QueryEngine:
 
         seg = gid * nb + np.clip(bucket, 0, nb - 1)
         nseg = g * nb
-        state = _bucket_partials(item.op, vals, valid, seg, nseg, ts, item.q)
+        sid = src.rows.sid if src.rows is not None else None
+        state = _bucket_partials(item.op, vals, valid, seg, nseg, ts, item.q,
+                                 sid=sid)
         state = {k: v.reshape(g, nb) for k, v in state.items()}
         combined = _window_combine(item.op, state, w)
         # sample window starts at stride offsets
@@ -636,7 +638,7 @@ class QueryEngine:
 # range window machinery
 # ----------------------------------------------------------------------
 
-def _bucket_partials(op, vals, valid, seg, nseg, ts, q):
+def _bucket_partials(op, vals, valid, seg, nseg, ts, q, *, sid=None):
     """Associative partial state per (group, bucket)."""
     cnt = np.bincount(seg[valid], minlength=nseg).astype(np.float64)
     if op in ("count",):
@@ -657,8 +659,13 @@ def _bucket_partials(op, vals, valid, seg, nseg, ts, q):
         s2 = np.bincount(seg, weights=vm * vm, minlength=nseg)
         return {"s": s, "s2": s2, "n": cnt}
     if op in ("first_value", "last_value"):
+        # deterministic tie-break: (ts, sid) lexicographic — last = max ts
+        # then max sid, first = min ts then min sid. Identical on the
+        # device grid path (device_range._fold_groups), independent of
+        # scan order.
         idx = np.arange(len(seg))
-        order = np.lexsort((idx, ts))
+        tiebreak = sid if sid is not None else idx
+        order = np.lexsort((tiebreak, ts))
         order = order[valid[order]]
         v_last = np.zeros(nseg)
         t_last = np.full(nseg, -(2**62), np.int64)

@@ -125,6 +125,98 @@ def test_device_range_falls_back_on_residual(cpu):
     assert r.num_rows > 0
 
 
+def test_first_last_tiebreak_matches_host(inst, rng):
+    """BY coarser than series + fully aligned timestamps (typical TSBS
+    shape): equal-ts ties must resolve identically on host and device
+    ((ts, sid) lexicographic — ADVICE r2 medium)."""
+    inst.execute_sql(
+        "create table m (ts timestamp time index, host string primary key,"
+        " dc string primary key, x double)"
+    )
+    tab = inst.catalog.table("public", "m")
+    n_hosts, t = 12, 50
+    ts = np.tile(np.arange(t) * 1000, n_hosts).astype(np.int64)  # aligned
+    hosts = np.repeat([f"h{i:02d}" for i in range(n_hosts)], t).astype(object)
+    dcs = np.repeat([f"d{i % 2}" for i in range(n_hosts)], t).astype(object)
+    x = rng.random(n_hosts * t) * 100
+    tab.write({"host": hosts, "dc": dcs}, ts, {"x": x})
+    q = (
+        "SELECT ts, dc, last_value(x) RANGE '10s', first_value(x) "
+        "RANGE '10s' FROM m ALIGN '10s' BY (dc) ORDER BY ts, dc"
+    )
+    inst.query_engine = QueryEngine(prefer_device=False)
+    rh = inst.sql(q)
+    inst.query_engine = QueryEngine(prefer_device=True)
+    rd = inst.sql(q)
+    assert inst.query_engine.last_exec_path == "device"
+    # exact equality at f32 (device value precision): the winning row must
+    # be the same row, not merely a close value
+    for i in range(len(rh.names)):
+        if rh.cols[i].values.dtype != object:
+            np.testing.assert_array_equal(
+                np.asarray(rh.cols[i].values, np.float64).astype(np.float32),
+                np.asarray(rd.cols[i].values, np.float64).astype(np.float32),
+                err_msg=rh.names[i],
+            )
+
+
+def test_long_span_exact(inst, rng):
+    """Spans beyond 2^31 ms stay exact on device: (cell, intra) int32
+    pairs replace the lossy global tick (ADVICE r2 low)."""
+    inst.execute_sql(
+        "create table lng (ts timestamp time index, host string primary key,"
+        " x double)"
+    )
+    tab = inst.catalog.table("public", "lng")
+    # ~50 days at irregular offsets; interval gcd stays 1000ms
+    base = np.arange(200, dtype=np.int64) * (25 * 3600 * 1000) + 13_000
+    ts = np.concatenate([base, base + 1000])
+    hosts = np.asarray(["a"] * 200 + ["b"] * 200, object)
+    x = rng.random(400) * 10
+    tab.write({"host": hosts}, ts, {"x": x})
+    assert ts.max() - ts.min() > 2**31
+    q = (
+        "SELECT ts, last_value(x) RANGE '1d', max(x) RANGE '1d' FROM lng "
+        "ALIGN '1d' BY () ORDER BY ts"
+    )
+    inst.query_engine = QueryEngine(prefer_device=False)
+    rh = inst.sql(q)
+    inst.query_engine = QueryEngine(prefer_device=True)
+    rd = inst.sql(q)
+    assert inst.query_engine.last_exec_path == "device"
+    assert rh.num_rows == rd.num_rows
+    for i in range(len(rh.names)):
+        np.testing.assert_allclose(
+            np.asarray(rh.cols[i].values, float),
+            np.asarray(rd.cols[i].values, float), rtol=1e-6,
+            err_msg=rh.names[i],
+        )
+
+
+def test_where_ts_far_outside_grid(inst, rng):
+    """Cell-aligned WHERE ts bounds billions of cells away from the grid
+    must not overflow the int32 device scalars."""
+    inst.execute_sql(
+        "create table tiny (ts timestamp time index, host string "
+        "primary key, x double)"
+    )
+    tab = inst.catalog.table("public", "tiny")
+    ts = np.arange(2000, dtype=np.int64)  # 1ms interval -> res=1ms
+    tab.write({"host": np.asarray(["a"] * 2000, object)}, ts,
+              {"x": rng.random(2000)})
+    inst.query_engine = QueryEngine(prefer_device=True)
+    r = inst.sql(
+        "SELECT ts, max(x) RANGE '1s' FROM tiny WHERE ts >= 6000000000 "
+        "ALIGN '1s' BY ()"
+    )
+    assert r.num_rows == 0
+    r = inst.sql(
+        "SELECT ts, max(x) RANGE '1s' FROM tiny WHERE ts < 6000000000 "
+        "ALIGN '1s' BY () ORDER BY ts"
+    )
+    assert r.num_rows == 2
+
+
 def test_device_range_empty_matcher(cpu):
     inst = cpu
     inst.query_engine = QueryEngine(prefer_device=True)
