@@ -73,12 +73,13 @@ class Standalone:
 
     def __init__(self, data_root: str = "./greptimedb_tpu_data", *,
                  engine_config: EngineConfig | None = None,
-                 prefer_device: bool | None = None):
+                 prefer_device: bool | None = None, mesh=None):
         cfg = engine_config or EngineConfig(data_root=data_root,
                                             enable_background=False)
         self.engine = TsdbEngine(cfg)
         self.catalog = CatalogManager(self.engine)
-        self.query_engine = QueryEngine(prefer_device=prefer_device)
+        self.query_engine = QueryEngine(prefer_device=prefer_device,
+                                        mesh=mesh)
         self.flows = None  # wired by flow.FlowManager when enabled
         self._procedures = []
 

@@ -107,14 +107,23 @@ class _Entry:
     # memoized per-query-shape device args + group decode (steady-state
     # queries re-upload nothing)
     query_memo: dict = dc_field(default_factory=dict)
+    # device bytes held; stored (not recomputed) so concurrent readers
+    # never iterate `fields` while a grow mutates it
+    nbytes: int = 0
+    # serializes in-place growth (ensure_states) across query threads
+    grow_lock: object = dc_field(default_factory=threading.Lock)
 
-    def bytes(self) -> int:
+    def recount_bytes(self) -> int:
         per = self.num_series * self.nb * 4
         # "__rows__" aliases entry.nrow (already in the 3 base arrays)
         n_arr = 3 + sum(
             len(d) for f, d in self.fields.items() if f != "__rows__"
         )
-        return per * n_arr
+        self.nbytes = per * n_arr
+        return self.nbytes
+
+    def bytes(self) -> int:
+        return self.nbytes
 
 
 class DeviceRangeCache:
@@ -165,6 +174,23 @@ class DeviceRangeCache:
     def total_bytes(self) -> int:
         with self._lock:
             return sum(e.bytes() for e in self._entries.values())
+
+    def reserve_growth(self, entry: _Entry, add: int) -> bool:
+        """Admit an in-place entry growth of `add` bytes against the
+        AGGREGATE budget, evicting other LRU entries if needed. False ->
+        the growth cannot fit (caller falls back to host)."""
+        with self._lock:
+            if entry.bytes() + add > self.byte_budget:
+                return False
+            total = sum(e.bytes() for e in self._entries.values()) + add
+            for key in list(self._entries):
+                if total <= self.byte_budget:
+                    break
+                if self._entries[key] is entry:
+                    continue
+                victim = self._entries.pop(key)
+                total -= victim.bytes()
+            return total <= self.byte_budget
 
     def clear(self):
         with self._lock:
@@ -363,6 +389,7 @@ def build_entry(plan, table, items, mesh=None,
         entry.fields[fname] = states
         entry.nan_ok[fname] = nan_ok
     _ensure_rows_pseudo(entry, items, jnp)
+    entry.recount_bytes()
     return entry
 
 
@@ -428,13 +455,18 @@ def _ensure_rows_pseudo(entry, items, jnp):
 
 
 def ensure_states(entry: _Entry, plan, table, items,
-                  byte_budget: int = _BYTE_BUDGET) -> bool:
+                  cache: "DeviceRangeCache | None" = None) -> bool:
     """Add any state arrays a new query needs that the entry lacks (same
     resolution/phase, different ops). Returns False if a rescan failed."""
     import jax.numpy as jnp
 
     if table.data_version() != entry.version:
         return False  # racing write; caller falls back / rebuilds later
+    with entry.grow_lock:
+        return _ensure_states_locked(entry, plan, table, items, cache, jnp)
+
+
+def _ensure_states_locked(entry, plan, table, items, cache, jnp) -> bool:
     missing: dict[str, set] = {}
     for fname, op in items:
         if fname == "__rows__":
@@ -446,12 +478,13 @@ def ensure_states(entry: _Entry, plan, table, items,
             missing.setdefault(fname, set()).update(want)
     if not missing:
         return True
-    # growing the entry in place must respect the same HBM budget that
-    # gated its construction
-    add = entry.num_series * entry.nb * 4 * sum(
-        len(k | {"n"}) for k in missing.values()
-    )
-    if entry.bytes() + add > byte_budget:
+    # growing the entry in place must respect the same AGGREGATE HBM
+    # budget that gated its construction
+    add = 0
+    for fname, keys in missing.items():
+        have = set(entry.fields.get(fname, {}))
+        add += entry.num_series * entry.nb * 4 * len((keys | {"n"}) - have)
+    if cache is not None and not cache.reserve_growth(entry, add):
         return False
     data = table.scan(field_names=sorted(missing))
     if table.data_version() != entry.version:
@@ -490,6 +523,7 @@ def ensure_states(entry: _Entry, plan, table, items,
         )
         entry.fields.setdefault(fname, {}).update(states)
         entry.nan_ok[fname] = entry.nan_ok.get(fname, True) and nan_ok
+    entry.recount_bytes()
     return True
 
 
@@ -944,13 +978,13 @@ def execute_range_device(engine, plan, table):
     entry = cache.lookup_compatible(tkey, version, r0, plan.align_to)
     if entry is None:
         entry = build_entry(plan, table, items,
+                            mesh=getattr(engine, "mesh", None),
                             byte_budget=cache.byte_budget)
         if entry is None:
             return None
         cache.insert((tkey, entry.res, entry.phase), entry)
     else:
-        if not ensure_states(entry, plan, table, items,
-                             byte_budget=cache.byte_budget):
+        if not ensure_states(entry, plan, table, items, cache=cache):
             return None
 
     res = entry.res
@@ -1008,10 +1042,11 @@ def execute_range_device(engine, plan, table):
         fold = not (g == entry.num_series
                     and np.array_equal(gid_full,
                                        np.arange(entry.num_series)))
-        dmask = (jnp.asarray(sid_mask & active) if sid_mask is not None
-                 else jnp.asarray(active))
+        _, put1 = _make_put(getattr(entry, "mesh", None))
+        dmask = (put1(sid_mask & active) if sid_mask is not None
+                 else put1(active))
         memo = {
-            "gid": jnp.asarray(gid_full), "mask": dmask, "g": g,
+            "gid": put1(gid_full), "mask": dmask, "g": g,
             "key_cols": key_cols, "fold": fold,
             "delta": jnp.int32(delta), "lo": jnp.int32(lo_c),
             "hi": jnp.int32(hi_c),

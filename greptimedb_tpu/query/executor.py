@@ -212,10 +212,16 @@ def _distinct_indices(cols: list[Col]) -> np.ndarray:
 
 
 class QueryEngine:
-    """Executes SelectPlans against catalog tables."""
+    """Executes SelectPlans against catalog tables.
 
-    def __init__(self, *, prefer_device: bool | None = None):
+    `mesh` (a jax.sharding.Mesh with a "shard" axis) makes the device
+    RANGE path shard its cell-state grids over the series axis; XLA
+    inserts the cross-device collectives for group folds (SURVEY.md §2.7
+    #1-2 — the region-partition + merge-scan analog over ICI)."""
+
+    def __init__(self, *, prefer_device: bool | None = None, mesh=None):
         self.prefer_device = prefer_device
+        self.mesh = mesh
         from greptimedb_tpu.query.device_range import DeviceRangeCache
 
         self.range_cache = DeviceRangeCache()

@@ -217,6 +217,34 @@ def test_where_ts_far_outside_grid(inst, rng):
     assert r.num_rows == 2
 
 
+def test_byte_budget_gates_build_and_growth(cpu):
+    """Cache HBM accounting: too-small budgets refuse the build (host
+    fallback); growth of a cached entry respects the aggregate budget."""
+    from greptimedb_tpu.query.device_range import DeviceRangeCache
+
+    inst = cpu
+    inst.query_engine = QueryEngine(prefer_device=True)
+    inst.query_engine.range_cache = DeviceRangeCache(byte_budget=1000)
+    r = inst.sql(QUERIES[0])
+    assert inst.query_engine.last_exec_path == "host"  # refused: too big
+    assert r.num_rows > 0
+
+    # budget fits the avg-states build but not growth to first/last states
+    inst.query_engine = QueryEngine(prefer_device=True)
+    cache = inst.query_engine.range_cache
+    r1 = inst.sql(QUERIES[0])
+    assert inst.query_engine.last_exec_path == "device"
+    entry = next(iter(cache._entries.values()))
+    assert cache.total_bytes() == entry.bytes() > 0
+    cache.byte_budget = entry.bytes()  # no headroom left
+    inst.sql(
+        "SELECT ts, host, last_value(u) RANGE '10s' FROM cpu "
+        "ALIGN '10s' BY (host)"
+    )
+    assert inst.query_engine.last_exec_path == "host"  # growth refused
+    assert cache.total_bytes() <= cache.byte_budget
+
+
 def test_device_range_empty_matcher(cpu):
     inst = cpu
     inst.query_engine = QueryEngine(prefer_device=True)
