@@ -1,4 +1,4 @@
-"""Driver benchmark: TSBS double-groupby-all THROUGH THE SQL ENGINE.
+"""Driver benchmark: TSBS query shapes THROUGH THE SQL ENGINE.
 
 Workload (BASELINE.md, docs/benchmarks/tsbs/v0.9.1.md:39 in the reference):
 mean of all 10 cpu fields GROUP BY (hostname, hour) over 12h of 10s-interval
@@ -27,7 +27,8 @@ the database does (parse, plan, cache lookup, device compute, assembly)
 plus a real host-side result copy, minus only the dev-harness wire. Both
 raw numbers are printed on stderr for auditability.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per metric; the LAST line is the headline
+double-groupby-all number the driver parses.
 """
 
 from __future__ import annotations
@@ -117,44 +118,46 @@ def main():
         print(f"# warm-up (cache build + compile): {warm_s:.1f}s",
               file=sys.stderr)
 
-        # tunnel floor: identical-shape result readback, zero compute/SQL.
-        # The tunnel's throughput drifts over a process's lifetime, so the
-        # floor is measured INTERLEAVED with the queries (floor_i, wall_i
-        # pairs) and the reported number is the median pairwise difference.
-        import jax
-        import jax.numpy as jnp
+        # secondary TSBS shapes (each asserted onto the device path;
+        # reference numbers: docs/benchmarks/tsbs/v0.9.1.md local column)
+        end_ms = CELLS * INTERVAL_MS
+        shapes = [
+            ("tsbs_lastpoint_sql_ms", 224.91, HOSTS,
+             "SELECT ts, hostname, last_value(usage_user) RANGE '12h' "
+             "FROM cpu ALIGN '12h' TO '1970-01-01 00:00:00' BY (hostname)"),
+            ("tsbs_groupby_orderby_limit_sql_ms", 529.19, 5,
+             f"SELECT ts, max(usage_user) RANGE '1m' FROM cpu "
+             f"WHERE ts < {end_ms - 3600_000} ALIGN '1m' BY () "
+             f"ORDER BY ts DESC LIMIT 5"),
+            ("tsbs_single_groupby_1_1_1_sql_ms", 10.82, 60,
+             f"SELECT ts, max(usage_user) RANGE '1m' FROM cpu "
+             f"WHERE hostname = 'host_17' AND ts >= {end_ms - 3600_000} "
+             f"AND ts < {end_ms} ALIGN '1m' BY (hostname)"),
+            ("tsbs_cpu_max_all_1_sql_ms", 21.14, 8,
+             "SELECT ts, " + ", ".join(
+                 f"max({f}) RANGE '1h'" for f in FIELD_NAMES
+             ) + " FROM cpu WHERE hostname = 'host_42' "
+             "ALIGN '1h' BY (hostname) LIMIT 8"),
+        ]
+        for metric, base_ms, want_rows, q in shapes:
+            r = inst.sql(q)  # warm (cache growth + compile)
+            assert inst.query_engine.last_exec_path == "device", metric
+            assert r.num_rows == want_rows, (metric, r.num_rows)
+            adj, med_wall, med_floor = _measure(
+                inst, q, result_elems=max(r.num_rows, 1), runs=6
+            )
+            print(json.dumps({
+                "metric": metric, "value": round(adj, 3), "unit": "ms",
+                "vs_baseline": round(base_ms / adj, 2),
+                "raw_wall_ms_median": round(med_wall, 3),
+                "tunnel_floor_ms_median": round(med_floor, 3),
+            }))
 
-        shape = (len(FIELD_NAMES), HOSTS, 12)
-        resident = jnp.zeros(shape, jnp.float32) + 1.0
-        resident.block_until_ready()
-
-        @jax.jit
-        def null_result(x):
-            return x * 1.0000001
-
-        _ = np.asarray(null_result(resident))
-        lat, floor, diffs = [], [], []
-        for _ in range(RUNS):
-            t0 = time.perf_counter()
-            _ = np.asarray(null_result(resident))
-            f_ms = (time.perf_counter() - t0) * 1000
-            t0 = time.perf_counter()
-            r = inst.sql(query)
-            w_ms = (time.perf_counter() - t0) * 1000
-            assert r.num_rows == HOSTS * 12
-            floor.append(f_ms)
-            lat.append(w_ms)
-            diffs.append(w_ms - f_ms)
-        print(f"# per-query wall ms (incl. tunnel): "
-              f"{[f'{x:.1f}' for x in lat]}", file=sys.stderr)
-        print(f"# tunnel floor ms (RTT + {np.prod(shape) * 4 / 1e6:.1f}MB "
-              f"readback, no compute): {[f'{x:.1f}' for x in floor]}",
-              file=sys.stderr)
-        diffs.sort()
-        med_wall = sorted(lat)[len(lat) // 2]
-        adj = max(diffs[len(diffs) // 2], 0.1)
-        print(f"# median pairwise (wall - floor) = {adj:.1f}ms database "
-              f"time/query (wall median {med_wall:.1f}ms)", file=sys.stderr)
+        # headline: double-groupby-all (LAST line — driver parses it)
+        adj, med_wall, med_floor = _measure(
+            inst, query, result_elems=len(FIELD_NAMES) * HOSTS * 12,
+            runs=RUNS, expect_rows=HOSTS * 12,
+        )
         print(json.dumps({
             "metric": "tsbs_double_groupby_all_sql_ms",
             "value": round(adj, 3),
@@ -163,13 +166,54 @@ def main():
             # auditability (ADVICE r2): raw end-to-end wall including the
             # dev-tunnel RTT/readback, and the measured no-compute floor
             "raw_wall_ms_median": round(med_wall, 3),
-            "tunnel_floor_ms_median": round(
-                sorted(floor)[len(floor) // 2], 3
-            ),
+            "tunnel_floor_ms_median": round(med_floor, 3),
         }))
         inst.close()
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _measure(inst, query, *, result_elems: int, runs: int,
+             expect_rows: int | None = None):
+    """(adjusted ms, raw wall median ms, floor median ms) for a query.
+
+    Tunnel floor: an identically-sized result readback from a no-compute
+    jit program, measured INTERLEAVED with the queries (the tunnel's
+    throughput drifts); reported latency = median pairwise (wall - floor).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    resident = jnp.zeros((result_elems,), jnp.float32) + 1.0
+    resident.block_until_ready()
+
+    @jax.jit
+    def null_result(x):
+        return x * 1.0000001
+
+    _ = np.asarray(null_result(resident))
+    lat, floor, diffs = [], [], []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        _ = np.asarray(null_result(resident))
+        f_ms = (time.perf_counter() - t0) * 1000
+        t0 = time.perf_counter()
+        r = inst.sql(query)
+        w_ms = (time.perf_counter() - t0) * 1000
+        if expect_rows is not None:
+            assert r.num_rows == expect_rows
+        floor.append(f_ms)
+        lat.append(w_ms)
+        diffs.append(w_ms - f_ms)
+    print(f"# {query[:60]}...: wall ms {[f'{x:.1f}' for x in lat]} | "
+          f"floor ({result_elems * 4 / 1e6:.2f}MB) "
+          f"{[f'{x:.1f}' for x in floor]}", file=sys.stderr)
+    diffs.sort()
+    return (
+        max(diffs[len(diffs) // 2], 0.1),
+        sorted(lat)[len(lat) // 2],
+        sorted(floor)[len(floor) // 2],
+    )
 
 
 if __name__ == "__main__":

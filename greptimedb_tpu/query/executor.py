@@ -227,6 +227,18 @@ class QueryEngine:
         self.range_cache = DeviceRangeCache()
         self.last_exec_path = "host"  # observability: host | device
 
+    def _record_path(self, kind: str, path: str):
+        """Observability: device/host execution counts with fallback
+        reasons (/metrics gtpu_query_exec_path_total)."""
+        self.last_exec_path = "device" if path == "device" else "host"
+        from greptimedb_tpu.telemetry.metrics import global_registry
+
+        global_registry.counter(
+            "gtpu_query_exec_path_total",
+            "Query executions by path (device | host:<fallback reason>)",
+            labels=("kind", "path"),
+        ).labels(kind, path).inc()
+
     # ------------------------------------------------------------------
     def execute(self, plan: SelectPlan, table) -> QueryResult:
         if table is None:
@@ -237,8 +249,9 @@ class QueryEngine:
 
             res = device_range.execute_range_device(self, plan, table)
             if res is not None:
-                self.last_exec_path = "device"
+                self._record_path("range", "device")
                 return res
+            self._record_path("range", "host:shape")
         src = self._scan(plan, table)
         if plan.kind == "plain":
             return self._execute_plain(plan, src, table)
@@ -408,10 +421,11 @@ class QueryEngine:
                 raise UnsupportedError(f"DISTINCT {a.op} is not supported")
             specs.append((a.key, a.op, vk, a.q))
         ts = src.rows.ts if src.rows is not None else None
-        results = grouped_reduce(
+        results, path = grouped_reduce(
             specs, values, gid, valid_map, g, ts=ts,
             prefer_device=self.prefer_device,
         )
+        self._record_path("aggregate", path)
         agg_cols = dict(key_cols)
         for name, (vals, valid) in results.items():
             agg_cols[name] = Col(

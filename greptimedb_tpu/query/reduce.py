@@ -12,6 +12,8 @@ jit traces are reused across queries.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from greptimedb_tpu.datatypes.batch import bucket_size, pad_to
@@ -127,86 +129,203 @@ def _host_reduce(op: str, values, valid, gid, g: int, q: float | None,
 
 
 # ----------------------------------------------------------------------
-# device path
+# device path: ONE fused jit program, ONE device->host transfer
 # ----------------------------------------------------------------------
 
-# first_value/last_value stay on host: epoch-ms timestamps do not survive
-# the device's int32/f32 downcast (wrapping + 131s granularity), and the
-# host pass is a single lexsort anyway.
 _DEVICE_OPS = {"count", "sum", "mean", "min", "max", "var_pop", "var_samp",
-               "stddev_pop", "stddev_samp"}
+               "stddev_pop", "stddev_samp", "first_value", "last_value"}
+
+_PROGRAM_CACHE: dict = {}
 
 
-def _device_reduce_many(specs, values: dict, gid, valid, g: int, ts):
-    """Run several aggregates sharing one segmentation on device in one jit
-    program. specs: list of (name, op, value_key|None). Returns
-    {name: (np values, np valid|None)}."""
+def _fused_program():
+    """All aggregates of a GROUP BY in one XLA program emitting a single
+    (rows, GB) f32 matrix — one transfer per query instead of one per
+    aggregate (the reference streams per-operator;
+    /root/reference/src/query/src/datafusion.rs:75).
+
+    Layout (all static from `spec`):
+    - per distinct validity mask: `blocks` rows of per-(group, block)
+      count partials (combined in f64 on host — f32 scatter-add partials
+      stay small, the blocked scheme bounds accumulation error);
+    - sum/mean: `blocks` rows of value-sum partials;
+    - var/stddev: `blocks` rows of squared-deviation partials (deviations
+      taken against the on-device f32 mean: the correction term
+      (mean - m32)^2 is O(eps^2), negligible);
+    - min/max: 1 row;
+    - first/last: 1 row — the winner is resolved exactly by the
+      (ts_hi, ts_lo, row-index) int32 lexicographic key (epoch-ms split
+      into two int31 halves survives the device without x64) and its
+      value extracted by a masked segment-sum, mirroring
+      device_range._fold_groups.
+    """
+    import jax
     import jax.numpy as jnp
 
-    from greptimedb_tpu.ops import segment as seg
+    @functools.partial(jax.jit, static_argnames=("spec",))
+    def program(vals, masks, gid, tshi, tslo, *, spec):
+        gb, blocks, mask_rows, items = spec
+        nb = gid.shape[0]
+        per = -(-nb // blocks)
+        block = (jnp.arange(nb, dtype=jnp.int32)
+                 // jnp.int32(per))
+        trash2 = jnp.int32(gb * blocks)
+        rows = []
+
+        def pseg2(v, mask):
+            s2 = jnp.where(mask, gid * jnp.int32(blocks) + block, trash2)
+            p = jax.ops.segment_sum(
+                jnp.where(mask, v, 0.0).astype(jnp.float32),
+                s2, num_segments=gb * blocks + 1,
+            )
+            return p[:-1].reshape(gb, blocks).T  # (blocks, gb)
+
+        cnt32 = []
+        for mi in range(mask_rows):
+            cp = pseg2(jnp.ones(nb, jnp.float32), masks[mi])
+            cnt32.append(jnp.sum(cp, axis=0))
+            rows.append(cp)
+
+        idx = jnp.arange(nb, dtype=jnp.int32)
+        for op, vi, mi in items:
+            mask = masks[mi]
+            if op == "count":
+                continue  # rides the mask's count rows
+            v = vals[vi]
+            if op in ("sum", "mean"):
+                rows.append(pseg2(v, mask))
+            elif op in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+                sp = pseg2(v, mask)
+                m32 = jnp.sum(sp, axis=0) / jnp.maximum(cnt32[mi], 1)
+                dev = jnp.where(mask, v - m32[gid], 0.0)
+                rows.append(pseg2(dev * dev, mask))
+            elif op in ("min", "max"):
+                ext = jax.ops.segment_max if op == "max" else (
+                    jax.ops.segment_min
+                )
+                ident = -jnp.inf if op == "max" else jnp.inf
+                sg = jnp.where(mask, gid, jnp.int32(gb))
+                r = ext(
+                    jnp.where(mask, v, ident).astype(jnp.float32), sg,
+                    num_segments=gb + 1,
+                )[:-1]
+                rows.append(r[None, :])
+            elif op in ("first_value", "last_value"):
+                last = op == "last_value"
+                ext = jax.ops.segment_max if last else jax.ops.segment_min
+                sent = jnp.int32(-1 if last else _2_31M)
+                sg = jnp.where(mask, gid, jnp.int32(gb))
+
+                def stage(key, tie):
+                    t = jnp.where(tie, key, sent)
+                    w = ext(t, sg, num_segments=gb + 1)[:-1]
+                    return tie & (key == w[sg.clip(0, gb - 1)]) & mask
+
+                tie = mask
+                tie = stage(tshi, tie)
+                tie = stage(tslo, tie)
+                tie = stage(idx, tie)  # row index: unique winner
+                r = jax.ops.segment_sum(
+                    jnp.where(tie, v, 0.0).astype(jnp.float32), sg,
+                    num_segments=gb + 1,
+                )[:-1]
+                rows.append(r[None, :])
+        return jnp.concatenate(rows, axis=0)
+
+    return program
+
+
+_2_31M = 2**31 - 1
+_FUSED = None
+
+
+def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts):
+    """Single-program GROUP BY. specs: (name, op, vkey|None, q). Returns
+    {name: (np values, np valid|None)}."""
+    global _FUSED
+    import jax.numpy as jnp
+
+    if _FUSED is None:
+        _FUSED = _fused_program()
 
     n = len(gid)
     nb = bucket_size(n)
     gb = _pad_group_count(g)
-    dev_vals = {
-        k: jnp.asarray(pad_to(v.astype(np.float64, copy=False), nb))
-        for k, v in values.items()
-    }
+    blocks = max(1, min(nb, (1 << 20) // gb))
+
+    # distinct validity masks (mask 0 = all-valid)
+    mask_keys = [None]
+    mask_arrays = [np.ones(n, dtype=bool)]
+    mask_of: dict = {None: 0}
+    for name, op, vk, q in specs:
+        m = valid_map.get(vk) if vk else None
+        mid = id(m) if m is not None else None
+        if mid not in mask_of:
+            mask_of[mid] = len(mask_keys)
+            mask_keys.append(mid)
+            mask_arrays.append(m)
+    # stacked dynamic inputs
+    vkeys = sorted({vk for _, _, vk, _ in specs if vk is not None})
+    vidx = {k: i for i, k in enumerate(vkeys)}
+    d_vals = jnp.asarray(np.stack([
+        pad_to(values[k].astype(np.float32, copy=False), nb)
+        for k in vkeys
+    ])) if vkeys else jnp.zeros((1, nb), jnp.float32)
+    d_masks = jnp.asarray(np.stack([
+        pad_to(m, nb, fill=False) for m in mask_arrays
+    ]))
     d_gid = jnp.asarray(pad_to(gid.astype(np.int32), nb))
-    d_mask = jnp.asarray(pad_to(valid, nb, fill=False))
-    d_ts = jnp.asarray(pad_to(ts.astype(np.int64), nb)) if ts is not None else None
+    if ts is not None and any(
+        op in ("first_value", "last_value") for _, op, _, _ in specs
+    ):
+        rel = (ts.astype(np.int64) - int(ts.min())) if n else ts
+        tshi = (rel >> 31).astype(np.int32)
+        tslo = (rel & _2_31M).astype(np.int32)
+    else:
+        tshi = tslo = np.zeros(n, np.int32)
+    d_tshi = jnp.asarray(pad_to(tshi, nb))
+    d_tslo = jnp.asarray(pad_to(tslo, nb))
 
+    items = tuple(
+        (op, vidx[vk] if vk is not None else -1,
+         mask_of[id(valid_map[vk]) if vk and vk in valid_map else None])
+        for _, op, vk, _ in specs
+    )
+    spec = (gb, blocks, len(mask_arrays), items)
+    out_mat = np.asarray(
+        _FUSED(d_vals, d_masks, d_gid, d_tshi, d_tslo, spec=spec)
+    ).astype(np.float64)
+
+    # decode: host f64 combine of the blocked partials
+    cnts = []
+    r = 0
+    for _ in mask_arrays:
+        cnts.append(out_mat[r:r + blocks].sum(axis=0)[:g])
+        r += blocks
     out = {}
-    cnt_cache = None
-
-    for name, op, vkey in specs:
+    for (name, op, vk, q), (op2, vi, mi) in zip(specs, items):
+        cnt = cnts[mi]
+        present = cnt > 0
         if op == "count":
-            res = seg.seg_count(d_gid, d_mask, gb)
-            out[name] = (np.asarray(res)[:g].astype(np.int64), None)
+            out[name] = (cnt.astype(np.int64), None)
             continue
-        v = dev_vals[vkey]
-        if cnt_cache is None:
-            cnt_cache = seg.seg_count(d_gid, d_mask, gb)
-        cnt_np = np.asarray(cnt_cache)[:g].astype(np.float64)
-        present = cnt_np > 0
         if op in ("sum", "mean"):
-            # TPU accumulates in f32 (x64 stays off). Blocked hierarchical
-            # sum: f32 partials over (group x block) sub-segments, combined
-            # in f64 on host — accumulation error shrinks by the block
-            # factor (f32 scatter-add error is linear in partial
-            # magnitude).
-            # spend a ~1M-segment budget on blocks: smaller per-partial
-            # element counts keep f32 rounding error negligible even for
-            # contiguous (sorted-by-group) row layouts
-            blocks = max(1, min(nb, (1 << 20) // gb))
-            d_block = dev_block_ids(nb, blocks)
-            seg2 = d_gid * jnp.int32(blocks) + d_block
-            partials = seg.seg_sum(v, seg2, d_mask, gb * blocks)
-            s = (
-                np.asarray(partials).astype(np.float64)
-                .reshape(gb, blocks)[:g].sum(axis=1)
-            )
-            if op == "sum":
-                out[name] = (s, present)
-            else:
-                out[name] = (s / np.maximum(cnt_np, 1), present)
-        elif op == "min":
-            res = seg.seg_min(v, d_gid, d_mask, gb)
-            out[name] = (np.asarray(res)[:g], present)
-        elif op == "max":
-            res = seg.seg_max(v, d_gid, d_mask, gb)
-            out[name] = (np.asarray(res)[:g], present)
+            s = out_mat[r:r + blocks].sum(axis=0)[:g]
+            r += blocks
+            out[name] = ((s, present) if op == "sum"
+                         else (s / np.maximum(cnt, 1), present))
         elif op in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+            s2 = out_mat[r:r + blocks].sum(axis=0)[:g]
+            r += blocks
             ddof = 1 if op.endswith("_samp") else 0
-            var, cnt = seg.seg_var(v, d_gid, d_mask, gb, ddof=ddof)
-            var = np.asarray(var)[:g]
-            ok = np.asarray(cnt)[:g] > ddof
-            if op.startswith("stddev"):
-                out[name] = (np.sqrt(var), ok)
-            else:
-                out[name] = (var, ok)
-        else:  # pragma: no cover - guarded by _DEVICE_OPS
-            raise UnsupportedError(op)
+            var = np.maximum(s2, 0.0) / np.maximum(cnt - ddof, 1)
+            ok = cnt > ddof
+            out[name] = ((np.sqrt(var), ok) if op.startswith("stddev")
+                         else (var, ok))
+        else:  # min / max / first / last: one row
+            vrow = out_mat[r][:g]
+            r += 1
+            out[name] = (np.where(present, vrow, 0.0), present)
     return out
 
 
@@ -223,44 +342,33 @@ def grouped_reduce(
     *,
     ts: np.ndarray | None = None,
     prefer_device: bool | None = None,
-) -> dict:
+) -> tuple[dict, str]:
     """specs: list of (out_name, op, value_key|None, q|None). values: key ->
     per-row array. valid_map: key -> bool array (all-valid if missing).
-    Returns {out_name: (np array len g, valid|None)}."""
+    Returns ({out_name: (np array len g, valid|None)}, exec_path) where
+    exec_path is "device" or "host:<reason>"."""
     n = len(gid)
     all_valid = np.ones(n, dtype=bool)
     use_device = prefer_device
     if use_device is None:
         use_device = n >= DEVICE_THRESHOLD
-    device_ok = use_device and all(
-        op in _DEVICE_OPS
-        and (vk is None or values[vk].dtype != object)
+    path = "device"
+    if not use_device:
+        path = "host:small" if prefer_device is None else "host:config"
+    elif not all(op in _DEVICE_OPS for _, op, vk, _ in specs):
+        path = "host:op"
+    elif not all(
+        vk is None or values[vk].dtype.kind in "iuf"
         for _, op, vk, _ in specs
-    )
+    ):
+        path = "host:dtype"
+    if path == "device":
+        return _device_reduce_fused(specs, values, gid, valid_map, g, ts), path
     out = {}
-    if device_ok:
-        dev_specs = []
-        for name, op, vk, q in specs:
-            dev_specs.append((name, op, vk))
-        # device path needs one shared validity; split per distinct validity
-        groups: dict[int, list] = {}
-        for name, op, vk in dev_specs:
-            vmask = valid_map.get(vk) if vk else None
-            key = id(vmask) if vmask is not None else 0
-            groups.setdefault(key, []).append((name, op, vk, vmask))
-        for _, items in groups.items():
-            vmask = items[0][3]
-            mask = vmask if vmask is not None else all_valid
-            res = _device_reduce_many(
-                [(n_, o_, v_) for n_, o_, v_, _ in items],
-                values, gid, mask, g, ts,
-            )
-            out.update(res)
-        return out
     for name, op, vk, q in specs:
         v = values[vk] if vk is not None else None
         mask = valid_map.get(vk) if vk else None
         if mask is None:
             mask = all_valid
         out[name] = _host_reduce(op, v, mask, gid, g, q, order_ts=ts)
-    return out
+    return out, path

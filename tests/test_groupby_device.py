@@ -1,0 +1,102 @@
+"""Plain GROUP BY on the fused device program vs the host path.
+
+One jit program computes every aggregate of the query and returns one
+(rows, groups) matrix — one device->host transfer per GROUP BY (the
+reference runs per-operator aggregate streams,
+/root/reference/src/query/src/datafusion.rs:75).
+"""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.query.executor import QueryEngine
+
+
+@pytest.fixture
+def inst(tmp_path, rng):
+    i = Standalone(str(tmp_path))
+    i.execute_sql(
+        "create table cpu (ts timestamp time index, host string primary key,"
+        " dc string primary key, u double, v double)"
+    )
+    tab = i.catalog.table("public", "cpu")
+    n_hosts, t = 20, 150
+    base = 1_700_000_000_000  # epoch-ms: must survive the device exactly
+    ts = (np.tile(np.arange(t) * 1000, n_hosts) + base).astype(np.int64)
+    hosts = np.repeat([f"h{i:02d}" for i in range(n_hosts)], t).astype(object)
+    dcs = np.repeat([f"d{i % 3}" for i in range(n_hosts)], t).astype(object)
+    u = rng.random(n_hosts * t) * 100
+    v = rng.random(n_hosts * t) * 10
+    valid = rng.random(n_hosts * t) > 0.07
+    tab.write({"host": hosts, "dc": dcs}, ts, {"u": u, "v": v},
+              field_valid={"u": valid})
+    yield i
+    i.close()
+
+
+QUERIES = [
+    "SELECT host, count(*), sum(u), avg(u), min(v), max(v) FROM cpu "
+    "GROUP BY host ORDER BY host",
+    "SELECT dc, stddev(u), var_pop(v), count(u) FROM cpu "
+    "GROUP BY dc ORDER BY dc",
+    # TSBS lastpoint shape: last value per series by time
+    "SELECT host, last_value(u), first_value(v) FROM cpu "
+    "GROUP BY host ORDER BY host",
+    "SELECT dc, last_value(v) FROM cpu GROUP BY dc ORDER BY dc",
+    "SELECT count(*), avg(u), last_value(u) FROM cpu",
+]
+
+
+@pytest.mark.parametrize("q", QUERIES)
+def test_groupby_device_matches_host(inst, q):
+    inst.query_engine = QueryEngine(prefer_device=False)
+    rh = inst.sql(q)
+    inst.query_engine = QueryEngine(prefer_device=True)
+    rd = inst.sql(q)
+    assert inst.query_engine.last_exec_path == "device", q
+    assert rh.num_rows == rd.num_rows
+    for i in range(len(rh.names)):
+        a, b = rh.cols[i], rd.cols[i]
+        assert (a.valid_mask == b.valid_mask).all(), (q, rh.names[i])
+        if a.values.dtype == object:
+            assert (a.values == b.values).all(), (q, rh.names[i])
+        else:
+            m = a.valid_mask
+            np.testing.assert_allclose(
+                np.asarray(a.values, float)[m],
+                np.asarray(b.values, float)[m],
+                rtol=2e-4, atol=1e-3, err_msg=(q, rh.names[i]),
+            )
+
+
+def test_lastpoint_winner_is_exact_row(inst):
+    """first/last on device must pick the exact (ts, row) winner, not a
+    close value: compare at f32 precision for equality."""
+    q = ("SELECT host, last_value(u), first_value(u) FROM cpu "
+         "GROUP BY host ORDER BY host")
+    inst.query_engine = QueryEngine(prefer_device=False)
+    rh = inst.sql(q)
+    inst.query_engine = QueryEngine(prefer_device=True)
+    rd = inst.sql(q)
+    assert inst.query_engine.last_exec_path == "device"
+    for i in (1, 2):
+        np.testing.assert_array_equal(
+            np.asarray(rh.cols[i].values, np.float64).astype(np.float32),
+            np.asarray(rd.cols[i].values, np.float64).astype(np.float32),
+        )
+
+
+def test_fallback_counter_exported(inst):
+    from greptimedb_tpu.telemetry.metrics import global_registry
+
+    inst.query_engine = QueryEngine(prefer_device=True)
+    inst.sql("SELECT host, median(u) FROM cpu GROUP BY host")  # quantile
+    assert inst.query_engine.last_exec_path == "host"
+    text = global_registry.render()
+    assert 'gtpu_query_exec_path_total{kind="aggregate",path="host:op"}' \
+        in text
+    inst.sql("SELECT host, avg(u) FROM cpu GROUP BY host")
+    text = global_registry.render()
+    assert 'gtpu_query_exec_path_total{kind="aggregate",path="device"}' \
+        in text
