@@ -153,6 +153,12 @@ def main():
                 "tunnel_floor_ms_median": round(med_floor, 3),
             }))
 
+        # PromQL north-star: range query p50 < 50 ms @ 1M active series
+        # (BASELINE.md). Served by the selector grid cache
+        # (promql/fast.py): dictionary-coded matchers/grouping + one fused
+        # XLA program; per-query cost is independent of the series count.
+        _bench_promql_1m(inst)
+
         # headline: double-groupby-all (LAST line — driver parses it)
         adj, med_wall, med_floor = _measure(
             inst, query, result_elems=len(FIELD_NAMES) * HOSTS * 12,
@@ -173,9 +179,93 @@ def main():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_promql_1m(inst):
+    """1M active series, `sum by (dc) (rate(...))` through the PromQL
+    engine + Prometheus JSON response assembly (the same code the HTTP
+    handler runs). Data: 1M series x 10 samples at 30s."""
+    from greptimedb_tpu.promql.engine import PromEngine
+    from greptimedb_tpu.servers.http import _prom_matrix_json
+
+    n_series = 1_000_000
+    n_samples = 10
+    interval = 30_000
+    t0_data = 1_700_000_000_000
+    target_ms = 50.0  # BASELINE.md north-star
+
+    inst.execute_sql(
+        "create table prom_bench (ts timestamp time index, "
+        "host string, dc string, greptime_value double, "
+        "primary key (host, dc))"
+    )
+    table = inst.catalog.table("public", "prom_bench")
+    hosts = np.asarray([f"host_{i}" for i in range(n_series)], object)
+    dcs = np.asarray([f"dc{i % 32}" for i in range(n_series)], object)
+    rng = np.random.default_rng(11)
+    t_load = time.perf_counter()
+    for s in range(n_samples):
+        ts = np.full(n_series, t0_data + s * interval, np.int64)
+        table.write(
+            {"host": hosts, "dc": dcs}, ts,
+            {"greptime_value": np.cumsum(rng.random(n_series)) + s * 50.0},
+            skip_wal=True,
+        )
+    print(
+        f"# promql bench: ingested {n_series * n_samples} rows "
+        f"({n_series} series) in {time.perf_counter() - t_load:.1f}s",
+        file=sys.stderr,
+    )
+    q = "sum by (dc) (rate(prom_bench[1m]))"
+    start = t0_data + 60_000
+    end = t0_data + (n_samples - 1) * interval
+    step = 30_000
+
+    def run():
+        engine = PromEngine(inst)
+        val, ev = engine.query_range(q, start, end, step)
+        resp = _prom_matrix_json(val, ev)
+        assert len(resp["data"]["result"]) == 32
+        return resp
+
+    t_warm = time.perf_counter()
+    run()  # builds the 1M-series grid + compiles the fused program
+    print(
+        f"# promql warm-up (grid build + compile): "
+        f"{time.perf_counter() - t_warm:.1f}s",
+        file=sys.stderr,
+    )
+    from greptimedb_tpu.promql import fast as F
+    assert any(
+        e.num_series == n_series for e in F._CACHE._entries.values()
+    ), "PromQL query did not hit the selector grid cache"
+    n_steps = (end - start) // step + 1
+    adj, med_wall, med_floor = _measure_fn(
+        run, label=q, result_elems=32 * n_steps, runs=15,
+    )
+    print(json.dumps({
+        "metric": "promql_1m_series_range_p50_ms",
+        "value": round(adj, 3),
+        "unit": "ms",
+        "vs_baseline": round(target_ms / adj, 2),
+        "raw_wall_ms_median": round(med_wall, 3),
+        "tunnel_floor_ms_median": round(med_floor, 3),
+    }))
+
+
 def _measure(inst, query, *, result_elems: int, runs: int,
              expect_rows: int | None = None):
-    """(adjusted ms, raw wall median ms, floor median ms) for a query.
+    """(adjusted ms, raw wall median ms, floor median ms) for a query."""
+    def run():
+        r = inst.sql(query)
+        if expect_rows is not None:
+            assert r.num_rows == expect_rows
+        return r
+
+    return _measure_fn(run, label=query, result_elems=result_elems,
+                       runs=runs)
+
+
+def _measure_fn(run, *, label: str, result_elems: int, runs: int):
+    """(adjusted ms, raw wall median ms, floor median ms) for a callable.
 
     Tunnel floor: an identically-sized result readback from a no-compute
     jit program, measured INTERLEAVED with the queries (the tunnel's
@@ -198,14 +288,12 @@ def _measure(inst, query, *, result_elems: int, runs: int,
         _ = np.asarray(null_result(resident))
         f_ms = (time.perf_counter() - t0) * 1000
         t0 = time.perf_counter()
-        r = inst.sql(query)
+        run()
         w_ms = (time.perf_counter() - t0) * 1000
-        if expect_rows is not None:
-            assert r.num_rows == expect_rows
         floor.append(f_ms)
         lat.append(w_ms)
         diffs.append(w_ms - f_ms)
-    print(f"# {query[:60]}...: wall ms {[f'{x:.1f}' for x in lat]} | "
+    print(f"# {label[:60]}...: wall ms {[f'{x:.1f}' for x in lat]} | "
           f"floor ({result_elems * 4 / 1e6:.2f}MB) "
           f"{[f'{x:.1f}' for x in floor]}", file=sys.stderr)
     diffs.sort()

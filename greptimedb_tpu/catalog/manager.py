@@ -269,6 +269,13 @@ class CatalogManager:
             for rid in table.info.region_ids():
                 self.engine.drop_region(rid)
             self._persist()
+        # release any HBM-resident query caches pinned to the table
+        try:
+            from greptimedb_tpu.promql import fast as _promql_fast
+
+            _promql_fast.drop_table_entries(table)
+        except ImportError:  # pragma: no cover - promql optional
+            pass
 
     def table(self, database: str, name: str) -> Table:
         with self._lock:

@@ -175,6 +175,20 @@ def histogram_quantile(le: jax.Array, buckets: jax.Array, mask: jax.Array, q):
 # cross-series aggregation (sum/avg/min/max/topk... by (...) semantics)
 # ----------------------------------------------------------------------
 
+# above this series count, linear group reductions run as one-hot matmuls
+# on the MXU instead of segment scatters (TPU scatter serializes per index:
+# at 1M series a segment_sum costs ~1s, the equivalent (G,S)x(S,J) matmul
+# costs <1ms). Min/max are not linear and stay on the scatter path.
+_MATMUL_MIN_SERIES = 4096
+_MATMUL_MAX_ONEHOT_ELEMS = 1 << 28  # 1 GB f32 one-hot ceiling
+
+
+def _group_matmul(x, onehot_t):
+    """(G, S) @ (S, J) with full f32 accumulation (one-hot entries are
+    exact in any precision; the data must not round through bf16)."""
+    return jax.lax.dot(onehot_t, x, precision=jax.lax.Precision.HIGHEST)
+
+
 @functools.partial(jax.jit, static_argnames=("op", "num_groups"))
 def aggregate_across_series(vals, present, group_ids, num_groups: int, op: str):
     """PromQL aggregation operators over the series axis of an (S, J) matrix.
@@ -182,6 +196,39 @@ def aggregate_across_series(vals, present, group_ids, num_groups: int, op: str):
     from label sets). Returns (G, J) values + presence."""
     dt = vals.dtype
     gid = group_ids.astype(jnp.int32)
+    linear = op in ("sum", "avg", "count", "group", "stddev", "stdvar")
+    # the (G, S) one-hot must stay bounded: high-cardinality group-bys
+    # (G ~ S) would materialize G*S floats, so those keep the scatter path
+    use_matmul = (
+        linear
+        and vals.shape[0] >= _MATMUL_MIN_SERIES
+        and num_groups * vals.shape[0] <= _MATMUL_MAX_ONEHOT_ELEMS
+    )
+
+    if use_matmul:
+        onehot_t = (
+            gid[None, :] == jnp.arange(num_groups, dtype=jnp.int32)[:, None]
+        ).astype(dt)                                    # (G, S)
+        cnt_f = _group_matmul(present.astype(dt), onehot_t)
+        any_present = cnt_f > 0
+        masked = jnp.where(present, vals, 0)
+        if op in ("sum", "avg"):
+            s = _group_matmul(masked, onehot_t)
+            if op == "avg":
+                s = s / jnp.maximum(cnt_f, 1)
+            return jnp.where(any_present, s, 0), any_present
+        if op == "count":
+            return cnt_f, any_present
+        if op == "group":
+            return any_present.astype(dt), any_present
+        # stddev / stdvar: two-pass for stability (matches the scatter path)
+        n = jnp.maximum(cnt_f, 1)
+        mean = _group_matmul(masked, onehot_t) / n
+        dev = jnp.where(present, vals - jnp.take(mean, gid, axis=0), 0)
+        var = _group_matmul(dev * dev, onehot_t) / n
+        out = var if op == "stdvar" else jnp.sqrt(var)
+        return jnp.where(any_present, out, 0), any_present
+
     cnt = jax.ops.segment_sum(
         present.astype(jnp.int32), gid, num_segments=num_groups
     )

@@ -123,7 +123,27 @@ def _gather_steps(arr: jax.Array, idx: jax.Array) -> jax.Array:
     (S, J) -> (S, J)."""
     if idx.ndim == 1:
         return arr[:, idx]
-    return jnp.take_along_axis(arr, idx, axis=1)
+    return _take_cells(arr, idx)
+
+
+# per-row gathers (take_along_axis with (S, J) indices) lower to
+# scatter-like HLO that serializes on TPU (~110ms at 1M series x 12 cells);
+# when the cell axis is small, a broadcast-compare + masked reduction is
+# pure fused VPU work (~10x faster). Above the threshold the (S, J, T)
+# virtual intermediate stops fusing profitably and take_along_axis wins.
+_TAKE_CELLS_MAX_T = 128
+
+
+def _take_cells(arr: jax.Array, idx: jax.Array) -> jax.Array:
+    """take_along_axis(arr, idx, axis=1) for (S, T) arr and (S, J) idx,
+    TPU-reformulated for small T."""
+    t = arr.shape[1]
+    if t > _TAKE_CELLS_MAX_T:
+        return jnp.take_along_axis(arr, idx, axis=1)
+    oh = idx[:, :, None] == jnp.arange(t, dtype=jnp.int32)[None, None, :]
+    return jnp.sum(
+        jnp.where(oh, arr[:, None, :], jnp.zeros((), arr.dtype)), axis=2
+    )
 
 
 # ----------------------------------------------------------------------
@@ -157,8 +177,8 @@ def window_last(vals, has, tsg, lo, hi):
     li = _gather_steps(_last_present_idx(has), hi)
     present = li > lo[None, :]
     safe = jnp.maximum(li, 0)
-    v = jnp.take_along_axis(vals, safe, axis=1)
-    t = jnp.take_along_axis(tsg, safe, axis=1)
+    v = _take_cells(vals, safe)
+    t = _take_cells(tsg, safe)
     return v, t, present
 
 
@@ -168,8 +188,8 @@ def window_first(vals, has, tsg, lo, hi):
     present = fi <= hi[None, :]
     t_max = vals.shape[1] - 1
     safe = jnp.minimum(fi, t_max)
-    v = jnp.take_along_axis(vals, safe, axis=1)
-    t = jnp.take_along_axis(tsg, safe, axis=1)
+    v = _take_cells(vals, safe)
+    t = _take_cells(tsg, safe)
     return v, t, present
 
 
@@ -178,7 +198,7 @@ def _pair_indicator(vals, has, pred):
     lastidx = _last_present_idx(has)
     pl = _prev_present_idx(lastidx)
     safe = jnp.maximum(pl, 0)
-    prev_val = jnp.take_along_axis(vals, safe, axis=1)
+    prev_val = _take_cells(vals, safe)
     pair = has & (pl >= 0)
     return pair, prev_val
 
@@ -200,17 +220,17 @@ def extrapolated_rate(
     li_s = jnp.maximum(li, 0)
     fi_s = jnp.minimum(fi, t_max)
     valid = (li > lo[None, :]) & (fi <= hi[None, :]) & (fi < li)
-    v_last = jnp.take_along_axis(vals, li_s, axis=1)
-    v_first = jnp.take_along_axis(vals, fi_s, axis=1)
-    t_last = jnp.take_along_axis(tsg, li_s, axis=1).astype(dt)
-    t_first = jnp.take_along_axis(tsg, fi_s, axis=1).astype(dt)
+    v_last = _take_cells(vals, li_s)
+    v_first = _take_cells(vals, fi_s)
+    t_last = _take_cells(tsg, li_s).astype(dt)
+    t_first = _take_cells(tsg, fi_s).astype(dt)
 
     delta = v_last - v_first
     if is_counter:
         pair, prev_val = _pair_indicator(vals, has, None)
         drop = jnp.where(pair & (vals < prev_val), prev_val, jnp.zeros((), dt))
         d = _prefix(drop)
-        corr = _gather_steps(d, hi + 1) - jnp.take_along_axis(d, fi_s + 1, axis=1)
+        corr = _gather_steps(d, hi + 1) - _take_cells(d, fi_s + 1)
         delta = delta + corr
 
     cnt = window_count(has, lo, hi).astype(dt)
@@ -258,7 +278,7 @@ def window_pair_count(vals, has, lo, hi, *, count_changes: bool):
     t_max = vals.shape[1] - 1
     fi_s = jnp.minimum(fi, t_max)
     in_w = fi <= hi[None, :]
-    cnt = _gather_steps(p, hi + 1) - jnp.take_along_axis(p, fi_s + 1, axis=1)
+    cnt = _gather_steps(p, hi + 1) - _take_cells(p, fi_s + 1)
     cnt = jnp.where(in_w, cnt, 0)
     return cnt.astype(dt), in_w
 
@@ -274,13 +294,13 @@ def instant_delta(vals, has, tsg, lo, hi, tps, *, is_rate: bool):
     t_max = vals.shape[1] - 1
     li_s = jnp.maximum(li, 0)
     # previous present cell strictly before li
-    pi = jnp.take_along_axis(pl, li_s, axis=1)
+    pi = _take_cells(pl, li_s)
     pi_s = jnp.maximum(pi, 0)
     valid = (li > lo[None, :]) & (pi > lo[None, :]) & (pi >= 0)
-    v1 = jnp.take_along_axis(vals, pi_s, axis=1)
-    v2 = jnp.take_along_axis(vals, li_s, axis=1)
-    t1 = jnp.take_along_axis(tsg, pi_s, axis=1).astype(dt)
-    t2 = jnp.take_along_axis(tsg, li_s, axis=1).astype(dt)
+    v1 = _take_cells(vals, pi_s)
+    v2 = _take_cells(vals, li_s)
+    t1 = _take_cells(tsg, pi_s).astype(dt)
+    t2 = _take_cells(tsg, li_s).astype(dt)
     if is_rate:
         dv = jnp.where(v2 < v1, v2, v2 - v1)  # counter reset: use raw value
         dtm = jnp.maximum(t2 - t1, 1) / jnp.asarray(tps, dt)
@@ -444,8 +464,8 @@ def instant_lookback(vals, has, tsg, hi, t_end, lookback_ticks):
     lastidx = _last_present_idx(has)
     li = _gather_steps(lastidx, hi)
     safe = jnp.maximum(li, 0)
-    v = jnp.take_along_axis(vals, safe, axis=1)
-    t = jnp.take_along_axis(tsg, safe, axis=1)
+    v = _take_cells(vals, safe)
+    t = _take_cells(tsg, safe)
     # int32-safe freshness test: ts is <= t_end by construction, so the
     # difference is small and non-positive.
     age = t_end[None, :] - t

@@ -190,6 +190,11 @@ class PromEngine:
         if isinstance(e, Binary):
             return self._eval_binary(e, ev)
         if isinstance(e, Agg):
+            from greptimedb_tpu.promql import fast as F
+
+            hit = F.try_fast(self, e, ev)
+            if hit is not None:
+                return hit
             return self._eval_agg(e, ev)
         if isinstance(e, Call):
             return self._eval_call(e, ev)

@@ -1,0 +1,479 @@
+"""Cached device fast path for hot PromQL shapes.
+
+The generic engine (promql/engine.py) rescans storage, regridifies, and
+builds one Python label dict per series on every query — fine at 10k
+series, hopeless at 1M. This module is the counterpart of the reference's
+specialised PromQL plans (/root/reference/src/query/src/promql/planner.rs
+PromPlanner + src/promql/src/extension_plan/range_manipulate.rs), rebuilt
+around the device grid cache idea proven by query/device_range.py:
+
+- a **selector grid cache**: per (table, field), the full (series x cell)
+  vals/has/tsg grids for every series live in HBM, version-stamped by
+  Table.data_version() and evicted under a byte budget;
+- **dictionary-coded label algebra**: matchers evaluate per distinct tag
+  value then broadcast through int32 code columns (SeriesRegistry.
+  match_mask); group-by keys come from the cached codes matrix via one
+  np.unique — no per-series Python;
+- **one fused XLA program** per query shape: range function (prefix-path
+  kernels from ops/window.py) + cross-series aggregation
+  (ops/promql.aggregate_across_series) compile into a single jit call, so
+  a query moves J*12 bytes of window indices to the device and (G, J)
+  results back — independent of the series count.
+
+Shapes handled: `agg [by/without (...)] (range_fn(sel[d]))` and
+`agg [by/without (...)] (sel)` for the prefix-path range functions and the
+simple aggregators. Everything else falls back to the generic engine, as
+do queries whose step/range don't align with the cached grid resolution.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from greptimedb_tpu.promql.parser import Agg, Call, VectorSelector
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+# range functions computable from per-series prefix sums: O(S*T) memory,
+# no (S, J, L) window materialisation, safe at 1M series.
+_PREFIX_FNS = frozenset({
+    "rate", "increase", "delta", "idelta", "irate",
+    "sum_over_time", "count_over_time", "avg_over_time",
+    "last_over_time", "first_over_time", "present_over_time",
+    "changes", "resets",
+})
+_SIMPLE_AGGS = frozenset(
+    {"sum", "avg", "min", "max", "count", "group", "stddev", "stdvar"}
+)
+
+_FAST_HITS = global_registry.counter(
+    "greptime_promql_fast_path_total",
+    "PromQL queries served from the selector grid cache", ("event",),
+)
+
+
+def _budget_bytes() -> int:
+    return int(os.environ.get(
+        "GREPTIMEDB_TPU_PROMQL_CACHE_BYTES", 4 * 1024**3
+    ))
+
+
+def _pow2_bucket(n: int, minimum: int = 8) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class _Entry:
+    table: object
+    fieldname: str
+    version: tuple
+    registry: object            # SeriesRegistry snapshot backing the grids
+    spec: object                # ops.grid.GridSpec
+    vals: object                # (S_pad, NC) device float32
+    has: object                 # (S_pad, NC) device bool
+    tsg: object                 # (S_pad, NC) device int32
+    num_series: int
+    s_pad: int
+    nbytes: int
+    last_used: float = 0.0
+    # per-entry derived caches (device-resident, so queries move no masks)
+    match_cache: dict = field(default_factory=dict)
+    group_cache: dict = field(default_factory=dict)
+    win_cache: dict = field(default_factory=dict)
+
+
+class SelectorGridCache:
+    """LRU byte-budgeted cache of full-table selector grids."""
+
+    def __init__(self):
+        self._entries: dict[tuple, _Entry] = {}
+        self._lock = threading.Lock()
+
+    def get_entry(self, table, fieldname: str) -> _Entry | None:
+        key = (id(table), fieldname)
+        version = table.data_version()
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.table is table and e.version == version:
+                e.last_used = time.monotonic()
+                return e
+        e = _build_entry(table, fieldname, version)
+        if e is None:
+            return None
+        with self._lock:
+            self._entries[key] = e
+            e.last_used = time.monotonic()
+            self._evict_locked(keep=key)
+        return e
+
+    def _evict_locked(self, keep):
+        budget = _budget_bytes()
+        total = sum(e.nbytes for e in self._entries.values())
+        if total <= budget:
+            return
+        for key, _ in sorted(
+            self._entries.items(), key=lambda kv: kv[1].last_used
+        ):
+            if key == keep:
+                continue
+            total -= self._entries.pop(key).nbytes
+            if total <= budget:
+                return
+
+    def invalidate(self):
+        with self._lock:
+            self._entries.clear()
+
+    def drop_table(self, table):
+        with self._lock:
+            for key in [
+                k for k, e in self._entries.items() if e.table is table
+            ]:
+                del self._entries[key]
+
+
+_CACHE = SelectorGridCache()
+
+
+def _build_entry(table, fieldname: str, version) -> _Entry | None:
+    """Scan the whole table once and gridify every series onto one
+    HBM-resident grid. Resolution is the gcd of observed sample intervals
+    (coarsened if the grid would blow the cell cap, same approximation as
+    ops/window.plan_grid_and_windows)."""
+    import jax.numpy as jnp
+
+    from greptimedb_tpu.ops import grid as G
+
+    col = next(
+        (c for c in table.info.schema.field_columns if c.name == fieldname),
+        None,
+    )
+    if col is None or col.data_type.is_string():
+        return None  # no device grid for string fields; skip the scan
+    t0_build = time.perf_counter()
+    data = table.scan(field_names=[fieldname])
+    rows = data.rows
+    registry = data.registry
+    if rows is None or len(rows) == 0 or registry.num_series == 0:
+        return _Entry(
+            table, fieldname, version, registry, None, None, None, None,
+            0, 0, 0,
+        )
+    vals_np = rows.fields[fieldname]
+    if not np.issubdtype(np.asarray(vals_np).dtype, np.number):
+        return None  # string field: no device grid
+    ts = np.asarray(rows.ts, np.int64)
+    uniq_ts = np.unique(ts)
+    if len(uniq_ts) > 1:
+        res = int(np.gcd.reduce(np.diff(uniq_ts)))
+    else:
+        res = 1000
+    res = max(res, 1)
+    t_min = int(uniq_ts[0])
+    t_max = int(uniq_ts[-1])
+    s = registry.num_series
+    s_pad = _pow2_bucket(s)
+    # keep grid bytes within half the cache budget: coarsen res as needed
+    # (sacrifices exact window alignment on pathological intervals; such
+    # queries then fail the alignment check and use the generic path)
+    max_cells = max(_budget_bytes() // 2 // (9 * s_pad), 16)
+    while (t_max - t_min) // res + 2 > max_cells:
+        res *= 2
+    # anchor the grid to the data's phase: samples at t_min + k*res land
+    # exactly on cell boundaries, so query starts on sample times satisfy
+    # the alignment precondition in _plan_windows
+    t0 = t_min - res
+    nc = int(-((-(t_max - t0)) // res)) + 1
+    spec = G.GridSpec.build(t0, res, nc)
+
+    cell = spec.cell_of(ts).astype(np.int32)
+    tsrel = spec.device_ts(ts)
+    mask = np.ones(len(ts), bool)
+    if rows.field_valid is not None and fieldname in rows.field_valid:
+        mask = np.asarray(rows.field_valid[fieldname], bool)
+    gvals, ghas, gtsg = G.gridify(
+        jnp.asarray(np.asarray(rows.sid, np.int32)),
+        jnp.asarray(cell),
+        jnp.asarray(tsrel),
+        jnp.asarray(np.asarray(vals_np, np.float32)),
+        jnp.asarray(mask),
+        s_pad, nc,
+    )
+    gvals.block_until_ready()
+    nbytes = s_pad * nc * 9
+    _FAST_HITS.labels("grid_build").inc()
+    global_registry.gauge(
+        "greptime_promql_grid_build_seconds",
+        "wall seconds of the last selector grid build",
+    ).set(time.perf_counter() - t0_build)
+    return _Entry(
+        table, fieldname, version, registry, spec, gvals, ghas, gtsg,
+        s, s_pad, nbytes,
+    )
+
+
+# ----------------------------------------------------------------------
+# per-query planning against a cached grid
+# ----------------------------------------------------------------------
+
+@dataclass
+class _WinShim:
+    """Windows with traced lo/hi/t_end arrays + static scalars, shaped for
+    ops/promql.eval_range_function inside jit."""
+
+    lo: object
+    hi: object
+    t_end: object
+    range_ticks: int
+    range_seconds: float
+    l_cells: int
+
+    @property
+    def num_cells_per_window(self) -> int:
+        return self.l_cells
+
+
+@dataclass
+class _SpecShim:
+    tps: float
+
+
+def _plan_windows(entry: _Entry, ev, range_ms: int, offset_ms: int,
+                  *, align_range: bool = True):
+    """Window cell indices against the cached grid, or None if the query's
+    step/range/start don't land on cell boundaries (exactness requires
+    alignment; see ops/grid.py cell convention). Instant lookback compares
+    exact sample ticks, so only step/start need aligning for it."""
+    spec = entry.spec
+    res = spec.res
+    start = ev.start_ms - offset_ms
+    end = ev.end_ms - offset_ms
+    if ev.step_ms % res or (start - spec.t0) % res:
+        return None
+    if align_range and range_ms % res:
+        return None
+    key = (start, end, ev.step_ms, range_ms)
+    hit = entry.win_cache.get(key)
+    if hit is not None:
+        return hit
+    steps = np.arange(start, end + 1, ev.step_ms, dtype=np.int64)
+    hi_raw = (steps - spec.t0) // res
+    w = max(range_ms // res, 1)
+    hi = np.clip(hi_raw, 0, spec.num_cells - 1).astype(np.int32)
+    lo = np.clip(hi_raw - w, 0, spec.num_cells - 1).astype(np.int32)
+    lo = np.minimum(lo, hi)
+    t_end = np.clip(
+        (steps - spec.t0) // spec.unit, -2**31 + 1, 2**31 - 1
+    ).astype(np.int32)
+    import jax.numpy as jnp
+
+    # device-resident window indices: a repeated query uploads nothing
+    out = (
+        jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(t_end),
+        int(range_ms // spec.unit), range_ms / 1000.0, int(w),
+    )
+    if len(entry.win_cache) >= 64:
+        entry.win_cache.pop(next(iter(entry.win_cache)))
+    entry.win_cache[key] = out
+    return out
+
+
+def _matcher_mask_dev(entry: _Entry, matchers):
+    """Device-resident (S_pad,) bool mask for a matcher set (padded series
+    are always False). Cached so repeated queries move no bytes."""
+    import jax.numpy as jnp
+
+    key = tuple(
+        (name, op, value.pattern if hasattr(value, "pattern") else value)
+        for name, op, value in matchers
+    )
+    hit = entry.match_cache.get(key)
+    if hit is not None:
+        return hit
+    mask = np.zeros(entry.s_pad, bool)
+    if matchers:
+        mask[: entry.num_series] = entry.registry.match_mask(matchers)
+    else:
+        mask[: entry.num_series] = True
+    any_match = bool(mask.any())
+    dev = jnp.asarray(mask)
+    if len(entry.match_cache) >= 128:
+        entry.match_cache.pop(next(iter(entry.match_cache)))
+    entry.match_cache[key] = (dev, any_match)
+    return dev, any_match
+
+
+def _grouping_dev(entry: _Entry, table, grouping, without: bool):
+    """(group label dicts, device gid (S_pad,), num_groups). Padded series
+    map to group G (dropped after aggregation). Cached per label set."""
+    import jax.numpy as jnp
+
+    key = (tuple(sorted(grouping)), bool(without))
+    hit = entry.group_cache.get(key)
+    if hit is not None:
+        return hit
+    reg = entry.registry
+    codes = reg.codes_matrix()
+    visible = set(table.tag_names)
+    cols = [
+        i for i, nm in enumerate(reg.tag_names)
+        if nm in visible and not nm.startswith("__")
+        and ((nm not in grouping) if without else (nm in grouping))
+    ]
+    s = entry.num_series
+    if not cols or s == 0:
+        labels = [{}]
+        gid = np.zeros(entry.s_pad, np.int32)
+        gid[s:] = 1
+        out = (labels, jnp.asarray(gid), 1)
+        entry.group_cache[key] = out
+        return out
+    sub = codes[:s, cols]
+    uniq, inv = np.unique(sub, axis=0, return_inverse=True)
+    labels = []
+    for row in uniq:
+        lab = {}
+        for ci, code in zip(cols, row):
+            v = reg.dicts[ci].decode(int(code))
+            if v != "":
+                lab[reg.tag_names[ci]] = v
+        labels.append(lab)
+    g = len(uniq)
+    gid = np.full(entry.s_pad, g, np.int32)
+    gid[:s] = inv.astype(np.int32)
+    out = (labels, jnp.asarray(gid), g)
+    if len(entry.group_cache) >= 128:
+        entry.group_cache.pop(next(iter(entry.group_cache)))
+    entry.group_cache[key] = out
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "fname", "op", "g", "range_ticks", "range_seconds", "l_cells",
+        "tps", "fargs", "lookback_ticks",
+    ),
+)
+def _fused_query(
+    vals, has, tsg, smask, gid, lo, hi, t_end, *,
+    fname: str, op: str, g: int, range_ticks: int, range_seconds: float,
+    l_cells: int, tps: float, fargs: tuple, lookback_ticks: int,
+):
+    """The whole query as one XLA program: matcher mask, range function or
+    instant lookback, cross-series aggregation."""
+    from greptimedb_tpu.ops import promql as K
+    from greptimedb_tpu.ops import window as W
+
+    import jax.numpy as jnp
+
+    has = has & smask[:, None]
+    if fname == "__instant__":
+        out, pres = W.instant_lookback(
+            vals, has, tsg, hi, t_end, lookback_ticks
+        )
+    else:
+        win = _WinShim(lo, hi, t_end, range_ticks, range_seconds, l_cells)
+        out, pres = K.eval_range_function(
+            fname, vals, has, tsg, win, _SpecShim(tps), args=fargs
+        )
+    vals_g, pres_g = K.aggregate_across_series(out, pres, gid, g + 1, op)
+    # single packed (2G, J) buffer: one device->host transfer per query
+    return jnp.concatenate([
+        vals_g[:g], pres_g[:g].astype(vals_g.dtype),
+    ])
+
+
+# ----------------------------------------------------------------------
+# entry point
+# ----------------------------------------------------------------------
+
+def try_fast(engine, e, ev):
+    """Serve `agg(range_fn(selector))` / `agg(selector)` from the grid
+    cache. Returns a VectorValue, or None to fall back to the generic
+    path."""
+    from greptimedb_tpu.promql.engine import VectorValue, _empty_vector
+
+    if not isinstance(e, Agg) or e.op not in _SIMPLE_AGGS:
+        return None
+    inner = e.expr
+    fargs: tuple = ()
+    if isinstance(inner, Call) and inner.name in _PREFIX_FNS:
+        sel = inner.args[-1]
+        if not isinstance(sel, VectorSelector) or sel.range_ms is None:
+            return None
+        fname = inner.name
+        range_ms = sel.range_ms
+    elif isinstance(inner, VectorSelector) and inner.range_ms is None:
+        sel = inner
+        fname = "__instant__"
+        range_ms = ev.lookback_ms
+    else:
+        return None
+    if sel.at_ms is not None:
+        return None
+    table, field_sel, raw_matchers = engine._resolve_table(sel)
+    if table is None:
+        return None
+    try:
+        fieldname = engine._value_field(table, field_sel)
+    except Exception:
+        return None
+    entry = _CACHE.get_entry(table, fieldname)
+    if entry is None:
+        _FAST_HITS.labels("fallback").inc()
+        return None
+    if entry.num_series == 0:
+        _FAST_HITS.labels("hit").inc()
+        return _empty_vector(ev)
+    win = _plan_windows(
+        entry, ev, range_ms, sel.offset_ms,
+        align_range=fname != "__instant__",
+    )
+    if win is None:
+        _FAST_HITS.labels("fallback").inc()
+        return None
+    lo, hi, t_end, range_ticks, range_seconds, l_cells = win
+    matchers = engine._to_registry_matchers(raw_matchers, table)
+    smask, any_match = _matcher_mask_dev(entry, matchers)
+    if not any_match:
+        _FAST_HITS.labels("hit").inc()
+        return _empty_vector(ev)
+    labels, gid, g = _grouping_dev(entry, table, e.grouping, e.without)
+    lookback_ticks = max(int(ev.lookback_ms // entry.spec.unit), 1)
+    packed = _fused_query(
+        entry.vals, entry.has, entry.tsg, smask, gid,
+        lo, hi, t_end,
+        fname=fname, op=e.op, g=g, range_ticks=range_ticks,
+        range_seconds=range_seconds, l_cells=l_cells,
+        tps=entry.spec.tps, fargs=fargs, lookback_ticks=lookback_ticks,
+    )
+    packed_np = np.asarray(packed, np.float64)
+    vals_np = packed_np[:g]
+    pres_np = packed_np[g:] != 0.0
+    keep = pres_np.any(axis=1)
+    _FAST_HITS.labels("hit").inc()
+    if not keep.all():
+        idx = np.nonzero(keep)[0]
+        return VectorValue(
+            [labels[i] for i in idx], vals_np[idx], pres_np[idx]
+        )
+    return VectorValue(list(labels), vals_np, pres_np)
+
+
+def invalidate_cache():
+    _CACHE.invalidate()
+
+
+def drop_table_entries(table):
+    """Called by the catalog on DROP TABLE so grids don't pin dead tables."""
+    _CACHE.drop_table(table)

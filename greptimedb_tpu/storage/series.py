@@ -24,6 +24,7 @@ class SeriesRegistry:
         self._series: dict[tuple, int] = {}
         self._rows: list[tuple] = []
         self._lock = threading.Lock()
+        self._codes_cache: np.ndarray | None = None
 
     def __len__(self) -> int:
         return len(self._rows)
@@ -100,7 +101,80 @@ class SeriesRegistry:
         i = self.tag_names.index(tag_name)
         if not self._rows or not self.tag_names:
             return np.zeros(len(self._rows), dtype=np.int32)
-        return np.asarray([r[i] for r in self._rows], dtype=np.int32)
+        return self.codes_matrix()[:, i]
+
+    def codes_matrix(self) -> np.ndarray:
+        """(num_series, num_tags) int32 per-sid tag codes, cached.
+
+        The dictionary-coded label plane: matchers and group-by run over
+        this matrix instead of per-series Python dicts, which is what keeps
+        1M-series label algebra vectorized (the capability analog of the
+        reference's mcmp-encoded primary-key comparisons)."""
+        with self._lock:
+            n = len(self._rows)
+            k = len(self.tag_names)
+            c = self._codes_cache
+            if c is not None and c.shape == (n, k):
+                return c
+            if n == 0 or k == 0:
+                c = np.zeros((n, k), dtype=np.int32)
+            else:
+                c = np.asarray(self._rows, dtype=np.int32).reshape(n, k)
+            self._codes_cache = c
+            return c
+
+    def match_mask(self, matchers: list[tuple[str, str, object]]) -> np.ndarray:
+        """(num_series,) bool mask of series satisfying all matchers.
+
+        Predicates are evaluated once per distinct dictionary value (regexes
+        included), then broadcast through the int32 code columns — O(distinct
+        values) string work instead of O(series)."""
+        n = len(self._rows)
+        keep = np.ones(n, dtype=bool)
+        codes = self.codes_matrix()
+        for name, op, value in matchers:
+            if name not in self.tag_names:
+                # a missing tag behaves as the empty string on every series
+                if op == "eq":
+                    ok = value == ""
+                elif op == "ne":
+                    ok = value != ""
+                elif op == "in":
+                    ok = "" in value
+                elif op == "nin":
+                    ok = "" not in value
+                elif op == "re":
+                    ok = bool(value.fullmatch(""))
+                elif op == "nre":
+                    ok = not value.fullmatch("")
+                else:
+                    raise ValueError(op)
+                if not ok:
+                    keep[:] = False
+                continue
+            i = self.tag_names.index(name)
+            d = self.dicts[i]
+            vals = np.asarray(list(d.values), dtype=object)
+            if op == "eq":
+                ok_codes = vals == value
+            elif op == "ne":
+                ok_codes = vals != value
+            elif op == "in":
+                ok_codes = np.isin(vals.astype(str), list(value))
+            elif op == "nin":
+                ok_codes = ~np.isin(vals.astype(str), list(value))
+            elif op == "re":
+                ok_codes = np.asarray(
+                    [bool(value.fullmatch(str(v))) for v in vals]
+                )
+            elif op == "nre":
+                ok_codes = np.asarray(
+                    [not value.fullmatch(str(v)) for v in vals]
+                )
+            else:
+                raise ValueError(op)
+            keep &= ok_codes[codes[:, i]]
+        return keep
 
     def tag_values(self, tag_name: str) -> np.ndarray:
         """Per-sid decoded value of one tag column: (num_series,) object."""
@@ -119,44 +193,7 @@ class SeriesRegistry:
         """Sids whose tags satisfy all matchers (op in {eq, ne, in, nin, re,
         nre}; value is str, list[str], or compiled regex). Host-side series
         pruning — the capability analog of inverted-index applier pruning."""
-        n = len(self._rows)
-        keep = np.ones(n, dtype=bool)
-        for name, op, value in matchers:
-            if name not in self.tag_names:
-                # a missing tag behaves as the empty string on every series
-                if op == "eq":
-                    keep &= value == ""
-                elif op == "ne":
-                    keep &= value != ""
-                elif op == "in":
-                    keep &= "" in value
-                elif op == "nin":
-                    keep &= "" not in value
-                elif op == "re":
-                    keep &= bool(value.fullmatch(""))
-                elif op == "nre":
-                    keep &= not value.fullmatch("")
-                continue
-            vals = self.tag_values(name)
-            if op == "eq":
-                keep &= vals == value
-            elif op == "ne":
-                keep &= vals != value
-            elif op == "in":
-                keep &= np.isin(vals.astype(str), list(value))
-            elif op == "nin":
-                keep &= ~np.isin(vals.astype(str), list(value))
-            elif op == "re":
-                keep &= np.asarray(
-                    [bool(value.fullmatch(str(v))) for v in vals]
-                )
-            elif op == "nre":
-                keep &= np.asarray(
-                    [not value.fullmatch(str(v)) for v in vals]
-                )
-            else:
-                raise ValueError(op)
-        return np.nonzero(keep)[0].astype(np.int32)
+        return np.nonzero(self.match_mask(matchers))[0].astype(np.int32)
 
     # ---- persistence --------------------------------------------------
     def snapshot(self) -> dict:
