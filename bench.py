@@ -96,6 +96,53 @@ def main():
             f"in {load_s:.1f}s ({rows_total / load_s:,.0f} rows/s)",
             file=sys.stderr,
         )
+        print(json.dumps({
+            "metric": "tsbs_ingest_skip_wal_rows_per_s",
+            "value": round(rows_total / load_s),
+            "unit": "rows/s",
+            # bulk-load path (no durability) vs the reference's WAL-on
+            # 387,698 rows/s — see tsbs_ingest_wal_rows_per_s for the
+            # apples-to-apples number
+            "vs_baseline": round(rows_total / load_s / 387_698, 2),
+        }))
+
+        # WAL-on ingest (durability on, the reference's TSBS condition:
+        # docs/benchmarks/tsbs/v0.9.1.md:28, 387,698 rows/s local)
+        inst.execute_sql(
+            f"create table cpu_wal (ts timestamp time index, "
+            f"hostname string primary key, {cols})"
+        )
+        wal_table = inst.catalog.table("public", "cpu_wal")
+        wal_table.write(   # intern tags once; steady-state is what TSBS measures
+            {"hostname": hostnames},
+            np.zeros(HOSTS, np.int64),
+            {f: np.zeros(HOSTS) for f in FIELD_NAMES},
+        )
+        t_wal = time.perf_counter()
+        wal_rows = 0
+        for b in range(3):
+            ts_block = (
+                np.arange(b * 360, (b + 1) * 360, dtype=np.int64)
+                * INTERVAL_MS + INTERVAL_MS
+            )
+            ts = np.tile(ts_block, HOSTS)
+            hosts = np.repeat(hostnames, 360)
+            n = len(ts)
+            fields = {
+                f: (rng.random(n, dtype=np.float32) * 100.0).astype(
+                    np.float64
+                )
+                for f in FIELD_NAMES
+            }
+            wal_table.write({"hostname": hosts}, ts, fields)
+            wal_rows += n
+        wal_s = time.perf_counter() - t_wal
+        print(json.dumps({
+            "metric": "tsbs_ingest_wal_rows_per_s",
+            "value": round(wal_rows / wal_s),
+            "unit": "rows/s",
+            "vs_baseline": round(wal_rows / wal_s / 387_698, 2),
+        }))
 
         items = ", ".join(
             f"avg({f}) RANGE '1h'" for f in FIELD_NAMES

@@ -113,9 +113,16 @@ class Dictionary:
         return code
 
     def intern_array(self, values: np.ndarray) -> np.ndarray:
-        """Vectorized interning: np.unique once per batch, dict work only on
-        the (few) distinct values, then a single np.take to expand."""
-        uniq, inv = np.unique(values, return_inverse=True)
+        """Vectorized interning: hash-based dictionary encode once per
+        batch (Arrow, O(n)), dict work only on the (few) distinct values,
+        then a single np.take to expand. np.unique's sort-based O(n log n)
+        string compares are the fallback for non-string payloads."""
+        try:
+            enc = pa.array(values, type=pa.string()).dictionary_encode()
+            uniq = enc.dictionary.to_pylist()
+            inv = enc.indices.to_numpy(zero_copy_only=False)
+        except (pa.lib.ArrowInvalid, pa.lib.ArrowTypeError):
+            uniq, inv = np.unique(values, return_inverse=True)
         codes = self._codes
         uniq_codes = np.empty(len(uniq), dtype=np.int32)
         for i, v in enumerate(uniq):
@@ -125,7 +132,7 @@ class Dictionary:
                 codes[v] = c
                 self._values.append(v)
             uniq_codes[i] = c
-        return uniq_codes[inv]
+        return uniq_codes[np.asarray(inv, np.int64)]
 
     def lookup(self, value: str) -> int | None:
         return self._codes.get(value)

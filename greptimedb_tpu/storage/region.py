@@ -94,6 +94,9 @@ class Region:
         self.memtable = Memtable(meta.field_names,
                                  window_ms=meta.options.memtable_window_ms)
         self._frozen: list[Memtable] = []
+        # intern deltas not yet on the log (skip_wal writes, failed
+        # appends); the next WAL-on entry carries them, flush clears them
+        self._pending_new_series: list[tuple[int, list[str]]] = []
         self._seq = self.manifest.state.committed_sequence
         self._truncate_epoch = 0
         self._lock = threading.RLock()
@@ -130,28 +133,69 @@ class Region:
         with self._lock:
             base_seq = self._seq
             self._seq += n
-            if not skip_wal:
-                payload = codec.encode_columns(
-                    {"__ts": np.asarray(ts, np.int64),
-                     **{f"__tag_{k}": np.asarray(v, object)
-                        for k, v in tag_columns.items()},
-                     **{f"__f_{k}": np.asarray(v) for k, v in fields.items()},
-                     **({f"__v_{k}": np.asarray(v, bool)
-                         for k, v in (field_valid or {}).items()})},
-                    meta={"op": op, "base_seq": base_seq},
-                )
-                self.wal.append(payload)
-            self._apply_rows(tag_columns, ts, fields, field_valid, op, base_seq)
+            rows, new_series = self._make_rows(
+                tag_columns, ts, fields, field_valid, op, base_seq
+            )
+            if skip_wal:
+                # rows skip durability, but the intern delta must still
+                # reach the log eventually or later durable entries would
+                # reference unreconstructable sids — park it for the next
+                # WAL-on write (flush clears it: the manifest snapshot
+                # then covers the registry)
+                self._pending_new_series.extend(new_series)
+            else:
+                # int-coded WAL payload (fmt 2): sids + numeric columns as
+                # raw buffers, tag STRINGS only for series first seen in
+                # this batch — the end-to-end int-coding of tags the
+                # reference gets from its mcmp primary-key encoding
+                # (/root/reference/src/mito2/src/row_converter.rs:54).
+                # Only caller-provided fields travel; replay backfills the
+                # rest exactly like _make_rows does.
+                cols = {"__ts": rows.ts, "__sid": rows.sid}
+                for k in fields:
+                    cols[f"__f_{k}"] = rows.fields[k]
+                for k, v in (field_valid or {}).items():
+                    cols[f"__v_{k}"] = np.asarray(v, bool)
+                delta = self._pending_new_series + list(new_series)
+                payload = codec.encode_columns(cols, meta={
+                    "fmt": 2, "op": op, "base_seq": base_seq,
+                    "new_series": [[sid, tags] for sid, tags in delta],
+                })
+                try:
+                    self.wal.append(payload)
+                except Exception:
+                    # the registry already holds the delta; make sure a
+                    # future successful entry re-reports it (ensure_series
+                    # is idempotent on replay)
+                    self._pending_new_series.extend(new_series)
+                    raise
+                self._pending_new_series = []
+            self.memtable.append(rows)
             return base_seq
 
-    def _apply_rows(self, tag_columns, ts, fields, field_valid, op, base_seq):
+    def _make_rows(self, tag_columns, ts, fields, field_valid, op, base_seq):
+        """Intern tags and normalize fields into sid-resolved ColumnarRows.
+        Returns (rows, new_series_delta)."""
         n = len(ts)
-        sids = self.series.intern_rows(
+        sids, new_series = self.series.intern_rows_delta(
             [np.asarray(tag_columns[name], object) if name in tag_columns
              else np.full(n, "", object)
              for name in self.meta.tag_names],
             n=n,
         )
+        full_fields, valids = self._normalize_fields(n, fields, field_valid)
+        rows = ColumnarRows(
+            sid=sids,
+            ts=np.asarray(ts, np.int64),
+            seq=np.arange(base_seq, base_seq + n, dtype=np.uint64),
+            op=np.full(n, op, dtype=np.uint8),
+            fields=full_fields,
+            field_valid=valids or None,
+        )
+        return rows, new_series
+
+    def _normalize_fields(self, n, fields, field_valid):
+        """Every schema field present; absent ones zero-filled + invalid."""
         full_fields = {}
         valids = dict(field_valid) if field_valid else {}
         for name in self.meta.field_names:
@@ -160,13 +204,11 @@ class Region:
             else:
                 full_fields[name] = np.zeros(n, dtype=np.float64)
                 valids[name] = np.zeros(n, dtype=bool)
-        rows = ColumnarRows(
-            sid=sids,
-            ts=np.asarray(ts, np.int64),
-            seq=np.arange(base_seq, base_seq + n, dtype=np.uint64),
-            op=np.full(n, op, dtype=np.uint8),
-            fields=full_fields,
-            field_valid=valids or None,
+        return full_fields, valids
+
+    def _apply_rows(self, tag_columns, ts, fields, field_valid, op, base_seq):
+        rows, _ = self._make_rows(
+            tag_columns, ts, fields, field_valid, op, base_seq
         )
         self.memtable.append(rows)
 
@@ -180,19 +222,45 @@ class Region:
         for entry in self.wal.replay(from_id):
             cols, meta = codec.decode_columns(entry.payload)
             ts = cols.pop("__ts")
-            tags = {}
-            fields = {}
-            valids = {}
-            for k, v in cols.items():
-                if k.startswith("__tag_"):
-                    tags[k[6:]] = v
-                elif k.startswith("__f_"):
-                    fields[k[4:]] = v
-                elif k.startswith("__v_"):
-                    valids[k[4:]] = v
             base_seq = meta["base_seq"]
-            self._apply_rows(tags, ts, fields, valids or None,
-                             meta["op"], base_seq)
+            if meta.get("fmt") == 2:
+                # int-coded payload: restore the intern delta, then feed
+                # the memtable directly — no re-interning
+                for sid, tag_vals in meta.get("new_series", []):
+                    self.series.ensure_series(int(sid), list(tag_vals))
+                n = len(ts)
+                fields = {}
+                valids = {}
+                for k, v in cols.items():
+                    if k.startswith("__f_"):
+                        fields[k[4:]] = v
+                    elif k.startswith("__v_"):
+                        valids[k[4:]] = v
+                full_fields, valids = self._normalize_fields(
+                    n, fields, valids or None
+                )
+                rows = ColumnarRows(
+                    sid=np.asarray(cols["__sid"], np.int32),
+                    ts=np.asarray(ts, np.int64),
+                    seq=np.arange(base_seq, base_seq + n, dtype=np.uint64),
+                    op=np.full(n, meta["op"], dtype=np.uint8),
+                    fields=full_fields,
+                    field_valid=valids or None,
+                )
+                self.memtable.append(rows)
+            else:
+                tags = {}
+                fields = {}
+                valids = {}
+                for k, v in cols.items():
+                    if k.startswith("__tag_"):
+                        tags[k[6:]] = v
+                    elif k.startswith("__f_"):
+                        fields[k[4:]] = v
+                    elif k.startswith("__v_"):
+                        valids[k[4:]] = v
+                self._apply_rows(tags, ts, fields, valids or None,
+                                 meta["op"], base_seq)
             self._seq = max(self._seq, base_seq + len(ts))
 
     # ------------------------------------------------------------------
@@ -230,6 +298,9 @@ class Region:
                 "committed_sequence": seq_now,
                 "series_snapshot": self.series.snapshot(),
             })
+            # the snapshot covers every live series: replay never needs
+            # pre-flush intern deltas again
+            self._pending_new_series = []
             self._frozen.remove(frozen)
             self.wal.obsolete(flushed_entry_id)
         return meta

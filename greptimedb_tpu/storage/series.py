@@ -38,6 +38,15 @@ class SeriesRegistry:
         """Map N rows of tag values to sids, creating new series on demand.
         tag_columns are object arrays aligned with tag_names. For tagless
         tables pass `n` explicitly (every row maps to series 0)."""
+        sids, _ = self.intern_rows_delta(tag_columns, n)
+        return sids
+
+    def intern_rows_delta(
+        self, tag_columns: list[np.ndarray], n: int | None = None,
+    ) -> tuple[np.ndarray, list[tuple[int, list[str]]]]:
+        """intern_rows that also reports the series created by this batch
+        as (sid, decoded tag values) in sid order — what the WAL records so
+        replay can rebuild the registry without re-interning strings."""
         assert len(tag_columns) == len(self.tag_names)
         if tag_columns:
             n = len(tag_columns[0])
@@ -46,27 +55,71 @@ class SeriesRegistry:
         with self._lock:
             if not tag_columns:
                 # tagless table: single series 0
+                new: list[tuple[int, list[str]]] = []
                 if not self._rows:
                     self._series[()] = 0
                     self._rows.append(())
-                return np.zeros(n, dtype=np.int32)
+                    new.append((0, []))
+                return np.zeros(n, dtype=np.int32), new
             codes = [d.intern_array(c) for d, c in zip(self.dicts, tag_columns)]
             series = self._series
             rows = self._rows
-            stacked = np.stack(codes, axis=1)
             # dict work only on distinct tag combinations (same pattern as
-            # Dictionary.intern_array): unique rows, then expand
-            uniq, inv = np.unique(stacked, axis=0, return_inverse=True)
-            uniq_sids = np.empty(len(uniq), dtype=np.int32)
-            for i, row in enumerate(uniq):
-                key = tuple(int(c) for c in row)
-                sid = series.get(key)
+            # Dictionary.intern_array): unique rows, then expand. Rows fold
+            # into one int64 key when the code space fits (radix = dict
+            # sizes), avoiding np.unique's 2-D lexsort.
+            radices = [len(d) + 1 for d in self.dicts]
+            space = 1
+            for r in radices:
+                space *= r
+            if space < 2**62:
+                key = codes[0].astype(np.int64)
+                for c, r in zip(codes[1:], radices[1:]):
+                    key = key * r + c
+                _, first, inv = np.unique(
+                    key, return_index=True, return_inverse=True
+                )
+            else:
+                _, first, inv = np.unique(
+                    np.stack(codes, axis=1), axis=0,
+                    return_index=True, return_inverse=True,
+                )
+            uniq_iter = first
+            uniq_sids = np.empty(len(uniq_iter), dtype=np.int32)
+            new = []
+            for i, row_idx in enumerate(uniq_iter):
+                key_t = tuple(int(c[row_idx]) for c in codes)
+                sid = series.get(key_t)
                 if sid is None:
                     sid = len(rows)
-                    series[key] = sid
-                    rows.append(key)
+                    series[key_t] = sid
+                    rows.append(key_t)
+                    new.append((sid, [
+                        d.decode(c) for d, c in zip(self.dicts, key_t)
+                    ]))
                 uniq_sids[i] = sid
-            return uniq_sids[np.ravel(inv)]
+            return uniq_sids[np.ravel(inv)], new
+
+    def ensure_series(self, sid: int, tag_values: list[str]) -> None:
+        """Idempotently (re)create one series at a known sid — WAL replay
+        of an intern delta. Sids arrive in creation order, so a gap means a
+        corrupted log. Tag values recorded before an ALTER ADD TAG are
+        shorter than the current tag set; the new tags read "" (same
+        backfill as add_tag gives live series)."""
+        with self._lock:
+            if sid < len(self._rows):
+                return
+            if sid != len(self._rows):
+                raise ValueError(
+                    f"series id gap in replay: have {len(self._rows)}, "
+                    f"got {sid}"
+                )
+            vals = list(tag_values) + [""] * (len(self.dicts) - len(tag_values))
+            key = tuple(
+                d.intern(v) for d, v in zip(self.dicts, vals)
+            )
+            self._series[key] = sid
+            self._rows.append(key)
 
     def add_tag(self, name: str) -> None:
         """Add a tag column; existing series get "" for it. Sids are stable
