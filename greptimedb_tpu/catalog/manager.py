@@ -130,6 +130,7 @@ class CatalogManager:
         self.store = engine.store
         self._lock = threading.RLock()
         self._databases: dict[str, dict[str, Table]] = {}
+        self._views: dict[str, dict[str, str]] = {}  # db -> name -> SQL text
         self._next_table_id = 1024
         self._load()
         if DEFAULT_SCHEMA not in self._databases:
@@ -144,6 +145,9 @@ class CatalogManager:
             return
         doc = json.loads(self.store.read(CATALOG_PATH))
         self._next_table_id = doc.get("next_table_id", 1024)
+        self._views = {
+            db: dict(views) for db, views in doc.get("views", {}).items()
+        }
         for db_name, tables in doc.get("databases", {}).items():
             db = self._databases.setdefault(db_name, {})
             infos = [TableInfo.from_json(t) for t in tables]
@@ -159,6 +163,7 @@ class CatalogManager:
                 db: [t.info.to_json() for t in tables.values()]
                 for db, tables in self._databases.items()
             },
+            "views": {db: dict(v) for db, v in self._views.items() if v},
         }
         self.store.write(CATALOG_PATH, json.dumps(doc).encode())
 
@@ -202,7 +207,44 @@ class CatalogManager:
             for tname in list(self._databases[name]):
                 self.drop_table(name, tname)
             del self._databases[name]
+            self._views.pop(name, None)
             self._persist()
+
+    # ------------------------------------------------------------------
+    # views (name -> stored SQL text; execution re-plans on every query,
+    # the reference's view substitution in src/query/src/planner.rs)
+    # ------------------------------------------------------------------
+    def create_view(self, database: str, name: str, sql_text: str,
+                    *, or_replace: bool = False):
+        with self._lock:
+            self._db(database)  # database must exist
+            if name in self._databases.get(database, {}):
+                raise InvalidArgumentError(
+                    f"a table named {name!r} already exists"
+                )
+            views = self._views.setdefault(database, {})
+            if name in views and not or_replace:
+                raise InvalidArgumentError(f"view already exists: {name}")
+            views[name] = sql_text
+            self._persist()
+
+    def drop_view(self, database: str, name: str, *, if_exists: bool = False):
+        with self._lock:
+            views = self._views.get(database, {})
+            if name not in views:
+                if if_exists:
+                    return
+                raise TableNotFoundError(f"view not found: {name}")
+            del views[name]
+            self._persist()
+
+    def maybe_view(self, database: str, name: str) -> str | None:
+        with self._lock:
+            return self._views.get(database, {}).get(name)
+
+    def view_names(self, database: str) -> list[str]:
+        with self._lock:
+            return sorted(self._views.get(database, {}))
 
     def database_names(self) -> list[str]:
         with self._lock:
@@ -228,6 +270,10 @@ class CatalogManager:
     ) -> Table:
         with self._lock:
             db = self._db(database)
+            if name in self._views.get(database, {}):
+                raise InvalidArgumentError(
+                    f"a view named {name!r} already exists"
+                )
             if name in db:
                 if if_not_exists:
                     return db[name]

@@ -114,6 +114,22 @@ class Standalone:
                           ) -> Output:
         if isinstance(stmt, A.Select):
             return Output.records(self._select(stmt, ctx))
+        if isinstance(stmt, A.SetOp):
+            from greptimedb_tpu.query import relational
+
+            return Output.records(relational.execute(self, stmt, ctx))
+        if isinstance(stmt, A.CreateView):
+            db, name = self._resolve(stmt.name, ctx)
+            if stmt.text is None:
+                raise UnsupportedError("CREATE VIEW requires query text")
+            self.catalog.create_view(
+                db, name, stmt.text, or_replace=stmt.or_replace
+            )
+            return Output.rows(0)
+        if isinstance(stmt, A.DropView):
+            db, name = self._resolve(stmt.name, ctx)
+            self.catalog.drop_view(db, name, if_exists=stmt.if_exists)
+            return Output.rows(0)
         if isinstance(stmt, A.Insert):
             return Output.rows(self._insert(stmt, ctx))
         if isinstance(stmt, A.Delete):
@@ -165,6 +181,19 @@ class Standalone:
             return self._drop_flow(stmt, ctx)
         if isinstance(stmt, A.ShowFlows):
             return Output.records(self._show_flows())
+        if isinstance(stmt, A.ShowViews):
+            return Output.records(_result_from_lists(
+                ["Views"], [self.catalog.view_names(ctx.database)]
+            ))
+        if isinstance(stmt, A.ShowCreateView):
+            db, name = self._resolve(stmt.name, ctx)
+            sql_text = self.catalog.maybe_view(db, name)
+            if sql_text is None:
+                raise TableNotFoundError(f"view not found: {name}")
+            return Output.records(_result_from_lists(
+                ["View", "Create View"],
+                [[name], [f"CREATE VIEW {name} AS {sql_text}"]],
+            ))
         if isinstance(stmt, A.Copy):
             return Output.rows(self._copy(stmt, ctx))
         raise UnsupportedError(
@@ -371,6 +400,15 @@ class Standalone:
     # queries
     # ------------------------------------------------------------------
     def _select(self, stmt: A.Select, ctx: QueryContext) -> QueryResult:
+        from greptimedb_tpu.query import relational
+
+        if relational.needs_relational(self, stmt, ctx):
+            return relational.execute(self, stmt, ctx)
+        return self._select_single(stmt, ctx)
+
+    def _select_single(self, stmt: A.Select, ctx: QueryContext) -> QueryResult:
+        """Single-table fast path: plan straight onto the storage scan +
+        device grid caches."""
         table = None
         ts_name = None
         tag_names: list[str] = []

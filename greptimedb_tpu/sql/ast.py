@@ -104,6 +104,59 @@ class Case(Expr):
     else_: Optional[Expr]
 
 
+@dataclass
+class ScalarSubquery(Expr):
+    """(SELECT ...) used as a scalar value (uncorrelated)."""
+
+    query: "Statement"
+
+
+@dataclass
+class InSubquery(Expr):
+    """x [NOT] IN (SELECT ...) (uncorrelated)."""
+
+    operand: Expr
+    query: "Statement"
+    negated: bool = False
+
+
+@dataclass
+class Exists(Expr):
+    """[NOT] EXISTS (SELECT ...) (uncorrelated)."""
+
+    query: "Statement"
+    negated: bool = False
+
+
+# ----------------------------------------------------------------------
+# FROM sources
+# ----------------------------------------------------------------------
+
+@dataclass
+class TableName:
+    """A (possibly aliased) base table, CTE, or view reference."""
+
+    name: str
+    alias: str | None = None
+
+
+@dataclass
+class SubquerySource:
+    """(SELECT ...) AS alias in FROM."""
+
+    query: "Statement"              # Select | SetOp
+    alias: str
+
+
+@dataclass
+class JoinSource:
+    left: object                    # TableName | SubquerySource | JoinSource
+    right: object
+    kind: str                       # inner | left | right | full | cross
+    on: Expr | None = None
+    using: list[str] | None = None
+
+
 # ----------------------------------------------------------------------
 # statements
 # ----------------------------------------------------------------------
@@ -209,7 +262,7 @@ class RangeClause:
 @dataclass
 class Select(Statement):
     items: list[SelectItem]
-    from_table: str | None = None
+    from_table: str | None = None   # set when FROM is one plain table
     where: Expr | None = None
     group_by: list[Expr] = field(default_factory=list)
     having: Expr | None = None
@@ -218,6 +271,23 @@ class Select(Statement):
     offset: int | None = None
     range_clause: RangeClause | None = None
     distinct: bool = False
+    source: object | None = None    # TableName | SubquerySource | JoinSource
+    ctes: list[tuple[str, "Statement"]] = field(default_factory=list)
+
+
+@dataclass
+class SetOp(Statement):
+    """UNION / INTERSECT / EXCEPT compound select. Trailing ORDER BY /
+    LIMIT apply to the combined result."""
+
+    op: str                         # union | intersect | except
+    all: bool
+    left: Statement                 # Select | SetOp
+    right: Statement
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: int | None = None
+    offset: int | None = None
+    ctes: list[tuple[str, "Statement"]] = field(default_factory=list)
 
 
 @dataclass
@@ -245,6 +315,16 @@ class ShowCreateTable(Statement):
 @dataclass
 class ShowFlows(Statement):
     pass
+
+
+@dataclass
+class ShowViews(Statement):
+    pass
+
+
+@dataclass
+class ShowCreateView(Statement):
+    name: str
 
 
 @dataclass
@@ -289,8 +369,9 @@ class DropFlow(Statement):
 @dataclass
 class CreateView(Statement):
     name: str
-    query: Select
+    query: Statement                # Select | SetOp
     or_replace: bool = False
+    text: str | None = None         # raw SQL of the query (persisted)
 
 
 @dataclass

@@ -129,8 +129,8 @@ class Parser:
         if t.kind != Tok.IDENT:
             raise InvalidSyntaxError(f"expected statement at {t.pos}")
         kw = t.upper
-        if kw == "SELECT":
-            return self.select()
+        if kw in ("SELECT", "WITH"):
+            return self.select_or_setop()
         if kw == "CREATE":
             return self.create()
         if kw == "DROP":
@@ -177,11 +177,13 @@ class Parser:
             self.expect_kw("VIEW")
             name = self.qualified_name()
             self.expect_kw("AS")
-            return A.CreateView(name, self.select(), or_replace=True)
+            q, text = self._query_with_text()
+            return A.CreateView(name, q, or_replace=True, text=text)
         if self.eat_kw("VIEW"):
             name = self.qualified_name()
             self.expect_kw("AS")
-            return A.CreateView(name, self.select())
+            q, text = self._query_with_text()
+            return A.CreateView(name, q, text=text)
         if self.eat_kw("FLOW"):
             ine = self._if_not_exists()
             name = self.qualified_name()
@@ -204,6 +206,15 @@ class Parser:
             self.expect_kw("TABLE")
             return self.create_table(external=True)
         raise InvalidSyntaxError(f"unsupported CREATE at {self.peek().pos}")
+
+    def _query_with_text(self) -> tuple[A.Statement, str]:
+        """Parse a select/compound and return it with its raw SQL text
+        (what the catalog persists for views)."""
+        start = self.peek().pos
+        q = self.select_or_setop()
+        t = self.peek()
+        end = t.pos if t.kind != Tok.EOF else len(self.sql)
+        return q, self.sql[start:end].strip()
 
     def _if_not_exists(self) -> bool:
         if self.at_kw("IF"):
@@ -485,12 +496,120 @@ class Parser:
             return A.ShowTables(like=like, database=db, full=full)
         if self.eat_kw("FLOWS"):
             return A.ShowFlows()
+        if self.eat_kw("VIEWS"):
+            return A.ShowViews()
         if self.eat_kw("CREATE"):
+            if self.eat_kw("VIEW"):
+                return A.ShowCreateView(self.qualified_name())
             self.expect_kw("TABLE")
             return A.ShowCreateTable(self.qualified_name())
         raise InvalidSyntaxError(f"unsupported SHOW at {self.peek().pos}")
 
     # ---- SELECT -------------------------------------------------------
+    def select_or_setop(self) -> A.Statement:
+        """[WITH ...] select-core (UNION|INTERSECT|EXCEPT [ALL] core)*.
+        A trailing ORDER BY / LIMIT on the last core applies to the whole
+        compound (standard SQL)."""
+        ctes: list[tuple[str, A.Statement]] = []
+        if self.eat_kw("WITH"):
+            while True:
+                name = self.ident()
+                self.expect_kw("AS")
+                self.expect_op("(")
+                q = self.select_or_setop()
+                self.expect_op(")")
+                ctes.append((name, q))
+                if not self.eat_op(","):
+                    break
+        left, l_paren = self._intersect_level()
+        had_setop = False
+        last_paren = l_paren
+        while self.at_kw("UNION", "EXCEPT"):
+            op = self.next().upper.lower()
+            all_ = self.eat_kw("ALL")
+            self.eat_kw("DISTINCT")
+            self._check_core_clean(left, l_paren or had_setop)
+            right, r_paren = self._intersect_level()
+            left = A.SetOp(op=op, all=all_, left=left, right=right)
+            had_setop = True
+            last_paren = r_paren
+        if isinstance(left, A.SetOp):
+            # trailing order/limit of the last UNPARENTHESIZED core binds
+            # to the whole compound (standard SQL); a parenthesized
+            # operand keeps its own ORDER BY / LIMIT
+            last = left.right
+            if isinstance(last, (A.Select, A.SetOp)) and not last_paren \
+                    and not left.order_by and left.limit is None \
+                    and (last.order_by or last.limit is not None):
+                left.order_by = last.order_by
+                left.limit = last.limit
+                left.offset = last.offset
+                last.order_by = []
+                last.limit = last.offset = None
+            # a parenthesized last operand keeps its own clauses; the
+            # compound's ORDER BY / LIMIT can still follow the parens
+            if not left.order_by and self.eat_kw("ORDER"):
+                self.expect_kw("BY")
+                left.order_by = [self.order_item()]
+                while self.eat_op(","):
+                    left.order_by.append(self.order_item())
+            if left.limit is None and self.eat_kw("LIMIT"):
+                left.limit = int(self.next().text)
+            if left.offset is None and self.eat_kw("OFFSET"):
+                left.offset = int(self.next().text)
+        if ctes:
+            left.ctes = ctes
+        return left
+
+    def _intersect_level(self) -> tuple[A.Statement, bool]:
+        """INTERSECT binds tighter than UNION/EXCEPT (standard SQL).
+        Returns (stmt, last operand was parenthesized)."""
+        left, l_paren = self.select_core()
+        had = False
+        last_paren = l_paren
+        while self.at_kw("INTERSECT"):
+            self.next()
+            all_ = self.eat_kw("ALL")
+            self.eat_kw("DISTINCT")
+            self._check_core_clean(left, l_paren or had)
+            right, r_paren = self.select_core()
+            left = A.SetOp(op="intersect", all=all_, left=left, right=right)
+            had = True
+            last_paren = r_paren
+        if isinstance(left, A.SetOp) and had:
+            last = left.right
+            if isinstance(last, A.Select) and not last_paren:
+                left.order_by = last.order_by
+                left.limit = last.limit
+                left.offset = last.offset
+                last.order_by = []
+                last.limit = last.offset = None
+        return left, last_paren and not had
+
+    def _check_core_clean(self, core, parenthesized: bool):
+        if parenthesized:
+            return
+        if isinstance(core, A.Select) and (
+            core.order_by or core.limit is not None
+        ):
+            raise InvalidSyntaxError(
+                "ORDER BY / LIMIT before a set operator — "
+                "parenthesize the subquery"
+            )
+
+    def select_core(self) -> tuple[A.Select | A.SetOp, bool]:
+        """Returns (select, was_parenthesized)."""
+        if self.at_op("("):
+            # parenthesized select as a set-operation operand
+            save = self.i
+            self.next()
+            if self.at_kw("SELECT", "WITH"):
+                q = self.select_or_setop()
+                self.expect_op(")")
+                return q, True
+            self.i = save
+        return self.select(), False
+
     def select(self) -> A.Select:
         self.expect_kw("SELECT")
         distinct = self.eat_kw("DISTINCT")
@@ -498,8 +617,11 @@ class Parser:
         while self.eat_op(","):
             items.append(self.select_item())
         from_table = None
+        source = None
         if self.eat_kw("FROM"):
-            from_table = self.qualified_name()
+            source = self.from_source()
+            if isinstance(source, A.TableName):
+                from_table = source.name
         where = self.expr() if self.eat_kw("WHERE") else None
         range_clause = None
         if self.at_kw("ALIGN"):
@@ -528,8 +650,86 @@ class Parser:
             items=items, from_table=from_table, where=where,
             group_by=group_by, having=having, order_by=order_by,
             limit=limit, offset=offset, range_clause=range_clause,
-            distinct=distinct,
+            distinct=distinct, source=source,
         )
+
+    # ---- FROM sources -------------------------------------------------
+    _ALIAS_STOP = (
+        "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "ALIGN",
+        "UNION", "INTERSECT", "EXCEPT", "JOIN", "INNER", "LEFT", "RIGHT",
+        "FULL", "CROSS", "ON", "USING", "AS", "FILL", "BY", "TO", "SET",
+    )
+
+    def _maybe_alias(self) -> str | None:
+        if self.eat_kw("AS"):
+            return self.ident()
+        t = self.peek()
+        if t.kind in (Tok.IDENT, Tok.QIDENT) and not self.at_kw(
+            *self._ALIAS_STOP
+        ):
+            return self.ident()
+        return None
+
+    def table_factor(self):
+        if self.at_op("("):
+            save = self.i
+            self.next()
+            if self.at_kw("SELECT", "WITH"):
+                q = self.select_or_setop()
+                self.expect_op(")")
+                alias = self._maybe_alias()
+                if alias is None:
+                    raise InvalidSyntaxError("FROM subquery needs an alias")
+                return A.SubquerySource(q, alias)
+            # parenthesized join tree
+            src = self.from_source()
+            self.expect_op(")")
+            return src
+        name = self.qualified_name()
+        return A.TableName(name, self._maybe_alias())
+
+    def from_source(self):
+        left = self.table_factor()
+        while True:
+            if self.at_kw("CROSS"):
+                self.next()
+                self.expect_kw("JOIN")
+                left = A.JoinSource(left, self.table_factor(), "cross")
+                continue
+            kind = None
+            if self.at_kw("JOIN", "INNER"):
+                self.eat_kw("INNER")
+                kind = "inner"
+            elif self.at_kw("LEFT"):
+                self.next()
+                self.eat_kw("OUTER")
+                kind = "left"
+            elif self.at_kw("RIGHT"):
+                self.next()
+                self.eat_kw("OUTER")
+                kind = "right"
+            elif self.at_kw("FULL"):
+                self.next()
+                self.eat_kw("OUTER")
+                kind = "full"
+            elif self.eat_op(","):
+                left = A.JoinSource(left, self.table_factor(), "cross")
+                continue
+            else:
+                return left
+            self.expect_kw("JOIN")
+            right = self.table_factor()
+            on = None
+            using = None
+            if self.eat_kw("ON"):
+                on = self.expr()
+            elif self.eat_kw("USING"):
+                self.expect_op("(")
+                using = [self.ident()]
+                while self.eat_op(","):
+                    using.append(self.ident())
+                self.expect_op(")")
+            left = A.JoinSource(left, right, kind, on, using)
 
     def align_clause(self) -> A.RangeClause:
         self.expect_kw("ALIGN")
@@ -571,7 +771,7 @@ class Parser:
             alias = self.ident()
         elif self.peek().kind in (Tok.IDENT, Tok.QIDENT) and not self.at_kw(
             "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET",
-            "ALIGN", "UNION", "FILL", "BY", "TO",
+            "ALIGN", "UNION", "INTERSECT", "EXCEPT", "FILL", "BY", "TO",
         ):
             alias = self.ident()
         return A.SelectItem(e, alias)
@@ -612,8 +812,23 @@ class Parser:
 
     def not_expr(self) -> A.Expr:
         if self.at_kw("NOT"):
+            save = self.i
+            self.next()
+            if self.at_kw("EXISTS"):
+                self.next()
+                self.expect_op("(")
+                q = self.select_or_setop()
+                self.expect_op(")")
+                return A.Exists(q, negated=True)
+            self.i = save
             self.next()
             return A.UnaryOp("not", self.not_expr())
+        if self.at_kw("EXISTS"):
+            self.next()
+            self.expect_op("(")
+            q = self.select_or_setop()
+            self.expect_op(")")
+            return A.Exists(q)
         return self.cmp_expr()
 
     def cmp_expr(self) -> A.Expr:
@@ -635,6 +850,10 @@ class Parser:
         if self.at_kw("IN"):
             self.next()
             self.expect_op("(")
+            if self.at_kw("SELECT", "WITH"):
+                q = self.select_or_setop()
+                self.expect_op(")")
+                return A.InSubquery(left, q)
             items = [self.expr()]
             while self.eat_op(","):
                 items.append(self.expr())
@@ -649,6 +868,10 @@ class Parser:
                 return A.Between(left, low, self.add_expr(), negated=True)
             if self.eat_kw("IN"):
                 self.expect_op("(")
+                if self.at_kw("SELECT", "WITH"):
+                    q = self.select_or_setop()
+                    self.expect_op(")")
+                    return A.InSubquery(left, q, negated=True)
                 items = [self.expr()]
                 while self.eat_op(","):
                     items.append(self.expr())
@@ -715,6 +938,10 @@ class Parser:
                 return A.IntervalLit(parse_interval_ms(t.text), t.text)
             return A.Literal(t.text)
         if self.eat_op("("):
+            if self.at_kw("SELECT", "WITH"):
+                q = self.select_or_setop()
+                self.expect_op(")")
+                return A.ScalarSubquery(q)
             e = self.expr()
             self.expect_op(")")
             return e

@@ -1,0 +1,67 @@
+-- Aggregates (reference sqlness: common/aggregate/)
+CREATE TABLE m (host STRING, region STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host, region));
+
+INSERT INTO m (host, region, v, ts) VALUES
+  ('h1', 'us', 1, 1000), ('h1', 'us', 3, 2000),
+  ('h2', 'us', 5, 1000), ('h2', 'eu', 7, 2000),
+  ('h3', 'eu', 9, 1000);
+
+SELECT count(*) FROM m;
+----
+count(*)
+5
+
+SELECT region, count(*) AS n, sum(v) AS s, avg(v) AS a FROM m GROUP BY region ORDER BY region;
+----
+region|n|s|a
+eu|2|16.0|8.0
+us|3|9.0|3.0
+
+SELECT region, min(v) AS lo, max(v) AS hi FROM m GROUP BY region ORDER BY region;
+----
+region|lo|hi
+eu|7.0|9.0
+us|1.0|5.0
+
+SELECT host, count(DISTINCT region) AS r FROM m GROUP BY host ORDER BY host;
+----
+host|r
+h1|1
+h2|2
+h3|1
+
+SELECT region, sum(v) AS s FROM m GROUP BY region HAVING sum(v) > 10 ORDER BY region;
+----
+region|s
+eu|16.0
+
+SELECT DISTINCT region FROM m ORDER BY region;
+----
+region
+eu
+us
+
+SELECT region, last_value(v ORDER BY ts) AS lv FROM m GROUP BY region ORDER BY region;
+----
+region|lv
+eu|7.0
+us|3.0
+
+-- grouped expression keys (all values are odd: one group)
+SELECT v % 2 AS parity, count(*) AS n FROM m GROUP BY v % 2 ORDER BY parity;
+----
+parity|n
+1.0|5
+
+SELECT floor(v / 4) AS bucket, count(*) AS n FROM m GROUP BY floor(v / 4) ORDER BY bucket;
+----
+bucket|n
+0.0|2
+1.0|2
+2.0|1
+
+-- aggregate over empty input: one row, count 0
+SELECT count(*) AS n, sum(v) AS s FROM m WHERE v > 100;
+----
+n|s
+0|NULL

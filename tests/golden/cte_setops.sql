@@ -1,0 +1,85 @@
+-- CTEs and set operations (reference sqlness: common/cte/, common/select/
+-- union cases)
+CREATE TABLE nums (v DOUBLE, tag STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(tag));
+
+INSERT INTO nums (v, tag, ts) VALUES (1, 'a', 1000), (2, 'b', 2000), (3, 'c', 3000), (4, 'd', 4000);
+
+WITH small AS (SELECT v, tag FROM nums WHERE v <= 2) SELECT * FROM small ORDER BY v;
+----
+v|tag
+1.0|a
+2.0|b
+
+WITH small AS (SELECT v FROM nums WHERE v <= 2), big AS (SELECT v FROM nums WHERE v > 2)
+SELECT small.v AS sv, big.v AS bv FROM small JOIN big ON small.v + 2 = big.v ORDER BY sv;
+----
+sv|bv
+1.0|3.0
+2.0|4.0
+
+-- CTE shadows a base table name
+WITH nums AS (SELECT v FROM nums WHERE v = 1) SELECT * FROM nums;
+----
+v
+1.0
+
+SELECT v FROM nums WHERE v < 2 UNION ALL SELECT v FROM nums WHERE v > 3;
+----
+v
+1.0
+4.0
+
+SELECT tag FROM nums WHERE v < 3 UNION SELECT tag FROM nums WHERE v < 2 ORDER BY tag;
+----
+tag
+a
+b
+
+SELECT v FROM nums WHERE v < 3 INTERSECT SELECT v FROM nums WHERE v > 1;
+----
+v
+2.0
+
+SELECT v FROM nums EXCEPT SELECT v FROM nums WHERE v > 1 ORDER BY v;
+----
+v
+1.0
+
+SELECT v FROM nums UNION ALL SELECT v FROM nums WHERE v = 1 ORDER BY v LIMIT 3;
+----
+v
+1.0
+1.0
+2.0
+
+-- column count mismatch
+SELECT v, tag FROM nums UNION SELECT v FROM nums;
+----
+ERROR
+
+-- a parenthesized operand keeps its own ORDER BY / LIMIT; the trailing
+-- clauses after the parens bind to the compound
+SELECT v FROM nums WHERE v = 1 UNION ALL (SELECT v FROM nums ORDER BY v DESC LIMIT 1) ORDER BY v;
+----
+v
+1.0
+4.0
+
+-- INTERSECT binds tighter than UNION (standard SQL)
+SELECT 1 AS v UNION SELECT 2 INTERSECT SELECT 2 ORDER BY v;
+----
+v
+1
+2
+
+-- EXCEPT ALL removes one left copy per right row (bag semantics)
+SELECT * FROM (SELECT v FROM nums WHERE v < 2 UNION ALL SELECT 1.0) u EXCEPT ALL SELECT 1.0;
+----
+v
+1.0
+
+-- NULLs compare equal in set operations
+SELECT NULL AS x, 1 AS y INTERSECT SELECT NULL, 1;
+----
+x|y
+NULL|1

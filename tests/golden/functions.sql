@@ -1,0 +1,49 @@
+-- Scalar functions (reference sqlness: common/function/)
+CREATE TABLE f (s STRING, x DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(s));
+
+INSERT INTO f (s, x, ts) VALUES ('Hello', 2.0, 1000), ('world', -3.5, 2000);
+
+SELECT s, upper(s) AS u, lower(s) AS l, length(s) AS n FROM f ORDER BY s;
+----
+s|u|l|n
+Hello|HELLO|hello|5
+world|WORLD|world|5
+
+SELECT abs(x) AS a, round(x) AS r, ceil(x) AS c, floor(x) AS fl FROM f ORDER BY x;
+----
+a|r|c|fl
+3.5|-4.0|-3.0|-4.0
+2.0|2.0|2.0|2.0
+
+SELECT sqrt(4.0) AS sq, pow(2.0, 10.0) AS p, ln(1.0) AS l;
+----
+sq|p|l
+2.0|1024.0|0.0
+
+SELECT concat(s, '!') AS c FROM f ORDER BY s;
+----
+c
+Hello!
+world!
+
+SELECT CASE WHEN x > 0 THEN 'pos' ELSE 'neg' END AS sign FROM f ORDER BY x;
+----
+sign
+neg
+pos
+
+SELECT coalesce(NULL, 'fallback') AS c;
+----
+c
+fallback
+
+SELECT x, x::BIGINT AS i FROM f ORDER BY x;
+----
+x|i
+-3.5|-3
+2.0|2
+
+SELECT greatest(1.0, 2.0) AS g, least(1.0, 2.0) AS l;
+----
+g|l
+2.0|1.0

@@ -1,0 +1,95 @@
+-- JOIN semantics (capability port of the reference sqlness join cases,
+-- /root/reference/tests/cases/standalone/common/select/ + dml joins)
+CREATE TABLE t1 (k STRING, x DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k));
+
+CREATE TABLE t2 (k STRING, y DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k));
+
+INSERT INTO t1 (k, x, ts) VALUES ('a', 1.0, 1000), ('b', 2.0, 1000), ('c', 3.0, 2000);
+
+INSERT INTO t2 (k, y, ts) VALUES ('a', 10.0, 1000), ('b', 20.0, 1000), ('d', 40.0, 2000);
+
+SELECT t1.k, x, y FROM t1 JOIN t2 ON t1.k = t2.k ORDER BY t1.k;
+----
+k|x|y
+a|1.0|10.0
+b|2.0|20.0
+
+SELECT t1.k, x, y FROM t1 LEFT JOIN t2 ON t1.k = t2.k ORDER BY t1.k;
+----
+k|x|y
+a|1.0|10.0
+b|2.0|20.0
+c|3.0|NULL
+
+SELECT t2.k, x, y FROM t1 RIGHT JOIN t2 ON t1.k = t2.k ORDER BY t2.k;
+----
+k|x|y
+a|1.0|10.0
+b|2.0|20.0
+d|NULL|40.0
+
+SELECT t1.k, t2.k, x, y FROM t1 FULL JOIN t2 ON t1.k = t2.k ORDER BY x NULLS LAST;
+----
+k|k|x|y
+a|a|1.0|10.0
+b|b|2.0|20.0
+c|NULL|3.0|NULL
+NULL|d|NULL|40.0
+
+SELECT k, x, y FROM t1 JOIN t2 USING (k) ORDER BY k;
+----
+k|x|y
+a|1.0|10.0
+b|2.0|20.0
+
+-- non-equi residual on top of the equi pair
+SELECT t1.k, x, y FROM t1 JOIN t2 ON t1.k = t2.k AND y > 15 ORDER BY t1.k;
+----
+k|x|y
+b|2.0|20.0
+
+-- cross join
+SELECT count(*) FROM t1 CROSS JOIN t2;
+----
+count(*)
+9
+
+-- comma cross join with where acting as join condition
+SELECT a.k, b.y FROM t1 a, t2 b WHERE a.k = b.k ORDER BY a.k;
+----
+k|y
+a|10.0
+b|20.0
+
+-- aggregate over a join
+SELECT a.k, sum(a.x + b.y) AS s FROM t1 a JOIN t2 b ON a.k = b.k GROUP BY a.k ORDER BY s;
+----
+k|s
+a|11.0
+b|22.0
+
+-- join on time index + tag
+SELECT t1.k, x, y FROM t1 JOIN t2 ON t1.k = t2.k AND t1.ts = t2.ts ORDER BY t1.k;
+----
+k|x|y
+a|1.0|10.0
+b|2.0|20.0
+
+-- outer join without any equality is rejected
+SELECT * FROM t1 LEFT JOIN t2 ON t1.x < t2.y;
+----
+ERROR
+
+-- WHERE on the null-supplying side filters AFTER the outer join
+SELECT t1.k, y FROM t1 LEFT JOIN t2 ON t1.k = t2.k WHERE y = 10;
+----
+k|y
+a|10.0
+
+-- USING key coalesces across sides on right-only rows
+SELECT k, y FROM t1 RIGHT JOIN t2 USING (k) ORDER BY y;
+----
+k|y
+a|10.0
+b|20.0
+d|40.0

@@ -1,0 +1,54 @@
+-- ORDER BY / LIMIT / WHERE pruning (reference sqlness: common/order/,
+-- common/select/limit cases)
+CREATE TABLE t (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO t (host, v, ts) VALUES
+  ('a', 5, 1000), ('b', 3, 2000), ('c', 8, 3000), ('d', 1, 4000), ('e', 6, 5000);
+
+SELECT host, v FROM t ORDER BY v DESC LIMIT 2;
+----
+host|v
+c|8.0
+e|6.0
+
+SELECT host, v FROM t ORDER BY v LIMIT 2 OFFSET 1;
+----
+host|v
+b|3.0
+a|5.0
+
+SELECT host FROM t WHERE ts >= 3000 AND ts < 5000 ORDER BY host;
+----
+host
+c
+d
+
+SELECT host FROM t WHERE ts BETWEEN 2000 AND 3000 ORDER BY host;
+----
+host
+b
+c
+
+SELECT host FROM t WHERE host IN ('a', 'd', 'nope') ORDER BY host;
+----
+host
+a
+d
+
+SELECT host FROM t WHERE host LIKE 'b%' OR v > 7 ORDER BY host;
+----
+host
+b
+c
+
+SELECT host, v FROM t WHERE v BETWEEN 3 AND 6 AND host != 'e' ORDER BY v DESC;
+----
+host|v
+a|5.0
+b|3.0
+
+SELECT host, v * 10 AS scaled FROM t WHERE NOT (v < 5) ORDER BY scaled DESC LIMIT 2;
+----
+host|scaled
+c|80.0
+e|60.0

@@ -1,0 +1,39 @@
+-- RANGE queries (reference: src/query/src/range_select/plan.rs semantics,
+-- sqlness common/range/)
+CREATE TABLE cpu (host STRING, val DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO cpu (host, val, ts) VALUES
+  ('h1', 1, 0), ('h1', 2, 5000), ('h1', 3, 10000), ('h1', 4, 15000),
+  ('h2', 10, 0), ('h2', 20, 5000), ('h2', 30, 10000), ('h2', 40, 15000);
+
+SELECT ts, host, avg(val) RANGE '10s' FROM cpu ALIGN '10s' TO '1970-01-01 00:00:00' BY (host) ORDER BY ts, host;
+----
+ts|host|avg(val) RANGE 10000ms
+0|h1|1.5
+0|h2|15.0
+10000|h1|3.5
+10000|h2|35.0
+
+SELECT ts, host, max(val) RANGE '10s', min(val) RANGE '10s' FROM cpu ALIGN '10s' TO '1970-01-01 00:00:00' BY (host) ORDER BY ts, host;
+----
+ts|host|max(val) RANGE 10000ms|min(val) RANGE 10000ms
+0|h1|2.0|1.0
+0|h2|20.0|10.0
+10000|h1|4.0|3.0
+10000|h2|40.0|30.0
+
+-- BY () folds all series into one group
+SELECT ts, sum(val) RANGE '10s' FROM cpu ALIGN '10s' TO '1970-01-01 00:00:00' BY () ORDER BY ts;
+----
+ts|sum(val) RANGE 10000ms
+0|33.0
+10000|77.0
+
+-- range wider than step: sliding windows labeled by window START,
+-- [t, t + range) per the reference's plan.rs:1068 semantics
+SELECT ts, count(val) RANGE '20s' FROM cpu ALIGN '10s' TO '1970-01-01 00:00:00' BY () ORDER BY ts;
+----
+ts|count(val) RANGE 20000ms
+-10000|4.0
+0|8.0
+10000|4.0

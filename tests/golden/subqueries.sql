@@ -1,0 +1,52 @@
+-- Subqueries: scalar, IN, EXISTS, FROM (reference sqlness:
+-- common/select/ subquery coverage)
+CREATE TABLE s (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k));
+
+INSERT INTO s (k, v, ts) VALUES ('a', 1, 1000), ('b', 5, 2000), ('c', 9, 3000);
+
+SELECT k, v FROM s WHERE v > (SELECT avg(v) FROM s) ORDER BY k;
+----
+k|v
+c|9.0
+
+SELECT (SELECT max(v) FROM s) + 1 AS m;
+----
+m
+10.0
+
+SELECT k FROM s WHERE k IN (SELECT k FROM s WHERE v >= 5) ORDER BY k;
+----
+k
+b
+c
+
+SELECT k FROM s WHERE k NOT IN (SELECT k FROM s WHERE v >= 5) ORDER BY k;
+----
+k
+a
+
+SELECT count(*) AS c FROM s WHERE EXISTS (SELECT 1 FROM s WHERE v > 100);
+----
+c
+0
+
+SELECT count(*) AS c FROM s WHERE NOT EXISTS (SELECT 1 FROM s WHERE v > 100);
+----
+c
+3
+
+SELECT sub.k, sub.doubled FROM (SELECT k, v * 2 AS doubled FROM s WHERE v > 1) sub ORDER BY sub.k;
+----
+k|doubled
+b|10.0
+c|18.0
+
+SELECT max(doubled) AS m FROM (SELECT v * 2 AS doubled FROM s) d;
+----
+m
+18.0
+
+-- scalar subquery with more than one row errors
+SELECT (SELECT v FROM s);
+----
+ERROR

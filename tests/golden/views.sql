@@ -1,0 +1,50 @@
+-- Views (reference: src/operator/src/statement/ddl.rs create_view +
+-- common/view sqlness cases)
+CREATE TABLE base (host STRING, cpu DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO base (host, cpu, ts) VALUES ('h1', 10, 1000), ('h2', 90, 2000), ('h3', 50, 3000);
+
+CREATE VIEW hot AS SELECT host, cpu FROM base WHERE cpu > 40;
+
+SELECT * FROM hot ORDER BY host;
+----
+host|cpu
+h2|90.0
+h3|50.0
+
+SELECT count(*) AS n FROM hot;
+----
+n
+2
+
+-- view joined with its base table
+SELECT hot.host, base.ts FROM hot JOIN base ON hot.host = base.host ORDER BY hot.host;
+----
+host|ts
+h2|2000
+h3|3000
+
+CREATE OR REPLACE VIEW hot AS SELECT host FROM base WHERE cpu >= 90;
+
+SELECT * FROM hot;
+----
+host
+h2
+
+SHOW VIEWS;
+----
+Views
+hot
+
+-- a view name cannot collide with a table
+CREATE VIEW base AS SELECT host FROM base;
+----
+ERROR
+
+DROP VIEW hot;
+
+SELECT * FROM hot;
+----
+ERROR
+
+DROP VIEW IF EXISTS hot;

@@ -1,0 +1,115 @@
+"""Sqlness-style golden runner.
+
+Capability counterpart of the reference's sqlness harness
+(/root/reference/tests/runner/src/env.rs:68-133 + tests/cases/standalone/
+common/): each `tests/golden/*.sql` file is a sequence of statements; a
+statement followed by a `----` block asserts the formatted result. Cases
+port the behavior covered by the reference's common sqlness suites
+(select, join, cte, view, order_by, ...) onto this engine's dialect.
+
+Format:
+    -- comment
+    CREATE TABLE t (...);          <- executed, result ignored
+    SELECT ...;
+    ----
+    col1|col2
+    v11|v12
+    <blank line ends the block>
+An expected block of `ERROR` asserts the statement raises.
+"""
+
+import math
+import pathlib
+
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "golden"
+
+
+def _fmt_value(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if v == int(v) and abs(v) < 1e15:
+            return f"{v:.1f}"
+        return f"{v:.6g}"
+    return str(v)
+
+
+def format_result(res) -> list[str]:
+    lines = ["|".join(res.names)]
+    for row in res.rows():
+        lines.append("|".join(_fmt_value(v) for v in row))
+    return lines
+
+
+def parse_cases(text: str):
+    """Yields (statement, expected_lines | None, line_no)."""
+    lines = text.split("\n")
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        if not line or line.startswith("--"):
+            i += 1
+            continue
+        # accumulate statement until ';'
+        start = i
+        stmt_lines = []
+        while i < len(lines):
+            stmt_lines.append(lines[i])
+            if lines[i].rstrip().endswith(";"):
+                break
+            i += 1
+        stmt = "\n".join(stmt_lines).strip().rstrip(";")
+        i += 1
+        expected = None
+        if i < len(lines) and lines[i].strip() == "----":
+            i += 1
+            expected = []
+            while i < len(lines) and lines[i].strip() != "":
+                expected.append(lines[i].rstrip())
+                i += 1
+        yield stmt, expected, start + 1
+
+
+def golden_files():
+    return sorted(GOLDEN_DIR.glob("*.sql"))
+
+
+@pytest.mark.parametrize(
+    "path", golden_files(), ids=lambda p: p.stem,
+)
+def test_golden(path, tmp_path):
+    inst = Standalone(str(tmp_path / "data"))
+    try:
+        for stmt, expected, line_no in parse_cases(path.read_text()):
+            if expected == ["ERROR"]:
+                with pytest.raises(Exception):
+                    inst.sql(stmt)
+                continue
+            try:
+                res = inst.sql(stmt)
+            except Exception as e:
+                raise AssertionError(
+                    f"{path.name}:{line_no}: {stmt!r} failed: {e}"
+                ) from e
+            if expected is None:
+                continue
+            got = format_result(res)
+            assert got == expected, (
+                f"{path.name}:{line_no}:\n{stmt}\n"
+                f"expected:\n" + "\n".join(expected)
+                + "\ngot:\n" + "\n".join(got)
+            )
+    finally:
+        inst.close()
+
+
+def test_golden_dir_has_cases():
+    assert len(golden_files()) >= 5, "golden suite missing"
