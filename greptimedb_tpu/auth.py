@@ -24,6 +24,13 @@ class UserProvider:
     def authenticate(self, username: str, password: str) -> bool:
         raise NotImplementedError
 
+    def plain_password(self, username: str) -> str | None:
+        """Plaintext password for challenge-response handshakes
+        (mysql_native_password). Providers that only store hashes return
+        None; such users can authenticate only over password-carrying
+        protocols (HTTP Basic)."""
+        return None
+
 
 class StaticUserProvider(UserProvider):
     """`user=pwd` pairs, the static_user_provider analog. Values may be
@@ -53,6 +60,15 @@ class StaticUserProvider(UserProvider):
                 want[len("sha256:"):].encode(),
             )
         return hmac.compare_digest(password.encode(), want.encode())
+
+    def plain_password(self, username: str) -> str | None:
+        """Plaintext password when stored plain — required by challenge
+        handshakes (mysql_native_password); sha256-stored users can only
+        authenticate over protocols that send the password (HTTP Basic)."""
+        want = self._users.get(username)
+        if want is None or want.startswith("sha256:"):
+            return None
+        return want
 
 
 class WatchFileUserProvider(UserProvider):
@@ -87,6 +103,10 @@ class WatchFileUserProvider(UserProvider):
     def authenticate(self, username: str, password: str) -> bool:
         self._maybe_reload()
         return self._inner.authenticate(username, password)
+
+    def plain_password(self, username: str) -> str | None:
+        self._maybe_reload()
+        return self._inner.plain_password(username)
 
 
 def check_basic_auth(header: str | None, provider: UserProvider | None

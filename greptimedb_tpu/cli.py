@@ -20,6 +20,10 @@ def main(argv=None):
     start = s_sub.add_parser("start")
     start.add_argument("--data-home", default="./greptimedb_tpu_data")
     start.add_argument("--http-addr", default="127.0.0.1:4000")
+    start.add_argument("--mysql-addr", default="127.0.0.1:4002",
+                       help="MySQL wire protocol address ('' disables)")
+    start.add_argument("--flight-addr", default="127.0.0.1:4001",
+                       help="Arrow Flight (gRPC) address ('' disables)")
     start.add_argument("--no-flows", action="store_true")
 
     repl = sub.add_parser("cli")
@@ -51,6 +55,29 @@ def _start_standalone(args):
             pass
     server = HttpServer(inst, addr=host or "127.0.0.1",
                         port=int(port)).start()
+    extra = []
+    if args.mysql_addr:
+        from greptimedb_tpu.servers.mysql import MySqlServer
+
+        mh, _, mp = args.mysql_addr.rpartition(":")
+        extra.append(MySqlServer(
+            inst, addr=mh or "127.0.0.1", port=int(mp)
+        ).start())
+        print(f"greptimedb-tpu mysql protocol on {args.mysql_addr}",
+              flush=True)
+    if args.flight_addr:
+        try:
+            from greptimedb_tpu.servers.flight import FlightFrontend
+
+            fh, _, fp = args.flight_addr.rpartition(":")
+            extra.append(FlightFrontend(
+                inst, addr=fh or "127.0.0.1", port=int(fp)
+            ).start())
+            print(f"greptimedb-tpu arrow flight on {args.flight_addr}",
+                  flush=True)
+        except ImportError:
+            print("# pyarrow.flight unavailable; flight disabled",
+                  flush=True)
     print(
         f"greptimedb-tpu standalone listening on http://{server.addr}:"
         f"{server.port}", flush=True,
@@ -63,6 +90,8 @@ def _start_standalone(args):
         while not stop:
             time.sleep(0.2)
     finally:
+        for s in extra:
+            s.close()
         server.stop()
         inst.close()
     return 0

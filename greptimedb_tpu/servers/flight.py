@@ -1,0 +1,145 @@
+"""Arrow Flight data plane.
+
+Capability counterpart of the reference's gRPC + Arrow Flight services
+(/root/reference/src/servers/src/grpc/flight.rs:115 FlightCraft,
+src/client/src/database.rs do_get): columnar query results stream as
+Arrow record batches instead of per-row JSON, and DoPut ingests columnar
+batches straight into Table.write.
+
+- DoGet: ticket = SQL text (utf-8) -> one Arrow stream of the result.
+- GetFlightInfo: descriptor (cmd = SQL) -> schema + a ticket for DoGet.
+- DoPut: descriptor path = table name; uploaded batches append to the
+  table (tags = dictionary/string columns, time index from schema).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.flight as flight
+
+from greptimedb_tpu.datatypes.batch import HostColumn
+from greptimedb_tpu.session import QueryContext
+
+
+def result_to_arrow(res) -> pa.Table:
+    """QueryResult -> Arrow table (timestamps become timestamp[ms])."""
+    arrays = []
+    fields = []
+    for name, col in zip(res.names, res.cols):
+        vals = col.values
+        mask = None if col.validity is None else ~col.validity
+        dt = res.types.get(name)
+        if dt is not None and dt.is_timestamp():
+            arr = pa.array(np.asarray(vals, np.int64), pa.timestamp("ms"),
+                           mask=mask)
+        elif vals.dtype == object:
+            arr = pa.array(vals, pa.string(), mask=mask)
+        else:
+            arr = pa.array(vals, mask=mask)
+        arrays.append(arr)
+        fields.append(pa.field(name, arr.type))
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+class FlightServer(flight.FlightServerBase):
+    def __init__(self, instance, *, addr: str = "127.0.0.1", port: int = 0,
+                 user_provider=None):
+        self.instance = instance
+        self.user_provider = user_provider
+        location = f"grpc://{addr}:{port}"
+        super().__init__(location)
+        self.addr = addr
+        # FlightServerBase binds immediately; port resolves the 0 case
+        self._location = location
+        # get_flight_info -> do_get runs the query once: the info call
+        # materializes and parks the table for the matching ticket
+        self._pending: dict[bytes, pa.Table] = {}
+        self._pending_lock = threading.Lock()
+
+    # ---- queries ------------------------------------------------------
+    def _run_sql(self, sql: str) -> pa.Table:
+        res = self.instance.sql(sql, QueryContext(database="public"))
+        return result_to_arrow(res)
+
+    def do_get(self, context, ticket: flight.Ticket):
+        with self._pending_lock:
+            table = self._pending.pop(ticket.ticket, None)
+        if table is None:
+            sql = ticket.ticket.decode("utf-8")
+            try:
+                table = self._run_sql(sql)
+            except Exception as e:  # noqa: BLE001 - RPC boundary
+                raise flight.FlightServerError(str(e)) from e
+        return flight.RecordBatchStream(table)
+
+    def get_flight_info(self, context, descriptor: flight.FlightDescriptor):
+        sql = (descriptor.command or b"").decode("utf-8")
+        try:
+            table = self._run_sql(sql)
+        except Exception as e:  # noqa: BLE001
+            raise flight.FlightServerError(str(e)) from e
+        with self._pending_lock:
+            if len(self._pending) >= 32:
+                self._pending.pop(next(iter(self._pending)))
+            self._pending[sql.encode()] = table
+        endpoint = flight.FlightEndpoint(sql.encode(), [self._location])
+        return flight.FlightInfo(
+            table.schema, descriptor, [endpoint], table.num_rows, -1
+        )
+
+    # ---- ingest -------------------------------------------------------
+    def do_put(self, context, descriptor, reader, writer):
+        path = descriptor.path
+        if not path:
+            raise flight.FlightServerError("DoPut needs a table-name path")
+        name = path[0].decode("utf-8")
+        inst = self.instance
+        db = "public"
+        if "." in name:
+            db, name = name.split(".", 1)
+        table = inst.catalog.table(db, name)
+        for chunk in reader:
+            batch = chunk.data
+            data: dict = {}
+            valid: dict = {}
+            for i in range(batch.num_columns):
+                cname = batch.schema.field(i).name
+                arr = batch.column(i)
+                if pa.types.is_timestamp(arr.type):
+                    # normalize to ms before the shared converter so null
+                    # timestamps fill to int 0, not float NaN
+                    arr = arr.cast(pa.timestamp("ms"))
+                hc = HostColumn.from_arrow(cname, arr)
+                data[cname] = hc.values
+                valid[cname] = hc.valid_mask
+            try:
+                inst._write_columns(table, data, valid)
+            except Exception as e:  # noqa: BLE001 - RPC boundary
+                raise flight.FlightServerError(str(e)) from e
+            inst._notify_flows(db, name, table, data, valid)
+
+
+class FlightFrontend:
+    """Owns the Flight server thread (FlightServerBase.serve blocks)."""
+
+    def __init__(self, instance, *, addr: str = "127.0.0.1", port: int = 0,
+                 user_provider=None):
+        self.server = FlightServer(
+            instance, addr=addr, port=port, user_provider=user_provider
+        )
+        self.addr = addr
+        self.port = self.server.port
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "FlightFrontend":
+        self._thread = threading.Thread(
+            target=self.server.serve, daemon=True, name="flight-server"
+        )
+        self._thread.start()
+        return self
+
+    def close(self):
+        self.server.shutdown()

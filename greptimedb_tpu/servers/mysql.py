@@ -1,0 +1,387 @@
+"""MySQL wire-protocol frontend.
+
+Capability counterpart of the reference's opensrv-mysql based server
+(/root/reference/src/servers/src/mysql/handler.rs MysqlInstanceShim +
+mysql/server.rs): protocol-4.1 handshake with mysql_native_password,
+COM_QUERY with text resultsets, COM_INIT_DB / COM_PING / COM_QUIT, and
+the small set of `@@variable` / SET probes clients issue on connect.
+
+Implementation is a threaded stdlib TCP server (the host plane is
+IO-bound glue; queries execute through the same Standalone instance the
+HTTP frontend uses, so device fast paths apply unchanged).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import re
+import secrets
+import socket
+import socketserver
+import struct
+import threading
+
+from greptimedb_tpu.session import QueryContext
+
+# capability flags
+CLIENT_LONG_PASSWORD = 0x00000001
+CLIENT_CONNECT_WITH_DB = 0x00000008
+CLIENT_PROTOCOL_41 = 0x00000200
+CLIENT_TRANSACTIONS = 0x00002000
+CLIENT_SECURE_CONNECTION = 0x00008000
+CLIENT_PLUGIN_AUTH = 0x00080000
+CLIENT_PLUGIN_AUTH_LENENC = 0x00200000
+CLIENT_DEPRECATE_EOF = 0x01000000
+
+SERVER_CAPS = (
+    CLIENT_LONG_PASSWORD | CLIENT_CONNECT_WITH_DB | CLIENT_PROTOCOL_41
+    | CLIENT_TRANSACTIONS | CLIENT_SECURE_CONNECTION | CLIENT_PLUGIN_AUTH
+)
+
+# column types (text protocol: type bytes are metadata only)
+T_TINY = 0x01
+T_LONGLONG = 0x08
+T_DOUBLE = 0x05
+T_DATETIME = 0x0C
+T_VAR_STRING = 0xFD
+
+COM_QUIT = 0x01
+COM_INIT_DB = 0x02
+COM_QUERY = 0x03
+COM_FIELD_LIST = 0x04
+COM_PING = 0x0E
+COM_STMT_PREPARE = 0x16
+
+_SERVER_VERSION = "8.4.0-greptimedb-tpu"
+
+# connect-time probes answered without the SQL engine
+_AT_VAR_VALUES = {
+    "version_comment": "greptimedb-tpu",
+    "version": _SERVER_VERSION,
+    "max_allowed_packet": "16777216",
+    "system_time_zone": "UTC",
+    "time_zone": "SYSTEM",
+    "tx_isolation": "REPEATABLE-READ",
+    "transaction_isolation": "REPEATABLE-READ",
+    "session.transaction_isolation": "REPEATABLE-READ",
+    "autocommit": "1",
+    "sql_mode": "",
+    "lower_case_table_names": "0",
+    "interactive_timeout": "28800",
+    "wait_timeout": "28800",
+    "character_set_client": "utf8mb4",
+    "character_set_connection": "utf8mb4",
+    "character_set_results": "utf8mb4",
+    "collation_connection": "utf8mb4_general_ci",
+}
+_AT_VAR_RE = re.compile(r"@@([A-Za-z_.]+)")
+# an entire statement made of @@-variable selects (connector probes);
+# anything else — @@ in a string literal, mixed expressions — runs as SQL
+_AT_VAR_STMT_RE = re.compile(
+    r"select\s+@@[\w.]+(?:\s+as\s+\w+)?"
+    r"(?:\s*,\s*@@[\w.]+(?:\s+as\s+\w+)?)*"
+    r"(?:\s+limit\s+\d+)?",
+    re.IGNORECASE,
+)
+
+
+def _lenc_int(n: int) -> bytes:
+    if n < 0xFB:
+        return bytes([n])
+    if n < 2**16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 2**24:
+        return b"\xfd" + struct.pack("<I", n)[:3]
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def _lenc_str(s: bytes) -> bytes:
+    return _lenc_int(len(s)) + s
+
+
+def native_password_token(password: str, scramble: bytes) -> bytes:
+    """mysql_native_password: SHA1(pwd) XOR SHA1(scramble + SHA1(SHA1(pwd)))."""
+    if not password:
+        return b""
+    p1 = hashlib.sha1(password.encode()).digest()
+    p2 = hashlib.sha1(p1).digest()
+    mix = hashlib.sha1(scramble + p2).digest()
+    return bytes(a ^ b for a, b in zip(p1, mix))
+
+
+class _Conn:
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.seq = 0
+
+    def read_packet(self) -> bytes | None:
+        head = self._read_n(4)
+        if head is None:
+            return None
+        ln = head[0] | (head[1] << 8) | (head[2] << 16)
+        self.seq = head[3] + 1
+        if ln == 0:
+            return b""
+        return self._read_n(ln)
+
+    def _read_n(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def send_packet(self, payload: bytes):
+        ln = len(payload)
+        head = bytes([ln & 0xFF, (ln >> 8) & 0xFF, (ln >> 16) & 0xFF,
+                      self.seq & 0xFF])
+        self.seq += 1
+        self.sock.sendall(head + payload)
+
+    def reset_seq(self):
+        self.seq = 0
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):  # noqa: C901 - protocol state machine
+        server: MySqlServer = self.server.owner  # type: ignore[attr-defined]
+        inst = server.instance
+        conn = _Conn(self.request)
+        ctx = QueryContext(database="public")
+        scramble = secrets.token_bytes(20)
+        # scramble bytes must not contain NUL (clients C-string them)
+        scramble = bytes((b % 254) + 1 for b in scramble)
+        conn.send_packet(self._greeting(scramble))
+        resp = conn.read_packet()
+        if resp is None:
+            return
+        ok, user, db = self._check_login(server, resp, scramble)
+        if not ok:
+            conn.send_packet(self._err(1045, "28000",
+                                       f"Access denied for user '{user}'"))
+            return
+        if db:
+            ctx.database = db
+        conn.send_packet(self._ok())
+
+        while True:
+            conn.reset_seq()
+            pkt = conn.read_packet()
+            if pkt is None or not pkt:
+                return
+            cmd = pkt[0]
+            if cmd == COM_QUIT:
+                return
+            if cmd == COM_PING:
+                conn.send_packet(self._ok())
+                continue
+            if cmd == COM_INIT_DB:
+                ctx.database = pkt[1:].decode("utf-8", "replace")
+                conn.send_packet(self._ok())
+                continue
+            if cmd == COM_QUERY:
+                self._query(conn, inst, ctx,
+                            pkt[1:].decode("utf-8", "replace"))
+                continue
+            if cmd == COM_FIELD_LIST:
+                conn.send_packet(self._eof())
+                continue
+            conn.send_packet(self._err(1047, "08S01", "unsupported command"))
+
+    # ---- handshake ----------------------------------------------------
+    def _greeting(self, scramble: bytes) -> bytes:
+        out = b"\x0a" + _SERVER_VERSION.encode() + b"\x00"
+        out += struct.pack("<I", threading.get_ident() & 0xFFFFFFFF)
+        out += scramble[:8] + b"\x00"
+        out += struct.pack("<H", SERVER_CAPS & 0xFFFF)
+        out += bytes([255])                       # utf8mb4
+        out += struct.pack("<H", 0x0002)          # autocommit
+        out += struct.pack("<H", (SERVER_CAPS >> 16) & 0xFFFF)
+        out += bytes([21])                        # auth data length
+        out += b"\x00" * 10
+        out += scramble[8:20] + b"\x00"
+        out += b"mysql_native_password\x00"
+        return out
+
+    def _check_login(self, server, resp: bytes, scramble: bytes):
+        try:
+            caps = struct.unpack("<I", resp[:4])[0]
+            i = 4 + 4 + 1 + 23
+            end = resp.index(b"\x00", i)
+            user = resp[i:end].decode()
+            i = end + 1
+            if caps & CLIENT_PLUGIN_AUTH_LENENC:
+                ln = resp[i]
+                i += 1
+                token = resp[i:i + ln]
+                i += ln
+            elif caps & CLIENT_SECURE_CONNECTION:
+                ln = resp[i]
+                i += 1
+                token = resp[i:i + ln]
+                i += ln
+            else:
+                end = resp.index(b"\x00", i)
+                token = resp[i:end]
+                i = end + 1
+            db = None
+            if caps & CLIENT_CONNECT_WITH_DB and i < len(resp):
+                end = resp.find(b"\x00", i)
+                if end == -1:
+                    end = len(resp)
+                db = resp[i:end].decode() or None
+        except (ValueError, IndexError, struct.error):
+            return False, "?", None
+        provider = server.user_provider
+        if provider is None:
+            return True, user, db
+        plain = provider.plain_password(user)
+        if plain is None:
+            return False, user, db
+        want = native_password_token(plain, scramble)
+        return hmac.compare_digest(token, want), user, db
+
+    # ---- packets ------------------------------------------------------
+    def _ok(self, affected: int = 0) -> bytes:
+        return (b"\x00" + _lenc_int(affected) + _lenc_int(0)
+                + struct.pack("<H", 0x0002) + struct.pack("<H", 0))
+
+    def _eof(self) -> bytes:
+        return b"\xfe" + struct.pack("<H", 0) + struct.pack("<H", 0x0002)
+
+    def _err(self, code: int, state: str, msg: str) -> bytes:
+        return (b"\xff" + struct.pack("<H", code) + b"#"
+                + state.encode()[:5].ljust(5, b"0")
+                + msg.encode()[:400])
+
+    def _col_def(self, name: str, type_byte: int) -> bytes:
+        out = _lenc_str(b"def") + _lenc_str(b"") + _lenc_str(b"")
+        out += _lenc_str(b"") + _lenc_str(name.encode())
+        out += _lenc_str(name.encode())
+        out += bytes([0x0C])
+        charset = 63 if type_byte != T_VAR_STRING else 255
+        out += struct.pack("<H", charset)
+        out += struct.pack("<I", 1024)
+        out += bytes([type_byte])
+        out += struct.pack("<H", 0)
+        out += bytes([31 if type_byte == T_DOUBLE else 0])
+        out += b"\x00\x00"
+        return out
+
+    # ---- query execution ----------------------------------------------
+    def _query(self, conn: _Conn, inst, ctx, sql: str):
+        stripped = sql.strip().rstrip(";").strip()
+        low = stripped.lower()
+        if low.startswith("set ") or low in ("begin", "commit", "rollback"):
+            conn.send_packet(self._ok())
+            return
+        if _AT_VAR_STMT_RE.fullmatch(stripped):
+            self._at_vars(conn, stripped)
+            return
+        try:
+            outs = inst.execute_sql(stripped, ctx)
+        except Exception as e:  # noqa: BLE001 - protocol boundary
+            conn.send_packet(self._err(1064, "42000", str(e)))
+            return
+        out = outs[-1]
+        if out.result is None:
+            conn.send_packet(self._ok(out.affected_rows or 0))
+            return
+        self._send_resultset(conn, out.result)
+
+    def _at_vars(self, conn: _Conn, sql: str):
+        names = _AT_VAR_RE.findall(sql)
+        if not names:
+            conn.send_packet(self._ok())
+            return
+        cols = [f"@@{n}" for n in names]
+        vals = [_AT_VAR_VALUES.get(n.lower().rsplit(".", 1)[-1], "")
+                for n in names]
+        conn.send_packet(_lenc_int(len(cols)))
+        for c in cols:
+            conn.send_packet(self._col_def(c, T_VAR_STRING))
+        conn.send_packet(self._eof())
+        conn.send_packet(b"".join(_lenc_str(v.encode()) for v in vals))
+        conn.send_packet(self._eof())
+
+    def _send_resultset(self, conn: _Conn, res):
+        names = res.names
+        type_bytes = []
+        ts_cols = set()
+        for i, n in enumerate(names):
+            dt = res.types.get(n)
+            vals = res.cols[i].values
+            if dt is not None and dt.is_timestamp():
+                type_bytes.append(T_DATETIME)
+                ts_cols.add(i)
+            elif vals.dtype.kind == "f":
+                type_bytes.append(T_DOUBLE)
+            elif vals.dtype.kind in "iu":
+                type_bytes.append(T_LONGLONG)
+            elif vals.dtype.kind == "b":
+                type_bytes.append(T_TINY)
+            else:
+                type_bytes.append(T_VAR_STRING)
+        conn.send_packet(_lenc_int(len(names)))
+        for n, tb in zip(names, type_bytes):
+            conn.send_packet(self._col_def(n, tb))
+        conn.send_packet(self._eof())
+        for row in res.rows():
+            parts = []
+            for i, v in enumerate(row):
+                if v is None:
+                    parts.append(b"\xfb")
+                    continue
+                if i in ts_cols:
+                    dt = datetime.datetime.fromtimestamp(
+                        int(v) / 1000.0, tz=datetime.timezone.utc
+                    )
+                    s = dt.strftime("%Y-%m-%d %H:%M:%S.%f")
+                elif isinstance(v, bool):
+                    s = "1" if v else "0"
+                elif isinstance(v, float):
+                    s = repr(v)
+                else:
+                    s = str(v)
+                parts.append(_lenc_str(s.encode()))
+            conn.send_packet(b"".join(parts))
+        conn.send_packet(self._eof())
+
+
+class _TcpServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class MySqlServer:
+    """`MySqlServer(inst, port=4002).start()` — serves until close()."""
+
+    def __init__(self, instance, *, addr: str = "127.0.0.1",
+                 port: int = 4002, user_provider=None):
+        self.instance = instance
+        self.addr = addr
+        self.port = port
+        self.user_provider = user_provider
+        self._srv: _TcpServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "MySqlServer":
+        self._srv = _TcpServer((self.addr, self.port), _Handler)
+        self._srv.owner = self  # type: ignore[attr-defined]
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="mysql-server",
+        )
+        self._thread.start()
+        return self
+
+    def close(self):
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None

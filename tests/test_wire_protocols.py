@@ -1,0 +1,259 @@
+"""MySQL wire protocol + Arrow Flight frontends (VERDICT r2 task #7).
+
+The MySQL test client speaks the real 4.1 protocol over a socket — the
+same bytes a mysql CLI or connector sends.
+"""
+
+import socket
+import struct
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.servers.mysql import MySqlServer, native_password_token
+
+flight = pytest.importorskip("pyarrow.flight")
+
+CLIENT_PROTOCOL_41 = 0x0200
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_PLUGIN_AUTH = 0x80000
+CLIENT_CONNECT_WITH_DB = 0x08
+
+
+class MiniMySqlClient:
+    """Just enough client protocol for the tests: handshake + COM_QUERY."""
+
+    def __init__(self, port, user="root", password="", db=None):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        self.seq = 0
+        greeting = self._read_packet()
+        assert greeting[0] == 0x0A, "expected protocol 10 greeting"
+        i = greeting.index(b"\x00", 1) + 1   # server version
+        i += 4                               # thread id
+        auth1 = greeting[i:i + 8]
+        i += 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10
+        auth2 = greeting[i:i + 12]
+        scramble = auth1 + auth2
+        caps = (CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION
+                | CLIENT_PLUGIN_AUTH)
+        if db:
+            caps |= CLIENT_CONNECT_WITH_DB
+        token = native_password_token(password, scramble)
+        resp = struct.pack("<I", caps) + struct.pack("<I", 1 << 24)
+        resp += bytes([255]) + b"\x00" * 23
+        resp += user.encode() + b"\x00"
+        resp += bytes([len(token)]) + token
+        if db:
+            resp += db.encode() + b"\x00"
+        resp += b"mysql_native_password\x00"
+        self._send_packet(resp)
+        ok = self._read_packet()
+        if ok[0] == 0xFF:
+            code = struct.unpack("<H", ok[1:3])[0]
+            raise PermissionError(f"auth failed: {code}")
+        assert ok[0] == 0x00
+
+    def _read_packet(self):
+        head = self._read_n(4)
+        ln = head[0] | (head[1] << 8) | (head[2] << 16)
+        self.seq = head[3] + 1
+        return self._read_n(ln) if ln else b""
+
+    def _read_n(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("eof")
+            buf += chunk
+        return buf
+
+    def _send_packet(self, payload):
+        ln = len(payload)
+        self.sock.sendall(bytes([
+            ln & 0xFF, (ln >> 8) & 0xFF, (ln >> 16) & 0xFF, self.seq & 0xFF
+        ]) + payload)
+        self.seq += 1
+
+    @staticmethod
+    def _lenc(data, i):
+        b0 = data[i]
+        if b0 < 0xFB:
+            return b0, i + 1
+        if b0 == 0xFC:
+            return struct.unpack("<H", data[i + 1:i + 3])[0], i + 3
+        if b0 == 0xFD:
+            return int.from_bytes(data[i + 1:i + 4], "little"), i + 4
+        return struct.unpack("<Q", data[i + 1:i + 9])[0], i + 9
+
+    def query(self, sql: str):
+        """Returns (column_names, rows) or raises on ERR."""
+        self.seq = 0
+        self._send_packet(b"\x03" + sql.encode())
+        first = self._read_packet()
+        if first[0] == 0xFF:
+            raise RuntimeError(first[9:].decode("utf-8", "replace"))
+        if first[0] == 0x00:
+            return [], []  # OK packet (no resultset)
+        ncols, _ = self._lenc(first, 0)
+        names = []
+        for _ in range(ncols):
+            col = self._read_packet()
+            i = 0
+            vals = []
+            for _ in range(5):
+                ln, i = self._lenc(col, i)
+                vals.append(col[i:i + ln])
+                i += ln
+            names.append(vals[4].decode())
+        eof = self._read_packet()
+        assert eof[0] == 0xFE
+        rows = []
+        while True:
+            pkt = self._read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            row = []
+            i = 0
+            while i < len(pkt):
+                if pkt[i] == 0xFB:
+                    row.append(None)
+                    i += 1
+                else:
+                    ln, i = self._lenc(pkt, i)
+                    row.append(pkt[i:i + ln].decode())
+                    i += ln
+            rows.append(row)
+        return names, rows
+
+    def close(self):
+        try:
+            self.seq = 0
+            self._send_packet(b"\x01")
+        except OSError:
+            pass
+        self.sock.close()
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    s = Standalone(str(tmp_path / "data"))
+    s.sql(
+        "CREATE TABLE wt (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, "
+        "PRIMARY KEY (host))"
+    )
+    s.sql(
+        "INSERT INTO wt (host, v, ts) VALUES ('a', 1.5, 1000), "
+        "('b', 2.5, 2000)"
+    )
+    yield s
+    s.close()
+
+
+def test_mysql_query_roundtrip(inst):
+    srv = MySqlServer(inst, port=0).start()
+    try:
+        c = MiniMySqlClient(srv.port)
+        names, rows = c.query("SELECT host, v FROM wt ORDER BY host")
+        assert names == ["host", "v"]
+        assert rows == [["a", "1.5"], ["b", "2.5"]]
+        # connect-time probe
+        names, rows = c.query("select @@version_comment limit 1")
+        assert rows == [["greptimedb-tpu"]]
+        # DDL/insert through the wire
+        names, rows = c.query(
+            "INSERT INTO wt (host, v, ts) VALUES ('c', 9.0, 3000)"
+        )
+        names, rows = c.query("SELECT count(*) FROM wt")
+        assert rows == [["3"]]
+        # error surfaces as ERR packet
+        with pytest.raises(RuntimeError):
+            c.query("SELECT nope FROM missing_table")
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_mysql_auth(inst):
+    from greptimedb_tpu.auth import StaticUserProvider
+
+    provider = StaticUserProvider({"alice": "secret"})
+    srv = MySqlServer(inst, port=0, user_provider=provider).start()
+    try:
+        c = MiniMySqlClient(srv.port, user="alice", password="secret")
+        _, rows = c.query("SELECT 1")
+        assert rows == [["1"]]
+        c.close()
+        with pytest.raises(PermissionError):
+            MiniMySqlClient(srv.port, user="alice", password="wrong")
+        with pytest.raises(PermissionError):
+            MiniMySqlClient(srv.port, user="mallory", password="secret")
+    finally:
+        srv.close()
+
+
+def test_mysql_init_db(inst):
+    inst.sql("CREATE DATABASE mdb")
+    inst.sql(
+        "CREATE TABLE mdb.t2 (v DOUBLE, ts TIMESTAMP TIME INDEX)"
+    )
+    inst.sql("INSERT INTO mdb.t2 (v, ts) VALUES (7.0, 1000)")
+    srv = MySqlServer(inst, port=0).start()
+    try:
+        c = MiniMySqlClient(srv.port, db="mdb")
+        _, rows = c.query("SELECT v FROM t2")
+        assert rows == [["7.0"]]
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_flight_do_get_and_info(inst):
+    from greptimedb_tpu.servers.flight import FlightFrontend
+
+    f = FlightFrontend(inst, port=0).start()
+    try:
+        client = flight.connect(f"grpc://127.0.0.1:{f.server.port}")
+        reader = client.do_get(
+            flight.Ticket(b"SELECT host, v, ts FROM wt ORDER BY host")
+        )
+        table = reader.read_all()
+        assert table.column("host").to_pylist() == ["a", "b"]
+        assert table.column("v").to_pylist() == [1.5, 2.5]
+        assert pa.types.is_timestamp(table.schema.field("ts").type)
+        info = client.get_flight_info(
+            flight.FlightDescriptor.for_command(b"SELECT count(*) FROM wt")
+        )
+        assert info.total_records == 1
+        with pytest.raises(flight.FlightServerError):
+            client.do_get(flight.Ticket(b"SELECT broken FROM nothing"))
+    finally:
+        f.close()
+
+
+def test_flight_do_put_ingest(inst):
+    from greptimedb_tpu.servers.flight import FlightFrontend
+
+    f = FlightFrontend(inst, port=0).start()
+    try:
+        client = flight.connect(f"grpc://127.0.0.1:{f.server.port}")
+        batch = pa.record_batch({
+            "host": pa.array(["c", "d"]),
+            "v": pa.array([10.0, 20.0]),
+            "ts": pa.array(
+                np.asarray([4000, 5000], np.int64), pa.timestamp("ms")
+            ),
+        })
+        desc = flight.FlightDescriptor.for_path("wt")
+        writer, _ = client.do_put(desc, batch.schema)
+        writer.write_batch(batch)
+        writer.close()
+        res = inst.sql("SELECT host, v FROM wt ORDER BY host")
+        rows = [list(r) for r in res.rows()]
+        assert rows == [
+            ["a", 1.5], ["b", 2.5], ["c", 10.0], ["d", 20.0],
+        ]
+    finally:
+        f.close()
