@@ -439,17 +439,35 @@ class Standalone:
                            all_columns=all_columns), table
 
     def _explain(self, stmt: A.Explain, ctx: QueryContext) -> QueryResult:
-        if not isinstance(stmt.statement, A.Select):
+        if not isinstance(stmt.statement, (A.Select, A.SetOp)):
             raise UnsupportedError("EXPLAIN supports SELECT only")
-        plan, _ = self.plan(stmt.statement, ctx)
-        lines = plan.explain_lines()
+        if isinstance(stmt.statement, A.Select) and not (
+            stmt.statement.ctes or isinstance(
+                stmt.statement.source, (A.JoinSource, A.SubquerySource)
+            )
+        ):
+            plan, _ = self.plan(stmt.statement, ctx)
+            lines = plan.explain_lines()
+        else:
+            lines = ["SelectPlan[relational]"]
         if stmt.analyze:
             import time as _time
 
+            from greptimedb_tpu.query import stats as qstats
+
             t0 = _time.perf_counter()
-            res = self._select(stmt.statement, ctx)
+            with qstats.collect() as st:
+                if isinstance(stmt.statement, A.SetOp):
+                    from greptimedb_tpu.query import relational
+
+                    res = relational.execute(self, stmt.statement, ctx)
+                else:
+                    res = self._select(stmt.statement, ctx)
             dt = (_time.perf_counter() - t0) * 1000
-            lines.append(f"  Metrics: rows={res.num_rows} elapsed={dt:.3f}ms")
+            lines.append(
+                f"  Metrics: rows={res.num_rows} elapsed={dt:.3f}ms"
+            )
+            lines.extend(st.lines())
         return _result_from_lists(["plan"], [lines])
 
     def _tql(self, stmt: A.Tql, ctx: QueryContext) -> QueryResult:

@@ -972,20 +972,28 @@ def execute_range_device(engine, plan, table):
     for it in plan.range_items:
         r0 = math.gcd(r0, it.range_ms)
 
+    from greptimedb_tpu.query import stats
+
     version = table.data_version()
     cache: DeviceRangeCache = engine.range_cache
     tkey = (table.info.database, table.info.name, id(table))
     entry = cache.lookup_compatible(tkey, version, r0, plan.align_to)
     if entry is None:
-        entry = build_entry(plan, table, items,
-                            mesh=getattr(engine, "mesh", None),
-                            byte_budget=cache.byte_budget)
+        with stats.timed("grid_cache_build_ms"):
+            entry = build_entry(plan, table, items,
+                                mesh=getattr(engine, "mesh", None),
+                                byte_budget=cache.byte_budget)
         if entry is None:
             return None
+        stats.note("grid_cache", "miss(build)")
         cache.insert((tkey, entry.res, entry.phase), entry)
     else:
-        if not ensure_states(entry, plan, table, items, cache=cache):
+        stats.note("grid_cache", "hit")
+        with stats.timed("grid_cache_ensure_ms"):
+            ok = ensure_states(entry, plan, table, items, cache=cache)
+        if not ok:
             return None
+    stats.add("grid_cache_bytes", entry.bytes())
 
     res = entry.res
     # WHERE ts bounds must land on cell edges or partials can't honor them
@@ -1078,12 +1086,16 @@ def execute_range_device(engine, plan, table):
         entry.nan_ok.get(fname, fname == "__rows__") for fname, _ in items
     )
     program = get_program()
-    out = program(
-        arrs, memo["gid"], memo["mask"],
-        memo["delta"], memo["lo"], memo["hi"],
-        spec=(stride, n_steps, g, memo["fold"], nanenc, prog_items),
-    )
-    out = np.asarray(out)
+    with stats.timed("device_exec_ms"):
+        out = program(
+            arrs, memo["gid"], memo["mask"],
+            memo["delta"], memo["lo"], memo["hi"],
+            spec=(stride, n_steps, g, memo["fold"], nanenc, prog_items),
+        )
+        out = np.asarray(out)
+    stats.add("device_readback_bytes", out.nbytes)
+    stats.add("range_groups", g)
+    stats.add("range_steps", n_steps)
     n_items = len(plan.range_items)
     vals = out[:n_items].astype(np.float64)
     if nanenc:

@@ -231,8 +231,10 @@ class QueryEngine:
         """Observability: device/host execution counts with fallback
         reasons (/metrics gtpu_query_exec_path_total)."""
         self.last_exec_path = "device" if path == "device" else "host"
+        from greptimedb_tpu.query import stats
         from greptimedb_tpu.telemetry.metrics import global_registry
 
+        stats.note(f"exec_path_{kind}", path)
         global_registry.counter(
             "gtpu_query_exec_path_total",
             "Query executions by path (device | host:<fallback reason>)",
@@ -284,12 +286,23 @@ class QueryEngine:
         if plan.having is not None:
             collect_columns(plan.having, needed)
         field_names = [f for f in table.field_names if f in needed]
-        data = table.scan(
-            ts_min=plan.scan.ts_min,
-            ts_max=plan.scan.ts_max,
-            field_names=field_names,
-            matchers=plan.scan.matchers or None,
-        )
+        from greptimedb_tpu.query import stats
+
+        with stats.timed("scan_ms"):
+            data = table.scan(
+                ts_min=plan.scan.ts_min,
+                ts_max=plan.scan.ts_max,
+                field_names=field_names,
+                matchers=plan.scan.matchers or None,
+            )
+        stats.add("rows_scanned", data.num_rows)
+        stats.add("series_total", data.registry.num_series)
+        if stats.active() is not None and plan.scan.matchers:
+            # selectivity is worth a re-match under EXPLAIN ANALYZE only
+            stats.add("series_matched", sum(
+                len(r.series.match_sids(plan.scan.matchers))
+                for r in table.regions
+            ))
         src = RowsSource(data.rows, data.registry, table.tag_names,
                          table.ts_name)
         if plan.scan.residual is not None and src.num_rows:
@@ -298,6 +311,8 @@ class QueryEngine:
             if not mask.all():
                 from greptimedb_tpu.storage.memtable import _slice_rows
 
+                stats.add("rows_filtered_residual",
+                          int(src.num_rows - mask.sum()))
                 src = RowsSource(
                     _slice_rows(src.rows, mask), data.registry,
                     table.tag_names, table.ts_name,
@@ -421,10 +436,14 @@ class QueryEngine:
                 raise UnsupportedError(f"DISTINCT {a.op} is not supported")
             specs.append((a.key, a.op, vk, a.q))
         ts = src.rows.ts if src.rows is not None else None
-        results, path = grouped_reduce(
-            specs, values, gid, valid_map, g, ts=ts,
-            prefer_device=self.prefer_device,
-        )
+        from greptimedb_tpu.query import stats
+
+        with stats.timed("reduce_ms"):
+            results, path = grouped_reduce(
+                specs, values, gid, valid_map, g, ts=ts,
+                prefer_device=self.prefer_device,
+            )
+        stats.add("agg_groups", g)
         self._record_path("aggregate", path)
         agg_cols = dict(key_cols)
         for name, (vals, valid) in results.items():

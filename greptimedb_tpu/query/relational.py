@@ -389,6 +389,8 @@ def _eval_source(inst, src, ctx, env, conjuncts):
         qr = _subselect(inst, src.query, ctx, env)
         return Frame.from_result(qr, src.alias), conjuncts
     if isinstance(src, A.JoinSource):
+        from greptimedb_tpu.query import stats
+
         # WHERE pushdown must not cross into a null-supplying side: a
         # filter below the outer side would silently convert filtered-out
         # matches into NULL-padded rows
@@ -402,7 +404,10 @@ def _eval_source(inst, src, ctx, env, conjuncts):
             rf, conjuncts = _eval_source(inst, src.right, ctx, env, conjuncts)
         else:
             rf, _ = _eval_source(inst, src.right, ctx, env, [])
-        return _join(lf, rf, src), conjuncts
+        with stats.timed("join_ms"):
+            joined = _join(lf, rf, src)
+        stats.add("join_rows", joined.num_rows)
+        return joined, conjuncts
     raise PlanError(f"unsupported FROM source: {src!r}")
 
 

@@ -44,13 +44,81 @@ def result_to_arrow(res) -> pa.Table:
     return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
 
 
+class _BearerMiddleware(flight.ServerMiddleware):
+    def __init__(self, header: str):
+        self.header = header
+
+    def sending_headers(self):
+        return {"authorization": self.header}
+
+
+class _BasicAuthMiddlewareFactory(flight.ServerMiddlewareFactory):
+    """Basic-credentials handshake -> bearer token, validated on every
+    call (what `client.authenticate_basic_token(user, pwd)` speaks)."""
+
+    def __init__(self, provider):
+        self.provider = provider
+        self._tokens: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def start_call(self, info, headers):
+        import base64
+        import secrets
+
+        auth = None
+        for k, v in headers.items():
+            if k.lower() == "authorization" and v:
+                auth = v[0]
+        if auth is None:
+            raise flight.FlightUnauthenticatedError("no credentials")
+        if auth.lower().startswith("basic "):
+            try:
+                user, _, pwd = base64.b64decode(
+                    auth[6:]
+                ).decode().partition(":")
+            except Exception:
+                raise flight.FlightUnauthenticatedError("bad credentials")
+            if not self.provider.authenticate(user, pwd):
+                raise flight.FlightUnauthenticatedError("access denied")
+            token = secrets.token_urlsafe(16)
+            with self._lock:
+                if len(self._tokens) >= 1024:
+                    self._tokens.pop(next(iter(self._tokens)))
+                self._tokens[token] = user
+            return _BearerMiddleware(f"Bearer {token}")
+        if auth.startswith("Bearer "):
+            with self._lock:
+                ok = auth[7:] in self._tokens
+            if not ok:
+                raise flight.FlightUnauthenticatedError("bad token")
+            return _BearerMiddleware(auth)
+        raise flight.FlightUnauthenticatedError("unsupported auth scheme")
+
+
+class _NoOpAuthHandler(flight.ServerAuthHandler):
+    """Handshake passthrough: credential checking happens in the header
+    middleware (the pyarrow-documented basic-auth pattern)."""
+
+    def authenticate(self, outgoing, incoming):
+        pass
+
+    def is_valid(self, token):
+        return ""
+
+
 class FlightServer(flight.FlightServerBase):
     def __init__(self, instance, *, addr: str = "127.0.0.1", port: int = 0,
                  user_provider=None):
         self.instance = instance
         self.user_provider = user_provider
         location = f"grpc://{addr}:{port}"
-        super().__init__(location)
+        kwargs = {}
+        if user_provider is not None:
+            kwargs["middleware"] = {
+                "auth": _BasicAuthMiddlewareFactory(user_provider)
+            }
+            kwargs["auth_handler"] = _NoOpAuthHandler()
+        super().__init__(location, **kwargs)
         self.addr = addr
         # FlightServerBase binds immediately; port resolves the 0 case
         self._location = location

@@ -117,14 +117,22 @@ class _Conn:
         self.seq = 0
 
     def read_packet(self) -> bytes | None:
-        head = self._read_n(4)
-        if head is None:
-            return None
-        ln = head[0] | (head[1] << 8) | (head[2] << 16)
-        self.seq = head[3] + 1
-        if ln == 0:
-            return b""
-        return self._read_n(ln)
+        """One logical packet, reassembling the 16MB-split continuation
+        frames the protocol mandates for payloads >= 0xFFFFFF."""
+        out = b""
+        while True:
+            head = self._read_n(4)
+            if head is None:
+                return None
+            ln = head[0] | (head[1] << 8) | (head[2] << 16)
+            self.seq = head[3] + 1
+            if ln:
+                chunk = self._read_n(ln)
+                if chunk is None:
+                    return None
+                out += chunk
+            if ln < 0xFFFFFF:
+                return out
 
     def _read_n(self, n: int) -> bytes | None:
         buf = b""
@@ -136,11 +144,19 @@ class _Conn:
         return buf
 
     def send_packet(self, payload: bytes):
-        ln = len(payload)
-        head = bytes([ln & 0xFF, (ln >> 8) & 0xFF, (ln >> 16) & 0xFF,
-                      self.seq & 0xFF])
-        self.seq += 1
-        self.sock.sendall(head + payload)
+        """Send one logical packet, splitting at the protocol's 0xFFFFFF
+        frame cap (a max-size frame must be followed by a continuation,
+        possibly empty)."""
+        while True:
+            chunk = payload[:0xFFFFFF]
+            payload = payload[0xFFFFFF:]
+            ln = len(chunk)
+            head = bytes([ln & 0xFF, (ln >> 8) & 0xFF, (ln >> 16) & 0xFF,
+                          self.seq & 0xFF])
+            self.seq += 1
+            self.sock.sendall(head + chunk)
+            if ln < 0xFFFFFF:
+                return
 
     def reset_seq(self):
         self.seq = 0
@@ -180,7 +196,13 @@ class _Handler(socketserver.BaseRequestHandler):
                 conn.send_packet(self._ok())
                 continue
             if cmd == COM_INIT_DB:
-                ctx.database = pkt[1:].decode("utf-8", "replace")
+                db_name = pkt[1:].decode("utf-8", "replace")
+                if not inst.catalog.has_database(db_name):
+                    conn.send_packet(self._err(
+                        1049, "42000", f"Unknown database '{db_name}'"
+                    ))
+                    continue
+                ctx.database = db_name
                 conn.send_packet(self._ok())
                 continue
             if cmd == COM_QUERY:

@@ -340,3 +340,36 @@ def test_http_log_ingest(tmp_path):
         srv.stop()
         inst.close()
     
+
+
+def test_explain_analyze_stage_metrics(tmp_path):
+    """EXPLAIN ANALYZE reports per-stage metrics (VERDICT r2 task #9):
+    rows scanned, exec path, cache state, reduce/device timings."""
+    import numpy as np
+
+    from greptimedb_tpu.instance import Standalone
+
+    inst = Standalone(str(tmp_path / "data"))
+    inst.sql(
+        "CREATE TABLE ea (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, "
+        "PRIMARY KEY (host))"
+    )
+    table = inst.catalog.table("public", "ea")
+    table.write(
+        {"host": np.asarray(["a", "b"] * 10, object)},
+        np.arange(20, dtype=np.int64) * 1000,
+        {"v": np.arange(20, dtype=np.float64)},
+    )
+    r = inst.sql("EXPLAIN ANALYZE SELECT host, count(*) FROM ea GROUP BY host")
+    text = "\n".join(row[0] for row in r.rows())
+    assert "rows_scanned: 20" in text
+    assert "agg_groups: 2" in text
+    assert "exec_path_aggregate:" in text
+    assert "reduce_ms:" in text
+    # joins report their stage too
+    r = inst.sql(
+        "EXPLAIN ANALYZE SELECT a.host FROM ea a JOIN ea b ON a.host = b.host"
+    )
+    text = "\n".join(row[0] for row in r.rows())
+    assert "join_rows:" in text and "join_ms:" in text
+    inst.close()

@@ -257,3 +257,40 @@ def test_flight_do_put_ingest(inst):
         ]
     finally:
         f.close()
+
+
+def test_flight_auth(inst):
+    from greptimedb_tpu.auth import StaticUserProvider
+    from greptimedb_tpu.servers.flight import FlightFrontend
+
+    provider = StaticUserProvider({"alice": "secret"})
+    f = FlightFrontend(inst, port=0, user_provider=provider).start()
+    try:
+        client = flight.connect(f"grpc://127.0.0.1:{f.server.port}")
+        with pytest.raises(flight.FlightUnauthenticatedError):
+            client.do_get(flight.Ticket(b"SELECT 1")).read_all()
+        token = client.authenticate_basic_token("alice", "secret")
+        opts = flight.FlightCallOptions(headers=[token])
+        t = client.do_get(
+            flight.Ticket(b"SELECT count(*) FROM wt"), options=opts
+        )
+        assert t.read_all().num_rows == 1
+        bad = flight.connect(f"grpc://127.0.0.1:{f.server.port}")
+        with pytest.raises(flight.FlightUnauthenticatedError):
+            bad.authenticate_basic_token("alice", "wrong")
+    finally:
+        f.close()
+
+
+def test_mysql_unknown_database_rejected(inst):
+    srv = MySqlServer(inst, port=0).start()
+    try:
+        c = MiniMySqlClient(srv.port)
+        c.seq = 0
+        c._send_packet(b"\x02nodb")
+        err = c._read_packet()
+        assert err[0] == 0xFF
+        assert struct.unpack("<H", err[1:3])[0] == 1049
+        c.close()
+    finally:
+        srv.close()
