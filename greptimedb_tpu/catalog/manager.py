@@ -45,6 +45,7 @@ class TableInfo:
     options: dict = dc_field(default_factory=dict)
     num_regions: int = 1
     created_ms: int = 0
+    partition: dict | None = None   # PartitionRule.to_json payload
 
     def region_ids(self) -> list[int]:
         return [
@@ -61,6 +62,7 @@ class TableInfo:
             "options": self.options,
             "num_regions": self.num_regions,
             "created_ms": self.created_ms,
+            "partition": self.partition,
             "columns": [
                 {
                     "name": c.name,
@@ -98,6 +100,7 @@ class TableInfo:
             engine=d.get("engine", "mito"),
             options=d.get("options", {}),
             num_regions=d.get("num_regions", 1),
+            partition=d.get("partition"),
             created_ms=d.get("created_ms", 0),
         )
 
@@ -267,6 +270,7 @@ class CatalogManager:
         options: dict | None = None,
         num_regions: int = 1,
         if_not_exists: bool = False,
+        partition: dict | None = None,
     ) -> Table:
         with self._lock:
             db = self._db(database)
@@ -287,6 +291,7 @@ class CatalogManager:
                 engine=engine,
                 options=options or {},
                 num_regions=max(1, num_regions),
+                partition=partition,
                 created_ms=int(time.time() * 1000),
             )
             self._next_table_id += 1

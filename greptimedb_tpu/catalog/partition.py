@@ -1,0 +1,121 @@
+"""PARTITION ON expression rules: write routing + query-time pruning.
+
+Capability counterpart of the reference's multi-dimension partition rule
+(/root/reference/src/partition/src/multi_dim.rs:37-74
+MultiDimPartitionRule::find_region and src/partition/src/manager.rs:228
+find_regions_by_filters): each region owns the rows satisfying its
+expression over the partition columns; queries whose tag matchers pin the
+partition columns scan only the owning regions.
+
+Routing is first-match-wins over the expression list (the reference
+requires the expressions to be exhaustive and disjoint; rows matching no
+expression fall to the last region so ingestion never fails)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from greptimedb_tpu.query.expr import Col, ColumnSource, eval_expr
+from greptimedb_tpu.sql import ast as A
+
+
+class _ValuesSource(ColumnSource):
+    def __init__(self, values: dict[str, str]):
+        self._values = values
+        self.num_rows = 1
+
+    def col(self, name: str) -> Col:
+        if name not in self._values:
+            from greptimedb_tpu.errors import ColumnNotFoundError
+
+            raise ColumnNotFoundError(name)
+        return Col(np.asarray([self._values[name]], dtype=object))
+
+
+class PartitionRule:
+    def __init__(self, columns: list[str], exprs: list[A.Expr],
+                 expr_texts: list[str]):
+        self.columns = list(columns)
+        self.exprs = list(exprs)
+        self.expr_texts = list(expr_texts)
+
+    @property
+    def num_regions(self) -> int:
+        return max(len(self.exprs), 1)
+
+    # ---- persistence ---------------------------------------------------
+    def to_json(self) -> dict:
+        return {"columns": self.columns, "exprs": self.expr_texts}
+
+    @staticmethod
+    def from_json(d: dict) -> "PartitionRule":
+        from greptimedb_tpu.sql.parser import Parser
+
+        exprs = [Parser(t).expr() for t in d["exprs"]]
+        return PartitionRule(d["columns"], exprs, list(d["exprs"]))
+
+    @staticmethod
+    def from_ast(columns: list[str], exprs: list[A.Expr]) -> "PartitionRule":
+        from greptimedb_tpu.query.expr import format_expr
+
+        return PartitionRule(columns, exprs,
+                             [format_expr(e) for e in exprs])
+
+    # ---- routing -------------------------------------------------------
+    def region_of(self, values: dict[str, str]) -> int:
+        src = _ValuesSource(values)
+        for i, e in enumerate(self.exprs):
+            try:
+                c = eval_expr(e, src)
+            except Exception:
+                continue
+            if bool(np.asarray(c.values, bool)[0]) and bool(c.valid_mask[0]):
+                return i
+        return self.num_regions - 1
+
+    def route_rows(self, tag_cols: dict[str, np.ndarray], n: int
+                   ) -> np.ndarray:
+        """Per-row region index; expression evaluation once per distinct
+        partition-key combination."""
+        cols = [
+            np.asarray(tag_cols.get(c, np.full(n, "", object)), object)
+            for c in self.columns
+        ]
+        if not cols:
+            return np.zeros(n, np.int32)
+        stacked = np.stack([c.astype(str) for c in cols], axis=1)
+        uniq, inv = np.unique(stacked, axis=0, return_inverse=True)
+        dest = np.empty(len(uniq), np.int32)
+        for i, row in enumerate(uniq):
+            dest[i] = self.region_of(dict(zip(self.columns, row)))
+        return dest[np.ravel(inv)]
+
+    # ---- pruning -------------------------------------------------------
+    def prune(self, matchers: list[tuple[str, str, object]]
+              ) -> list[int] | None:
+        """Region indices that can satisfy the matchers, or None when the
+        matchers don't pin every partition column with eq/in (conservative:
+        scan everything)."""
+        value_sets: dict[str, set] = {}
+        for name, op, value in matchers or []:
+            if name not in self.columns:
+                continue
+            if op == "eq":
+                s = {value}
+            elif op == "in":
+                s = set(value)
+            else:
+                continue  # ne/re restrict further; never widen
+            cur = value_sets.get(name)
+            value_sets[name] = s if cur is None else (cur & s)
+        if set(value_sets) != set(self.columns):
+            return None
+        combos = [{}]
+        for c in self.columns:
+            vals = value_sets[c]
+            if not vals or len(combos) * len(vals) > 4096:
+                return None if vals else []
+            combos = [
+                {**combo, c: v} for combo in combos for v in sorted(vals)
+            ]
+        return sorted({self.region_of(combo) for combo in combos})

@@ -58,6 +58,12 @@ class Table:
     def __init__(self, info, regions: list[Region]):
         self.info = info
         self.regions = regions
+        self.partition_rule = None
+        part = getattr(info, "partition", None)
+        if part:
+            from greptimedb_tpu.catalog.partition import PartitionRule
+
+            self.partition_rule = PartitionRule.from_json(part)
 
     @property
     def name(self) -> str:
@@ -124,7 +130,13 @@ class Table:
                 field_valid=field_valid or None, op=op, skip_wal=skip_wal,
             )
             return n
-        dest = _route_rows(tag_cols, n, len(self.regions))
+        if self.partition_rule is not None:
+            dest = self.partition_rule.route_rows(
+                dict(zip(tag_names, tag_cols)), n
+            )
+            dest = np.clip(dest, 0, len(self.regions) - 1)
+        else:
+            dest = _route_rows(tag_cols, n, len(self.regions))
         for r_idx in np.unique(dest):
             sel = dest == r_idx
             self.regions[int(r_idx)].write(
@@ -171,9 +183,21 @@ class Table:
                               field_names=names, sids=sids)
             return TableScanData(res.rows, res.registry, names)
 
+        from greptimedb_tpu.query import stats
+
+        scan_regions = self.regions
+        if self.partition_rule is not None and matchers:
+            keep = self.partition_rule.prune(matchers)
+            if keep is not None:
+                scan_regions = [
+                    self.regions[i] for i in keep if i < len(self.regions)
+                ]
+                stats.add("regions_pruned",
+                          len(self.regions) - len(scan_regions))
+        stats.add("regions_scanned", len(scan_regions))
         merged = SeriesRegistry(self.tag_names)
         chunks: list[ColumnarRows] = []
-        for region in self.regions:
+        for region in scan_regions:
             sids = None
             if matchers:
                 sids = region.series.match_sids(matchers)

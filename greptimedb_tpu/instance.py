@@ -277,13 +277,28 @@ class Standalone:
             ))
         schema = Schema(cols)
         num_regions = 1
+        partition = None
         if stmt.partitions:
+            from greptimedb_tpu.catalog.partition import PartitionRule
+
             num_regions = max(1, len(stmt.partitions))
+            rule = PartitionRule.from_ast(
+                stmt.partition_columns, stmt.partitions
+            )
+            for c in rule.columns:
+                col = schema.maybe_column(c)
+                if col is None or not col.is_tag:
+                    raise InvalidArgumentError(
+                        f"PARTITION ON column {c!r} must be a tag "
+                        "(PRIMARY KEY) column"
+                    )
+            partition = rule.to_json()
         elif "num_regions" in stmt.options:
             num_regions = int(stmt.options.pop("num_regions"))
         self.catalog.create_table(
             db, name, schema, engine=stmt.engine, options=stmt.options,
             num_regions=num_regions, if_not_exists=stmt.if_not_exists,
+            partition=partition,
         )
 
     def _alter(self, stmt: A.AlterTable, ctx: QueryContext) -> int:
@@ -557,6 +572,13 @@ class Standalone:
             )
         lines.append(",\n".join(defs))
         lines.append(")")
+        part = getattr(table.info, "partition", None)
+        if part:
+            cols_txt = ", ".join(f"`{c}`" for c in part["columns"])
+            lines.append(
+                f"PARTITION ON COLUMNS ({cols_txt}) ("
+                + ", ".join(part["exprs"]) + ")"
+            )
         lines.append(f"ENGINE={table.info.engine}")
         if table.info.options:
             opts = ", ".join(

@@ -395,7 +395,8 @@ def format_expr(e: A.Expr) -> str:
     """Render an expression back to SQL-ish text (output column naming)."""
     if isinstance(e, A.Literal):
         if isinstance(e.value, str):
-            return f"'{e.value}'"
+            escaped = e.value.replace("'", "''")
+            return f"'{escaped}'"
         if e.value is None:
             return "NULL"
         return str(e.value)

@@ -116,23 +116,31 @@ class _Conn:
         self.sock = sock
         self.seq = 0
 
+    # logical packet cap (max_allowed_packet analog): bounds what one
+    # unauthenticated socket can make the server buffer
+    MAX_PACKET = 64 * 1024 * 1024
+
     def read_packet(self) -> bytes | None:
         """One logical packet, reassembling the 16MB-split continuation
         frames the protocol mandates for payloads >= 0xFFFFFF."""
-        out = b""
+        parts = []
+        total = 0
         while True:
             head = self._read_n(4)
             if head is None:
                 return None
             ln = head[0] | (head[1] << 8) | (head[2] << 16)
             self.seq = head[3] + 1
+            total += ln
+            if total > self.MAX_PACKET:
+                raise ConnectionError("packet exceeds max_allowed_packet")
             if ln:
                 chunk = self._read_n(ln)
                 if chunk is None:
                     return None
-                out += chunk
+                parts.append(chunk)
             if ln < 0xFFFFFF:
-                return out
+                return b"".join(parts)
 
     def _read_n(self, n: int) -> bytes | None:
         buf = b""
@@ -163,7 +171,13 @@ class _Conn:
 
 
 class _Handler(socketserver.BaseRequestHandler):
-    def handle(self):  # noqa: C901 - protocol state machine
+    def handle(self):
+        try:
+            self._handle_conn()
+        except (ConnectionError, OSError):
+            pass  # client went away / oversized packet: drop the socket
+
+    def _handle_conn(self):  # noqa: C901 - protocol state machine
         server: MySqlServer = self.server.owner  # type: ignore[attr-defined]
         inst = server.instance
         conn = _Conn(self.request)

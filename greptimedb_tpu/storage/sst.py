@@ -62,6 +62,44 @@ def sort_rows(rows: ColumnarRows) -> ColumnarRows:
     return _slice_rows(rows, order)
 
 
+_SID_INDEX_KEY = b"gtpu.sid_index"
+
+
+def _build_sid_index(sid: np.ndarray, n: int, row_group_rows: int) -> bytes:
+    """Per-row-group distinct-sid index, embedded in the Parquet footer.
+
+    The inverted-index analog (/root/reference/src/index/src/
+    inverted_index/format.rs:28-34): the series registry already maps tag
+    values -> sids, so a per-row-group sid set gives tag-value -> row-group
+    pruning at the same granularity. Inlining it in the footer (instead of
+    a sidecar puffin file) ties its lifecycle to the SST object."""
+    from greptimedb_tpu.storage import codec
+
+    offsets = [0]
+    chunks = []
+    for start in range(0, n, row_group_rows):
+        uniq = np.unique(sid[start:start + row_group_rows])
+        chunks.append(uniq.astype(np.int32))
+        offsets.append(offsets[-1] + len(uniq))
+    sids_cat = (np.concatenate(chunks) if chunks
+                else np.zeros(0, np.int32))
+    return codec.encode_columns({
+        "offsets": np.asarray(offsets, np.int64),
+        "sids": sids_cat,
+    })
+
+
+def _load_sid_index(pf) -> tuple[np.ndarray, np.ndarray] | None:
+    meta = pf.schema_arrow.metadata or {}
+    payload = meta.get(_SID_INDEX_KEY)
+    if payload is None:
+        return None
+    from greptimedb_tpu.storage import codec
+
+    cols, _ = codec.decode_columns(payload)
+    return cols["offsets"], cols["sids"]
+
+
 def write_sst(
     store: ObjectStore,
     path: str,
@@ -85,6 +123,11 @@ def write_sst(
             mask = ~rows.field_valid[name]
         arrays[name] = pa.array(vals, mask=mask)
     table = pa.table(arrays)
+    table = table.replace_schema_metadata({
+        _SID_INDEX_KEY: _build_sid_index(
+            rows.sid, len(rows), row_group_rows
+        ),
+    })
     buf = io.BytesIO()
     pq.write_table(
         table, buf, row_group_size=row_group_rows, compression="zstd",
@@ -129,7 +172,12 @@ def read_sst(
     )
     cols = list(_INTERNAL) + [n for n in wanted_fields if n in schema_names]
 
+    from greptimedb_tpu.query import stats
+
     ts_idx = schema_names.index(TS_COL)
+    sid_idx = schema_names.index(SERIES_COL)
+    sid_index = _load_sid_index(pf) if sids is not None else None
+    sids_sorted = np.sort(sids) if sids is not None else None
     groups = []
     for g in range(md.num_row_groups):
         st = md.row_group(g).column(ts_idx).statistics
@@ -138,7 +186,25 @@ def read_sst(
                 continue
             if ts_max is not None and st.min > ts_max:
                 continue
+        if sids_sorted is not None:
+            if sid_index is not None:
+                offsets, all_sids = sid_index
+                grp = all_sids[offsets[g]:offsets[g + 1]]
+                if not np.isin(
+                    grp, sids_sorted, assume_unique=True
+                ).any():
+                    continue
+            else:
+                # older SSTs without the footer index: min/max stats on
+                # the (sorted) __series column still bound the sid range
+                sst = md.row_group(g).column(sid_idx).statistics
+                if sst is not None and sst.has_min_max:
+                    lo = np.searchsorted(sids_sorted, sst.min, "left")
+                    if lo >= len(sids_sorted) or sids_sorted[lo] > sst.max:
+                        continue
         groups.append(g)
+    stats.add("row_groups_total", md.num_row_groups)
+    stats.add("row_groups_read", len(groups))
     if not groups:
         return None
     table = pf.read_row_groups(groups, columns=cols)
