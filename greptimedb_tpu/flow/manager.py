@@ -70,6 +70,7 @@ class Flow:
         self.state: dict[tuple, _GroupState] = {}
         self.lock = threading.Lock()
         self.plan = None          # lazily planned against the source schema
+        self.device_state = None  # DeviceFlowState when the plan allows
         self.last_tick_ms = 0
 
     def to_json(self) -> dict:
@@ -94,9 +95,11 @@ def _source_of(stmt: A.CreateFlow) -> str:
 class FlowManager:
     """Hosts all flows in-process (standalone's flownode role)."""
 
-    def __init__(self, instance, *, tick_interval_s: float = 1.0):
+    def __init__(self, instance, *, tick_interval_s: float | None = None):
         self.instance = instance
-        self.tick_interval_s = tick_interval_s
+        self.tick_interval_s = (
+            1.0 if tick_interval_s is None else tick_interval_s
+        )
         self._flows: dict[str, Flow] = {}
         self._by_source: dict[str, list[Flow]] = {}
         self._lock = threading.RLock()
@@ -214,7 +217,6 @@ class FlowManager:
                 raise UnsupportedError(
                     f"aggregate {a.op} is not accumulable in a flow"
                 )
-        flow.plan = plan
         # which key expr is the time window (date_bin/date_trunc on ts)?
         flow.time_key_idx = None
         for i, k in enumerate(plan.keys):
@@ -222,6 +224,24 @@ class FlowManager:
                 flow.time_key_idx = i
                 break
         flow.source_ts_name = table.ts_name
+        # accumulators with a dense-array form keep their state on device
+        # (flow/device_state.py); set/string state stays on the host path
+        from greptimedb_tpu.flow import device_state as DS
+
+        def _string_arg(a) -> bool:
+            if a.arg is None or not isinstance(a.arg, A.Column):
+                return False
+            cs = table.schema.maybe_column(a.arg.name)
+            return cs is not None and cs.data_type.is_string()
+
+        flow.device_state = (
+            DS.DeviceFlowState(plan, time_key_idx=flow.time_key_idx)
+            if DS.plan_supports_device(plan)
+            and not any(_string_arg(a) for a in plan.aggs)
+            else None
+        )
+        # published LAST: _apply_delta's unlocked fast path keys off it
+        flow.plan = plan
 
     # ------------------------------------------------------------------
     # ingest (mirrored inserts)
@@ -243,7 +263,11 @@ class FlowManager:
 
     def _apply_delta(self, flow: Flow, table, data: dict, valid: dict):
         if flow.plan is None:
-            self._plan_flow(flow, table)
+            with flow.lock:
+                # concurrent first inserts must not each build a plan +
+                # device state (the loser's rows would be orphaned)
+                if flow.plan is None:
+                    self._plan_flow(flow, table)
         plan = flow.plan
         n = len(next(iter(data.values())))
         if n == 0:
@@ -306,6 +330,35 @@ class FlowManager:
                 agg_args.append((c.values, c.validity))
 
         idxs = np.nonzero(mask)[0]
+        ds = flow.device_state
+        if ds is not None and len(idxs) and int(ts[idxs].min()) < 0:
+            # device ts encoding assumes epoch >= 0
+            self._demote_flow(flow)
+            ds = None
+        if ds is not None:
+            key_cols = [np.asarray(kv, object)[idxs] for kv in key_vals]
+            try:
+                arg_sub = [
+                    (None if vals is None
+                     else np.asarray(vals[idxs], np.float64),
+                     None if validity is None else validity[idxs])
+                    for vals, validity in agg_args
+                ]
+            except (ValueError, TypeError):
+                # non-numeric aggregate input: this flow is host-only
+                self._demote_flow(flow)
+            else:
+                applied = False
+                with flow.lock:
+                    # a concurrent batch may have demoted the flow since
+                    # ds was read; only apply if it is still live
+                    if flow.device_state is ds:
+                        gids = ds.intern_keys(key_cols, len(idxs))
+                        ds.apply(gids, ts[idxs], arg_sub)
+                        flow.processed_rows += len(idxs)
+                        applied = True
+                if applied:
+                    return
         with flow.lock:
             flow.processed_rows += len(idxs)
             state = flow.state
@@ -355,8 +408,55 @@ class FlowManager:
 
                 traceback.print_exc()
 
+    def _demote_flow(self, flow: Flow):
+        """Move a flow's device state back to host accumulators (input
+        the device encoding can't represent: the flow keeps running on
+        the host path with nothing lost)."""
+        with flow.lock:
+            ds = flow.device_state
+            flow.device_state = None
+            if ds is None or flow.plan is None:
+                return
+            rows, dirty = ds.export_host_accs()
+            for gid, key in enumerate(ds.key_rows()):
+                gs = flow.state.get(key)
+                if gs is None:
+                    gs = _GroupState(len(flow.plan.aggs))
+                    flow.state[key] = gs
+                gs.accs = rows[gid]
+                gs.dirty = bool(dirty[gid]) or gs.dirty
+
+    def _expire_horizon(self, flow: Flow):
+        return int(time.time() * 1000) - flow.expire_after_s * 1000
+
+    def _emit_groups(self, flow: Flow, key_rows, per_agg):
+        """Finalized groups -> post-projection -> sink write. key_rows is
+        a list of key tuples; per_agg a list of (values, present) arrays
+        aligned with plan.aggs."""
+        plan = flow.plan
+        g = len(key_rows)
+        out_cols: dict[str, Col] = {}
+        for i, k in enumerate(plan.keys):
+            vals = [key[i] for key in key_rows]
+            arr = np.asarray(vals, object) if isinstance(
+                vals[0], str
+            ) else np.asarray(vals)
+            out_cols[k.key] = Col(arr)
+        for j, a in enumerate(plan.aggs):
+            vals, present = per_agg[j]
+            out_cols[a.key] = Col(
+                vals, None if present.all() else present
+            )
+        gsrc = DictSource(out_cols, g)
+        names = [nm for _, nm in plan.post_items]
+        results = [eval_expr(e, gsrc) for e, _ in plan.post_items]
+        self._write_sink(flow, names, results, out_cols)
+
     def _flush_flow(self, flow: Flow):
         if flow.plan is None:
+            return
+        ds = flow.device_state
+        if ds is not None and self._flush_flow_device(flow, ds):
             return
         plan = flow.plan
         with flow.lock:
@@ -366,9 +466,7 @@ class FlowManager:
             for _, gs in dirty:
                 gs.dirty = False
             if flow.expire_after_s is not None and flow.time_key_idx is not None:
-                horizon = (
-                    int(time.time() * 1000) - flow.expire_after_s * 1000
-                )
+                horizon = self._expire_horizon(flow)
                 expired = [
                     k for k in flow.state
                     if isinstance(k[flow.time_key_idx], (int, float))
@@ -379,13 +477,7 @@ class FlowManager:
         if not dirty:
             return
         g = len(dirty)
-        out_cols: dict[str, Col] = {}
-        for i, k in enumerate(plan.keys):
-            vals = [key[i] for key, _ in dirty]
-            arr = np.asarray(vals, object) if isinstance(
-                vals[0], str
-            ) else np.asarray(vals)
-            out_cols[k.key] = Col(arr)
+        per_agg = []
         for j, a in enumerate(plan.aggs):
             vals = np.zeros(g)
             present = np.zeros(g, bool)
@@ -394,14 +486,9 @@ class FlowManager:
                 if out is not None:
                     vals[gi] = out
                     present[gi] = True
-            out_cols[a.key] = Col(
-                vals, None if present.all() else present
-            )
+            per_agg.append((vals, present))
         try:
-            gsrc = DictSource(out_cols, g)
-            names = [nm for _, nm in plan.post_items]
-            results = [eval_expr(e, gsrc) for e, _ in plan.post_items]
-            self._write_sink(flow, names, results, out_cols)
+            self._emit_groups(flow, [key for key, _ in dirty], per_agg)
         except Exception:
             # keep the updates flushable: re-mark the groups dirty
             with flow.lock:
@@ -409,6 +496,45 @@ class FlowManager:
                     if key in flow.state:
                         gs.dirty = True
             raise
+
+    def _flush_flow_device(self, flow: Flow, ds) -> bool:
+        """Device-state tick: one finalize program over every group with
+        a device-side dirty gather, then writeback of the dirty slice.
+        Expiry compacts only after a successful write so the failure
+        path's gids stay valid. Returns False (caller runs the host
+        flush) if a concurrent batch demoted the flow."""
+        with flow.lock:
+            if flow.device_state is not ds:
+                return False
+            snap = ds.snapshot_dirty()
+            dirty_gids = snap[2] if snap else np.zeros(0, np.int64)
+            keys = [ds.key_rows()[i] for i in dirty_gids]
+        if len(dirty_gids):
+            # the state tuple in snap is immutable; the program + device
+            # readback run here without stalling concurrent ingest
+            _, per_agg = ds.finalize_snapshot(snap)
+            try:
+                self._emit_groups(
+                    flow, keys,
+                    [per_agg[j] for j in range(len(flow.plan.aggs))],
+                )
+            except Exception:
+                with flow.lock:
+                    if flow.device_state is ds:
+                        ds.dirty[dirty_gids] = True
+                    else:
+                        # demoted mid-emit: re-dirty the host groups
+                        for k in keys:
+                            gs = flow.state.get(k)
+                            if gs is not None:
+                                gs.dirty = True
+                raise
+        if flow.expire_after_s is not None and \
+                flow.time_key_idx is not None:
+            with flow.lock:
+                if flow.device_state is ds:
+                    ds.expire_older_than(self._expire_horizon(flow))
+        return True
 
     def _write_sink(self, flow: Flow, names, results, out_cols):
         plan = flow.plan

@@ -606,7 +606,7 @@ class Standalone:
     # ------------------------------------------------------------------
     # flows (wired by flow.FlowManager; stubs raise otherwise)
     # ------------------------------------------------------------------
-    def enable_flows(self):
+    def enable_flows(self, *, tick_interval_s: float | None = None):
         if self.flows is None:
             try:
                 from greptimedb_tpu.flow import FlowManager
@@ -614,7 +614,10 @@ class Standalone:
                 raise UnsupportedError(
                     f"flows require the flow module: {e}"
                 )
-            self.flows = FlowManager(self)
+            self.flows = FlowManager(self, tick_interval_s=tick_interval_s)
+        elif tick_interval_s is not None:
+            # retarget the running ticker; takes effect at its next wait
+            self.flows.tick_interval_s = tick_interval_s
         return self.flows
 
     def _create_flow(self, stmt: A.CreateFlow, ctx: QueryContext) -> Output:

@@ -1,0 +1,311 @@
+"""Device-resident flow state (VERDICT r2 task #6): equivalence with the
+host accumulator path, and a >=100k-group tick through one device
+program."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+
+T0 = 1_700_000_000_000
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    s = Standalone(str(tmp_path / "data"))
+    s.enable_flows(tick_interval_s=3600)  # manual ticks only
+    yield s
+    s.close()
+
+
+def _setup(inst, flow_sql):
+    inst.sql(
+        "CREATE TABLE src (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, "
+        "PRIMARY KEY (host))"
+    )
+    inst.sql(flow_sql)
+
+
+def _ingest(inst, hosts, vals, ts):
+    inst.sql(
+        "INSERT INTO src (host, v, ts) VALUES "
+        + ", ".join(
+            f"('{h}', {v}, {t})" for h, v, t in zip(hosts, vals, ts)
+        )
+    )
+
+
+FLOW_SQL = (
+    "CREATE FLOW f1 SINK TO out1 AS "
+    "SELECT host, count(v) AS c, sum(v) AS s, avg(v) AS a, min(v) AS lo, "
+    "max(v) AS hi, last_value(v ORDER BY ts) AS lv, stddev_pop(v) AS sd "
+    "FROM src GROUP BY host"
+)
+
+
+def _sink_rows(inst, table="out1", order="host"):
+    r = inst.sql(f"SELECT * FROM {table} ORDER BY {order}")
+    return {tuple(row[:1]): row[1:] for row in
+            ([list(x) for x in r.rows()])}
+
+
+def test_device_state_used_and_matches_host(inst, monkeypatch):
+    _setup(inst, FLOW_SQL)
+    flow = inst.flows._flows["f1"]
+    assert flow.device_state is not None, "expected the device state path"
+
+    _ingest(inst, ["a", "b", "a"], [1.0, 5.0, 3.0], [T0, T0, T0 + 1000])
+    _ingest(inst, ["a", "b", "c"], [7.0, 2.0, 9.0],
+            [T0 + 2000, T0 + 3000, T0])
+    inst.flows.flush_all()
+    got = {k[0]: v for k, v in _sink_rows(inst).items()}
+
+    # independent host-path run: same flow logic with device state off
+    inst.sql("DROP FLOW f1")
+    inst.sql("DROP TABLE out1")
+    inst.sql(FLOW_SQL.replace("f1", "f2").replace("out1", "out2"))
+    flow2 = inst.flows._flows["f2"]
+    flow2.device_state = None  # force host accumulators
+    _ingest(inst, ["a", "b", "a"], [1.0, 5.0, 3.0], [T0, T0, T0 + 1000])
+    _ingest(inst, ["a", "b", "c"], [7.0, 2.0, 9.0],
+            [T0 + 2000, T0 + 3000, T0])
+    inst.flows.flush_all()
+    want = {k[0]: v for k, v in _sink_rows(inst, "out2").items()}
+
+    assert set(got) == set(want) == {"a", "b", "c"}
+    for h in got:
+        # [count, sum, avg, min, max, last, stddev] (+update_at ignored)
+        np.testing.assert_allclose(
+            [float(x) for x in got[h][:7]],
+            [float(x) for x in want[h][:7]],
+            rtol=1e-6, err_msg=h,
+        )
+
+
+def test_incremental_updates_accumulate(inst):
+    _setup(inst, FLOW_SQL)
+    _ingest(inst, ["a"], [2.0], [T0])
+    inst.flows.flush_all()
+    _ingest(inst, ["a"], [4.0], [T0 + 1000])
+    inst.flows.flush_all()
+    got = {k[0]: v for k, v in _sink_rows(inst).items()}
+    c, s, a, lo, hi, lv = [float(x) for x in got["a"][:6]]
+    assert (c, s, a, lo, hi, lv) == (2.0, 6.0, 3.0, 2.0, 4.0, 4.0)
+
+
+def test_100k_groups_one_program(inst):
+    """A tick over >=100k groups runs the ONE finalize program and writes
+    every group back correctly."""
+    inst.sql(
+        "CREATE TABLE big (host STRING, v DOUBLE, ts TIMESTAMP TIME "
+        "INDEX, PRIMARY KEY (host))"
+    )
+    inst.sql(
+        "CREATE FLOW bigf SINK TO bigout AS "
+        "SELECT host, sum(v) AS s, count(v) AS c FROM big GROUP BY host"
+    )
+    flow = inst.flows._flows["bigf"]
+    assert flow.device_state is not None
+    n = 120_000
+    table = inst.catalog.table("public", "big")
+    hosts = np.asarray([f"h{i:06d}" for i in range(n)], object)
+    data = {
+        "host": hosts,
+        "ts": np.full(n, T0, np.int64),
+        "v": np.arange(n, dtype=np.float64),
+    }
+    valid = {k: np.ones(n, bool) for k in data}
+    inst._write_columns(table, data, valid)
+    inst._notify_flows("public", "big", table, data, valid)
+    assert flow.device_state.num_groups == n
+    inst.flows.flush_all()
+    r = inst.sql("SELECT count(*), sum(s), sum(c) FROM bigout")
+    row = r.rows()[0]
+    assert row[0] == n
+    assert float(row[1]) == float(np.arange(n).sum())
+    assert float(row[2]) == float(n)
+    # second delta touches two groups only: dirty slice stays small
+    data2 = {
+        "host": np.asarray(["h000000", "h000001"], object),
+        "ts": np.full(2, T0 + 1000, np.int64),
+        "v": np.asarray([100.0, 200.0]),
+    }
+    valid2 = {k: np.ones(2, bool) for k in data2}
+    inst._write_columns(table, data2, valid2)
+    inst._notify_flows("public", "big", table, data2, valid2)
+    assert int(flow.device_state.dirty.sum()) == 2
+    inst.flows.flush_all()
+    r = inst.sql("SELECT s FROM bigout WHERE host = 'h000000'")
+    assert float(r.cols[0].values[0]) == 100.0
+
+
+def test_expiry_compacts_device_state(inst):
+    inst.sql(
+        "CREATE TABLE esrc (host STRING, v DOUBLE, ts TIMESTAMP TIME "
+        "INDEX, PRIMARY KEY (host))"
+    )
+    inst.sql(
+        "CREATE FLOW ef SINK TO eout EXPIRE AFTER '1h' AS "
+        "SELECT date_bin('1 minute', ts) AS w, sum(v) AS s "
+        "FROM esrc GROUP BY date_bin('1 minute', ts)"
+    )
+    flow = inst.flows._flows["ef"]
+    assert flow.device_state is not None
+    import time as _t
+
+    now = int(_t.time() * 1000)
+    old = now - 7_200_000   # 2h ago: beyond EXPIRE AFTER '1h'
+    _ingest_table(inst, "esrc", ["x", "y"], [1.0, 2.0], [old, now])
+    inst.flows.flush_all()
+    assert flow.device_state.num_groups == 1  # expired window dropped
+
+
+def _ingest_table(inst, table, hosts, vals, ts):
+    inst.sql(
+        f"INSERT INTO {table} (host, v, ts) VALUES "
+        + ", ".join(
+            f"('{h}', {v}, {t})" for h, v, t in zip(hosts, vals, ts)
+        )
+    )
+
+
+def test_expiry_shrinks_large_state(inst):
+    """Compacting from >1024 groups down to a handful must not corrupt
+    the device arrays (regression: expire() used to crash resizing the
+    dirty mask and left the state unusable)."""
+    inst.sql(
+        "CREATE TABLE esrc (host STRING, v DOUBLE, ts TIMESTAMP TIME "
+        "INDEX, PRIMARY KEY (host))"
+    )
+    inst.sql(
+        "CREATE FLOW ef SINK TO eout EXPIRE AFTER '1h' AS "
+        "SELECT date_bin('1 minute', ts) AS w, host, sum(v) AS s "
+        "FROM esrc GROUP BY date_bin('1 minute', ts), host"
+    )
+    flow = inst.flows._flows["ef"]
+    assert flow.device_state is not None
+    import time as _t
+
+    now = int(_t.time() * 1000)
+    n = 3000
+    table = inst.catalog.table("public", "esrc")
+    data = {
+        "host": np.asarray([f"h{i}" for i in range(n)], object),
+        "ts": np.full(n, now, np.int64),
+        "v": np.ones(n),
+    }
+    valid = {k: np.ones(n, bool) for k in data}
+    inst._write_columns(table, data, valid)
+    inst._notify_flows("public", "esrc", table, data, valid)
+    assert flow.device_state.num_groups == n
+    inst.flows.flush_all()
+    # shrink the window so every ingested group is now expired
+    flow.expire_after_s = -60
+    inst.flows.flush_all()          # everything expires
+    assert flow.device_state.num_groups == 0
+    flow.expire_after_s = 3600
+    # state stays usable after the compaction
+    _ingest_table(inst, "esrc", ["a"], [5.0], [now])
+    inst.flows.flush_all()
+    r = inst.sql("SELECT s FROM eout WHERE host = 'a'")
+    assert float(r.cols[0].values[-1]) == 5.0
+
+
+def test_keyless_flow_uses_device_and_matches(inst):
+    inst.sql(
+        "CREATE TABLE ksrc (host STRING, v DOUBLE, ts TIMESTAMP TIME "
+        "INDEX, PRIMARY KEY (host))"
+    )
+    inst.sql(
+        "CREATE FLOW kf SINK TO kout AS "
+        "SELECT count(v) AS c, sum(v) AS s FROM ksrc"
+    )
+    flow = inst.flows._flows["kf"]
+    assert flow.device_state is not None
+    _ingest_table(inst, "ksrc", ["a", "b"], [2.0, 3.0], [T0, T0 + 1])
+    inst.flows.flush_all()
+    r = inst.sql("SELECT c, s FROM kout")
+    assert int(r.cols[0].values[-1]) == 2
+    assert float(r.cols[1].values[-1]) == 5.0
+
+
+def test_first_value_tie_prefers_first_arrival(inst):
+    inst.sql(
+        "CREATE TABLE fsrc (host STRING, v DOUBLE, ts TIMESTAMP TIME "
+        "INDEX, PRIMARY KEY (host))"
+    )
+    inst.sql(
+        "CREATE FLOW ff SINK TO fout AS "
+        "SELECT host, first_value(v ORDER BY ts) AS fv, "
+        "last_value(v ORDER BY ts) AS lv FROM fsrc GROUP BY host"
+    )
+    flow = inst.flows._flows["ff"]
+    assert flow.device_state is not None
+    # same host, same timestamp: host semantics keep the first arrival
+    # for first_value and the last arrival for last_value
+    _ingest_table(inst, "fsrc", ["a", "a", "a"], [1.0, 2.0, 3.0],
+                  [T0, T0, T0])
+    inst.flows.flush_all()
+    r = inst.sql("SELECT fv, lv FROM fout WHERE host = 'a'")
+    assert float(r.cols[0].values[-1]) == 1.0
+    assert float(r.cols[1].values[-1]) == 3.0
+    # a later batch at the SAME ts: first keeps, last replaces
+    _ingest_table(inst, "fsrc", ["a"], [9.0], [T0])
+    inst.flows.flush_all()
+    r = inst.sql("SELECT fv, lv FROM fout WHERE host = 'a'")
+    assert float(r.cols[0].values[-1]) == 1.0
+    assert float(r.cols[1].values[-1]) == 9.0
+
+
+def test_all_null_sum_is_null(inst):
+    inst.sql(
+        "CREATE TABLE nsrc (host STRING, v DOUBLE, ts TIMESTAMP TIME "
+        "INDEX, PRIMARY KEY (host))"
+    )
+    inst.sql(
+        "CREATE FLOW nf SINK TO nout AS "
+        "SELECT host, sum(v) AS s, count(v) AS c FROM nsrc GROUP BY host"
+    )
+    flow = inst.flows._flows["nf"]
+    assert flow.device_state is not None
+    inst.sql(f"INSERT INTO nsrc (host, v, ts) VALUES ('a', NULL, {T0})")
+    inst.flows.flush_all()
+    r = inst.sql("SELECT s, c FROM nout WHERE host = 'a'")
+    col = r.cols[0]
+    assert col.validity is not None and not bool(col.validity[-1])
+    assert int(r.cols[1].values[-1]) == 0
+
+
+def test_null_key_distinct_from_none_string(inst):
+    """NULL and the literal string 'None' in a key column are distinct
+    groups on the device path, matching the host path."""
+    inst.sql(
+        "CREATE TABLE msrc (host STRING, v DOUBLE, ts TIMESTAMP TIME "
+        "INDEX, PRIMARY KEY (host))"
+    )
+    inst.sql(
+        "CREATE FLOW mf SINK TO mout AS "
+        "SELECT host, sum(v) AS s FROM msrc GROUP BY host"
+    )
+    flow = inst.flows._flows["mf"]
+    assert flow.device_state is not None
+    inst.sql(
+        f"INSERT INTO msrc (host, v, ts) VALUES "
+        f"('None', 1.0, {T0}), (NULL, 10.0, {T0})"
+    )
+    assert flow.device_state.num_groups == 2
+
+
+def test_demotion_preserves_state(inst):
+    """A batch the device encoding can't take (negative ts) demotes the
+    flow to the host path without losing accumulated state."""
+    _setup(inst, FLOW_SQL)
+    flow = inst.flows._flows["f1"]
+    assert flow.device_state is not None
+    _ingest(inst, ["a"], [2.0], [T0])
+    _ingest(inst, ["a"], [4.0], [-5])   # pre-epoch ts: demote
+    assert flow.device_state is None
+    inst.flows.flush_all()
+    got = {k[0]: v for k, v in _sink_rows(inst).items()}
+    c, s = [float(x) for x in got["a"][:2]]
+    assert (c, s) == (2.0, 6.0)
