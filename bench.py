@@ -55,9 +55,111 @@ RUNS = 12
 
 
 def main():
-    from greptimedb_tpu.instance import Standalone
+    """Orchestrator: phase 1 (ingest + all query metrics) runs in a child
+    process, then the cold-start probe runs in a SECOND child against the
+    same data dir — a true process restart (fresh jax client, restored
+    grid snapshot, persistent XLA compilation cache). Output lines are
+    re-emitted with the headline metric last (the driver parses it)."""
+    import subprocess
 
     tmp = tempfile.mkdtemp(prefix="gtpu_bench_")
+    try:
+        p1 = subprocess.run(
+            [sys.executable, __file__, "--phase1", tmp],
+            stdout=subprocess.PIPE, text=True, timeout=3600,
+        )
+        lines = [ln for ln in p1.stdout.splitlines() if ln.strip()]
+        if p1.returncode != 0 or not lines:
+            sys.stdout.write(p1.stdout)
+            sys.exit(p1.returncode or 1)
+        cold_line = None
+        try:
+            p2 = subprocess.run(
+                [sys.executable, __file__, "--cold-start", tmp],
+                stdout=subprocess.PIPE, text=True, timeout=1800,
+            )
+            probe = json.loads(p2.stdout.splitlines()[-1])
+            first_ms = probe["first_query_s"] * 1000.0
+            cold_line = json.dumps({
+                "metric": "cold_start_first_query_ms",
+                "value": round(first_ms, 1),
+                "unit": "ms",
+                # target: < 5 s to first flagship result after restart
+                # (first query after the open-time background warm; the
+                # warm itself is restore_ms, dominated by the
+                # dev-tunnel's slow host->device attachment)
+                "vs_baseline": round(5000.0 / max(first_ms, 1e-9), 2),
+                "open_ms": round(probe["open_s"] * 1000.0, 1),
+                "restore_ms": round(probe["restore_s"] * 1000.0, 1),
+                "second_query_ms": round(
+                    probe["second_query_s"] * 1000.0, 1
+                ),
+                "restored_bytes": probe["entry_bytes"],
+            })
+        except Exception as e:  # cold start is additive: never mask phase 1
+            print(f"# cold-start probe failed: {e}", file=sys.stderr)
+        for ln in lines[:-1]:
+            print(ln)
+        if cold_line:
+            print(cold_line)
+        print(lines[-1])
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def cold_start_probe(data_dir: str):
+    """Fresh-process restart: open the instance, run the flagship query
+    once, and measure the pure put floor of the restored entry bytes so
+    the tunnel transfer can be separated (a co-located chip moves the
+    same bytes over PCIe in well under a second)."""
+    import jax
+
+    from greptimedb_tpu.instance import Standalone
+
+    items = ", ".join(f"avg({f}) RANGE '1h'" for f in FIELD_NAMES)
+    query = (
+        f"SELECT ts, hostname, {items} FROM cpu ALIGN '1h' BY (hostname)"
+    )
+    from greptimedb_tpu.query import device_range as DR
+
+    t0 = time.perf_counter()
+    inst = Standalone(data_dir, prefer_device=True, warm_start=False)
+    open_s = time.perf_counter() - t0
+    # restore phase, run synchronously for measurement (a server does
+    # this in the warm_start background thread): snapshot decode + grid
+    # puts + forced residency. The transfer portion is the dev-tunnel's
+    # ~12 MB/s attachment cost — a co-located chip moves the same bytes
+    # over PCIe in well under a second.
+    t1 = time.perf_counter()
+    n = DR.warm_from_snapshots(inst.query_engine, inst.catalog)
+    restore_s = time.perf_counter() - t1
+    assert n == 1, f"expected 1 restored snapshot entry, got {n}"
+    entries = inst.query_engine.range_cache._entries
+    entry = next(iter(entries.values()))
+    assert entry.rows_scanned == HOSTS * CELLS  # restored, not rebuilt
+    nbytes = entry.bytes()
+    # first query: what a co-located restart pays AFTER the background
+    # warm — parse/plan, compile-cache load, prelude, execution, result
+    t2 = time.perf_counter()
+    res = inst.sql(query)
+    first_q = time.perf_counter() - t2
+    assert inst.query_engine.last_exec_path == "device", "not on device"
+    assert res.num_rows == HOSTS * 12, res.num_rows
+    # steady state for reference
+    t3 = time.perf_counter()
+    inst.sql(query)
+    second_q = time.perf_counter() - t3
+    print(json.dumps({
+        "open_s": open_s, "restore_s": restore_s,
+        "first_query_s": first_q, "second_query_s": second_q,
+        "entry_bytes": nbytes,
+    }))
+    inst.close()
+
+
+def phase1(tmp: str):
+    from greptimedb_tpu.instance import Standalone
+
     try:
         inst = Standalone(tmp, prefer_device=True)
         cols = ", ".join(f"{f} double" for f in FIELD_NAMES)
@@ -221,9 +323,18 @@ def main():
             "raw_wall_ms_median": round(med_wall, 3),
             "tunnel_floor_ms_median": round(med_floor, 3),
         }))
+        # let the grid-snapshot writer finish: the cold-start probe in
+        # the next process restores from it
+        region = table.regions[0]
+        deadline = time.time() + 300
+        while time.time() < deadline and not region.store.list(
+            f"{region.prefix}/device_cache/"
+        ):
+            time.sleep(1.0)
         inst.close()
     finally:
-        shutil.rmtree(tmp, ignore_errors=True)
+        # tmp is owned (and removed) by the orchestrator process
+        pass
 
 
 def _bench_promql_1m(inst):
@@ -352,4 +463,9 @@ def _measure_fn(run, *, label: str, result_elems: int, runs: int):
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--phase1":
+        phase1(sys.argv[2])
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--cold-start":
+        cold_start_probe(sys.argv[2])
+    else:
+        main()

@@ -66,6 +66,31 @@ def _result_from_lists(names: list[str], columns: list[list]) -> QueryResult:
     return QueryResult(names, cols)
 
 
+_xla_cache_enabled = False
+
+
+def _enable_xla_persistent_cache(data_root: str):
+    """Persist XLA compilations under the data dir so a restarted process
+    skips recompiles (the reference has no compile step; this removes the
+    cold-start cliff unique to the XLA design). First instance in the
+    process wins — the cache is content-addressed, so sharing is safe."""
+    global _xla_cache_enabled
+    import os
+
+    if _xla_cache_enabled or os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return
+    try:
+        import jax
+
+        path = os.path.join(os.path.abspath(data_root), ".xla_cache")
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _xla_cache_enabled = True
+    except Exception:
+        pass
+
+
 class Standalone:
     """Single-process database instance (frontend + datanode + flownode in
     one, like `greptime standalone start`,
@@ -73,15 +98,35 @@ class Standalone:
 
     def __init__(self, data_root: str = "./greptimedb_tpu_data", *,
                  engine_config: EngineConfig | None = None,
-                 prefer_device: bool | None = None, mesh=None):
+                 prefer_device: bool | None = None, mesh=None,
+                 warm_start: bool = True):
         cfg = engine_config or EngineConfig(data_root=data_root,
                                             enable_background=False)
+        _enable_xla_persistent_cache(cfg.data_root)
         self.engine = TsdbEngine(cfg)
         self.catalog = CatalogManager(self.engine)
         self.query_engine = QueryEngine(prefer_device=prefer_device,
                                         mesh=mesh)
         self.flows = None  # wired by flow.FlowManager when enabled
         self._procedures = []
+        if warm_start:
+            # restore device grid snapshots in the background so the
+            # first query after a restart skips the SST rescan
+            import threading
+
+            def _warm():
+                try:
+                    from greptimedb_tpu.query.device_range import (
+                        warm_from_snapshots,
+                    )
+
+                    warm_from_snapshots(self.query_engine, self.catalog)
+                except Exception:
+                    pass
+
+            threading.Thread(
+                target=_warm, daemon=True, name="device-cache-warm"
+            ).start()
 
     def close(self):
         if self.flows is not None:

@@ -221,6 +221,8 @@ class QueryEngine:
 
     def __init__(self, *, prefer_device: bool | None = None, mesh=None):
         self.prefer_device = prefer_device
+        # write/restore device grid snapshots across restarts
+        self.persist_device_cache = True
         self.mesh = mesh
         from greptimedb_tpu.query.device_range import DeviceRangeCache
 

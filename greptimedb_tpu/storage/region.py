@@ -104,13 +104,16 @@ class Region:
         self._replay()
 
     @property
-    def data_version(self) -> tuple[int, int]:
+    def data_version(self) -> tuple[int, int, int]:
         """Monotonic logical-data version: bumps with every write (sequence)
         and every truncate. Device caches key on this to know when a region's
         row set changed (the page-cache-invalidation analog of the
         reference's memtable/SST version in
-        /root/reference/src/mito2/src/region/version.rs)."""
-        return (self._seq, self._truncate_epoch)
+        /root/reference/src/mito2/src/region/version.rs). The manifest's
+        truncated_entry_id rides along so the version stays comparable
+        across restarts (the in-memory epoch resets to 0 at reopen)."""
+        return (self._seq, self._truncate_epoch,
+                self.manifest.state.truncated_entry_id)
 
     # ------------------------------------------------------------------
     # write path
