@@ -24,6 +24,9 @@ def main(argv=None):
                        help="MySQL wire protocol address ('' disables)")
     start.add_argument("--flight-addr", default="127.0.0.1:4001",
                        help="Arrow Flight (gRPC) address ('' disables)")
+    start.add_argument("--postgres-addr", default="127.0.0.1:4003",
+                       help="PostgreSQL wire protocol address "
+                            "('' disables)")
     start.add_argument("--no-flows", action="store_true")
 
     repl = sub.add_parser("cli")
@@ -64,6 +67,15 @@ def _start_standalone(args):
             inst, addr=mh or "127.0.0.1", port=int(mp)
         ).start())
         print(f"greptimedb-tpu mysql protocol on {args.mysql_addr}",
+              flush=True)
+    if getattr(args, "postgres_addr", ""):
+        from greptimedb_tpu.servers.postgres import PostgresServer
+
+        ph, _, pp = args.postgres_addr.rpartition(":")
+        extra.append(PostgresServer(
+            inst, addr=ph or "127.0.0.1", port=int(pp)
+        ).start())
+        print(f"greptimedb-tpu postgres protocol on {args.postgres_addr}",
               flush=True)
     if args.flight_addr:
         try:

@@ -1,0 +1,459 @@
+"""PostgreSQL wire-protocol frontend (protocol 3.0).
+
+Capability counterpart of the reference's pgwire-based server
+(/root/reference/src/servers/src/postgres/: PostgresServerHandler in
+handler.rs, startup/auth in auth_handler.rs): startup + cleartext
+password auth, the simple query protocol, and enough of the extended
+protocol (Parse/Bind/Describe/Execute/Sync with text-format parameter
+substitution) for common drivers. SSL/GSS encryption requests are
+declined ('N'), matching the reference's plain-TCP default.
+
+Like the MySQL frontend (servers/mysql.py) this is a threaded stdlib
+TCP server: the host plane is IO-bound glue, and queries execute through
+the same Standalone instance, so device fast paths apply unchanged.
+"""
+
+from __future__ import annotations
+
+import datetime
+import secrets
+import socket
+import socketserver
+import struct
+import threading
+
+from greptimedb_tpu.session import QueryContext
+
+_SERVER_VERSION = "16.3 (greptimedb-tpu)"
+
+SSL_REQUEST = 80877103
+GSSENC_REQUEST = 80877104
+CANCEL_REQUEST = 80877102
+PROTOCOL_3 = 196608
+
+# type OIDs (pg_catalog.pg_type)
+OID_BOOL = 16
+OID_INT8 = 20
+OID_FLOAT8 = 701
+OID_TEXT = 25
+OID_TIMESTAMP = 1114
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack("!I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class _Conn:
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.sock.settimeout(600)
+
+    def read_exact(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def read_startup(self) -> tuple[int, bytes] | None:
+        head = self.read_exact(4)
+        if head is None:
+            return None
+        (length,) = struct.unpack("!I", head)
+        if length < 8 or length > 1 << 20:
+            return None
+        body = self.read_exact(length - 4)
+        if body is None or len(body) < 4:
+            return None
+        (code,) = struct.unpack("!I", body[:4])
+        return code, body[4:]
+
+    def read_message(self) -> tuple[bytes, bytes] | None:
+        head = self.read_exact(5)
+        if head is None:
+            return None
+        tag = head[:1]
+        (length,) = struct.unpack("!I", head[1:])
+        if length < 4 or length > 1 << 26:
+            return None
+        body = self.read_exact(length - 4)
+        if body is None and length > 4:
+            return None
+        return tag, body or b""
+
+    def send(self, data: bytes):
+        self.sock.sendall(data)
+
+
+def _error(code: str, message: str) -> bytes:
+    fields = b"".join([
+        b"S" + _cstr("ERROR"),
+        b"V" + _cstr("ERROR"),
+        b"C" + _cstr(code),
+        b"M" + _cstr(message),
+    ]) + b"\x00"
+    return _msg(b"E", fields)
+
+
+def _ready(status: bytes = b"I") -> bytes:
+    return _msg(b"Z", status)
+
+
+def _param_status(name: str, value: str) -> bytes:
+    return _msg(b"S", _cstr(name) + _cstr(value))
+
+
+def _col_oid(res, i: int) -> int:
+    dt = res.types.get(res.names[i])
+    vals = res.cols[i].values
+    if dt is not None and dt.is_timestamp():
+        return OID_TIMESTAMP
+    if vals.dtype.kind == "f":
+        return OID_FLOAT8
+    if vals.dtype.kind in "iu":
+        return OID_INT8
+    if vals.dtype.kind == "b":
+        return OID_BOOL
+    return OID_TEXT
+
+
+def _row_description(res) -> bytes:
+    parts = [struct.pack("!H", len(res.names))]
+    for i, name in enumerate(res.names):
+        oid = _col_oid(res, i)
+        size = {OID_BOOL: 1, OID_INT8: 8, OID_FLOAT8: 8,
+                OID_TIMESTAMP: 8}.get(oid, -1)
+        parts.append(
+            _cstr(name)
+            + struct.pack("!IhIhih", 0, 0, oid, size, -1, 0)
+        )
+    return _msg(b"T", b"".join(parts))
+
+
+def _format_value(v, is_ts: bool) -> bytes:
+    if is_ts:
+        dt = datetime.datetime.fromtimestamp(
+            int(v) / 1000.0, tz=datetime.timezone.utc
+        )
+        return dt.strftime("%Y-%m-%d %H:%M:%S.%f").encode()
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, float):
+        return repr(v).encode()
+    return str(v).encode()
+
+
+def _data_rows(res) -> list[bytes]:
+    ts_cols = {
+        i for i in range(len(res.names))
+        if (res.types.get(res.names[i]) is not None
+            and res.types[res.names[i]].is_timestamp())
+    }
+    out = []
+    for row in res.rows():
+        parts = [struct.pack("!H", len(row))]
+        for i, v in enumerate(row):
+            if v is None:
+                parts.append(struct.pack("!i", -1))
+            else:
+                b = _format_value(v, i in ts_cols)
+                parts.append(struct.pack("!i", len(b)) + b)
+        out.append(_msg(b"D", b"".join(parts)))
+    return out
+
+
+def _quote_literal(text: str) -> str:
+    # the SQL lexer treats backslash as an escape inside strings, so both
+    # quote AND backslash must be doubled or parameter text can splice
+    # into the statement (injection)
+    return ("'"
+            + text.replace("\\", "\\\\").replace("'", "''")
+            + "'")
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            self._handle_conn()
+        except (ConnectionError, socket.timeout, OSError):
+            pass
+
+    def _handle_conn(self):  # noqa: C901 - protocol state machine
+        server: PostgresServer = self.server.owner  # type: ignore
+        conn = _Conn(self.request)
+        params: dict[str, str] = {}
+        while True:
+            st = conn.read_startup()
+            if st is None:
+                return
+            code, body = st
+            if code in (SSL_REQUEST, GSSENC_REQUEST):
+                conn.send(b"N")  # no TLS/GSS: client may retry plain
+                continue
+            if code == CANCEL_REQUEST:
+                return
+            if code != PROTOCOL_3:
+                conn.send(_error("08P01", "unsupported protocol"))
+                return
+            # body is key\0value\0 ... \0\0 — walk pairs WITHOUT
+            # dropping empties (an empty value must not shift alignment)
+            kv = [p.decode("utf-8", "replace")
+                  for p in body.split(b"\x00")]
+            params = {}
+            i = 0
+            while i + 1 < len(kv) and kv[i]:
+                params[kv[i]] = kv[i + 1]
+                i += 2
+            break
+
+        user = params.get("user", "")
+        if server.user_provider is not None:
+            conn.send(_msg(b"R", struct.pack("!I", 3)))  # cleartext
+            m = conn.read_message()
+            if m is None or m[0] != b"p":
+                return
+            password = m[1].split(b"\x00", 1)[0].decode("utf-8", "replace")
+            if not server.user_provider.authenticate(user, password):
+                conn.send(_error("28P01",
+                                 f'password authentication failed for '
+                                 f'user "{user}"'))
+                return
+        conn.send(_msg(b"R", struct.pack("!I", 0)))  # AuthenticationOk
+        for k, v in (
+            ("server_version", _SERVER_VERSION),
+            ("server_encoding", "UTF8"),
+            ("client_encoding", "UTF8"),
+            ("DateStyle", "ISO, MDY"),
+            ("integer_datetimes", "on"),
+            ("TimeZone", "UTC"),
+            # the SQL lexer processes backslash escapes in strings, so
+            # conforming-strings must be advertised OFF
+            ("standard_conforming_strings", "off"),
+        ):
+            conn.send(_param_status(k, v))
+        conn.send(_msg(b"K", struct.pack(
+            "!II", threading.get_ident() & 0x7FFFFFFF,
+            secrets.randbits(31),
+        )))
+        conn.send(_ready())
+
+        ctx = QueryContext()
+        if params.get("database"):
+            ctx.database = params["database"]
+        inst = server.instance
+        prepared: dict[str, str] = {}
+        portals: dict[str, str] = {}
+
+        while True:
+            m = conn.read_message()
+            if m is None:
+                return
+            tag, body = m
+            if tag == b"X":  # Terminate
+                return
+            if tag == b"Q":
+                sql = body.split(b"\x00", 1)[0].decode("utf-8", "replace")
+                self._simple_query(conn, inst, ctx, sql)
+            elif tag == b"P":  # Parse
+                name, rest = body.split(b"\x00", 1)
+                sql = rest.split(b"\x00", 1)[0]
+                prepared[name.decode()] = sql.decode("utf-8", "replace")
+                conn.send(_msg(b"1", b""))
+            elif tag == b"B":  # Bind
+                try:
+                    portal, stmt, sql = self._bind(body, prepared)
+                    portals[portal] = sql
+                    conn.send(_msg(b"2", b""))
+                except KeyError:
+                    conn.send(_error("26000", "unknown statement"))
+            elif tag == b"D":  # Describe
+                kind, name = body[:1], body[1:].split(b"\x00", 1)[0]
+                sql = (portals.get(name.decode()) if kind == b"P"
+                       else prepared.get(name.decode()))
+                if sql is None:
+                    conn.send(_error("26000", "unknown portal"))
+                    continue
+                if kind == b"S":
+                    n_params = _count_placeholders(sql)
+                    conn.send(_msg(
+                        b"t",
+                        struct.pack("!H", n_params)
+                        + struct.pack(f"!{n_params}I",
+                                      *([OID_TEXT] * n_params)),
+                    ))
+                # result columns aren't known until Execute runs the
+                # statement; NoData + RowDescription-at-Execute serves
+                # simple drivers (describe-dependent drivers like
+                # asyncpg need the full describe flow)
+                conn.send(_msg(b"n", b""))
+            elif tag == b"E":  # Execute
+                name = body.split(b"\x00", 1)[0].decode()
+                sql = portals.get(name)
+                if sql is None:
+                    conn.send(_error("26000", "unknown portal"))
+                    continue
+                self._execute(conn, inst, ctx, sql, extended=True)
+            elif tag == b"C":  # Close
+                conn.send(_msg(b"3", b""))
+            elif tag == b"S":  # Sync
+                conn.send(_ready())
+            elif tag == b"H":  # Flush
+                pass
+            else:
+                conn.send(_error("08P01", "unsupported message"))
+                conn.send(_ready())
+
+    # ------------------------------------------------------------------
+    def _bind(self, body: bytes, prepared: dict) -> tuple[str, str, str]:
+        """Parse a Bind message; substitute text parameters as quoted
+        literals into the prepared SQL ($1, $2, ...)."""
+        portal, rest = body.split(b"\x00", 1)
+        stmt, rest = rest.split(b"\x00", 1)
+        (n_fcodes,) = struct.unpack("!H", rest[:2])
+        off = 2 + 2 * n_fcodes
+        fcodes = struct.unpack(f"!{n_fcodes}H", rest[2:off])
+        (n_params,) = struct.unpack("!H", rest[off:off + 2])
+        off += 2
+        args: list[str | None] = []
+        for i in range(n_params):
+            (ln,) = struct.unpack("!i", rest[off:off + 4])
+            off += 4
+            if ln == -1:
+                args.append(None)
+            else:
+                raw = rest[off:off + ln]
+                off += ln
+                fcode = fcodes[i] if i < len(fcodes) else (
+                    fcodes[0] if fcodes else 0
+                )
+                if fcode != 0:
+                    raise ValueError("binary parameters unsupported")
+                args.append(raw.decode("utf-8", "replace"))
+        sql = prepared[stmt.decode()]
+
+        def _lit(v: str | None) -> str:
+            if v is None:
+                return "NULL"
+            return v if _is_plain_number(v) else _quote_literal(v)
+
+        import re
+
+        # ONE pass: sequential .replace would rewrite $n occurrences
+        # inside already-substituted parameter VALUES
+        def _sub(m):
+            i = int(m.group(1))
+            return _lit(args[i - 1]) if 1 <= i <= len(args) else m.group(0)
+
+        sql = re.sub(r"\$(\d+)", _sub, sql)
+        return portal.decode(), stmt.decode(), sql
+
+    def _simple_query(self, conn: _Conn, inst, ctx, sql: str):
+        stripped = sql.strip().rstrip(";").strip()
+        if not stripped:
+            conn.send(_msg(b"I", b""))
+            conn.send(_ready())
+            return
+        low = stripped.lower()
+        if low.startswith(("set ", "begin", "commit", "rollback",
+                           "discard all", "deallocate")):
+            conn.send(_msg(b"C", _cstr(low.split()[0].upper())))
+            conn.send(_ready())
+            return
+        self._execute(conn, inst, ctx, stripped, extended=False)
+        conn.send(_ready())
+
+    def _execute(self, conn: _Conn, inst, ctx, sql: str, *, extended: bool):
+        import re
+
+        from greptimedb_tpu.sql.parser import parse_sql
+
+        # simple protocol allows multiple statements per Query message:
+        # each gets its own resultset/CommandComplete
+        try:
+            stmts = parse_sql(sql)
+        except Exception as e:  # noqa: BLE001 - protocol boundary
+            conn.send(_error("42601", str(e)))
+            return
+        for st in stmts:
+            try:
+                out = inst.execute_statement(st, ctx)
+            except Exception as e:  # noqa: BLE001 - protocol boundary
+                conn.send(_error("42601", str(e)))
+                return
+            if out.result is None:
+                n = out.affected_rows or 0
+                verb = " ".join(
+                    re.findall(r"[A-Z][a-z]*", type(st).__name__)
+                ).upper()
+                done = f"INSERT 0 {n}" if verb == "INSERT" else (
+                    f"{verb} {n}" if verb in ("DELETE", "UPDATE")
+                    else verb or "OK"
+                )
+                conn.send(_msg(b"C", _cstr(done)))
+                continue
+            res = out.result
+            conn.send(_row_description(res))
+            for row_msg in _data_rows(res):
+                conn.send(row_msg)
+            conn.send(_msg(b"C", _cstr(f"SELECT {res.num_rows}")))
+
+
+def _count_placeholders(sql: str) -> int:
+    import re
+
+    nums = [int(m) for m in re.findall(r"\$(\d+)", sql)]
+    return max(nums, default=0)
+
+
+_NUMBER_RE = None
+
+
+def _is_plain_number(s: str) -> bool:
+    # strict literal form only: float() also accepts 'nan', 'inf' and
+    # '1_0', which must be quoted, not spliced as bare SQL tokens
+    global _NUMBER_RE
+    if _NUMBER_RE is None:
+        import re
+
+        _NUMBER_RE = re.compile(r"-?\d+(\.\d+)?([eE][+-]?\d+)?\Z")
+    return _NUMBER_RE.match(s) is not None
+
+
+class _TcpServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PostgresServer:
+    """`PostgresServer(inst, port=4003).start()` — serves until close()."""
+
+    def __init__(self, instance, *, addr: str = "127.0.0.1",
+                 port: int = 4003, user_provider=None):
+        self.instance = instance
+        self.addr = addr
+        self.port = port
+        self.user_provider = user_provider
+        self._srv: _TcpServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "PostgresServer":
+        self._srv = _TcpServer((self.addr, self.port), _Handler)
+        self._srv.owner = self  # type: ignore[attr-defined]
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="postgres-server",
+        )
+        self._thread.start()
+        return self
+
+    def close(self):
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()

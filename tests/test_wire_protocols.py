@@ -294,3 +294,197 @@ def test_mysql_unknown_database_rejected(inst):
         c.close()
     finally:
         srv.close()
+
+
+# ----------------------------------------------------------------------
+# PostgreSQL wire protocol
+# ----------------------------------------------------------------------
+
+class MiniPgClient:
+    """Just enough protocol-3 client for the tests: startup + simple and
+    extended query, cleartext auth."""
+
+    def __init__(self, port, user="root", password=None, database=None,
+                 try_ssl=False):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        if try_ssl:
+            self.sock.sendall(struct.pack("!II", 8, 80877103))
+            assert self.sock.recv(1) == b"N"
+        params = {"user": user}
+        if database:
+            params["database"] = database
+        body = struct.pack("!I", 196608)
+        for k, v in params.items():
+            body += k.encode() + b"\x00" + v.encode() + b"\x00"
+        body += b"\x00"
+        self.sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        self.params = {}
+        while True:
+            tag, payload = self._read_msg()
+            if tag == b"R":
+                (code,) = struct.unpack("!I", payload[:4])
+                if code == 3:
+                    if password is None:
+                        raise PermissionError("password required")
+                    p = password.encode() + b"\x00"
+                    self.sock.sendall(
+                        b"p" + struct.pack("!I", len(p) + 4) + p
+                    )
+                elif code != 0:
+                    raise AssertionError(f"unexpected auth code {code}")
+            elif tag == b"S":
+                k, v = payload.split(b"\x00")[:2]
+                self.params[k.decode()] = v.decode()
+            elif tag == b"E":
+                raise PermissionError(payload.decode("utf8", "replace"))
+            elif tag == b"Z":
+                return
+            # K (BackendKeyData) ignored
+
+    def _read_msg(self):
+        head = b""
+        while len(head) < 5:
+            chunk = self.sock.recv(5 - len(head))
+            assert chunk, "connection closed"
+            head += chunk
+        (ln,) = struct.unpack("!I", head[1:])
+        body = b""
+        while len(body) < ln - 4:
+            chunk = self.sock.recv(ln - 4 - len(body))
+            assert chunk, "connection closed"
+            body += chunk
+        return head[:1], body
+
+    def query(self, sql):
+        p = sql.encode() + b"\x00"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(p) + 4) + p)
+        return self._collect()
+
+    def extended(self, sql, args):
+        def send(tag, payload):
+            self.sock.sendall(
+                tag + struct.pack("!I", len(payload) + 4) + payload
+            )
+
+        send(b"P", b"\x00" + sql.encode() + b"\x00" + struct.pack("!H", 0))
+        bind = b"\x00\x00" + struct.pack("!H", 0)
+        bind += struct.pack("!H", len(args))
+        for a in args:
+            if a is None:
+                bind += struct.pack("!i", -1)
+            else:
+                b = str(a).encode()
+                bind += struct.pack("!i", len(b)) + b
+        bind += struct.pack("!H", 0)
+        send(b"B", bind)
+        send(b"E", b"\x00" + struct.pack("!I", 0))
+        send(b"S", b"")
+        return self._collect()
+
+    def _collect(self):
+        names, rows, err = [], [], None
+        while True:
+            tag, body = self._read_msg()
+            if tag == b"T":
+                (n,) = struct.unpack("!H", body[:2])
+                off = 2
+                names = []
+                for _ in range(n):
+                    end = body.index(b"\x00", off)
+                    names.append(body[off:end].decode())
+                    off = end + 1 + 18
+            elif tag == b"D":
+                (n,) = struct.unpack("!H", body[:2])
+                off = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", body[off:off + 4])
+                    off += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(body[off:off + ln].decode())
+                        off += ln
+                rows.append(row)
+            elif tag == b"E":
+                err = body.decode("utf8", "replace")
+            elif tag == b"Z":
+                if err:
+                    raise RuntimeError(err)
+                return names, rows
+            # C/1/2/3/n/I ignored
+
+    def close(self):
+        self.sock.sendall(b"X" + struct.pack("!I", 4))
+        self.sock.close()
+
+
+def test_postgres_simple_query(inst):
+    from greptimedb_tpu.servers.postgres import PostgresServer
+
+    srv = PostgresServer(inst, port=0).start()
+    try:
+        c = MiniPgClient(srv.port, try_ssl=True)
+        assert c.params.get("server_encoding") == "UTF8"
+        names, rows = c.query("SELECT host, v FROM wt ORDER BY host")
+        assert names == ["host", "v"]
+        assert rows == [["a", "1.5"], ["b", "2.5"]]
+        c.query("INSERT INTO wt (host, v, ts) VALUES ('pg', 9.0, 9000)")
+        _, rows = c.query("SELECT count(*) FROM wt")
+        assert rows == [["3"]]
+        with pytest.raises(RuntimeError):
+            c.query("SELECT broken FROM nothing")
+        # connection still usable after an error
+        _, rows = c.query("SELECT 1")
+        assert rows == [["1"]]
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_postgres_extended_protocol(inst):
+    from greptimedb_tpu.servers.postgres import PostgresServer
+
+    srv = PostgresServer(inst, port=0).start()
+    try:
+        c = MiniPgClient(srv.port)
+        names, rows = c.extended(
+            "SELECT host, v FROM wt WHERE host = $1", ["a"]
+        )
+        assert rows == [["a", "1.5"]]
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_postgres_auth(inst):
+    from greptimedb_tpu.auth import StaticUserProvider
+    from greptimedb_tpu.servers.postgres import PostgresServer
+
+    provider = StaticUserProvider({"alice": "secret"})
+    srv = PostgresServer(inst, port=0, user_provider=provider).start()
+    try:
+        c = MiniPgClient(srv.port, user="alice", password="secret")
+        _, rows = c.query("SELECT 1")
+        assert rows == [["1"]]
+        c.close()
+        with pytest.raises(PermissionError):
+            MiniPgClient(srv.port, user="alice", password="wrong")
+    finally:
+        srv.close()
+
+
+def test_postgres_database_param(inst):
+    from greptimedb_tpu.servers.postgres import PostgresServer
+
+    inst.sql("CREATE DATABASE pdb")
+    inst.sql("CREATE TABLE pdb.t3 (v DOUBLE, ts TIMESTAMP TIME INDEX)")
+    inst.sql("INSERT INTO pdb.t3 (v, ts) VALUES (3.25, 1000)")
+    srv = PostgresServer(inst, port=0).start()
+    try:
+        c = MiniPgClient(srv.port, database="pdb")
+        _, rows = c.query("SELECT v FROM t3")
+        assert rows == [["3.25"]]
+        c.close()
+    finally:
+        srv.close()
