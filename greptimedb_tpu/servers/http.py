@@ -132,7 +132,8 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             "/health", "/ready", "/status", "/metrics", "/v1/sql",
             "/v1/promql", "/v1/prometheus/api/v1/", "/v1/prometheus/write",
             "/v1/prometheus/read", "/v1/influxdb/", "/influxdb/",
-            "/v1/events",
+            "/v1/events", "/v1/opentsdb/api/put", "/api/put",
+            "/v1/otlp/v1/metrics",
         )
 
         def _raw_path(self) -> str:
@@ -274,6 +275,11 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             if path in ("/v1/influxdb/write", "/v1/influxdb/api/v2/write",
                         "/influxdb/write"):
                 return self._handle_influx_write()
+            if path in ("/v1/opentsdb/api/put", "/opentsdb/api/put",
+                        "/api/put"):
+                return self._handle_opentsdb_put()
+            if path == "/v1/otlp/v1/metrics":
+                return self._handle_otlp_metrics()
             if path == "/v1/events/pipelines" or path.startswith(
                 "/v1/events"
             ):
@@ -476,6 +482,43 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             )
             _INGEST_ROWS.labels("influx_line").inc(rows)
             self._send(204, b"")
+
+        def _handle_opentsdb_put(self):
+            from greptimedb_tpu.servers import opentsdb
+
+            params = self._params()
+            db = params.get("db", "public")
+            try:
+                rows = opentsdb.put_json(instance, self._body(), db=db)
+            except opentsdb.OpenTsdbError as e:
+                return self._json(400, {"error": str(e)})
+            _INGEST_ROWS.labels("opentsdb").inc(rows)
+            # OpenTSDB returns 204 unless ?details/?summary is asked
+            # (value-less flags: parse with blanks kept)
+            flags = {
+                k for k, _v in urllib.parse.parse_qsl(
+                    urllib.parse.urlparse(self.path).query,
+                    keep_blank_values=True,
+                )
+            }
+            if "details" in flags or "summary" in flags:
+                return self._json(200, {"success": rows, "failed": 0})
+            self._send(204, b"")
+
+        def _handle_otlp_metrics(self):
+            from greptimedb_tpu.servers import otlp
+
+            db = self.headers.get("X-Greptime-DB-Name", "public")
+            ctype = self.headers.get("Content-Type", "")
+            try:
+                rows = otlp.write_metrics(
+                    instance, self._body(), ctype, db=db
+                )
+            except Exception as e:  # noqa: BLE001 - protocol boundary
+                return self._json(400, {"error": str(e)})
+            _INGEST_ROWS.labels("otlp").inc(rows)
+            # ExportMetricsServiceResponse: empty message
+            self._send(200, b"", "application/x-protobuf")
 
         def _handle_events(self, method: str, path: str):
             from greptimedb_tpu.servers import event_handlers

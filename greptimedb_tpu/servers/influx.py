@@ -243,7 +243,8 @@ def _write_measurement(instance, db: str, measurement: str, rows) -> int:
 
 def ensure_table(instance, db: str, name: str, tag_keys: list[str],
                  field_types: dict[str, ConcreteDataType],
-                 *, ts_type: ConcreteDataType | None = None):
+                 *, ts_type: ConcreteDataType | None = None,
+                 ts_name: str = "ts"):
     """Auto-create or widen a table for protocol ingest (the reference's
     auto-create/auto-alter on insert, src/operator/src/insert.rs)."""
     table = instance.catalog.maybe_table(db, name)
@@ -256,7 +257,7 @@ def ensure_table(instance, db: str, name: str, tag_keys: list[str],
         for k, t in field_types.items():
             cols.append(ColumnSchema(k, t, SemanticType.FIELD))
         cols.append(ColumnSchema(
-            "ts", ts_type or ConcreteDataType.timestamp_millisecond(),
+            ts_name, ts_type or ConcreteDataType.timestamp_millisecond(),
             SemanticType.TIMESTAMP, nullable=False,
         ))
         if not instance.catalog.has_database(db):

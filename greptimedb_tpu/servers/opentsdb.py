@@ -1,0 +1,63 @@
+"""OpenTSDB HTTP ingest (`/api/put`).
+
+Capability counterpart of the reference's OpenTSDB handler
+(/root/reference/src/servers/src/opentsdb/codec.rs DataPoint +
+http/opentsdb.rs put): JSON body with one data point or an array of
+them; each metric becomes a table with the tags as tag columns,
+`greptime_timestamp` as the time index and `greptime_value` as the
+field. Second-precision timestamps (OpenTSDB's default) are detected by
+magnitude and scaled to ms, like the reference's
+`DataPoint::timestamp_to_millis`.
+"""
+
+from __future__ import annotations
+
+import json
+
+from greptimedb_tpu.servers.otlp import _Rows
+
+
+class OpenTsdbError(ValueError):
+    pass
+
+
+def _ts_ms(ts) -> int:
+    t = int(ts)
+    # seconds vs milliseconds by magnitude (reference codec.rs behavior)
+    return t * 1000 if t < 10_000_000_000 else t
+
+
+def put_json(instance, body: bytes, db: str = "public") -> int:
+    """Handle an /api/put payload. Returns data points written."""
+    try:
+        doc = json.loads(body or b"null")
+    except json.JSONDecodeError as e:
+        raise OpenTsdbError(f"invalid json: {e}") from None
+    if isinstance(doc, dict):
+        points = [doc]
+    elif isinstance(doc, list):
+        points = doc
+    else:
+        raise OpenTsdbError("expected a data point or an array of them")
+
+    out = _Rows()
+    for p in points:
+        if not isinstance(p, dict):
+            raise OpenTsdbError("data point must be an object")
+        metric = p.get("metric")
+        if not metric:
+            raise OpenTsdbError("metric is required")
+        if "timestamp" not in p or "value" not in p:
+            raise OpenTsdbError("timestamp and value are required")
+        try:
+            value = float(p["value"])
+        except (TypeError, ValueError):
+            raise OpenTsdbError(
+                f"bad value {p['value']!r} for {metric}"
+            ) from None
+        tags = {str(k): str(v) for k, v in (p.get("tags") or {}).items()}
+        # metric names normalize like OTLP names (dots -> underscores):
+        # dotted identifiers are database qualifiers in this SQL dialect
+        out.add(str(metric).replace(".", "_"), tags,
+                _ts_ms(p["timestamp"]), value)
+    return out.write(instance, db)

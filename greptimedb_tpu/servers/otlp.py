@@ -1,0 +1,383 @@
+"""OTLP (OpenTelemetry protocol) metrics ingest.
+
+Capability counterpart of the reference's OTLP handler
+(/root/reference/src/servers/src/otlp/metrics.rs): each metric becomes a
+table named by `normalize_otlp_name` (lowercase, `.`/`-` -> `_`) with
+resource + scope + data-point attributes as tags, `greptime_timestamp`
+as the time index and `greptime_value` as the field. Histograms land in
+three tables (`<m>_bucket` with an `le` tag, `<m>_sum`, `<m>_count`);
+summaries write one table per quantile tagged `quantile`.
+
+The wire payload is protobuf (ExportMetricsServiceRequest). No protobuf
+runtime is required: a minimal wire-format reader below walks exactly
+the fields this mapping needs (varint + length-delimited decoding per
+https://protobuf.dev/programming-guides/encoding/). The JSON flavor
+(content-type application/json) is accepted too.
+"""
+
+from __future__ import annotations
+
+import json
+
+from greptimedb_tpu.datatypes.types import ConcreteDataType
+from greptimedb_tpu.servers import influx
+
+GREPTIME_TS = "greptime_timestamp"
+GREPTIME_VALUE = "greptime_value"
+
+
+def normalize_otlp_name(name: str) -> str:
+    return name.lower().replace(".", "_").replace("-", "_")
+
+
+# ----------------------------------------------------------------------
+# minimal protobuf wire reader
+# ----------------------------------------------------------------------
+
+def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint overflow")
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message's fields.
+    Length-delimited values come back as bytes; varints as int; 64/32-bit
+    as raw little-endian bytes."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 1:
+            v, i = buf[i:i + 8], i + 8
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v, i = buf[i:i + ln], i + ln
+        elif wt == 5:
+            v, i = buf[i:i + 4], i + 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fno, wt, v
+
+
+def _f64(raw) -> float:
+    import struct
+
+    return struct.unpack("<d", raw)[0]
+
+
+def _sint(v: int) -> int:
+    """Interpret a varint as a signed 64-bit int (two's complement)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _decode_any_value(buf: bytes) -> str:
+    # AnyValue: 1 string, 2 bool, 3 int, 4 double (others stringified)
+    for fno, wt, v in _fields(buf):
+        if fno == 1:
+            return v.decode("utf-8", "replace")
+        if fno == 2:
+            return "true" if v else "false"
+        if fno == 3:
+            return str(_sint(v))
+        if fno == 4:
+            return repr(_f64(v))
+    return ""
+
+
+def _decode_attrs(pairs: list[bytes]) -> dict[str, str]:
+    out = {}
+    for kv in pairs:
+        key = ""
+        val = ""
+        for fno, wt, v in _fields(kv):
+            if fno == 1:
+                key = v.decode("utf-8", "replace")
+            elif fno == 2:
+                val = _decode_any_value(v)
+        if key:
+            out[normalize_otlp_name(key)] = val
+    return out
+
+
+def _u64(v, wt) -> int:
+    """fixed64 on the wire (wt 1); tolerate varint encodings too."""
+    import struct
+
+    return struct.unpack("<Q", v)[0] if wt == 1 else int(v)
+
+
+def _i64(v, wt) -> int:
+    """sfixed64 on the wire (wt 1); tolerate varint (two's complement)."""
+    import struct
+
+    return struct.unpack("<q", v)[0] if wt == 1 else _sint(v)
+
+
+def _decode_number_point(buf: bytes) -> tuple[dict, int, float | None]:
+    """NumberDataPoint: attributes(7), time_unix_nano(3, fixed64),
+    as_double(4)/as_int(6, sfixed64)."""
+    attrs_raw: list[bytes] = []
+    t_nano = 0
+    value: float | None = None
+    for fno, wt, v in _fields(buf):
+        if fno == 7:
+            attrs_raw.append(v)
+        elif fno == 3:
+            t_nano = _u64(v, wt)
+        elif fno == 4:
+            value = _f64(v)
+        elif fno == 6:
+            value = float(_i64(v, wt))
+    return _decode_attrs(attrs_raw), t_nano // 1_000_000, value
+
+
+def _decode_histogram_point(buf: bytes):
+    """HistogramDataPoint: attributes(9), time(3), count(4), sum(5),
+    bucket_counts(6, packed fixed64), explicit_bounds(7, packed double)."""
+    import struct
+
+    attrs_raw: list[bytes] = []
+    t_nano = 0
+    count = 0
+    hsum = None
+    bucket_counts: list[int] = []
+    bounds: list[float] = []
+    for fno, wt, v in _fields(buf):
+        if fno == 9:
+            attrs_raw.append(v)
+        elif fno == 3:
+            t_nano = _u64(v, wt)
+        elif fno == 4:
+            count = v if wt == 0 else struct.unpack("<Q", v)[0]
+        elif fno == 5:
+            hsum = _f64(v)
+        elif fno == 6:
+            if wt == 2:
+                bucket_counts = [
+                    struct.unpack("<Q", v[i:i + 8])[0]
+                    for i in range(0, len(v), 8)
+                ]
+            elif wt == 1:
+                bucket_counts.append(struct.unpack("<Q", v)[0])
+            else:
+                bucket_counts.append(v)
+        elif fno == 7:
+            if wt == 2:
+                bounds = [
+                    struct.unpack("<d", v[i:i + 8])[0]
+                    for i in range(0, len(v), 8)
+                ]
+            else:
+                bounds.append(_f64(v))
+    return (_decode_attrs(attrs_raw), t_nano // 1_000_000, count, hsum,
+            bucket_counts, bounds)
+
+
+def _decode_summary_point(buf: bytes):
+    """SummaryDataPoint: attributes(7), time(3), count(4), sum(5),
+    quantile_values(6: {quantile(1), value(2)})."""
+    import struct
+
+    attrs_raw: list[bytes] = []
+    t_nano = 0
+    count = 0
+    ssum = None
+    quantiles: list[tuple[float, float]] = []
+    for fno, wt, v in _fields(buf):
+        if fno == 7:
+            attrs_raw.append(v)
+        elif fno == 3:
+            t_nano = _u64(v, wt)
+        elif fno == 4:
+            count = v if wt == 0 else struct.unpack("<Q", v)[0]
+        elif fno == 5:
+            ssum = _f64(v)
+        elif fno == 6:
+            q = val = 0.0
+            for f2, _, v2 in _fields(v):
+                if f2 == 1:
+                    q = _f64(v2)
+                elif f2 == 2:
+                    val = _f64(v2)
+            quantiles.append((q, val))
+    return (_decode_attrs(attrs_raw), t_nano // 1_000_000, count, ssum,
+            quantiles)
+
+
+class _Rows:
+    """Accumulates (tags, value, ts) rows per output table."""
+
+    def __init__(self):
+        self.tables: dict[str, list] = {}
+
+    def add(self, table: str, tags: dict, ts_ms: int, value: float):
+        self.tables.setdefault(table, []).append(
+            (tags, {GREPTIME_VALUE: float(value)}, ts_ms)
+        )
+
+    def write(self, instance, db: str) -> int:
+        total = 0
+        for name, rows in self.tables.items():
+            tag_keys: list[str] = []
+            for tags, _f, _t in rows:
+                for k in tags:
+                    if k not in tag_keys:
+                        tag_keys.append(k)
+            table = influx.ensure_table(
+                instance, db, name, tag_keys,
+                {GREPTIME_VALUE: ConcreteDataType.float64()},
+                ts_name=GREPTIME_TS,
+            )
+            total += influx_write_rows(instance, db, name, table, rows)
+        return total
+
+
+def influx_write_rows(instance, db, name, table, rows) -> int:
+    import numpy as np
+
+    n = len(rows)
+    ts = np.fromiter((r[2] for r in rows), np.int64, n)
+    tag_cols = {
+        k: np.asarray([r[0].get(k, "") for r in rows], object)
+        for k in table.tag_names
+    }
+    vals = np.asarray([r[1][GREPTIME_VALUE] for r in rows], np.float64)
+    table.write(tag_cols, ts, {GREPTIME_VALUE: vals})
+    data = {table.ts_name: ts, **tag_cols, GREPTIME_VALUE: vals}
+    instance._notify_flows(db, name, table, data, {})
+    return n
+
+
+def _metric_rows(out: _Rows, mbuf: bytes, base_tags: dict):
+    """Metric: name(1), gauge(5), sum(7), histogram(9), summary(11)."""
+    name = ""
+    kinds: list[tuple[int, bytes]] = []
+    for fno, wt, v in _fields(mbuf):
+        if fno == 1:
+            name = v.decode("utf-8", "replace")
+        elif fno in (5, 7, 9, 11):
+            kinds.append((fno, v))
+    if not name:
+        return
+    tname = normalize_otlp_name(name)
+    for fno, kbuf in kinds:
+        # Gauge/Sum/Histogram/Summary all hold data_points as field 1
+        points = [v for f2, _, v in _fields(kbuf) if f2 == 1]
+        for p in points:
+            if fno in (5, 7):
+                attrs, ts_ms, value = _decode_number_point(p)
+                if value is None:
+                    continue
+                out.add(tname, {**base_tags, **attrs}, ts_ms, value)
+            elif fno == 9:
+                (attrs, ts_ms, count, hsum, bucket_counts,
+                 bounds) = _decode_histogram_point(p)
+                tags = {**base_tags, **attrs}
+                acc = 0
+                for i, c in enumerate(bucket_counts):
+                    acc += c
+                    le = (repr(bounds[i]) if i < len(bounds) else "+Inf")
+                    out.add(f"{tname}_bucket", {**tags, "le": le},
+                            ts_ms, acc)
+                if hsum is not None:
+                    out.add(f"{tname}_sum", tags, ts_ms, hsum)
+                out.add(f"{tname}_count", tags, ts_ms, count)
+            elif fno == 11:
+                attrs, ts_ms, count, ssum, quantiles = (
+                    _decode_summary_point(p)
+                )
+                tags = {**base_tags, **attrs}
+                for q, val in quantiles:
+                    out.add(tname, {**tags, "quantile": repr(q)},
+                            ts_ms, val)
+                if ssum is not None:
+                    out.add(f"{tname}_sum", tags, ts_ms, ssum)
+                out.add(f"{tname}_count", tags, ts_ms, count)
+
+
+def write_protobuf(instance, body: bytes, db: str = "public") -> int:
+    """ExportMetricsServiceRequest: resource_metrics(1) ->
+    {resource(1){attributes(1)}, scope_metrics(2) ->
+    {scope(1){name(1)}, metrics(2)}}."""
+    out = _Rows()
+    for fno, wt, rm in _fields(body):
+        if fno != 1:
+            continue
+        res_tags: dict = {}
+        scope_bufs: list[bytes] = []
+        for f2, _, v in _fields(rm):
+            if f2 == 1:  # Resource
+                attrs = [a for f3, _, a in _fields(v) if f3 == 1]
+                res_tags = _decode_attrs(attrs)
+            elif f2 == 2:
+                scope_bufs.append(v)
+        for sm in scope_bufs:
+            for f3, _, v in _fields(sm):
+                if f3 == 2:  # Metric
+                    _metric_rows(out, v, res_tags)
+    return out.write(instance, db)
+
+
+# ----------------------------------------------------------------------
+# JSON flavor
+# ----------------------------------------------------------------------
+
+def _json_attrs(attrs: list) -> dict:
+    out = {}
+    for kv in attrs or []:
+        k = kv.get("key", "")
+        v = kv.get("value", {})
+        sval = None
+        for variant in ("stringValue", "intValue", "doubleValue",
+                        "boolValue"):
+            if variant in v:   # explicit membership: false/0.0/"" are
+                sval = v[variant]  # legitimate values, not absent ones
+                if variant == "boolValue":
+                    sval = "true" if sval else "false"
+                break
+        if k and sval is not None:
+            out[normalize_otlp_name(k)] = str(sval)
+    return out
+
+
+def write_json(instance, body: bytes, db: str = "public") -> int:
+    doc = json.loads(body)
+    out = _Rows()
+    for rm in doc.get("resourceMetrics", []):
+        res_tags = _json_attrs(
+            rm.get("resource", {}).get("attributes", [])
+        )
+        for sm in rm.get("scopeMetrics", []):
+            for metric in sm.get("metrics", []):
+                name = normalize_otlp_name(metric.get("name", ""))
+                if not name:
+                    continue
+                for kind in ("gauge", "sum"):
+                    for p in metric.get(kind, {}).get("dataPoints", []):
+                        attrs = _json_attrs(p.get("attributes", []))
+                        ts_ms = int(p.get("timeUnixNano", 0)) // 1_000_000
+                        v = p.get("asDouble", p.get("asInt"))
+                        if v is None:
+                            continue
+                        out.add(name, {**res_tags, **attrs}, ts_ms,
+                                float(v))
+    return out.write(instance, db)
+
+
+def write_metrics(instance, body: bytes, content_type: str,
+                  db: str = "public") -> int:
+    if "json" in (content_type or ""):
+        return write_json(instance, body, db)
+    return write_protobuf(instance, body, db)
