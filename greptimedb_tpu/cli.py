@@ -1,6 +1,21 @@
-"""Process entry point: `python -m greptimedb_tpu.cli standalone start`.
+"""Process entry point: `python -m greptimedb_tpu.cli <role> start`.
 
-Counterpart of /root/reference/src/cmd/src/bin/greptime.rs subcommands.
+Counterpart of /root/reference/src/cmd/src/bin/greptime.rs subcommands
+(standalone/frontend/datanode/metasrv/flownode start + cli), with the
+reference's layered options resolution (src/cmd/src/options.rs):
+defaults < --config-file TOML < GREPTIMEDB_TPU__* env < CLI flags
+(config.py).
+
+Role topology:
+- standalone: everything in one process (engine + all protocol servers
+  + flows), like the reference's `greptime standalone start`.
+- datanode: storage engine + Arrow Flight data RPC (+ admin HTTP);
+  optionally registers and heartbeats against a metasrv.
+- frontend: stateless protocol servers (HTTP/MySQL/Postgres) forwarding
+  SQL to datanodes over Flight (servers/remote.py).
+- metasrv: control plane over HTTP — KV/CAS, registration, heartbeats,
+  region routes (servers/meta_http.py).
+- flownode: engine + flow manager, ingest-facing HTTP only.
 """
 
 from __future__ import annotations
@@ -10,91 +25,81 @@ import signal
 import sys
 import time
 
+from greptimedb_tpu.config import load_options
 
-def main(argv=None):
+ROLES = ("standalone", "frontend", "datanode", "metasrv", "flownode")
+
+
+def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="greptimedb-tpu")
     sub = ap.add_subparsers(dest="role", required=True)
 
-    standalone = sub.add_parser("standalone")
-    s_sub = standalone.add_subparsers(dest="cmd", required=True)
-    start = s_sub.add_parser("start")
-    start.add_argument("--data-home", default="./greptimedb_tpu_data")
-    start.add_argument("--http-addr", default="127.0.0.1:4000")
-    start.add_argument("--mysql-addr", default="127.0.0.1:4002",
-                       help="MySQL wire protocol address ('' disables)")
-    start.add_argument("--flight-addr", default="127.0.0.1:4001",
-                       help="Arrow Flight (gRPC) address ('' disables)")
-    start.add_argument("--postgres-addr", default="127.0.0.1:4003",
-                       help="PostgreSQL wire protocol address "
-                            "('' disables)")
-    start.add_argument("--no-flows", action="store_true")
+    for role in ROLES:
+        rp = sub.add_parser(role)
+        r_sub = rp.add_subparsers(dest="cmd", required=True)
+        start = r_sub.add_parser("start")
+        start.add_argument("-c", "--config-file", default=None)
+        start.add_argument("--data-home", default=None)
+        start.add_argument("--http-addr", default=None)
+        start.add_argument("--mysql-addr", default=None,
+                           help="MySQL wire address ('' disables)")
+        start.add_argument("--postgres-addr", default=None,
+                           help="PostgreSQL wire address ('' disables)")
+        start.add_argument("--flight-addr", default=None,
+                           help="Arrow Flight (gRPC) address "
+                                "('' disables)")
+        start.add_argument("--metasrv-addr", default=None,
+                           help="metasrv to register with (datanode) "
+                                "or to serve on (metasrv)")
+        start.add_argument("--datanode-addrs", default=None,
+                           help="comma-separated datanode flight "
+                                "addresses (frontend)")
+        start.add_argument("--node-id", type=int, default=None)
+        start.add_argument("--no-flows", action="store_true")
 
     repl = sub.add_parser("cli")
     repl.add_argument("--data-home", default="./greptimedb_tpu_data")
+    return ap
 
-    args = ap.parse_args(argv)
-    if args.role == "standalone":
-        return _start_standalone(args)
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
     if args.role == "cli":
         return _repl(args)
-    ap.error("unknown role")
-
-
-def _start_standalone(args):
-    from greptimedb_tpu.instance import Standalone
-    from greptimedb_tpu.servers.http import HttpServer
-    from greptimedb_tpu.storage.engine import EngineConfig
-
-    host, _, port = args.http_addr.rpartition(":")
-    inst = Standalone(
-        engine_config=EngineConfig(
-            data_root=args.data_home, enable_background=True,
-        )
+    opts = load_options(
+        args.role,
+        config_file=args.config_file,
+        cli_overrides={
+            "data_home": args.data_home,
+            "http.addr": args.http_addr,
+            "mysql.addr": args.mysql_addr,
+            "postgres.addr": args.postgres_addr,
+            "grpc.addr": args.flight_addr,
+            "metasrv.addr": args.metasrv_addr,
+            "datanode.metasrv_addr": args.metasrv_addr,
+            "datanode.node_id": args.node_id,
+            "frontend.datanode_addrs": (
+                args.datanode_addrs.split(",")
+                if args.datanode_addrs else None
+            ),
+            "flow.enable": False if args.no_flows else None,
+        },
     )
-    if not args.no_flows:
-        try:
-            inst.enable_flows()
-        except Exception:
-            pass
-    server = HttpServer(inst, addr=host or "127.0.0.1",
-                        port=int(port)).start()
-    extra = []
-    if args.mysql_addr:
-        from greptimedb_tpu.servers.mysql import MySqlServer
+    return {
+        "standalone": _start_standalone,
+        "frontend": _start_frontend,
+        "datanode": _start_datanode,
+        "metasrv": _start_metasrv,
+        "flownode": _start_flownode,
+    }[args.role](opts)
 
-        mh, _, mp = args.mysql_addr.rpartition(":")
-        extra.append(MySqlServer(
-            inst, addr=mh or "127.0.0.1", port=int(mp)
-        ).start())
-        print(f"greptimedb-tpu mysql protocol on {args.mysql_addr}",
-              flush=True)
-    if getattr(args, "postgres_addr", ""):
-        from greptimedb_tpu.servers.postgres import PostgresServer
 
-        ph, _, pp = args.postgres_addr.rpartition(":")
-        extra.append(PostgresServer(
-            inst, addr=ph or "127.0.0.1", port=int(pp)
-        ).start())
-        print(f"greptimedb-tpu postgres protocol on {args.postgres_addr}",
-              flush=True)
-    if args.flight_addr:
-        try:
-            from greptimedb_tpu.servers.flight import FlightFrontend
+def _split(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
 
-            fh, _, fp = args.flight_addr.rpartition(":")
-            extra.append(FlightFrontend(
-                inst, addr=fh or "127.0.0.1", port=int(fp)
-            ).start())
-            print(f"greptimedb-tpu arrow flight on {args.flight_addr}",
-                  flush=True)
-        except ImportError:
-            print("# pyarrow.flight unavailable; flight disabled",
-                  flush=True)
-    print(
-        f"greptimedb-tpu standalone listening on http://{server.addr}:"
-        f"{server.port}", flush=True,
-    )
 
+def _serve_until_signal(closers):
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
@@ -102,11 +107,205 @@ def _start_standalone(args):
         while not stop:
             time.sleep(0.2)
     finally:
-        for s in extra:
-            s.close()
-        server.stop()
-        inst.close()
+        for c in reversed(closers):
+            try:
+                c()
+            except Exception:
+                pass
     return 0
+
+
+def _wire_protocols(inst, opts, closers) -> None:
+    """MySQL/Postgres/Flight servers shared by standalone + frontend."""
+    if opts.get("mysql.enable", True) and opts.get("mysql.addr"):
+        from greptimedb_tpu.servers.mysql import MySqlServer
+
+        mh, mp = _split(opts.get("mysql.addr"))
+        srv = MySqlServer(inst, addr=mh, port=mp).start()
+        closers.append(srv.close)
+        print(f"greptimedb-tpu mysql protocol on {mh}:{srv.port}",
+              flush=True)
+    if opts.get("postgres.enable", True) and opts.get("postgres.addr"):
+        from greptimedb_tpu.servers.postgres import PostgresServer
+
+        ph, pp = _split(opts.get("postgres.addr"))
+        srv = PostgresServer(inst, addr=ph, port=pp).start()
+        closers.append(srv.close)
+        print(f"greptimedb-tpu postgres protocol on {ph}:{srv.port}",
+              flush=True)
+
+
+def _http_server(inst, opts, closers):
+    if not (opts.get("http.enable", True) and opts.get("http.addr")):
+        return None
+    from greptimedb_tpu.servers.http import HttpServer
+
+    hh, hp = _split(opts.get("http.addr"))
+    server = HttpServer(inst, addr=hh, port=hp).start()
+    closers.append(server.stop)
+    return server
+
+
+def _flight_server(inst, opts, closers) -> None:
+    if not (opts.get("grpc.enable", True) and opts.get("grpc.addr")):
+        return
+    try:
+        from greptimedb_tpu.servers.flight import FlightFrontend
+    except ImportError:
+        print("# pyarrow.flight unavailable; flight disabled", flush=True)
+        return
+    fh, fp = _split(opts.get("grpc.addr"))
+    srv = FlightFrontend(inst, addr=fh, port=fp).start()
+    closers.append(srv.close)
+    print(f"greptimedb-tpu arrow flight on {fh}:{srv.server.port}",
+          flush=True)
+
+
+def _make_instance(opts):
+    from greptimedb_tpu.instance import Standalone
+    from greptimedb_tpu.storage.engine import EngineConfig
+
+    inst = Standalone(
+        engine_config=EngineConfig(
+            data_root=opts.get("data_home"),
+            enable_background=opts.get("engine.enable_background", True),
+            background_interval_s=opts.get(
+                "engine.background_interval_s", 5.0
+            ),
+        )
+    )
+    if opts.get("flow.enable", True):
+        try:
+            inst.enable_flows(
+                tick_interval_s=opts.get("flow.tick_interval_s", 1.0)
+            )
+        except Exception:
+            pass
+    return inst
+
+
+def _start_standalone(opts):
+    inst = _make_instance(opts)
+    closers = [inst.close]
+    server = _http_server(inst, opts, closers)
+    _wire_protocols(inst, opts, closers)
+    _flight_server(inst, opts, closers)
+    print(
+        f"greptimedb-tpu standalone listening on http://{server.addr}:"
+        f"{server.port}", flush=True,
+    )
+    return _serve_until_signal(closers)
+
+
+def _start_datanode(opts):
+    inst = _make_instance(opts)
+    closers = [inst.close]
+    _flight_server(inst, opts, closers)
+    _http_server(inst, opts, closers)
+    meta_addr = opts.get("datanode.metasrv_addr") or ""
+    if meta_addr:
+        node_id = int(opts.get("datanode.node_id", 0))
+        closers.append(
+            _heartbeat_loop(meta_addr, node_id, inst)
+        )
+    print(
+        f"greptimedb-tpu datanode (node {opts.get('datanode.node_id')}) "
+        f"flight on {opts.get('grpc.addr')}", flush=True,
+    )
+    return _serve_until_signal(closers)
+
+
+def _heartbeat_loop(meta_addr: str, node_id: int, inst):
+    """Register + heartbeat against the metasrv HTTP service."""
+    import json
+    import threading
+    import urllib.request
+
+    stop = threading.Event()
+
+    def post(path, doc):
+        req = urllib.request.Request(
+            f"http://{meta_addr}{path}",
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def loop():
+        registered = False
+        while True:   # register immediately, THEN pace by the interval
+            try:
+                if not registered:
+                    post("/register", {"node_id": node_id})
+                    registered = True
+                stats = {}
+                try:
+                    for t in inst.catalog.all_tables():
+                        for r in t.regions:
+                            stats[str(r.meta.region_id)] = {
+                                "rows": int(getattr(r.memtable, "rows",
+                                                    0)),
+                            }
+                except Exception:
+                    pass
+                resp = post("/heartbeat", {
+                    "node_id": node_id, "region_stats": stats,
+                })
+                for ins in resp.get("instructions") or []:
+                    # mailbox instructions (migrations etc.) are logged;
+                    # region movement over this HTTP topology is handled
+                    # by the in-process cluster layer (cluster.py)
+                    if ins.get("type") != "grant_lease":
+                        print(f"# metasrv instruction: {ins}", flush=True)
+            except Exception:
+                registered = False
+            if stop.wait(2.0):
+                return
+
+    t = threading.Thread(target=loop, daemon=True, name="dn-heartbeat")
+    t.start()
+    return stop.set
+
+
+def _start_frontend(opts):
+    from greptimedb_tpu.servers.remote import RemoteInstance
+
+    addrs = opts.get("frontend.datanode_addrs") or []
+    if isinstance(addrs, str):
+        addrs = [a for a in addrs.split(",") if a]
+    inst = RemoteInstance(addrs)
+    closers = [inst.close]
+    _wire_protocols(inst, opts, closers)
+    server = _http_server(inst, opts, closers)
+    print(
+        f"greptimedb-tpu frontend -> datanodes {addrs} on "
+        f"http://{server.addr}:{server.port}", flush=True,
+    )
+    return _serve_until_signal(closers)
+
+
+def _start_metasrv(opts):
+    from greptimedb_tpu.servers.meta_http import MetasrvServer
+
+    mh, mp = _split(opts.get("metasrv.addr"))
+    srv = MetasrvServer(
+        addr=mh, port=mp, data_home=opts.get("data_home"),
+        selector=opts.get("metasrv.selector", "round_robin"),
+    ).start()
+    print(f"greptimedb-tpu metasrv on {mh}:{srv.port}", flush=True)
+    return _serve_until_signal([srv.close])
+
+
+def _start_flownode(opts):
+    inst = _make_instance(opts)   # flows on by default
+    closers = [inst.close]
+    server = _http_server(inst, opts, closers)
+    print(
+        f"greptimedb-tpu flownode on http://{server.addr}:{server.port}",
+        flush=True,
+    )
+    return _serve_until_signal(closers)
 
 
 def _repl(args):
@@ -145,8 +344,10 @@ def _print_result(res):
         if res.num_rows else len(str(n))
         for i, n in enumerate(res.names)
     ]
+
     def fmt(row):
         return " | ".join(str(v).ljust(w) for v, w in zip(row, widths))
+
     print(fmt(res.names))
     print("-+-".join("-" * w for w in widths))
     for row in res.rows():

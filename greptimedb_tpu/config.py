@@ -1,0 +1,157 @@
+"""Layered configuration (defaults < TOML file < env < CLI flags).
+
+Capability counterpart of the reference's options system
+(/root/reference/src/cmd/src/options.rs GreptimeOptions::load_layered_
+options: serde defaults, `--config-file` TOML, `GREPTIMEDB_<ROLE>__`
+double-underscore env keys, CLI overrides — last wins).
+
+Every role process (standalone/frontend/datanode/metasrv/flownode)
+resolves its options through `load_options`; values are kept as a
+nested dict with dotted-path access so new sections need no schema
+changes here.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_PREFIX = "GREPTIMEDB_TPU"
+
+# role-shared defaults; role sections are only consulted by their role
+DEFAULTS: dict = {
+    "data_home": "./greptimedb_tpu_data",
+    "default_timezone": "UTC",
+    "http": {"addr": "127.0.0.1:4000", "enable": True},
+    "grpc": {"addr": "127.0.0.1:4001", "enable": True},   # arrow flight
+    "mysql": {"addr": "127.0.0.1:4002", "enable": True},
+    "postgres": {"addr": "127.0.0.1:4003", "enable": True},
+    "opentsdb": {"enable": True},
+    "influxdb": {"enable": True},
+    "wal": {"sync": False},
+    "storage": {"type": "fs"},
+    "flow": {"enable": True, "tick_interval_s": 1.0},
+    "engine": {
+        "enable_background": True,
+        "background_interval_s": 5.0,
+    },
+    "frontend": {
+        # flight addresses of the datanodes this frontend fans out to
+        "datanode_addrs": [],
+    },
+    "metasrv": {"addr": "127.0.0.1:4010", "selector": "round_robin"},
+    "datanode": {"node_id": 0, "metasrv_addr": ""},
+    "logging": {"level": "info"},
+}
+
+
+class Options:
+    """Nested options with dotted-path access: opts.get('http.addr')."""
+
+    def __init__(self, values: dict):
+        self.values = values
+
+    def get(self, path: str, default=None):
+        cur = self.values
+        for part in path.split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                return default
+            cur = cur[part]
+        return cur
+
+    def section(self, name: str) -> dict:
+        v = self.get(name, {})
+        return v if isinstance(v, dict) else {}
+
+    def set(self, path: str, value):
+        cur = self.values
+        parts = path.split(".")
+        for part in parts[:-1]:
+            cur = cur.setdefault(part, {})
+        cur[parts[-1]] = value
+
+
+def _deep_merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _parse_scalar(raw: str):
+    """Env values parse like TOML scalars; unparseable stays a string."""
+    low = raw.strip().lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        pass
+    if raw.startswith("[") and raw.endswith("]"):
+        inner = raw[1:-1].strip()
+        if not inner:
+            return []
+        return [
+            _parse_scalar(x.strip().strip("'\""))
+            for x in inner.split(",")
+        ]
+    return raw
+
+
+def _env_overrides(env, prefixes: list[str]) -> dict:
+    out: dict = {}
+    for key, raw in env.items():
+        for pfx in prefixes:
+            if not key.startswith(pfx + "__"):
+                continue
+            path = key[len(pfx) + 2:].lower().split("__")
+            cur = out
+            for part in path[:-1]:
+                cur = cur.setdefault(part, {})
+            cur[path[-1]] = _parse_scalar(raw)
+            break
+    return out
+
+
+def load_options(
+    role: str = "standalone",
+    config_file: str | None = None,
+    env: dict | None = None,
+    cli_overrides: dict | None = None,
+) -> Options:
+    """Resolve options for a role: defaults < TOML < env < CLI.
+
+    env keys: GREPTIMEDB_TPU__SECTION__KEY (or the role-scoped
+    GREPTIMEDB_TPU_<ROLE>__SECTION__KEY, which wins over the generic
+    prefix). cli_overrides maps dotted paths to values; None values are
+    skipped so unset flags never mask lower layers.
+    """
+    import copy
+
+    # deep copy: Options.set writes into nested dicts, which must never
+    # reach back into the shared module-level DEFAULTS
+    values = copy.deepcopy(DEFAULTS)
+    if config_file:
+        import tomllib
+
+        with open(config_file, "rb") as f:
+            values = _deep_merge(values, tomllib.load(f))
+    env = dict(os.environ if env is None else env)
+    for prefixes in (
+        [ENV_PREFIX],
+        [f"{ENV_PREFIX}_{role.upper()}"],
+    ):
+        ov = _env_overrides(env, prefixes)
+        if ov:
+            values = _deep_merge(values, ov)
+    opts = Options(values)
+    for path, value in (cli_overrides or {}).items():
+        if value is not None:
+            opts.set(path, value)
+    return opts

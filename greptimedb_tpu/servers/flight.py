@@ -129,8 +129,31 @@ class FlightServer(flight.FlightServerBase):
 
     # ---- queries ------------------------------------------------------
     def _run_sql(self, sql: str) -> pa.Table:
-        res = self.instance.sql(sql, QueryContext(database="public"))
-        return result_to_arrow(res)
+        # raw SQL, or a JSON envelope {"sql": ..., "db": ...} so remote
+        # frontends can forward session database context
+        db = "public"
+        if sql.startswith("{"):
+            try:
+                import json
+
+                doc = json.loads(sql)
+                sql = doc["sql"]
+                db = doc.get("db") or "public"
+            except (ValueError, KeyError):
+                pass
+        outs = self.instance.execute_sql(sql, QueryContext(database=db))
+        out = outs[-1]
+        if out.result is None:
+            # DML/DDL ack: marked in schema metadata so remote frontends
+            # never confuse it with a query result that happens to have
+            # an "affected_rows" column
+            tbl = pa.table({
+                "affected_rows": pa.array(
+                    [out.affected_rows or 0], pa.int64()
+                )
+            })
+            return tbl.replace_schema_metadata({b"gtdb:affected": b"1"})
+        return result_to_arrow(out.result)
 
     def do_get(self, context, ticket: flight.Ticket):
         with self._pending_lock:

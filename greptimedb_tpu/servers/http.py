@@ -264,6 +264,19 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 return self._handle_sql()
             if path == "/v1/promql":
                 return self._handle_promql_range(self._form())
+            _local_only = (
+                path.startswith("/v1/prometheus/")
+                or path.startswith(("/v1/influxdb/", "/influxdb/"))
+                or path in ("/v1/opentsdb/api/put", "/opentsdb/api/put",
+                            "/api/put", "/v1/otlp/v1/metrics")
+            )
+            if _local_only and not hasattr(instance, "_write_columns"):
+                # frontend-role (remote) instances forward SQL only; the
+                # columnar ingest/PromQL surfaces need engine access
+                return self._error(
+                    501, "not available on a frontend role process; "
+                         "send to a datanode or standalone"
+                )
             if path.startswith("/v1/prometheus/api/v1/"):
                 return self._handle_prom_api(
                     path.removeprefix("/v1/prometheus/api/v1/")

@@ -1,0 +1,166 @@
+"""Metasrv HTTP service: the control plane as a role process.
+
+Capability counterpart of the reference's metasrv gRPC services
+(/root/reference/src/meta-srv/src/service/: store.rs KV api,
+heartbeat.rs, cluster.rs): datanodes register and heartbeat over HTTP,
+frontends resolve region routes, and the shared KV (with CAS) backs
+procedures and (meta/election.py) leader election.
+
+Endpoints (JSON):
+  POST /register   {node_id}
+  POST /heartbeat  {node_id, region_stats, leases?} -> {instructions}
+  GET  /routes                                      -> {region: node}
+  GET  /route/<region_id>                           -> {node_id}
+  POST /kv         {op: get|put|delete|cas|range, key, value?, expect?}
+  GET  /health
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from greptimedb_tpu.meta.kv import FsKv, KvBackend, MemoryKv
+from greptimedb_tpu.meta.metasrv import Metasrv
+
+
+def _make_handler(metasrv: Metasrv, kv: KvBackend):
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "greptimedb-tpu-metasrv"
+
+        def log_message(self, *args):
+            pass
+
+        def _json(self, code: int, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> dict:
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            raw = self.rfile.read(n) if n else b"{}"
+            return json.loads(raw or b"{}")
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path == "/health":
+                return self._json(200, {"status": "ok"})
+            if path == "/routes":
+                return self._json(200, {
+                    str(r): n for r, n in metasrv._all_routes().items()
+                })
+            if path.startswith("/route/"):
+                try:
+                    rid = int(path.rsplit("/", 1)[-1])
+                except ValueError:
+                    return self._json(400, {"error": "bad region id"})
+                return self._json(200, {"node_id": metasrv.route_of(rid)})
+            return self._json(404, {"error": f"no route: {path}"})
+
+        def do_POST(self):
+            path = self.path.split("?")[0]
+            try:
+                doc = self._body()
+            except ValueError as e:
+                return self._json(400, {"error": f"bad json: {e}"})
+            try:
+                if path == "/register":
+                    metasrv.register_node(int(doc["node_id"]))
+                    return self._json(200, {})
+                if path == "/heartbeat":
+                    instructions = metasrv.heartbeat(
+                        int(doc["node_id"]),
+                        doc.get("region_stats") or {},
+                    )
+                    return self._json(
+                        200, {"instructions": instructions or []}
+                    )
+                if path == "/kv":
+                    return self._kv(doc)
+            except Exception as e:  # noqa: BLE001 - RPC boundary
+                return self._json(400, {"error": str(e)})
+            return self._json(404, {"error": f"no route: {path}"})
+
+        def _kv(self, doc: dict):
+            op = doc.get("op")
+            key = doc.get("key", "")
+            if op == "get":
+                v = kv.get(key)
+                return self._json(200, {
+                    "value": None if v is None else v.decode("utf-8",
+                                                             "replace")
+                })
+            if op == "put":
+                kv.put(key, str(doc.get("value", "")).encode())
+                return self._json(200, {})
+            if op == "delete":
+                return self._json(200, {"deleted": kv.delete(key)})
+            if op == "cas":
+                expect = doc.get("expect")
+                ok = kv.compare_and_put(
+                    key,
+                    None if expect is None else str(expect).encode(),
+                    str(doc.get("value", "")).encode(),
+                )
+                return self._json(200, {"success": bool(ok)})
+            if op == "range":
+                return self._json(200, {
+                    "kvs": [
+                        [k, v.decode("utf-8", "replace")]
+                        for k, v in kv.range(key)
+                    ]
+                })
+            return self._json(400, {"error": f"bad kv op: {op}"})
+
+    return Handler
+
+
+class MetasrvServer:
+    """`MetasrvServer(port=4010).start()` — control plane over HTTP."""
+
+    def __init__(self, *, addr: str = "127.0.0.1", port: int = 4010,
+                 data_home: str | None = None,
+                 selector: str = "round_robin"):
+        self.kv: KvBackend = (
+            FsKv(f"{data_home}/metasrv/kv.json") if data_home
+            else MemoryKv()
+        )
+        self.metasrv = Metasrv(self.kv, selector=selector)
+        self.addr = addr
+        self.port = port
+        self._srv: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._ticker = threading.Thread(
+            target=self._tick_loop, daemon=True, name="metasrv-tick"
+        )
+        self._stop = threading.Event()
+
+    def _tick_loop(self):
+        while not self._stop.wait(1.0):
+            try:
+                self.metasrv.tick()
+            except Exception:
+                pass
+
+    def start(self) -> "MetasrvServer":
+        self._srv = ThreadingHTTPServer(
+            (self.addr, self.port), _make_handler(self.metasrv, self.kv)
+        )
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="metasrv-http",
+        )
+        self._thread.start()
+        self._ticker.start()
+        return self
+
+    def close(self):
+        self._stop.set()
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()

@@ -380,12 +380,29 @@ class _Handler(socketserver.BaseRequestHandler):
         except Exception as e:  # noqa: BLE001 - protocol boundary
             conn.send(_error("42601", str(e)))
             return
-        for st in stmts:
+        exec_stmt = getattr(inst, "execute_statement", None)
+        if exec_stmt is None:
+            # remote (frontend-role) instances forward whole strings;
+            # pair the outputs back up with the parsed statements
             try:
-                out = inst.execute_statement(st, ctx)
+                outs = inst.execute_sql(sql, ctx)
             except Exception as e:  # noqa: BLE001 - protocol boundary
                 conn.send(_error("42601", str(e)))
                 return
+            if len(outs) != len(stmts):
+                stmts = stmts[-len(outs):] if outs else []
+            pairs = list(zip(stmts, outs))
+        else:
+            pairs = [(st, None) for st in stmts]
+        for st, pre in pairs:
+            if pre is None:
+                try:
+                    out = exec_stmt(st, ctx)
+                except Exception as e:  # noqa: BLE001
+                    conn.send(_error("42601", str(e)))
+                    return
+            else:
+                out = pre
             if out.result is None:
                 n = out.affected_rows or 0
                 verb = " ".join(

@@ -1,0 +1,130 @@
+"""Frontend-side remote executor: SQL over Arrow Flight to a datanode.
+
+Capability counterpart of the reference's frontend -> datanode data
+plane (/root/reference/src/client/src/database.rs Database::sql over
+FlightClient + src/servers/src/grpc/flight.rs): a frontend role process
+owns no storage — every statement forwards over gRPC/Flight and results
+stream back columnar.
+
+The protocol servers (HTTP /v1/sql, MySQL, Postgres) only need the
+`execute_sql`/`sql` surface, so a RemoteInstance slots in where a
+Standalone would. Statements route to the first configured datanode
+(region routing across datanodes stays inside the cluster layer,
+cluster.py; this is the process-topology wire path).
+"""
+
+from __future__ import annotations
+
+import json
+
+from greptimedb_tpu.datatypes.batch import HostColumn
+from greptimedb_tpu.datatypes.types import ConcreteDataType
+from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.query.executor import Col, QueryResult
+from greptimedb_tpu.session import QueryContext
+
+
+class Output:
+    """Mirror of instance.Output's surface for protocol handlers."""
+
+    def __init__(self, result=None, affected_rows=None):
+        self.result = result
+        self.affected_rows = affected_rows
+
+
+def arrow_to_result(table) -> QueryResult:
+    names = []
+    cols = []
+    types = {}
+    import pyarrow as pa
+
+    for field in table.schema:
+        arr = table.column(field.name)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if pa.types.is_timestamp(field.type):
+            arr = arr.cast(pa.timestamp("ms"))
+        hc = HostColumn.from_arrow(field.name, arr)
+        names.append(field.name)
+        valid = hc.valid_mask
+        cols.append(Col(hc.values, None if valid.all() else valid))
+        types[field.name] = ConcreteDataType.from_arrow(field.type)
+    return QueryResult(names, cols, types)
+
+
+class _RemoteCatalog:
+    """Just enough catalog surface for protocol handlers (USE db)."""
+
+    def __init__(self, inst: "RemoteInstance"):
+        self._inst = inst
+
+    def has_database(self, name: str) -> bool:
+        try:
+            res = self._inst.sql("SHOW DATABASES")
+            return name in {row[0] for row in res.rows()}
+        except Exception:
+            return False
+
+    def all_tables(self):
+        return []
+
+
+class RemoteInstance:
+    """execute_sql/sql forwarding over Flight; lazily connected."""
+
+    def __init__(self, datanode_addrs: list[str]):
+        if not datanode_addrs:
+            raise GreptimeError("frontend needs >=1 datanode_addrs")
+        self.addrs = list(datanode_addrs)
+        self._clients: dict[str, object] = {}
+        self.catalog = _RemoteCatalog(self)
+
+    def _client(self, addr: str):
+        cli = self._clients.get(addr)
+        if cli is None:
+            import pyarrow.flight as flight
+
+            cli = flight.connect(f"grpc://{addr}")
+            self._clients[addr] = cli
+        return cli
+
+    def execute_sql(self, sql: str, ctx: QueryContext | None = None):
+        import pyarrow.flight as flight
+
+        db = getattr(ctx, "database", None) or "public"
+        ticket = flight.Ticket(
+            json.dumps({"sql": sql, "db": db}).encode()
+        )
+        try:
+            reader = self._client(self.addrs[0]).do_get(ticket)
+            table = reader.read_all()
+        except flight.FlightError as e:
+            # surface the datanode's message, not the gRPC wrapper
+            msg = str(e).split("gRPC client debug context")[0]
+            msg = msg.split(". Detail: Failed")[0].strip().rstrip(". ")
+            raise GreptimeError(msg) from None
+        meta = table.schema.metadata or {}
+        if meta.get(b"gtdb:affected") == b"1":
+            return [Output(
+                affected_rows=int(table.column(0).to_pylist()[0])
+            )]
+        return [Output(result=arrow_to_result(table))]
+
+    def sql(self, sql: str, ctx: QueryContext | None = None) -> QueryResult:
+        outs = self.execute_sql(sql, ctx)
+        out = outs[-1]
+        if out.result is None:
+            return QueryResult(
+                ["affected_rows"],
+                [Col(__import__("numpy").asarray(
+                    [out.affected_rows or 0]
+                ))],
+            )
+        return out.result
+
+    def close(self):
+        for cli in self._clients.values():
+            try:
+                cli.close()
+            except Exception:
+                pass

@@ -1,0 +1,198 @@
+"""Layered config + role-process topology (VERDICT coverage rows 1/30:
+CLI role processes, option layering; row 6: a real frontend->datanode
+data plane over Flight)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.config import load_options
+from greptimedb_tpu.instance import Standalone
+
+
+# ----------------------------------------------------------------------
+# config layering
+# ----------------------------------------------------------------------
+
+def test_config_layering(tmp_path):
+    cfg = tmp_path / "cfg.toml"
+    cfg.write_text(
+        'data_home = "/from/toml"\n'
+        "[http]\naddr = \"0.0.0.0:9000\"\n"
+        "[flow]\ntick_interval_s = 9.5\n"
+    )
+    env = {
+        "GREPTIMEDB_TPU__HTTP__ADDR": "1.2.3.4:8000",
+        "GREPTIMEDB_TPU__WAL__SYNC": "true",
+        "GREPTIMEDB_TPU__ENGINE__BACKGROUND_INTERVAL_S": "2.5",
+    }
+    opts = load_options(
+        "standalone", config_file=str(cfg), env=env,
+        cli_overrides={"http.addr": "127.0.0.1:7000",
+                       "mysql.addr": None},   # unset flag: no masking
+    )
+    # precedence: cli > env > toml > defaults
+    assert opts.get("http.addr") == "127.0.0.1:7000"
+    assert opts.get("wal.sync") is True
+    assert opts.get("engine.background_interval_s") == 2.5
+    assert opts.get("data_home") == "/from/toml"
+    assert opts.get("flow.tick_interval_s") == 9.5
+    assert opts.get("mysql.addr") == "127.0.0.1:4002"  # default kept
+
+
+def test_config_role_scoped_env_wins():
+    env = {
+        "GREPTIMEDB_TPU__HTTP__ADDR": "generic:1",
+        "GREPTIMEDB_TPU_DATANODE__HTTP__ADDR": "scoped:2",
+    }
+    opts = load_options("datanode", env=env)
+    assert opts.get("http.addr") == "scoped:2"
+    assert load_options("frontend", env=env).get("http.addr") == "generic:1"
+
+
+def test_config_list_env_parse():
+    env = {"GREPTIMEDB_TPU__FRONTEND__DATANODE_ADDRS":
+           "[\"127.0.0.1:4001\", \"127.0.0.1:5001\"]"}
+    opts = load_options("frontend", env=env)
+    assert opts.get("frontend.datanode_addrs") == [
+        "127.0.0.1:4001", "127.0.0.1:5001",
+    ]
+
+
+# ----------------------------------------------------------------------
+# role topology: metasrv + datanode(flight) + frontend(remote)
+# ----------------------------------------------------------------------
+
+flight = pytest.importorskip("pyarrow.flight")
+
+
+@pytest.fixture()
+def datanode(tmp_path):
+    from greptimedb_tpu.servers.flight import FlightFrontend
+
+    inst = Standalone(str(tmp_path / "dn"))
+    f = FlightFrontend(inst, port=0).start()
+    yield inst, f
+    f.close()
+    inst.close()
+
+
+def test_frontend_forwards_sql_over_flight(datanode):
+    from greptimedb_tpu.servers.remote import RemoteInstance
+
+    _, f = datanode
+    fe = RemoteInstance([f"127.0.0.1:{f.server.port}"])
+    out = fe.execute_sql(
+        "CREATE TABLE rt (host STRING, v DOUBLE, ts TIMESTAMP TIME "
+        "INDEX, PRIMARY KEY (host))"
+    )[-1]
+    assert out.result is None
+    out = fe.execute_sql(
+        "INSERT INTO rt (host, v, ts) VALUES ('a', 1.5, 1000), "
+        "('b', 2.5, 2000)"
+    )[-1]
+    assert out.affected_rows == 2
+    res = fe.sql("SELECT host, v FROM rt ORDER BY host")
+    assert [list(r) for r in res.rows()] == [["a", 1.5], ["b", 2.5]]
+    # errors surface as GreptimeError, not gRPC internals
+    from greptimedb_tpu.errors import GreptimeError
+
+    with pytest.raises(GreptimeError):
+        fe.sql("SELECT broken FROM missing")
+    fe.close()
+
+
+def test_frontend_database_context(datanode):
+    from greptimedb_tpu.servers.remote import RemoteInstance
+    from greptimedb_tpu.session import QueryContext
+
+    inst, f = datanode
+    inst.sql("CREATE DATABASE fdb")
+    inst.sql("CREATE TABLE fdb.t (v DOUBLE, ts TIMESTAMP TIME INDEX)")
+    inst.sql("INSERT INTO fdb.t (v, ts) VALUES (4.5, 10)")
+    fe = RemoteInstance([f"127.0.0.1:{f.server.port}"])
+    res = fe.sql("SELECT v FROM t", QueryContext(database="fdb"))
+    assert float(res.cols[0].values[0]) == 4.5
+    assert fe.catalog.has_database("fdb")
+    assert not fe.catalog.has_database("nope")
+    fe.close()
+
+
+def test_frontend_mysql_protocol_through_datanode(datanode):
+    from greptimedb_tpu.servers.mysql import MySqlServer
+    from greptimedb_tpu.servers.remote import RemoteInstance
+
+    import sys
+    sys.path.insert(0, "tests")
+    from test_wire_protocols import MiniMySqlClient
+
+    _, f = datanode
+    fe = RemoteInstance([f"127.0.0.1:{f.server.port}"])
+    srv = MySqlServer(fe, port=0).start()
+    try:
+        c = MiniMySqlClient(srv.port)
+        c.query("CREATE TABLE mt (v DOUBLE, ts TIMESTAMP TIME INDEX)")
+        c.query("INSERT INTO mt (v, ts) VALUES (7.5, 1000)")
+        _, rows = c.query("SELECT v FROM mt")
+        assert rows == [["7.5"]]
+        c.close()
+    finally:
+        srv.close()
+        fe.close()
+
+
+def test_metasrv_http_service(tmp_path):
+    from greptimedb_tpu.servers.meta_http import MetasrvServer
+
+    srv = MetasrvServer(port=0, data_home=str(tmp_path)).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def post(path, doc):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(doc).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            return json.loads(urllib.request.urlopen(req, timeout=5)
+                              .read())
+
+        post("/register", {"node_id": 1})
+        hb = post("/heartbeat", {"node_id": 1,
+                                 "region_stats": {"7": {"rows": 10}}})
+        # first heartbeat grants the node its lease
+        assert {i["type"] for i in hb["instructions"]} <= {"grant_lease"}
+        # kv with CAS
+        assert post("/kv", {"op": "cas", "key": "k", "expect": None,
+                            "value": "v1"})["success"]
+        assert not post("/kv", {"op": "cas", "key": "k", "expect": None,
+                                "value": "v2"})["success"]
+        assert post("/kv", {"op": "get", "key": "k"})["value"] == "v1"
+        got = json.loads(urllib.request.urlopen(
+            base + "/routes", timeout=5
+        ).read())
+        assert isinstance(got, dict)
+    finally:
+        srv.close()
+
+
+def test_cli_role_parsers():
+    """Every role's start command parses with the layered flags."""
+    from greptimedb_tpu import cli
+
+    ap = cli.build_parser()
+    for role in cli.ROLES:
+        args = ap.parse_args([
+            role, "start", "--data-home", "/tmp/x",
+            "--http-addr", "127.0.0.1:0", "--mysql-addr", "",
+            "--postgres-addr", "", "--flight-addr", "127.0.0.1:0",
+            "--metasrv-addr", "127.0.0.1:4010",
+            "--datanode-addrs", "a:1,b:2", "--node-id", "7",
+            "--no-flows",
+        ])
+        assert args.role == role and args.cmd == "start"
+        assert args.data_home == "/tmp/x"
+        assert args.node_id == 7 and args.no_flows
+    args = ap.parse_args(["cli", "--data-home", "/tmp/y"])
+    assert args.role == "cli" and args.data_home == "/tmp/y"
