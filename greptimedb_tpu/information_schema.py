@@ -98,12 +98,233 @@ def _flows_doc(inst) -> dict[str, list]:
     return rows
 
 
+def _views_doc(inst) -> dict[str, list]:
+    rows = {"table_catalog": [], "table_schema": [], "table_name": [],
+            "view_definition": []}
+    for db in inst.catalog.database_names():
+        for name in inst.catalog.view_names(db):
+            rows["table_catalog"].append("greptime")
+            rows["table_schema"].append(db)
+            rows["table_name"].append(name)
+            rows["view_definition"].append(
+                inst.catalog.maybe_view(db, name) or ""
+            )
+    return rows
+
+
+def _key_column_usage_doc(inst) -> dict[str, list]:
+    rows = {"constraint_catalog": [], "constraint_schema": [],
+            "constraint_name": [], "table_catalog": [],
+            "table_schema": [], "table_name": [], "column_name": [],
+            "ordinal_position": []}
+    for db in inst.catalog.database_names():
+        for name in inst.catalog.table_names(db):
+            t = inst.catalog.table(db, name)
+            pos = {"PRIMARY": 0, "TIME INDEX": 0}  # 1-based PER constraint
+            for c in t.schema.columns:
+                if not (c.is_tag or c.is_time_index):
+                    continue
+                cname = "TIME INDEX" if c.is_time_index else "PRIMARY"
+                pos[cname] += 1
+                rows["constraint_catalog"].append("def")
+                rows["constraint_schema"].append(db)
+                rows["constraint_name"].append(cname)
+                rows["table_catalog"].append("def")
+                rows["table_schema"].append(db)
+                rows["table_name"].append(name)
+                rows["column_name"].append(c.name)
+                rows["ordinal_position"].append(pos[cname])
+    return rows
+
+
+def _table_constraints_doc(inst) -> dict[str, list]:
+    rows = {"constraint_catalog": [], "constraint_schema": [],
+            "constraint_name": [], "table_schema": [], "table_name": [],
+            "constraint_type": []}
+    for db in inst.catalog.database_names():
+        for name in inst.catalog.table_names(db):
+            t = inst.catalog.table(db, name)
+            for cname, ctype in (("TIME INDEX", "TIME INDEX"),
+                                 ("PRIMARY", "PRIMARY KEY")):
+                if cname == "PRIMARY" and not t.tag_names:
+                    continue
+                rows["constraint_catalog"].append("def")
+                rows["constraint_schema"].append(db)
+                rows["constraint_name"].append(cname)
+                rows["table_schema"].append(db)
+                rows["table_name"].append(name)
+                rows["constraint_type"].append(ctype)
+    return rows
+
+
+def _partitions_doc(inst) -> dict[str, list]:
+    rows = {"table_catalog": [], "table_schema": [], "table_name": [],
+            "partition_name": [], "partition_expression": [],
+            "greptime_partition_id": []}
+    for db in inst.catalog.database_names():
+        for name in inst.catalog.table_names(db):
+            t = inst.catalog.table(db, name)
+            rule = getattr(t, "partition_rule", None)
+            exprs = rule.expr_texts if rule is not None else []
+            for i, r in enumerate(t.regions):
+                rows["table_catalog"].append("greptime")
+                rows["table_schema"].append(db)
+                rows["table_name"].append(name)
+                rows["partition_name"].append(f"p{i}")
+                rows["partition_expression"].append(
+                    exprs[i] if i < len(exprs) else ""
+                )
+                rows["greptime_partition_id"].append(r.meta.region_id)
+    return rows
+
+
+def _region_peers_doc(inst) -> dict[str, list]:
+    rows = {"region_id": [], "table_id": [], "peer_id": [],
+            "peer_addr": [], "is_leader": [], "status": []}
+    cluster = getattr(inst, "cluster", None)
+    for t in inst.catalog.all_tables():
+        for r in t.regions:
+            rows["region_id"].append(r.meta.region_id)
+            rows["table_id"].append(t.info.table_id)
+            node = 0
+            if cluster is not None and hasattr(cluster, "route_of"):
+                node = cluster.route_of(r.meta.region_id) or 0
+            rows["peer_id"].append(node)
+            rows["peer_addr"].append("")
+            rows["is_leader"].append("Yes")
+            rows["status"].append("ALIVE")
+    return rows
+
+
+def _runtime_metrics_doc(inst) -> dict[str, list]:
+    from greptimedb_tpu.telemetry.metrics import global_registry
+
+    rows = {"metric_name": [], "value": [], "labels": []}
+    for line in global_registry.render().splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        name, _, labels = head.partition("{")
+        try:
+            fval = float(val)
+        except ValueError:
+            continue
+        rows["metric_name"].append(name)
+        rows["value"].append(fval)
+        rows["labels"].append(labels.rstrip("}"))
+    return rows
+
+
+def _cluster_info_doc(inst) -> dict[str, list]:
+    from greptimedb_tpu.version import __version__
+
+    cluster = getattr(inst, "cluster", None)
+    datanodes = getattr(cluster, "datanodes", None) if cluster else None
+    if datanodes:
+        rows = {"peer_id": [], "peer_type": [], "peer_addr": [],
+                "version": [], "git_commit": [], "active_time": []}
+        for node_id in sorted(datanodes):
+            rows["peer_id"].append(int(node_id))
+            rows["peer_type"].append("DATANODE")
+            rows["peer_addr"].append("")
+            rows["version"].append(__version__)
+            rows["git_commit"].append("")
+            rows["active_time"].append("")
+        rows["peer_id"].append(-1)
+        rows["peer_type"].append("METASRV")
+        rows["peer_addr"].append("")
+        rows["version"].append(__version__)
+        rows["git_commit"].append("")
+        rows["active_time"].append("")
+        return rows
+    return {
+        "peer_id": [0],
+        "peer_type": ["STANDALONE"],
+        "peer_addr": [""],
+        "version": [__version__],
+        "git_commit": [""],
+        "active_time": [""],
+    }
+
+
+def _procedure_info_doc(inst) -> dict[str, list]:
+    rows = {"procedure_id": [], "procedure_type": [], "status": [],
+            "error": []}
+    pm = getattr(inst, "procedure_manager", None)
+    if pm is not None:
+        for m in pm.list_procedures():
+            rows["procedure_id"].append(m.proc_id)
+            rows["procedure_type"].append(m.type_name)
+            rows["status"].append(m.state)
+            rows["error"].append(m.error or "")
+    return rows
+
+
+def _engines_doc(inst) -> dict[str, list]:
+    names = ["tsdb", "metric", "file"]
+    comments = [
+        "TPU-native LSM time-series engine (mito analog)",
+        "logical metric tables over the tsdb engine",
+        "external tables over CSV/JSON/Parquet files",
+    ]
+    return {
+        "engine": names,
+        "support": ["DEFAULT", "YES", "YES"],
+        "comment": comments,
+        "transactions": ["NO"] * 3,
+        "xa": ["NO"] * 3,
+        "savepoints": ["NO"] * 3,
+    }
+
+
+def _build_info_doc(inst) -> dict[str, list]:
+    from greptimedb_tpu.version import __version__
+
+    return {
+        "git_branch": [""], "git_commit": [""],
+        "git_commit_short": [""], "git_clean": ["true"],
+        "pkg_version": [__version__],
+    }
+
+
+def _character_sets_doc(inst) -> dict[str, list]:
+    return {
+        "character_set_name": ["utf8"],
+        "default_collate_name": ["utf8_bin"],
+        "description": ["UTF-8 Unicode"],
+        "maxlen": [4],
+    }
+
+
+def _collations_doc(inst) -> dict[str, list]:
+    return {
+        "collation_name": ["utf8_bin"],
+        "character_set_name": ["utf8"],
+        "id": [1],
+        "is_default": ["Yes"],
+        "is_compiled": ["Yes"],
+        "sortlen": [1],
+    }
+
+
 _PROVIDERS = {
     "tables": _tables_doc,
     "columns": _columns_doc,
     "region_statistics": _region_statistics_doc,
     "schemata": _schemata_doc,
     "flows": _flows_doc,
+    "views": _views_doc,
+    "key_column_usage": _key_column_usage_doc,
+    "table_constraints": _table_constraints_doc,
+    "partitions": _partitions_doc,
+    "region_peers": _region_peers_doc,
+    "runtime_metrics": _runtime_metrics_doc,
+    "cluster_info": _cluster_info_doc,
+    "procedure_info": _procedure_info_doc,
+    "engines": _engines_doc,
+    "build_info": _build_info_doc,
+    "character_sets": _character_sets_doc,
+    "collations": _collations_doc,
 }
 
 
@@ -119,8 +340,12 @@ def query_information_schema(inst, stmt: A.Select, ctx) -> QueryResult:
     cols = {}
     n = len(next(iter(doc.values()))) if doc else 0
     for k, vals in doc.items():
-        if vals and isinstance(vals[0], (int, np.integer)):
+        if vals and isinstance(vals[0], bool):
+            cols[k] = Col(np.asarray(vals, bool))
+        elif vals and isinstance(vals[0], (int, np.integer)):
             cols[k] = Col(np.asarray(vals, np.int64))
+        elif vals and isinstance(vals[0], (float, np.floating)):
+            cols[k] = Col(np.asarray(vals, np.float64))
         else:
             cols[k] = Col(np.asarray(vals, object))
     src = DictSource(cols, n)

@@ -267,6 +267,58 @@ def test_information_schema(inst):
     assert res.rows() == [["host", "TAG"], ["region", "TAG"]]
 
 
+def test_information_schema_breadth(inst):
+    """The wider information_schema surface (VERDICT row 27): every
+    provider answers, and the structured ones carry real catalog data."""
+    setup_cpu(inst)
+    inst.sql("CREATE VIEW v1 AS SELECT host, usage_user FROM cpu")
+
+    r = inst.sql("SELECT table_name, view_definition FROM "
+                 "information_schema.views")
+    assert r.rows()[0][0] == "v1" and "usage_user" in r.rows()[0][1]
+
+    r = inst.sql(
+        "SELECT constraint_name, column_name FROM "
+        "information_schema.key_column_usage WHERE table_name = 'cpu' "
+        "ORDER BY ordinal_position"
+    )
+    names = {tuple(row) for row in r.rows()}
+    assert ("PRIMARY", "host") in names and ("PRIMARY", "region") in names
+    assert any(c == "TIME INDEX" for c, _ in names)
+
+    r = inst.sql("SELECT constraint_type FROM "
+                 "information_schema.table_constraints "
+                 "WHERE table_name = 'cpu' ORDER BY constraint_type")
+    assert [row[0] for row in r.rows()] == ["PRIMARY KEY", "TIME INDEX"]
+
+    r = inst.sql("SELECT table_name, partition_name FROM "
+                 "information_schema.partitions "
+                 "WHERE table_name = 'cpu'")
+    assert r.rows()[0][1] == "p0"
+
+    r = inst.sql("SELECT region_id, is_leader, status FROM "
+                 "information_schema.region_peers")
+    assert r.num_rows >= 1 and r.rows()[0][1:] == ["Yes", "ALIVE"]
+
+    r = inst.sql("SELECT metric_name, value FROM "
+                 "information_schema.runtime_metrics "
+                 "WHERE metric_name LIKE 'greptime%' OR 1 = 1 LIMIT 5")
+    assert r.num_rows >= 1
+
+    r = inst.sql("SELECT peer_type, version FROM "
+                 "information_schema.cluster_info")
+    assert r.rows()[0][0] == "STANDALONE"
+
+    r = inst.sql("SELECT engine, support FROM information_schema.engines "
+                 "ORDER BY engine")
+    assert ["file", "metric", "tsdb"] == [row[0] for row in r.rows()]
+
+    for tbl in ("procedure_info", "build_info", "character_sets",
+                "collations"):
+        r = inst.sql(f"SELECT * FROM information_schema.{tbl}")
+        assert r.names, tbl
+
+
 def test_alter_add_drop_column(inst):
     setup_cpu(inst)
     inst.sql("ALTER TABLE cpu ADD COLUMN usage_idle DOUBLE")
