@@ -320,6 +320,13 @@ def eval_scalar_function(e: A.FuncCall, src: ColumnSource) -> Col:
     if name == "database" or name == "current_schema":
         return Col(np.full(n, "public", object))
 
+    # ---- json / geo / net families (query/functions_ext.py) -----------
+    from greptimedb_tpu.query import functions_ext
+
+    out = functions_ext.try_eval(name, args, src)
+    if out is not None:
+        return out
+
     raise UnsupportedError(f"unknown function: {name}")
 
 

@@ -1,0 +1,94 @@
+"""JSON / geo / network scalar functions (VERDICT row 20)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    s = Standalone(str(tmp_path / "data"))
+    yield s
+    s.close()
+
+
+@pytest.fixture()
+def jt(inst):
+    inst.sql(
+        "CREATE TABLE jt (doc STRING, ts TIMESTAMP TIME INDEX)"
+    )
+    inst.sql(
+        'INSERT INTO jt (doc, ts) VALUES '
+        '(\'{"a": {"b": 7}, "tags": ["x", "y"], "ok": true, "pi": 3.5}\', 1), '
+        "('not json', 2), "
+        '(\'{"a": {}}\', 3)'
+    )
+    return inst
+
+
+def test_json_get(jt):
+    r = jt.sql("SELECT json_get_int(doc, '$.a.b') FROM jt ORDER BY ts")
+    rows = r.rows()
+    assert rows[0][0] == 7 and rows[1][0] is None and rows[2][0] is None
+    r = jt.sql("SELECT json_get_string(doc, '$.tags[1]') FROM jt "
+               "WHERE ts = 1")
+    assert r.rows()[0][0] == "y"
+    r = jt.sql("SELECT json_get_bool(doc, 'ok'), "
+               "json_get_float(doc, 'pi') FROM jt WHERE ts = 1")
+    assert r.rows()[0] == [True, 3.5]
+
+
+def test_json_predicates(jt):
+    r = jt.sql("SELECT json_path_exists(doc, '$.a.b'), "
+               "json_is_object(doc) FROM jt ORDER BY ts")
+    rows = r.rows()
+    assert rows[0] == [True, True]
+    assert rows[1] == [False, False]
+    assert rows[2] == [False, True]
+
+
+def test_json_in_where(jt):
+    r = jt.sql("SELECT ts FROM jt WHERE json_get_int(doc, '$.a.b') = 7")
+    assert [row[0] for row in r.rows()] == [1]
+
+
+def test_geo_functions(inst):
+    inst.sql("CREATE TABLE gt (lat DOUBLE, lon DOUBLE, "
+             "ts TIMESTAMP TIME INDEX)")
+    # San Francisco and New York
+    inst.sql("INSERT INTO gt (lat, lon, ts) VALUES "
+             "(37.7749, -122.4194, 1), (40.7128, -74.0060, 2)")
+    r = inst.sql("SELECT st_distance(lat, lon, 40.7128, -74.0060) "
+                 "FROM gt ORDER BY ts")
+    d = float(r.rows()[0][0])
+    assert abs(d - 4_129_000) < 15_000   # ~4129 km great-circle
+    assert float(r.rows()[1][0]) == 0.0
+
+    r = inst.sql("SELECT geohash(lat, lon, 6) FROM gt ORDER BY ts")
+    assert r.rows()[0][0].startswith("9q8yy")   # SF geohash prefix
+
+    r = inst.sql("SELECT st_point(lat, lon) FROM gt WHERE ts = 2")
+    assert r.rows()[0][0] == "POINT(-74.006 40.7128)"
+
+    # cell bucketing groups nearby points to the same id
+    r = inst.sql("SELECT h3_latlng_to_cell(lat, lon, 8) FROM gt "
+                 "ORDER BY ts")
+    ids = [row[0] for row in r.rows()]
+    assert ids[0] != ids[1] and all(isinstance(i, int) for i in ids)
+
+
+def test_net_functions(inst):
+    inst.sql("CREATE TABLE nt (ip STRING, ts TIMESTAMP TIME INDEX)")
+    inst.sql("INSERT INTO nt (ip, ts) VALUES ('10.0.0.1', 1), "
+             "('192.168.1.5', 2), ('garbage', 3)")
+    r = inst.sql("SELECT ipv4_string_to_num(ip) FROM nt ORDER BY ts")
+    rows = [row[0] for row in r.rows()]
+    assert rows[0] == 10 * 2**24 + 1 and rows[2] is None
+    r = inst.sql("SELECT ipv4_num_to_string(167772161) FROM nt LIMIT 1")
+    assert r.rows()[0][0] == "10.0.0.1"
+    r = inst.sql("SELECT ts FROM nt WHERE ipv4_in_range(ip, "
+                 "'192.168.0.0/16')")
+    assert [row[0] for row in r.rows()] == [2]
