@@ -1,0 +1,149 @@
+"""Lease-based leader election over the CAS kv.
+
+Capability counterpart of the reference's etcd election
+(/root/reference/src/meta-srv/src/election/etcd.rs:161-206
+campaign/lease keep-alive: the leader holds a leased key and renews it;
+followers watch and take over when the lease lapses), built on the same
+compare-and-put primitive our KvBackend already guarantees.
+
+The leader key holds {leader, expires_at}: a candidate CAS-claims the
+key when absent or expired, the incumbent CAS-renews against the exact
+bytes it last wrote (so a steal it didn't see makes renewal fail
+cleanly), and stepping down deletes the key for an immediate handover.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from greptimedb_tpu.meta.kv import KvBackend
+
+LEADER_KEY = "__meta/election/leader"
+
+
+class Election:
+    """One candidate's campaign loop. start() spawns the ticker;
+    is_leader reflects the latest observation."""
+
+    def __init__(self, kv: KvBackend, candidate_id: str, *,
+                 key: str = LEADER_KEY, lease_s: float = 5.0,
+                 tick_s: float | None = None,
+                 on_change=None):
+        self.kv = kv
+        self.me = candidate_id
+        self.key = key
+        self.lease_s = lease_s
+        self.tick_s = tick_s if tick_s is not None else lease_s / 3.0
+        self.on_change = on_change
+        self._is_leader = False
+        self._last_written: bytes | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # ---- observation --------------------------------------------------
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def leader(self) -> tuple[str | None, float]:
+        doc = self._read()
+        if doc is None:
+            return None, 0.0
+        return doc.get("leader"), float(doc.get("expires_at", 0.0))
+
+    def _read(self) -> dict | None:
+        raw = self.kv.get(self.key)
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    # ---- campaign -----------------------------------------------------
+    def step(self, now: float | None = None) -> bool:
+        """One election round; returns leadership after the round."""
+        now = time.time() if now is None else now
+        with self._lock:
+            raw = self.kv.get(self.key)
+            doc = None
+            if raw is not None:
+                try:
+                    doc = json.loads(raw)
+                except ValueError:
+                    doc = None
+            new = json.dumps({
+                "leader": self.me, "expires_at": now + self.lease_s,
+            }).encode()
+            won = False
+            if raw is None:
+                won = self.kv.compare_and_put(self.key, None, new)
+            elif doc is None:
+                # corrupt leader key: CAS against its raw bytes so SOME
+                # candidate can always repair it
+                won = self.kv.compare_and_put(self.key, raw, new)
+            elif doc.get("leader") == self.me:
+                # renew against the exact bytes we hold; a steal we
+                # haven't observed fails the CAS and demotes us
+                expect = (self._last_written
+                          if self._last_written is not None else raw)
+                won = self.kv.compare_and_put(self.key, expect, new)
+            elif float(doc.get("expires_at", 0.0)) < now:
+                won = self.kv.compare_and_put(self.key, raw, new)
+            if won:
+                self._last_written = new
+            was = self._is_leader
+            self._is_leader = won
+        if won != was and self.on_change is not None:
+            try:
+                self.on_change(won)
+            except Exception:
+                pass
+        return won
+
+    def resign(self):
+        """Step down: delete the key iff we still own it."""
+        with self._lock:
+            if not self._is_leader:
+                return
+            raw = self.kv.get(self.key)
+            if raw is not None and raw == self._last_written:
+                # best-effort: CAS to an already-expired lease so the
+                # next candidate's step() takes over immediately
+                self.kv.compare_and_put(self.key, raw, json.dumps({
+                    "leader": self.me, "expires_at": 0.0,
+                }).encode())
+            was = self._is_leader
+            self._is_leader = False
+        if was and self.on_change is not None:
+            try:
+                self.on_change(False)
+            except Exception:
+                pass
+
+    # ---- lifecycle ----------------------------------------------------
+    def start(self) -> "Election":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"election-{self.me}",
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        self.step()
+        while not self._stop.wait(self.tick_s):
+            try:
+                self.step()
+            except Exception:
+                pass
+
+    def stop(self, *, resign: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if resign:
+            self.resign()

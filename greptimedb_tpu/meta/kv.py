@@ -77,18 +77,59 @@ class MemoryKv(KvBackend):
 class FsKv(KvBackend):
     """Durable kv over one JSON file with atomic rename commits — the
     standalone-mode analog of the reference's raft-engine kv backend
-    (src/log-store/src/raft_engine/backend.rs)."""
+    (src/log-store/src/raft_engine/backend.rs).
+
+    Safe for MULTIPLE instances (threads or processes) over one file:
+    every operation revalidates the in-memory cache against the file's
+    (mtime_ns, size) stamp, and mutations hold an OS-level flock on a
+    sidecar lock file — so compare_and_put is a true cross-process CAS
+    and leader election over a shared data_home can't split-brain."""
 
     def __init__(self, path: str):
         self.path = path
         self._mem = MemoryKv()
         self._lock = threading.RLock()
-        if os.path.exists(path):
-            with open(path) as f:
-                for k, v in json.load(f).items():
-                    self._mem.put(k, bytes.fromhex(v))
-        else:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._stamp: tuple | None = None
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._reload_if_changed()
+
+    # ---- cross-instance coherence -------------------------------------
+    def _file_stamp(self):
+        try:
+            st = os.stat(self.path)
+            return (st.st_mtime_ns, st.st_size)
+        except FileNotFoundError:
+            return None
+
+    def _reload_if_changed(self):
+        stamp = self._file_stamp()
+        if stamp == self._stamp:
+            return
+        mem = MemoryKv()
+        if stamp is not None:
+            try:
+                with open(self.path) as f:
+                    for k, v in json.load(f).items():
+                        mem.put(k, bytes.fromhex(v))
+            except (ValueError, OSError):
+                return   # mid-replace read; next op retries
+        self._mem = mem
+        self._stamp = stamp
+
+    def _flock(self):
+        import fcntl
+        from contextlib import contextmanager
+
+        @contextmanager
+        def hold():
+            with open(self.path + ".lock", "w") as lf:
+                fcntl.flock(lf, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lf, fcntl.LOCK_UN)
+
+        return hold()
 
     def _persist(self):
         doc = {k: v.hex() for k, v in self._mem.range("")}
@@ -98,27 +139,35 @@ class FsKv(KvBackend):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        self._stamp = self._file_stamp()
 
     def get(self, key):
-        return self._mem.get(key)
+        with self._lock:
+            self._reload_if_changed()
+            return self._mem.get(key)
 
     def put(self, key, value):
-        with self._lock:
+        with self._lock, self._flock():
+            self._reload_if_changed()
             self._mem.put(key, value)
             self._persist()
 
     def delete(self, key):
-        with self._lock:
+        with self._lock, self._flock():
+            self._reload_if_changed()
             out = self._mem.delete(key)
             if out:
                 self._persist()
             return out
 
     def range(self, prefix):
-        return self._mem.range(prefix)
+        with self._lock:
+            self._reload_if_changed()
+            return self._mem.range(prefix)
 
     def compare_and_put(self, key, expect, value):
-        with self._lock:
+        with self._lock, self._flock():
+            self._reload_if_changed()
             ok = self._mem.compare_and_put(key, expect, value)
             if ok:
                 self._persist()

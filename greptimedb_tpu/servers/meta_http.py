@@ -47,8 +47,17 @@ def _make_handler(metasrv: Metasrv, kv: KvBackend):
 
         def do_GET(self):
             path = self.path.split("?")[0]
+            owner = self.server.owner  # type: ignore[attr-defined]
             if path == "/health":
-                return self._json(200, {"status": "ok"})
+                return self._json(200, {
+                    "status": "ok",
+                    "is_leader": owner.election.is_leader,
+                })
+            if path == "/leader":
+                leader, expires = owner.election.leader()
+                return self._json(200, {
+                    "leader": leader, "expires_at": expires,
+                })
             if path == "/routes":
                 return self._json(200, {
                     str(r): n for r, n in metasrv._all_routes().items()
@@ -124,7 +133,8 @@ class MetasrvServer:
 
     def __init__(self, *, addr: str = "127.0.0.1", port: int = 4010,
                  data_home: str | None = None,
-                 selector: str = "round_robin"):
+                 selector: str = "round_robin",
+                 election_lease_s: float = 5.0):
         self.kv: KvBackend = (
             FsKv(f"{data_home}/metasrv/kv.json") if data_home
             else MemoryKv()
@@ -132,6 +142,13 @@ class MetasrvServer:
         self.metasrv = Metasrv(self.kv, selector=selector)
         self.addr = addr
         self.port = port
+        # HA: candidates sharing a kv (same data_home) elect ONE leader
+        # (meta/election.py); only it drives failover ticks
+        from greptimedb_tpu.meta.election import Election
+
+        self.election = Election(
+            self.kv, f"{addr}:{port}", lease_s=election_lease_s
+        )
         self._srv: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._ticker = threading.Thread(
@@ -142,7 +159,8 @@ class MetasrvServer:
     def _tick_loop(self):
         while not self._stop.wait(1.0):
             try:
-                self.metasrv.tick()
+                if self.election.is_leader:
+                    self.metasrv.tick()
             except Exception:
                 pass
 
@@ -150,17 +168,21 @@ class MetasrvServer:
         self._srv = ThreadingHTTPServer(
             (self.addr, self.port), _make_handler(self.metasrv, self.kv)
         )
+        self._srv.owner = self  # type: ignore[attr-defined]
         self.port = self._srv.server_address[1]
+        self.election.me = f"{self.addr}:{self.port}"
         self._thread = threading.Thread(
             target=self._srv.serve_forever, daemon=True,
             name="metasrv-http",
         )
         self._thread.start()
+        self.election.start()
         self._ticker.start()
         return self
 
     def close(self):
         self._stop.set()
+        self.election.stop()
         if self._srv is not None:
             self._srv.shutdown()
             self._srv.server_close()

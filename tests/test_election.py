@@ -1,0 +1,105 @@
+"""Metasrv HA: lease election over the CAS kv (VERDICT missing #9)."""
+
+import time
+
+from greptimedb_tpu.meta.election import Election
+from greptimedb_tpu.meta.kv import FsKv, MemoryKv
+
+
+def test_single_candidate_wins_and_renews():
+    kv = MemoryKv()
+    e = Election(kv, "a", lease_s=1.0)
+    assert e.step(now=100.0)
+    assert e.is_leader
+    assert e.leader() == ("a", 101.0)
+    # renewal extends the lease
+    assert e.step(now=100.5)
+    assert e.leader() == ("a", 101.5)
+
+
+def test_second_candidate_waits_then_takes_over():
+    kv = MemoryKv()
+    a = Election(kv, "a", lease_s=1.0)
+    b = Election(kv, "b", lease_s=1.0)
+    assert a.step(now=100.0)
+    assert not b.step(now=100.1)      # lease held
+    assert not b.is_leader
+    # a stops renewing; past expiry b steals
+    assert b.step(now=101.5)
+    assert b.is_leader
+    # a's next renewal must FAIL (its bytes were replaced)
+    assert not a.step(now=101.6)
+    assert not a.is_leader
+
+
+def test_resign_hands_over_immediately():
+    kv = MemoryKv()
+    changes = []
+    a = Election(kv, "a", lease_s=30.0,
+                 on_change=lambda lead: changes.append(("a", lead)))
+    b = Election(kv, "b", lease_s=30.0,
+                 on_change=lambda lead: changes.append(("b", lead)))
+    assert a.step(now=100.0)
+    a.resign()
+    assert not a.is_leader
+    assert b.step(now=100.1)          # no 30s wait after resign
+    assert changes == [("a", True), ("a", False), ("b", True)]
+
+
+def test_no_split_brain_across_fskv_instances(tmp_path):
+    """Two FsKv views of ONE file (two processes in real life) must not
+    both win: CAS revalidates against the file under an OS lock."""
+    path = str(tmp_path / "kv.json")
+    a = Election(FsKv(path), "a", lease_s=30.0)
+    b = Election(FsKv(path), "b", lease_s=30.0)
+    assert a.step(now=100.0)
+    assert not b.step(now=100.1), "split brain: both candidates lead"
+    assert a.is_leader and not b.is_leader
+    # and the loser observes the true leader through its own view
+    assert b.leader()[0] == "a"
+
+
+def test_corrupt_leader_key_is_repaired():
+    kv = MemoryKv()
+    kv.put("__meta/election/leader", b"not-json")
+    e = Election(kv, "a", lease_s=1.0)
+    assert e.step(now=100.0), "corrupt key must be reclaimable"
+    assert e.leader()[0] == "a"
+
+
+def test_election_durable_across_kv_reload(tmp_path):
+    path = str(tmp_path / "kv.json")
+    kv1 = FsKv(path)
+    a = Election(kv1, "a", lease_s=30.0)
+    assert a.step(now=100.0)
+    # a different process view of the same kv sees the same leader
+    kv2 = FsKv(path)
+    b = Election(kv2, "b", lease_s=30.0)
+    assert not b.step(now=100.1)
+    assert b.leader()[0] == "a"
+
+
+def test_metasrv_server_election_and_failover():
+    from greptimedb_tpu.servers.meta_http import MetasrvServer
+
+    s1 = MetasrvServer(port=0, election_lease_s=0.6).start()
+    # same kv object BEFORE starting: two metasrvs share the backend
+    s2 = MetasrvServer(port=0, election_lease_s=0.6)
+    s2.kv = s1.kv
+    s2.election.kv = s1.kv
+    s2.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not s1.election.is_leader:
+            time.sleep(0.05)
+        assert s1.election.is_leader
+        assert not s2.election.is_leader
+        # leader dies; follower takes over within ~one lease
+        s1.election.stop(resign=True)
+        deadline = time.time() + 5
+        while time.time() < deadline and not s2.election.is_leader:
+            time.sleep(0.05)
+        assert s2.election.is_leader
+    finally:
+        s1.close()
+        s2.close()
