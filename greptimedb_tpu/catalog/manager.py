@@ -127,6 +127,30 @@ def region_options_from_table(options: dict) -> RegionOptions:
     return opts
 
 
+class _BrokenTable:
+    """Placeholder for a table that failed to open: keeps the metadata
+    alive while every data access raises the open error."""
+
+    def __init__(self, info, error: Exception):
+        self.info = info
+        self._error = error
+
+    @property
+    def name(self):
+        return self.info.name
+
+    @property
+    def schema(self):
+        return self.info.schema
+
+    def __getattr__(self, item):
+        from greptimedb_tpu.errors import IllegalStateError
+
+        raise IllegalStateError(
+            f"table {self.info.name!r} failed to open: {self._error}"
+        )
+
+
 class CatalogManager:
     def __init__(self, engine: TsdbEngine):
         self.engine = engine
@@ -157,11 +181,23 @@ class CatalogManager:
             # physical (mito) tables first: logical metric tables resolve
             # their shared physical table during open
             for info in sorted(infos, key=lambda i: i.engine == "metric"):
-                db[info.name] = self._open_table(info)
+                try:
+                    db[info.name] = self._open_table(info)
+                except Exception as e:  # noqa: BLE001 - startup isolation
+                    # one broken table (e.g. an external file that moved)
+                    # must not take down the rest of the catalog; keep a
+                    # placeholder so metadata persists and errors are
+                    # per-table
+                    import traceback
+
+                    traceback.print_exc()
+                    db[info.name] = _BrokenTable(info, e)
 
     def _persist(self):
         doc = {
             "next_table_id": self._next_table_id,
+            # placeholder tables keep their info, so brokenness is not
+            # silently dropped from the persisted catalog
             "databases": {
                 db: [t.info.to_json() for t in tables.values()]
                 for db, tables in self._databases.items()
@@ -173,6 +209,10 @@ class CatalogManager:
     def _open_table(self, info: TableInfo) -> Table:
         if info.engine == "metric":
             return self._open_metric_table(info)
+        if info.engine == "file":
+            from greptimedb_tpu.storage.file_engine import open_file_table
+
+            return open_file_table(self, info)
         regions = []
         opts = region_options_from_table(info.options)
         for rid in info.region_ids():

@@ -1,0 +1,175 @@
+"""File engine: immutable external tables over CSV / JSON / Parquet.
+
+Capability counterpart of the reference's file-engine
+(/root/reference/src/file-engine/src/engine.rs FileRegionEngine +
+src/file-engine/src/region.rs: read-only regions whose data lives in
+user-supplied files):
+
+  CREATE EXTERNAL TABLE t (... ts TIMESTAMP TIME INDEX ...)
+  WITH (location = '/path/data.csv', format = 'csv')
+
+TPU-first shape: the file is decoded ONCE at open (pyarrow readers),
+loaded into an in-memory region, and from there every normal query
+surface applies unchanged — including the device grid cache, which is
+ideal for immutable data (the entry never invalidates). Writes are
+rejected like the reference's read-only file regions.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from greptimedb_tpu.catalog.table import Table
+from greptimedb_tpu.errors import (
+    InvalidArgumentError,
+    UnsupportedError,
+)
+from greptimedb_tpu.storage.object_store import MemoryObjectStore
+from greptimedb_tpu.storage.region import Region, RegionMetadata
+
+
+class FileTable(Table):
+    """Read-only table over an external file."""
+
+    def write(self, *a, **k):
+        raise UnsupportedError(
+            f"table {self.name!r} uses the file engine and is read-only"
+        )
+
+    def truncate(self):
+        raise UnsupportedError(
+            f"table {self.name!r} uses the file engine and is read-only"
+        )
+
+
+def _read_file(location: str, fmt: str):
+    import pyarrow as pa
+
+    if not os.path.exists(location):
+        raise InvalidArgumentError(f"location not found: {location}")
+    if fmt == "csv":
+        from pyarrow import csv as pa_csv
+
+        return pa_csv.read_csv(location)
+    if fmt in ("json", "ndjson"):
+        from pyarrow import json as pa_json
+
+        return pa_json.read_json(location)
+    if fmt == "parquet":
+        from pyarrow import parquet as pq
+
+        return pq.read_table(location)
+    raise InvalidArgumentError(
+        f"unsupported file format {fmt!r} (csv, json, parquet)"
+    )
+
+
+def _column_arrays(table, schema):
+    """Arrow table -> (tag_cols, ts, field_cols, field_valid) matching
+    the declared schema; missing columns are all-NULL fields."""
+    import pyarrow as pa
+
+    n = table.num_rows
+    names = set(table.column_names)
+
+    def col(name):
+        if name not in names:
+            return None
+        arr = table.column(name)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        return arr
+
+    ts_name = schema.time_index.name
+    ts_arr = col(ts_name)
+    if ts_arr is None:
+        raise InvalidArgumentError(
+            f"file has no time-index column {ts_name!r}"
+        )
+    import pyarrow.types as pat
+
+    if pat.is_timestamp(ts_arr.type):
+        ts = np.asarray(
+            ts_arr.cast(pa.timestamp("ms")).to_numpy(
+                zero_copy_only=False
+            ).astype("datetime64[ms]").astype(np.int64)
+        )
+    elif pat.is_string(ts_arr.type) or pat.is_large_string(ts_arr.type):
+        from greptimedb_tpu.query.expr import parse_ts_literal
+
+        ts = np.asarray(
+            [parse_ts_literal(str(v)) for v in ts_arr.to_pylist()],
+            np.int64,
+        )
+    else:
+        ts = np.asarray(ts_arr.to_numpy(zero_copy_only=False), np.int64)
+
+    tags = {}
+    for c in schema.tag_columns:
+        arr = col(c.name)
+        if arr is None:
+            tags[c.name] = np.asarray([""] * n, object)
+        else:
+            tags[c.name] = np.asarray(
+                ["" if v is None else str(v) for v in arr.to_pylist()],
+                object,
+            )
+    fields = {}
+    valid = {}
+    for c in schema.field_columns:
+        arr = col(c.name)
+        if arr is None:
+            fields[c.name] = np.zeros(n, c.data_type.to_numpy())
+            valid[c.name] = np.zeros(n, bool)
+            continue
+        py = arr.to_pylist()
+        v = np.asarray([x is not None for x in py], bool)
+        if c.data_type.is_string():
+            vals = np.asarray(
+                ["" if x is None else str(x) for x in py], object
+            )
+        else:
+            np_t = c.data_type.to_numpy()
+            vals = np.zeros(n, np_t)
+            for i, x in enumerate(py):
+                if x is not None:
+                    vals[i] = x
+        fields[c.name] = vals
+        if not v.all():
+            valid[c.name] = v
+    return tags, ts, fields, valid
+
+
+def open_file_table(catalog, info) -> FileTable:
+    """Decode the external file into an in-memory region."""
+    location = info.options.get("location")
+    if not location:
+        raise InvalidArgumentError(
+            "file engine requires WITH (location = '...')"
+        )
+    fmt = str(info.options.get(
+        "format", os.path.splitext(location)[1].lstrip(".") or "csv"
+    )).lower()
+    schema = info.schema
+    arrow = _read_file(location, fmt)
+    tags, ts, fields, valid = _column_arrays(arrow, schema)
+
+    meta = RegionMetadata(
+        region_id=info.region_ids()[0],
+        table=info.name,
+        tag_names=[c.name for c in schema.tag_columns],
+        field_names=[c.name for c in schema.field_columns],
+        ts_name=schema.time_index.name,
+    )
+    wal_dir = os.path.join(
+        catalog.engine.config.data_root, ".file_engine",
+        f"region_{meta.region_id}",
+    )
+    region = Region(meta, MemoryObjectStore(), wal_dir)
+    if len(ts):
+        region.write(tags, ts, fields,
+                     field_valid=valid or None, skip_wal=True)
+    region.writable = False
+    return FileTable(info, [region])

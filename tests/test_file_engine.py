@@ -1,0 +1,118 @@
+"""File engine: CREATE EXTERNAL TABLE over CSV/JSON/Parquet
+(VERDICT missing #8)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from greptimedb_tpu.errors import UnsupportedError
+from greptimedb_tpu.instance import Standalone
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    s = Standalone(str(tmp_path / "data"))
+    yield s
+    s.close()
+
+
+def test_external_csv(inst, tmp_path):
+    p = tmp_path / "m.csv"
+    p.write_text(
+        "host,v,ts\n"
+        "a,1.5,1000\n"
+        "b,2.5,2000\n"
+        "a,3.5,3000\n"
+    )
+    inst.sql(
+        f"CREATE EXTERNAL TABLE ext (host STRING, v DOUBLE, "
+        f"ts TIMESTAMP TIME INDEX, PRIMARY KEY (host)) "
+        f"WITH (location = '{p}', format = 'csv')"
+    )
+    r = inst.sql("SELECT host, v FROM ext ORDER BY host, v")
+    assert [list(x) for x in r.rows()] == [
+        ["a", 1.5], ["a", 3.5], ["b", 2.5],
+    ]
+    # aggregates + RANGE work through the normal engine
+    r = inst.sql("SELECT host, sum(v) FROM ext GROUP BY host "
+                 "ORDER BY host")
+    assert [list(x) for x in r.rows()] == [["a", 5.0], ["b", 2.5]]
+    # read-only
+    with pytest.raises(UnsupportedError):
+        inst.sql("INSERT INTO ext (host, v, ts) VALUES ('c', 1.0, 1)")
+
+    # survives restart (file re-read at open)
+    inst2 = Standalone(str(inst.engine.config.data_root))
+    try:
+        r = inst2.sql("SELECT count(*) FROM ext")
+        assert int(r.rows()[0][0]) == 3
+    finally:
+        inst2.close()
+
+
+def test_external_parquet_and_json(inst, tmp_path):
+    pqp = tmp_path / "m.parquet"
+    pq.write_table(pa.table({
+        "host": ["x", "y"],
+        "v": [10.0, 20.0],
+        "ts": pa.array(np.asarray([1000, 2000], np.int64),
+                       pa.timestamp("ms")),
+    }), pqp)
+    inst.sql(
+        f"CREATE EXTERNAL TABLE extp (host STRING, v DOUBLE, "
+        f"ts TIMESTAMP TIME INDEX, PRIMARY KEY (host)) "
+        f"WITH (location = '{pqp}', format = 'parquet')"
+    )
+    r = inst.sql("SELECT host, v FROM extp ORDER BY host")
+    assert [list(x) for x in r.rows()] == [["x", 10.0], ["y", 20.0]]
+
+    jp = tmp_path / "m.json"
+    jp.write_text(
+        '{"host": "j1", "v": 5.0, "ts": 1000}\n'
+        '{"host": "j2", "ts": 2000}\n'   # missing v -> NULL
+    )
+    inst.sql(
+        f"CREATE EXTERNAL TABLE extj (host STRING, v DOUBLE, "
+        f"ts TIMESTAMP TIME INDEX, PRIMARY KEY (host)) "
+        f"WITH (location = '{jp}', format = 'json')"
+    )
+    r = inst.sql("SELECT host, v FROM extj ORDER BY host")
+    rows = [list(x) for x in r.rows()]
+    assert rows[0] == ["j1", 5.0]
+    assert rows[1][1] is None
+
+
+def test_missing_file_does_not_break_catalog(inst, tmp_path):
+    """A vanished external file must not take down the whole catalog at
+    restart: other tables stay queryable, the broken one errors."""
+    p = tmp_path / "gone.csv"
+    p.write_text("host,v,ts\na,1.0,1000\n")
+    inst.sql(
+        f"CREATE EXTERNAL TABLE willbreak (host STRING, v DOUBLE, "
+        f"ts TIMESTAMP TIME INDEX, PRIMARY KEY (host)) "
+        f"WITH (location = '{p}', format = 'csv')"
+    )
+    inst.sql("CREATE TABLE healthy (v DOUBLE, ts TIMESTAMP TIME INDEX)")
+    inst.sql("INSERT INTO healthy (v, ts) VALUES (1.0, 1)")
+    p.unlink()
+    inst2 = Standalone(str(inst.engine.config.data_root))
+    try:
+        r = inst2.sql("SELECT count(*) FROM healthy")
+        assert int(r.rows()[0][0]) == 1
+        from greptimedb_tpu.errors import GreptimeError
+
+        with pytest.raises(GreptimeError):
+            inst2.sql("SELECT * FROM willbreak")
+    finally:
+        inst2.close()
+
+
+def test_external_missing_location_rejected(inst):
+    from greptimedb_tpu.errors import InvalidArgumentError
+
+    with pytest.raises(InvalidArgumentError):
+        inst.sql(
+            "CREATE EXTERNAL TABLE bad (v DOUBLE, ts TIMESTAMP TIME "
+            "INDEX) WITH (format = 'csv')"
+        )
