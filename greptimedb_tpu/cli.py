@@ -164,7 +164,14 @@ def _flight_server(inst, opts, closers) -> None:
 def _make_instance(opts):
     from greptimedb_tpu.instance import Standalone
     from greptimedb_tpu.storage.engine import EngineConfig
+    from greptimedb_tpu.storage.object_store import (
+        object_store_from_options,
+    )
 
+    store = None
+    storage = opts.section("storage")
+    if str(storage.get("type", "fs")).lower() != "fs":
+        store = object_store_from_options(storage, opts.get("data_home"))
     inst = Standalone(
         engine_config=EngineConfig(
             data_root=opts.get("data_home"),
@@ -172,7 +179,9 @@ def _make_instance(opts):
             background_interval_s=opts.get(
                 "engine.background_interval_s", 5.0
             ),
-        )
+            wal_backend=opts.get("wal.backend", "fs"),
+        ),
+        store=store,
     )
     if opts.get("flow.enable", True):
         try:

@@ -27,8 +27,12 @@ DEFAULTS: dict = {
     "postgres": {"addr": "127.0.0.1:4003", "enable": True},
     "opentsdb": {"enable": True},
     "influxdb": {"enable": True},
-    "wal": {"sync": False},
-    "storage": {"type": "fs"},
+    "wal": {"sync": False, "backend": "fs"},
+    "storage": {
+        "type": "fs",            # fs | memory | s3
+        # s3: bucket/endpoint/access_key_id/secret_access_key/region/root
+        "cache_capacity_bytes": 0,
+    },
     "flow": {"enable": True, "tick_interval_s": 1.0},
     "engine": {
         "enable_background": True,

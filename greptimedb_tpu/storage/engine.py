@@ -31,6 +31,9 @@ class EngineConfig:
     # deployment shape (the reference's Kafka WAL,
     # src/log-store/src/kafka/), which makes region failover lossless.
     wal_root: str | None = None
+    # "fs" (node-local segment files) or "object" (ObjectStoreLogStore
+    # over the engine's object store — the remote-WAL topology)
+    wal_backend: str = "fs"
 
 
 class TsdbEngine:
@@ -71,7 +74,29 @@ class TsdbEngine:
             self.config.data_root, "wal"
         )
         wal_dir = os.path.join(wal_root, f"region_{meta.region_id}")
-        return Region(meta, self.store, wal_dir)
+        log_store = None
+        if self.config.wal_backend == "object":
+            # remote-WAL topology: the log rides the (possibly shared /
+            # S3) object store instead of node-local files. WAL objects
+            # are write-once/read-at-replay, so they bypass any local
+            # read cache rather than evict hot SST data from it.
+            from greptimedb_tpu.storage.object_store import (
+                CachedObjectStore,
+            )
+            from greptimedb_tpu.storage.wal import ObjectStoreLogStore
+
+            wal_store = (self.store.inner
+                         if isinstance(self.store, CachedObjectStore)
+                         else self.store)
+            log_store = ObjectStoreLogStore(
+                wal_store, f"wal/region_{meta.region_id}"
+            )
+        elif self.config.wal_backend != "fs":
+            raise ValueError(
+                f"unknown wal_backend {self.config.wal_backend!r} "
+                "(fs | object)"
+            )
+        return Region(meta, self.store, wal_dir, log_store=log_store)
 
     def close_region(self, region_id: int):
         with self._lock:

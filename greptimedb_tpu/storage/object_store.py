@@ -134,3 +134,331 @@ class MemoryObjectStore(ObjectStore):
 
     def local_path(self, path: str) -> str:
         raise NotImplementedError("memory store has no local paths")
+
+
+class S3ObjectStore(ObjectStore):
+    """S3-compatible backend over the REST API with AWS SigV4 signing
+    (counterpart of the reference's opendal S3 service,
+    /root/reference/src/object-store/src/lib.rs + datanode store config
+    src/datanode/src/config.rs S3Config). Works against AWS, MinIO, or
+    any list-type=2-capable endpoint; no SDK dependency — http.client
+    plus the published signing algorithm."""
+
+    def __init__(self, *, bucket: str, endpoint: str,
+                 access_key_id: str = "", secret_access_key: str = "",
+                 region: str = "us-east-1", root: str = ""):
+        import urllib.parse as _up
+
+        u = _up.urlparse(
+            endpoint if "://" in endpoint else "http://" + endpoint
+        )
+        self.secure = u.scheme == "https"
+        self.host = u.netloc
+        self.bucket = bucket
+        self.region = region
+        self.access_key = access_key_id
+        self.secret_key = secret_access_key
+        self.root = root.strip("/")
+
+    # ---- signing ------------------------------------------------------
+    def _sign(self, method: str, path: str, query: str,
+              payload_hash: str, amz_date: str) -> dict:
+        import hashlib
+        import hmac
+
+        datestamp = amz_date[:8]
+        headers = {
+            "host": self.host,
+            "x-amz-content-sha256": payload_hash,
+            "x-amz-date": amz_date,
+        }
+        signed = ";".join(sorted(headers))
+        canonical = "\n".join([
+            method, path, query,
+            "".join(f"{k}:{headers[k]}\n" for k in sorted(headers)),
+            signed, payload_hash,
+        ])
+        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        ])
+
+        def hm(key, msg):
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = hm(("AWS4" + self.secret_key).encode(), datestamp)
+        k = hm(k, self.region)
+        k = hm(k, "s3")
+        k = hm(k, "aws4_request")
+        sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+        headers["authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={signed}, Signature={sig}"
+        )
+        return headers
+
+    def _request(self, method: str, key: str = "", *, query: str = "",
+                 body: bytes = b"", range_hdr: str | None = None):
+        import hashlib
+        import http.client
+        import time as _time
+        import urllib.parse as _up
+
+        path = "/" + self.bucket
+        if key:
+            path += "/" + _up.quote(
+                (f"{self.root}/{key}" if self.root else key).lstrip("/"),
+                safe="/",
+            )
+        amz_date = _time.strftime("%Y%m%dT%H%M%SZ", _time.gmtime())
+        payload_hash = hashlib.sha256(body).hexdigest()
+        headers = self._sign(method, path, query, payload_hash, amz_date)
+        if range_hdr:
+            headers["range"] = range_hdr
+        conn_cls = (http.client.HTTPSConnection if self.secure
+                    else http.client.HTTPConnection)
+        conn = conn_cls(self.host, timeout=30)
+        try:
+            url = path + ("?" + query if query else "")
+            conn.request(method, url, body=body or None, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, data
+        finally:
+            conn.close()
+
+    # ---- ObjectStore surface ------------------------------------------
+    def read(self, path: str) -> bytes:
+        status, data = self._request("GET", path)
+        if status == 404:
+            raise FileNotFoundError(path)
+        if status >= 300:
+            raise IOError(f"s3 GET {path}: {status}")
+        return data
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        status, data = self._request(
+            "GET", path, range_hdr=f"bytes={offset}-{offset + length - 1}"
+        )
+        if status == 404:
+            raise FileNotFoundError(path)
+        if status >= 300:
+            raise IOError(f"s3 GET(range) {path}: {status}")
+        return data
+
+    def write(self, path: str, data: bytes) -> None:
+        status, _ = self._request("PUT", path, body=data)
+        if status >= 300:
+            raise IOError(f"s3 PUT {path}: {status}")
+
+    def delete(self, path: str) -> None:
+        status, _ = self._request("DELETE", path)
+        # 404 is success (already gone); other failures must surface or
+        # GC/obsoletion would silently leak objects
+        if status >= 300 and status != 404:
+            raise IOError(f"s3 DELETE {path}: {status}")
+
+    def exists(self, path: str) -> bool:
+        status, _ = self._request("HEAD", path)
+        if status < 300:
+            return True
+        if status == 404:
+            return False
+        # a transient 5xx/403 must NOT read as "absent": callers like
+        # the catalog would reinitialize over live data
+        raise IOError(f"s3 HEAD {path}: {status}")
+
+    def list(self, prefix: str) -> list[ObjectMeta]:
+        import urllib.parse as _up
+        import xml.etree.ElementTree as ET
+
+        full_prefix = (f"{self.root}/{prefix}" if self.root
+                       else prefix).lstrip("/")
+        out: list[ObjectMeta] = []
+        token = None
+        while True:
+            q = {"list-type": "2", "prefix": full_prefix}
+            if token:
+                q["continuation-token"] = token
+            query = "&".join(
+                f"{k}={_up.quote(str(v), safe='')}"
+                for k, v in sorted(q.items())
+            )
+            status, data = self._request("GET", "", query=query)
+            if status >= 300:
+                raise IOError(f"s3 LIST {prefix}: {status}")
+            ns = ""
+            root = ET.fromstring(data)
+            if root.tag.startswith("{"):
+                ns = root.tag.split("}")[0] + "}"
+            for c in root.findall(f"{ns}Contents"):
+                key = c.findtext(f"{ns}Key") or ""
+                size = int(c.findtext(f"{ns}Size") or 0)
+                rel = key[len(self.root):].lstrip("/") if self.root else key
+                out.append(ObjectMeta(rel, size))
+            token = root.findtext(f"{ns}NextContinuationToken")
+            if not token:
+                break
+        out.sort(key=lambda m: m.path)
+        return out
+
+    def local_path(self, path: str) -> str:
+        raise NotImplementedError("s3 store has no local paths")
+
+
+class CachedObjectStore(ObjectStore):
+    """LRU read cache + write-through layer over another store
+    (counterpart of the reference's object-store LRU read cache and
+    mito write cache, /root/reference/src/object-store/src/layers/
+    lru_cache.rs + src/mito2/src/cache/write_cache.rs:41): reads fill a
+    local directory bounded by max_bytes; writes land locally AND in the
+    backing store, so cold restarts hit the cache and remote reads are
+    skipped for hot objects."""
+
+    def __init__(self, inner: ObjectStore, cache_dir: str,
+                 max_bytes: int = 1024 * 1024 * 1024):
+        import collections
+
+        self.inner = inner
+        self.cache_dir = cache_dir
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._lru: "collections.OrderedDict[str, int]" = (
+            collections.OrderedDict()
+        )
+        self._bytes = 0
+        os.makedirs(cache_dir, exist_ok=True)
+        # recover the cache index from disk (files named by path hash);
+        # drop leftover .tmp files from interrupted writes
+        for f in os.listdir(cache_dir):
+            p = os.path.join(cache_dir, f)
+            if f.endswith(".tmp"):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+                continue
+            if os.path.isfile(p):
+                self._lru[f] = os.path.getsize(p)
+                self._bytes += self._lru[f]
+
+    def _key(self, path: str) -> str:
+        import hashlib
+
+        return hashlib.sha256(path.encode()).hexdigest()
+
+    def _cache_put(self, path: str, data: bytes):
+        key = self._key(path)
+        p = os.path.join(self.cache_dir, key)
+        with self._lock:
+            old = self._lru.pop(key, 0)
+            self._bytes -= old
+            if old and len(data) > self.max_bytes:
+                # an uncacheable update must also remove the stale file,
+                # or a restart re-index would serve the OLD content
+                try:
+                    os.remove(p)
+                except FileNotFoundError:
+                    pass
+            if len(data) <= self.max_bytes:
+                tmp = p + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, p)
+                self._lru[key] = len(data)
+                self._bytes += len(data)
+            while self._bytes > self.max_bytes and self._lru:
+                k, sz = self._lru.popitem(last=False)
+                self._bytes -= sz
+                try:
+                    os.remove(os.path.join(self.cache_dir, k))
+                except FileNotFoundError:
+                    pass
+
+    def _cache_get(self, path: str) -> bytes | None:
+        key = self._key(path)
+        with self._lock:
+            if key not in self._lru:
+                return None
+            self._lru.move_to_end(key)
+        try:
+            with open(os.path.join(self.cache_dir, key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            with self._lock:
+                self._bytes -= self._lru.pop(key, 0)
+            return None
+
+    def _cache_drop(self, path: str):
+        key = self._key(path)
+        with self._lock:
+            self._bytes -= self._lru.pop(key, 0)
+        try:
+            os.remove(os.path.join(self.cache_dir, key))
+        except FileNotFoundError:
+            pass
+
+    def read(self, path: str) -> bytes:
+        data = self._cache_get(path)
+        if data is not None:
+            return data
+        data = self.inner.read(path)
+        self._cache_put(path, data)
+        return data
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        data = self._cache_get(path)
+        if data is not None:
+            return data[offset:offset + length]
+        return self.inner.read_range(path, offset, length)
+
+    def write(self, path: str, data: bytes) -> None:
+        self.inner.write(path, data)
+        self._cache_put(path, data)
+
+    def delete(self, path: str) -> None:
+        self.inner.delete(path)
+        self._cache_drop(path)
+
+    def exists(self, path: str) -> bool:
+        key = self._key(path)
+        with self._lock:
+            if key in self._lru:
+                return True
+        return self.inner.exists(path)
+
+    def list(self, prefix: str) -> list[ObjectMeta]:
+        return self.inner.list(prefix)
+
+    def local_path(self, path: str) -> str:
+        return self.inner.local_path(path)
+
+
+def object_store_from_options(storage: dict, data_root: str) -> ObjectStore:
+    """Build the configured store ([storage] section of config.py):
+    type fs|memory|s3, optional cache_capacity_bytes wrapping it in the
+    local read/write cache."""
+    kind = str(storage.get("type", "fs")).lower()
+    if kind == "fs":
+        inner: ObjectStore = FsObjectStore(data_root)
+    elif kind == "memory":
+        inner = MemoryObjectStore()
+    elif kind == "s3":
+        inner = S3ObjectStore(
+            bucket=storage.get("bucket", ""),
+            endpoint=storage.get("endpoint", ""),
+            access_key_id=storage.get("access_key_id", ""),
+            secret_access_key=storage.get("secret_access_key", ""),
+            region=storage.get("region", "us-east-1"),
+            root=storage.get("root", ""),
+        )
+    else:
+        raise ValueError(f"unknown storage.type {kind!r}")
+    cap = int(storage.get("cache_capacity_bytes", 0) or 0)
+    if cap > 0 and kind != "fs":
+        inner = CachedObjectStore(
+            inner, os.path.join(data_root, ".object_cache"),
+            max_bytes=cap,
+        )
+    return inner

@@ -76,11 +76,16 @@ class Region:
         wal_dir: str,
         *,
         prefix: str | None = None,
+        log_store=None,
     ):
         self.meta = meta
         self.store = store
         self.prefix = prefix or f"data/region_{meta.region_id}"
-        self.wal = RegionWal(wal_dir, sync=meta.options.wal_sync)
+        # pluggable WAL backend: node-local segment files by default, or
+        # any LogStore (e.g. ObjectStoreLogStore for the remote-WAL
+        # topology) supplied by the engine
+        self.wal = (log_store if log_store is not None
+                    else RegionWal(wal_dir, sync=meta.options.wal_sync))
         self.manifest = RegionManifest(store, f"{self.prefix}/manifest")
         self.series = (
             SeriesRegistry.restore(self.manifest.state.series_snapshot)

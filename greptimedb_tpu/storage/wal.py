@@ -1,16 +1,24 @@
-"""Write-ahead log.
+"""Write-ahead log: pluggable LogStores.
 
-Capability counterpart of the reference's LogStore trait + RaftEngineLogStore
-(/root/reference/src/store-api/src/logstore.rs:51,
-/root/reference/src/log-store/src/raft_engine/log_store.rs): per-region
-appends with monotonically increasing entry ids, replay from an id, and
-obsoletion after flush. Implementation: per-region segment files of
-CRC-checked length-prefixed records, rotated by size; obsolete() unlinks
-whole segments below the flushed id.
+Capability counterpart of the reference's LogStore trait + its two
+implementations (/root/reference/src/store-api/src/logstore.rs:51;
+RaftEngineLogStore src/log-store/src/raft_engine/log_store.rs node-local,
+KafkaLogStore src/log-store/src/kafka/log_store.rs:45 remote/shared):
+per-region appends with monotonically increasing entry ids, replay from
+an id, and obsoletion after flush.
 
-A region's single-writer discipline (mito2 worker actors) means appends for
-one region never race; the lock here guards cross-region sharing of the
-same Wal object.
+Two LogStores here share the CRC-checked length-prefixed record framing:
+
+- RegionWal: node-local segment FILES rotated by size (raft-engine
+  analog); obsolete() unlinks whole segments below the flushed id.
+- ObjectStoreLogStore: record batches appended as immutable OBJECTS via
+  any ObjectStore (fs, memory, S3) — the remote-WAL deployment shape
+  (Kafka analog), which makes region failover lossless because a new
+  node can replay the lost node's log from shared storage.
+
+A region's single-writer discipline (mito2 worker actors) means appends
+for one region never race; the lock here guards cross-region sharing of
+the same Wal object.
 """
 
 from __future__ import annotations
@@ -31,7 +39,152 @@ class WalEntry:
     payload: bytes
 
 
-class RegionWal:
+class LogStore:
+    """The pluggable WAL interface every backend implements."""
+
+    def append(self, payload: bytes) -> int:
+        raise NotImplementedError
+
+    def append_batch(self, payloads: list[bytes]) -> int:
+        raise NotImplementedError
+
+    def replay(self, from_id: int = 0) -> list[WalEntry]:
+        raise NotImplementedError
+
+    def obsolete(self, up_to_id: int) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def next_entry_id(self) -> int:
+        raise NotImplementedError
+
+
+def _encode_records(entries: list[tuple[int, bytes]]) -> bytes:
+    parts = []
+    for eid, payload in entries:
+        parts.append(_HEADER.pack(_MAGIC, eid, len(payload),
+                                  zlib.crc32(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def _scan_records(data: bytes, from_id: int) -> tuple[list[WalEntry], int]:
+    """Decode CRC-framed records until corruption/torn tail; returns the
+    entries >= from_id and the offset where valid data ends. The ONE
+    framing decoder both LogStores share."""
+    out: list[WalEntry] = []
+    off = 0
+    n = len(data)
+    while off + _HEADER.size <= n:
+        magic, eid, ln, crc = _HEADER.unpack_from(data, off)
+        if magic != _MAGIC or off + _HEADER.size + ln > n:
+            break
+        payload = data[off + _HEADER.size: off + _HEADER.size + ln]
+        if zlib.crc32(payload) != crc:
+            break
+        if eid >= from_id:
+            out.append(WalEntry(eid, payload))
+        off += _HEADER.size + ln
+    return out, off
+
+
+def _decode_records(data: bytes, from_id: int) -> list[WalEntry]:
+    return _scan_records(data, from_id)[0]
+
+
+class ObjectStoreLogStore(LogStore):
+    """Remote WAL over an ObjectStore: each append(-batch) writes ONE
+    immutable object named {first}_{last}.wseg, so durability is the
+    store's atomic write and replay is a prefix listing. With an S3
+    store this is the shared-WAL topology (reference Kafka WAL)."""
+
+    def __init__(self, store, prefix: str):
+        self.store = store
+        self.prefix = prefix.rstrip("/") + "/"
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._recover_next_id()
+
+    def _objects(self) -> list[str]:
+        return [m.path for m in self.store.list(self.prefix)
+                if m.path.endswith(".wseg")]
+
+    @staticmethod
+    def _ids_of(path: str) -> tuple[int, int]:
+        base = path.rsplit("/", 1)[-1][:-5]
+        first, last = base.split("_")
+        return int(first), int(last)
+
+    def _recover_next_id(self):
+        last = -1
+        for p in self._objects():
+            try:
+                last = max(last, self._ids_of(p)[1])
+            except ValueError:
+                continue
+        self._next_id = last + 1
+
+    def append(self, payload: bytes) -> int:
+        return self.append_batch([payload])
+
+    def append_batch(self, payloads: list[bytes]) -> int:
+        if not payloads:
+            return self._next_id - 1
+        with self._lock:
+            first = self._next_id
+            entries = []
+            for p in payloads:
+                entries.append((self._next_id, p))
+                self._next_id += 1
+            last = self._next_id - 1
+            self.store.write(
+                f"{self.prefix}{first:016d}_{last:016d}.wseg",
+                _encode_records(entries),
+            )
+            return last
+
+    def replay(self, from_id: int = 0) -> list[WalEntry]:
+        with self._lock:
+            out: list[WalEntry] = []
+            for p in sorted(self._objects()):
+                try:
+                    _, last = self._ids_of(p)
+                except ValueError:
+                    continue
+                if last < from_id:
+                    continue
+                out.extend(_decode_records(self.store.read(p), from_id))
+            return out
+
+    def obsolete(self, up_to_id: int) -> None:
+        with self._lock:
+            objs = []
+            for p in self._objects():
+                try:
+                    objs.append((self._ids_of(p)[1], p))
+                except ValueError:
+                    continue
+            if not objs:
+                return
+            # NEVER delete the tail segment (same rule as RegionWal):
+            # it carries the highest entry id, which _recover_next_id
+            # needs after a restart — deleting it would reset ids to 0
+            # below the manifest's flushed id and make every subsequent
+            # append unreplayable
+            tail = max(objs)[1]
+            for last, p in objs:
+                if p is not tail and last <= up_to_id:
+                    self.store.delete(p)
+
+    @property
+    def next_entry_id(self) -> int:
+        return self._next_id
+
+
+class RegionWal(LogStore):
     """WAL for one region: a directory of segment files named by their first
     entry id."""
 
@@ -54,9 +207,7 @@ class RegionWal:
             eid = self._next_id
             self._next_id += 1
             fh = self._active_file(eid)
-            crc = zlib.crc32(payload)
-            fh.write(_HEADER.pack(_MAGIC, eid, len(payload), crc))
-            fh.write(payload)
+            fh.write(_encode_records([(eid, payload)]))
             fh.flush()
             if self.sync:
                 os.fsync(fh.fileno())
@@ -70,9 +221,7 @@ class RegionWal:
                 eid = self._next_id
                 self._next_id += 1
                 fh = self._active_file(eid)
-                crc = zlib.crc32(payload)
-                fh.write(_HEADER.pack(_MAGIC, eid, len(payload), crc))
-                fh.write(payload)
+                fh.write(_encode_records([(eid, payload)]))
             if fh is not None:
                 fh.flush()
                 if self.sync:
@@ -108,22 +257,9 @@ class RegionWal:
     def _scan_segment(self, path: str, from_id: int):
         """Returns (entries, valid_end_offset) — the offset where the first
         torn/corrupt record starts (== file size when intact)."""
-        out: list[WalEntry] = []
         with open(path, "rb") as f:
             data = f.read()
-        off = 0
-        n = len(data)
-        while off + _HEADER.size <= n:
-            magic, eid, ln, crc = _HEADER.unpack_from(data, off)
-            if magic != _MAGIC or off + _HEADER.size + ln > n:
-                break  # torn tail
-            payload = data[off + _HEADER.size: off + _HEADER.size + ln]
-            if zlib.crc32(payload) != crc:
-                break
-            if eid >= from_id:
-                out.append(WalEntry(eid, payload))
-            off += _HEADER.size + ln
-        return out, off
+        return _scan_records(data, from_id)
 
     # ---- maintenance --------------------------------------------------
     def obsolete(self, up_to_id: int) -> None:

@@ -1,0 +1,218 @@
+"""S3-compatible object store + cache layers + pluggable remote WAL
+(VERDICT missing #6).
+
+The mini-S3 server below speaks the real REST surface the store uses
+(GET/PUT/DELETE/HEAD, ListObjectsV2 XML, Range) and asserts every
+request carries a SigV4 authorization header — the same wire shape a
+MinIO/AWS endpoint expects.
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.storage.engine import EngineConfig
+from greptimedb_tpu.storage.object_store import (
+    CachedObjectStore,
+    MemoryObjectStore,
+    S3ObjectStore,
+)
+from greptimedb_tpu.storage.wal import ObjectStoreLogStore
+
+
+class _MiniS3(BaseHTTPRequestHandler):
+    store: dict
+    requests_seen: list
+
+    def log_message(self, *a):
+        pass
+
+    def _key(self):
+        # /bucket/key...
+        path = self.path.split("?")[0]
+        parts = path.lstrip("/").split("/", 1)
+        return parts[1] if len(parts) > 1 else ""
+
+    def _check_auth(self):
+        auth = self.headers.get("Authorization", "")
+        assert auth.startswith("AWS4-HMAC-SHA256"), "missing sigv4"
+        type(self).requests_seen.append(self.command)
+
+    def do_PUT(self):
+        self._check_auth()
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        type(self).store[self._key()] = self.rfile.read(n)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        self._check_auth()
+        if "list-type=2" in self.path:
+            import urllib.parse as up
+
+            q = up.parse_qs(up.urlparse(self.path).query)
+            prefix = q.get("prefix", [""])[0]
+            keys = sorted(k for k in type(self).store if
+                          k.startswith(prefix))
+            body = (
+                "<?xml version=\"1.0\"?><ListBucketResult>"
+                + "".join(
+                    f"<Contents><Key>{k}</Key>"
+                    f"<Size>{len(type(self).store[k])}</Size></Contents>"
+                    for k in keys
+                )
+                + "</ListBucketResult>"
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        data = type(self).store.get(self._key())
+        if data is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        rng = self.headers.get("Range")
+        if rng:
+            lo, hi = rng.removeprefix("bytes=").split("-")
+            data = data[int(lo):int(hi) + 1]
+        self.send_response(206 if rng else 200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_HEAD(self):
+        self._check_auth()
+        ok = self._key() in type(self).store
+        self.send_response(200 if ok else 404)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        self._check_auth()
+        type(self).store.pop(self._key(), None)
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+@pytest.fixture()
+def mini_s3():
+    handler = type("H", (_MiniS3,), {"store": {}, "requests_seen": []})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1], handler
+    srv.shutdown()
+    srv.server_close()
+
+
+def _s3(port):
+    return S3ObjectStore(
+        bucket="test", endpoint=f"127.0.0.1:{port}",
+        access_key_id="ak", secret_access_key="sk",
+    )
+
+
+def test_s3_store_roundtrip(mini_s3):
+    port, handler = mini_s3
+    s3 = _s3(port)
+    s3.write("a/b.txt", b"hello world")
+    assert s3.read("a/b.txt") == b"hello world"
+    assert s3.read_range("a/b.txt", 6, 5) == b"world"
+    assert s3.exists("a/b.txt") and not s3.exists("a/nope")
+    s3.write("a/c.txt", b"x")
+    assert [m.path for m in s3.list("a/")] == ["a/b.txt", "a/c.txt"]
+    assert s3.list("a/")[0].size == 11
+    s3.delete("a/b.txt")
+    with pytest.raises(FileNotFoundError):
+        s3.read("a/b.txt")
+    assert "PUT" in handler.requests_seen   # sigv4 asserted per request
+
+
+def test_cached_store_hits_and_evicts(tmp_path, mini_s3):
+    port, handler = mini_s3
+    cached = CachedObjectStore(_s3(port), str(tmp_path / "cache"),
+                               max_bytes=100)
+    cached.write("k1", b"a" * 60)
+    handler.requests_seen.clear()
+    assert cached.read("k1") == b"a" * 60
+    assert handler.requests_seen == []       # served from cache
+    cached.write("k2", b"b" * 60)            # evicts k1 (100-byte cap)
+    handler.requests_seen.clear()
+    assert cached.read("k1") == b"a" * 60    # refetched from s3
+    assert "GET" in handler.requests_seen
+    # read_range served from cached copy
+    handler.requests_seen.clear()
+    assert cached.read_range("k1", 0, 5) == b"aaaaa"
+    assert handler.requests_seen == []
+    # delete drops both layers
+    cached.delete("k1")
+    assert not cached.exists("k1")
+
+
+def test_object_store_log_store(tmp_path):
+    store = MemoryObjectStore()
+    ls = ObjectStoreLogStore(store, "wal/region_1")
+    assert ls.append(b"one") == 0
+    assert ls.append_batch([b"two", b"three"]) == 2
+    got = [e.payload for e in ls.replay(0)]
+    assert got == [b"one", b"two", b"three"]
+    assert [e.entry_id for e in ls.replay(1)] == [1, 2]
+    # a second instance over the same store resumes ids (failover shape)
+    ls2 = ObjectStoreLogStore(store, "wal/region_1")
+    assert ls2.next_entry_id == 3
+    ls2.obsolete(0)
+    assert [e.payload for e in ls2.replay(0)] == [b"two", b"three"]
+    # obsoleting EVERYTHING keeps the tail segment so a restart still
+    # recovers the id sequence (ids below the flushed mark would
+    # otherwise make post-restart appends unreplayable)
+    ls2.obsolete(2)
+    ls3 = ObjectStoreLogStore(store, "wal/region_1")
+    assert ls3.next_entry_id == 3
+    assert ls3.append(b"four") == 3
+    assert [e.entry_id for e in ls3.replay(3)] == [3]
+
+
+def test_cached_store_no_stale_file_after_uncacheable_update(tmp_path,
+                                                             mini_s3):
+    port, _ = mini_s3
+    cdir = str(tmp_path / "cache")
+    cached = CachedObjectStore(_s3(port), cdir, max_bytes=100)
+    cached.write("k", b"old")
+    cached.write("k", b"x" * 200)     # exceeds cache cap: uncacheable
+    # a NEW cache instance over the same dir must not resurrect "old"
+    cached2 = CachedObjectStore(_s3(port), cdir, max_bytes=100)
+    assert cached2.read("k") == b"x" * 200
+
+
+def test_engine_on_s3_with_remote_wal(tmp_path, mini_s3):
+    """Full engine over the S3 store with the object-store WAL: ingest
+    with durability, reopen from the same bucket, data survives."""
+    port, _ = mini_s3
+    cfg = EngineConfig(data_root=str(tmp_path / "d1"),
+                       enable_background=False, wal_backend="object")
+    inst = Standalone(engine_config=cfg, store=_s3(port),
+                      warm_start=False)
+    inst.sql("CREATE TABLE s3t (host STRING, v DOUBLE, ts TIMESTAMP "
+             "TIME INDEX, PRIMARY KEY (host))")
+    inst.sql("INSERT INTO s3t (host, v, ts) VALUES ('a', 1.5, 1000), "
+             "('b', 2.5, 2000)")
+    inst.close()
+
+    # a DIFFERENT node (fresh data_root) opens the same bucket: catalog,
+    # WAL and data all come from shared storage
+    cfg2 = EngineConfig(data_root=str(tmp_path / "d2"),
+                        enable_background=False, wal_backend="object")
+    inst2 = Standalone(engine_config=cfg2, store=_s3(port),
+                       warm_start=False)
+    try:
+        r = inst2.sql("SELECT host, v FROM s3t ORDER BY host")
+        assert [list(x) for x in r.rows()] == [["a", 1.5], ["b", 2.5]]
+    finally:
+        inst2.close()
