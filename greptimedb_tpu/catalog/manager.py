@@ -223,6 +223,10 @@ class CatalogManager:
                 field_names=[c.name for c in info.schema.field_columns],
                 ts_name=info.schema.time_index.name,
                 options=opts,
+                fulltext_fields=[
+                    c.name for c in info.schema.field_columns
+                    if getattr(c, "fulltext", False)
+                ],
             )
             regions.append(self.engine.open_region(meta))
         return Table(info, regions)

@@ -167,6 +167,7 @@ class Table:
         ts_max: int | None = None,
         field_names: list[str] | None = None,
         matchers: list[tuple[str, str, object]] | None = None,
+        fulltext: list | None = None,
     ) -> TableScanData:
         """Fan out to regions, prune series by tag matchers, merge into one
         table-level sid space. Rows stay per-series time-sorted (series are
@@ -180,7 +181,8 @@ class Table:
                 if len(sids) == 0:
                     return TableScanData(None, region.series, names)
             res = region.scan(ts_min=ts_min, ts_max=ts_max,
-                              field_names=names, sids=sids)
+                              field_names=names, sids=sids,
+                              fulltext=fulltext)
             return TableScanData(res.rows, res.registry, names)
 
         from greptimedb_tpu.query import stats
@@ -204,7 +206,8 @@ class Table:
                 if len(sids) == 0:
                     continue
             res = region.scan(ts_min=ts_min, ts_max=ts_max,
-                              field_names=names, sids=sids)
+                              field_names=names, sids=sids,
+                              fulltext=fulltext)
             if res.rows is None or len(res.rows) == 0:
                 continue
             # region sid -> table sid: intern every region series once

@@ -61,13 +61,14 @@ class LogicalTable(Table):
         )
 
     def scan(self, *, ts_min=None, ts_max=None, field_names=None,
-             matchers=None) -> TableScanData:
+             matchers=None, fulltext=None) -> TableScanData:
         m = list(matchers) if matchers else []
         m.append((TABLE_ID_TAG, "eq", self._tid))
         names = (field_names if field_names is not None
                  else self.field_names)
         return self.physical.scan(
             ts_min=ts_min, ts_max=ts_max, field_names=names, matchers=m,
+            fulltext=fulltext,
         )
 
     def flush(self):

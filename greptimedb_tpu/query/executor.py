@@ -291,11 +291,20 @@ class QueryEngine:
         from greptimedb_tpu.query import stats
 
         with stats.timed("scan_ms"):
+            ft = None
+            if getattr(plan.scan, "fulltext", None):
+                from greptimedb_tpu.query.fulltext import required_terms
+
+                ft = [
+                    (col, terms) for col, q in plan.scan.fulltext
+                    if (terms := required_terms(q))
+                ] or None
             data = table.scan(
                 ts_min=plan.scan.ts_min,
                 ts_max=plan.scan.ts_max,
                 field_names=field_names,
                 matchers=plan.scan.matchers or None,
+                fulltext=ft,
             )
         stats.add("rows_scanned", data.num_rows)
         stats.add("series_total", data.registry.num_series)

@@ -15,7 +15,9 @@ import numpy as np
 
 from greptimedb_tpu.errors import InvalidArgumentError
 
-_TOKEN_RE = re.compile(r'"[^"]*"|\(|\)|\S+')
+# parens tokenize on their own even when glued to a word: "net)" must
+# yield ["net", ")"], not one token
+_TOKEN_RE = re.compile(r'"[^"]*"|\(|\)|[^\s()"]+')
 _WORD_RE = re.compile(r"[a-z0-9_]+")
 
 
@@ -141,3 +143,39 @@ def eval_matches(values: np.ndarray, query: str) -> np.ndarray:
         text = str(v).lower()
         out[i] = node.eval(_tokenize_text(text), text)
     return out
+
+
+def required_terms(query: str) -> frozenset[str]:
+    """Terms that MUST appear for the query to match — the index-pruning
+    contract: a row group whose term index lacks any of these cannot
+    contain a matching row. AND unions children; OR intersects (only a
+    term needed on every branch is required); NOT requires nothing."""
+    try:
+        node = _parse_query(query)
+    except InvalidArgumentError:
+        return frozenset()
+    return frozenset(_required(node))
+
+
+def _required(node: _Node) -> set[str]:
+    if isinstance(node, _Term):
+        return {node.term} if _WORD_RE.fullmatch(node.term) else set()
+    if isinstance(node, _Phrase):
+        # phrase matching is a raw SUBSTRING test, so the phrase's edge
+        # words may match mid-token ('"network err"' matches
+        # "network error"); only INTERIOR words — bounded by non-word
+        # chars inside the phrase itself — are guaranteed whole tokens
+        p = node.phrase
+        return {
+            m.group(0) for m in _WORD_RE.finditer(p)
+            if m.start() > 0 and m.end() < len(p)
+        }
+    if isinstance(node, _Bin):
+        parts = [_required(n) for n in node.nodes]
+        if node.op == "and":
+            return set().union(*parts)
+        out = parts[0]
+        for p in parts[1:]:
+            out &= p
+        return out
+    return set()

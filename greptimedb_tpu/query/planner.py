@@ -36,6 +36,11 @@ class ScanSpec:
     ts_max: int | None = None
     matchers: list = dc_field(default_factory=list)
     residual: A.Expr | None = None
+    # (column, query) pairs from top-level matches() conjuncts — used
+    # for flush-time fulltext row-group pruning; rows are STILL filtered
+    # exactly by the residual, this only skips row groups that cannot
+    # contain a match
+    fulltext: list = dc_field(default_factory=list)
 
 
 @dataclass
@@ -179,6 +184,13 @@ def analyze_where(
             continue
         if _absorb_matcher(c, tag_names, spec):
             continue
+        if (isinstance(c, A.FuncCall) and c.name == "matches"
+                and len(c.args) == 2
+                and isinstance(c.args[0], A.Column)
+                and isinstance(c.args[1], A.Literal)):
+            # stays in the residual for exact row filtering; recorded
+            # for index pruning too
+            spec.fulltext.append((c.args[0].name, str(c.args[1].value)))
         residual.append(c)
     if residual:
         e = residual[0]

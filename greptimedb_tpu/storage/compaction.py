@@ -14,7 +14,7 @@ from collections import defaultdict
 
 from greptimedb_tpu.storage.memtable import _concat_rows
 from greptimedb_tpu.storage.region import Region, dedup_rows
-from greptimedb_tpu.storage.sst import read_sst, write_sst
+from greptimedb_tpu.storage.sst import (read_sst, write_sst, sidecar_path)
 
 
 def pick_compaction(region: Region) -> list | None:
@@ -58,12 +58,15 @@ def compact_once(region: Region) -> bool:
                           drop_deletes=False)
     file_id = uuid.uuid4().hex
     new_path = f"{region.prefix}/sst/{file_id}.parquet"
-    new_meta = write_sst(region.store, new_path, file_id, rows, level=1)
+    new_meta = write_sst(region.store, new_path, file_id, rows, level=1,
+                         fulltext_fields=region.meta.fulltext_fields)
     with region._lock:
         live = {m.file_id for m in region.manifest.state.ssts}
         if not all(m.file_id in live for m in files):
             # lost a race with truncate/another compaction: abort
             region.store.delete(new_path)
+            if new_meta.fulltext:
+                region.store.delete(sidecar_path(new_path))
             return False
         region.manifest.commit({
             "kind": "compact",
@@ -72,4 +75,6 @@ def compact_once(region: Region) -> bool:
         })
     for m in files:
         region.store.delete(m.path)
+        if m.fulltext:
+            region.store.delete(sidecar_path(m.path))
     return True

@@ -28,7 +28,12 @@ from greptimedb_tpu.storage.memtable import (
 )
 from greptimedb_tpu.storage.object_store import ObjectStore
 from greptimedb_tpu.storage.series import SeriesRegistry
-from greptimedb_tpu.storage.sst import SstMeta, read_sst, write_sst
+from greptimedb_tpu.storage.sst import (
+    SstMeta,
+    read_sst,
+    sidecar_path,
+    write_sst,
+)
 from greptimedb_tpu.storage.wal import RegionWal
 
 
@@ -53,6 +58,8 @@ class RegionMetadata:
     field_names: list[str]
     ts_name: str
     options: RegionOptions = field(default_factory=RegionOptions)
+    # columns with flush-time fulltext term indexes (puffin sidecars)
+    fulltext_fields: list = field(default_factory=list)
 
 
 @dataclass
@@ -296,7 +303,8 @@ class Region:
         rows = frozen.scan()
         file_id = uuid.uuid4().hex
         meta = write_sst(
-            self.store, f"{self.prefix}/sst/{file_id}.parquet", file_id, rows
+            self.store, f"{self.prefix}/sst/{file_id}.parquet", file_id,
+            rows, fulltext_fields=self.meta.fulltext_fields,
         )
         with self._lock:
             self.manifest.commit({
@@ -324,6 +332,7 @@ class Region:
         field_names: list[str] | None = None,
         sids: np.ndarray | None = None,
         raw: bool = False,
+        fulltext: list | None = None,
     ) -> ScanResult:
         """Merged + deduped scan. Output rows sorted by (sid, ts)."""
         if self.meta.options.ttl_ms is not None and ts_min is None:
@@ -336,9 +345,16 @@ class Region:
         with self._lock:
             ssts = list(self.manifest.state.ssts)
             tables = [self.memtable] + list(self._frozen)
+        # fulltext row-group pruning is VALUE-based: under last-write-
+        # wins dedup, skipping a group that holds a newer overwrite or
+        # tombstone would resurrect the shadowed row. Append-mode
+        # regions (the log-table shape fulltext serves) have no dedup,
+        # so pruning is sound there; everywhere else the residual
+        # filter alone does the matching.
+        ft = fulltext if self.meta.options.append_mode else None
         for meta in ssts:
             r = read_sst(self.store, meta, ts_min=ts_min, ts_max=ts_max,
-                         field_names=names, sids=sids)
+                         field_names=names, sids=sids, fulltext=ft)
             if r is not None:
                 chunks.append(r)
         for mt in tables:
@@ -377,6 +393,8 @@ class Region:
             self._frozen.clear()
             for s in self.manifest.state.ssts:
                 self.store.delete(s.path)
+                if s.fulltext:
+                    self.store.delete(sidecar_path(s.path))
             self.manifest.commit({
                 "kind": "truncate",
                 "truncated_entry_id": entry_id,

@@ -46,6 +46,8 @@ class SstMeta:
     sid_max: int
     size_bytes: int
     level: int = 0
+    # a <path>.puffin sidecar with flush-time fulltext term indexes
+    fulltext: bool = False
 
     def to_json(self) -> dict:
         return self.__dict__.copy()
@@ -100,6 +102,77 @@ def _load_sid_index(pf) -> tuple[np.ndarray, np.ndarray] | None:
     return cols["offsets"], cols["sids"]
 
 
+def _build_fulltext_sidecar(rows: ColumnarRows, fulltext_fields,
+                            row_group_rows: int) -> bytes | None:
+    """Flush-time fulltext index: per fulltext-flagged column a
+    term -> row-group map (the tantivy-index analog,
+    /root/reference/src/index/src/fulltext_index/create.rs, at
+    row-group granularity to match this engine's pruning unit), shipped
+    in a puffin sidecar next to the SST."""
+    import json as _json
+    import zlib as _zlib
+
+    from greptimedb_tpu.query.fulltext import _WORD_RE
+    from greptimedb_tpu.storage.puffin import PuffinWriter
+
+    w = PuffinWriter()
+    any_blob = False
+    n = len(rows)
+    for col in fulltext_fields or ():
+        vals = rows.fields.get(col)
+        if vals is None:
+            continue
+        valid = (rows.field_valid or {}).get(col)
+        term_groups: dict[str, set] = {}
+        for i in range(n):
+            if valid is not None and not valid[i]:
+                continue
+            g = i // row_group_rows
+            for t in _WORD_RE.findall(str(vals[i]).lower()):
+                term_groups.setdefault(t, set()).add(g)
+        doc = {t: sorted(gs) for t, gs in term_groups.items()}
+        w.add_blob(
+            FULLTEXT_BLOB, _zlib.compress(_json.dumps(doc).encode()),
+            {"column": col},
+        )
+        any_blob = True
+    return w.finish() if any_blob else None
+
+
+FULLTEXT_BLOB = "greptime-fulltext-index-v1"
+
+
+def sidecar_path(path: str) -> str:
+    return path + ".puffin"
+
+
+def _fulltext_allowed_groups(store, meta, fulltext) -> set | None:
+    """Row groups that can satisfy EVERY (column, required-terms)
+    constraint; None -> no constraint applies; empty set -> whole SST
+    prunable."""
+    import json as _json
+    import zlib as _zlib
+
+    from greptimedb_tpu.storage.puffin import PuffinReader
+
+    try:
+        reader = PuffinReader(store.read(sidecar_path(meta.path)))
+    except (FileNotFoundError, ValueError):
+        return None
+    allowed: set | None = None
+    for col, terms in fulltext:
+        blob = reader.find(FULLTEXT_BLOB, column=col)
+        if blob is None:
+            continue   # column unindexed in this SST: no pruning
+        index = _json.loads(_zlib.decompress(reader.read(blob)))
+        for t in terms:
+            groups = set(index.get(t, ()))
+            allowed = groups if allowed is None else (allowed & groups)
+            if not allowed:
+                return set()
+    return allowed
+
+
 def write_sst(
     store: ObjectStore,
     path: str,
@@ -108,6 +181,7 @@ def write_sst(
     *,
     row_group_rows: int = 256 * 1024,
     level: int = 0,
+    fulltext_fields: list | None = None,
 ) -> SstMeta:
     """Write sorted rows as one Parquet object; returns its metadata."""
     rows = sort_rows(rows)
@@ -135,6 +209,10 @@ def write_sst(
     )
     data = buf.getvalue()
     store.write(path, data)
+    sidecar = _build_fulltext_sidecar(rows, fulltext_fields,
+                                      row_group_rows)
+    if sidecar is not None:
+        store.write(sidecar_path(path), sidecar)
     return SstMeta(
         file_id=file_id,
         path=path,
@@ -143,6 +221,7 @@ def write_sst(
         ts_max=int(rows.ts.max()) if len(rows) else 0,
         sid_max=int(rows.sid.max()) if len(rows) else -1,
         size_bytes=len(data),
+        fulltext=sidecar is not None,
         level=level,
     )
 
@@ -155,13 +234,23 @@ def read_sst(
     ts_max: int | None = None,
     field_names: list[str] | None = None,
     sids: np.ndarray | None = None,
+    fulltext: list | None = None,
 ) -> ColumnarRows | None:
-    """Read an SST with row-group pruning by __ts stats, then row-filter to
-    the exact range (and optional sid set)."""
+    """Read an SST with row-group pruning by __ts stats, the sid index
+    and the fulltext sidecar, then row-filter to the exact range (and
+    optional sid set)."""
     if ts_min is not None and meta.ts_max < ts_min:
         return None
     if ts_max is not None and meta.ts_min > ts_max:
         return None
+    ft_allowed = None
+    if fulltext and meta.fulltext:
+        ft_allowed = _fulltext_allowed_groups(store, meta, fulltext)
+        if ft_allowed is not None and not ft_allowed:
+            from greptimedb_tpu.query import stats as _stats
+
+            _stats.add("ssts_pruned_fulltext", 1)
+            return None
     data = store.read(meta.path)
     pf = pq.ParquetFile(io.BytesIO(data))
     md = pf.metadata
@@ -179,7 +268,11 @@ def read_sst(
     sid_index = _load_sid_index(pf) if sids is not None else None
     sids_sorted = np.sort(sids) if sids is not None else None
     groups = []
+    ft_pruned = 0
     for g in range(md.num_row_groups):
+        if ft_allowed is not None and g not in ft_allowed:
+            ft_pruned += 1
+            continue
         st = md.row_group(g).column(ts_idx).statistics
         if st is not None and st.has_min_max:
             if ts_min is not None and st.max < ts_min:
@@ -205,6 +298,8 @@ def read_sst(
         groups.append(g)
     stats.add("row_groups_total", md.num_row_groups)
     stats.add("row_groups_read", len(groups))
+    if ft_pruned:
+        stats.add("row_groups_pruned_fulltext", ft_pruned)
     if not groups:
         return None
     table = pf.read_row_groups(groups, columns=cols)
