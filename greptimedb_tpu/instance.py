@@ -157,6 +157,13 @@ class Standalone:
     # ------------------------------------------------------------------
     def execute_statement(self, stmt: A.Statement, ctx: QueryContext
                           ) -> Output:
+        from greptimedb_tpu.telemetry import tracing
+
+        with tracing.span(f"sql.{type(stmt).__name__}"):
+            return self._execute_statement(stmt, ctx)
+
+    def _execute_statement(self, stmt: A.Statement, ctx: QueryContext
+                           ) -> Output:
         if isinstance(stmt, A.Select):
             return Output.records(self._select(stmt, ctx))
         if isinstance(stmt, A.SetOp):

@@ -299,13 +299,16 @@ class QueryEngine:
                     (col, terms) for col, q in plan.scan.fulltext
                     if (terms := required_terms(q))
                 ] or None
-            data = table.scan(
-                ts_min=plan.scan.ts_min,
-                ts_max=plan.scan.ts_max,
-                field_names=field_names,
-                matchers=plan.scan.matchers or None,
-                fulltext=ft,
-            )
+            from greptimedb_tpu.telemetry import tracing
+
+            with tracing.span("query.scan", table=table.name):
+                data = table.scan(
+                    ts_min=plan.scan.ts_min,
+                    ts_max=plan.scan.ts_max,
+                    field_names=field_names,
+                    matchers=plan.scan.matchers or None,
+                    fulltext=ft,
+                )
         stats.add("rows_scanned", data.num_rows)
         stats.add("series_total", data.registry.num_series)
         if stats.active() is not None and plan.scan.matchers:

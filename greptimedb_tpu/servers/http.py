@@ -133,7 +133,7 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             "/v1/promql", "/v1/prometheus/api/v1/", "/v1/prometheus/write",
             "/v1/prometheus/read", "/v1/influxdb/", "/influxdb/",
             "/v1/events", "/v1/opentsdb/api/put", "/api/put",
-            "/v1/otlp/v1/metrics",
+            "/v1/otlp/v1/metrics", "/v1/traces", "/v1/traces/",
         )
 
         def _raw_path(self) -> str:
@@ -198,9 +198,25 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
         def do_POST(self):
             self._dispatch("POST")
 
+        _UNTRACED = ("/health", "/ready", "/-/healthy", "/-/ready",
+                     "/metrics", "/v1/traces")
+
         def _dispatch(self, method: str):
+            from greptimedb_tpu.telemetry import tracing
+
             path = self._raw_path()
             t0 = time.perf_counter()
+            if path in self._UNTRACED or path.startswith("/v1/traces/"):
+                # probe/scrape noise would churn real query traces out
+                # of the bounded ring
+                return self._dispatch_traced(method, path, t0)
+            with tracing.start_remote(
+                self.headers.get("traceparent"),
+                f"http {self._route()}", method=method,
+            ):
+                self._dispatch_traced(method, path, t0)
+
+        def _dispatch_traced(self, method: str, path: str, t0: float):
             try:
                 if user_provider is not None and path not in (
                     "/health", "/ready", "/-/healthy", "/-/ready",
@@ -259,6 +275,18 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 return self._send(
                     200, global_registry.render().encode(),
                     "text/plain; version=0.0.4",
+                )
+            if path == "/v1/traces" or path.startswith("/v1/traces/"):
+                from greptimedb_tpu.telemetry.tracing import global_traces
+
+                if path.startswith("/v1/traces/"):
+                    tid = path.rsplit("/", 1)[-1]
+                    return self._json(200, {
+                        "trace_id": tid,
+                        "spans": global_traces.trace(tid),
+                    })
+                return self._json(
+                    200, {"traces": global_traces.traces()}
                 )
             if path == "/v1/sql":
                 return self._handle_sql()

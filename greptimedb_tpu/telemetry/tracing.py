@@ -1,0 +1,162 @@
+"""Distributed-tracing spans.
+
+Capability counterpart of the reference's tracing stack
+(/root/reference/src/common/telemetry/src/logging.rs:22-67 tracing
+subscriber + OTLP export, src/common/telemetry/src/tracing_context.rs
+W3C context propagation): timed spans carrying a trace id, parent links
+via a context var (so nested spans form a tree across threads when the
+context is passed), inbound `traceparent` header parsing, and an
+in-memory ring of finished traces served by the HTTP API (/v1/traces)
+for inspection without an external collector.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+
+_current_span: contextvars.ContextVar["Span | None"] = (
+    contextvars.ContextVar("gtpu_span", default=None)
+)
+
+_MAX_TRACES = 256
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    name: str
+    start_ms: float
+    end_ms: float | None = None
+    attributes: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_ms": round(self.start_ms, 3),
+            "duration_ms": (
+                None if self.end_ms is None
+                else round(self.end_ms - self.start_ms, 3)
+            ),
+            # copied: a reader may serialize while __exit__ mutates
+            "attributes": dict(self.attributes),
+        }
+
+
+class _TraceStore:
+    """Bounded ring of finished traces (newest kept)."""
+
+    def __init__(self, cap: int = _MAX_TRACES):
+        self._lock = threading.Lock()
+        self._spans: dict[str, list[Span]] = {}
+        self._order: list[str] = []
+        self.cap = cap
+
+    # a client/proxy bug resending one traceparent forever must not
+    # grow a single trace unboundedly
+    MAX_SPANS_PER_TRACE = 512
+
+    def record(self, span: Span):
+        with self._lock:
+            if span.trace_id not in self._spans:
+                self._spans[span.trace_id] = []
+                self._order.append(span.trace_id)
+                while len(self._order) > self.cap:
+                    victim = self._order.pop(0)
+                    self._spans.pop(victim, None)
+            spans = self._spans[span.trace_id]
+            if len(spans) < self.MAX_SPANS_PER_TRACE:
+                spans.append(span)
+
+    def traces(self, limit: int = 50) -> list[dict]:
+        with self._lock:
+            out = []
+            for tid in reversed(self._order[-limit:]):
+                spans = self._spans.get(tid, [])
+                out.append({
+                    "trace_id": tid,
+                    "spans": [s.to_json() for s in spans],
+                })
+            return out
+
+    def trace(self, trace_id: str) -> list[dict]:
+        with self._lock:
+            return [s.to_json() for s in self._spans.get(trace_id, [])]
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+            self._order.clear()
+
+
+global_traces = _TraceStore()
+
+
+def _new_id(nbytes: int) -> str:
+    return secrets.token_hex(nbytes)
+
+
+class span:
+    """Context manager: `with tracing.span("query.plan", sql=...)`.
+    Nests under the current span; starts a new trace at the root."""
+
+    def __init__(self, name: str, _parent: Span | None = None,
+                 **attributes):
+        self.name = name
+        self.attributes = attributes
+        self._parent = _parent
+        self._span: Span | None = None
+        self._token = None
+
+    def __enter__(self) -> Span:
+        parent = (self._parent if self._parent is not None
+                  else _current_span.get())
+        self._span = Span(
+            trace_id=(parent.trace_id if parent else _new_id(16)),
+            span_id=_new_id(8),
+            parent_id=parent.span_id if parent else None,
+            name=self.name,
+            start_ms=time.time() * 1000.0,
+            attributes=dict(self.attributes),
+        )
+        self._token = _current_span.set(self._span)
+        # recorded at START: /v1/traces shows in-flight spans (duration
+        # null) and a span is never missing just because its exit races
+        # a reader; __exit__ finalizes the same object in place
+        global_traces.record(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        sp = self._span
+        sp.end_ms = time.time() * 1000.0
+        if exc is not None:
+            sp.attributes["error"] = f"{type(exc).__name__}: {exc}"
+        _current_span.reset(self._token)
+        return False
+
+
+def current_trace_id() -> str | None:
+    sp = _current_span.get()
+    return sp.trace_id if sp else None
+
+
+def start_remote(traceparent: str | None, name: str, **attributes):
+    """Span continuing a W3C `traceparent: 00-<trace>-<parent>-<flags>`
+    header when present; a fresh root otherwise."""
+    parent = None
+    if traceparent:
+        parts = traceparent.strip().split("-")
+        if len(parts) == 4 and len(parts[1]) == 32 and len(parts[2]) == 16:
+            parent = Span(
+                trace_id=parts[1], span_id=parts[2], parent_id=None,
+                name="remote-parent", start_ms=0.0,
+            )
+    return span(name, _parent=parent, **attributes)
