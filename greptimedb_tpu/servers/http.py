@@ -296,7 +296,8 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 path.startswith("/v1/prometheus/")
                 or path.startswith(("/v1/influxdb/", "/influxdb/"))
                 or path in ("/v1/opentsdb/api/put", "/opentsdb/api/put",
-                            "/api/put", "/v1/otlp/v1/metrics")
+                            "/api/put")
+                or path.startswith("/v1/otlp/")
             )
             if _local_only and not hasattr(instance, "_write_columns"):
                 # frontend-role (remote) instances forward SQL only; the
@@ -321,6 +322,8 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 return self._handle_opentsdb_put()
             if path == "/v1/otlp/v1/metrics":
                 return self._handle_otlp_metrics()
+            if path in ("/v1/otlp/v1/traces", "/v1/otlp/v1/logs"):
+                return self._handle_otlp_records(path.rsplit("/", 1)[-1])
             if path == "/v1/events/pipelines" or path.startswith(
                 "/v1/events"
             ):
@@ -559,6 +562,31 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 return self._json(400, {"error": str(e)})
             _INGEST_ROWS.labels("otlp").inc(rows)
             # ExportMetricsServiceResponse: empty message
+            self._send(200, b"", "application/x-protobuf")
+
+        def _handle_otlp_records(self, kind: str):
+            from greptimedb_tpu.servers import otlp
+
+            db = self.headers.get("X-Greptime-DB-Name", "public")
+            try:
+                if kind == "traces":
+                    table = self.headers.get(
+                        "X-Greptime-Trace-Table-Name",
+                        otlp.TRACE_TABLE_NAME,
+                    )
+                    rows = otlp.write_traces_protobuf(
+                        instance, self._body(), db=db, table=table
+                    )
+                else:
+                    table = self.headers.get(
+                        "X-Greptime-Log-Table-Name", otlp.LOG_TABLE_NAME
+                    )
+                    rows = otlp.write_logs_protobuf(
+                        instance, self._body(), db=db, table=table
+                    )
+            except Exception as e:  # noqa: BLE001 - protocol boundary
+                return self._json(400, {"error": str(e)})
+            _INGEST_ROWS.labels(f"otlp_{kind}").inc(rows)
             self._send(200, b"", "application/x-protobuf")
 
         def _handle_events(self, method: str, path: str):

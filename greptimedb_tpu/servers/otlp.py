@@ -381,3 +381,206 @@ def write_metrics(instance, body: bytes, content_type: str,
     if "json" in (content_type or ""):
         return write_json(instance, body, db)
     return write_protobuf(instance, body, db)
+
+
+# ----------------------------------------------------------------------
+# OTLP traces + logs
+# ----------------------------------------------------------------------
+
+TRACE_TABLE_NAME = "traces_preview_v01"   # reference trace.rs:26
+LOG_TABLE_NAME = "opentelemetry_logs"
+
+
+def _hex(b: bytes) -> str:
+    return b.hex()
+
+
+def _ensure_record_table(instance, db: str, name: str,
+                         field_specs: list[tuple[str, "ConcreteDataType"]]):
+    """Auto-create an append-mode table (records at equal (tag, ts) must
+    all survive — no last-write-wins dedup for traces/logs)."""
+    return influx.ensure_table(
+        instance, db, name, ["service_name"], dict(field_specs),
+        ts_name=GREPTIME_TS, options={"append_mode": "true"},
+    )
+
+
+_TRACE_FIELDS = [
+    ("trace_id", "string"), ("span_id", "string"),
+    ("parent_span_id", "string"), ("span_name", "string"),
+    ("span_kind", "string"), ("span_status_code", "string"),
+    ("span_status_message", "string"), ("duration_nano", "float64"),
+    ("span_attributes", "string"), ("resource_attributes", "string"),
+    ("scope_name", "string"),
+]
+_LOG_FIELDS = [
+    ("severity_text", "string"), ("severity_number", "float64"),
+    ("body", "string"), ("log_attributes", "string"),
+    ("resource_attributes", "string"), ("scope_name", "string"),
+]
+
+
+def _write_records(instance, db: str, name: str, specs, records) -> int:
+    """records: list of dicts with ts_ms + service_name + spec fields."""
+    import numpy as np
+
+    if not records:
+        return 0
+    field_specs = [
+        (fname, ConcreteDataType.string() if t == "string"
+         else ConcreteDataType.float64())
+        for fname, t in specs
+    ]
+    table = _ensure_record_table(instance, db, name, field_specs)
+    n = len(records)
+    ts = np.asarray([r["ts_ms"] for r in records], np.int64)
+    tags = {"service_name": np.asarray(
+        [r.get("service_name", "") for r in records], object
+    )}
+    fields = {}
+    for fname, t in specs:
+        if t == "string":
+            fields[fname] = np.asarray(
+                [str(r.get(fname, "")) for r in records], object
+            )
+        else:
+            fields[fname] = np.asarray(
+                [float(r.get(fname, 0.0)) for r in records], np.float64
+            )
+    table.write(tags, ts, fields)
+    data = {table.ts_name: ts, **tags, **fields}
+    instance._notify_flows(db, name, table, data, {})
+    return n
+
+
+def _decode_status(buf: bytes) -> tuple[str, str]:
+    code = 0
+    msg = ""
+    for fno, wt, v in _fields(buf):
+        if fno == 2:
+            msg = v.decode("utf-8", "replace")
+        elif fno == 3:
+            code = v
+    names = {0: "STATUS_CODE_UNSET", 1: "STATUS_CODE_OK",
+             2: "STATUS_CODE_ERROR"}
+    return names.get(code, str(code)), msg
+
+
+_SPAN_KINDS = {0: "SPAN_KIND_UNSPECIFIED", 1: "SPAN_KIND_INTERNAL",
+               2: "SPAN_KIND_SERVER", 3: "SPAN_KIND_CLIENT",
+               4: "SPAN_KIND_PRODUCER", 5: "SPAN_KIND_CONSUMER"}
+
+
+def _decode_span(buf: bytes, res_attrs: dict, scope_name: str) -> dict:
+    import json as _json
+
+    out = {"service_name": res_attrs.get("service_name", ""),
+           "scope_name": scope_name,
+           "resource_attributes": _json.dumps(res_attrs)}
+    attrs_raw = []
+    start = end = 0
+    for fno, wt, v in _fields(buf):
+        if fno == 1:
+            out["trace_id"] = _hex(v)
+        elif fno == 2:
+            out["span_id"] = _hex(v)
+        elif fno == 4:
+            out["parent_span_id"] = _hex(v)
+        elif fno == 5:
+            out["span_name"] = v.decode("utf-8", "replace")
+        elif fno == 6:
+            out["span_kind"] = _SPAN_KINDS.get(int(v), str(v))
+        elif fno == 7:
+            start = _u64(v, wt)
+        elif fno == 8:
+            end = _u64(v, wt)
+        elif fno == 9:
+            attrs_raw.append(v)
+        elif fno == 15:
+            code, msg = _decode_status(v)
+            out["span_status_code"] = code
+            out["span_status_message"] = msg
+    out["ts_ms"] = start // 1_000_000
+    out["duration_nano"] = float(max(end - start, 0))
+    out["span_attributes"] = _json.dumps(_decode_attrs(attrs_raw))
+    return out
+
+
+def _walk_resource_scopes(body: bytes):
+    """Yield (res_attrs, scope_name, record_buf) over the shared
+    Export*ServiceRequest shape: resource_*(1) -> {resource(1){attrs(1)},
+    scope_*(2) -> {scope(1){name(1)}, records(2)}}."""
+    for fno, _, rs in _fields(body):
+        if fno != 1:
+            continue
+        res_attrs: dict = {}
+        scopes = []
+        for f2, _, v in _fields(rs):
+            if f2 == 1:
+                res_attrs = _decode_attrs(
+                    [a for f3, _, a in _fields(v) if f3 == 1]
+                )
+            elif f2 == 2:
+                scopes.append(v)
+        for ss in scopes:
+            scope_name = ""
+            recs = []
+            for f3, _, v in _fields(ss):
+                if f3 == 1:
+                    for f4, _, sv in _fields(v):
+                        if f4 == 1:
+                            scope_name = sv.decode("utf-8", "replace")
+                elif f3 == 2:
+                    recs.append(v)
+            for r in recs:
+                yield res_attrs, scope_name, r
+
+
+def write_traces_protobuf(instance, body: bytes, db: str = "public",
+                          table: str = TRACE_TABLE_NAME) -> int:
+    """ExportTraceServiceRequest (reference mapping: trace/span.rs
+    parse_span — hex ids, kind/status names, ns duration)."""
+    records = [
+        _decode_span(sp, res_attrs, scope_name)
+        for res_attrs, scope_name, sp in _walk_resource_scopes(body)
+    ]
+    return _write_records(instance, db, table, _TRACE_FIELDS, records)
+
+
+def _decode_log_record(buf: bytes, res_attrs: dict,
+                       scope_name: str) -> dict:
+    import json as _json
+
+    out = {"service_name": res_attrs.get("service_name", ""),
+           "scope_name": scope_name,
+           "resource_attributes": _json.dumps(res_attrs),
+           "ts_ms": 0}
+    attrs_raw = []
+    observed = 0
+    for fno, wt, v in _fields(buf):
+        if fno == 1:
+            out["ts_ms"] = _u64(v, wt) // 1_000_000
+        elif fno == 11:
+            observed = _u64(v, wt) // 1_000_000
+        elif fno == 2:
+            out["severity_number"] = float(v)
+        elif fno == 3:
+            out["severity_text"] = v.decode("utf-8", "replace")
+        elif fno == 5:
+            out["body"] = _decode_any_value(v)
+        elif fno == 6:
+            attrs_raw.append(v)
+    if not out["ts_ms"]:
+        out["ts_ms"] = observed
+    out["log_attributes"] = _json.dumps(_decode_attrs(attrs_raw))
+    return out
+
+
+def write_logs_protobuf(instance, body: bytes, db: str = "public",
+                        table: str = LOG_TABLE_NAME) -> int:
+    """ExportLogsServiceRequest (reference logs.rs mapping)."""
+    records = [
+        _decode_log_record(r, res_attrs, scope_name)
+        for res_attrs, scope_name, r in _walk_resource_scopes(body)
+    ]
+    return _write_records(instance, db, table, _LOG_FIELDS, records)

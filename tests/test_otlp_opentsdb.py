@@ -215,3 +215,69 @@ def test_opentsdb_put(inst, http):
         raise AssertionError("expected 400")
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def _span_pb(trace_id, span_id, name, start_ns, end_ns, attrs=None):
+    p = _ld(1, trace_id) + _ld(2, span_id) + _ld(5, name.encode())
+    p += _tag(6, 0) + _varint(2)  # SPAN_KIND_SERVER
+    p += _tag(7, 1) + struct.pack("<Q", start_ns)
+    p += _tag(8, 1) + struct.pack("<Q", end_ns)
+    for k, v in (attrs or {}).items():
+        p += _ld(9, _kv(k, v))
+    p += _ld(15, _ld(2, b"boom") + (_tag(3, 0) + _varint(2)))  # ERROR
+    return p
+
+
+def test_otlp_traces(inst, http):
+    span = _span_pb(b"\xab" * 16, b"\xcd" * 8, "GET /api",
+                    T0 * 1_000_000, (T0 + 25) * 1_000_000,
+                    {"http.method": "GET"})
+    scope_spans = _ld(1, _ld(1, b"my-lib")) + _ld(2, span)
+    resource = _ld(1, _kv("service.name", "checkout"))
+    body = _ld(1, _ld(1, resource) + _ld(2, scope_spans))
+    resp = _post(http.port, "/v1/otlp/v1/traces", body,
+                 "application/x-protobuf")
+    assert resp.status == 200
+    r = inst.sql(
+        "SELECT service_name, trace_id, span_name, span_kind, "
+        "span_status_code, duration_nano, greptime_timestamp "
+        "FROM traces_preview_v01"
+    )
+    row = list(r.rows()[0])
+    assert row[0] == "checkout"
+    assert row[1] == "ab" * 16
+    assert row[2] == "GET /api" and row[3] == "SPAN_KIND_SERVER"
+    assert row[4] == "STATUS_CODE_ERROR"
+    assert float(row[5]) == 25_000_000.0
+    assert int(row[6]) == T0
+    # append-mode: a second identical-ts span must NOT dedup away
+    resp = _post(http.port, "/v1/otlp/v1/traces", body,
+                 "application/x-protobuf")
+    r = inst.sql("SELECT count(*) FROM traces_preview_v01")
+    assert int(r.rows()[0][0]) == 2
+
+
+def test_otlp_logs(inst, http):
+    rec = _tag(1, 1) + struct.pack("<Q", T0 * 1_000_000)
+    rec += _tag(2, 0) + _varint(17)            # SEVERITY_NUMBER_ERROR
+    rec += _ld(3, b"ERROR")
+    rec += _ld(5, _ld(1, b"disk on fire"))     # body AnyValue string
+    rec += _ld(6, _kv("k8s.pod", "web-1"))
+    scope_logs = _ld(1, _ld(1, b"applog")) + _ld(2, rec)
+    resource = _ld(1, _kv("service.name", "api"))
+    body = _ld(1, _ld(1, resource) + _ld(2, scope_logs))
+    resp = _post(http.port, "/v1/otlp/v1/logs", body,
+                 "application/x-protobuf")
+    assert resp.status == 200
+    r = inst.sql(
+        "SELECT service_name, severity_text, body, greptime_timestamp "
+        "FROM opentelemetry_logs"
+    )
+    row = list(r.rows()[0])
+    assert row == ["api", "ERROR", "disk on fire", T0]
+    r = inst.sql("SELECT log_attributes FROM opentelemetry_logs")
+    assert "web-1" in r.rows()[0][0]
+    # fulltext-style filtering works over the body
+    r = inst.sql("SELECT count(*) FROM opentelemetry_logs "
+                 "WHERE matches(body, 'disk AND fire')")
+    assert int(r.rows()[0][0]) == 1
