@@ -59,7 +59,9 @@ def parse_line(line: str):
     cur = []
     while i < n:
         c = line[i]
-        if c == "\\" and i + 1 < n and not depth_quote:
+        if c == "\\" and i + 1 < n:
+            # escape pairs survive INSIDE quotes too: \" must not close
+            # a string field value
             cur.append(c)
             cur.append(line[i + 1])
             i += 2
@@ -102,7 +104,7 @@ def parse_line(line: str):
         kv = token.split("=", 1)
         if len(kv) != 2:
             raise LineProtocolError(f"bad field {token!r} in {line!r}")
-        fields[kv[0]] = _parse_field_value(kv[1])
+        fields[_unescape(kv[0])] = _parse_field_value(kv[1])
     if not fields:
         raise LineProtocolError(f"no fields in {line!r}")
     return measurement, tags, fields, ts_raw
@@ -137,6 +139,22 @@ def _split_field_pairs(s: str):
     return out
 
 
+def _unescape(s: str) -> str:
+    """Collapse backslash pairs: '\\x' -> 'x'."""
+    if "\\" not in s:
+        return s
+    out = []
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            out.append(s[i + 1])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
 def _parse_field_value(v: str):
     if v.startswith('"') and v.endswith('"') and len(v) >= 2:
         return v[1:-1].replace('\\"', '"').replace("\\\\", "\\")
@@ -145,8 +163,15 @@ def _parse_field_value(v: str):
         return True
     if low in ("f", "false"):
         return False
+    # '_' digit grouping is a Python-ism, not line protocol: reject it
+    # so native and fallback agree on what malformed data looks like
+    if "_" in v:
+        raise LineProtocolError(f"bad field value {v!r}")
     if v.endswith("i") or v.endswith("u"):
-        return int(v[:-1])
+        try:
+            return int(v[:-1])
+        except ValueError:
+            raise LineProtocolError(f"bad field value {v!r}") from None
     try:
         return float(v)
     except ValueError:
@@ -163,6 +188,38 @@ def _field_type(v) -> ConcreteDataType:
     return ConcreteDataType.string()
 
 
+# native tokenizer (greptimedb_tpu/native/lineproto.c, built by `make -C
+# greptimedb_tpu/native`); the pure-Python parser below is the always-
+# available fallback AND the behavioral spec the C version mirrors
+try:
+    from greptimedb_tpu.native import _lineproto as _native_lineproto
+except ImportError:   # pragma: no cover - build-artifact dependent
+    _native_lineproto = None
+
+
+def parse_payload(body: str) -> list:
+    """[(measurement, tags, fields, ts_raw|None)] for a whole payload."""
+    if _native_lineproto is not None:
+        try:
+            return _native_lineproto.parse_payload(body)
+        except ValueError as e:
+            raise LineProtocolError(str(e)) from None
+    out = []
+    # split on \n only (matching the native tokenizer); stray \r is
+    # stripped with the other edge whitespace
+    for raw in body.split("\n"):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            out.append(parse_line(line))
+        except LineProtocolError:
+            raise
+        except ValueError as e:
+            raise LineProtocolError(f"{e}: {line!r}") from None
+    return out
+
+
 def write_lines(instance, body: str, *, db: str = "public",
                 precision: str = "ns") -> int:
     """Parse a line-protocol payload and write it, auto-creating/widening
@@ -174,11 +231,7 @@ def write_lines(instance, body: str, *, db: str = "public",
 
     # batch rows per measurement
     per_table: dict[str, list] = defaultdict(list)
-    for raw in body.splitlines():
-        line = raw.strip()
-        if not line or line.startswith("#"):
-            continue
-        m, tags, fields, ts_raw = parse_line(line)
+    for m, tags, fields, ts_raw in parse_payload(body):
         ts = now_ms if ts_raw is None else int(int(ts_raw) * scale)
         per_table[m].append((tags, fields, ts))
 

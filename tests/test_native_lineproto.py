@@ -1,0 +1,110 @@
+"""Native line-protocol tokenizer parity (greptimedb_tpu/native).
+
+The pure-Python parser is the behavioral spec; the C extension must
+produce identical structures on every case, including the escape and
+quoting corners.
+"""
+
+import time
+
+import pytest
+
+from greptimedb_tpu.servers import influx
+
+native = pytest.importorskip("greptimedb_tpu.native._lineproto")
+
+
+CASES = [
+    "cpu,host=a,region=us usage=1.5 1000",
+    "cpu usage=1.5",                                 # no tags, no ts
+    'm,tag\\,x=va\\=l field=2i 5',                   # escaped , and =
+    'm f1=1.5,f2=2i,f3=t,f4=F,f5="hi there" 7',      # all value types
+    'weird\\ name,t=v f="a\\"b\\\\c" 9',             # escaped space+quote
+    'm f="comma, inside" 1',
+    "m value=-42i 2",
+    "m value=1e-3 3",
+    "  m spaced=1 4  ",                              # surrounding space
+    "# comment line\nm a=1 5\n\nm b=2 6",            # comments + blanks
+    'm,empty= f=1 8',                                # empty tag value
+]
+
+BAD = [
+    "justonemeasurement",
+    "m novalue 1",
+    "m f=notanumber 1",
+]
+
+
+def _python_parse(payload):
+    out = []
+    for raw in payload.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        out.append(influx.parse_line(line))
+    return out
+
+
+@pytest.mark.parametrize("payload", CASES)
+def test_native_matches_python(payload):
+    assert native.parse_payload(payload) == _python_parse(payload)
+
+
+@pytest.mark.parametrize("payload", BAD)
+def test_native_rejects_like_python(payload):
+    with pytest.raises(ValueError):
+        native.parse_payload(payload)
+    with pytest.raises(Exception):
+        _python_parse(payload)
+
+
+def test_value_types_exact():
+    (m, tags, fields, ts), = native.parse_payload(
+        'm f1=1.5,f2=2i,f3=t,f4="x"'
+    )
+    assert isinstance(fields["f1"], float)
+    assert isinstance(fields["f2"], int) and not isinstance(
+        fields["f2"], bool
+    )
+    assert fields["f3"] is True
+    assert fields["f4"] == "x"
+    assert ts is None
+
+
+def test_native_is_faster():
+    lines = "\n".join(
+        f"cpu,host=h{i % 100},dc=dc{i % 5} "
+        f"usage_user={i % 97}.5,usage_system={i % 13}i {i * 1000}"
+        for i in range(20_000)
+    )
+    def best_of(fn, k=3):
+        best = float("inf")
+        out = None
+        for _ in range(k):
+            t0 = time.perf_counter()
+            out = fn()
+            best = min(best, time.perf_counter() - t0)
+        return out, best
+
+    a, t_native = best_of(lambda: native.parse_payload(lines))
+    b, t_python = best_of(lambda: _python_parse(lines))
+    assert a == b
+    # the native tokenizer must actually pay for itself (min-of-3 to
+    # tolerate scheduler noise on shared runners)
+    assert t_native * 1.2 < t_python, (t_native, t_python)
+
+
+def test_ingest_path_uses_native(tmp_path):
+    from greptimedb_tpu.instance import Standalone
+
+    inst = Standalone(str(tmp_path / "d"), warm_start=False)
+    try:
+        n = influx.write_lines(
+            inst, "lp,host=a v=1.5 1000000\nlp,host=b v=2.5 2000000",
+            db="public", precision="us",
+        )
+        assert n == 2
+        r = inst.sql("SELECT host, v FROM lp ORDER BY host")
+        assert [list(x) for x in r.rows()] == [["a", 1.5], ["b", 2.5]]
+    finally:
+        inst.close()
