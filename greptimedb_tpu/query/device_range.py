@@ -631,8 +631,8 @@ def _snap_open(region, path):
     import json as _json
 
     try:
-        lp = region.store.local_path(path)
-    except NotImplementedError:
+        lp = region.store.local_read_path(path)
+    except (NotImplementedError, FileNotFoundError, OSError):
         raw = region.store.read(path)
         if raw[:len(_SNAP_MAGIC)] != _SNAP_MAGIC:
             raise ValueError("bad snapshot magic")
@@ -768,7 +768,10 @@ def force_resident(entry: _Entry) -> None:
 
     @jax.jit
     def touch(*xs):
-        return sum(x[0, 0].astype(jnp.float32) for x in xs)
+        # FULL-array reductions: every element of every grid must be
+        # materialized on device (an x[0,0] probe could let a lazy
+        # attachment ship only the touched tiles)
+        return sum(x.sum().astype(jnp.float32) for x in xs)
 
     # float() is a real synchronization point (device->host readback)
     float(touch(*arrs))

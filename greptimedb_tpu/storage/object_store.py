@@ -40,6 +40,12 @@ class ObjectStore:
     def local_path(self, path: str) -> str:
         raise NotImplementedError
 
+    def local_read_path(self, path: str) -> str:
+        """A local file holding this object's bytes, for zero-copy READS
+        (mmap). Unlike local_path, implementations may serve a cached
+        copy; writing through it is NOT meaningful."""
+        return self.local_path(path)
+
 
 class FsObjectStore(ObjectStore):
     def __init__(self, root: str):
@@ -433,6 +439,17 @@ class CachedObjectStore(ObjectStore):
 
     def local_path(self, path: str) -> str:
         return self.inner.local_path(path)
+
+    def local_read_path(self, path: str) -> str:
+        """Serve reads from the cache FILE (filling it on miss) so
+        mmap-based readers skip the remote round-trip; uncacheable
+        objects fall back to the inner store's own local path."""
+        if self._cache_get(path) is None:
+            data = self.inner.read(path)
+            self._cache_put(path, data)
+            if len(data) > self.max_bytes:
+                return self.inner.local_path(path)  # may raise
+        return os.path.join(self.cache_dir, self._key(path))
 
 
 def object_store_from_options(storage: dict, data_root: str) -> ObjectStore:

@@ -251,8 +251,16 @@ def read_sst(
 
             _stats.add("ssts_pruned_fulltext", 1)
             return None
-    data = store.read(meta.path)
-    pf = pq.ParquetFile(io.BytesIO(data))
+    try:
+        # local files open memory-mapped: footer + only the SURVIVING
+        # row groups touch disk, instead of slurping the whole object
+        # before pruning (a selective query over a multi-GB SST would
+        # otherwise pay the full read). Cached stores serve the cache
+        # file; FileNotFoundError covers an eviction race.
+        pf = pq.ParquetFile(store.local_read_path(meta.path),
+                            memory_map=True)
+    except (NotImplementedError, FileNotFoundError, OSError):
+        pf = pq.ParquetFile(io.BytesIO(store.read(meta.path)))
     md = pf.metadata
     schema_names = pf.schema_arrow.names
     wanted_fields = (

@@ -1,0 +1,46 @@
+-- Fulltext matches() (reference sqlness: common/function/matches.sql)
+CREATE TABLE logs (host STRING, msg STRING FULLTEXT, ts TIMESTAMP TIME INDEX, PRIMARY KEY (host)) WITH (append_mode = 'true');
+
+INSERT INTO logs (host, msg, ts) VALUES
+  ('a', 'disk error timeout on raid', 1000),
+  ('b', 'warning slow query path', 2000),
+  ('c', 'all systems nominal', 3000),
+  ('a', 'network error detected', 4000);
+
+SELECT host, ts FROM logs WHERE matches(msg, 'error') ORDER BY ts;
+----
+host|ts
+a|1000
+a|4000
+
+SELECT ts FROM logs WHERE matches(msg, 'error AND timeout');
+----
+ts
+1000
+
+SELECT ts FROM logs WHERE matches(msg, 'timeout OR slow') ORDER BY ts;
+----
+ts
+1000
+2000
+
+SELECT ts FROM logs WHERE matches(msg, 'error NOT network');
+----
+ts
+1000
+
+SELECT ts FROM logs WHERE matches(msg, '"slow query"');
+----
+ts
+2000
+
+SELECT ts FROM logs WHERE matches(msg, '(disk OR network) error') ORDER BY ts;
+----
+ts
+1000
+4000
+
+SELECT count(*) AS c FROM logs WHERE matches_term(msg, 'raid');
+----
+c
+1

@@ -1,0 +1,51 @@
+-- information_schema surface (reference sqlness:
+-- common/system/information_schema.sql)
+CREATE TABLE m (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (host));
+
+SELECT table_name, table_type, engine FROM information_schema.tables WHERE table_schema = 'public';
+----
+table_name|table_type|engine
+m|BASE TABLE|mito
+
+SELECT column_name, semantic_type, is_nullable FROM information_schema.columns WHERE table_name = 'm' ORDER BY column_name;
+----
+column_name|semantic_type|is_nullable
+host|TAG|No
+ts|TIMESTAMP|No
+v|FIELD|Yes
+
+SELECT constraint_name, column_name, ordinal_position FROM information_schema.key_column_usage WHERE table_name = 'm' ORDER BY constraint_name;
+----
+constraint_name|column_name|ordinal_position
+PRIMARY|host|1
+TIME INDEX|ts|1
+
+SELECT constraint_type FROM information_schema.table_constraints WHERE table_name = 'm' ORDER BY constraint_type;
+----
+constraint_type
+PRIMARY KEY
+TIME INDEX
+
+SELECT engine, support FROM information_schema.engines ORDER BY engine;
+----
+engine|support
+file|YES
+metric|YES
+tsdb|DEFAULT
+
+SELECT peer_type FROM information_schema.cluster_info;
+----
+peer_type
+STANDALONE
+
+CREATE VIEW vw AS SELECT host FROM m;
+
+SELECT table_name FROM information_schema.views;
+----
+table_name
+vw
+
+SELECT schema_name FROM information_schema.schemata ORDER BY schema_name;
+----
+schema_name
+public

@@ -1,0 +1,47 @@
+-- JSON / geo / network scalar families (reference sqlness:
+-- common/function/json/, common/function/geo.sql)
+CREATE TABLE j (doc STRING, ip STRING, lat DOUBLE, lon DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO j (doc, ip, lat, lon, ts) VALUES
+  ('{"a": {"b": 3}, "name": "x", "ok": true}', '10.1.2.3', 37.7749, -122.4194, 1000),
+  ('not json', '192.168.0.9', 40.7128, -74.0060, 2000);
+
+SELECT json_get_int(doc, '$.a.b') AS b, json_get_string(doc, 'name') AS n FROM j ORDER BY ts;
+----
+b|n
+3|x
+NULL|NULL
+
+SELECT json_is_object(doc) AS o, json_path_exists(doc, '$.ok') AS e FROM j ORDER BY ts;
+----
+o|e
+true|true
+false|false
+
+SELECT ts FROM j WHERE json_get_bool(doc, 'ok');
+----
+ts
+1000
+
+SELECT geohash(lat, lon, 4) AS g FROM j ORDER BY ts;
+----
+g
+9q8y
+dr5r
+
+SELECT round(st_distance(lat, lon, 37.7749, -122.4194) / 1000.0) AS km FROM j ORDER BY ts;
+----
+km
+0.0
+4129.0
+
+SELECT ipv4_num_to_string(ipv4_string_to_num(ip)) AS rt FROM j ORDER BY ts;
+----
+rt
+10.1.2.3
+192.168.0.9
+
+SELECT ts FROM j WHERE ipv4_in_range(ip, '10.0.0.0/8');
+----
+ts
+1000
