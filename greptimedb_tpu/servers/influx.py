@@ -17,8 +17,13 @@ from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema, SemanticType
 from greptimedb_tpu.datatypes.types import ConcreteDataType
 from greptimedb_tpu.errors import GreptimeError, InvalidArgumentError
 
-_PRECISION_MS = {"ns": 1e-6, "u": 1e-3, "us": 1e-3, "ms": 1.0, "s": 1000.0,
-                 "m": 60_000.0, "h": 3_600_000.0}
+# exact (numerator, denominator) ms conversion per precision: float
+# scaling at epoch-scale ns values (~1.7e18) rounds the INPUT to
+# float64's 2^8-ns granularity, flipping milliseconds and silently
+# colliding adjacent rows into last-write-wins dedup
+_PRECISION_MS = {"ns": (1, 1_000_000), "u": (1, 1_000), "us": (1, 1_000),
+                 "ms": (1, 1), "s": (1_000, 1), "m": (60_000, 1),
+                 "h": (3_600_000, 1)}
 
 
 class LineProtocolError(InvalidArgumentError):
@@ -227,12 +232,14 @@ def write_lines(instance, body: str, *, db: str = "public",
     scale = _PRECISION_MS.get(precision)
     if scale is None:
         raise LineProtocolError(f"bad precision {precision!r}")
+    num, den = scale
     now_ms = int(time.time() * 1000)
 
     # batch rows per measurement
     per_table: dict[str, list] = defaultdict(list)
     for m, tags, fields, ts_raw in parse_payload(body):
-        ts = now_ms if ts_raw is None else int(int(ts_raw) * scale)
+        ts = (now_ms if ts_raw is None
+              else int(ts_raw) * num // den)    # exact integer math
         per_table[m].append((tags, fields, ts))
 
     total = 0
