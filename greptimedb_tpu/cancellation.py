@@ -1,0 +1,34 @@
+"""Cooperative query cancellation.
+
+Counterpart of the reference's process manager + tokio task abort on KILL
+(/root/reference/src/catalog/src/process_manager.rs): a kill cannot abort
+an XLA program mid-flight, so long-running statements poll `checkpoint()`
+at stage boundaries (per-region scans, between batch statements) and
+raise there.
+"""
+
+from __future__ import annotations
+
+import contextvars
+
+_check: contextvars.ContextVar = contextvars.ContextVar(
+    "gtpu_cancel_check", default=None
+)
+
+
+def set_check(fn):
+    """Install a zero-arg callable that raises if the statement was
+    killed. Returns a token for `reset`."""
+    return _check.set(fn)
+
+
+def reset(token):
+    _check.reset(token)
+
+
+def checkpoint():
+    """Raise (via the installed callable) if the current statement has
+    been killed. No-op outside statement execution."""
+    fn = _check.get()
+    if fn is not None:
+        fn()

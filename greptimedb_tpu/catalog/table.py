@@ -173,6 +173,9 @@ class Table:
         table-level sid space. Rows stay per-series time-sorted (series are
         region-disjoint, so concatenation preserves per-series order)."""
         names = field_names if field_names is not None else self.field_names
+        from greptimedb_tpu import cancellation
+
+        cancellation.checkpoint()
         if len(self.regions) == 1:
             region = self.regions[0]
             sids = None
@@ -199,7 +202,10 @@ class Table:
         stats.add("regions_scanned", len(scan_regions))
         merged = SeriesRegistry(self.tag_names)
         chunks: list[ColumnarRows] = []
+        from greptimedb_tpu import cancellation
+
         for region in scan_regions:
+            cancellation.checkpoint()
             sids = None
             if matchers:
                 sids = region.series.match_sids(matchers)

@@ -66,6 +66,67 @@ def _result_from_lists(names: list[str], columns: list[list]) -> QueryResult:
     return QueryResult(names, cols)
 
 
+class _ProcessList:
+    """In-process running-statement registry backing SHOW PROCESSLIST and
+    ADMIN kill (reference: src/catalog/src/process_manager.rs). A killed
+    id raises in the owning thread at its next cancellation checkpoint."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._next_id = 1
+        self._running: dict[int, dict] = {}
+
+    def register(self, query: str, ctx) -> int:
+        import time
+
+        with self._lock:
+            pid = self._next_id
+            self._next_id += 1
+            self._running[pid] = {
+                "id": pid, "query": query, "db": ctx.database,
+                "user": ctx.username or "greptime", "start": time.time(),
+                "killed": False,
+            }
+            return pid
+
+    def unregister(self, pid: int):
+        with self._lock:
+            self._running.pop(pid, None)
+
+    def kill(self, pid_text: str) -> bool:
+        try:
+            pid = int(pid_text)
+        except ValueError:
+            return False
+        with self._lock:
+            entry = self._running.get(pid)
+            if entry is None:
+                return False
+            entry["killed"] = True
+            return True
+
+    def check_killed(self, pid: int):
+        with self._lock:
+            entry = self._running.get(pid)
+            killed = entry is not None and entry["killed"]
+        if killed:
+            from greptimedb_tpu.errors import ExecutionError
+
+            raise ExecutionError(f"query {pid} was killed")
+
+    def snapshot(self) -> list[dict]:
+        import time
+
+        with self._lock:
+            now = time.time()
+            return [
+                {**e, "elapsed_s": now - e["start"]}
+                for e in self._running.values()
+            ]
+
+
 _xla_cache_enabled = False
 
 
@@ -109,6 +170,7 @@ class Standalone:
                                         mesh=mesh)
         self.flows = None  # wired by flow.FlowManager when enabled
         self._procedures = []
+        self._process_list = _ProcessList()
         if warm_start:
             # restore device grid snapshots in the background so the
             # first query after a restart skips the SST rescan
@@ -159,8 +221,19 @@ class Standalone:
                           ) -> Output:
         from greptimedb_tpu.telemetry import tracing
 
-        with tracing.span(f"sql.{type(stmt).__name__}"):
-            return self._execute_statement(stmt, ctx)
+        from greptimedb_tpu import cancellation
+
+        kind = type(stmt).__name__
+        pid = self._process_list.register(kind, ctx)
+        token = cancellation.set_check(
+            lambda: self._process_list.check_killed(pid)
+        )
+        try:
+            with tracing.span(f"sql.{kind}"):
+                return self._execute_statement(stmt, ctx)
+        finally:
+            cancellation.reset(token)
+            self._process_list.unregister(pid)
 
     def _execute_statement(self, stmt: A.Statement, ctx: QueryContext
                            ) -> Output:
@@ -248,8 +321,202 @@ class Standalone:
             ))
         if isinstance(stmt, A.Copy):
             return Output.rows(self._copy(stmt, ctx))
+        if isinstance(stmt, A.Admin):
+            return self._admin(stmt, ctx)
+        if isinstance(stmt, A.SetVariable):
+            return self._set_variable(stmt, ctx)
+        if isinstance(stmt, A.ShowVariables):
+            return Output.records(self._show_variables(stmt, ctx))
+        if isinstance(stmt, A.ShowColumns):
+            return Output.records(self._show_columns(stmt, ctx))
+        if isinstance(stmt, A.ShowIndex):
+            return Output.records(self._show_index(stmt, ctx))
+        if isinstance(stmt, A.ShowStatus):
+            return Output.records(_result_from_lists(
+                ["Variable_name", "Value"], [["Uptime"], ["0"]]
+            ))
+        if isinstance(stmt, A.ShowCharset):
+            return Output.records(_result_from_lists(
+                ["Charset", "Description", "Default collation", "Maxlen"],
+                [["utf8mb4"], ["UTF-8 Unicode"], ["utf8mb4_bin"], [4]],
+            ))
+        if isinstance(stmt, A.ShowCollation):
+            return Output.records(_result_from_lists(
+                ["Collation", "Charset", "Id", "Default", "Compiled",
+                 "Sortlen"],
+                [["utf8mb4_bin"], ["utf8mb4"], [46], ["Yes"], ["Yes"], [1]],
+            ))
+        if isinstance(stmt, A.ShowProcesslist):
+            return Output.records(self._show_processlist(stmt))
         raise UnsupportedError(
             f"statement not supported yet: {type(stmt).__name__}"
+        )
+
+    # ------------------------------------------------------------------
+    # ADMIN maintenance functions (reference:
+    # src/sql/src/statements/admin.rs dispatching to the admin function
+    # set — flush/compact region + table, migrate_region)
+    # ------------------------------------------------------------------
+    def _admin(self, stmt: A.Admin, ctx: QueryContext) -> Output:
+        def arg(i: int) -> A.Expr:
+            if i >= len(stmt.args):
+                raise InvalidArgumentError(
+                    f"admin {stmt.func}: missing argument {i + 1}"
+                )
+            return stmt.args[i]
+
+        def const_str(i: int) -> str:
+            v = eval_const(arg(i))
+            if not isinstance(v, str):
+                raise InvalidArgumentError(
+                    f"admin {stmt.func}: arg {i} must be a string"
+                )
+            return v
+
+        def const_int(i: int) -> int:
+            v = eval_const(arg(i))
+            if isinstance(v, bool) or not isinstance(v, (int, np.integer)):
+                raise InvalidArgumentError(
+                    f"admin {stmt.func}: arg {i} must be an integer"
+                )
+            return int(v)
+
+        name = stmt.func
+        if name in ("flush_table", "compact_table"):
+            ident = const_str(0)
+            db, tname = self._resolve(ident, ctx)
+            table = self.catalog.table(db, tname)
+            n = 0
+            for region in table.regions:
+                if name == "flush_table":
+                    if region.flush() is not None:
+                        n += 1
+                else:
+                    from greptimedb_tpu.storage.compaction import (
+                        compact_once,
+                    )
+
+                    if compact_once(region):
+                        n += 1
+            return Output.records(_result_from_lists(
+                [f"ADMIN {name}('{ident}')"], [[n]]
+            ))
+        if name in ("flush_region", "compact_region"):
+            rid = const_int(0)
+            region = self.engine.region(rid)
+            if name == "flush_region":
+                n = 1 if region.flush() is not None else 0
+            else:
+                from greptimedb_tpu.storage.compaction import compact_once
+
+                n = 1 if compact_once(region) else 0
+            return Output.records(_result_from_lists(
+                [f"ADMIN {name}({rid})"], [[n]]
+            ))
+        if name == "migrate_region":
+            metasrv = getattr(self, "metasrv", None)
+            if metasrv is None:
+                raise UnsupportedError(
+                    "migrate_region requires a metasrv-managed cluster"
+                )
+            rid, to_node = const_int(0), const_int(1)
+            pid = metasrv.migrate_region(rid, to_node)
+            return Output.records(_result_from_lists(
+                [f"ADMIN migrate_region({rid}, {to_node})"], [[str(pid)]]
+            ))
+        if name == "kill":
+            target = eval_const(arg(0))
+            ok = self._process_list.kill(str(target))
+            return Output.records(_result_from_lists(
+                [f"ADMIN kill('{target}')"], [[1 if ok else 0]]
+            ))
+        raise UnsupportedError(f"unknown admin function {name!r}")
+
+    def _set_variable(self, stmt: A.SetVariable, ctx: QueryContext
+                      ) -> Output:
+        for name, value_expr in stmt.assignments:
+            value = eval_const(value_expr)
+            if name in ("time_zone", "timezone", "session_time_zone"):
+                ctx.timezone = str(value)
+                ctx.variables["time_zone"] = str(value)
+            else:
+                ctx.variables[name] = (
+                    value if isinstance(value, str) else str(value)
+                )
+        return Output.rows(0)
+
+    def _show_variables(self, stmt: A.ShowVariables, ctx: QueryContext):
+        from greptimedb_tpu.query.expr import like_to_regex
+        from greptimedb_tpu.session import DEFAULT_VARIABLES
+
+        merged = dict(DEFAULT_VARIABLES)
+        merged.update(ctx.variables)
+        items = sorted(merged.items())
+        if stmt.like:
+            pat = like_to_regex(stmt.like.lower())
+            items = [
+                (k, v) for k, v in items if pat.fullmatch(k.lower())
+            ]
+        return _result_from_lists(
+            ["Variable_name", "Value"],
+            [[k for k, _ in items], [v for _, v in items]],
+        )
+
+    def _show_columns(self, stmt: A.ShowColumns, ctx: QueryContext):
+        from greptimedb_tpu.query.expr import like_to_regex
+
+        db = stmt.database or ctx.database
+        table = self.catalog.table(db, stmt.table)
+        pat = like_to_regex(stmt.like.lower()) if stmt.like else None
+        names, types, nulls, keys, defaults, semantics = [], [], [], [], [], []
+        for cs in table.schema.columns:
+            if pat is not None and not pat.fullmatch(cs.name.lower()):
+                continue
+            names.append(cs.name)
+            types.append(cs.data_type.name)
+            nulls.append("Yes" if cs.nullable else "No")
+            if cs.semantic_type == SemanticType.TIMESTAMP:
+                keys.append("TIME INDEX")
+            elif cs.semantic_type == SemanticType.TAG:
+                keys.append("PRI")
+            else:
+                keys.append("")
+            defaults.append("")
+            semantics.append(cs.semantic_type.name)
+        cols = [names, types, nulls, keys, defaults]
+        headers = ["Column", "Type", "Null", "Key", "Default"]
+        if stmt.full:
+            headers.append("Semantic Type")
+            cols.append(semantics)
+        return _result_from_lists(headers, cols)
+
+    def _show_index(self, stmt: A.ShowIndex, ctx: QueryContext):
+        db = stmt.database or ctx.database
+        table = self.catalog.table(db, stmt.table)
+        names, key_names, seqs = [], [], []
+        for i, tag in enumerate(table.tag_names):
+            names.append(stmt.table)
+            key_names.append("PRIMARY")
+            seqs.append(i + 1)
+        names.append(stmt.table)
+        key_names.append("TIME INDEX")
+        seqs.append(1)
+        cols = [names, key_names, seqs,
+                table.tag_names + [table.ts_name]]
+        return _result_from_lists(
+            ["Table", "Key_name", "Seq_in_index", "Column_name"], cols
+        )
+
+    def _show_processlist(self, stmt: A.ShowProcesslist):
+        entries = self._process_list.snapshot()
+        return _result_from_lists(
+            ["Id", "User", "db", "Command", "Time", "Info"],
+            [[e["id"] for e in entries],
+             [e["user"] for e in entries],
+             [e["db"] for e in entries],
+             ["Query"] * len(entries),
+             [round(e["elapsed_s"], 3) for e in entries],
+             [e["query"] for e in entries]],
         )
 
     # ------------------------------------------------------------------

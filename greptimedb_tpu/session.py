@@ -17,3 +17,33 @@ class QueryContext:
     channel: str = "http"
     username: str = ""
     extensions: dict = field(default_factory=dict)
+    # session variables set via SET; read back by SHOW VARIABLES and the
+    # MySQL @@var probes (reference: src/session/src/context.rs
+    # configuration_parameter + set handling in operator/statement/set.rs)
+    variables: dict = field(default_factory=dict)
+
+
+#: server-level defaults reported by SHOW VARIABLES when the session has
+#: not overridden them (MySQL-compatible names clients probe on connect)
+DEFAULT_VARIABLES = {
+    "version": "8.4.2-greptimedb-tpu",
+    "version_comment": "GreptimeDB-TPU",
+    "sql_mode": "ANSI",
+    "time_zone": "UTC",
+    "system_time_zone": "UTC",
+    "max_allowed_packet": "16777216",
+    "max_execution_time": "0",
+    "autocommit": "ON",
+    "character_set_client": "utf8mb4",
+    "character_set_results": "utf8mb4",
+    "character_set_connection": "utf8mb4",
+    "collation_connection": "utf8mb4_bin",
+    "transaction_isolation": "REPEATABLE-READ",
+    "wait_timeout": "28800",
+    "interactive_timeout": "28800",
+    "net_write_timeout": "60",
+    "lower_case_table_names": "0",
+    "datestyle": "ISO, MDY",
+    "client_encoding": "UTF8",
+    "read_timeout": "0",
+}

@@ -387,3 +387,62 @@ class Copy(Statement):
     path: str
     format: str = "parquet"
     options: dict = field(default_factory=dict)
+
+
+@dataclass
+class Admin(Statement):
+    """ADMIN func(args...) — maintenance functions callable from SQL
+    (reference: src/sql/src/statements/admin.rs + the admin function set
+    in src/common/function/src/{flush_flow,system}/)."""
+
+    func: str
+    args: list[Expr] = field(default_factory=list)
+
+
+@dataclass
+class SetVariable(Statement):
+    """SET [SESSION|GLOBAL] name = value [, name = value ...]
+    (reference: src/operator/src/statement/set.rs)."""
+
+    assignments: list  # list[tuple[str, Expr]]
+    scope: str = "session"
+
+
+@dataclass
+class ShowVariables(Statement):
+    name: str | None = None         # SHOW VARIABLES LIKE 'x' / SHOW VARIABLES
+    like: str | None = None
+
+
+@dataclass
+class ShowColumns(Statement):
+    table: str
+    database: str | None = None
+    like: str | None = None
+    full: bool = False
+
+
+@dataclass
+class ShowIndex(Statement):
+    table: str
+    database: str | None = None
+
+
+@dataclass
+class ShowStatus(Statement):
+    pass
+
+
+@dataclass
+class ShowCharset(Statement):
+    pass
+
+
+@dataclass
+class ShowCollation(Statement):
+    pass
+
+
+@dataclass
+class ShowProcesslist(Statement):
+    full: bool = False

@@ -163,7 +163,69 @@ class Parser:
             return A.TruncateTable(self.qualified_name())
         if kw == "COPY":
             return self.copy()
+        if kw == "ADMIN":
+            return self.admin()
+        if kw == "SET":
+            return self.set_variable()
+        if kw == "KILL":
+            self.next()
+            # MySQL: KILL [QUERY | CONNECTION] <id>
+            if self.at_kw("QUERY") or self.at_kw("CONNECTION"):
+                self.next()
+            return A.Admin("kill", [self.expr()])
         raise InvalidSyntaxError(f"unsupported statement {t.text!r} at {t.pos}")
+
+    def admin(self) -> A.Statement:
+        self.expect_kw("ADMIN")
+        func = self.ident()
+        args: list[A.Expr] = []
+        if self.eat_op("("):
+            if not self.eat_op(")"):
+                while True:
+                    args.append(self.expr())
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+        return A.Admin(func.lower(), args)
+
+    def set_variable(self) -> A.Statement:
+        self.expect_kw("SET")
+        scope = "session"
+        if self.eat_kw("SESSION"):
+            scope = "session"
+        elif self.eat_kw("GLOBAL"):
+            scope = "global"
+        elif self.eat_kw("LOCAL"):
+            scope = "session"
+        name = self.ident()
+        # postgres `SET TIME ZONE 'x'`
+        if name.upper() == "TIME" and self.at_kw("ZONE"):
+            self.next()
+            return A.SetVariable([("time_zone", self._set_value())], scope)
+        assignments = []
+        while True:
+            if not self.eat_op("="):
+                self.eat_kw("TO")
+            assignments.append((name.lower(), self._set_value()))
+            if not self.eat_op(","):
+                break
+            name = self.ident()
+        return A.SetVariable(assignments, scope)
+
+    def _set_value(self) -> A.Expr:
+        """A SET value: bare identifiers are string values, not column
+        references (MySQL `SET NAMES utf8mb4`, `SET sql_mode = ANSI`)."""
+        t = self.peek()
+        if t.kind in (Tok.IDENT, Tok.QIDENT) and t.upper not in (
+            "TRUE", "FALSE", "NULL", "DEFAULT",
+        ):
+            nxt = self.peek(1)
+            if nxt.kind != Tok.OP or nxt.text in (",", ";"):
+                self.next()
+                return A.Literal(t.text)
+        if self.eat_kw("DEFAULT"):
+            return A.Literal("DEFAULT")
+        return self.expr()
 
     # ---- DDL ----------------------------------------------------------
     def create(self) -> A.Statement:
@@ -503,7 +565,51 @@ class Parser:
                 return A.ShowCreateView(self.qualified_name())
             self.expect_kw("TABLE")
             return A.ShowCreateTable(self.qualified_name())
+        if self.eat_kw("VARIABLES"):
+            like = None
+            if self.eat_kw("LIKE"):
+                like = self.next().text
+            return A.ShowVariables(like=like)
+        if self.eat_kw("COLUMNS") or self.eat_kw("FIELDS"):
+            self.expect_kw("FROM")
+            table, db = self._show_table_target()
+            like = None
+            if self.eat_kw("LIKE"):
+                like = self.next().text
+            return A.ShowColumns(table, database=db, like=like, full=full)
+        if self.eat_kw("INDEX") or self.eat_kw("INDEXES") or self.eat_kw("KEYS"):
+            self.expect_kw("FROM")
+            table, db = self._show_table_target()
+            return A.ShowIndex(table, database=db)
+        if self.at_kw("GLOBAL") or self.at_kw("SESSION"):
+            self.next()
+            if self.eat_kw("STATUS"):
+                return A.ShowStatus()
+            self.expect_kw("VARIABLES")
+            like = None
+            if self.eat_kw("LIKE"):
+                like = self.next().text
+            return A.ShowVariables(like=like)
+        if self.eat_kw("STATUS"):
+            return A.ShowStatus()
+        if self.eat_kw("CHARSET") or self.eat_kw("CHARACTER"):
+            self.eat_kw("SET")
+            return A.ShowCharset()
+        if self.eat_kw("COLLATION"):
+            return A.ShowCollation()
+        if self.eat_kw("PROCESSLIST"):
+            return A.ShowProcesslist(full=full)
         raise InvalidSyntaxError(f"unsupported SHOW at {self.peek().pos}")
+
+    def _show_table_target(self) -> tuple[str, str | None]:
+        """`tbl [FROM|IN db]` or `db.tbl` (MySQL qualified form)."""
+        name = self.ident()
+        db = None
+        if self.eat_op("."):
+            db, name = name, self.ident()
+        elif self.eat_kw("FROM") or self.eat_kw("IN"):
+            db = self.ident()
+        return name, db
 
     # ---- SELECT -------------------------------------------------------
     def select_or_setop(self) -> A.Statement:

@@ -1,0 +1,181 @@
+"""ADMIN maintenance functions + session SET/SHOW statements.
+
+Reference surface: src/sql/src/statements/admin.rs (ADMIN func calls),
+src/operator/src/statement/set.rs (SET), the MySQL-compat SHOW family
+served by the frontend (src/servers/src/mysql/federated.rs).
+"""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.errors import UnsupportedError
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.session import QueryContext
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    inst = Standalone(str(tmp_path), prefer_device=False, warm_start=False)
+    inst.execute_sql(
+        "create table cpu (ts timestamp time index, "
+        "host string primary key, usage double)"
+    )
+    hosts = np.asarray(["a", "b", "c", "a"], object)
+    ts = np.asarray([1000, 1000, 2000, 3000], np.int64)
+    inst.catalog.table("public", "cpu").write(
+        {"host": hosts}, ts, {"usage": np.asarray([1.0, 2.0, 3.0, 4.0])}
+    )
+    yield inst
+    inst.close()
+
+
+def test_admin_flush_table(inst):
+    r = inst.sql("ADMIN flush_table('cpu')")
+    assert r.names[0] == "ADMIN flush_table('cpu')"
+    assert r.cols[0].values[0] == 1  # one region had rows to flush
+    # flushing again is a no-op
+    r = inst.sql("ADMIN flush_table('cpu')")
+    assert r.cols[0].values[0] == 0
+
+
+def test_admin_flush_region_and_compact(inst):
+    table = inst.catalog.table("public", "cpu")
+    rid = table.regions[0].meta.region_id
+    r = inst.sql(f"ADMIN flush_region({rid})")
+    assert r.cols[0].values[0] == 1
+    # two SSTs -> compaction merges them
+    table.write(
+        {"host": np.asarray(["z"], object)},
+        np.asarray([5000], np.int64), {"usage": np.asarray([9.0])},
+    )
+    inst.sql(f"ADMIN flush_region({rid})")
+    inst.sql(f"ADMIN compact_region({rid})")
+    res = inst.sql("select count(usage) from cpu")
+    assert res.cols[0].values[0] == 5
+
+
+def test_admin_migrate_region_requires_metasrv(inst):
+    with pytest.raises(UnsupportedError):
+        inst.sql("ADMIN migrate_region(1, 2)")
+
+
+def test_admin_unknown_function(inst):
+    with pytest.raises(UnsupportedError):
+        inst.sql("ADMIN frobnicate()")
+
+
+def test_set_and_show_variables(inst):
+    ctx = QueryContext()
+    inst.execute_sql("SET time_zone = '+08:00'", ctx)
+    assert ctx.timezone == "+08:00"
+    r = inst.sql("SHOW VARIABLES LIKE 'time_zone'", ctx)
+    assert list(r.cols[0].values) == ["time_zone"]
+    assert list(r.cols[1].values) == ["+08:00"]
+    inst.execute_sql("SET max_execution_time = 1000", ctx)
+    r = inst.sql("SHOW VARIABLES LIKE 'max_execution_time'", ctx)
+    assert list(r.cols[1].values) == ["1000"]
+    # unfiltered listing includes server defaults
+    r = inst.sql("SHOW VARIABLES", ctx)
+    names = list(r.cols[0].values)
+    assert "sql_mode" in names and "version" in names
+    # postgres-style SET TIME ZONE
+    inst.execute_sql("SET TIME ZONE 'UTC'", ctx)
+    assert ctx.timezone == "UTC"
+
+
+def test_show_columns_and_index(inst):
+    r = inst.sql("SHOW COLUMNS FROM cpu")
+    by_name = dict(zip(r.cols[0].values, r.cols[3].values))
+    assert by_name["ts"] == "TIME INDEX"
+    assert by_name["host"] == "PRI"
+    assert by_name["usage"] == ""
+    r = inst.sql("SHOW FULL COLUMNS FROM cpu")
+    assert "Semantic Type" in r.names
+    r = inst.sql("SHOW INDEX FROM cpu")
+    assert "host" in list(r.cols[3].values)
+    assert "ts" in list(r.cols[3].values)
+
+
+def test_show_status_charset_collation_processlist(inst):
+    assert inst.sql("SHOW STATUS").num_rows == 1
+    assert inst.sql("SHOW CHARSET").cols[0].values[0] == "utf8mb4"
+    assert inst.sql("SHOW COLLATION").cols[0].values[0] == "utf8mb4_bin"
+    # the processlist contains the SHOW PROCESSLIST statement itself
+    r = inst.sql("SHOW PROCESSLIST")
+    assert r.num_rows >= 1
+    assert "ShowProcesslist" in list(r.cols[5].values)
+
+
+def test_admin_kill_nonexistent(inst):
+    r = inst.sql("ADMIN kill('99999')")
+    assert r.cols[0].values[0] == 0
+    r = inst.sql("KILL QUERY 99999")
+    assert r.cols[0].values[0] == 0
+
+
+def test_admin_missing_arg(inst):
+    from greptimedb_tpu.errors import InvalidArgumentError
+
+    with pytest.raises(InvalidArgumentError):
+        inst.sql("ADMIN flush_table()")
+
+
+def test_set_names_and_multi_assignment(inst):
+    ctx = QueryContext()
+    # bare-identifier values (connector handshake probes)
+    inst.execute_sql("SET NAMES utf8mb4", ctx)
+    assert ctx.variables["names"] == "utf8mb4"
+    inst.execute_sql("SET autocommit = 1, sql_mode = ANSI", ctx)
+    assert ctx.variables["autocommit"] == "1"
+    assert ctx.variables["sql_mode"] == "ANSI"
+
+
+def test_show_columns_qualified(inst):
+    r = inst.sql("SHOW COLUMNS FROM public.cpu")
+    assert "host" in list(r.cols[0].values)
+    # LIKE metacharacters are literal except % and _
+    r = inst.sql("SHOW COLUMNS FROM cpu LIKE 'usage'")
+    assert list(r.cols[0].values) == ["usage"]
+    r = inst.sql("SHOW COLUMNS FROM cpu LIKE 'h%'")
+    assert list(r.cols[0].values) == ["host"]
+
+
+def test_kill_running_query_cancels_at_checkpoint(inst):
+    """A kill lands mid-statement and the victim raises at its next
+    per-region scan checkpoint."""
+    import threading
+    import time
+
+    from greptimedb_tpu import cancellation
+    from greptimedb_tpu.errors import ExecutionError
+
+    started = threading.Event()
+    results = {}
+
+    orig_checkpoint = cancellation.checkpoint
+
+    def run_victim():
+        ctx = QueryContext()
+        try:
+            # monkeypatched checkpoint below blocks until the kill lands
+            results["r"] = inst.sql("select count(usage) from cpu", ctx)
+        except ExecutionError as e:
+            results["err"] = str(e)
+
+    def slow_checkpoint():
+        started.set()
+        time.sleep(0.3)  # give the killer thread time to land the kill
+        orig_checkpoint()
+
+    cancellation.checkpoint = slow_checkpoint
+    try:
+        victim = threading.Thread(target=run_victim)
+        victim.start()
+        assert started.wait(5.0)
+        # find the victim pid and kill it
+        for entry in inst._process_list.snapshot():
+            inst._process_list.kill(str(entry["id"]))
+        victim.join(10.0)
+    finally:
+        cancellation.checkpoint = orig_checkpoint
+    assert "was killed" in results.get("err", ""), results
