@@ -141,9 +141,30 @@ def _http_server(inst, opts, closers):
     from greptimedb_tpu.servers.http import HttpServer
 
     hh, hp = _split(opts.get("http.addr"))
-    server = HttpServer(inst, addr=hh, port=hp).start()
+    server = HttpServer(
+        inst, addr=hh, port=hp,
+        tls_cert=opts.get("http.tls.cert_path") or None,
+        tls_key=opts.get("http.tls.key_path") or None,
+    ).start()
     closers.append(server.stop)
     return server
+
+
+def _export_metrics(inst, opts, closers):
+    """Self-import node metrics (independent of the HTTP server; a node
+    with http disabled still exports)."""
+    if not opts.get("export_metrics.enable", False):
+        return
+    if not hasattr(getattr(inst, "catalog", None), "create_database"):
+        return  # stateless roles (frontend) have no local storage
+    from greptimedb_tpu.telemetry.export import ExportMetricsTask
+
+    task = ExportMetricsTask(
+        inst,
+        db=opts.get("export_metrics.db", "greptime_metrics"),
+        interval_s=float(opts.get("export_metrics.write_interval_s", 30.0)),
+    ).start()
+    closers.append(task.stop)
 
 
 def _flight_server(inst, opts, closers) -> None:
@@ -197,6 +218,7 @@ def _start_standalone(opts):
     inst = _make_instance(opts)
     closers = [inst.close]
     server = _http_server(inst, opts, closers)
+    _export_metrics(inst, opts, closers)
     _wire_protocols(inst, opts, closers)
     _flight_server(inst, opts, closers)
     print(
@@ -211,6 +233,7 @@ def _start_datanode(opts):
     closers = [inst.close]
     _flight_server(inst, opts, closers)
     _http_server(inst, opts, closers)
+    _export_metrics(inst, opts, closers)
     meta_addr = opts.get("datanode.metasrv_addr") or ""
     if meta_addr:
         node_id = int(opts.get("datanode.node_id", 0))

@@ -21,7 +21,16 @@ ENV_PREFIX = "GREPTIMEDB_TPU"
 DEFAULTS: dict = {
     "data_home": "./greptimedb_tpu_data",
     "default_timezone": "UTC",
-    "http": {"addr": "127.0.0.1:4000", "enable": True},
+    "http": {
+        "addr": "127.0.0.1:4000", "enable": True,
+        "tls": {"cert_path": "", "key_path": ""},
+    },
+    # self-import node metrics into the TSDB every write_interval_s
+    # (reference: src/servers/src/export_metrics.rs)
+    "export_metrics": {
+        "enable": False, "db": "greptime_metrics",
+        "write_interval_s": 30.0,
+    },
     "grpc": {"addr": "127.0.0.1:4001", "enable": True},   # arrow flight
     "mysql": {"addr": "127.0.0.1:4002", "enable": True},
     "postgres": {"addr": "127.0.0.1:4003", "enable": True},

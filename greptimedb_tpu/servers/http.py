@@ -75,11 +75,16 @@ def result_to_json(res) -> dict:
 
 class HttpServer:
     def __init__(self, instance, *, addr: str = "127.0.0.1", port: int = 4000,
-                 user_provider=None, enable_scripts: bool = False):
+                 user_provider=None, enable_scripts: bool = False,
+                 tls_cert: str | None = None, tls_key: str | None = None):
         self.instance = instance
         self.addr = addr
         self.port = port
         self.user_provider = user_provider
+        # TLS (reference: src/servers/src/tls.rs TlsOption) — serve
+        # https when a certificate chain + key are configured
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
         # scripts compile arbitrary Python with exec() in the server
         # process (the reference isolates coprocessors in an embedded
         # RustPython VM, src/script/src/python/engine.rs:345). Off by
@@ -94,7 +99,35 @@ class HttpServer:
     def start(self):
         handler = _make_handler(self.instance, self.user_provider,
                                 enable_scripts=self.enable_scripts)
-        self._httpd = ThreadingHTTPServer((self.addr, self.port), handler)
+        if self.tls_cert:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.tls_cert, self.tls_key)
+
+            class _TlsHTTPServer(ThreadingHTTPServer):
+                """Handshake runs per-connection in the handler thread
+                (wrapping the listener would serialize all connection
+                setup through the accept loop and let one stalled client
+                block it indefinitely)."""
+
+                def get_request(self):
+                    sock, addr = self.socket.accept()
+                    sock.settimeout(10.0)  # bound the TLS handshake
+                    tls_sock = ctx.wrap_socket(
+                        sock, server_side=True,
+                        do_handshake_on_connect=False,
+                    )
+                    return tls_sock, addr
+
+                def finish_request(self, request, client_address):
+                    request.do_handshake()
+                    request.settimeout(None)
+                    super().finish_request(request, client_address)
+
+            self._httpd = _TlsHTTPServer((self.addr, self.port), handler)
+        else:
+            self._httpd = ThreadingHTTPServer((self.addr, self.port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="http-server"
@@ -134,6 +167,7 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             "/v1/prometheus/read", "/v1/influxdb/", "/influxdb/",
             "/v1/events", "/v1/opentsdb/api/put", "/api/put",
             "/v1/otlp/v1/metrics", "/v1/traces", "/v1/traces/",
+            "/debug/prof/cpu", "/debug/prof/mem",
         )
 
         def _raw_path(self) -> str:
@@ -287,6 +321,32 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                     })
                 return self._json(
                     200, {"traces": global_traces.traces()}
+                )
+            if path == "/debug/prof/cpu":
+                # sampling CPU profile of the whole process (pprof
+                # analog, src/servers/src/http/pprof.rs)
+                from greptimedb_tpu.telemetry import pprof
+
+                params = self._params()
+                try:
+                    seconds = float(params.get("seconds", "1"))
+                except ValueError:
+                    return self._error(400, "bad seconds")
+                stacks = pprof.sample_cpu(seconds)
+                if params.get("format", "text") == "collapsed":
+                    body = pprof.render_collapsed(stacks)
+                else:
+                    body = pprof.render_report(stacks)
+                return self._send(200, body.encode(), "text/plain")
+            if path == "/debug/prof/mem":
+                from greptimedb_tpu.telemetry import pprof
+
+                try:
+                    top = int(self._params().get("top", "30"))
+                except ValueError:
+                    return self._error(400, "bad top")
+                return self._send(
+                    200, pprof.mem_profile(top).encode(), "text/plain"
                 )
             if path == "/v1/sql":
                 return self._handle_sql()

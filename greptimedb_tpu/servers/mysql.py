@@ -54,28 +54,27 @@ COM_FIELD_LIST = 0x04
 COM_PING = 0x0E
 COM_STMT_PREPARE = 0x16
 
-_SERVER_VERSION = "8.4.0-greptimedb-tpu"
+from greptimedb_tpu.session import DEFAULT_VARIABLES as _DEFAULT_VARS
 
-# connect-time probes answered without the SQL engine
-_AT_VAR_VALUES = {
-    "version_comment": "greptimedb-tpu",
-    "version": _SERVER_VERSION,
-    "max_allowed_packet": "16777216",
-    "system_time_zone": "UTC",
-    "time_zone": "SYSTEM",
-    "tx_isolation": "REPEATABLE-READ",
-    "transaction_isolation": "REPEATABLE-READ",
-    "session.transaction_isolation": "REPEATABLE-READ",
-    "autocommit": "1",
-    "sql_mode": "",
-    "lower_case_table_names": "0",
-    "interactive_timeout": "28800",
-    "wait_timeout": "28800",
-    "character_set_client": "utf8mb4",
-    "character_set_connection": "utf8mb4",
-    "character_set_results": "utf8mb4",
-    "collation_connection": "utf8mb4_general_ci",
+_SERVER_VERSION = _DEFAULT_VARS["version"]
+
+# connect-time @@var probes read the same server defaults SHOW VARIABLES
+# uses (session.DEFAULT_VARIABLES) overlaid with the session's SET values;
+# these aliases bridge MySQL spellings onto the canonical names
+_AT_VAR_ALIASES = {
+    "tx_isolation": "transaction_isolation",
 }
+# @@-probe values MySQL connectors expect in numeric form
+_AT_VAR_NUMERIC = {"ON": "1", "OFF": "0"}
+
+
+def _at_var_value(name: str, ctx) -> str:
+    from greptimedb_tpu.session import DEFAULT_VARIABLES
+
+    key = name.lower().rsplit(".", 1)[-1]
+    key = _AT_VAR_ALIASES.get(key, key)
+    v = ctx.variables.get(key, DEFAULT_VARIABLES.get(key, ""))
+    return _AT_VAR_NUMERIC.get(v, v)
 _AT_VAR_RE = re.compile(r"@@([A-Za-z_.]+)")
 # an entire statement made of @@-variable selects (connector probes);
 # anything else — @@ in a string literal, mixed expressions — runs as SQL
@@ -312,11 +311,20 @@ class _Handler(socketserver.BaseRequestHandler):
     def _query(self, conn: _Conn, inst, ctx, sql: str):
         stripped = sql.strip().rstrip(";").strip()
         low = stripped.lower()
-        if low.startswith("set ") or low in ("begin", "commit", "rollback"):
+        if low.startswith("set "):
+            # run through the engine so SHOW VARIABLES / @@vars read the
+            # values back; unparseable connector dialects get a blind OK
+            try:
+                inst.execute_sql(stripped, ctx)
+            except Exception:
+                pass
+            conn.send_packet(self._ok())
+            return
+        if low in ("begin", "commit", "rollback"):
             conn.send_packet(self._ok())
             return
         if _AT_VAR_STMT_RE.fullmatch(stripped):
-            self._at_vars(conn, stripped)
+            self._at_vars(conn, stripped, ctx)
             return
         try:
             outs = inst.execute_sql(stripped, ctx)
@@ -329,14 +337,13 @@ class _Handler(socketserver.BaseRequestHandler):
             return
         self._send_resultset(conn, out.result)
 
-    def _at_vars(self, conn: _Conn, sql: str):
+    def _at_vars(self, conn: _Conn, sql: str, ctx):
         names = _AT_VAR_RE.findall(sql)
         if not names:
             conn.send_packet(self._ok())
             return
         cols = [f"@@{n}" for n in names]
-        vals = [_AT_VAR_VALUES.get(n.lower().rsplit(".", 1)[-1], "")
-                for n in names]
+        vals = [_at_var_value(n, ctx) for n in names]
         conn.send_packet(_lenc_int(len(cols)))
         for c in cols:
             conn.send_packet(self._col_def(c, T_VAR_STRING))

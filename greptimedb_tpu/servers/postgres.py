@@ -360,7 +360,17 @@ class _Handler(socketserver.BaseRequestHandler):
             conn.send(_ready())
             return
         low = stripped.lower()
-        if low.startswith(("set ", "begin", "commit", "rollback",
+        if low.startswith("set "):
+            # run through the engine so SHOW VARIABLES reads values back;
+            # unparseable client dialects still get a clean SET reply
+            try:
+                inst.execute_sql(stripped, ctx)
+            except Exception:
+                pass
+            conn.send(_msg(b"C", _cstr("SET")))
+            conn.send(_ready())
+            return
+        if low.startswith(("begin", "commit", "rollback",
                            "discard all", "deallocate")):
             conn.send(_msg(b"C", _cstr(low.split()[0].upper())))
             conn.send(_ready())

@@ -129,6 +129,13 @@ def remote_write(instance, body: bytes, *, db: str = "public",
     if compressed:
         body = snappy.decompress(body)
     serieses = parse_write_request(body)
+    return len(serieses), apply_series(instance, serieses, db=db)
+
+
+def apply_series(instance, serieses, *, db: str = "public") -> int:
+    """Write [(labels-with-__name__, [(value, ts_ms)])] series into
+    per-metric tables (shared by remote write and the metrics
+    self-export task). Returns samples written."""
     per_metric: dict[str, list] = defaultdict(list)
     for labels, samples in serieses:
         metric = labels.pop("__name__", None)
@@ -162,7 +169,7 @@ def remote_write(instance, body: bytes, *, db: str = "public",
         data = {table.ts_name: ts, VALUE_FIELD: vals, **tag_cols}
         instance._notify_flows(db, metric, table, data, {})
         n_samples += len(ts)
-    return len(serieses), n_samples
+    return n_samples
 
 
 # ----------------------------------------------------------------------

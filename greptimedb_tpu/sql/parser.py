@@ -202,6 +202,43 @@ class Parser:
         if name.upper() == "TIME" and self.at_kw("ZONE"):
             self.next()
             return A.SetVariable([("time_zone", self._set_value())], scope)
+        # `SET NAMES <charset> [COLLATE <collation>]`
+        if name.upper() == "NAMES" and not self.at_op("="):
+            charset = self._set_value()
+            assignments = [("names", charset)]
+            if self.eat_kw("COLLATE"):
+                assignments.append(
+                    ("collation_connection", self._set_value())
+                )
+            return A.SetVariable(assignments, scope)
+        # `SET [SESSION|GLOBAL] TRANSACTION ISOLATION LEVEL <levels>` /
+        # `SET TRANSACTION READ ONLY|WRITE`
+        if name.upper() == "TRANSACTION" and not self.at_op("="):
+            assignments = []
+            while True:
+                if self.eat_kw("ISOLATION"):
+                    self.expect_kw("LEVEL")
+                    words = [self.ident().upper()]
+                    while self.at_kw("COMMITTED", "UNCOMMITTED", "READ"):
+                        words.append(self.ident().upper())
+                    assignments.append((
+                        "transaction_isolation", A.Literal("-".join(words)),
+                    ))
+                elif self.eat_kw("READ"):
+                    mode = self.ident().upper()  # ONLY | WRITE
+                    assignments.append(
+                        ("transaction_read_only",
+                         A.Literal("ON" if mode == "ONLY" else "OFF"))
+                    )
+                else:
+                    break
+                if not self.eat_op(","):
+                    break
+            if not assignments:
+                raise InvalidSyntaxError(
+                    f"expected ISOLATION or READ at {self.peek().pos}"
+                )
+            return A.SetVariable(assignments, scope)
         assignments = []
         while True:
             if not self.eat_op("="):

@@ -1,0 +1,93 @@
+"""Metrics self-export: periodically import the node's own metrics into
+the TSDB (or push them to a remote-write endpoint).
+
+Capability counterpart of the reference's ExportMetricsTask
+(/root/reference/src/servers/src/export_metrics.rs:81-191): every
+`interval_s` the global registry is scraped in-process and written
+through the same per-metric table path Prometheus remote write uses, so
+`select * from greptime_http_requests_total` works on the node itself.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+_LINE = re.compile(
+    r"^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^\s]+)$"
+)
+_LABEL = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def scrape_registry(now_ms: int | None = None) -> list:
+    """Render the global registry and parse it into remote-write-shaped
+    series: [(labels-with-__name__, [(value, ts_ms)])]."""
+    now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+    series = []
+    for line in global_registry.render().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        if m is None:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        labels = {"__name__": m.group("name")}
+        if m.group("labels"):
+            for lk, lv in _LABEL.findall(m.group("labels")):
+                labels[lk] = lv.replace('\\"', '"').replace("\\\\", "\\")
+        series.append((labels, [(value, now_ms)]))
+    return series
+
+
+class ExportMetricsTask:
+    """Background self-import loop. `instance` is a Standalone (or any
+    object with the catalog/_notify_flows surface apply_series needs)."""
+
+    def __init__(self, instance, *, db: str = "greptime_metrics",
+                 interval_s: float = 30.0):
+        self.instance = instance
+        self.db = db
+        self.interval_s = max(1.0, float(interval_s))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.runs = 0
+        self.samples_written = 0
+
+    def start(self):
+        self.instance.catalog.create_database(self.db, if_not_exists=True)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="export-metrics"
+        )
+        self._thread.start()
+        return self
+
+    def tick(self):
+        """One scrape+import cycle (also called by the loop)."""
+        from greptimedb_tpu.servers.prom_store import apply_series
+
+        series = scrape_registry()
+        if series:
+            self.samples_written += apply_series(
+                self.instance, series, db=self.db
+            )
+        self.runs += 1
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # metrics export must never take the node down
+                pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

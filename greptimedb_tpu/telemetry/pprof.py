@@ -1,0 +1,131 @@
+"""On-demand CPU and heap profiling behind HTTP debug routes.
+
+Capability counterpart of the reference's pprof integration
+(/root/reference/src/common/pprof/src/nix.rs — pprof-rs sampling CPU
+profiler behind /debug/prof/cpu, src/servers/src/http/pprof.rs) and the
+jemalloc heap dumps (/root/reference/src/common/mem-prof/, http/mem_prof.rs).
+
+CPU: a sampling profiler over `sys._current_frames()` — the Python analog
+of a SIGPROF sampler. Output is collapsed-stack (flamegraph) text or an
+aggregated self/total report. Heap: tracemalloc snapshots with top
+allocation sites.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+
+
+def sample_cpu(seconds: float = 1.0, hz: int = 99,
+               *, skip_threads: tuple[str, ...] = ("pprof-sampler",)
+               ) -> Counter:
+    """Sample all thread stacks for `seconds` at `hz`. Returns a Counter
+    of collapsed stacks ('outer;inner;leaf' -> samples)."""
+    seconds = max(0.01, min(float(seconds), 60.0))
+    hz = max(1, min(int(hz), 1000))
+    interval = 1.0 / hz
+    stacks: Counter = Counter()
+    names = {}
+
+    def loop():
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            for tid, frame in sys._current_frames().items():
+                name = names.get(tid)
+                if name is None:
+                    name = "thread"
+                    for t in threading.enumerate():
+                        if t.ident == tid:
+                            name = t.name
+                            break
+                    names[tid] = name
+                if name in skip_threads:
+                    continue
+                parts = []
+                f = frame
+                depth = 0
+                while f is not None and depth < 128:
+                    code = f.f_code
+                    parts.append(
+                        f"{code.co_name} "
+                        f"({code.co_filename.rsplit('/', 1)[-1]}:"
+                        f"{f.f_lineno})"
+                    )
+                    f = f.f_back
+                    depth += 1
+                parts.reverse()
+                stacks[name + ";" + ";".join(parts)] += 1
+            time.sleep(interval)
+
+    t = threading.Thread(target=loop, name="pprof-sampler", daemon=True)
+    t.start()
+    t.join(seconds + 5.0)
+    return stacks
+
+
+def render_collapsed(stacks: Counter) -> str:
+    """flamegraph.pl / speedscope-compatible collapsed stack lines."""
+    return "\n".join(
+        f"{stack} {count}" for stack, count in stacks.most_common()
+    ) + ("\n" if stacks else "")
+
+
+def render_report(stacks: Counter, top: int = 40) -> str:
+    """Aggregated self-time report (like `pprof -top`)."""
+    total = sum(stacks.values())
+    self_c: Counter = Counter()
+    total_c: Counter = Counter()
+    for stack, n in stacks.items():
+        frames = stack.split(";")[1:]  # drop the thread name
+        if not frames:
+            continue
+        self_c[frames[-1]] += n
+        for fr in set(frames):
+            total_c[fr] += n
+    lines = [f"samples: {total}", "",
+             f"{'self':>8} {'self%':>7} {'total%':>7}  function"]
+    for fn, n in self_c.most_common(top):
+        lines.append(
+            f"{n:>8} {100.0 * n / max(total, 1):>6.1f}% "
+            f"{100.0 * total_c[fn] / max(total, 1):>6.1f}%  {fn}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------------------------
+# heap profiling (tracemalloc)
+# ----------------------------------------------------------------------
+
+_tracemalloc_lock = threading.Lock()
+
+
+def mem_profile(top: int = 30) -> str:
+    """Top heap allocation sites. Starts tracemalloc on first use (the
+    first call reports allocations made after it — like enabling jemalloc
+    profiling at runtime)."""
+    import tracemalloc
+
+    with _tracemalloc_lock:
+        if not tracemalloc.is_tracing():
+            tracemalloc.start(8)
+            return (
+                "tracemalloc started; allocations are now being tracked.\n"
+                "Request this endpoint again to see a snapshot.\n"
+            )
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")
+    current, peak = tracemalloc.get_traced_memory()
+    lines = [
+        f"traced current={current / 1e6:.1f}MB peak={peak / 1e6:.1f}MB",
+        "", f"{'bytes':>12} {'count':>8}  site",
+    ]
+    for st in stats[:max(1, min(int(top), 200))]:
+        frame = st.traceback[0]
+        lines.append(
+            f"{st.size:>12} {st.count:>8}  "
+            f"{frame.filename.rsplit('/', 1)[-1]}:{frame.lineno}"
+        )
+    return "\n".join(lines) + "\n"

@@ -130,6 +130,23 @@ def test_set_names_and_multi_assignment(inst):
     assert ctx.variables["sql_mode"] == "ANSI"
 
 
+def test_set_connector_handshake_forms(inst):
+    ctx = QueryContext()
+    inst.execute_sql("SET NAMES utf8mb4 COLLATE utf8mb4_general_ci", ctx)
+    assert ctx.variables["names"] == "utf8mb4"
+    assert ctx.variables["collation_connection"] == "utf8mb4_general_ci"
+    inst.execute_sql(
+        "SET SESSION TRANSACTION ISOLATION LEVEL READ COMMITTED", ctx
+    )
+    assert ctx.variables["transaction_isolation"] == "READ-COMMITTED"
+    inst.execute_sql("SET TRANSACTION READ ONLY", ctx)
+    assert ctx.variables["transaction_read_only"] == "ON"
+    inst.execute_sql(
+        "SET TRANSACTION ISOLATION LEVEL REPEATABLE READ", ctx
+    )
+    assert ctx.variables["transaction_isolation"] == "REPEATABLE-READ"
+
+
 def test_show_columns_qualified(inst):
     r = inst.sql("SHOW COLUMNS FROM public.cpu")
     assert "host" in list(r.cols[0].values)

@@ -1,0 +1,149 @@
+"""pprof debug routes, TLS serving, and the metrics self-export task.
+
+Reference surface: src/servers/src/http/pprof.rs + mem_prof.rs,
+src/servers/src/tls.rs, src/servers/src/export_metrics.rs.
+"""
+
+import json
+import ssl
+import subprocess
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.servers.http import HttpServer
+from greptimedb_tpu.telemetry import pprof
+from greptimedb_tpu.telemetry.export import ExportMetricsTask, scrape_registry
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    inst = Standalone(str(tmp_path / "data"), prefer_device=False,
+                      warm_start=False)
+    yield inst
+    inst.close()
+
+
+@pytest.fixture()
+def server(inst):
+    srv = HttpServer(inst, port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _get(srv, path, scheme="http", ctx=None):
+    url = f"{scheme}://127.0.0.1:{srv.port}{path}"
+    with urllib.request.urlopen(url, timeout=30, context=ctx) as r:
+        return r.status, r.read()
+
+
+# ---------------------------------------------------------------------
+# pprof
+# ---------------------------------------------------------------------
+
+def test_sample_cpu_captures_running_code():
+    stop = threading.Event()
+
+    def busy_loop_for_profiler():
+        while not stop.wait(0.001):
+            sum(i * i for i in range(500))
+
+    t = threading.Thread(target=busy_loop_for_profiler, name="busy")
+    t.start()
+    try:
+        stacks = pprof.sample_cpu(0.4, hz=200)
+    finally:
+        stop.set()
+        t.join()
+    collapsed = pprof.render_collapsed(stacks)
+    assert "busy_loop_for_profiler" in collapsed
+    report = pprof.render_report(stacks)
+    assert "samples:" in report and "self%" in report
+
+
+def test_mem_profile_reports_sites():
+    first = pprof.mem_profile()
+    if "started" in first:
+        # tracked from now on; allocate something visible
+        _hold = [bytearray(256) for _ in range(2000)]
+        out = pprof.mem_profile(10)
+        assert "traced current=" in out
+        del _hold
+
+
+def test_debug_prof_routes(server):
+    code, body = _get(server, "/debug/prof/cpu?seconds=0.2")
+    assert code == 200 and b"samples:" in body
+    code, body = _get(
+        server, "/debug/prof/cpu?seconds=0.2&format=collapsed"
+    )
+    assert code == 200
+    code, body = _get(server, "/debug/prof/mem")
+    assert code == 200
+
+
+# ---------------------------------------------------------------------
+# metrics self-export
+# ---------------------------------------------------------------------
+
+def test_scrape_registry_parses_labels():
+    global_registry.counter(
+        "test_export_requests", "t", ("route", "code")
+    ).labels("/v1/sql", "200").inc(3)
+    series = scrape_registry(now_ms=1234)
+    match = [
+        (lab, s) for lab, s in series
+        if lab["__name__"] == "test_export_requests"
+        and lab.get("route") == "/v1/sql"
+    ]
+    assert match
+    labels, samples = match[0]
+    assert labels["code"] == "200"
+    assert samples == [(3.0, 1234)]
+
+
+def test_export_metrics_task_self_import(inst):
+    global_registry.counter("test_selfimport_total", "t").inc(7)
+    task = ExportMetricsTask(inst, db="greptime_metrics",
+                             interval_s=3600.0).start()
+    try:
+        task.tick()
+        res = inst.sql(
+            "select greptime_value from greptime_metrics.test_selfimport_total"
+        )
+        assert res.num_rows >= 1
+        assert float(res.cols[0].values[0]) >= 7.0
+    finally:
+        task.stop()
+
+
+# ---------------------------------------------------------------------
+# TLS
+# ---------------------------------------------------------------------
+
+def test_https_serving(inst, tmp_path):
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    p = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        capture_output=True,
+    )
+    if p.returncode != 0:
+        pytest.skip(f"openssl unavailable: {p.stderr[:120]}")
+    srv = HttpServer(inst, port=0, tls_cert=str(cert),
+                     tls_key=str(key)).start()
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        code, body = _get(srv, "/health", scheme="https", ctx=ctx)
+        assert code == 200
+        assert json.loads(body) == {}
+    finally:
+        srv.stop()

@@ -161,7 +161,11 @@ def test_mysql_query_roundtrip(inst):
         assert rows == [["a", "1.5"], ["b", "2.5"]]
         # connect-time probe
         names, rows = c.query("select @@version_comment limit 1")
-        assert rows == [["greptimedb-tpu"]]
+        assert rows == [["GreptimeDB-TPU"]]
+        # SET routes through the engine; @@ probes read the value back
+        c.query("SET time_zone = '+08:00'")
+        names, rows = c.query("select @@time_zone")
+        assert rows == [["+08:00"]]
         # DDL/insert through the wire
         names, rows = c.query(
             "INSERT INTO wt (host, v, ts) VALUES ('c', 9.0, 3000)"
