@@ -57,14 +57,49 @@ def build_parser() -> argparse.ArgumentParser:
         start.add_argument("--node-id", type=int, default=None)
         start.add_argument("--no-flows", action="store_true")
 
-    repl = sub.add_parser("cli")
-    repl.add_argument("--data-home", default="./greptimedb_tpu_data")
+    cli = sub.add_parser("cli")
+    # the real default lives on the parent; subcommand flags use SUPPRESS
+    # so `cli --data-home X <cmd>` isn't clobbered by subparser defaults
+    cli.add_argument("--data-home", default="./greptimedb_tpu_data")
+    cli_sub = cli.add_subparsers(dest="cli_cmd")
+    repl = cli_sub.add_parser("repl")
+    repl.add_argument("--data-home", default=argparse.SUPPRESS)
+    exp = cli_sub.add_parser("export")
+    exp.add_argument("--data-home", default=argparse.SUPPRESS)
+    exp.add_argument("--output-dir", required=True)
+    exp.add_argument("--target", default="all",
+                     choices=("all", "schema", "data"))
+    exp.add_argument("--database", default=None)
+    imp = cli_sub.add_parser("import")
+    imp.add_argument("--data-home", default=argparse.SUPPRESS)
+    imp.add_argument("--input-dir", required=True)
+    imp.add_argument("--database", default=None)
     return ap
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.role == "cli":
+        cmd = getattr(args, "cli_cmd", None)
+        if cmd == "export":
+            from greptimedb_tpu.tools import export_data
+
+            report = export_data(args.data_home, args.output_dir,
+                                 target=args.target,
+                                 database=args.database)
+            for db, r in report.items():
+                print(f"exported {db}: {r['tables']} tables, "
+                      f"{r['rows']} rows")
+            return 0
+        if cmd == "import":
+            from greptimedb_tpu.tools import import_data
+
+            report = import_data(args.data_home, args.input_dir,
+                                 database=args.database)
+            for db, r in report.items():
+                print(f"imported {db}: {r['tables']} statements, "
+                      f"{r['rows']} rows")
+            return 0
         return _repl(args)
     opts = load_options(
         args.role,
@@ -201,6 +236,7 @@ def _make_instance(opts):
                 "engine.background_interval_s", 5.0
             ),
             wal_backend=opts.get("wal.backend", "fs"),
+            wal_topics=int(opts.get("wal.topics", 4)),
         ),
         store=store,
     )

@@ -1,0 +1,126 @@
+"""Offline data tools: export / import a data home.
+
+Capability counterpart of the reference's CLI subtools
+(/root/reference/src/cmd/src/cli/export.rs, import.rs): dump every
+database's schema (CREATE TABLE / CREATE VIEW statements) and data
+(per-table Parquet via the COPY path) into a directory tree, and load
+such a tree back into an empty data home.
+
+Layout (mirrors the reference's per-db dirs):
+    <out>/<db>/create_tables.sql
+    <out>/<db>/<table>.parquet
+"""
+
+from __future__ import annotations
+
+import os
+
+_SYSTEM_DBS = {"information_schema"}
+
+
+def _qstr(s: str) -> str:
+    """Escape a value for a single-quoted SQL literal."""
+    return s.replace("'", "''")
+
+
+def _qid(s: str) -> str:
+    """Escape an identifier for double quotes."""
+    return s.replace('"', '""')
+
+
+def _open(data_home: str):
+    from greptimedb_tpu.instance import Standalone
+
+    return Standalone(data_home, prefer_device=False, warm_start=False)
+
+
+def export_data(data_home: str, output_dir: str, *, target: str = "all",
+                database: str | None = None) -> dict:
+    """Dump schema and/or data. target: all | schema | data.
+    Returns {db: {"tables": n, "rows": n}} for reporting."""
+    from greptimedb_tpu.session import QueryContext
+
+    if target not in ("all", "schema", "data"):
+        raise ValueError(f"bad target {target!r}")
+    inst = _open(data_home)
+    report: dict = {}
+    try:
+        dbs = [database] if database else [
+            d for d in inst.catalog.database_names()
+            if d not in _SYSTEM_DBS
+        ]
+        for db in dbs:
+            ctx = QueryContext(database=db)
+            db_dir = os.path.join(output_dir, db)
+            os.makedirs(db_dir, exist_ok=True)
+            tables = inst.catalog.table_names(db)
+            rows_total = 0
+            if target in ("all", "schema"):
+                stmts = []
+                for t in tables:
+                    r = inst.sql(
+                        f'SHOW CREATE TABLE "{_qid(t)}"', ctx
+                    )
+                    stmts.append(str(r.cols[1].values[0]).rstrip(";"))
+                for v in inst.catalog.view_names(db):
+                    text = inst.catalog.maybe_view(db, v)
+                    if text:
+                        stmts.append(f'CREATE VIEW "{v}" AS {text}')
+                with open(os.path.join(db_dir, "create_tables.sql"),
+                          "w") as f:
+                    f.write(";\n\n".join(stmts) + (";\n" if stmts else ""))
+            if target in ("all", "data"):
+                for t in tables:
+                    path = os.path.join(db_dir, f"{t}.parquet")
+                    out = inst.execute_sql(
+                        f"COPY \"{_qid(t)}\" TO '{_qstr(path)}' "
+                        f"WITH (format = 'parquet')",
+                        ctx,
+                    )
+                    rows_total += out[-1].affected_rows or 0
+            report[db] = {"tables": len(tables), "rows": rows_total}
+        return report
+    finally:
+        inst.close()
+
+
+def import_data(data_home: str, input_dir: str, *,
+                database: str | None = None) -> dict:
+    """Load an export_data tree into a data home (created if missing)."""
+    from greptimedb_tpu.session import QueryContext
+
+    inst = _open(data_home)
+    report: dict = {}
+    try:
+        dbs = sorted(
+            d for d in os.listdir(input_dir)
+            if os.path.isdir(os.path.join(input_dir, d))
+            and (database is None or d == database)
+        )
+        for db in dbs:
+            db_dir = os.path.join(input_dir, db)
+            inst.catalog.create_database(db, if_not_exists=True)
+            ctx = QueryContext(database=db)
+            schema_path = os.path.join(db_dir, "create_tables.sql")
+            n_tables = 0
+            if os.path.exists(schema_path):
+                with open(schema_path) as f:
+                    sql = f.read()
+                if sql.strip():
+                    n_tables = len(inst.execute_sql(sql, ctx))
+            rows_total = 0
+            for fn in sorted(os.listdir(db_dir)):
+                if not fn.endswith(".parquet"):
+                    continue
+                t = fn[:-len(".parquet")]
+                path = os.path.join(db_dir, fn)
+                out = inst.execute_sql(
+                    f"COPY \"{_qid(t)}\" FROM '{_qstr(path)}' "
+                    f"WITH (format = 'parquet')",
+                    ctx,
+                )
+                rows_total += out[-1].affected_rows or 0
+            report[db] = {"tables": n_tables, "rows": rows_total}
+        return report
+    finally:
+        inst.close()
