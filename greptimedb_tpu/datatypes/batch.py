@@ -55,6 +55,12 @@ class HostColumn:
 
     def to_arrow(self) -> pa.Array:
         mask = None if self.validity is None else ~self.validity
+        if self.data_type.is_decimal():
+            # float64 in memory -> exact decimal128 on the wire
+            arr = pa.array(
+                np.asarray(self.values, np.float64), pa.float64(), mask=mask
+            )
+            return arr.cast(self.data_type.to_arrow(), safe=False)
         return pa.array(self.values, type=self.data_type.to_arrow(), mask=mask)
 
     @staticmethod
@@ -69,6 +75,11 @@ class HostColumn:
             validity = np.asarray(arr.is_valid())
         if dt.is_string() or dt.id.value == "binary":
             values = np.asarray(arr.to_pylist(), dtype=object)
+        elif dt.is_decimal():
+            arr = arr.cast(pa.float64())
+            if arr.null_count:
+                arr = arr.fill_null(0)
+            values = np.asarray(arr)
         elif dt.is_timestamp():
             arr = arr.cast(pa.int64())
             if arr.null_count:

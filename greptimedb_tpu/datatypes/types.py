@@ -38,6 +38,11 @@ class TypeId(enum.Enum):
     TIMESTAMP_NANOSECOND = "timestamp_ns"
     DATE = "date"
     JSON = "json"
+    # Decimal128 (reference: src/common/decimal/): exact (precision,
+    # scale) at the schema/wire/Parquet boundary; the in-memory and
+    # on-device representation is float64 (the TPU computes in floats —
+    # values round-trip exactly for precision <= 15)
+    DECIMAL = "decimal"
 
 
 _TS_UNITS = {
@@ -53,6 +58,9 @@ _TS_PER_SECOND = {"s": 1, "ms": 1_000, "us": 1_000_000, "ns": 1_000_000_000}
 @dataclass(frozen=True)
 class ConcreteDataType:
     id: TypeId
+    # decimal parameters (None for every other type)
+    precision: int | None = None
+    scale: int | None = None
 
     # ---- constructors -------------------------------------------------
     @staticmethod
@@ -131,6 +139,20 @@ class ConcreteDataType:
     def date() -> "ConcreteDataType":
         return ConcreteDataType(TypeId.DATE)
 
+    @staticmethod
+    def decimal128(precision: int = 38, scale: int = 10
+                   ) -> "ConcreteDataType":
+        if not (1 <= precision <= 38):
+            raise ValueError(f"decimal precision {precision} out of [1,38]")
+        if not (0 <= scale <= precision):
+            raise ValueError(
+                f"decimal scale {scale} out of [0,{precision}]"
+            )
+        return ConcreteDataType(TypeId.DECIMAL, precision, scale)
+
+    def is_decimal(self) -> bool:
+        return self.id == TypeId.DECIMAL
+
     # ---- predicates ---------------------------------------------------
     def is_timestamp(self) -> bool:
         return self.id in _TS_UNITS
@@ -142,11 +164,12 @@ class ConcreteDataType:
         return self.id in (
             TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.INT64,
             TypeId.UINT8, TypeId.UINT16, TypeId.UINT32, TypeId.UINT64,
-            TypeId.FLOAT32, TypeId.FLOAT64,
+            TypeId.FLOAT32, TypeId.FLOAT64, TypeId.DECIMAL,
         )
 
     def is_float(self) -> bool:
-        return self.id in (TypeId.FLOAT32, TypeId.FLOAT64)
+        # decimal computes as float64 in this engine (see TypeId.DECIMAL)
+        return self.id in (TypeId.FLOAT32, TypeId.FLOAT64, TypeId.DECIMAL)
 
     def is_integer(self) -> bool:
         return self.is_numeric() and not self.is_float()
@@ -154,7 +177,7 @@ class ConcreteDataType:
     def is_signed(self) -> bool:
         return self.id in (
             TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.INT64,
-            TypeId.FLOAT32, TypeId.FLOAT64,
+            TypeId.FLOAT32, TypeId.FLOAT64, TypeId.DECIMAL,
         )
 
     @property
@@ -168,6 +191,8 @@ class ConcreteDataType:
     # ---- conversions --------------------------------------------------
     def to_arrow(self) -> pa.DataType:
         t = self.id
+        if t == TypeId.DECIMAL:
+            return pa.decimal128(self.precision or 38, self.scale or 0)
         if t == TypeId.BOOL:
             return pa.bool_()
         if t == TypeId.STRING:
@@ -190,10 +215,14 @@ class ConcreteDataType:
             return np.dtype(object)
         if self.is_timestamp() or t == TypeId.DATE:
             return np.dtype(np.int64)
+        if t == TypeId.DECIMAL:
+            return np.dtype(np.float64)
         return np.dtype(t.value)
 
     @property
     def name(self) -> str:
+        if self.id == TypeId.DECIMAL:
+            return f"decimal({self.precision},{self.scale})"
         return self.id.value
 
     @staticmethod
@@ -208,6 +237,8 @@ class ConcreteDataType:
             )
         if pa.types.is_date(dt):
             return ConcreteDataType.date()
+        if pa.types.is_decimal(dt):
+            return ConcreteDataType.decimal128(dt.precision, dt.scale)
         if pa.types.is_string(dt) or pa.types.is_large_string(dt):
             return ConcreteDataType.string()
         if pa.types.is_binary(dt) or pa.types.is_large_binary(dt):
@@ -253,4 +284,16 @@ class ConcreteDataType:
         }
         if name in aliases:
             return ConcreteDataType(aliases[name])
+        import re as _re
+
+        m = _re.fullmatch(
+            r"(?:decimal|numeric)\s*(?:\(\s*(\d+)\s*(?:,\s*(\d+)\s*)?\))?",
+            name,
+        )
+        if m:
+            precision = int(m.group(1)) if m.group(1) else 38
+            scale = int(m.group(2)) if m.group(2) else (
+                10 if m.group(1) is None else 0
+            )
+            return ConcreteDataType.decimal128(precision, scale)
         return ConcreteDataType(TypeId(name))

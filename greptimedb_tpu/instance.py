@@ -542,6 +542,10 @@ class Standalone:
                     arrays[n] = pa.array(
                         col.values.astype("datetime64[ms]"), mask=mask
                     )
+                elif cs is not None and cs.data_type.is_decimal():
+                    arrays[n] = pa.array(
+                        np.asarray(col.values, np.float64), mask=mask
+                    ).cast(cs.data_type.to_arrow(), safe=False)
                 else:
                     arrays[n] = pa.array(col.values, mask=mask)
             pa_table = pa.table(arrays)
@@ -977,6 +981,12 @@ def _coerce_insert(vals: list, dt: ConcreteDataType):
             np.asarray(["" if v is None else str(v) for v in vals], object),
             validity,
         )
+    if dt.is_decimal():
+        out = np.zeros(n, np.float64)
+        for i, v in enumerate(vals):
+            if v is not None:
+                out[i] = float(v)
+        return out, validity
     np_t = dt.to_numpy()
     out = np.zeros(n, np_t)
     for i, v in enumerate(vals):

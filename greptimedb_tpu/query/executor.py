@@ -48,21 +48,32 @@ class QueryResult:
         self.num_rows = len(cols[0]) if cols else 0
 
     def rows(self) -> list[list]:
-        """Row-major python values (None for nulls) — protocol output."""
+        """Row-major python values (None for nulls) — protocol output.
+        Decimal columns render as scale-fixed strings (the exact wire
+        form; they compute as float64 internally, datatypes/types.py)."""
         out = []
         pycols = []
-        for c in self.cols:
+        for j, c in enumerate(self.cols):
             vals = c.values
             valid = c.valid_mask
-            pycols.append((vals, valid))
+            dt = self.types.get(self.names[j])
+            scale = (
+                dt.scale if dt is not None and dt.is_decimal() else None
+            )
+            pycols.append((vals, valid, scale))
         for i in range(self.num_rows):
             row = []
-            for vals, valid in pycols:
+            for vals, valid, scale in pycols:
                 if not valid[i]:
                     row.append(None)
                 else:
                     v = vals[i]
-                    row.append(v.item() if isinstance(v, np.generic) else v)
+                    if scale is not None:
+                        row.append(f"{float(v):.{scale}f}")
+                    else:
+                        row.append(
+                            v.item() if isinstance(v, np.generic) else v
+                        )
             out.append(row)
         return out
 

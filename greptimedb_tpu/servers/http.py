@@ -59,6 +59,8 @@ def _type_name(tn: str) -> str:
         "timestamp_ns": "TimestampNanosecond",
         "date": "Date", "json": "Json",
     }
+    if tn.startswith("decimal("):
+        return "Decimal128" + tn[len("decimal"):]
     return names.get(tn, tn)
 
 
@@ -121,7 +123,17 @@ class HttpServer:
                     return tls_sock, addr
 
                 def finish_request(self, request, client_address):
-                    request.do_handshake()
+                    try:
+                        request.do_handshake()
+                    except (ssl.SSLError, OSError):
+                        # plain-HTTP probes / port scans / stalled
+                        # handshakes: close quietly instead of dumping a
+                        # traceback per connection
+                        try:
+                            request.close()
+                        except OSError:
+                            pass
+                        return
                     request.settimeout(None)
                     super().finish_request(request, client_address)
 

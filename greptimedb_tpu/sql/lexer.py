@@ -91,11 +91,19 @@ def tokenize(sql: str) -> list[Token]:
         if c in ('"', "`"):
             close = c
             j = i + 1
-            while j < n and sql[j] != close:
-                j += 1
+            buf = []
+            while j < n:
+                if sql[j] == close and j + 1 < n and sql[j + 1] == close:
+                    buf.append(close)  # doubled quote escapes itself
+                    j += 2
+                elif sql[j] == close:
+                    break
+                else:
+                    buf.append(sql[j])
+                    j += 1
             if j >= n:
                 raise InvalidSyntaxError(f"unterminated identifier at {i}")
-            out.append(Token(Tok.QIDENT, sql[i + 1:j], i))
+            out.append(Token(Tok.QIDENT, "".join(buf), i))
             i = j + 1
             continue
         if sql[i:i + 2] in _TWO_CHAR_OPS:

@@ -218,9 +218,16 @@ class Parser:
             while True:
                 if self.eat_kw("ISOLATION"):
                     self.expect_kw("LEVEL")
-                    words = [self.ident().upper()]
-                    while self.at_kw("COMMITTED", "UNCOMMITTED", "READ"):
-                        words.append(self.ident().upper())
+                    first = self.ident().upper()
+                    # each level's word count is fixed; a greedy loop
+                    # would eat the READ of a following "READ ONLY"
+                    if first == "READ":
+                        words = [first, self.ident().upper()]
+                    elif first == "REPEATABLE":
+                        self.expect_kw("READ")
+                        words = [first, "READ"]
+                    else:  # SERIALIZABLE
+                        words = [first]
                     assignments.append((
                         "transaction_isolation", A.Literal("-".join(words)),
                     ))
@@ -232,8 +239,9 @@ class Parser:
                     )
                 else:
                     break
-                if not self.eat_op(","):
-                    break
+                # clauses may be comma-separated (MySQL) or juxtaposed
+                # (postgres: "... SERIALIZABLE READ ONLY")
+                self.eat_op(",")
             if not assignments:
                 raise InvalidSyntaxError(
                     f"expected ISOLATION or READ at {self.peek().pos}"
@@ -441,9 +449,11 @@ class Parser:
     def data_type(self) -> ConcreteDataType:
         base = self.ident().lower()
         if self.eat_op("("):
-            arg = self.next().text
+            args = [self.next().text]
+            while self.eat_op(","):
+                args.append(self.next().text)
             self.expect_op(")")
-            base = f"{base}({arg})"
+            base = f"{base}({','.join(args)})"
         if self.at_kw("UNSIGNED"):
             self.next()
             base = f"{base} unsigned"

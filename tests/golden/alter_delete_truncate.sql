@@ -1,0 +1,69 @@
+-- ALTER TABLE / DELETE / TRUNCATE behavior (ports the semantics of the
+-- reference's tests/cases/standalone/common/{alter,delete,truncate}/)
+
+CREATE TABLE monitor (
+  ts TIMESTAMP TIME INDEX,
+  host STRING PRIMARY KEY,
+  cpu DOUBLE
+);
+
+INSERT INTO monitor VALUES
+  (1000, 'a', 1.0), (2000, 'a', 2.0), (1000, 'b', 10.0), (3000, 'b', 30.0);
+
+-- add a column: existing rows read NULL for it
+ALTER TABLE monitor ADD COLUMN memory DOUBLE;
+
+SELECT host, cpu, memory FROM monitor ORDER BY ts, host;
+----
+host|cpu|memory
+a|1.0|NULL
+b|10.0|NULL
+a|2.0|NULL
+b|30.0|NULL
+
+INSERT INTO monitor (ts, host, cpu, memory) VALUES (4000, 'a', 4.0, 64.0);
+
+SELECT host, cpu, memory FROM monitor WHERE memory IS NOT NULL;
+----
+host|cpu|memory
+a|4.0|64.0
+
+-- delete one series row by primary key + time
+DELETE FROM monitor WHERE host = 'b' AND ts = 1000;
+
+SELECT host, cpu FROM monitor ORDER BY ts, host;
+----
+host|cpu
+a|1.0
+a|2.0
+b|30.0
+a|4.0
+
+-- drop the added column
+ALTER TABLE monitor DROP COLUMN memory;
+
+SELECT * FROM monitor WHERE host = 'a' ORDER BY ts LIMIT 1;
+----
+ts|host|cpu
+1000|a|1.0
+
+-- rename
+ALTER TABLE monitor RENAME monitor2;
+
+SELECT count(cpu) FROM monitor2;
+----
+count(cpu)
+4
+
+SELECT count(cpu) FROM monitor;
+----
+ERROR
+
+TRUNCATE TABLE monitor2;
+
+SELECT count(cpu) FROM monitor2;
+----
+count(cpu)
+0
+
+DROP TABLE monitor2;

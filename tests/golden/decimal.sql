@@ -1,0 +1,78 @@
+-- Decimal128 behavior (ports the semantics covered by the reference's
+-- tests/cases/standalone/common/types/decimal/ suite onto this engine:
+-- exact-scale rendering, ordering, casts, arithmetic; engine computes
+-- decimals as float64 — datatypes/types.py TypeId.DECIMAL)
+
+CREATE TABLE decimals (d DECIMAL(3, 2), ts TIMESTAMP TIME INDEX);
+
+INSERT INTO decimals VALUES (0.1, 1000), (0.2, 2000);
+
+SELECT d FROM decimals ORDER BY ts;
+----
+d
+0.10
+0.20
+
+SELECT d FROM decimals ORDER BY d DESC;
+----
+d
+0.20
+0.10
+
+SELECT d FROM decimals WHERE d = '0.1'::DECIMAL(3,2);
+----
+d
+0.10
+
+-- different scale on the comparison side still matches numerically
+SELECT d FROM decimals WHERE d >= '0.1'::DECIMAL(9,5) ORDER BY d;
+----
+d
+0.10
+0.20
+
+INSERT INTO decimals VALUES (0.11, 3000), (0.21, 4000);
+
+SELECT d FROM decimals WHERE d > '0.1'::DECIMAL(9,1) ORDER BY d;
+----
+d
+0.11
+0.20
+0.21
+
+-- scalar functions over decimal casts
+SELECT ABS('-0.1'::DECIMAL(4,3)) AS a, CEIL('10.5'::DECIMAL(4,1)) AS c;
+----
+a|c
+0.1|11.0
+
+SELECT FLOOR('-10.5'::DECIMAL(4,1)) AS f, ROUND('2.5'::DECIMAL(4,1)) AS r;
+----
+f|r
+-11.0|2.0
+
+-- arithmetic promotes to double
+SELECT d + 1 FROM decimals WHERE ts <= 2000 ORDER BY ts;
+----
+d + 1
+1.1
+1.2
+
+-- aggregates over decimal
+SELECT count(d) AS n, sum(d) AS s, max(d) AS m FROM decimals;
+----
+n|s|m
+4|0.62|0.21
+
+-- describe reports the exact type
+SHOW COLUMNS FROM decimals LIKE 'd';
+----
+Column|Type|Null|Key|Default
+d|decimal(3,2)|Yes||
+
+-- out-of-range decimal declarations error
+CREATE TABLE bad (d DECIMAL(99, 2), ts TIMESTAMP TIME INDEX);
+----
+ERROR
+
+DROP TABLE decimals;

@@ -1,0 +1,72 @@
+-- Session variables + ADMIN functions + MySQL-compat SHOW family
+-- (reference: tests/cases/standalone/common/show/ +
+-- src/sql/src/statements/admin.rs behaviors)
+
+CREATE TABLE host_metrics (
+  ts TIMESTAMP TIME INDEX,
+  host STRING PRIMARY KEY,
+  cpu DOUBLE
+);
+
+INSERT INTO host_metrics VALUES (1000, 'a', 1.5), (2000, 'b', 2.5);
+
+SET time_zone = '+08:00';
+
+SHOW VARIABLES LIKE 'time_zone';
+----
+Variable_name|Value
+time_zone|+08:00
+
+SET autocommit = 1, sql_mode = ANSI;
+
+SHOW VARIABLES LIKE 'autocommit';
+----
+Variable_name|Value
+autocommit|1
+
+SHOW COLUMNS FROM host_metrics;
+----
+Column|Type|Null|Key|Default
+ts|timestamp_ms|No|TIME INDEX|
+host|string|No|PRI|
+cpu|float64|Yes||
+
+SHOW INDEX FROM host_metrics;
+----
+Table|Key_name|Seq_in_index|Column_name
+host_metrics|PRIMARY|1|host
+host_metrics|TIME INDEX|1|ts
+
+-- flush makes the memtable durable as an SST; second flush is a no-op
+ADMIN flush_table('host_metrics');
+----
+ADMIN flush_table('host_metrics')
+1
+
+ADMIN flush_table('host_metrics');
+----
+ADMIN flush_table('host_metrics')
+0
+
+-- data survives the flush
+SELECT host, cpu FROM host_metrics ORDER BY ts;
+----
+host|cpu
+a|1.5
+b|2.5
+
+ADMIN compact_table('host_metrics');
+----
+ADMIN compact_table('host_metrics')
+0
+
+ADMIN kill('424242');
+----
+ADMIN kill('424242')
+0
+
+ADMIN no_such_function();
+----
+ERROR
+
+DROP TABLE host_metrics;

@@ -145,6 +145,12 @@ def test_set_connector_handshake_forms(inst):
         "SET TRANSACTION ISOLATION LEVEL REPEATABLE READ", ctx
     )
     assert ctx.variables["transaction_isolation"] == "REPEATABLE-READ"
+    # postgres juxtaposed form (no comma)
+    inst.execute_sql(
+        "SET TRANSACTION ISOLATION LEVEL SERIALIZABLE READ ONLY", ctx
+    )
+    assert ctx.variables["transaction_isolation"] == "SERIALIZABLE"
+    assert ctx.variables["transaction_read_only"] == "ON"
 
 
 def test_show_columns_qualified(inst):

@@ -1,0 +1,110 @@
+"""Decimal128 type: schema, ingest, query, persistence, COPY.
+
+Reference: src/common/decimal/src/decimal128.rs + the sqlness decimal
+cases. Engine representation is float64 (exact round-trip for
+precision <= 15); schema/wire/Parquet carry the exact (p, s) type.
+"""
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from greptimedb_tpu.datatypes.types import ConcreteDataType, TypeId
+from greptimedb_tpu.instance import Standalone
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    inst = Standalone(str(tmp_path / "data"), prefer_device=False,
+                      warm_start=False)
+    inst.execute_sql(
+        "create table prices (ts timestamp time index, "
+        "item string primary key, price decimal(10, 2), qty bigint)"
+    )
+    inst.execute_sql(
+        "insert into prices (ts, item, price, qty) values "
+        "(1000, 'a', 12.25, 3), (2000, 'b', 0.10, 1), "
+        "(3000, 'c', 1999.99, 2)"
+    )
+    yield inst
+    inst.close()
+
+
+def test_type_parsing_and_name():
+    t = ConcreteDataType.from_name("decimal(10,2)")
+    assert t.id == TypeId.DECIMAL and (t.precision, t.scale) == (10, 2)
+    assert t.name == "decimal(10,2)"
+    assert ConcreteDataType.from_name(t.name) == t  # persistence roundtrip
+    assert ConcreteDataType.from_name("numeric").precision == 38
+    with pytest.raises(ValueError):
+        ConcreteDataType.decimal128(50, 2)
+    with pytest.raises(ValueError):
+        ConcreteDataType.decimal128(10, 12)
+
+
+def test_select_renders_exact_scale(inst):
+    r = inst.sql("select item, price from prices order by ts")
+    rows = r.rows()
+    assert rows[0][1] == "12.25"
+    assert rows[1][1] == "0.10"
+    assert rows[2][1] == "1999.99"
+
+
+def test_describe_and_show_create(inst):
+    r = inst.sql("show columns from prices")
+    by_name = dict(zip(r.cols[0].values, r.cols[1].values))
+    assert by_name["price"] == "decimal(10,2)"
+    r = inst.sql("show create table prices")
+    assert "DECIMAL(10,2)" in str(r.cols[1].values[0]).upper()
+
+
+def test_filter_and_aggregate(inst):
+    r = inst.sql("select item from prices where price > 10 order by ts")
+    assert list(r.cols[0].values) == ["a", "c"]
+    r = inst.sql("select sum(price) from prices")
+    assert float(r.cols[0].values[0]) == pytest.approx(2012.34)
+
+
+def test_persistence_roundtrip(tmp_path, inst):
+    inst.catalog.table("public", "prices").flush()
+    inst.close()
+    inst2 = Standalone(str(tmp_path / "data"), prefer_device=False,
+                       warm_start=False)
+    try:
+        cs = inst2.catalog.table("public", "prices").schema.column("price")
+        assert cs.data_type == ConcreteDataType.decimal128(10, 2)
+        r = inst2.sql("select price from prices order by ts")
+        assert r.rows()[0][0] == "12.25"
+    finally:
+        inst2.close()
+
+
+def test_copy_to_writes_decimal_parquet(tmp_path, inst):
+    path = str(tmp_path / "prices.parquet")
+    inst.execute_sql(f"COPY prices TO '{path}' WITH (format = 'parquet')")
+    schema = pq.read_schema(path)
+    f = schema.field("price")
+    assert str(f.type) == "decimal128(10, 2)"
+    # and back
+    inst.execute_sql("create database rt")
+    from greptimedb_tpu.session import QueryContext
+
+    ctx = QueryContext(database="rt")
+    inst.execute_sql(
+        "create table prices (ts timestamp time index, "
+        "item string primary key, price decimal(10, 2), qty bigint)", ctx
+    )
+    inst.execute_sql(
+        f"COPY prices FROM '{path}' WITH (format = 'parquet')", ctx
+    )
+    r = inst.sql("select price from rt.prices order by ts")
+    assert r.rows()[0][0] == "12.25"
+
+
+def test_insert_string_literal_value(inst):
+    inst.execute_sql(
+        "insert into prices (ts, item, price, qty) values "
+        "(4000, 'd', '7.77', 1)"
+    )
+    r = inst.sql("select price from prices where item = 'd'")
+    assert r.rows()[0][0] == "7.77"

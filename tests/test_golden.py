@@ -86,15 +86,18 @@ def golden_files():
     "path", golden_files(), ids=lambda p: p.stem,
 )
 def test_golden(path, tmp_path):
+    from greptimedb_tpu.session import QueryContext
+
     inst = Standalone(str(tmp_path / "data"))
+    ctx = QueryContext()  # one session per case file, like sqlness
     try:
         for stmt, expected, line_no in parse_cases(path.read_text()):
             if expected == ["ERROR"]:
                 with pytest.raises(Exception):
-                    inst.sql(stmt)
+                    inst.sql(stmt, ctx)
                 continue
             try:
-                res = inst.sql(stmt)
+                res = inst.sql(stmt, ctx)
             except Exception as e:
                 raise AssertionError(
                     f"{path.name}:{line_no}: {stmt!r} failed: {e}"
