@@ -36,7 +36,7 @@ DEFAULTS: dict = {
     "postgres": {"addr": "127.0.0.1:4003", "enable": True},
     "opentsdb": {"enable": True},
     "influxdb": {"enable": True},
-    "wal": {"sync": False, "backend": "fs"},
+    "wal": {"sync": False, "backend": "fs", "topics": 4},
     "storage": {
         "type": "fs",            # fs | memory | s3
         # s3: bucket/endpoint/access_key_id/secret_access_key/region/root

@@ -31,9 +31,15 @@ class EngineConfig:
     # deployment shape (the reference's Kafka WAL,
     # src/log-store/src/kafka/), which makes region failover lossless.
     wal_root: str | None = None
-    # "fs" (node-local segment files) or "object" (ObjectStoreLogStore
-    # over the engine's object store — the remote-WAL topology)
+    # "fs" (node-local segment files), "object" (ObjectStoreLogStore
+    # over the engine's object store — the remote-WAL topology), or
+    # "shared" (N shared topics multiplexing all regions — the Kafka
+    # remote-WAL analog, /root/reference/src/log-store/src/kafka/)
     wal_backend: str = "fs"
+    # number of shared topics when wal_backend == "shared" (the
+    # WalOptionsAllocator analog assigns region -> topic round-robin,
+    # /root/reference/src/common/meta/src/wal_options_allocator/)
+    wal_topics: int = 4
 
 
 class TsdbEngine:
@@ -42,6 +48,7 @@ class TsdbEngine:
         self.config = config or EngineConfig()
         self.store = store or FsObjectStore(self.config.data_root)
         self._regions: dict[int, Region] = {}
+        self._topics: dict[int, object] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._bg: threading.Thread | None = None
@@ -91,12 +98,54 @@ class TsdbEngine:
             log_store = ObjectStoreLogStore(
                 wal_store, f"wal/region_{meta.region_id}"
             )
+        elif self.config.wal_backend == "shared":
+            from greptimedb_tpu.storage.wal import TopicRegionLog
+
+            topic_id = self._assign_topic(meta.region_id, wal_root)
+            topic = self._topic(topic_id, wal_root)
+            log_store = TopicRegionLog(topic, meta.region_id)
         elif self.config.wal_backend != "fs":
             raise ValueError(
                 f"unknown wal_backend {self.config.wal_backend!r} "
-                "(fs | object)"
+                "(fs | object | shared)"
             )
         return Region(meta, self.store, wal_dir, log_store=log_store)
+
+    def _assign_topic(self, region_id: int, wal_root: str) -> int:
+        """Persisted region->topic assignment (WalOptionsAllocator
+        analog): an existing region keeps its topic even if wal.topics
+        changes across restarts — recomputing the modulus would replay
+        the wrong topic and silently drop unflushed entries."""
+        import json
+
+        path = os.path.join(wal_root, "topics.json")
+        os.makedirs(wal_root, exist_ok=True)
+        assignments = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                assignments = {int(k): v for k, v in json.load(f).items()}
+        if region_id in assignments:
+            return assignments[region_id]
+        n = max(1, int(self.config.wal_topics))
+        topic_id = region_id % n
+        assignments[region_id] = topic_id
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({str(k): v for k, v in assignments.items()}, f)
+        os.replace(tmp, path)
+        return topic_id
+
+    def _topic(self, topic_id: int, wal_root: str):
+        """Open (once) the shared topic this region multiplexes into."""
+        from greptimedb_tpu.storage.wal import RegionWal, SharedWalTopic
+
+        topic = self._topics.get(topic_id)
+        if topic is None:
+            topic = SharedWalTopic(
+                RegionWal(os.path.join(wal_root, f"topic_{topic_id}"))
+            )
+            self._topics[topic_id] = topic
+        return topic
 
     def close_region(self, region_id: int):
         with self._lock:
@@ -114,9 +163,15 @@ class TsdbEngine:
                 self.store.delete(meta.path)
             for m in self.store.list(region.prefix + "/"):
                 self.store.delete(m.path)
-            import shutil
+            if hasattr(region.wal, "drop"):
+                # shared-topic view: forget the region so its dead
+                # entries stop pinning topic truncation
+                region.wal.drop()
+            wal_root = getattr(region.wal, "root", None)
+            if wal_root:
+                import shutil
 
-            shutil.rmtree(region.wal.root, ignore_errors=True)
+                shutil.rmtree(wal_root, ignore_errors=True)
 
     def region(self, region_id: int) -> Region:
         with self._lock:
@@ -167,3 +222,7 @@ class TsdbEngine:
             self._bg.join(timeout=10)
         for rid in list(self._regions):
             self.close_region(rid)
+        with self._lock:
+            for topic in self._topics.values():
+                topic.close()
+            self._topics.clear()

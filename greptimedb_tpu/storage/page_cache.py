@@ -1,0 +1,133 @@
+"""Decoded row-group cache for SST reads.
+
+Capability counterpart of the reference's in-memory page cache
+(/root/reference/src/mito2/src/cache/ — SST page LRU consulted by the
+parquet reader): selective queries that revisit the same row groups skip
+the Parquet decode entirely. Keys are (sst_path, row_group, column);
+SSTs are immutable, so entries never invalidate — the byte budget evicts
+least-recently-used columns.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+_DEFAULT_CAPACITY = 256 * 1024 * 1024
+
+
+class PageCache:
+    def __init__(self, capacity_bytes: int = _DEFAULT_CAPACITY):
+        self.capacity = capacity_bytes
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple):
+        """-> (values, validity|None) or None."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry[0]
+
+    def put(self, key: tuple, value, nbytes: int):
+        if nbytes > self.capacity:
+            return
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (value, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.capacity and self._entries:
+                _, (_, b) = self._entries.popitem(last=False)
+                self._bytes -= b
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    @property
+    def bytes(self) -> int:
+        return self._bytes
+
+
+global_page_cache = PageCache()
+
+
+def _col_nbytes(values: np.ndarray, validity) -> int:
+    n = values.nbytes if values.dtype != object else sum(
+        len(str(v)) + 48 for v in values
+    )
+    if validity is not None:
+        n += validity.nbytes
+    return n
+
+
+def read_columns(pf, path: str, groups: list[int], cols: list[str]):
+    """Read `cols` over `groups` of the ParquetFile `pf`, column-by-group
+    through the global cache. Returns {col: (values, validity|None)} with
+    arrays concatenated across groups in order."""
+    from greptimedb_tpu.query import stats
+
+    per_col: dict[str, list] = {c: [] for c in cols}
+    missing: dict[int, list[str]] = {}
+    for g in groups:
+        for c in cols:
+            hit = global_page_cache.get((path, g, c))
+            if hit is None:
+                missing.setdefault(g, []).append(c)
+            per_col[c].append(hit)  # placeholder (None) fixed below
+    n_miss = sum(len(v) for v in missing.values())
+    stats.add("page_cache_hit_cols", len(groups) * len(cols) - n_miss)
+    stats.add("page_cache_miss_cols", n_miss)
+    for g, want in missing.items():
+        tbl = pf.read_row_groups([g], columns=want)
+        for c in want:
+            import pyarrow as pa
+
+            arr = tbl.column(c)
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            is_str = (pa.types.is_string(arr.type)
+                      or pa.types.is_large_string(arr.type))
+            validity = None
+            if arr.null_count:
+                validity = np.asarray(arr.is_valid())
+                arr = arr.fill_null("" if is_str else 0)
+            if is_str:
+                values = np.asarray(arr.to_pylist(), dtype=object)
+            else:
+                values = np.asarray(arr)
+            values.setflags(write=False)
+            entry = (values, validity)
+            global_page_cache.put(
+                (path, g, c), entry, _col_nbytes(values, validity)
+            )
+            per_col[c][groups.index(g)] = entry
+    out = {}
+    for c in cols:
+        parts = per_col[c]
+        if len(parts) == 1:
+            out[c] = parts[0]
+        else:
+            values = np.concatenate([p[0] for p in parts])
+            if any(p[1] is not None for p in parts):
+                validity = np.concatenate([
+                    p[1] if p[1] is not None
+                    else np.ones(len(p[0]), bool)
+                    for p in parts
+                ])
+            else:
+                validity = None
+            out[c] = (values, validity)
+    return out

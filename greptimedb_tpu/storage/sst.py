@@ -310,12 +310,16 @@ def read_sst(
         stats.add("row_groups_pruned_fulltext", ft_pruned)
     if not groups:
         return None
-    table = pf.read_row_groups(groups, columns=cols)
+    # decoded row groups ride the page cache (SSTs are immutable;
+    # repeated selective queries skip the Parquet decode — the analog of
+    # /root/reference/src/mito2/src/cache/ page LRU)
+    from greptimedb_tpu.storage.page_cache import read_columns
 
-    sid = np.asarray(table.column(SERIES_COL))
-    ts = np.asarray(table.column(TS_COL))
-    seq = np.asarray(table.column(SEQ_COL))
-    op = np.asarray(table.column(OP_COL))
+    decoded = read_columns(pf, meta.path, groups, cols)
+    sid = decoded[SERIES_COL][0]
+    ts = decoded[TS_COL][0]
+    seq = decoded[SEQ_COL][0]
+    op = decoded[OP_COL][0]
     sel = np.ones(len(sid), dtype=bool)
     if ts_min is not None:
         sel &= ts >= ts_min
@@ -332,14 +336,13 @@ def read_sst(
     for name in wanted_fields:
         if name not in schema_names:
             continue
-        col = table.column(name)
-        if col.null_count:
+        values, validity = decoded[name]
+        if validity is not None:
             has_nulls = True
-            valids[name] = np.asarray(col.is_valid())[sel]
-            col = col.fill_null(0)
+            valids[name] = validity[sel]
         else:
             valids[name] = np.ones(int(sel.sum()), dtype=bool)
-        fields[name] = np.asarray(col)[sel]
+        fields[name] = values[sel]
     return ColumnarRows(
         sid=sid[sel], ts=ts[sel], seq=seq[sel], op=op[sel],
         fields=fields, field_valid=valids if has_nulls else None,

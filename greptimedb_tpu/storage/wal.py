@@ -330,3 +330,184 @@ class RegionWal(LogStore):
         # reopening an existing tail segment after restart is fine; torn
         # tails are tolerated by replay.
         return True
+
+
+# ----------------------------------------------------------------------
+# shared-topic WAL (Kafka remote-WAL analog)
+# ----------------------------------------------------------------------
+
+def _frame_topic_entry(region_id: int, region_eid: int,
+                       payload: bytes) -> bytes:
+    return (region_id.to_bytes(8, "little")
+            + region_eid.to_bytes(8, "little") + payload)
+
+
+def _unframe_topic_entry(data: bytes) -> tuple[int, int, bytes]:
+    return (int.from_bytes(data[:8], "little"),
+            int.from_bytes(data[8:16], "little"), data[16:])
+
+
+class SharedWalTopic:
+    """Many regions multiplexed into ONE log ("topic") — the capability
+    counterpart of the reference's Kafka remote WAL
+    (/root/reference/src/log-store/src/kafka/log_store.rs:45): entries
+    carry (region_id, per-region entry id, payload); per-region LogStore
+    views demultiplex at replay like the entry distributor
+    (src/mito2/src/wal/entry_distributor.rs).
+
+    The physical log is any LogStore (RegionWal segment files for
+    node-local, ObjectStoreLogStore for the shared/remote topology).
+    Truncation honors the slowest region: a physical entry is dropped
+    only once every region has flushed past its entries in that prefix
+    (kafka/log_store.rs obsolete via per-region offsets)."""
+
+    def __init__(self, inner: LogStore):
+        self.inner = inner
+        self._lock = threading.Lock()
+        # region_id -> last region entry id handed out
+        self._last_eid: dict[int, int] = {}
+        # region_id -> [(region_eid, global_id)], ascending
+        self._index: dict[int, list[tuple[int, int]]] = {}
+        # region_id -> obsolete mark (region entry ids <= mark are dead)
+        self._marks: dict[int, int] = {}
+        # entry-distributor startup buffers: the open-time scan retains
+        # decoded entries per region so R region replays cost ONE pass
+        # over the physical log, not R (src/mito2/src/wal/
+        # entry_distributor.rs). A region's buffer is dropped at its
+        # first replay or append; late replays fall back to a log scan.
+        self._startup: dict[int, list[WalEntry]] = {}
+        for e in self.inner.replay(0):
+            rid, reid, payload = _unframe_topic_entry(e.payload)
+            self._last_eid[rid] = max(self._last_eid.get(rid, -1), reid)
+            self._index.setdefault(rid, []).append((reid, e.entry_id))
+            self._startup.setdefault(rid, []).append(
+                WalEntry(reid, payload)
+            )
+
+    # ---- per-region surface -------------------------------------------
+    def append(self, region_id: int, payload: bytes) -> int:
+        with self._lock:
+            self._startup.pop(region_id, None)
+            reid = self._last_eid.get(region_id, -1) + 1
+            gid = self.inner.append(
+                _frame_topic_entry(region_id, reid, payload)
+            )
+            self._last_eid[region_id] = reid
+            self._index.setdefault(region_id, []).append((reid, gid))
+            return reid
+
+    def append_batch(self, region_id: int, payloads: list[bytes]) -> int:
+        with self._lock:
+            self._startup.pop(region_id, None)
+            start = self._last_eid.get(region_id, -1) + 1
+            if not payloads:
+                return start - 1
+            framed = [
+                _frame_topic_entry(region_id, start + i, p)
+                for i, p in enumerate(payloads)
+            ]
+            last_gid = self.inner.append_batch(framed)
+            first_gid = last_gid - len(payloads) + 1
+            idx = self._index.setdefault(region_id, [])
+            idx.extend(
+                (start + i, first_gid + i) for i in range(len(payloads))
+            )
+            self._last_eid[region_id] = start + len(payloads) - 1
+            return start + len(payloads) - 1
+
+    def replay(self, region_id: int, from_eid: int = 0) -> list[WalEntry]:
+        with self._lock:
+            buf = self._startup.pop(region_id, None)
+            if buf is not None:
+                return [e for e in buf if e.entry_id >= from_eid]
+            idx = self._index.get(region_id, [])
+            start_gid = None
+            for reid, gid in idx:
+                if reid >= from_eid:
+                    start_gid = gid
+                    break
+            if start_gid is None:
+                return []
+            out = []
+            for e in self.inner.replay(start_gid):
+                rid, reid, payload = _unframe_topic_entry(e.payload)
+                if rid == region_id and reid >= from_eid:
+                    out.append(WalEntry(reid, payload))
+            return out
+
+    def obsolete(self, region_id: int, up_to_eid: int) -> None:
+        """Advance the region's mark, then truncate the longest physical
+        prefix every region has flushed past."""
+        with self._lock:
+            self._marks[region_id] = max(
+                self._marks.get(region_id, -1), up_to_eid
+            )
+            self._truncate_locked()
+            for rid in list(self._index):
+                mark = self._marks.get(rid, -1)
+                self._index[rid] = [
+                    (reid, gid) for reid, gid in self._index[rid]
+                    if reid > mark
+                ]
+
+    def next_entry_id_for(self, region_id: int) -> int:
+        with self._lock:
+            return self._last_eid.get(region_id, -1) + 1
+
+    def drop_region(self, region_id: int) -> None:
+        """Forget a dropped region so its dead entries stop pinning
+        truncation (the per-region offset removal of kafka obsolete)."""
+        with self._lock:
+            self._index.pop(region_id, None)
+            self._last_eid.pop(region_id, None)
+            self._marks.pop(region_id, None)
+            self._startup.pop(region_id, None)
+            self._truncate_locked()
+
+    def _truncate_locked(self):
+        cutoff = None
+        for rid, idx in self._index.items():
+            mark = self._marks.get(rid, -1)
+            live = [gid for reid, gid in idx if reid > mark]
+            if live:
+                first_live = live[0]
+                cutoff = (first_live if cutoff is None
+                          else min(cutoff, first_live))
+        if cutoff is None:
+            cutoff = self.inner.next_entry_id
+        if cutoff > 0:
+            self.inner.obsolete(cutoff - 1)
+
+    def close(self):
+        self.inner.close()
+
+
+class TopicRegionLog(LogStore):
+    """One region's LogStore view over a SharedWalTopic. Closing the view
+    does NOT close the topic (the engine owns topic lifecycle)."""
+
+    def __init__(self, topic: SharedWalTopic, region_id: int):
+        self.topic = topic
+        self.region_id = region_id
+
+    def append(self, payload: bytes) -> int:
+        return self.topic.append(self.region_id, payload)
+
+    def append_batch(self, payloads: list[bytes]) -> int:
+        return self.topic.append_batch(self.region_id, payloads)
+
+    def replay(self, from_id: int = 0) -> list[WalEntry]:
+        return self.topic.replay(self.region_id, from_id)
+
+    def obsolete(self, up_to_id: int) -> None:
+        self.topic.obsolete(self.region_id, up_to_id)
+
+    def drop(self) -> None:
+        self.topic.drop_region(self.region_id)
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def next_entry_id(self) -> int:
+        return self.topic.next_entry_id_for(self.region_id)

@@ -60,6 +60,8 @@ class ExportMetricsTask:
         self._thread: threading.Thread | None = None
         self.runs = 0
         self.samples_written = 0
+        self.failures = 0
+        self._last_error: str | None = None
 
     def start(self):
         self.instance.catalog.create_database(self.db, if_not_exists=True)
@@ -81,11 +83,25 @@ class ExportMetricsTask:
         self.runs += 1
 
     def _loop(self):
+        import logging
+
         while not self._stop.wait(self.interval_s):
             try:
                 self.tick()
-            except Exception:  # metrics export must never take the node down
-                pass
+            except Exception as e:  # export must never take the node down,
+                # but persistent failures need a trace: log each distinct
+                # error once and count every failure in the registry
+                self.failures += 1
+                global_registry.counter(
+                    "greptime_export_metrics_failures_total",
+                    "metrics self-export tick failures",
+                ).inc()
+                msg = f"{type(e).__name__}: {e}"
+                if msg != self._last_error:
+                    self._last_error = msg
+                    logging.getLogger("greptimedb_tpu.export").warning(
+                        "metrics self-export failing: %s", msg
+                    )
 
     def stop(self):
         self._stop.set()
