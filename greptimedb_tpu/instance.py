@@ -348,6 +348,35 @@ class Standalone:
             ))
         if isinstance(stmt, A.ShowProcesslist):
             return Output.records(self._show_processlist(stmt))
+        if isinstance(stmt, A.Prepare):
+            ctx.extensions.setdefault("prepared", {})[
+                stmt.name.lower()
+            ] = stmt.sql_text
+            return Output.rows(0)
+        if isinstance(stmt, A.Execute):
+            prepared = ctx.extensions.get("prepared", {})
+            text = prepared.get(stmt.name.lower())
+            if text is None:
+                raise InvalidArgumentError(
+                    f"prepared statement {stmt.name!r} does not exist"
+                )
+            args = [eval_const(a) for a in stmt.args]
+            sub = substitute_placeholders(text, args)
+            stmts = parse_sql(sub)
+            if len(stmts) != 1:
+                raise InvalidArgumentError(
+                    "prepared statement must be a single statement"
+                )
+            return self._execute_statement(stmts[0], ctx)
+        if isinstance(stmt, A.Deallocate):
+            prepared = ctx.extensions.get("prepared", {})
+            if stmt.name == "all":
+                prepared.clear()
+            elif prepared.pop(stmt.name.lower(), None) is None:
+                raise InvalidArgumentError(
+                    f"prepared statement {stmt.name!r} does not exist"
+                )
+            return Output.rows(0)
         raise UnsupportedError(
             f"statement not supported yet: {type(stmt).__name__}"
         )
@@ -580,6 +609,20 @@ class Standalone:
     # ------------------------------------------------------------------
     def _create_table(self, stmt: A.CreateTable, ctx: QueryContext):
         db, name = self._resolve(stmt.name, ctx)
+        if stmt.like_table is not None:
+            # CREATE TABLE t LIKE s: clone the source's schema + options
+            # (reference: src/operator/src/statement.rs CreateTableLike)
+            sdb, sname = self._resolve(stmt.like_table, ctx)
+            src = self.catalog.table(sdb, sname)
+            self.catalog.create_table(
+                db, name, Schema(list(src.schema.columns)),
+                engine=src.info.engine,
+                options=dict(src.info.options),
+                num_regions=len(src.regions),
+                if_not_exists=stmt.if_not_exists,
+                partition=src.info.partition,
+            )
+            return
         cols = []
         pk = set(stmt.primary_keys)
         for cd in stmt.columns:
@@ -964,6 +1007,93 @@ class Standalone:
             db, t = name.split(".", 1)
             return db, t
         return ctx.database, name
+
+
+def format_sql_literal(v) -> str:
+    """Python value -> SQL literal text (prepared-statement binding).
+    Backslashes are escaped because the lexer treats \\x as an escape
+    inside strings — an unescaped trailing backslash would swallow the
+    closing quote (injection risk on the wire paths)."""
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, (int, np.integer)):
+        return str(int(v))
+    if isinstance(v, (float, np.floating)):
+        return repr(float(v))
+    s = str(v).replace("\\", "\\\\").replace("'", "''")
+    return f"'{s}'"
+
+
+def _scan_sql_segments(text: str):
+    """Yields ('text'|'quoted'|'qmark'|'dollar', segment) pieces; the ONE
+    quoting state machine shared by placeholder substitution and the
+    MySQL COM_STMT_PREPARE parameter counter."""
+    import re as _re
+
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c in ("'", '"', "`"):
+            close = c
+            j = i + 1
+            while j < n:
+                if text[j] == close and j + 1 < n and text[j + 1] == close:
+                    j += 2
+                elif text[j] == "\\" and close == "'" and j + 1 < n:
+                    j += 2
+                elif text[j] == close:
+                    break
+                else:
+                    j += 1
+            yield "quoted", text[i:j + 1]
+            i = j + 1
+            continue
+        if c == "?":
+            yield "qmark", "?"
+            i += 1
+            continue
+        if c == "$":
+            m = _re.match(r"\$(\d+)", text[i:])
+            if m:
+                yield "dollar", m.group(1)
+                i += m.end()
+                continue
+        yield "text", c
+        i += 1
+
+
+def count_placeholders(text: str) -> int:
+    """`?` placeholders outside string/quoted-identifier regions."""
+    return sum(1 for kind, _ in _scan_sql_segments(text) if kind == "qmark")
+
+
+def substitute_placeholders(text: str, args: list) -> str:
+    """Replace ? (positional) and $n placeholders outside string/quoted
+    regions with literal-formatted args (PREPARE/EXECUTE binding — the
+    reference binds through sqlparser placeholders; this engine binds at
+    the text layer before parsing)."""
+    out = []
+    pos = 0  # next ? index
+    for kind, seg in _scan_sql_segments(text):
+        if kind == "qmark":
+            if pos >= len(args):
+                raise InvalidArgumentError(
+                    f"not enough parameters: need > {pos}, have {len(args)}"
+                )
+            out.append(format_sql_literal(args[pos]))
+            pos += 1
+        elif kind == "dollar":
+            k = int(seg)
+            if not (1 <= k <= len(args)):
+                raise InvalidArgumentError(
+                    f"parameter ${k} out of range (have {len(args)})"
+                )
+            out.append(format_sql_literal(args[k - 1]))
+        else:
+            out.append(seg)
+    return "".join(out)
 
 
 def _coerce_insert(vals: list, dt: ConcreteDataType):

@@ -244,7 +244,22 @@ def _eval_binary(e: A.BinaryOp, src: ColumnSource) -> Col:
             np.asarray([str(x) + str(y) for x, y in zip(av, bv)], object),
             validity,
         )
-    # arithmetic
+    # arithmetic — a string combined with an INTERVAL is a timestamp
+    # literal ('2024-01-01' - interval '1 hour'), matching the
+    # reference's implicit timestamp coercion
+    if op in ("+", "-"):
+        def _as_ts(col: Col) -> Col:
+            valid = col.valid_mask
+            out = np.zeros(len(col.values), np.int64)
+            for k, v in enumerate(col.values):
+                if valid[k]:  # null slots stay 0 and propagate as NULL
+                    out[k] = parse_ts_literal(str(v))
+            return Col(out, col.validity)
+
+        if isinstance(e.right, A.IntervalLit) and a.values.dtype == object:
+            a = _as_ts(a)
+        if isinstance(e.left, A.IntervalLit) and b.values.dtype == object:
+            b = _as_ts(b)
     av, bv = a.values, b.values
     with np.errstate(divide="ignore", invalid="ignore"):
         if op == "+":

@@ -469,6 +469,37 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             db = params.get("db", "public")
             ctx = QueryContext(database=db)
             engine = PromEngine(instance, ctx)
+            if endpoint == "status/buildinfo":
+                # Grafana probes this before issuing queries
+                return self._json(200, {"status": "success", "data": {
+                    "version": "2.53.0",
+                    "revision": __version__, "branch": "HEAD",
+                    "buildUser": "", "buildDate": "", "goVersion": "",
+                    "application": "greptimedb-tpu",
+                }})
+            if endpoint == "metadata":
+                data = {}
+                limit = int(params.get("limit", "-1") or -1)
+                for t in instance.catalog.all_tables():
+                    if t.info.database != db:
+                        continue
+                    if limit >= 0 and len(data) >= limit:
+                        break
+                    data[t.name] = [
+                        {"type": "gauge", "help": "", "unit": ""}
+                    ]
+                return self._json(
+                    200, {"status": "success", "data": data}
+                )
+            if endpoint == "rules":
+                return self._json(200, {
+                    "status": "success", "data": {"groups": []}
+                })
+            if endpoint == "alertmanagers":
+                return self._json(200, {"status": "success", "data": {
+                    "activeAlertmanagers": [],
+                    "droppedAlertmanagers": [],
+                }})
             if endpoint == "query_range":
                 return self._handle_promql_range(params)
             if endpoint == "query":

@@ -47,12 +47,26 @@ T_DOUBLE = 0x05
 T_DATETIME = 0x0C
 T_VAR_STRING = 0xFD
 
+T_NULL = 0x06
+T_SHORT = 0x02
+T_LONG = 0x03
+T_FLOAT = 0x04
+T_TIMESTAMP = 0x07
+T_TIME = 0x0B
+T_NEWDECIMAL = 0xF6
+T_BLOB = 0xFC
+T_STRING = 0xFE
+T_VARCHAR = 0x0F
+
 COM_QUIT = 0x01
 COM_INIT_DB = 0x02
 COM_QUERY = 0x03
 COM_FIELD_LIST = 0x04
 COM_PING = 0x0E
 COM_STMT_PREPARE = 0x16
+COM_STMT_EXECUTE = 0x17
+COM_STMT_CLOSE = 0x19
+COM_STMT_RESET = 0x1A
 
 from greptimedb_tpu.session import DEFAULT_VARIABLES as _DEFAULT_VARS
 
@@ -196,6 +210,10 @@ class _Handler(socketserver.BaseRequestHandler):
         if db:
             ctx.database = db
         conn.send_packet(self._ok())
+        # binary prepared statements: per-connection registry
+        # stmt_id -> [sql, n_params, last_bound_types]
+        # (src/servers/src/mysql/handler.rs prepared-statement support)
+        stmts: dict[int, list] = {}
 
         while True:
             conn.reset_seq()
@@ -224,6 +242,21 @@ class _Handler(socketserver.BaseRequestHandler):
                 continue
             if cmd == COM_FIELD_LIST:
                 conn.send_packet(self._eof())
+                continue
+            if cmd == COM_STMT_PREPARE:
+                self._stmt_prepare(
+                    conn, stmts, pkt[1:].decode("utf-8", "replace")
+                )
+                continue
+            if cmd == COM_STMT_EXECUTE:
+                self._stmt_execute(conn, inst, ctx, stmts, pkt)
+                continue
+            if cmd == COM_STMT_CLOSE:
+                if len(pkt) >= 5:
+                    stmts.pop(struct.unpack("<I", pkt[1:5])[0], None)
+                continue  # no response, per protocol
+            if cmd == COM_STMT_RESET:
+                conn.send_packet(self._ok())
                 continue
             conn.send_packet(self._err(1047, "08S01", "unsupported command"))
 
@@ -351,6 +384,195 @@ class _Handler(socketserver.BaseRequestHandler):
         conn.send_packet(b"".join(_lenc_str(v.encode()) for v in vals))
         conn.send_packet(self._eof())
 
+    # ---- binary prepared statements -----------------------------------
+    def _stmt_prepare(self, conn: _Conn, stmts: dict, sql: str):
+        from greptimedb_tpu.instance import count_placeholders
+
+        n_params = count_placeholders(sql)
+        sid = max(stmts, default=0) + 1
+        # entry: [sql, n_params, last_bound_types] — libmysqlclient sends
+        # parameter types only on the FIRST execute (new_params_bind_flag
+        # 0 afterwards), so the types must be remembered here
+        stmts[sid] = [sql, n_params, None]
+        # COM_STMT_PREPARE_OK: status, stmt_id, num_columns (0: result
+        # metadata is sent with each execute), num_params, filler,
+        # warning count
+        head = (b"\x00" + struct.pack("<I", sid)
+                + struct.pack("<H", 0) + struct.pack("<H", n_params)
+                + b"\x00" + struct.pack("<H", 0))
+        conn.send_packet(head)
+        if n_params:
+            for k in range(n_params):
+                conn.send_packet(self._col_def(f"?{k}", T_VAR_STRING))
+            conn.send_packet(self._eof())
+
+    def _stmt_execute(self, conn: _Conn, inst, ctx, stmts: dict,
+                      pkt: bytes):
+        if len(pkt) < 10:
+            conn.send_packet(self._err(1064, "42000", "malformed execute"))
+            return
+        sid = struct.unpack("<I", pkt[1:5])[0]
+        entry = stmts.get(sid)
+        if entry is None:
+            conn.send_packet(self._err(
+                1243, "HY000", f"Unknown prepared statement handler {sid}"
+            ))
+            return
+        sql, n_params, bound_types = entry
+        try:
+            args, types = self._decode_exec_params(
+                pkt, n_params, bound_types
+            )
+            entry[2] = types
+        except Exception as e:  # noqa: BLE001 - protocol boundary
+            conn.send_packet(self._err(1210, "HY000", str(e)))
+            return
+        from greptimedb_tpu.instance import substitute_placeholders
+
+        try:
+            bound = substitute_placeholders(sql, args)
+            outs = inst.execute_sql(bound, ctx)
+        except Exception as e:  # noqa: BLE001 - protocol boundary
+            conn.send_packet(self._err(1064, "42000", str(e)))
+            return
+        out = outs[-1]
+        if out.result is None:
+            conn.send_packet(self._ok(out.affected_rows or 0))
+            return
+        self._send_resultset_binary(conn, out.result)
+
+    @staticmethod
+    def _decode_exec_params(pkt: bytes, n_params: int,
+                            bound_types) -> tuple[list, list]:
+        """COM_STMT_EXECUTE payload -> (values, types). types from the
+        packet when new_params_bind_flag is set, else the remembered
+        binding from a previous execute."""
+        if n_params == 0:
+            return [], []
+        off = 10  # cmd(1) stmt_id(4) flags(1) iterations(4)
+        nb = (n_params + 7) // 8
+        null_bitmap = pkt[off:off + nb]
+        off += nb
+        new_bound = pkt[off]
+        off += 1
+        if new_bound:
+            types = []
+            for _ in range(n_params):
+                types.append((pkt[off], pkt[off + 1]))
+                off += 2
+        elif bound_types is not None and len(bound_types) == n_params:
+            types = bound_types
+        else:
+            raise ValueError("parameter types were never bound")
+        args: list = []
+
+        def lenc(o: int) -> tuple[int, int]:
+            b0 = pkt[o]
+            if b0 < 0xFB:
+                return b0, o + 1
+            if b0 == 0xFC:
+                return struct.unpack("<H", pkt[o + 1:o + 3])[0], o + 3
+            if b0 == 0xFD:
+                return int.from_bytes(pkt[o + 1:o + 4], "little"), o + 4
+            return struct.unpack("<Q", pkt[o + 1:o + 9])[0], o + 9
+
+        for k, (t, flags) in enumerate(types):
+            if null_bitmap[k // 8] & (1 << (k % 8)):
+                args.append(None)
+                continue
+            unsigned = bool(flags & 0x80)
+            if t == T_NULL:
+                args.append(None)
+            elif t == T_TINY:
+                v = pkt[off]
+                args.append(v if unsigned else
+                            struct.unpack("<b", pkt[off:off + 1])[0])
+                off += 1
+            elif t == T_SHORT:
+                fmt = "<H" if unsigned else "<h"
+                args.append(struct.unpack(fmt, pkt[off:off + 2])[0])
+                off += 2
+            elif t == T_LONG:
+                fmt = "<I" if unsigned else "<i"
+                args.append(struct.unpack(fmt, pkt[off:off + 4])[0])
+                off += 4
+            elif t == T_LONGLONG:
+                fmt = "<Q" if unsigned else "<q"
+                args.append(struct.unpack(fmt, pkt[off:off + 8])[0])
+                off += 8
+            elif t == T_FLOAT:
+                args.append(struct.unpack("<f", pkt[off:off + 4])[0])
+                off += 4
+            elif t == T_DOUBLE:
+                args.append(struct.unpack("<d", pkt[off:off + 8])[0])
+                off += 8
+            elif t in (T_VARCHAR, T_VAR_STRING, T_STRING, T_BLOB,
+                       T_NEWDECIMAL):
+                ln, off = lenc(off)
+                args.append(pkt[off:off + ln].decode("utf-8", "replace"))
+                off += ln
+            elif t in (T_DATETIME, T_TIMESTAMP):
+                ln = pkt[off]
+                off += 1
+                y = mo = d = h = mi = s = us = 0
+                if ln >= 4:
+                    y, mo, d = struct.unpack("<HBB", pkt[off:off + 4])
+                if ln >= 7:
+                    h, mi, s = pkt[off + 4], pkt[off + 5], pkt[off + 6]
+                if ln >= 11:
+                    us = struct.unpack("<I", pkt[off + 7:off + 11])[0]
+                off += ln
+                args.append(
+                    f"{y:04d}-{mo:02d}-{d:02d} {h:02d}:{mi:02d}:{s:02d}"
+                    + (f".{us:06d}" if us else "")
+                )
+            else:
+                raise ValueError(f"unsupported parameter type {t:#x}")
+        return args, types
+
+
+    @staticmethod
+    def _format_value(v, is_ts: bool) -> str:
+        """One wire value as text (shared by text and binary resultsets)."""
+        if is_ts:
+            dt = datetime.datetime.fromtimestamp(
+                int(v) / 1000.0, tz=datetime.timezone.utc
+            )
+            return dt.strftime("%Y-%m-%d %H:%M:%S.%f")
+        if isinstance(v, bool):
+            return "1" if v else "0"
+        if isinstance(v, float):
+            return repr(v)
+        return str(v)
+
+    def _send_resultset_binary(self, conn: _Conn, res):
+        """Binary-protocol resultset: all columns declared VAR_STRING and
+        encoded as length-encoded strings (the values the text protocol
+        would send), which every connector decodes by declared type."""
+        names = res.names
+        conn.send_packet(_lenc_int(len(names)))
+        for n in names:
+            conn.send_packet(self._col_def(n, T_VAR_STRING))
+        conn.send_packet(self._eof())
+        ts_cols = {
+            i for i, n in enumerate(names)
+            if (dt := res.types.get(n)) is not None and dt.is_timestamp()
+        }
+        for row in res.rows():
+            nb = (len(row) + 7 + 2) // 8
+            bitmap = bytearray(nb)
+            parts = []
+            for i, v in enumerate(row):
+                if v is None:
+                    pos = i + 2  # binary-row null bitmap offset is 2
+                    bitmap[pos // 8] |= 1 << (pos % 8)
+                    continue
+                parts.append(_lenc_str(
+                    self._format_value(v, i in ts_cols).encode()
+                ))
+            conn.send_packet(b"\x00" + bytes(bitmap) + b"".join(parts))
+        conn.send_packet(self._eof())
+
     def _send_resultset(self, conn: _Conn, res):
         names = res.names
         type_bytes = []
@@ -379,18 +601,9 @@ class _Handler(socketserver.BaseRequestHandler):
                 if v is None:
                     parts.append(b"\xfb")
                     continue
-                if i in ts_cols:
-                    dt = datetime.datetime.fromtimestamp(
-                        int(v) / 1000.0, tz=datetime.timezone.utc
-                    )
-                    s = dt.strftime("%Y-%m-%d %H:%M:%S.%f")
-                elif isinstance(v, bool):
-                    s = "1" if v else "0"
-                elif isinstance(v, float):
-                    s = repr(v)
-                else:
-                    s = str(v)
-                parts.append(_lenc_str(s.encode()))
+                parts.append(_lenc_str(
+                    self._format_value(v, i in ts_cols).encode()
+                ))
             conn.send_packet(b"".join(parts))
         conn.send_packet(self._eof())
 

@@ -188,6 +188,7 @@ class CreateTable(Statement):
     options: dict = field(default_factory=dict)
     partitions: list[Expr] = field(default_factory=list)
     partition_columns: list[str] = field(default_factory=list)
+    like_table: str | None = None   # CREATE TABLE t LIKE source
 
 
 @dataclass
@@ -446,3 +447,25 @@ class ShowCollation(Statement):
 @dataclass
 class ShowProcesslist(Statement):
     full: bool = False
+
+
+@dataclass
+class Prepare(Statement):
+    """PREPARE name FROM '<sql>' (MySQL) | PREPARE name AS <stmt> (PG).
+    The statement text is stored per-session with ?/$n placeholders."""
+
+    name: str
+    sql_text: str
+
+
+@dataclass
+class Execute(Statement):
+    """EXECUTE name [(args...)] | EXECUTE name USING args..."""
+
+    name: str
+    args: list[Expr] = field(default_factory=list)
+
+
+@dataclass
+class Deallocate(Statement):
+    name: str

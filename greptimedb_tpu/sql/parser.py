@@ -173,7 +173,57 @@ class Parser:
             if self.at_kw("QUERY") or self.at_kw("CONNECTION"):
                 self.next()
             return A.Admin("kill", [self.expr()])
+        if kw == "PREPARE":
+            return self.prepare()
+        if kw == "EXECUTE":
+            return self.execute_stmt()
+        if kw == "DEALLOCATE":
+            self.next()
+            self.eat_kw("PREPARE")
+            if self.at_kw("ALL"):
+                self.next()
+                return A.Deallocate("all")
+            return A.Deallocate(self.ident())
         raise InvalidSyntaxError(f"unsupported statement {t.text!r} at {t.pos}")
+
+    def prepare(self) -> A.Statement:
+        self.expect_kw("PREPARE")
+        name = self.ident()
+        if self.eat_kw("FROM"):
+            t = self.next()
+            if t.kind != Tok.STRING:
+                raise InvalidSyntaxError(
+                    f"PREPARE ... FROM expects a string at {t.pos}"
+                )
+            return A.Prepare(name, t.text)
+        self.expect_kw("AS")
+        start = self.peek().pos
+        while self.peek().kind != Tok.EOF and not self.at_op(";"):
+            self.next()
+        t = self.peek()
+        end = t.pos if t.kind != Tok.EOF else len(self.sql)
+        text = self.sql[start:end].strip()
+        if not text:
+            raise InvalidSyntaxError("empty PREPARE body")
+        return A.Prepare(name, text)
+
+    def execute_stmt(self) -> A.Statement:
+        self.expect_kw("EXECUTE")
+        name = self.ident()
+        args: list[A.Expr] = []
+        if self.eat_op("("):
+            if not self.eat_op(")"):
+                while True:
+                    args.append(self.expr())
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+        elif self.eat_kw("USING"):
+            while True:
+                args.append(self.expr())
+                if not self.eat_op(","):
+                    break
+        return A.Execute(name, args)
 
     def admin(self) -> A.Statement:
         self.expect_kw("ADMIN")
@@ -334,6 +384,12 @@ class Parser:
     def create_table(self, external: bool = False) -> A.CreateTable:
         ine = self._if_not_exists()
         name = self.qualified_name()
+        if self.at_kw("LIKE"):
+            self.next()
+            src = self.qualified_name()
+            return A.CreateTable(
+                name, [], None, [], if_not_exists=ine, like_table=src
+            )
         columns: list[A.ColumnDef] = []
         time_index: str | None = None
         primary_keys: list[str] = []
@@ -1116,6 +1172,19 @@ class Parser:
                 self.next()
                 text = self._interval_text()
                 return A.IntervalLit(parse_interval_ms(text), text)
+            if up in ("TIMESTAMP", "DATE", "TIME") \
+                    and self.peek(1).kind == Tok.STRING:
+                # typed literals: TIMESTAMP '2024-01-01 00:00:00'
+                self.next()
+                lit = self.next().text
+                if up == "TIMESTAMP":
+                    return A.Cast(
+                        A.Literal(lit),
+                        ConcreteDataType.timestamp_millisecond(),
+                    )
+                if up == "DATE":
+                    return A.Cast(A.Literal(lit), ConcreteDataType.date())
+                return A.Literal(lit)
             if up == "CASE":
                 return self.case_expr()
             if up == "CAST":

@@ -130,6 +130,13 @@ class ObjectStoreLogStore(LogStore):
     def append(self, payload: bytes) -> int:
         return self.append_batch([payload])
 
+    def drop(self) -> None:
+        """Delete every log object (region dropped) — without this the
+        wal/region_N prefix would leak in the object store forever."""
+        with self._lock:
+            for p in self._objects():
+                self.store.delete(p)
+
     def append_batch(self, payloads: list[bytes]) -> int:
         if not payloads:
             return self._next_id - 1
@@ -262,6 +269,17 @@ class RegionWal(LogStore):
         return _scan_records(data, from_id)
 
     # ---- maintenance --------------------------------------------------
+    def drop(self) -> None:
+        """Delete the whole log (region dropped)."""
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+                self._fh = None
+                self._fh_path = None
+            import shutil
+
+            shutil.rmtree(self.root, ignore_errors=True)
+
     def obsolete(self, up_to_id: int) -> None:
         """Drop entries with id <= up_to_id (whole segments only)."""
         with self._lock:

@@ -1,0 +1,136 @@
+"""SQL PREPARE/EXECUTE/DEALLOCATE, CREATE TABLE LIKE, typed literals,
+interval-timestamp coercion (reference: src/operator/src/statement.rs
+Prepare/Execute + CreateTableLike; src/common/time interval exprs)."""
+
+import pytest
+
+from greptimedb_tpu.errors import InvalidArgumentError
+from greptimedb_tpu.instance import Standalone, substitute_placeholders
+from greptimedb_tpu.session import QueryContext
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    inst = Standalone(str(tmp_path / "data"), prefer_device=False,
+                      warm_start=False)
+    inst.execute_sql(
+        "create table t (ts timestamp time index, host string primary "
+        "key, v double)"
+    )
+    inst.execute_sql(
+        "insert into t values (1000,'a',1.0), (2000,'b',2.0)"
+    )
+    yield inst
+    inst.close()
+
+
+def test_substitute_placeholders():
+    assert substitute_placeholders("select ?", [1]) == "select 1"
+    assert substitute_placeholders(
+        "select * from t where a = ? and b = ?", [1.5, "x'y"]
+    ) == "select * from t where a = 1.5 and b = 'x''y'"
+    assert substitute_placeholders(
+        "select $2, $1", ["a", None]
+    ) == "select NULL, 'a'"
+    # placeholders inside strings are untouched
+    assert substitute_placeholders("select '?', ?", [5]) == "select '?', 5"
+    with pytest.raises(InvalidArgumentError):
+        substitute_placeholders("select ?, ?", [1])
+    with pytest.raises(InvalidArgumentError):
+        substitute_placeholders("select $3", [1])
+
+
+def test_binding_is_injection_safe(inst):
+    ctx = QueryContext()
+    inst.execute_sql(
+        "PREPARE q FROM 'select host from t where host = ?'", ctx
+    )
+    # a trailing backslash must not escape the closing quote and turn
+    # the next fragment into raw SQL
+    assert inst.sql("EXECUTE q ('x\\\\')", ctx).rows() == []
+    from greptimedb_tpu.instance import (
+        format_sql_literal,
+        substitute_placeholders,
+    )
+
+    bound = substitute_placeholders(
+        "select * from t where a = ? and b = ?",
+        ["x\\", "', (select 1) --"],
+    )
+    # both parameters stay inside string literals
+    from greptimedb_tpu.sql.lexer import Tok, tokenize
+
+    strings = [t.text for t in tokenize(bound) if t.kind == Tok.STRING]
+    assert strings == ["x\\", "', (select 1) --"]
+    assert format_sql_literal("C:\\new\\temp") == "'C:\\\\new\\\\temp'"
+
+
+def test_prepare_execute_deallocate(inst):
+    ctx = QueryContext()
+    inst.execute_sql(
+        "PREPARE q FROM 'select host from t where v > ? order by host'",
+        ctx,
+    )
+    assert inst.sql("EXECUTE q (1.5)", ctx).rows() == [["b"]]
+    assert inst.sql("EXECUTE q (0)", ctx).rows() == [["a"], ["b"]]
+    inst.execute_sql("PREPARE p AS select v from t where host = $1", ctx)
+    assert inst.sql("EXECUTE p ('a')", ctx).rows() == [[1.0]]
+    assert inst.sql("EXECUTE p USING 'b'", ctx).rows() == [[2.0]]
+    inst.execute_sql("DEALLOCATE PREPARE q", ctx)
+    with pytest.raises(InvalidArgumentError):
+        inst.sql("EXECUTE q (1)", ctx)
+    inst.execute_sql("DEALLOCATE ALL", ctx)
+    with pytest.raises(InvalidArgumentError):
+        inst.sql("EXECUTE p (1)", ctx)
+
+
+def test_create_table_like(inst):
+    inst.execute_sql("create table t2 like t")
+    r = inst.sql("show columns from t2")
+    assert list(r.cols[0].values) == ["ts", "host", "v"]
+    by_name = dict(zip(r.cols[0].values, r.cols[3].values))
+    assert by_name["host"] == "PRI" and by_name["ts"] == "TIME INDEX"
+    # independent data
+    inst.execute_sql("insert into t2 values (1000,'z',9.0)")
+    assert inst.sql("select count(v) from t2").cols[0].values[0] == 1
+    assert inst.sql("select count(v) from t").cols[0].values[0] == 2
+    # IF NOT EXISTS respected
+    inst.execute_sql("create table if not exists t2 like t")
+
+
+def test_typed_literals_and_interval_coercion(inst):
+    r = inst.sql(
+        "select v from t where ts > '1970-01-01 00:00:01' - interval '1s'"
+    )
+    assert sorted(float(x) for x in r.cols[0].values) == [1.0, 2.0]
+    r = inst.sql("select timestamp '1970-01-01 00:00:10' + interval '1h'")
+    assert r.rows() == [[3610000]]
+    r = inst.sql(
+        "select v from t where ts >= timestamp '1970-01-01 00:00:02'"
+    )
+    assert r.rows() == [[2.0]]
+
+
+def test_prom_status_endpoints(inst):
+    import json
+    import urllib.request
+
+    from greptimedb_tpu.servers.http import HttpServer
+
+    srv = HttpServer(inst, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/v1/prometheus/api/v1"
+        with urllib.request.urlopen(f"{base}/status/buildinfo") as r:
+            body = json.load(r)
+        assert body["status"] == "success"
+        assert body["data"]["version"]
+        with urllib.request.urlopen(f"{base}/metadata") as r:
+            body = json.load(r)
+        assert body["status"] == "success"
+        assert "t" in body["data"]
+        with urllib.request.urlopen(f"{base}/rules") as r:
+            assert json.load(r)["data"] == {"groups": []}
+        with urllib.request.urlopen(f"{base}/alertmanagers") as r:
+            assert "activeAlertmanagers" in json.load(r)["data"]
+    finally:
+        srv.stop()

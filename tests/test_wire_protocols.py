@@ -128,6 +128,100 @@ class MiniMySqlClient:
             rows.append(row)
         return names, rows
 
+    # ---- binary prepared statements (COM_STMT_*) ---------------------
+    def stmt_prepare(self, sql: str) -> tuple[int, int]:
+        """-> (stmt_id, n_params)"""
+        self.seq = 0
+        self._send_packet(b"\x16" + sql.encode())
+        ok = self._read_packet()
+        if ok[0] == 0xFF:
+            raise RuntimeError(ok[9:].decode("utf-8", "replace"))
+        stmt_id = struct.unpack("<I", ok[1:5])[0]
+        ncols = struct.unpack("<H", ok[5:7])[0]
+        nparams = struct.unpack("<H", ok[7:9])[0]
+        for _ in range(nparams):
+            self._read_packet()
+        if nparams:
+            assert self._read_packet()[0] == 0xFE
+        for _ in range(ncols):
+            self._read_packet()
+        if ncols:
+            assert self._read_packet()[0] == 0xFE
+        return stmt_id, nparams
+
+    def stmt_execute(self, stmt_id: int, args: list, *, rebind=True):
+        """Binary execute; args typed as double/longlong/string/NULL.
+        Returns (names, rows) with rows as decoded strings."""
+        payload = b"\x17" + struct.pack("<I", stmt_id) + b"\x00"
+        payload += struct.pack("<I", 1)
+        if args:
+            nb = (len(args) + 7) // 8
+            bitmap = bytearray(nb)
+            types = b""
+            values = b""
+            for k, a in enumerate(args):
+                if a is None:
+                    bitmap[k // 8] |= 1 << (k % 8)
+                    types += bytes([0x06, 0])
+                elif isinstance(a, float):
+                    types += bytes([0x05, 0])
+                    values += struct.pack("<d", a)
+                elif isinstance(a, int):
+                    types += bytes([0x08, 0])
+                    values += struct.pack("<q", a)
+                else:
+                    s = str(a).encode()
+                    types += bytes([0xFD, 0])
+                    assert len(s) < 0xFB
+                    values += bytes([len(s)]) + s
+            if rebind:
+                payload += bytes(bitmap) + b"\x01" + types + values
+            else:
+                payload += bytes(bitmap) + b"\x00" + values
+        self.seq = 0
+        self._send_packet(payload)
+        first = self._read_packet()
+        if first[0] == 0xFF:
+            raise RuntimeError(first[9:].decode("utf-8", "replace"))
+        if first[0] == 0x00:
+            return [], []  # OK packet (a column count is never 0)
+        ncols, _ = self._lenc(first, 0)
+        names = []
+        for _ in range(ncols):
+            col = self._read_packet()
+            i = 0
+            vals = []
+            for _ in range(5):
+                ln, i = self._lenc(col, i)
+                vals.append(col[i:i + ln])
+                i += ln
+            names.append(vals[4].decode())
+        assert self._read_packet()[0] == 0xFE
+        rows = []
+        while True:
+            pkt = self._read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            assert pkt[0] == 0x00
+            nb = (ncols + 7 + 2) // 8
+            bitmap = pkt[1:1 + nb]
+            i = 1 + nb
+            row = []
+            for c in range(ncols):
+                pos = c + 2
+                if bitmap[pos // 8] & (1 << (pos % 8)):
+                    row.append(None)
+                    continue
+                ln, i = self._lenc(pkt, i)
+                row.append(pkt[i:i + ln].decode())
+                i += ln
+            rows.append(row)
+        return names, rows
+
+    def stmt_close(self, stmt_id: int):
+        self.seq = 0
+        self._send_packet(b"\x19" + struct.pack("<I", stmt_id))
+
     def close(self):
         try:
             self.seq = 0
@@ -175,6 +269,44 @@ def test_mysql_query_roundtrip(inst):
         # error surfaces as ERR packet
         with pytest.raises(RuntimeError):
             c.query("SELECT nope FROM missing_table")
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_mysql_binary_prepared_statements(inst):
+    srv = MySqlServer(inst, port=0).start()
+    try:
+        c = MiniMySqlClient(srv.port)
+        sid, nparams = c.stmt_prepare(
+            "SELECT host, v FROM wt WHERE v > ? ORDER BY host"
+        )
+        assert nparams == 1
+        names, rows = c.stmt_execute(sid, [2.0])
+        assert names == ["host", "v"]
+        assert rows == [["b", "2.5"]]
+        # re-execute with different binding
+        _, rows = c.stmt_execute(sid, [0.0])
+        assert [r[0] for r in rows] == ["a", "b"]
+        # string + int params, insert through binary protocol
+        sid2, n2 = c.stmt_prepare(
+            "INSERT INTO wt (host, v, ts) VALUES (?, ?, ?)"
+        )
+        assert n2 == 3
+        assert c.stmt_execute(sid2, ["z", 7.5, 9000]) == ([], [])
+        _, rows = c.stmt_execute(sid, [7.0])
+        assert rows == [["z", "7.5"]]
+        # NULL binding round-trips
+        sid3, _ = c.stmt_prepare("SELECT ? IS NULL")
+        _, rows = c.stmt_execute(sid3, [None])
+        assert rows[0][0] in ("1", "true", "True")
+        # libmysqlclient sends types only on the FIRST execute
+        # (new_params_bind_flag=0 afterwards)
+        _, rows = c.stmt_execute(sid, [7.0], rebind=False)
+        assert rows == [["z", "7.5"]]
+        c.stmt_close(sid)
+        with pytest.raises(RuntimeError):
+            c.stmt_execute(sid, [1.0])
         c.close()
     finally:
         srv.close()
