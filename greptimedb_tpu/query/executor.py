@@ -17,6 +17,8 @@ range_select/plan.rs RangeSelectStream), restructured TPU-first:
 
 from __future__ import annotations
 
+import dataclasses
+
 import numpy as np
 
 from greptimedb_tpu.errors import (
@@ -92,6 +94,22 @@ class QueryResult:
         return str(dt)
 
 
+class _WindowOverlay(ColumnSource):
+    """A row source plus computed window-function columns (__win_k)."""
+
+    def __init__(self, base, extra: dict):
+        self.base = base
+        self.extra = extra
+        self.num_rows = base.num_rows
+
+    def col(self, name: str) -> Col:
+        hit = self.extra.get(name)
+        return hit if hit is not None else self.base.col(name)
+
+    def __getattr__(self, name):
+        return getattr(self.base, name)
+
+
 class RowsSource(ColumnSource):
     """Column resolution over a table scan: fields and ts direct, tags
     decoded lazily through the series registry (strings never ship to
@@ -150,10 +168,14 @@ class DictSource(ColumnSource):
 
 
 def _sort_indices(cols: list[Col], ascs: list[bool],
-                  nulls_first: list[bool | None]) -> np.ndarray:
+                  nulls_first: list[bool | None],
+                  primary: np.ndarray | None = None) -> np.ndarray:
     """Stable multi-key sort. Numeric keys via lexsort; object keys ranked
-    first. SQL default null placement: last for ASC, first for DESC."""
-    n = len(cols[0]) if cols else 0
+    first. SQL default null placement: last for ASC, first for DESC.
+    `primary` (e.g. a window partition id) sorts most-significant."""
+    n = len(cols[0]) if cols else (
+        len(primary) if primary is not None else 0
+    )
     keys = []
     for c, asc, nf in zip(reversed(cols), reversed(ascs), reversed(nulls_first)):
         vals = c.values
@@ -163,15 +185,21 @@ def _sort_indices(cols: list[Col], ascs: list[bool],
             vals = inv.astype(np.int64)
         elif vals.dtype == np.bool_:
             vals = vals.astype(np.int8)
-        vals = vals.astype(np.float64) if vals.dtype.kind not in "iuf" else vals
+        elif vals.dtype.kind == "u":
+            vals = vals.astype(np.int64)
+        vals = vals.astype(np.float64) if vals.dtype.kind not in "if" else vals
         if not asc:
-            vals = -vals.astype(np.float64)
+            # negate in the key's own dtype: int64 keys keep exact order
+            # above 2^53 (float negation would merge distinct BIGINTs)
+            vals = -vals
         null_last = nf is False or (nf is None and asc)
         nullkey = (~c.valid_mask).astype(np.int8)
         if not null_last:
             nullkey = -nullkey
         keys.append(vals)
         keys.append(nullkey)
+    if primary is not None:
+        keys.append(primary)
     if not keys:
         return np.arange(n)
     return np.lexsort(keys)
@@ -361,6 +389,36 @@ class QueryEngine:
         if src.num_rows == 0:
             cols = [Col(np.zeros(0)) for _ in plan.items]
             return QueryResult(names, cols, self._types_hint(plan, table))
+        # window functions: compute each OVER() call over the full row
+        # set, then project with the results spliced in as columns
+        from greptimedb_tpu.query import window_fns as W
+
+        win_calls = []
+        for e, _ in plan.items:
+            W.collect_window_calls(e, win_calls)
+        for o in plan.order_by:
+            W.collect_window_calls(o.expr, win_calls)
+        # alias resolution can splice the SAME FuncCall object into
+        # order_by — dedupe by identity so it's evaluated once
+        win_calls = list({id(fc): fc for fc in win_calls}.values())
+        if win_calls:
+            extra: dict[str, Col] = {}
+            mapping: dict[int, str] = {}
+            for k, fc in enumerate(win_calls):
+                cname = f"__win_{k}"
+                mapping[id(fc)] = cname
+                extra[cname] = W.eval_window(fc, src)
+            src = _WindowOverlay(src, extra)
+            plan = dataclasses.replace(
+                plan,
+                items=[(W.replace_window_calls(e, mapping), n)
+                       for e, n in plan.items],
+                order_by=[
+                    A.OrderItem(W.replace_window_calls(o.expr, mapping),
+                                o.asc, o.nulls_first)
+                    for o in plan.order_by
+                ],
+            )
         cols = [eval_expr(e, src) for e, _ in plan.items]
         if plan.distinct:
             idx = _distinct_indices(cols)

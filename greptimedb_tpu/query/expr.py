@@ -401,6 +401,11 @@ def collect_columns(e: A.Expr, out: set[str] | None = None) -> set[str]:
     elif isinstance(e, A.FuncCall):
         for x in e.args:
             collect_columns(x, out)
+        if e.over is not None:
+            for p in e.over.partition_by:
+                collect_columns(p, out)
+            for o in e.over.order_by:
+                collect_columns(o.expr, out)
     elif isinstance(e, A.RangeFunc):
         collect_columns(e.func, out)
     return out

@@ -341,11 +341,16 @@ AGGREGATE_FUNCS = {
     "stddev", "stddev_pop", "stddev_samp", "var", "var_pop", "var_samp",
     "variance", "first_value", "last_value", "count_distinct",
     "approx_distinct", "percentile", "quantile", "approx_percentile_cont",
+    "percentile_cont",
 }
 
 
 def contains_aggregate(e: A.Expr) -> bool:
     if isinstance(e, A.FuncCall):
+        if e.over is not None:
+            # a window function is not a GROUP BY aggregate; its args
+            # are row-level values
+            return False
         if e.name in AGGREGATE_FUNCS:
             return True
         return any(contains_aggregate(a) for a in e.args)

@@ -131,7 +131,7 @@ _NORMALIZE_AGG = {
     "var_samp": "var_samp", "variance": "var_samp",
     "first_value": "first_value", "last_value": "last_value",
     "median": "quantile", "percentile": "quantile", "quantile": "quantile",
-    "approx_percentile_cont": "quantile",
+    "approx_percentile_cont": "quantile", "percentile_cont": "quantile",
     "count_distinct": "count_distinct", "approx_distinct": "count_distinct",
 }
 
@@ -326,6 +326,11 @@ class _Rewriter:
         return key
 
     def rewrite(self, e: A.Expr) -> A.Expr:
+        if isinstance(e, A.FuncCall) and e.over is not None:
+            raise PlanError(
+                "window functions combined with GROUP BY/aggregates are "
+                "not supported yet"
+            )
         k = self._key_for(e)
         if k is not None:
             return A.Column(k)
@@ -402,7 +407,7 @@ def _resolve_alias_deep(e: A.Expr, items: list[A.SelectItem]) -> A.Expr:
         return A.IsNull(rec(e.operand), e.negated)
     if isinstance(e, A.FuncCall):
         return A.FuncCall(e.name, [rec(a) for a in e.args], e.distinct,
-                          e.order_by)
+                          e.order_by, over=e.over)
     return e
 
 
@@ -437,6 +442,22 @@ def plan_select(
     has_agg = bool(group_exprs) or any(
         contains_aggregate(it.expr) for it in items
     ) or (stmt.having is not None and contains_aggregate(stmt.having))
+
+    if has_agg:
+        from greptimedb_tpu.query.window_fns import collect_window_calls
+
+        wins: list = []
+        for it in items:
+            collect_window_calls(it.expr, wins)
+        for o in stmt.order_by:
+            collect_window_calls(o.expr, wins)
+        if stmt.having is not None:
+            collect_window_calls(stmt.having, wins)
+        if wins:
+            raise PlanError(
+                "window functions combined with GROUP BY/aggregates are "
+                "not supported yet"
+            )
 
     if not has_agg:
         plan = SelectPlan(

@@ -1,0 +1,418 @@
+"""SQL window functions over the host row source.
+
+Capability counterpart of the reference's DataFusion window execution
+(/root/reference/src/query/ executes OVER() through DataFusion's
+WindowAggExec; sqlness window cases under tests/cases/standalone/common/).
+
+Semantics implemented (SQL default frames):
+- no ORDER BY in the spec  -> whole-partition value broadcast
+- ORDER BY present         -> RANGE UNBOUNDED PRECEDING..CURRENT ROW
+  (running aggregate; peer rows — ties on the order keys — share the
+  frame end, so they share the value)
+- explicit frames: only the two spellings equivalent to the defaults are
+  accepted ("... UNBOUNDED PRECEDING AND CURRENT ROW", "... UNBOUNDED
+  PRECEDING AND UNBOUNDED FOLLOWING"); anything else raises.
+
+Ranking (row_number/rank/dense_rank) and offset (lag/lead,
+first_value/last_value) functions follow the standard definitions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from greptimedb_tpu.errors import PlanError, UnsupportedError
+from greptimedb_tpu.query.expr import Col, eval_expr
+from greptimedb_tpu.sql import ast as A
+
+WINDOW_ONLY_FUNCS = {
+    "row_number", "rank", "dense_rank", "ntile", "lag", "lead",
+    "first_value", "last_value", "nth_value", "percent_rank",
+    "cume_dist",
+}
+_AGG_OVER = {"sum", "count", "avg", "mean", "min", "max"}
+
+
+def collect_window_calls(e: A.Expr, out: list | None = None) -> list:
+    """All FuncCall nodes with an OVER clause, in depth-first order."""
+    if out is None:
+        out = []
+    if isinstance(e, A.FuncCall):
+        if e.over is not None:
+            out.append(e)
+        for a in e.args:
+            collect_window_calls(a, out)
+    elif isinstance(e, A.BinaryOp):
+        collect_window_calls(e.left, out)
+        collect_window_calls(e.right, out)
+    elif isinstance(e, (A.UnaryOp, A.Cast)):
+        collect_window_calls(e.operand, out)
+    elif isinstance(e, A.Between):
+        for x in (e.operand, e.low, e.high):
+            collect_window_calls(x, out)
+    elif isinstance(e, A.InList):
+        collect_window_calls(e.operand, out)
+        for x in e.items:
+            collect_window_calls(x, out)
+    elif isinstance(e, A.IsNull):
+        collect_window_calls(e.operand, out)
+    elif isinstance(e, A.Case):
+        if e.operand:
+            collect_window_calls(e.operand, out)
+        for c, t in e.whens:
+            collect_window_calls(c, out)
+            collect_window_calls(t, out)
+        if e.else_:
+            collect_window_calls(e.else_, out)
+    return out
+
+
+def replace_window_calls(e: A.Expr, mapping: dict) -> A.Expr:
+    """Structurally replace window FuncCalls (by identity) with Columns."""
+    if id(e) in mapping:
+        return A.Column(mapping[id(e)])
+    if isinstance(e, A.FuncCall):
+        return A.FuncCall(
+            e.name, [replace_window_calls(a, mapping) for a in e.args],
+            distinct=e.distinct, order_by=e.order_by,
+        )
+    if isinstance(e, A.BinaryOp):
+        return A.BinaryOp(e.op, replace_window_calls(e.left, mapping),
+                          replace_window_calls(e.right, mapping))
+    if isinstance(e, A.UnaryOp):
+        return A.UnaryOp(e.op, replace_window_calls(e.operand, mapping))
+    if isinstance(e, A.Cast):
+        return A.Cast(replace_window_calls(e.operand, mapping), e.to)
+    if isinstance(e, A.Between):
+        return A.Between(replace_window_calls(e.operand, mapping),
+                         replace_window_calls(e.low, mapping),
+                         replace_window_calls(e.high, mapping),
+                         e.negated)
+    if isinstance(e, A.InList):
+        return A.InList(replace_window_calls(e.operand, mapping),
+                        [replace_window_calls(x, mapping) for x in e.items],
+                        e.negated)
+    if isinstance(e, A.IsNull):
+        return A.IsNull(replace_window_calls(e.operand, mapping),
+                        e.negated)
+    if isinstance(e, A.Case):
+        return A.Case(
+            replace_window_calls(e.operand, mapping) if e.operand else None,
+            [(replace_window_calls(c, mapping),
+              replace_window_calls(t, mapping)) for c, t in e.whens],
+            replace_window_calls(e.else_, mapping) if e.else_ else None,
+        )
+    return e
+
+
+def _frame_mode(spec: A.WindowSpec) -> str:
+    """-> 'running' | 'whole'. Only the frame spellings equivalent to the
+    SQL defaults are accepted (see module docstring)."""
+    if spec.frame is None:
+        return "running" if spec.order_by else "whole"
+    body = spec.frame.upper().split("BETWEEN", 1)[-1].strip()
+    if body == "UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING":
+        return "whole"
+    if body == "UNBOUNDED PRECEDING AND CURRENT ROW":
+        return "running" if spec.order_by else "whole"
+    raise UnsupportedError(f"window frame not supported: {spec.frame}")
+
+
+def _key_codes(col: Col) -> np.ndarray:
+    """Column -> dense int codes (nulls get their own code)."""
+    vals = col.values
+    if vals.dtype == object:
+        vals = np.asarray([str(v) for v in vals], object)
+    _, codes = np.unique(vals, return_inverse=True)
+    if col.validity is not None:
+        codes = np.where(col.valid_mask, codes, -1)
+    return codes.astype(np.int64)
+
+
+def eval_window(fc: A.FuncCall, src) -> Col:
+    """Evaluate one window call over the full row source."""
+    spec = fc.over
+    n = src.num_rows
+    if n == 0:
+        return Col(np.zeros(0))
+    mode = _frame_mode(spec)
+
+    # ---- partition ids + intra-partition order ------------------------
+    part_keys = [_key_codes(eval_expr(p, src)) for p in spec.partition_by]
+    if part_keys:
+        stacked = np.stack(part_keys, axis=1)
+        _, pid = np.unique(stacked, axis=0, return_inverse=True)
+    else:
+        pid = np.zeros(n, np.int64)
+
+    order_cols = [eval_expr(o.expr, src) for o in spec.order_by]
+    from greptimedb_tpu.query.executor import _sort_indices
+
+    # partition most-significant, then the ORDER BY keys with SQL null
+    # placement; _sort_indices' lexsort is stable, so equal keys keep
+    # row order (deterministic)
+    order = _sort_indices(
+        order_cols,
+        [o.asc for o in spec.order_by],
+        [o.nulls_first for o in spec.order_by],
+        primary=pid,
+    )
+    # positions: order[i] = original row index of the i-th ordered row
+    opid = pid[order]
+    part_start = np.zeros(n, dtype=bool)
+    part_start[0] = True
+    part_start[1:] = opid[1:] != opid[:-1]
+
+    # peer boundaries: a change in any order key OR its null-ness
+    if order_cols:
+        peer_start = part_start.copy()
+        for col in order_cols:
+            codes = np.where(col.valid_mask, _sortable(col), 0)[order]
+            nulls = (~col.valid_mask)[order]
+            peer_start[1:] |= (codes[1:] != codes[:-1]) | (
+                nulls[1:] != nulls[:-1]
+            )
+    else:
+        peer_start = part_start.copy()
+
+    out_ordered, validity_ordered = _dispatch(
+        fc, src, mode, order, part_start, peer_start, n
+    )
+    inv = np.empty(n, np.int64)
+    inv[order] = np.arange(n)
+    values = out_ordered[inv]
+    validity = None if validity_ordered is None else validity_ordered[inv]
+    return Col(values, validity)
+
+
+def _sortable(col: Col) -> np.ndarray:
+    """Order-preserving codes for peer detection. Integers stay int64
+    (a float cast would merge distinct keys above 2^53)."""
+    vals = col.values
+    if vals.dtype == object:
+        _, codes = np.unique(
+            np.asarray([str(v) for v in vals], object), return_inverse=True
+        )
+        return codes.astype(np.int64)
+    if vals.dtype == np.bool_ or vals.dtype.kind in "iu":
+        return vals.astype(np.int64)
+    return vals
+
+
+def _partition_index(part_start: np.ndarray) -> np.ndarray:
+    """ordered-position -> index within its partition (0-based)."""
+    n = len(part_start)
+    idx = np.arange(n)
+    start_idx = np.maximum.accumulate(np.where(part_start, idx, 0))
+    return idx - start_idx
+
+
+def _dispatch(fc, src, mode, order, part_start, peer_start, n):
+    name = fc.name
+    within = _partition_index(part_start)
+    part_id = np.cumsum(part_start) - 1
+
+    if name == "row_number":
+        return within + 1, None
+    if name in ("rank", "dense_rank", "percent_rank", "cume_dist"):
+        peer_id = np.cumsum(peer_start) - 1
+        # rank: 1 + number of rows before the peer group, per partition
+        first_of_peer = np.where(peer_start)[0]
+        rank_at_peer = within[first_of_peer] + 1
+        rank = rank_at_peer[peer_id]
+        if name == "rank":
+            return rank, None
+        if name == "dense_rank":
+            first_peer_part = part_id[first_of_peer]
+            dense = np.zeros(len(first_of_peer), np.int64)
+            for p in range(int(part_id.max()) + 1 if n else 0):
+                sel = first_peer_part == p
+                dense[sel] = np.arange(1, int(sel.sum()) + 1)
+            return dense[peer_id], None
+        part_sizes = np.bincount(part_id, minlength=int(part_id.max()) + 1)
+        size = part_sizes[part_id].astype(np.float64)
+        if name == "percent_rank":
+            return np.where(size > 1, (rank - 1) / np.maximum(size - 1, 1),
+                            0.0), None
+        # cume_dist: peers count to the END of the peer group
+        peer_id2 = np.cumsum(peer_start) - 1
+        last_of_peer = np.zeros(int(peer_id2.max()) + 1, np.int64)
+        np.maximum.at(last_of_peer, peer_id2, within)
+        return (last_of_peer[peer_id2] + 1) / size, None
+
+    if name == "ntile":
+        if not fc.args:
+            raise PlanError("ntile(k) needs an argument")
+        from greptimedb_tpu.query.expr import eval_const
+
+        k = int(eval_const(fc.args[0]))
+        if k <= 0:
+            raise PlanError("ntile(k): k must be positive")
+        part_sizes = np.bincount(part_id, minlength=int(part_id.max()) + 1)
+        size = part_sizes[part_id]
+        return (within * k // np.maximum(size, 1)) + 1, None
+
+    if name in ("lag", "lead"):
+        col = eval_expr(fc.args[0], src)
+        offset = 1
+        default = None
+        if len(fc.args) > 1:
+            from greptimedb_tpu.query.expr import eval_const
+
+            offset = int(eval_const(fc.args[1]))
+        if len(fc.args) > 2:
+            from greptimedb_tpu.query.expr import eval_const
+
+            default = eval_const(fc.args[2])
+        vals = col.values[order]
+        valid = col.valid_mask[order]
+        shift = offset if name == "lag" else -offset
+        out = np.empty_like(vals)
+        ok = np.zeros(n, dtype=bool)
+        idx = np.arange(n)
+        src_idx = idx - shift
+        in_range = (src_idx >= 0) & (src_idx < n)
+        same_part = np.zeros(n, dtype=bool)
+        part_id_arr = part_id
+        sel = in_range.copy()
+        sel[in_range] = (
+            part_id_arr[src_idx[in_range]] == part_id_arr[idx[in_range]]
+        )
+        out[sel] = vals[src_idx[sel]]
+        ok[sel] = valid[src_idx[sel]]
+        if default is not None:
+            fillable = ~sel
+            if vals.dtype == object:
+                out[fillable] = str(default)
+            else:
+                out[fillable] = default
+            ok[fillable] = True
+        return out, ok
+
+    if name in ("first_value", "last_value", "nth_value"):
+        col = eval_expr(fc.args[0], src)
+        vals = col.values[order]
+        valid = col.valid_mask[order]
+        first_pos = np.maximum.accumulate(
+            np.where(part_start, np.arange(n), 0)
+        )
+        if name == "first_value":
+            return vals[first_pos], valid[first_pos]
+        if name == "nth_value":
+            from greptimedb_tpu.query.expr import eval_const
+
+            k = int(eval_const(fc.args[1])) - 1
+            pos = np.minimum(first_pos + k, n - 1)
+            within_arr = _partition_index(part_start)
+            if mode == "running":
+                # NULL until the frame has reached the k-th row
+                ok = within_arr >= k
+            else:
+                part_sizes = np.bincount(
+                    part_id, minlength=int(part_id.max()) + 1
+                )
+                ok = part_sizes[part_id] > k
+            return vals[pos], ok & valid[pos]
+        # last_value: running frame -> end of the current PEER group
+        # (ties on the order keys share the frame end); whole ->
+        # partition last
+        if mode == "running":
+            peer_id = np.cumsum(peer_start) - 1
+            last_of_peer = np.zeros(int(peer_id.max()) + 1, np.int64)
+            np.maximum.at(last_of_peer, peer_id, np.arange(n))
+            pos = last_of_peer[peer_id]
+            return vals[pos], valid[pos]
+        last_pos = _part_last(part_start, n)
+        return vals[last_pos], valid[last_pos]
+
+    if name in _AGG_OVER:
+        if name == "count" and not fc.args:
+            col = Col(np.ones(n, np.int64))
+        else:
+            col = eval_expr(fc.args[0], src)
+        vals = col.values[order]
+        valid = col.valid_mask[order]
+        return _agg_over(name, vals, valid, mode, part_start, peer_start,
+                         part_id, n)
+
+    raise UnsupportedError(f"window function {name!r} not supported")
+
+
+def _part_last(part_start: np.ndarray, n: int) -> np.ndarray:
+    """ordered-position -> position of the LAST row of its partition."""
+    ends = np.empty(n, np.int64)
+    starts = np.where(part_start)[0]
+    bounds = np.append(starts[1:], n) - 1
+    ends[:] = np.repeat(bounds, np.diff(np.append(starts, n)))
+    return ends
+
+
+def _agg_over(name, vals, valid, mode, part_start, peer_start, part_id, n):
+    numeric = np.where(valid, vals.astype(np.float64, copy=False), 0.0) \
+        if vals.dtype != object else None
+    if numeric is None:
+        raise PlanError(f"{name}() over string column")
+    cnt = valid.astype(np.int64)
+    if mode == "whole":
+        nparts = int(part_id.max()) + 1
+        if name in ("sum", "avg", "mean", "count"):
+            s = np.bincount(part_id, weights=numeric, minlength=nparts)
+            c = np.bincount(part_id, weights=cnt, minlength=nparts)
+            if name == "count":
+                return c[part_id].astype(np.int64), None
+            out = s[part_id]
+            if name in ("avg", "mean"):
+                out = out / np.maximum(c[part_id], 1)
+            return out, (c[part_id] > 0)
+        red = np.full(nparts, -np.inf if name == "max" else np.inf)
+        op = np.maximum if name == "max" else np.minimum
+        masked = np.where(valid, numeric,
+                          -np.inf if name == "max" else np.inf)
+        getattr(op, "at")(red, part_id, masked)
+        c = np.bincount(part_id, weights=cnt, minlength=nparts)
+        return red[part_id], (c[part_id] > 0)
+    # running: cumulative within partition, then peers share the value at
+    # the END of their peer group (SQL default RANGE frame)
+    csum = np.cumsum(numeric)
+    ccnt = np.cumsum(cnt)
+    starts = np.where(part_start)[0]
+    base_sum = np.repeat(
+        np.append(0.0, csum[starts[1:] - 1]),
+        np.diff(np.append(starts, n)),
+    )
+    base_cnt = np.repeat(
+        np.append(0, ccnt[starts[1:] - 1]),
+        np.diff(np.append(starts, n)),
+    )
+    run_sum = csum - base_sum
+    run_cnt = ccnt - base_cnt
+    if name in ("min", "max"):
+        masked = np.where(valid, numeric,
+                          -np.inf if name == "max" else np.inf)
+        out = np.empty(n)
+        acc = None
+        for i in range(n):  # partition-reset cummax/cummin
+            if part_start[i]:
+                acc = masked[i]
+            else:
+                acc = max(acc, masked[i]) if name == "max" \
+                    else min(acc, masked[i])
+            out[i] = acc
+        run = out
+    elif name == "count":
+        run = run_cnt
+    elif name in ("avg", "mean"):
+        run = run_sum / np.maximum(run_cnt, 1)
+    else:
+        run = run_sum
+    # peers share the frame end: broadcast the value at each peer
+    # group's last row back over the group
+    peer_id = np.cumsum(peer_start) - 1
+    npeers = int(peer_id.max()) + 1
+    last_of_peer = np.zeros(npeers, np.int64)
+    np.maximum.at(last_of_peer, peer_id, np.arange(n))
+    run = run[last_of_peer[peer_id]]
+    run_cnt_b = run_cnt[last_of_peer[peer_id]]
+    if name == "count":
+        return run.astype(np.int64), None
+    return run, (run_cnt_b > 0)

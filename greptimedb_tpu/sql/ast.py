@@ -58,6 +58,18 @@ class FuncCall(Expr):
     args: list[Expr] = field(default_factory=list)
     distinct: bool = False
     order_by: list["OrderItem"] = field(default_factory=list)
+    over: "WindowSpec | None" = None   # window function when set
+
+
+@dataclass
+class WindowSpec:
+    """OVER ([PARTITION BY ...] [ORDER BY ...] [frame]). frame is the
+    normalized frame text; None means the SQL default (whole partition
+    without ORDER BY, running peer-frame with it)."""
+
+    partition_by: list[Expr] = field(default_factory=list)
+    order_by: list["OrderItem"] = field(default_factory=list)
+    frame: str | None = None
 
 
 @dataclass

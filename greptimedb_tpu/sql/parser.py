@@ -853,8 +853,13 @@ class Parser:
         limit = offset = None
         if self.eat_kw("LIMIT"):
             limit = int(self.next().text)
+            # MySQL `LIMIT offset, count`
+            if self.eat_op(","):
+                offset, limit = limit, int(self.next().text)
         if self.eat_kw("OFFSET"):
             offset = int(self.next().text)
+        if limit is None and offset is not None and self.eat_kw("LIMIT"):
+            limit = int(self.next().text)  # postgres `OFFSET n LIMIT m`
         return A.Select(
             items=items, from_table=from_table, where=where,
             group_by=group_by, having=having, order_by=order_by,
@@ -1239,7 +1244,26 @@ class Parser:
         self.expect_op(")")
         fc = A.FuncCall(name.lower(), args, distinct=distinct,
                         order_by=order_by)
-        if self.at_kw("RANGE"):
+        if self.at_kw("WITHIN"):
+            # percentile_cont(f) WITHIN GROUP (ORDER BY x) -> quantile
+            # agg; ORDER BY x DESC means the fraction counts from the
+            # top, i.e. the ascending (1 - f) quantile
+            self.next()
+            self.expect_kw("GROUP")
+            self.expect_op("(")
+            self.expect_kw("ORDER")
+            self.expect_kw("BY")
+            target = self.order_item()
+            self.expect_op(")")
+            args = list(fc.args)
+            if not target.asc and args:
+                args[0] = A.BinaryOp("-", A.Literal(1.0), args[0])
+            fc = A.FuncCall(fc.name, args + [target.expr],
+                            distinct=fc.distinct)
+        if self.at_kw("OVER"):
+            self.next()
+            fc.over = self.window_spec()
+        if self.at_kw("RANGE") and fc.over is None:
             self.next()
             range_ms = parse_interval_ms(self._interval_text())
             fill = None
@@ -1248,6 +1272,28 @@ class Parser:
                 fill = self.next().text.lower()
             return A.RangeFunc(fc, range_ms, fill)
         return fc
+
+    def window_spec(self) -> A.WindowSpec:
+        self.expect_op("(")
+        spec = A.WindowSpec()
+        if self.eat_kw("PARTITION"):
+            self.expect_kw("BY")
+            spec.partition_by.append(self.expr())
+            while self.eat_op(","):
+                spec.partition_by.append(self.expr())
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            spec.order_by.append(self.order_item())
+            while self.eat_op(","):
+                spec.order_by.append(self.order_item())
+        if self.at_kw("ROWS", "RANGE", "GROUPS"):
+            words = [self.next().upper]
+            while not self.at_op(")"):
+                t = self.next()
+                words.append(t.upper if t.kind == Tok.IDENT else t.text)
+            spec.frame = " ".join(words)
+        self.expect_op(")")
+        return spec
 
 
 def parse_sql(sql: str) -> list[A.Statement]:

@@ -1,0 +1,143 @@
+-- SQL window functions (reference executes OVER() through DataFusion's
+-- WindowAggExec; behavior ports of the sqlness window coverage)
+
+CREATE TABLE w (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO w VALUES
+  (1000, 'a', 1.0), (2000, 'b', 2.0), (3000, 'a', 3.0),
+  (4000, 'b', 5.0), (5000, 'a', 2.0);
+
+SELECT ts, row_number() OVER (ORDER BY ts DESC) AS rn FROM w ORDER BY ts;
+----
+ts|rn
+1000|5
+2000|4
+3000|3
+4000|2
+5000|1
+
+SELECT ts, host, lag(v) OVER (PARTITION BY host ORDER BY ts) AS prev
+FROM w ORDER BY ts;
+----
+ts|host|prev
+1000|a|NULL
+2000|b|NULL
+3000|a|1.0
+4000|b|2.0
+5000|a|3.0
+
+SELECT ts, lead(v, 1, -1) OVER (ORDER BY ts) AS nxt FROM w ORDER BY ts;
+----
+ts|nxt
+1000|2.0
+2000|3.0
+3000|5.0
+4000|2.0
+5000|-1.0
+
+-- running sum per partition (SQL default frame with ORDER BY)
+SELECT ts, host, sum(v) OVER (PARTITION BY host ORDER BY ts) AS run
+FROM w ORDER BY ts;
+----
+ts|host|run
+1000|a|1.0
+2000|b|2.0
+3000|a|4.0
+4000|b|7.0
+5000|a|6.0
+
+-- whole-partition aggregate (no ORDER BY in the spec)
+SELECT ts, host, sum(v) OVER (PARTITION BY host) AS tot FROM w ORDER BY ts;
+----
+ts|host|tot
+1000|a|6.0
+2000|b|7.0
+3000|a|6.0
+4000|b|7.0
+5000|a|6.0
+
+-- ties: rank skips, dense_rank doesn't; peers share running values
+SELECT ts, rank() OVER (ORDER BY v) AS r, dense_rank() OVER (ORDER BY v) AS d
+FROM w ORDER BY ts;
+----
+ts|r|d
+1000|1|1
+2000|2|2
+3000|4|3
+4000|5|4
+5000|2|2
+
+SELECT ts, first_value(v) OVER (PARTITION BY host ORDER BY ts) AS f,
+  last_value(v) OVER (PARTITION BY host ORDER BY ts
+    ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) AS l
+FROM w ORDER BY ts;
+----
+ts|f|l
+1000|1.0|2.0
+2000|2.0|5.0
+3000|1.0|2.0
+4000|2.0|5.0
+5000|1.0|2.0
+
+SELECT ts, avg(v) OVER (ORDER BY ts) AS running_avg FROM w ORDER BY ts;
+----
+ts|running_avg
+1000|1.0
+2000|1.5
+3000|2.0
+4000|2.75
+5000|2.6
+
+SELECT ts, ntile(2) OVER (ORDER BY ts) AS bucket FROM w ORDER BY ts;
+----
+ts|bucket
+1000|1
+2000|1
+3000|1
+4000|2
+5000|2
+
+-- percentile_cont via WITHIN GROUP
+SELECT percentile_cont(0.5) WITHIN GROUP (ORDER BY v) AS med FROM w;
+----
+med
+2.0
+
+SELECT host, percentile_cont(0.5) WITHIN GROUP (ORDER BY v) AS med
+FROM w GROUP BY host ORDER BY host;
+----
+host|med
+a|2.0
+b|3.5
+
+-- window + GROUP BY composition is rejected, not silently wrong
+SELECT host, row_number() OVER (ORDER BY sum(v)) FROM w GROUP BY host;
+----
+ERROR
+
+-- unsupported explicit frames error cleanly
+SELECT sum(v) OVER (ORDER BY ts ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING)
+FROM w;
+----
+ERROR
+
+-- OFFSET / LIMIT forms
+SELECT ts FROM w ORDER BY ts LIMIT 2 OFFSET 1;
+----
+ts
+2000
+3000
+
+SELECT ts FROM w ORDER BY ts OFFSET 3 LIMIT 5;
+----
+ts
+4000
+5000
+
+SELECT ts FROM w ORDER BY ts LIMIT 1, 2;
+----
+ts
+2000
+3000
+
+DROP TABLE w;
