@@ -185,6 +185,22 @@ def _http_server(inst, opts, closers):
     return server
 
 
+def _telemetry(opts, closers, *, mode: str):
+    if not opts.get("telemetry.enable", False):
+        return
+    endpoint = opts.get("telemetry.endpoint", "")
+    if not endpoint:
+        return
+    from greptimedb_tpu.telemetry.report import TelemetryTask
+
+    task = TelemetryTask(
+        opts.get("data_home"), endpoint=endpoint,
+        interval_s=float(opts.get("telemetry.interval_s", 1800.0)),
+        mode=mode,
+    ).start()
+    closers.append(task.stop)
+
+
 def _export_metrics(inst, opts, closers):
     """Self-import node metrics (independent of the HTTP server; a node
     with http disabled still exports)."""
@@ -247,6 +263,15 @@ def _make_instance(opts):
             )
         except Exception:
             pass
+    from greptimedb_tpu.telemetry.slow_query import SlowQueryLog
+
+    inst.slow_query_log = SlowQueryLog(
+        enable=bool(opts.get("logging.slow_query.enable", True)),
+        threshold_s=float(opts.get("logging.slow_query.threshold_s", 5.0)),
+        sample_ratio=float(
+            opts.get("logging.slow_query.sample_ratio", 1.0)
+        ),
+    )
     return inst
 
 
@@ -255,6 +280,7 @@ def _start_standalone(opts):
     closers = [inst.close]
     server = _http_server(inst, opts, closers)
     _export_metrics(inst, opts, closers)
+    _telemetry(opts, closers, mode="standalone")
     _wire_protocols(inst, opts, closers)
     _flight_server(inst, opts, closers)
     print(

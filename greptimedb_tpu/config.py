@@ -31,6 +31,9 @@ DEFAULTS: dict = {
         "enable": False, "db": "greptime_metrics",
         "write_interval_s": 30.0,
     },
+    # anonymous usage reporting (ref src/common/greptimedb-telemetry);
+    # nothing is sent unless enable=true AND an endpoint is configured
+    "telemetry": {"enable": False, "endpoint": "", "interval_s": 1800.0},
     "grpc": {"addr": "127.0.0.1:4001", "enable": True},   # arrow flight
     "mysql": {"addr": "127.0.0.1:4002", "enable": True},
     "postgres": {"addr": "127.0.0.1:4003", "enable": True},
@@ -53,7 +56,14 @@ DEFAULTS: dict = {
     },
     "metasrv": {"addr": "127.0.0.1:4010", "selector": "round_robin"},
     "datanode": {"node_id": 0, "metasrv_addr": ""},
-    "logging": {"level": "info"},
+    "logging": {
+        "level": "info",
+        # statements slower than threshold land in the slow-query log +
+        # information_schema.slow_queries (ref [logging.slow_query])
+        "slow_query": {
+            "enable": True, "threshold_s": 5.0, "sample_ratio": 1.0,
+        },
+    },
 }
 
 

@@ -147,6 +147,10 @@ class FlowManager:
         with self._lock:
             return sorted(self._flows)
 
+    def maybe_flow(self, name: str) -> "Flow | None":
+        with self._lock:
+            return self._flows.get(name)
+
     def flow_infos(self) -> list[dict]:
         with self._lock:
             return [

@@ -307,6 +307,21 @@ def _collations_doc(inst) -> dict[str, list]:
     }
 
 
+def _slow_queries_doc(inst) -> dict[str, list]:
+    rows = {"cost_time_ms": [], "threshold_ms": [], "query": [],
+            "schema_name": [], "channel": [], "timestamp": []}
+    log = getattr(inst, "slow_query_log", None)
+    if log is not None:
+        for e in log.entries():
+            rows["cost_time_ms"].append(e["cost_ms"])
+            rows["threshold_ms"].append(e["threshold_ms"])
+            rows["query"].append(e["query"])
+            rows["schema_name"].append(e["schema"])
+            rows["channel"].append(e["channel"])
+            rows["timestamp"].append(e["ts_ms"])
+    return rows
+
+
 _PROVIDERS = {
     "tables": _tables_doc,
     "columns": _columns_doc,
@@ -325,6 +340,7 @@ _PROVIDERS = {
     "build_info": _build_info_doc,
     "character_sets": _character_sets_doc,
     "collations": _collations_doc,
+    "slow_queries": _slow_queries_doc,
 }
 
 

@@ -171,6 +171,9 @@ class Standalone:
         self.flows = None  # wired by flow.FlowManager when enabled
         self._procedures = []
         self._process_list = _ProcessList()
+        from greptimedb_tpu.telemetry.slow_query import SlowQueryLog
+
+        self.slow_query_log = SlowQueryLog()
         if warm_start:
             # restore device grid snapshots in the background so the
             # first query after a restart skips the SST rescan
@@ -200,10 +203,19 @@ class Standalone:
     # ------------------------------------------------------------------
     def execute_sql(self, sql: str, ctx: QueryContext | None = None
                     ) -> list[Output]:
+        import time as _time
+
         ctx = ctx or QueryContext()
         outputs = []
-        for stmt in parse_sql(sql):
-            outputs.append(self.execute_statement(stmt, ctx))
+        t0 = _time.perf_counter()
+        try:
+            for stmt in parse_sql(sql):
+                outputs.append(self.execute_statement(stmt, ctx))
+        finally:
+            self.slow_query_log.maybe_record(
+                sql, _time.perf_counter() - t0,
+                db=ctx.database, channel=ctx.channel,
+            )
         return outputs
 
     def sql(self, sql: str, ctx: QueryContext | None = None) -> QueryResult:
@@ -309,6 +321,15 @@ class Standalone:
         if isinstance(stmt, A.ShowViews):
             return Output.records(_result_from_lists(
                 ["Views"], [self.catalog.view_names(ctx.database)]
+            ))
+        if isinstance(stmt, A.ShowCreateFlow):
+            if self.flows is None:
+                raise UnsupportedError("flows are not enabled")
+            flow = self.flows.maybe_flow(stmt.name)
+            if flow is None:
+                raise TableNotFoundError(f"flow not found: {stmt.name}")
+            return Output.records(_result_from_lists(
+                ["Flow", "Create Flow"], [[stmt.name], [flow.raw_sql]]
             ))
         if isinstance(stmt, A.ShowCreateView):
             db, name = self._resolve(stmt.name, ctx)

@@ -9,9 +9,10 @@ Semantics implemented (SQL default frames):
 - ORDER BY present         -> RANGE UNBOUNDED PRECEDING..CURRENT ROW
   (running aggregate; peer rows — ties on the order keys — share the
   frame end, so they share the value)
-- explicit frames: only the two spellings equivalent to the defaults are
-  accepted ("... UNBOUNDED PRECEDING AND CURRENT ROW", "... UNBOUNDED
-  PRECEDING AND UNBOUNDED FOLLOWING"); anything else raises.
+- explicit frames: "... UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING"
+  (whole partition), "RANGE ... UNBOUNDED PRECEDING AND CURRENT ROW"
+  (peer-shared running), "ROWS ... UNBOUNDED PRECEDING AND CURRENT ROW"
+  (strictly per-row running); anything else raises.
 
 Ranking (row_number/rank/dense_rank) and offset (lag/lead,
 first_value/last_value) functions follow the standard definitions.
@@ -106,15 +107,18 @@ def replace_window_calls(e: A.Expr, mapping: dict) -> A.Expr:
 
 
 def _frame_mode(spec: A.WindowSpec) -> str:
-    """-> 'running' | 'whole'. Only the frame spellings equivalent to the
-    SQL defaults are accepted (see module docstring)."""
+    """-> 'running' (RANGE: peers share the frame end) |
+    'running_rows' (ROWS: strictly per-row) | 'whole'."""
     if spec.frame is None:
         return "running" if spec.order_by else "whole"
-    body = spec.frame.upper().split("BETWEEN", 1)[-1].strip()
+    text = spec.frame.upper()
+    body = text.split("BETWEEN", 1)[-1].strip()
     if body == "UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING":
         return "whole"
     if body == "UNBOUNDED PRECEDING AND CURRENT ROW":
-        return "running" if spec.order_by else "whole"
+        if not spec.order_by:
+            return "whole"
+        return "running_rows" if text.startswith("ROWS") else "running"
     raise UnsupportedError(f"window frame not supported: {spec.frame}")
 
 
@@ -223,12 +227,14 @@ def _dispatch(fc, src, mode, order, part_start, peer_start, n):
         if name == "rank":
             return rank, None
         if name == "dense_rank":
-            first_peer_part = part_id[first_of_peer]
-            dense = np.zeros(len(first_of_peer), np.int64)
-            for p in range(int(part_id.max()) + 1 if n else 0):
-                sel = first_peer_part == p
-                dense[sel] = np.arange(1, int(sel.sum()) + 1)
-            return dense[peer_id], None
+            # peer index minus the partition's first peer index, +1
+            # (peer_id is nondecreasing, so a running max of the values
+            # pinned at partition starts broadcasts each partition's
+            # first peer id)
+            part_first_peer = np.maximum.accumulate(
+                np.where(part_start, peer_id, 0)
+            )
+            return peer_id - part_first_peer + 1, None
         part_sizes = np.bincount(part_id, minlength=int(part_id.max()) + 1)
         size = part_sizes[part_id].astype(np.float64)
         if name == "percent_rank":
@@ -304,7 +310,7 @@ def _dispatch(fc, src, mode, order, part_start, peer_start, n):
             k = int(eval_const(fc.args[1])) - 1
             pos = np.minimum(first_pos + k, n - 1)
             within_arr = _partition_index(part_start)
-            if mode == "running":
+            if mode in ("running", "running_rows"):
                 # NULL until the frame has reached the k-th row
                 ok = within_arr >= k
             else:
@@ -313,6 +319,9 @@ def _dispatch(fc, src, mode, order, part_start, peer_start, n):
                 )
                 ok = part_sizes[part_id] > k
             return vals[pos], ok & valid[pos]
+        if mode == "running_rows":
+            # ROWS frame: the frame ends exactly at the current row
+            return vals, valid
         # last_value: running frame -> end of the current PEER group
         # (ties on the order keys share the frame end); whole ->
         # partition last
@@ -326,7 +335,9 @@ def _dispatch(fc, src, mode, order, part_start, peer_start, n):
         return vals[last_pos], valid[last_pos]
 
     if name in _AGG_OVER:
-        if name == "count" and not fc.args:
+        if name == "count" and (
+            not fc.args or isinstance(fc.args[0], A.Star)
+        ):
             col = Col(np.ones(n, np.int64))
         else:
             col = eval_expr(fc.args[0], src)
@@ -389,22 +400,25 @@ def _agg_over(name, vals, valid, mode, part_start, peer_start, part_id, n):
     if name in ("min", "max"):
         masked = np.where(valid, numeric,
                           -np.inf if name == "max" else np.inf)
-        out = np.empty(n)
-        acc = None
-        for i in range(n):  # partition-reset cummax/cummin
-            if part_start[i]:
-                acc = masked[i]
-            else:
-                acc = max(acc, masked[i]) if name == "max" \
-                    else min(acc, masked[i])
-            out[i] = acc
-        run = out
+        op = np.maximum if name == "max" else np.minimum
+        run = np.empty(n)
+        starts = np.where(part_start)[0]
+        for s, e in zip(starts, np.append(starts[1:], n)):
+            # accumulate is vectorized per partition slice
+            run[s:e] = op.accumulate(masked[s:e])
     elif name == "count":
         run = run_cnt
     elif name in ("avg", "mean"):
         run = run_sum / np.maximum(run_cnt, 1)
     else:
         run = run_sum
+    if mode == "running_rows":
+        # ROWS frame: strictly per-row, no peer sharing
+        if name == "count":
+            return run_cnt.astype(np.int64), None
+        if name in ("min", "max"):
+            return run, (run_cnt > 0)
+        return run, (run_cnt > 0)
     # peers share the frame end: broadcast the value at each peer
     # group's last row back over the group
     peer_id = np.cumsum(peer_start) - 1

@@ -341,6 +341,11 @@ class ShowCreateView(Statement):
 
 
 @dataclass
+class ShowCreateFlow(Statement):
+    name: str
+
+
+@dataclass
 class DescribeTable(Statement):
     name: str
 

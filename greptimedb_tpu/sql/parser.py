@@ -666,6 +666,8 @@ class Parser:
         if self.eat_kw("CREATE"):
             if self.eat_kw("VIEW"):
                 return A.ShowCreateView(self.qualified_name())
+            if self.eat_kw("FLOW"):
+                return A.ShowCreateFlow(self.qualified_name())
             self.expect_kw("TABLE")
             return A.ShowCreateTable(self.qualified_name())
         if self.eat_kw("VARIABLES"):

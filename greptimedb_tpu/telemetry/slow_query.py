@@ -1,0 +1,55 @@
+"""Slow-query logging.
+
+Capability counterpart of the reference's StatementStatistics slow-query
+support (/root/reference/src/cmd/src/standalone.rs:570 wiring + the
+[logging.slow_query] config section): statements slower than the
+threshold are logged and kept in a bounded ring surfaced through
+`information_schema.slow_queries`.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import deque
+
+logger = logging.getLogger("greptimedb_tpu.slow_query")
+
+
+class SlowQueryLog:
+    def __init__(self, *, enable: bool = True, threshold_s: float = 5.0,
+                 sample_ratio: float = 1.0, capacity: int = 256):
+        self.enable = enable
+        self.threshold_s = float(threshold_s)
+        self.sample_ratio = min(1.0, max(0.0, float(sample_ratio)))
+        self._ring: deque = deque(maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+        self.total_recorded = 0
+
+    def maybe_record(self, sql: str, elapsed_s: float, *, db: str = "",
+                     channel: str = ""):
+        if not self.enable or elapsed_s < self.threshold_s:
+            return
+        if self.sample_ratio < 1.0 and random.random() > self.sample_ratio:
+            return
+        entry = {
+            "ts_ms": int(time.time() * 1000),
+            "cost_ms": round(elapsed_s * 1000.0, 3),
+            "threshold_ms": round(self.threshold_s * 1000.0, 3),
+            "query": sql[:4096],
+            "schema": db,
+            "channel": channel,
+        }
+        with self._lock:
+            self._ring.append(entry)
+            self.total_recorded += 1
+        logger.warning(
+            "slow query (%.1f ms > %.0f ms) [%s]: %s",
+            entry["cost_ms"], entry["threshold_ms"], db, entry["query"],
+        )
+
+    def entries(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)

@@ -1,0 +1,111 @@
+"""Slow-query log, SHOW CREATE FLOW, anonymous telemetry reporter.
+
+Reference: StatementStatistics slow-query wiring (src/cmd/src/
+standalone.rs:570), SHOW CREATE FLOW (src/sql/src/parser.rs), and
+src/common/greptimedb-telemetry/src/lib.rs.
+"""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.telemetry.report import TelemetryTask, install_uuid
+from greptimedb_tpu.telemetry.slow_query import SlowQueryLog
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    inst = Standalone(str(tmp_path / "data"), prefer_device=False,
+                      warm_start=False)
+    yield inst
+    inst.close()
+
+
+def test_slow_query_recorded(inst):
+    inst.slow_query_log = SlowQueryLog(threshold_s=0.0)
+    inst.execute_sql(
+        "create table t (ts timestamp time index, v double)"
+    )
+    inst.sql("select count(v) from t")
+    entries = inst.slow_query_log.entries()
+    assert any("select count(v)" in e["query"] for e in entries)
+    r = inst.sql("select query, cost_time_ms from information_schema.slow_queries")
+    assert r.num_rows >= 1
+    # threshold filters
+    log = SlowQueryLog(threshold_s=10.0)
+    log.maybe_record("fast", 0.001)
+    assert log.entries() == []
+    log.maybe_record("slow", 11.0, db="public")
+    assert log.entries()[0]["query"] == "slow"
+    # disabled log records nothing
+    off = SlowQueryLog(enable=False, threshold_s=0.0)
+    off.maybe_record("x", 99.0)
+    assert off.entries() == []
+
+
+def test_show_create_flow(inst):
+    inst.enable_flows(tick_interval_s=3600.0)
+    inst.execute_sql(
+        "create table src (ts timestamp time index, host string primary "
+        "key, v double)"
+    )
+    inst.execute_sql(
+        "create flow f1 sink to agg_out as "
+        "select host, sum(v) from src group by host"
+    )
+    r = inst.sql("show create flow f1")
+    assert r.names == ["Flow", "Create Flow"]
+    text = str(r.cols[1].values[0]).lower()
+    assert "create flow" in text and "sink to" in text
+    from greptimedb_tpu.errors import TableNotFoundError
+
+    with pytest.raises(TableNotFoundError):
+        inst.sql("show create flow nope")
+
+
+def test_install_uuid_stable(tmp_path):
+    a = install_uuid(str(tmp_path))
+    b = install_uuid(str(tmp_path))
+    assert a == b and len(a) == 36
+
+
+def test_telemetry_report_roundtrip(tmp_path):
+    received = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        task = TelemetryTask(
+            str(tmp_path),
+            endpoint=f"http://127.0.0.1:{httpd.server_address[1]}/report",
+            mode="standalone",
+        )
+        assert task.report_once()
+        assert task.reports_sent == 1
+        payload = received[0]
+        assert payload["uuid"] == install_uuid(str(tmp_path))
+        assert payload["mode"] == "standalone"
+        assert payload["version"]
+    finally:
+        httpd.shutdown()
+
+
+def test_telemetry_failure_is_silent(tmp_path):
+    task = TelemetryTask(str(tmp_path),
+                         endpoint="http://127.0.0.1:1/nope")
+    assert task.report_once() is False
+    assert task.reports_sent == 0
