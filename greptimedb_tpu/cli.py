@@ -296,6 +296,7 @@ def _start_datanode(opts):
     _flight_server(inst, opts, closers)
     _http_server(inst, opts, closers)
     _export_metrics(inst, opts, closers)
+    _telemetry(opts, closers, mode="datanode")
     meta_addr = opts.get("datanode.metasrv_addr") or ""
     if meta_addr:
         node_id = int(opts.get("datanode.node_id", 0))
@@ -372,6 +373,7 @@ def _start_frontend(opts):
     closers = [inst.close]
     _wire_protocols(inst, opts, closers)
     server = _http_server(inst, opts, closers)
+    _telemetry(opts, closers, mode="frontend")
     print(
         f"greptimedb-tpu frontend -> datanodes {addrs} on "
         f"http://{server.addr}:{server.port}", flush=True,
@@ -387,14 +389,17 @@ def _start_metasrv(opts):
         addr=mh, port=mp, data_home=opts.get("data_home"),
         selector=opts.get("metasrv.selector", "round_robin"),
     ).start()
+    closers = [srv.close]
+    _telemetry(opts, closers, mode="metasrv")
     print(f"greptimedb-tpu metasrv on {mh}:{srv.port}", flush=True)
-    return _serve_until_signal([srv.close])
+    return _serve_until_signal(closers)
 
 
 def _start_flownode(opts):
     inst = _make_instance(opts)   # flows on by default
     closers = [inst.close]
     server = _http_server(inst, opts, closers)
+    _telemetry(opts, closers, mode="flownode")
     print(
         f"greptimedb-tpu flownode on http://{server.addr}:{server.port}",
         flush=True,

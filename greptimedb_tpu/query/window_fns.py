@@ -416,8 +416,6 @@ def _agg_over(name, vals, valid, mode, part_start, peer_start, part_id, n):
         # ROWS frame: strictly per-row, no peer sharing
         if name == "count":
             return run_cnt.astype(np.int64), None
-        if name in ("min", "max"):
-            return run, (run_cnt > 0)
         return run, (run_cnt > 0)
     # peers share the frame end: broadcast the value at each peer
     # group's last row back over the group

@@ -194,7 +194,7 @@ class _Handler(socketserver.BaseRequestHandler):
         server: MySqlServer = self.server.owner  # type: ignore[attr-defined]
         inst = server.instance
         conn = _Conn(self.request)
-        ctx = QueryContext(database="public")
+        ctx = QueryContext(database="public", channel="mysql")
         scramble = secrets.token_bytes(20)
         # scramble bytes must not contain NUL (clients C-string them)
         scramble = bytes((b % 254) + 1 for b in scramble)

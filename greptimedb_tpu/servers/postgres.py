@@ -243,7 +243,7 @@ class _Handler(socketserver.BaseRequestHandler):
         )))
         conn.send(_ready())
 
-        ctx = QueryContext()
+        ctx = QueryContext(channel="postgres")
         if params.get("database"):
             ctx.database = params["database"]
         inst = server.instance

@@ -31,6 +31,41 @@ def pick_compaction(region: Region) -> list | None:
     return None
 
 
+def purge_expired(region: Region, *, now_ms: int | None = None) -> int:
+    """Physically drop whole SSTs past the table's TTL horizon (the
+    reference removes expired files during compaction scheduling,
+    src/mito2/src/compaction.rs get_expired_ssts). Query-time filtering
+    already hides expired rows (region.py scan ts_min clamp); this
+    reclaims the storage. Returns files removed."""
+    import time as _time
+
+    ttl = region.meta.options.ttl_ms
+    if ttl is None:
+        return 0
+    horizon = (now_ms if now_ms is not None
+               else int(_time.time() * 1000)) - ttl
+    with region._lock:
+        expired = [
+            m for m in region.manifest.state.ssts if m.ts_max < horizon
+        ]
+        if not expired:
+            return 0
+        region.manifest.commit({
+            "kind": "compact",
+            "remove_files": [m.file_id for m in expired],
+            "add_ssts": [],
+        })
+        # rows disappeared without a write: bump the logical data
+        # version so device grid caches rebuild rather than serve
+        # purged rows
+        region._truncate_epoch += 1
+    for m in expired:
+        region.store.delete(m.path)
+        if m.fulltext:
+            region.store.delete(sidecar_path(m.path))
+    return len(expired)
+
+
 def compact_once(region: Region) -> bool:
     """Run one compaction if triggered. Returns True if work was done.
 

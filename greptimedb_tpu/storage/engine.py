@@ -203,8 +203,11 @@ class TsdbEngine:
                 r.flush()
 
     def run_maintenance(self):
+        from greptimedb_tpu.storage.compaction import purge_expired
+
         self.maybe_flush()
         for r in self.regions():
+            purge_expired(r)
             compact_once(r)
 
     def _background_loop(self):
