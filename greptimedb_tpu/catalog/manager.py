@@ -423,6 +423,20 @@ class CatalogManager:
                     f"{existing.data_type.name}"
                 )
             table.info.schema = table.info.schema.with_column(col)
+            if table.info.engine == "metric":
+                # logical metric table: the column must land on the
+                # SHARED physical table (its own schema + regions) so it
+                # persists across reopen — the metric engine's
+                # add-columns-on-demand (ref src/metric-engine/src/
+                # engine/alter.rs)
+                from greptimedb_tpu import metric_engine as ME
+
+                physical = ME.ensure_physical_table(self, database)
+                ME.widen_physical_for(
+                    self, database, physical, table.info.schema
+                )
+                self._persist()
+                return
             if col.semantic_type == SemanticType.TAG:
                 # existing series read "" for the new tag; sids stay stable
                 for region in table.regions:
@@ -446,6 +460,15 @@ class CatalogManager:
                 raise InvalidArgumentError(
                     "only FIELD columns can be dropped"
                 )
+            if table.info.engine == "metric":
+                # logical drop only: the physical column is SHARED with
+                # every other metric — touching the physical regions'
+                # field lists would break ingest for all of them
+                table.info.schema = table.info.schema.without_column(
+                    col_name
+                )
+                self._persist()
+                return
             table.info.schema = table.info.schema.without_column(col_name)
             for region in table.regions:
                 if col_name in region.meta.field_names:

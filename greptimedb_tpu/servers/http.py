@@ -481,7 +481,7 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 data = {}
                 limit = int(params.get("limit", "-1") or -1)
                 for t in instance.catalog.all_tables():
-                    if t.info.database != db:
+                    if t.info.database != db or _prom_hidden(t):
                         continue
                     if limit >= 0 and len(data) >= limit:
                         break
@@ -518,9 +518,11 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                         names.update(table.tag_names)
                 if not _match_params(params):
                     for t in instance.catalog.all_tables():
-                        if t.info.database != db:
+                        if t.info.database != db or _prom_hidden(t):
                             continue
                         names.update(t.tag_names)
+                names = {n for n in names
+                         if n == "__name__" or not n.startswith("__")}
                 return self._json(
                     200, {"status": "success", "data": sorted(names)}
                 )
@@ -529,7 +531,7 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 values = set()
                 if label == "__name__":
                     for t in instance.catalog.all_tables():
-                        if t.info.database == db:
+                        if t.info.database == db and not _prom_hidden(t):
                             values.add(t.name)
                 else:
                     tables = [
@@ -537,17 +539,12 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                         for m in _match_params(params)
                     ] or [
                         t for t in instance.catalog.all_tables()
-                        if t.info.database == db
+                        if t.info.database == db and not _prom_hidden(t)
                     ]
                     for t in tables:
                         if t is None or label not in t.tag_names:
                             continue
-                        for region in t.regions:
-                            idx = region.series.tag_names.index(label)
-                            values.update(
-                                v for v in region.series.dicts[idx].values
-                                if v != ""
-                            )
+                        values.update(_table_label_values(t, label))
                 return self._json(
                     200, {"status": "success", "data": sorted(values)}
                 )
@@ -775,6 +772,38 @@ def _prom_instant_json(val, ev) -> dict:
         })
     return {"status": "success",
             "data": {"resultType": "vector", "result": result}}
+
+
+def _prom_hidden(t) -> bool:
+    """Internal tables (the metric engine's shared physical table) never
+    surface through the Prometheus discovery APIs."""
+    from greptimedb_tpu.metric_engine import PHYSICAL_TABLE
+
+    return t.name == PHYSICAL_TABLE
+
+
+def _table_label_values(t, label: str) -> set:
+    """Distinct non-empty values of `label` among THIS table's series.
+    A logical metric table shares physical regions with every other
+    metric, so its values must filter by __table_id rather than read
+    the shared dictionary (which would leak other metrics' values)."""
+    from greptimedb_tpu import metric_engine as ME
+
+    out: set = set()
+    if isinstance(t, ME.LogicalTable):
+        for region in t.regions:
+            sids = region.series.match_sids(
+                [(ME.TABLE_ID_TAG, "eq", t._tid)]
+            )
+            if len(sids) == 0:
+                continue
+            vals = region.series.tag_values(label)
+            out.update(v for v in vals[sids] if v != "")
+        return out
+    for region in t.regions:
+        idx = region.series.tag_names.index(label)
+        out.update(v for v in region.series.dicts[idx].values if v != "")
+    return out
 
 
 def _match_params(params: dict) -> list[str]:

@@ -304,9 +304,12 @@ def _write_measurement(instance, db: str, measurement: str, rows) -> int:
 def ensure_table(instance, db: str, name: str, tag_keys: list[str],
                  field_types: dict[str, ConcreteDataType],
                  *, ts_type: ConcreteDataType | None = None,
-                 ts_name: str = "ts", options: dict | None = None):
+                 ts_name: str = "ts", options: dict | None = None,
+                 engine: str = "mito"):
     """Auto-create or widen a table for protocol ingest (the reference's
-    auto-create/auto-alter on insert, src/operator/src/insert.rs)."""
+    auto-create/auto-alter on insert, src/operator/src/insert.rs).
+    engine="metric" creates a logical table over the shared physical
+    region pair (the metric engine's remote-write role)."""
     table = instance.catalog.maybe_table(db, name)
     if table is None:
         cols = [
@@ -324,7 +327,7 @@ def ensure_table(instance, db: str, name: str, tag_keys: list[str],
             instance.catalog.create_database(db, if_not_exists=True)
         return instance.catalog.create_table(
             db, name, Schema(cols), if_not_exists=True,
-            options=options or {},
+            options=options or {}, engine=engine,
         )
     # widen: add unseen tags/fields; a name clash across semantics is an
     # error, not a silent drop

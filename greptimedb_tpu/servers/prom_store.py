@@ -149,9 +149,14 @@ def apply_series(instance, serieses, *, db: str = "public") -> int:
             for k in labels:
                 if k not in tag_keys:
                     tag_keys.append(k)
+        # remote-write metrics ride the METRIC ENGINE: thousands of
+        # small metrics share one physical region pair instead of each
+        # costing regions (ref src/metric-engine/src/engine.rs:60 —
+        # "backs Prometheus remote-write tables")
         table = ensure_table(
             instance, db, metric, tag_keys,
             {VALUE_FIELD: ConcreteDataType.float64()},
+            engine="metric",
         )
         rows_ts = []
         rows_val = []
@@ -283,7 +288,9 @@ def remote_read(instance, body: bytes, *, db: str = "public") -> bytes:
                         + _field_bytes(2, metric.encode())
                     ))
                     for k, v in labels.items():
-                        if v == "":
+                        if v == "" or k.startswith("__"):
+                            # internal tags (metric engine __table_id)
+                            # never leave the node
                             continue
                         lab_bytes += _field_bytes(1, (
                             _field_bytes(1, k.encode())

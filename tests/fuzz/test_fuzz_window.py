@@ -1,0 +1,159 @@
+"""Property fuzz: window functions vs a brute-force oracle.
+
+Random frames/specs/data checked against a per-row O(n^2) reference
+implementation of the SQL default-frame semantics (the engine's
+vectorized path lives in query/window_fns.py).
+"""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+
+N_CASES = 12
+
+
+def _oracle(rows, func, part_key, order_keys, mode):
+    """rows: list of dicts with k/host/ts/v. Returns {k: value|None}.
+    mode: 'running' (RANGE peers) | 'rows' | 'whole'."""
+    out = {}
+    for i, r in enumerate(rows):
+        part = [
+            (j, s) for j, s in enumerate(rows)
+            if part_key is None or s[part_key] == r[part_key]
+        ]
+        part.sort(key=lambda js: tuple(js[1][k] for k in order_keys)
+                  + (js[0],))
+        pos = next(p for p, (j, _) in enumerate(part) if j == i)
+        if order_keys and mode == "running":
+            me = tuple(r[k] for k in order_keys)
+            frame = [s for _, s in part
+                     if tuple(s[k] for k in order_keys) <= me]
+        elif order_keys and mode == "rows":
+            frame = [s for _, s in part[:pos + 1]]
+        else:
+            frame = [s for _, s in part]
+        vals = [s["v"] for s in frame if s["v"] is not None]
+        if func == "row_number":
+            out[r["k"]] = pos + 1
+        elif func == "rank":
+            me = tuple(r[k] for k in order_keys)
+            out[r["k"]] = 1 + sum(
+                1 for _, s in part
+                if tuple(s[k] for k in order_keys) < me
+            )
+        elif func == "dense_rank":
+            me = tuple(r[k] for k in order_keys)
+            distinct_before = {
+                tuple(s[k] for k in order_keys) for _, s in part
+                if tuple(s[k] for k in order_keys) < me
+            }
+            out[r["k"]] = len(distinct_before) + 1
+        elif func == "count":
+            out[r["k"]] = len(vals)
+        elif func == "sum":
+            out[r["k"]] = sum(vals) if vals else None
+        elif func == "avg":
+            out[r["k"]] = sum(vals) / len(vals) if vals else None
+        elif func == "min":
+            out[r["k"]] = min(vals) if vals else None
+        elif func == "max":
+            out[r["k"]] = max(vals) if vals else None
+        elif func == "first_value":
+            out[r["k"]] = frame[0]["v"]
+        elif func == "last_value":
+            out[r["k"]] = frame[-1]["v"]
+        elif func == "lag":
+            out[r["k"]] = part[pos - 1][1]["v"] if pos >= 1 else None
+        elif func == "lead":
+            out[r["k"]] = (part[pos + 1][1]["v"]
+                           if pos + 1 < len(part) else None)
+        else:
+            raise AssertionError(func)
+    return out
+
+
+@pytest.mark.parametrize("seed", range(N_CASES))
+def test_window_vs_oracle(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(20, 120))
+    hosts = [f"h{int(x)}" for x in rng.integers(0, 4, n)]
+    # unique (ts, k) ordering removes intra-peer ambiguity for the
+    # order-sensitive functions; ts alone has ties on purpose
+    ts = [int(x) * 1000 for x in rng.integers(0, n // 3 + 2, n)]
+    v = [None if rng.random() < 0.15 else round(float(x), 3)
+         for x in rng.normal(50, 20, n)]
+    rows = [{"k": i, "host": hosts[i], "ts": ts[i], "v": v[i]}
+            for i in range(n)]
+
+    inst = Standalone(str(tmp_path / "d"), prefer_device=False,
+                      warm_start=False)
+    try:
+        inst.execute_sql(
+            "create table t (rts timestamp time index, k bigint, "
+            "host string primary key, ts bigint, v double)"
+        )
+        vals = ", ".join(
+            f"({i}, {r['k']}, '{r['host']}', {r['ts']}, "
+            f"{'NULL' if r['v'] is None else r['v']})"
+            for i, r in enumerate(rows)
+        )
+        inst.execute_sql(
+            f"insert into t (rts, k, host, ts, v) values {vals}"
+        )
+
+        func = str(rng.choice([
+            "row_number", "rank", "dense_rank", "count", "sum", "avg",
+            "min", "max", "first_value", "last_value", "lag", "lead",
+        ]))
+        partition = bool(rng.random() < 0.6)
+        part_sql = "PARTITION BY host " if partition else ""
+        part_key = "host" if partition else None
+        order_sensitive = func in (
+            "row_number", "first_value", "last_value", "lag", "lead",
+        )
+        # order-sensitive funcs get a unique composite key (ts, k)
+        order_keys = ["ts", "k"] if order_sensitive else ["ts"]
+        order_sql = "ORDER BY " + ", ".join(order_keys)
+        frame_mode = "running"
+        frame_sql = ""
+        if func in ("count", "sum", "avg", "min", "max"):
+            pick = rng.random()
+            if pick < 0.33:
+                frame_mode = "rows"
+                frame_sql = (" ROWS BETWEEN UNBOUNDED PRECEDING "
+                             "AND CURRENT ROW")
+            elif pick < 0.55:
+                frame_mode = "whole"
+                order_sql = ""
+        args = "v" if func not in (
+            "row_number", "rank", "dense_rank",
+        ) else ""
+        if func == "count" and rng.random() < 0.5:
+            args = "*"
+        q = (f"SELECT k, {func}({args}) OVER ({part_sql}{order_sql}"
+             f"{frame_sql}) AS w FROM t")
+        res = inst.sql(q)
+        got = {int(k): w for k, w in zip(res.cols[0].values,
+                                         [None if not val else x
+                                          for x, val in zip(
+                                              res.cols[1].values,
+                                              res.cols[1].valid_mask)])}
+        want = _oracle(
+            rows, func, part_key,
+            order_keys if order_sql else [], frame_mode,
+        )
+        if func == "count" and args == "*":
+            want = _oracle(
+                [dict(r, v=0.0) for r in rows], "count", part_key,
+                order_keys if order_sql else [], frame_mode,
+            )
+        for k in want:
+            g, w = got[k], want[k]
+            if w is None or g is None:
+                assert g == w, (q, k, g, w)
+            else:
+                assert float(g) == pytest.approx(float(w), rel=1e-9), \
+                    (q, k, g, w)
+    finally:
+        inst.close()

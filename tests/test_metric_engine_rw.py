@@ -1,0 +1,111 @@
+"""Prometheus remote write rides the metric engine: many logical metric
+tables over ONE shared physical table (reference:
+src/metric-engine/src/engine.rs:60-115 — "backs Prometheus remote-write
+tables")."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.metric_engine import PHYSICAL_TABLE
+from greptimedb_tpu.servers.prom_store import apply_series
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    inst = Standalone(str(tmp_path / "data"), prefer_device=False,
+                      warm_start=False)
+    yield inst
+    inst.close()
+
+
+def _write_metrics(inst, n_metrics: int, t0: int = 0):
+    series = []
+    for m in range(n_metrics):
+        series.append((
+            {"__name__": f"metric_{m}", "host": f"h{m % 3}"},
+            [(float(m), t0 + 1000), (float(m) + 0.5, t0 + 2000)],
+        ))
+    return apply_series(inst, series, db="public")
+
+
+def test_many_metrics_share_one_physical_table(inst, tmp_path):
+    n = _write_metrics(inst, 20)
+    assert n == 40
+    # every metric is a logical table ...
+    for m in (0, 7, 19):
+        t = inst.catalog.table("public", f"metric_{m}")
+        assert t.info.engine == "metric"
+    # ... over ONE physical region set (not 20 tables x regions)
+    phys = inst.catalog.table("public", PHYSICAL_TABLE)
+    region_count = sum(
+        1 for r in inst.engine.regions()
+    )
+    assert region_count == len(phys.regions)
+    # logical reads are isolated per metric
+    r = inst.sql("select greptime_value from metric_7 order by ts")
+    assert [float(x) for x in r.cols[0].values] == [7.0, 7.5]
+    # and the physical table holds everything
+    r = inst.sql(
+        f"select count(greptime_value) from {PHYSICAL_TABLE}"
+    )
+    assert r.cols[0].values[0] == 40
+
+
+def test_new_label_widens_physical(inst):
+    _write_metrics(inst, 2)
+    # same metric reappears with a new label
+    apply_series(inst, [(
+        {"__name__": "metric_0", "host": "h0", "dc": "west"},
+        [(9.0, 5000)],
+    )], db="public")
+    r = inst.sql(
+        "select dc, greptime_value from metric_0 where dc != '' "
+    )
+    assert r.rows() == [["west", 9.0]]
+    phys = inst.catalog.table("public", PHYSICAL_TABLE)
+    assert phys.schema.maybe_column("dc") is not None
+
+
+def test_metric_tables_survive_restart(tmp_path, inst):
+    _write_metrics(inst, 5)
+    apply_series(inst, [(
+        {"__name__": "metric_1", "host": "h9", "zone": "z1"},
+        [(42.0, 9000)],
+    )], db="public")
+    inst.catalog.table("public", PHYSICAL_TABLE).flush()
+    inst.close()
+    inst2 = Standalone(str(tmp_path / "data"), prefer_device=False,
+                       warm_start=False)
+    try:
+        r = inst2.sql(
+            "select greptime_value from metric_1 where zone = 'z1'"
+        )
+        assert [float(x) for x in r.cols[0].values] == [42.0]
+        t = inst2.catalog.table("public", "metric_1")
+        assert t.info.engine == "metric"
+        assert t.schema.maybe_column("zone") is not None
+    finally:
+        inst2.close()
+
+
+def test_promql_over_metric_engine(inst):
+    _write_metrics(inst, 3, t0=1_700_000_000_000)
+    from greptimedb_tpu.promql.engine import PromEngine
+
+    engine = PromEngine(inst)
+    val, ev = engine.query_instant(
+        "metric_2", 1_700_000_000_000 + 2000
+    )
+    samples = [(lab.get("host"), v) for lab, v, *_ in _to_pairs(val, ev)]
+    assert samples == [("h2", 2.5)]
+
+
+def _to_pairs(val, ev):
+    from greptimedb_tpu.promql.engine import _to_vector
+
+    v = _to_vector(val, ev)
+    out = []
+    for i, lab in enumerate(v.labels):
+        out.append((lab, float(v.values[i])))
+    return out
