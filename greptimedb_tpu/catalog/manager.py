@@ -460,16 +460,13 @@ class CatalogManager:
                 raise InvalidArgumentError(
                     "only FIELD columns can be dropped"
                 )
+            table.info.schema = table.info.schema.without_column(col_name)
             if table.info.engine == "metric":
                 # logical drop only: the physical column is SHARED with
                 # every other metric — touching the physical regions'
                 # field lists would break ingest for all of them
-                table.info.schema = table.info.schema.without_column(
-                    col_name
-                )
                 self._persist()
                 return
-            table.info.schema = table.info.schema.without_column(col_name)
             for region in table.regions:
                 if col_name in region.meta.field_names:
                     region.meta.field_names.remove(col_name)

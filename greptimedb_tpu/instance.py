@@ -660,7 +660,7 @@ class Standalone:
             cols.append(ColumnSchema(
                 name=cd.name, data_type=cd.data_type, semantic_type=sem,
                 nullable=cd.nullable and sem == SemanticType.FIELD,
-                default=cd.default, fulltext=cd.fulltext,
+                default=_const_default(cd.default), fulltext=cd.fulltext,
             ))
         schema = Schema(cols)
         num_regions = 1
@@ -695,7 +695,7 @@ class Standalone:
             sem = SemanticType.TAG if cd.primary_key else SemanticType.FIELD
             self.catalog.alter_add_column(db, name, ColumnSchema(
                 name=cd.name, data_type=cd.data_type, semantic_type=sem,
-                nullable=True, default=cd.default,
+                nullable=True, default=_const_default(cd.default),
             ))
         elif stmt.action == "drop_column":
             self.catalog.alter_drop_column(db, name, stmt.old_name)
@@ -744,6 +744,18 @@ class Standalone:
             arr, v = _coerce_insert(vals, col_schema.data_type)
             data[c] = arr
             valid[c] = v
+        # declared DEFAULTs fill columns omitted from the column list
+        # (explicit NULLs stay NULL — standard SQL, ref
+        # src/datatypes/src/schema/column_schema.rs default constraints)
+        for cs in schema.columns:
+            if cs.name in data or cs.default is None or cs.is_time_index:
+                continue
+            default = cs.default
+            if isinstance(default, A.Expr):
+                default = eval_const(default)
+            arr, v = _coerce_insert([default] * n, cs.data_type)
+            data[cs.name] = arr
+            valid[cs.name] = v
         written = self._write_columns(table, data, valid)
         self._notify_flows(db, name, table, data, valid)
         return written
@@ -1115,6 +1127,15 @@ def substitute_placeholders(text: str, args: list) -> str:
         else:
             out.append(seg)
     return "".join(out)
+
+
+def _const_default(default):
+    """DDL DEFAULT expressions fold to plain values at create/alter time
+    (they persist in the catalog JSON; an AST node would not serialize
+    and could not fill omitted INSERT columns)."""
+    if isinstance(default, A.Expr):
+        return eval_const(default)
+    return default
 
 
 def _coerce_insert(vals: list, dt: ConcreteDataType):

@@ -108,6 +108,17 @@ def widen_physical_for(catalog, db: str, physical: Table,
         if c.is_time_index:
             continue
         existing = physical.schema.maybe_column(c.name)
+        if existing is not None and (
+            existing.semantic_type != c.semantic_type
+        ):
+            from greptimedb_tpu.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"column {c.name!r} already exists on the physical "
+                f"metric table as a {existing.semantic_type.name}; the "
+                f"logical table wants a {c.semantic_type.name} — rename "
+                "the label/field"
+            )
         if existing is None:
             catalog.alter_add_column(
                 db, PHYSICAL_TABLE,

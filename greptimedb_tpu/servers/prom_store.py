@@ -252,10 +252,15 @@ def remote_read(instance, body: bytes, *, db: str = "public") -> bytes:
             op = {0: "eq", 1: "ne", 2: "re", 3: "nre"}[mtype]
             val = _re.compile(value) if mtype in (2, 3) else value
             reg_matchers.append((name, op, val))
-        # resolve metric names: EQ narrows to one, RE/NEQ/NRE filter all
+        # resolve metric names: EQ narrows to one, RE/NEQ/NRE filter all.
+        # The metric engine's shared physical table is internal — a
+        # regex/NEQ matcher must not surface every sample a second time
+        # under its name.
+        from greptimedb_tpu.metric_engine import PHYSICAL_TABLE
+
         metrics = [
             t.name for t in instance.catalog.all_tables()
-            if t.info.database == db
+            if t.info.database == db and t.name != PHYSICAL_TABLE
         ]
         for mtype, value in name_matchers:
             if mtype == 0:

@@ -1231,6 +1231,17 @@ class Parser:
 
     def func_call(self, name: str) -> A.Expr:
         self.expect_op("(")
+        # EXTRACT(part FROM expr) standard form -> extract('part', expr)
+        if name.lower() in ("extract", "date_part") \
+                and self.peek().kind == Tok.IDENT \
+                and self.peek(1).kind == Tok.IDENT \
+                and self.peek(1).upper == "FROM":
+            part = self.ident()
+            self.expect_kw("FROM")
+            operand = self.expr()
+            self.expect_op(")")
+            return A.FuncCall(name.lower(),
+                              [A.Literal(part.lower()), operand])
         distinct = self.eat_kw("DISTINCT")
         args: list[A.Expr] = []
         order_by: list[A.OrderItem] = []

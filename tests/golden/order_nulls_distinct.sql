@@ -1,0 +1,53 @@
+-- NULL ordering and DISTINCT semantics (reference: common/order +
+-- common/aggregate/distinct sqlness areas)
+
+CREATE TABLE s (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO s VALUES
+  (1000, 'a', 2.0), (2000, 'b', NULL), (3000, 'c', 1.0),
+  (4000, 'd', 2.0), (5000, 'e', NULL);
+
+-- SQL default: NULLS LAST for ASC
+SELECT host, v FROM s ORDER BY v, host;
+----
+host|v
+c|1.0
+a|2.0
+d|2.0
+b|NULL
+e|NULL
+
+-- and NULLS FIRST for DESC
+SELECT host, v FROM s ORDER BY v DESC, host LIMIT 3;
+----
+host|v
+b|NULL
+e|NULL
+a|2.0
+
+SELECT host FROM s ORDER BY v NULLS FIRST, host LIMIT 2;
+----
+host
+b
+e
+
+-- DISTINCT treats NULLs as one group
+SELECT DISTINCT v FROM s ORDER BY v;
+----
+v
+1.0
+2.0
+NULL
+
+SELECT count(DISTINCT v) FROM s;
+----
+count(DISTINCT v)
+2
+
+-- aggregates skip NULLs
+SELECT count(v) AS c, sum(v) AS s, avg(v) AS a FROM s;
+----
+c|s|a
+3|5.0|1.66667
+
+DROP TABLE s;

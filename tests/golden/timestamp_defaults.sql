@@ -1,0 +1,67 @@
+-- Timestamp functions, interval arithmetic, and column DEFAULTs
+-- (behavior ports of the reference's common/timestamp + common/insert
+-- sqlness areas)
+
+CREATE TABLE events (
+  ts TIMESTAMP TIME INDEX,
+  level STRING DEFAULT 'info',
+  score DOUBLE DEFAULT 7.5,
+  n BIGINT
+);
+
+-- omitted columns take their declared DEFAULT
+INSERT INTO events (ts, n) VALUES (3600000, 1);
+
+-- explicit NULL stays NULL even with a DEFAULT declared
+INSERT INTO events (ts, score, n) VALUES (7200000, NULL, 2);
+
+SELECT level, score, n FROM events ORDER BY ts;
+----
+level|score|n
+info|7.5|1
+info|NULL|2
+
+-- EXTRACT standard form and function form agree
+SELECT extract(hour FROM ts) AS a, extract('hour', ts) AS b
+FROM events ORDER BY ts;
+----
+a|b
+1.0|1.0
+2.0|2.0
+
+SELECT date_trunc('hour', ts) FROM events ORDER BY ts;
+----
+date_trunc('hour', ts)
+3600000
+7200000
+
+-- interval arithmetic on the time index
+SELECT ts + INTERVAL '30 minutes' AS shifted FROM events ORDER BY ts;
+----
+shifted
+5400000
+9000000
+
+SELECT n FROM events
+WHERE ts >= TIMESTAMP '1970-01-01 02:00:00' - INTERVAL '1s';
+----
+n
+2
+
+-- timestamp string comparison coerces
+SELECT n FROM events WHERE ts = '1970-01-01 01:00:00';
+----
+n
+1
+
+SELECT to_unixtime('1970-01-01 00:01:40') AS u;
+----
+u
+100
+
+SELECT date_format(ts, '%Y-%m-%d %H:%M:%S') FROM events ORDER BY ts LIMIT 1;
+----
+date_format(ts, '%Y-%m-%d %H:%M:%S')
+1970-01-01 01:00:00
+
+DROP TABLE events;
