@@ -422,21 +422,24 @@ class CatalogManager:
                     f"column {col.name!r} already exists as "
                     f"{existing.data_type.name}"
                 )
-            table.info.schema = table.info.schema.with_column(col)
             if table.info.engine == "metric":
                 # logical metric table: the column must land on the
                 # SHARED physical table (its own schema + regions) so it
                 # persists across reopen — the metric engine's
                 # add-columns-on-demand (ref src/metric-engine/src/
-                # engine/alter.rs)
+                # engine/alter.rs). Widen (and validate against the
+                # physical schema) BEFORE touching the logical schema: a
+                # semantic collision must leave the table unchanged, not
+                # persist a column the physical side rejected.
                 from greptimedb_tpu import metric_engine as ME
 
                 physical = ME.ensure_physical_table(self, database)
-                ME.widen_physical_for(
-                    self, database, physical, table.info.schema
-                )
+                candidate = table.info.schema.with_column(col)
+                ME.widen_physical_for(self, database, physical, candidate)
+                table.info.schema = candidate
                 self._persist()
                 return
+            table.info.schema = table.info.schema.with_column(col)
             if col.semantic_type == SemanticType.TAG:
                 # existing series read "" for the new tag; sids stay stable
                 for region in table.regions:

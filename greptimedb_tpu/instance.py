@@ -723,6 +723,7 @@ class Standalone:
                 c: col.valid_mask
                 for c, col in zip(cols, res.cols)
             }
+            _apply_defaults(schema, data, valid, res.num_rows)
             written = self._write_columns(table, data, valid)
             self._notify_flows(db, name, table, data, valid)
             return written
@@ -744,18 +745,7 @@ class Standalone:
             arr, v = _coerce_insert(vals, col_schema.data_type)
             data[c] = arr
             valid[c] = v
-        # declared DEFAULTs fill columns omitted from the column list
-        # (explicit NULLs stay NULL — standard SQL, ref
-        # src/datatypes/src/schema/column_schema.rs default constraints)
-        for cs in schema.columns:
-            if cs.name in data or cs.default is None or cs.is_time_index:
-                continue
-            default = cs.default
-            if isinstance(default, A.Expr):
-                default = eval_const(default)
-            arr, v = _coerce_insert([default] * n, cs.data_type)
-            data[cs.name] = arr
-            valid[cs.name] = v
+        _apply_defaults(schema, data, valid, n)
         written = self._write_columns(table, data, valid)
         self._notify_flows(db, name, table, data, valid)
         return written
@@ -941,7 +931,7 @@ class Standalone:
             types.append(_sql_type_name(c.data_type))
             keys.append("PRI" if c.is_tag or c.is_time_index else "")
             nulls.append("YES" if c.nullable else "NO")
-            defaults.append("" if c.default is None else str(c.default))
+            defaults.append(default_display(c.default))
             semantics.append(
                 "TIMESTAMP" if c.is_time_index
                 else ("TAG" if c.is_tag else "FIELD")
@@ -1130,12 +1120,62 @@ def substitute_placeholders(text: str, args: list) -> str:
 
 
 def _const_default(default):
-    """DDL DEFAULT expressions fold to plain values at create/alter time
-    (they persist in the catalog JSON; an AST node would not serialize
-    and could not fill omitted INSERT columns)."""
+    """Normalize a DDL DEFAULT for catalog persistence: pure-literal
+    expressions fold to plain values; expressions with function calls
+    (now(), current_timestamp()...) persist as {"__expr__": text} and
+    re-evaluate on EVERY insert — folding them would freeze the
+    table-creation time into all future rows."""
+    if not isinstance(default, A.Expr):
+        return default
+
+    def has_call(e) -> bool:
+        if isinstance(e, A.FuncCall):
+            return True
+        if isinstance(e, A.BinaryOp):
+            return has_call(e.left) or has_call(e.right)
+        if isinstance(e, (A.UnaryOp, A.Cast)):
+            return has_call(e.operand)
+        return False
+
+    if has_call(default):
+        from greptimedb_tpu.query.expr import format_expr
+
+        return {"__expr__": format_expr(default)}
+    return eval_const(default)
+
+
+def default_display(default) -> str:
+    """Human form of a stored default (SHOW/DESCRIBE)."""
+    if default is None:
+        return ""
+    if isinstance(default, dict) and "__expr__" in default:
+        return default["__expr__"]
+    return str(default)
+
+
+def _eval_default(default):
+    """Stored default -> concrete value for this insert."""
+    if isinstance(default, dict) and "__expr__" in default:
+        from greptimedb_tpu.sql.parser import Parser
+
+        return eval_const(Parser(default["__expr__"]).expr())
     if isinstance(default, A.Expr):
         return eval_const(default)
     return default
+
+
+def _apply_defaults(schema, data: dict, valid: dict, n: int):
+    """Declared DEFAULTs fill columns omitted from an INSERT (explicit
+    NULLs stay NULL — standard SQL, ref src/datatypes/src/schema/
+    column_schema.rs default constraints). The time index participates
+    too (TIMESTAMP TIME INDEX DEFAULT current_timestamp())."""
+    for cs in schema.columns:
+        if cs.name in data or cs.default is None:
+            continue
+        arr, v = _coerce_insert([_eval_default(cs.default)] * n,
+                                cs.data_type)
+        data[cs.name] = arr
+        valid[cs.name] = v
 
 
 def _coerce_insert(vals: list, dt: ConcreteDataType):

@@ -448,10 +448,28 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             if not sql:
                 return self._error(400, "missing sql parameter")
             db = params.get("db", "public")
+            fmt = params.get("format", "greptimedb_v1").lower()
+            if fmt not in ("csv", "table", "greptimedb_v1"):
+                return self._error(400, f"unknown format {fmt!r}")
             ctx = QueryContext(database=db)
             t0 = time.perf_counter()
             outputs = instance.execute_sql(sql, ctx)
             elapsed = (time.perf_counter() - t0) * 1000
+            # alternate response formats (ref src/servers/src/http.rs
+            # ResponseFormat: csv | table | greptimedb_v1)
+            if fmt in ("csv", "table"):
+                res = next(
+                    (o.result for o in reversed(outputs)
+                     if o.result is not None), None
+                )
+                if res is None:
+                    return self._send(200, b"", "text/plain")
+                body = (_format_csv(res) if fmt == "csv"
+                        else _format_table(res))
+                return self._send(
+                    200, body.encode(),
+                    "text/csv" if fmt == "csv" else "text/plain",
+                )
             out_json = []
             for o in outputs:
                 if o.result is not None:
@@ -772,6 +790,38 @@ def _prom_instant_json(val, ev) -> dict:
         })
     return {"status": "success",
             "data": {"resultType": "vector", "result": result}}
+
+
+def _format_csv(res) -> str:
+    import csv
+    import io
+
+    buf = io.StringIO()
+    w = csv.writer(buf, lineterminator="\r\n")
+    w.writerow(res.names)
+    for row in res.rows():
+        w.writerow(["" if v is None else v for v in row])
+    return buf.getvalue()
+
+
+def _format_table(res) -> str:
+    """psql-style ASCII table."""
+    rows = [[("NULL" if v is None else str(v)) for v in r]
+            for r in res.rows()]
+    widths = [
+        max(len(n), *(len(r[i]) for r in rows)) if rows else len(n)
+        for i, n in enumerate(res.names)
+    ]
+    def line(ch="-", sep="+"):
+        return sep + sep.join(ch * (w + 2) for w in widths) + sep
+    def fmt(vals):
+        return "|" + "|".join(
+            f" {v}{' ' * (widths[i] - len(v))} " for i, v in enumerate(vals)
+        ) + "|"
+    out = [line(), fmt(res.names), line()]
+    out.extend(fmt(r) for r in rows)
+    out.append(line())
+    return "\n".join(out) + "\n"
 
 
 def _prom_hidden(t) -> bool:

@@ -1,0 +1,72 @@
+"""Dynamic column DEFAULTs (now()/current_timestamp()) evaluate per
+insert, not at CREATE time; INSERT..SELECT fills defaults too.
+(Reference: src/datatypes/src/schema/column_schema.rs
+ColumnDefaultConstraint::Function.)"""
+
+import time
+
+from greptimedb_tpu.instance import Standalone
+
+
+def test_dynamic_default_evaluates_per_insert(tmp_path):
+    inst = Standalone(str(tmp_path / "d"), prefer_device=False,
+                      warm_start=False)
+    try:
+        inst.execute_sql(
+            "create table t (ts timestamp time index, "
+            "created timestamp default now(), n bigint)"
+        )
+        inst.execute_sql("insert into t (ts, n) values (5000, 1)")
+        time.sleep(1.05)
+        inst.execute_sql("insert into t (ts, n) values (6000, 2)")
+        r = inst.sql("select created from t order by ts").rows()
+        assert r[1][0] - r[0][0] >= 1000, r
+        # survives restart (persisted as an expression, not a constant)
+        inst.close()
+        inst2 = Standalone(str(tmp_path / "d"), prefer_device=False,
+                           warm_start=False)
+        try:
+            before = int(time.time() * 1000)
+            inst2.execute_sql("insert into t (ts, n) values (7000, 3)")
+            r = inst2.sql("select created from t where ts = 7000").rows()
+            assert r[0][0] >= before - 1000
+        finally:
+            inst2.close()
+    finally:
+        try:
+            inst.close()
+        except Exception:
+            pass
+
+
+def test_time_index_default_current_timestamp(tmp_path):
+    inst = Standalone(str(tmp_path / "d"), prefer_device=False,
+                      warm_start=False)
+    try:
+        inst.execute_sql(
+            "create table t (ts timestamp time index default "
+            "current_timestamp(), n bigint)"
+        )
+        inst.execute_sql("insert into t (n) values (7)")
+        r = inst.sql("select n, ts from t").rows()
+        assert r[0][0] == 7 and r[0][1] > 0
+    finally:
+        inst.close()
+
+
+def test_insert_select_fills_defaults(tmp_path):
+    inst = Standalone(str(tmp_path / "d"), prefer_device=False,
+                      warm_start=False)
+    try:
+        inst.execute_sql(
+            "create table src (ts timestamp time index, n bigint)"
+        )
+        inst.execute_sql("insert into src values (1000, 1)")
+        inst.execute_sql(
+            "create table dst (ts timestamp time index, "
+            "level string default 'info', n bigint)"
+        )
+        inst.execute_sql("insert into dst (ts, n) select ts, n from src")
+        assert inst.sql("select level from dst").rows() == [["info"]]
+    finally:
+        inst.close()

@@ -107,5 +107,5 @@ def _to_pairs(val, ev):
     v = _to_vector(val, ev)
     out = []
     for i, lab in enumerate(v.labels):
-        out.append((lab, float(v.values[i])))
+        out.append((lab, float(np.asarray(v.values[i]).reshape(()))))
     return out

@@ -375,3 +375,34 @@ def test_influx_ns_precision_exact(tmp_path):
         assert int(row[1]) == ts0 and int(row[2]) == ts0 + n - 1
     finally:
         inst.close()
+
+
+def test_sql_response_formats(server):
+    _sql(server, "CREATE TABLE fmt_t (ts TIMESTAMP TIME INDEX, "
+                 "host STRING PRIMARY KEY, v DOUBLE)")
+    _sql(server, "INSERT INTO fmt_t VALUES (1000, 'a', 1.5), "
+                 "(2000, 'b', NULL)")
+    import urllib.parse
+    import urllib.request
+
+    def fetch(fmt):
+        q = urllib.parse.urlencode({
+            "sql": "SELECT host, v FROM fmt_t ORDER BY ts",
+            "format": fmt,
+        })
+        url = f"http://127.0.0.1:{server.port}/v1/sql?{q}"
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.headers.get("Content-Type"), r.read().decode()
+
+    ctype, body = fetch("csv")
+    assert ctype.startswith("text/csv")
+    assert body.splitlines() == ["host,v", "a,1.5", "b,"]
+    ctype, body = fetch("table")
+    assert "| host | v    |" in body and "| b    | NULL |" in body
+    # unknown format errors
+    q = urllib.parse.urlencode({"sql": "SELECT 1", "format": "nope"})
+    url = f"http://127.0.0.1:{server.port}/v1/sql?{q}"
+    import pytest as _pytest
+
+    with _pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(url, timeout=30)
