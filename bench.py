@@ -51,7 +51,7 @@ FIELD_NAMES = [
     "usage_iowait", "usage_irq", "usage_softirq", "usage_steal",
     "usage_guest", "usage_guest_nice",
 ]
-RUNS = 12
+RUNS = 20  # headline samples; the tunnel floor drifts, more pairs help
 
 
 def main():

@@ -1133,15 +1133,64 @@ def _const_default(default):
             return True
         if isinstance(e, A.BinaryOp):
             return has_call(e.left) or has_call(e.right)
-        if isinstance(e, (A.UnaryOp, A.Cast)):
+        if isinstance(e, (A.UnaryOp, A.Cast, A.IsNull)):
             return has_call(e.operand)
+        if isinstance(e, A.Between):
+            return any(has_call(x) for x in (e.operand, e.low, e.high))
+        if isinstance(e, A.InList):
+            return has_call(e.operand) or any(has_call(x) for x in e.items)
+        if isinstance(e, A.Case):
+            parts = ([e.operand] if e.operand else []) \
+                + [x for w in e.whens for x in w] \
+                + ([e.else_] if e.else_ else [])
+            return any(has_call(x) for x in parts)
         return False
 
     if has_call(default):
-        from greptimedb_tpu.query.expr import format_expr
-
-        return {"__expr__": format_expr(default)}
+        return {"__expr__": _default_expr_sql(default)}
     return eval_const(default)
+
+
+def _default_expr_sql(e: A.Expr) -> str:
+    """Serialize a DEFAULT expression for round-trip re-parsing.
+    Unlike format_expr (display names), every compound operand is
+    parenthesized so precedence survives the round trip exactly."""
+    if isinstance(e, A.BinaryOp):
+        return (f"({_default_expr_sql(e.left)}) {e.op} "
+                f"({_default_expr_sql(e.right)})")
+    if isinstance(e, A.UnaryOp):
+        return f"{e.op} ({_default_expr_sql(e.operand)})"
+    if isinstance(e, A.Cast):
+        return f"CAST(({_default_expr_sql(e.operand)}) AS {e.to.name})"
+    if isinstance(e, A.FuncCall):
+        args = ", ".join(f"({_default_expr_sql(a)})" for a in e.args)
+        return f"{e.name}({args})"
+    if isinstance(e, A.Case):
+        parts = ["CASE"]
+        if e.operand is not None:
+            parts.append(f"({_default_expr_sql(e.operand)})")
+        for c, t in e.whens:
+            parts.append(f"WHEN ({_default_expr_sql(c)}) "
+                         f"THEN ({_default_expr_sql(t)})")
+        if e.else_ is not None:
+            parts.append(f"ELSE ({_default_expr_sql(e.else_)})")
+        parts.append("END")
+        return " ".join(parts)
+    if isinstance(e, A.IsNull):
+        neg = " NOT" if e.negated else ""
+        return f"({_default_expr_sql(e.operand)}) IS{neg} NULL"
+    if isinstance(e, A.Between):
+        neg = "NOT " if e.negated else ""
+        return (f"({_default_expr_sql(e.operand)}) {neg}BETWEEN "
+                f"({_default_expr_sql(e.low)}) AND "
+                f"({_default_expr_sql(e.high)})")
+    if isinstance(e, A.InList):
+        neg = "NOT " if e.negated else ""
+        items = ", ".join(f"({_default_expr_sql(x)})" for x in e.items)
+        return f"({_default_expr_sql(e.operand)}) {neg}IN ({items})"
+    from greptimedb_tpu.query.expr import format_expr
+
+    return format_expr(e)
 
 
 def default_display(default) -> str:
@@ -1153,12 +1202,22 @@ def default_display(default) -> str:
     return str(default)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=512)
+def _parse_default_expr(text: str) -> A.Expr:
+    # stored default text is immutable; parsing once keeps the hot
+    # single-row insert path off the SQL tokenizer
+    from greptimedb_tpu.sql.parser import Parser
+
+    return Parser(text).expr()
+
+
 def _eval_default(default):
     """Stored default -> concrete value for this insert."""
     if isinstance(default, dict) and "__expr__" in default:
-        from greptimedb_tpu.sql.parser import Parser
-
-        return eval_const(Parser(default["__expr__"]).expr())
+        return eval_const(_parse_default_expr(default["__expr__"]))
     if isinstance(default, A.Expr):
         return eval_const(default)
     return default

@@ -39,6 +39,38 @@ def test_dynamic_default_evaluates_per_insert(tmp_path):
             pass
 
 
+def test_default_expression_roundtrip_precedence(tmp_path):
+    """Grouped arithmetic and CASE in a dynamic DEFAULT survive the
+    persist/parse round trip with precedence intact."""
+    inst = Standalone(str(tmp_path / "d"), prefer_device=False,
+                      warm_start=False)
+    try:
+        inst.execute_sql(
+            "create table t (ts timestamp time index, "
+            "d bigint default (now() - 0) / 1000, "
+            "c bigint default case when 1=1 then now() else 0 end)"
+        )
+        inst.execute_sql("insert into t (ts) values (1000)")
+        d, c = inst.sql("select d, c from t").rows()[0]
+        assert abs(d - time.time()) < 10            # seconds, not ms
+        assert abs(c - time.time() * 1000) < 10_000  # CASE re-evaluated
+        # survives restart through the catalog JSON
+        inst.close()
+        inst2 = Standalone(str(tmp_path / "d"), prefer_device=False,
+                           warm_start=False)
+        try:
+            inst2.execute_sql("insert into t (ts) values (2000)")
+            d2 = inst2.sql("select d from t where ts = 2000").rows()[0][0]
+            assert abs(d2 - time.time()) < 10
+        finally:
+            inst2.close()
+    finally:
+        try:
+            inst.close()
+        except Exception:
+            pass
+
+
 def test_time_index_default_current_timestamp(tmp_path):
     inst = Standalone(str(tmp_path / "d"), prefer_device=False,
                       warm_start=False)

@@ -89,6 +89,48 @@ def test_metric_tables_survive_restart(tmp_path, inst):
         inst2.close()
 
 
+def test_discovery_apis_hide_internals(inst):
+    """__table_id and the shared physical table never surface through
+    the Prometheus discovery APIs or remote read."""
+    import json
+    import urllib.request
+
+    from greptimedb_tpu.servers.http import HttpServer
+
+    _write_metrics(inst, 2)
+    srv = HttpServer(inst, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/v1/prometheus/api/v1"
+
+        def get(p):
+            with urllib.request.urlopen(base + p, timeout=30) as r:
+                return json.load(r)["data"]
+
+        assert get("/labels") == ["__name__", "host"]
+        names = get("/label/__name__/values")
+        assert PHYSICAL_TABLE not in names
+        assert set(names) == {"metric_0", "metric_1"}
+        # match[]-scoped label values are isolated per metric
+        vals = get("/label/host/values?match[]=metric_1")
+        assert vals == ["h1"]
+        assert PHYSICAL_TABLE not in get("/metadata")
+    finally:
+        srv.stop()
+
+
+def test_alter_collision_leaves_schema_unchanged(inst):
+    _write_metrics(inst, 2)
+    inst.execute_sql("alter table metric_0 add column foo double")
+    with pytest.raises(Exception):
+        inst.execute_sql(
+            "alter table metric_1 add column foo string primary key"
+        )
+    t = inst.catalog.table("public", "metric_1")
+    assert t.schema.maybe_column("foo") is None
+    # ingest for every metric still works
+    assert _write_metrics(inst, 2, t0=60_000) == 4
+
+
 def test_promql_over_metric_engine(inst):
     _write_metrics(inst, 3, t0=1_700_000_000_000)
     from greptimedb_tpu.promql.engine import PromEngine
