@@ -531,7 +531,7 @@ class Standalone:
                 keys.append("PRI")
             else:
                 keys.append("")
-            defaults.append("")
+            defaults.append(default_display(cs.default))
             semantics.append(cs.semantic_type.name)
         cols = [names, types, nulls, keys, defaults]
         headers = ["Column", "Type", "Null", "Key", "Default"]
@@ -951,6 +951,9 @@ class Standalone:
             d = f"  `{c.name}` {_sql_type_name(c.data_type)}"
             if not c.nullable:
                 d += " NOT NULL"
+            dflt = default_sql(c.default)
+            if dflt is not None:
+                d += f" DEFAULT {dflt}"
             defs.append(d)
         ts = table.schema.time_index.name
         defs.append(f"  TIME INDEX (`{ts}`)")
@@ -1058,6 +1061,18 @@ def _scan_sql_segments(text: str):
     i, n = 0, len(text)
     while i < n:
         c = text[i]
+        if c == "-" and text[i:i + 2] == "--":
+            j = text.find("\n", i)
+            j = n if j < 0 else j
+            yield "text", text[i:j]
+            i = j
+            continue
+        if c == "/" and text[i:i + 2] == "/*":
+            j = text.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            yield "text", text[i:j]
+            i = j
+            continue
         if c in ("'", '"', "`"):
             close = c
             j = i + 1
@@ -1200,6 +1215,18 @@ def default_display(default) -> str:
     if isinstance(default, dict) and "__expr__" in default:
         return default["__expr__"]
     return str(default)
+
+
+def default_sql(default) -> str | None:
+    """DDL form of a stored default, exact enough that SHOW CREATE TABLE
+    output re-parses to the same constraint (export->import must not
+    drop defaults). String literals re-quote; dynamic defaults emit
+    their expression text verbatim; None means no DEFAULT clause."""
+    if default is None:
+        return None
+    if isinstance(default, dict) and "__expr__" in default:
+        return default["__expr__"]
+    return format_sql_literal(default)
 
 
 import functools

@@ -234,6 +234,11 @@ class Region:
         """Re-apply WAL entries after the flushed id (open/catchup,
         /root/reference/src/mito2/src/worker/handle_catchup.rs analog)."""
         from_id = self.manifest.state.flushed_entry_id + 1
+        seed = getattr(self.wal, "seed_floor", None)
+        if seed is not None:
+            # shared-topic logs: never hand out ids below the flushed
+            # watermark even if truncation erased every physical entry
+            seed(self.manifest.state.flushed_entry_id)
         for entry in self.wal.replay(from_id):
             cols, meta = codec.decode_columns(entry.payload)
             ts = cols.pop("__ts")

@@ -472,6 +472,20 @@ class SharedWalTopic:
         with self._lock:
             return self._last_eid.get(region_id, -1) + 1
 
+    def seed_last_eid(self, region_id: int, floor_eid: int) -> None:
+        """Raise a region's last-entry-id floor to its manifest flushed
+        watermark. Needed at open: truncation may have dropped ALL of a
+        region's physical entries (they were flushed, and other regions'
+        progress allowed the prefix drop), in which case the open-time
+        scan recovers nothing for it and a naive restart would hand out
+        entry ids from 0 again — below flushed_entry_id, so replay
+        (flushed+1) after the next crash silently skips them. Truncation
+        only drops reids <= the region's obsolete mark (== its flushed
+        id), so the manifest watermark is exactly the erased maximum."""
+        with self._lock:
+            if floor_eid > self._last_eid.get(region_id, -1):
+                self._last_eid[region_id] = floor_eid
+
     def drop_region(self, region_id: int) -> None:
         """Forget a dropped region so its dead entries stop pinning
         truncation (the per-region offset removal of kafka obsolete)."""
@@ -522,6 +536,9 @@ class TopicRegionLog(LogStore):
 
     def drop(self) -> None:
         self.topic.drop_region(self.region_id)
+
+    def seed_floor(self, floor_eid: int) -> None:
+        self.topic.seed_last_eid(self.region_id, floor_eid)
 
     def close(self) -> None:
         pass

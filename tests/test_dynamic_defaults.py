@@ -102,3 +102,68 @@ def test_insert_select_fills_defaults(tmp_path):
         assert inst.sql("select level from dst").rows() == [["info"]]
     finally:
         inst.close()
+
+
+def test_show_create_table_includes_defaults(tmp_path):
+    """ADVICE r3 (medium): SHOW CREATE TABLE must carry DEFAULT clauses
+    (literal + dynamic), or cli export -> import silently drops them."""
+    inst = Standalone(str(tmp_path / "d"), prefer_device=False,
+                      warm_start=False)
+    try:
+        inst.execute_sql(
+            "create table t (ts timestamp time index default "
+            "current_timestamp(), level string default 'info', "
+            "n bigint default 7, note string)"
+        )
+        ddl = inst.sql("show create table t").rows()[0][1]
+        assert "DEFAULT current_timestamp()" in ddl
+        assert "DEFAULT 'info'" in ddl
+        assert "DEFAULT 7" in ddl
+        assert "`note` STRING DEFAULT" not in ddl
+        # SHOW COLUMNS agrees with DESCRIBE on the Default column
+        r = inst.sql("show columns from t")
+        by_name = dict(zip(r.cols[0].values, r.cols[4].values))
+        assert by_name["level"] == "info"
+        assert by_name["n"] == "7"
+    finally:
+        inst.close()
+
+
+def test_export_import_preserves_defaults(tmp_path):
+    from greptimedb_tpu.tools import export_data, import_data
+
+    src = str(tmp_path / "src")
+    inst = Standalone(src, prefer_device=False, warm_start=False)
+    inst.execute_sql(
+        "create table logs (ts timestamp time index, "
+        "level string default 'info', n bigint)"
+    )
+    inst.execute_sql("insert into logs values (1000, 'warn', 1)")
+    inst.close()
+    export_data(src, str(tmp_path / "dump"))
+    import_data(str(tmp_path / "dst"), str(tmp_path / "dump"))
+
+    inst2 = Standalone(str(tmp_path / "dst"), prefer_device=False,
+                       warm_start=False)
+    try:
+        inst2.execute_sql("insert into logs (ts, n) values (2000, 2)")
+        r = inst2.sql("select level from logs order by ts").rows()
+        assert [x[0] for x in r] == ["warn", "info"]
+    finally:
+        inst2.close()
+
+
+def test_placeholders_inside_comments_not_counted():
+    """ADVICE r3 (low): '?' inside -- or /* */ comments must not count
+    as a COM_STMT_PREPARE parameter."""
+    from greptimedb_tpu.instance import (
+        count_placeholders,
+        substitute_placeholders,
+    )
+
+    sql = ("select * from t -- what? really?\n"
+           "where a = ? /* and b = ? */ and c = ?")
+    assert count_placeholders(sql) == 2
+    out = substitute_placeholders(sql, [1, 2])
+    assert "a = 1" in out and "c = 2" in out
+    assert "what? really?" in out and "/* and b = ? */" in out

@@ -147,3 +147,15 @@ def test_https_serving(inst, tmp_path):
         assert json.loads(body) == {}
     finally:
         srv.stop()
+
+
+def test_scrape_registry_brace_in_label_value():
+    """ADVICE r3 (low): a '}' inside a quoted label value must not
+    truncate the label block."""
+    from greptimedb_tpu.telemetry.export import _LABEL, _LINE
+
+    line = 'greptime_http{path="a}b",method="GET"} 3'
+    m = _LINE.match(line)
+    assert m is not None and m.group("value") == "3"
+    labels = dict(_LABEL.findall(m.group("labels")))
+    assert labels == {"path": "a}b", "method": "GET"}

@@ -13,6 +13,7 @@ from greptimedb_tpu.storage.wal import (
     RegionWal,
     SharedWalTopic,
     TopicRegionLog,
+    _unframe_topic_entry,
 )
 
 
@@ -173,3 +174,67 @@ def test_engine_shared_wal_replay_after_restart(tmp_path, shared_inst):
             assert list(r.cols[0].values) == [1.0, 2.0]
     finally:
         inst2.close()
+
+
+def test_truncated_region_ids_never_regress_below_flushed(tmp_path):
+    """ADVICE r3 (high): once truncation has erased ALL of a region's
+    physical entries, a restart must not hand out entry ids below the
+    region's manifest flushed watermark — otherwise the appends land at
+    reid 0..k < flushed and replay(flushed+1) after the NEXT crash skips
+    them: silent data loss."""
+    import os
+
+    from greptimedb_tpu.storage.engine import TsdbEngine
+    from greptimedb_tpu.storage.region import RegionMetadata, RegionOptions
+
+    def meta(rid, tbl):
+        return RegionMetadata(
+            region_id=rid, table=tbl, tag_names=["h"], field_names=["v"],
+            ts_name="ts", options=RegionOptions(),
+        )
+
+    cfg = EngineConfig(data_root=str(tmp_path / "d"),
+                       enable_background=False,
+                       wal_backend="shared", wal_topics=1)
+    eng = TsdbEngine(cfg)
+    # tiny segments so obsolete() can drop the prefix holding region A
+    wal_root = os.path.join(cfg.data_root, "wal")
+    os.makedirs(wal_root, exist_ok=True)
+    eng._topics[0] = SharedWalTopic(
+        RegionWal(os.path.join(wal_root, "topic_0"), segment_bytes=64)
+    )
+    ra = eng.create_region(meta(1, "a"))
+    rb = eng.create_region(meta(2, "b"))
+    for i in range(5):
+        ra.write({"h": np.asarray(["x"], object)},
+                 np.asarray([1000 + i], np.int64),
+                 {"v": np.asarray([float(i)])})
+    ra.flush()
+    for i in range(5):
+        rb.write({"h": np.asarray(["y"], object)},
+                 np.asarray([1000 + i], np.int64),
+                 {"v": np.asarray([float(i)])})
+    rb.flush()
+    # every physical entry of region A is gone from the shared log
+    assert all(
+        _unframe_topic_entry(e.payload)[0] != 1
+        for e in eng._topics[0].inner.replay(0)
+    )
+    flushed_a = ra.manifest.state.flushed_entry_id
+    assert flushed_a == 4
+    del eng, ra, rb  # crash: no close, no flush
+
+    eng2 = TsdbEngine(cfg)
+    ra2 = eng2.open_region(meta(1, "a"))
+    ra2.write({"h": np.asarray(["x"], object)},
+              np.asarray([9000], np.int64), {"v": np.asarray([99.0])})
+    # the new entry's id must sit ABOVE the flushed watermark
+    assert ra2.wal.next_entry_id - 1 > flushed_a
+    del eng2, ra2  # crash again before any flush
+
+    eng3 = TsdbEngine(cfg)
+    ra3 = eng3.open_region(meta(1, "a"))
+    res = ra3.scan(field_names=["v"])
+    got = sorted(res.rows.fields["v"])
+    assert got == [0.0, 1.0, 2.0, 3.0, 4.0, 99.0]
+    eng3.close()
