@@ -125,9 +125,10 @@ class Table:
         tag_cols = [np.asarray(tag_columns.get(t, np.full(n, "", object)),
                                object) for t in tag_names]
         if len(self.regions) == 1:
-            self.regions[0].write(
-                dict(zip(tag_names, tag_cols)), ts, fields,
-                field_valid=field_valid or None, op=op, skip_wal=skip_wal,
+            self._dispatch_writes(
+                [(0, dict(zip(tag_names, tag_cols)), ts, fields,
+                  field_valid or None)],
+                op=op, skip_wal=skip_wal,
             )
             return n
         if self.partition_rule is not None:
@@ -137,20 +138,30 @@ class Table:
             dest = np.clip(dest, 0, len(self.regions) - 1)
         else:
             dest = _route_rows(tag_cols, n, len(self.regions))
+        puts = []
         for r_idx in np.unique(dest):
             sel = dest == r_idx
-            self.regions[int(r_idx)].write(
+            puts.append((
+                int(r_idx),
                 {t: c[sel] for t, c in zip(tag_names, tag_cols)},
                 ts[sel],
                 {k: v[sel] for k, v in fields.items()},
-                field_valid=(
+                (
                     {k: v[sel] for k, v in field_valid.items()}
                     if field_valid else None
                 ),
-                op=op,
+            ))
+        self._dispatch_writes(puts, op=op, skip_wal=skip_wal)
+        return n
+
+    def _dispatch_writes(self, puts, *, op: int, skip_wal: bool):
+        """Apply routed row splits; remote tables override to batch all
+        of one datanode's regions into a single RPC."""
+        for r_idx, tag_columns, ts, fields, field_valid in puts:
+            self.regions[r_idx].write(
+                tag_columns, ts, fields, field_valid=field_valid, op=op,
                 skip_wal=skip_wal,
             )
-        return n
 
     def delete(self, tag_columns: dict[str, np.ndarray], ts: np.ndarray) -> int:
         from greptimedb_tpu.storage.memtable import OP_DELETE

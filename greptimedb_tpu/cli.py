@@ -218,19 +218,37 @@ def _export_metrics(inst, opts, closers):
     closers.append(task.stop)
 
 
-def _flight_server(inst, opts, closers) -> None:
+def _flight_server(inst, opts, closers):
     if not (opts.get("grpc.enable", True) and opts.get("grpc.addr")):
-        return
+        return None
     try:
         from greptimedb_tpu.servers.flight import FlightFrontend
     except ImportError:
         print("# pyarrow.flight unavailable; flight disabled", flush=True)
-        return
+        return None
     fh, fp = _split(opts.get("grpc.addr"))
     srv = FlightFrontend(inst, addr=fh, port=fp).start()
     closers.append(srv.close)
     print(f"greptimedb-tpu arrow flight on {fh}:{srv.server.port}",
           flush=True)
+    return srv
+
+
+def _advertise_addr(opts, srv) -> str | None:
+    """The address peers should dial: grpc.advertise_addr if set, else
+    the bind address with the RESOLVED port (port 0 binds ephemerally)
+    and a routable host when bound to a wildcard."""
+    adv = opts.get("grpc.advertise_addr")
+    if adv:
+        return adv
+    if srv is None:
+        return opts.get("grpc.addr") or None
+    host = srv.addr
+    if host in ("", "0.0.0.0", "::"):
+        import socket as _socket
+
+        host = _socket.gethostbyname(_socket.gethostname())
+    return f"{host}:{srv.server.port}"
 
 
 def _make_instance(opts):
@@ -293,7 +311,12 @@ def _start_standalone(opts):
 def _start_datanode(opts):
     inst = _make_instance(opts)
     closers = [inst.close]
-    _flight_server(inst, opts, closers)
+    # region-server surface: per-region open/write/scan/partial-SQL for
+    # the distributed topology (dist/region_server.py)
+    from greptimedb_tpu.dist.region_server import RegionServer
+
+    inst.region_server = RegionServer(inst.engine, opts.get("data_home"))
+    flight_srv = _flight_server(inst, opts, closers)
     _http_server(inst, opts, closers)
     _export_metrics(inst, opts, closers)
     _telemetry(opts, closers, mode="datanode")
@@ -301,7 +324,8 @@ def _start_datanode(opts):
     if meta_addr:
         node_id = int(opts.get("datanode.node_id", 0))
         closers.append(
-            _heartbeat_loop(meta_addr, node_id, inst)
+            _heartbeat_loop(meta_addr, node_id, inst,
+                            flight_addr=_advertise_addr(opts, flight_srv))
         )
     print(
         f"greptimedb-tpu datanode (node {opts.get('datanode.node_id')}) "
@@ -310,7 +334,8 @@ def _start_datanode(opts):
     return _serve_until_signal(closers)
 
 
-def _heartbeat_loop(meta_addr: str, node_id: int, inst):
+def _heartbeat_loop(meta_addr: str, node_id: int, inst,
+                    flight_addr: str | None = None):
     """Register + heartbeat against the metasrv HTTP service."""
     import json
     import threading
@@ -332,7 +357,8 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst):
         while True:   # register immediately, THEN pace by the interval
             try:
                 if not registered:
-                    post("/register", {"node_id": node_id})
+                    post("/register", {"node_id": node_id,
+                                       "addr": flight_addr})
                     registered = True
                 stats = {}
                 try:
@@ -364,18 +390,29 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst):
 
 
 def _start_frontend(opts):
-    from greptimedb_tpu.servers.remote import RemoteInstance
+    meta_addr = opts.get("metasrv.addr") or ""
+    if meta_addr:
+        # distributed frontend: catalog in the metasrv kv, regions on
+        # datanode processes, full SQL engine here (dist/frontend.py)
+        from greptimedb_tpu.dist.frontend import DistInstance
 
-    addrs = opts.get("frontend.datanode_addrs") or []
-    if isinstance(addrs, str):
-        addrs = [a for a in addrs.split(",") if a]
-    inst = RemoteInstance(addrs)
+        inst = DistInstance(opts.get("data_home"), meta_addr)
+        target = f"metasrv {meta_addr}"
+    else:
+        # legacy single-datanode proxy: forward statements over Flight
+        from greptimedb_tpu.servers.remote import RemoteInstance
+
+        addrs = opts.get("frontend.datanode_addrs") or []
+        if isinstance(addrs, str):
+            addrs = [a for a in addrs.split(",") if a]
+        inst = RemoteInstance(addrs)
+        target = f"datanodes {addrs}"
     closers = [inst.close]
     _wire_protocols(inst, opts, closers)
     server = _http_server(inst, opts, closers)
     _telemetry(opts, closers, mode="frontend")
     print(
-        f"greptimedb-tpu frontend -> datanodes {addrs} on "
+        f"greptimedb-tpu frontend -> {target} on "
         f"http://{server.addr}:{server.port}", flush=True,
     )
     return _serve_until_signal(closers)

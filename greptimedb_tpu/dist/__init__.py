@@ -1,0 +1,21 @@
+"""Cross-process distribution: the cluster on the wire.
+
+The in-process cluster layer (cluster.py) proved the routing + merge
+semantics; this package puts them across process boundaries the way the
+reference's distributed mode does (/root/reference/src/query/src/
+dist_plan/merge_scan.rs MergeScanExec, src/datanode/src/region_server.rs
+RegionServer, src/meta-srv routing):
+
+- region_server.py — the datanode side: per-region open/write/scan/
+  partial-SQL service surface (exposed over Arrow Flight).
+- client.py       — frontend-side Flight/HTTP clients (datanode, metasrv).
+- remote.py       — RemoteRegion/RemoteTable proxies: a Table whose
+  regions live in other processes, pluggable into the unchanged query
+  engine.
+- catalog.py      — DistCatalogManager: table metadata in the metasrv
+  kv, regions allocated across datanodes.
+- frontend.py     — DistInstance: the full SQL surface (instance.py)
+  over a distributed catalog.
+- merge.py        — partial-aggregate decomposition + merge (the
+  MergeScan split: commutative part on datanodes, remainder local).
+"""

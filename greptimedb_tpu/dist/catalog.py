@@ -1,0 +1,229 @@
+"""Distributed catalog: table metadata in the metasrv kv, regions
+allocated across datanode processes.
+
+Counterpart of the reference's kv-backed catalog + DDL procedures
+(/root/reference/src/catalog/src/kvbackend/manager.rs,
+src/common/meta/src/ddl/create_table.rs): CREATE TABLE allocates region
+routes through the metasrv selector, opens each region on its owning
+datanode over Flight, and persists the table info in the shared kv so
+any frontend can assemble the table.
+"""
+
+from __future__ import annotations
+
+import json
+
+from greptimedb_tpu.catalog.manager import (
+    DEFAULT_SCHEMA,
+    CatalogManager,
+    TableInfo,
+    _BrokenTable,
+)
+from greptimedb_tpu.datatypes.schema import SemanticType
+from greptimedb_tpu.dist.client import DatanodeClient, MetaClient
+from greptimedb_tpu.dist.remote import (
+    RemoteTable,
+    region_meta_doc,
+    remote_regions_for,
+)
+from greptimedb_tpu.errors import (
+    InvalidArgumentError,
+    TableNotFoundError,
+    UnsupportedError,
+)
+
+CATALOG_KEY = "__catalog"
+
+
+class DistCatalogManager(CatalogManager):
+    """Catalog whose tables live across datanode processes."""
+
+    def __init__(self, engine, meta: MetaClient):
+        self.meta = meta
+        self._clients: dict[int, DatanodeClient] = {}
+        # base __init__ runs _load(), which needs self.meta/_clients
+        super().__init__(engine)
+
+    # ------------------------------------------------------------------
+    def _client_for(self, node_id: int) -> DatanodeClient:
+        cli = self._clients.get(node_id)
+        if cli is None:
+            addr = self.meta.peers().get(node_id)
+            if addr is None:
+                raise InvalidArgumentError(
+                    f"datanode {node_id} has no registered address"
+                )
+            cli = DatanodeClient(addr)
+            self._clients[node_id] = cli
+        return cli
+
+    # ------------------------------------------------------------------
+    # persistence: the shared kv instead of the local object store
+    # ------------------------------------------------------------------
+    def _load(self):
+        raw = self.meta.kv_get(CATALOG_KEY)
+        if raw is None:
+            return
+        doc = json.loads(raw)
+        self._next_table_id = doc.get("next_table_id", 1024)
+        self._views = {
+            db: dict(views) for db, views in doc.get("views", {}).items()
+        }
+        for db_name, tables in doc.get("databases", {}).items():
+            db = self._databases.setdefault(db_name, {})
+            infos = [TableInfo.from_json(t) for t in tables]
+            for info in infos:
+                # ids advance BEFORE any open: a mid-load create must
+                # never reuse a persisted table's id
+                self._next_table_id = max(
+                    self._next_table_id, info.table_id + 1
+                )
+            # physical (mito) first so logical metric tables resolve
+            # their shared physical table without creating a duplicate
+            for info in sorted(infos, key=lambda i: i.engine == "metric"):
+                try:
+                    db[info.name] = self._open_table(info)
+                except Exception as e:  # noqa: BLE001 - startup isolation
+                    db[info.name] = _BrokenTable(info, e)
+
+    def _persist(self):
+        doc = {
+            "next_table_id": self._next_table_id,
+            "databases": {
+                db: [t.info.to_json() for t in tables.values()]
+                for db, tables in self._databases.items()
+            },
+            "views": {db: dict(v) for db, v in self._views.items() if v},
+        }
+        self.meta.kv_put(CATALOG_KEY, json.dumps(doc))
+
+    # ------------------------------------------------------------------
+    # table assembly: allocate + open regions across datanodes
+    # ------------------------------------------------------------------
+    def _open_table(self, info: TableInfo) -> RemoteTable:
+        if info.engine not in ("mito", "metric"):
+            raise UnsupportedError(
+                f"engine {info.engine!r} is not supported on a "
+                "distributed frontend yet"
+            )
+        if info.engine == "metric":
+            return self._open_metric_table(info)
+        routes = self.meta.routes()
+        rids = info.region_ids()
+        missing = [r for r in rids if r not in routes]
+        if missing:
+            routes.update(self.meta.allocate_regions(missing))
+            for rid in missing:
+                nid = routes.get(rid)
+                if nid is None:
+                    raise InvalidArgumentError(
+                        "metasrv could not place regions "
+                        "(no registered datanodes?)"
+                    )
+                self._client_for(nid).open_region(
+                    region_meta_doc(info, rid)
+                )
+        clients = {
+            nid: self._client_for(nid)
+            for nid in {routes[r] for r in rids if r in routes}
+        }
+        return RemoteTable(info, remote_regions_for(info, routes, clients))
+
+    # ------------------------------------------------------------------
+    def drop_table(self, database: str, name: str, *,
+                   if_exists: bool = False):
+        with self._lock:
+            db = self._db(database)
+            table = db.pop(name, None)
+            if table is None:
+                if if_exists:
+                    return
+                raise TableNotFoundError(f"table not found: {name}")
+            if table.info.engine == "metric":
+                # logical drop only: the physical regions are SHARED
+                # with every other metric table on this database
+                self._persist()
+                return
+            rids = table.info.region_ids()
+            for r in getattr(table, "regions", []):
+                try:
+                    r.client.drop_region(r.meta.region_id)
+                except Exception:  # noqa: BLE001 - best effort teardown
+                    pass
+            try:
+                self.meta.remove_routes(rids)
+            except Exception:  # noqa: BLE001
+                pass
+            self._persist()
+
+    # ------------------------------------------------------------------
+    # alter: fan the region-level change to owning datanodes
+    # ------------------------------------------------------------------
+    def alter_add_column(self, database: str, name: str, col, *,
+                         if_not_exists: bool = False):
+        with self._lock:
+            table = self.table(database, name)
+            if col.semantic_type == SemanticType.TIMESTAMP:
+                raise InvalidArgumentError("cannot add a TIME INDEX column")
+            existing = table.info.schema.maybe_column(col.name)
+            if existing is not None:
+                if existing.semantic_type != col.semantic_type:
+                    raise InvalidArgumentError(
+                        f"column {col.name!r} already exists as a "
+                        f"{existing.semantic_type.name} column"
+                    )
+                if if_not_exists or existing.data_type == col.data_type:
+                    return
+                raise InvalidArgumentError(
+                    f"column {col.name!r} already exists as "
+                    f"{existing.data_type.name}"
+                )
+            if table.info.engine == "metric":
+                # the column must land on the SHARED physical table;
+                # widening recurses into this method for the physical
+                # (mito) table, which fans alter_region out per datanode
+                from greptimedb_tpu import metric_engine as ME
+
+                physical = ME.ensure_physical_table(self, database)
+                candidate = table.info.schema.with_column(col)
+                ME.widen_physical_for(self, database, physical, candidate)
+                table.info.schema = candidate
+                self._persist()
+                return
+            table.info.schema = table.info.schema.with_column(col)
+            op = ("add_tag" if col.semantic_type == SemanticType.TAG
+                  else "add_field")
+            for r in table.regions:
+                r.client.alter_region(r.meta.region_id, op, col.name)
+                if op == "add_tag":
+                    r.meta.tag_names.append(col.name)
+                else:
+                    r.meta.field_names.append(col.name)
+            self._persist()
+
+    def alter_drop_column(self, database: str, name: str, col_name: str):
+        with self._lock:
+            table = self.table(database, name)
+            col = table.info.schema.column(col_name)
+            if not col.is_field:
+                raise InvalidArgumentError(
+                    "only FIELD columns can be dropped"
+                )
+            table.info.schema = table.info.schema.without_column(col_name)
+            if table.info.engine == "metric":
+                # logical drop only: the physical column is shared with
+                # every other metric table
+                self._persist()
+                return
+            for r in table.regions:
+                r.client.alter_region(
+                    r.meta.region_id, "drop_field", col_name
+                )
+                if col_name in r.meta.field_names:
+                    r.meta.field_names.remove(col_name)
+            self._persist()
+
+    # ------------------------------------------------------------------
+    def close(self):
+        for cli in self._clients.values():
+            cli.close()

@@ -1,0 +1,244 @@
+"""Frontend-side clients: datanode (Arrow Flight) + metasrv (HTTP).
+
+Counterpart of the reference's client crate
+(/root/reference/src/client/src/region.rs RegionRequester,
+src/meta-client/src/client.rs): thin, lazily-connected wrappers that the
+remote-table layer and the dist catalog talk through.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+from greptimedb_tpu.errors import GreptimeError
+
+
+def _strip_flight_error(e) -> str:
+    msg = str(e).split("gRPC client debug context")[0]
+    return msg.split(". Detail: Failed")[0].strip().rstrip(". ")
+
+
+class DatanodeClient:
+    """Region requests to one datanode process over Flight."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self._lock = threading.Lock()
+        self._conn = None
+
+    def _client(self):
+        with self._lock:
+            if self._conn is None:
+                import pyarrow.flight as flight
+
+                self._conn = flight.connect(f"grpc://{self.addr}")
+            return self._conn
+
+    def close(self):
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = None
+
+    # ---- actions ------------------------------------------------------
+    def action(self, kind: str, body: dict | None = None) -> dict:
+        import pyarrow.flight as flight
+
+        try:
+            results = list(self._client().do_action(
+                flight.Action(kind, json.dumps(body or {}).encode())
+            ))
+        except flight.FlightError as e:
+            raise GreptimeError(_strip_flight_error(e)) from None
+        if not results:
+            return {}
+        return json.loads(results[0].body.to_pybytes() or b"{}")
+
+    def open_region(self, meta_doc: dict):
+        self.action("open_region", {"meta": meta_doc})
+
+    def drop_region(self, region_id: int):
+        self.action("drop_region", {"region_id": region_id})
+
+    def flush_region(self, region_id: int) -> bool:
+        return bool(
+            self.action("flush_region", {"region_id": region_id})
+            .get("flushed")
+        )
+
+    def truncate_region(self, region_id: int):
+        self.action("truncate_region", {"region_id": region_id})
+
+    def alter_region(self, region_id: int, op: str, name: str):
+        self.action("alter_region",
+                    {"region_id": region_id, "op": op, "name": name})
+
+    def region_stats(self, region_ids: list[int]) -> dict:
+        return self.action("region_stats", {"region_ids": region_ids}).get(
+            "stats", {}
+        )
+
+    def data_versions(self, region_ids: list[int]) -> dict:
+        return self.action(
+            "data_versions", {"region_ids": region_ids}
+        ).get("versions", {})
+
+    # ---- data plane ---------------------------------------------------
+    def region_scan(self, region_ids: list[int], *, ts_min=None,
+                    ts_max=None, fields=None, matchers=None,
+                    fulltext=None):
+        """One RPC: merged scan of this datanode's listed regions.
+        Returns (ColumnarRows|None, tag_values, stats)."""
+        import pyarrow.flight as flight
+
+        from greptimedb_tpu.dist.codec import arrow_to_scan
+
+        ticket = {
+            "rpc": "region_scan", "region_ids": list(region_ids),
+            "ts_min": ts_min, "ts_max": ts_max, "fields": fields,
+            "matchers": (
+                [[m[0], m[1], m[2]] for m in matchers] if matchers else None
+            ),
+            "fulltext": (
+                [list(f) for f in fulltext] if fulltext else None
+            ),
+        }
+        try:
+            reader = self._client().do_get(
+                flight.Ticket(json.dumps(ticket).encode())
+            )
+            table = reader.read_all()
+        except flight.FlightError as e:
+            raise GreptimeError(_strip_flight_error(e)) from None
+        meta = table.schema.metadata or {}
+        stats = json.loads(meta.get(b"gtdb:stats", b"{}"))
+        names = (fields if fields is not None else [
+            f.name for f in table.schema
+            if f.name not in ("__sid", "__ts", "__seq", "__op")
+        ])
+        rows, tag_values = arrow_to_scan(table, names)
+        return rows, tag_values, stats
+
+    def partial_sql(self, doc: dict):
+        """Ship a partial plan (SQL fragment over named regions); returns
+        the raw Arrow table + metrics metadata."""
+        import pyarrow.flight as flight
+
+        try:
+            reader = self._client().do_get(flight.Ticket(
+                json.dumps({"rpc": "partial_sql", **doc}).encode()
+            ))
+            return reader.read_all()
+        except flight.FlightError as e:
+            raise GreptimeError(_strip_flight_error(e)) from None
+
+    def write_regions(self, puts: list[dict]):
+        """puts: [{region_id, op, skip_wal, tag_columns, ts, fields,
+        field_valid}] — one DoPut stream carrying every batch bound for
+        this datanode."""
+        import pyarrow.flight as flight
+
+        from greptimedb_tpu.dist.codec import write_to_batch
+
+        if not puts:
+            return
+        batches = []
+        for p in puts:
+            batch = write_to_batch(p["tag_columns"], p["ts"], p["fields"],
+                                   p.get("field_valid"))
+            meta = json.dumps({
+                "region_id": p["region_id"], "op": p.get("op", 0),
+                "skip_wal": p.get("skip_wal", False),
+            }).encode()
+            batches.append((batch, meta))
+        descriptor = flight.FlightDescriptor.for_path("region_write")
+        try:
+            writer, reader = self._client().do_put(
+                descriptor, batches[0][0].schema
+            )
+            schema = batches[0][0].schema
+            for batch, meta in batches:
+                if batch.schema != schema:
+                    # schema changes mid-stream need a fresh stream
+                    writer.close()
+                    writer, reader = self._client().do_put(
+                        descriptor, batch.schema
+                    )
+                    schema = batch.schema
+                writer.write_with_metadata(batch, meta)
+            writer.close()
+        except flight.FlightError as e:
+            raise GreptimeError(_strip_flight_error(e)) from None
+
+
+class MetaClient:
+    """Metasrv control plane over HTTP (kv, routes, allocation)."""
+
+    def __init__(self, addr: str, *, timeout: float = 5.0):
+        self.addr = addr
+        self.timeout = timeout
+
+    def _post(self, path: str, doc: dict) -> dict:
+        req = urllib.request.Request(
+            f"http://{self.addr}{path}", data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            out = json.loads(resp.read() or b"{}")
+        if isinstance(out, dict) and out.get("error"):
+            raise GreptimeError(f"metasrv: {out['error']}")
+        return out
+
+    def _get(self, path: str) -> dict:
+        with urllib.request.urlopen(
+            f"http://{self.addr}{path}", timeout=self.timeout
+        ) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    # ---- kv -----------------------------------------------------------
+    def kv_get(self, key: str) -> str | None:
+        return self._post("/kv", {"op": "get", "key": key}).get("value")
+
+    def kv_put(self, key: str, value: str):
+        self._post("/kv", {"op": "put", "key": key, "value": value})
+
+    def kv_delete(self, key: str):
+        self._post("/kv", {"op": "delete", "key": key})
+
+    def kv_range(self, prefix: str) -> list[tuple[str, str]]:
+        return [
+            (k, v) for k, v in
+            self._post("/kv", {"op": "range", "key": prefix}).get("kvs", [])
+        ]
+
+    def kv_cas(self, key: str, expect: str | None, value: str) -> bool:
+        return bool(self._post("/kv", {
+            "op": "cas", "key": key, "expect": expect, "value": value,
+        }).get("success"))
+
+    # ---- routing ------------------------------------------------------
+    def routes(self) -> dict[int, int]:
+        return {
+            int(k): int(v) for k, v in self._get("/routes").items()
+            if v is not None
+        }
+
+    def peers(self) -> dict[int, str]:
+        return {
+            int(k): v for k, v in self._get("/peers").items() if v
+        }
+
+    def allocate_regions(self, region_ids: list[int]) -> dict[int, int]:
+        out = self._post("/allocate", {"region_ids": region_ids})
+        return {int(k): int(v) for k, v in out.get("routes", {}).items()}
+
+    def remove_routes(self, region_ids: list[int]):
+        self._post("/remove_routes", {"region_ids": region_ids})
+
+    def register(self, node_id: int, addr: str | None = None):
+        self._post("/register", {"node_id": node_id, "addr": addr})

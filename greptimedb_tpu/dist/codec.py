@@ -1,0 +1,208 @@
+"""Wire codecs for the distributed data plane (Arrow-framed).
+
+Scan results travel as one Arrow table per datanode: row columns
+(__sid/__ts/__seq/__op + fields, validity as Arrow nulls) with the
+compacted per-sid tag registry in schema metadata — the columnar
+stream + dictionary split of the reference's region data plane
+(/root/reference/src/common/grpc/src/flight.rs FlightEncoder).
+Region writes travel as Arrow record batches whose app_metadata names
+the target region (src/store-api/src/region_request.rs RegionPutRequest
+analog).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pyarrow as pa
+
+from greptimedb_tpu.storage.memtable import ColumnarRows
+from greptimedb_tpu.storage.region import RegionMetadata, RegionOptions
+
+# ---------------------------------------------------------------------------
+# region metadata
+# ---------------------------------------------------------------------------
+
+
+def region_meta_to_json(meta: RegionMetadata) -> dict:
+    o = meta.options
+    return {
+        "region_id": meta.region_id,
+        "table": meta.table,
+        "tag_names": list(meta.tag_names),
+        "field_names": list(meta.field_names),
+        "ts_name": meta.ts_name,
+        "fulltext_fields": list(meta.fulltext_fields),
+        "options": {
+            "memtable_window_ms": o.memtable_window_ms,
+            "flush_rows": o.flush_rows,
+            "flush_bytes": o.flush_bytes,
+            "wal_sync": o.wal_sync,
+            "compaction_window_ms": o.compaction_window_ms,
+            "compaction_trigger_files": o.compaction_trigger_files,
+            "merge_mode": o.merge_mode,
+            "append_mode": o.append_mode,
+            "ttl_ms": o.ttl_ms,
+        },
+    }
+
+
+def region_meta_from_json(doc: dict) -> RegionMetadata:
+    o = doc.get("options") or {}
+    return RegionMetadata(
+        region_id=int(doc["region_id"]),
+        table=doc["table"],
+        tag_names=list(doc["tag_names"]),
+        field_names=list(doc["field_names"]),
+        ts_name=doc["ts_name"],
+        fulltext_fields=list(doc.get("fulltext_fields") or []),
+        options=RegionOptions(**o) if o else RegionOptions(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# scan results
+# ---------------------------------------------------------------------------
+
+
+def _field_array(vals: np.ndarray, valid: np.ndarray | None) -> pa.Array:
+    mask = None if valid is None or valid.all() else ~valid
+    if vals.dtype == object:
+        return pa.array(vals, pa.string(), mask=mask)
+    return pa.array(vals, mask=mask)
+
+
+def scan_to_arrow(rows: ColumnarRows | None, tag_values: dict[str, list],
+                  field_names: list[str], extra_meta: dict | None = None
+                  ) -> pa.Table:
+    """rows (sids already compacted to 0..k-1) + per-sid tag values ->
+    one Arrow table. Empty scans still carry the schema."""
+    n = 0 if rows is None else len(rows)
+    arrays = [
+        pa.array(np.zeros(0, np.int32) if rows is None else rows.sid,
+                 pa.int32()),
+        pa.array(np.zeros(0, np.int64) if rows is None else rows.ts,
+                 pa.int64()),
+        pa.array(np.zeros(0, np.uint64) if rows is None else rows.seq,
+                 pa.uint64()),
+        pa.array(np.zeros(0, np.uint8) if rows is None else rows.op,
+                 pa.uint8()),
+    ]
+    names = ["__sid", "__ts", "__seq", "__op"]
+    for f in field_names:
+        if rows is None:
+            arrays.append(pa.array(np.zeros(0, np.float64)))
+        else:
+            valid = (rows.field_valid or {}).get(f)
+            arrays.append(_field_array(np.asarray(rows.fields[f]), valid))
+        names.append(f)
+    meta = {
+        b"gtdb:tags": json.dumps(tag_values).encode(),
+        b"gtdb:nrows": str(n).encode(),
+    }
+    for k, v in (extra_meta or {}).items():
+        meta[k.encode() if isinstance(k, str) else k] = (
+            v if isinstance(v, bytes) else json.dumps(v).encode()
+        )
+    return pa.Table.from_arrays(arrays, names=names).replace_schema_metadata(
+        meta
+    )
+
+
+def arrow_to_scan(table: pa.Table, field_names: list[str]
+                  ) -> tuple[ColumnarRows | None, dict[str, list]]:
+    """Inverse of scan_to_arrow: (rows, per-sid tag values)."""
+    meta = table.schema.metadata or {}
+    tag_values = json.loads(meta.get(b"gtdb:tags", b"{}"))
+    if table.num_rows == 0:
+        return None, tag_values
+
+    def col(name):
+        arr = table.column(name)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        return arr
+
+    fields = {}
+    valids = {}
+    for f in field_names:
+        arr = col(f)
+        if pa.types.is_string(arr.type) or pa.types.is_large_string(arr.type):
+            vals = np.asarray(arr.to_pylist(), object)
+            vals[np.asarray(arr.is_null())] = ""
+        else:
+            vals = arr.to_numpy(zero_copy_only=False)
+            if arr.null_count:
+                vals = np.nan_to_num(np.asarray(vals, np.float64), nan=0.0)
+                if not pa.types.is_floating(arr.type):
+                    vals = vals.astype(arr.type.to_pandas_dtype())
+        fields[f] = vals
+        if arr.null_count:
+            valids[f] = np.asarray(arr.is_valid())
+    rows = ColumnarRows(
+        sid=col("__sid").to_numpy(zero_copy_only=False).astype(np.int32),
+        ts=col("__ts").to_numpy(zero_copy_only=False).astype(np.int64),
+        seq=col("__seq").to_numpy(zero_copy_only=False).astype(np.uint64),
+        op=col("__op").to_numpy(zero_copy_only=False).astype(np.uint8),
+        fields=fields,
+        field_valid=valids or None,
+    )
+    return rows, tag_values
+
+
+# ---------------------------------------------------------------------------
+# region writes
+# ---------------------------------------------------------------------------
+
+
+def write_to_batch(tag_columns: dict[str, np.ndarray], ts: np.ndarray,
+                   fields: dict[str, np.ndarray],
+                   field_valid: dict[str, np.ndarray] | None
+                   ) -> pa.RecordBatch:
+    arrays = []
+    names = []
+    for t, v in tag_columns.items():
+        arrays.append(pa.array(np.asarray(v, object), pa.string()))
+        names.append(f"__tag_{t}")
+    arrays.append(pa.array(np.asarray(ts, np.int64)))
+    names.append("__ts")
+    for f, v in fields.items():
+        valid = (field_valid or {}).get(f)
+        arrays.append(_field_array(np.asarray(v), valid))
+        names.append(f"__f_{f}")
+    return pa.RecordBatch.from_arrays(arrays, names=names)
+
+
+def batch_to_write(batch: pa.RecordBatch
+                   ) -> tuple[dict, np.ndarray, dict, dict]:
+    tag_columns: dict[str, np.ndarray] = {}
+    fields: dict[str, np.ndarray] = {}
+    valids: dict[str, np.ndarray] = {}
+    ts = None
+    for i in range(batch.num_columns):
+        name = batch.schema.field(i).name
+        arr = batch.column(i)
+        if name == "__ts":
+            ts = arr.to_numpy(zero_copy_only=False).astype(np.int64)
+        elif name.startswith("__tag_"):
+            vals = np.asarray(arr.to_pylist(), object)
+            vals[np.asarray(arr.is_null())] = ""
+            tag_columns[name[6:]] = vals
+        elif name.startswith("__f_"):
+            f = name[4:]
+            if pa.types.is_string(arr.type):
+                vals = np.asarray(arr.to_pylist(), object)
+                vals[np.asarray(arr.is_null())] = ""
+            else:
+                vals = arr.to_numpy(zero_copy_only=False)
+                if arr.null_count:
+                    vals = np.nan_to_num(
+                        np.asarray(vals, np.float64), nan=0.0
+                    )
+                    if not pa.types.is_floating(arr.type):
+                        vals = vals.astype(arr.type.to_pandas_dtype())
+            fields[f] = vals
+            if arr.null_count:
+                valids[f] = np.asarray(arr.is_valid())
+    return tag_columns, ts, fields, valids

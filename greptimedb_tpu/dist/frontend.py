@@ -1,0 +1,43 @@
+"""DistInstance: the full SQL surface over a distributed catalog.
+
+The frontend role of the reference's distributed mode
+(/root/reference/src/frontend/src/instance.rs): it owns NO storage —
+the catalog lives in the metasrv kv, regions live on datanode
+processes — yet serves the complete statement surface because the
+query engine runs here against RemoteTables. Aggregate-shaped queries
+additionally push partial plans down to the datanodes (dist/merge.py,
+the MergeScan split) so raw rows stay where they were written.
+"""
+
+from __future__ import annotations
+
+import os
+
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.dist.catalog import DistCatalogManager
+from greptimedb_tpu.dist.client import MetaClient
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+class DistInstance(Standalone):
+    def __init__(self, data_home: str, metasrv_addr: str, *,
+                 prefer_device: bool | None = None):
+        # the local engine only backs frontend-local scratch (scripts,
+        # slow-query log); table data never lands here
+        super().__init__(
+            engine_config=EngineConfig(
+                data_root=os.path.join(data_home, "frontend_local"),
+                enable_background=False,
+            ),
+            prefer_device=prefer_device,
+            warm_start=False,
+        )
+        self.meta = MetaClient(metasrv_addr)
+        self.catalog = DistCatalogManager(self.engine, self.meta)
+        self.distributed = True
+
+    def close(self):
+        try:
+            self.catalog.close()
+        finally:
+            super().close()

@@ -1,0 +1,50 @@
+"""Partial-plan shipping + merge (the MergeScan split).
+
+Datanode side: exec_partial() executes a SQL fragment over the named
+local regions and streams the partial result back (the sub-plan below
+MergeScanExec, /root/reference/src/query/src/dist_plan/merge_scan.rs).
+Frontend side (dist/dist_query.py) decides decomposability, rewrites
+aggregates into partial form, and merges.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def exec_partial(instance, doc: dict):
+    """Run `doc['sql']` on the datanode over ONLY the named regions.
+
+    The table is assembled on the fly from the shipped TableInfo + the
+    datanode's local regions, so the datanode needs no catalog entry —
+    the region-server contract (region_server.rs:153) extended with a
+    query surface."""
+    from greptimedb_tpu.catalog.manager import TableInfo
+    from greptimedb_tpu.catalog.table import Table
+    from greptimedb_tpu.query import stats as qstats
+    from greptimedb_tpu.servers.flight import result_to_arrow
+    from greptimedb_tpu.sql.parser import parse_sql
+
+    info = TableInfo.from_json(doc["table"])
+    rs = instance.region_server
+    regions = [rs._region(int(r)) for r in doc["region_ids"]]
+    table = Table(info, regions)
+    stmts = parse_sql(doc["sql"])
+    if len(stmts) != 1:
+        raise ValueError("partial_sql takes exactly one statement")
+    from greptimedb_tpu.query.planner import plan_select
+
+    plan = plan_select(
+        stmts[0], ts_name=info.schema.time_index.name,
+        tag_names=[c.name for c in info.schema.tag_columns],
+        all_columns=info.schema.column_names,
+    )
+    with qstats.collect() as collected:
+        res = instance.query_engine.execute(plan, table)
+    out = result_to_arrow(res)
+    meta = dict(out.schema.metadata or {})
+    meta[b"gtdb:stage_stats"] = json.dumps({
+        "counters": collected.counters, "notes": collected.notes,
+    }).encode()
+    meta[b"gtdb:exec_path"] = instance.query_engine.last_exec_path.encode()
+    return out.replace_schema_metadata(meta)

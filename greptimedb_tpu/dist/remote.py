@@ -1,0 +1,277 @@
+"""RemoteRegion / RemoteTable: a table whose regions live in other
+processes.
+
+The frontend's query engine is unchanged — it sees a Table with the
+usual scan/write surface; underneath, scans fan out ONE Flight RPC per
+datanode (each datanode merges its own regions locally, the region-
+server half of the reference's MergeScan split,
+/root/reference/src/query/src/dist_plan/merge_scan.rs:124) and the
+frontend interns per-datanode series spaces into one table-level sid
+space exactly as the in-process Table.scan does for local regions.
+Device fast paths skip remote tables (`table.remote`): HBM grids build
+from local region internals, which live on the datanodes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from greptimedb_tpu.catalog.table import Table, TableScanData
+from greptimedb_tpu.dist.codec import region_meta_to_json
+from greptimedb_tpu.storage.memtable import OP_PUT, _concat_rows
+from greptimedb_tpu.storage.series import SeriesRegistry
+
+
+class _MemtableShim:
+    """Last-known stats standing in for a local memtable (feeds
+    information_schema.region_statistics + heartbeats)."""
+
+    def __init__(self, region: "RemoteRegion"):
+        self._region = region
+
+    @property
+    def rows(self) -> int:
+        return self._region._stat("memtable_rows")
+
+    @property
+    def bytes(self) -> int:
+        return self._region._stat("memtable_bytes")
+
+
+class _SstShim:
+    def __init__(self, rows: int, size_bytes: int):
+        self.rows = rows
+        self.size_bytes = size_bytes
+
+
+class _ManifestStateShim:
+    def __init__(self, region: "RemoteRegion"):
+        self._region = region
+
+    @property
+    def ssts(self):
+        n = self._region._stat("sst_count")
+        if n <= 0:
+            return []
+        rows = self._region._stat("sst_rows")
+        size = self._region._stat("sst_bytes")
+        # per-SST split is not tracked remotely; surface totals on one
+        # synthetic entry plus empty placeholders to keep counts right
+        out = [_SstShim(rows, size)]
+        out.extend(_SstShim(0, 0) for _ in range(n - 1))
+        return out
+
+
+class _ManifestShim:
+    def __init__(self, region: "RemoteRegion"):
+        self.state = _ManifestStateShim(region)
+
+
+class RemoteRegion:
+    """Proxy for one region hosted by a datanode process."""
+
+    remote = True
+
+    def __init__(self, meta, client):
+        self.meta = meta
+        self.client = client
+        self.writable = True
+        self.memtable = _MemtableShim(self)
+        self.manifest = _ManifestShim(self)
+        self._stats_cache: dict | None = None
+
+    def _stat(self, key: str) -> int:
+        if self._stats_cache is None:
+            self.refresh_stats()
+        return int((self._stats_cache or {}).get(key, 0))
+
+    def refresh_stats(self):
+        stats = self.client.region_stats([self.meta.region_id])
+        self._stats_cache = stats.get(str(self.meta.region_id), {})
+
+    # ---- data ops -----------------------------------------------------
+    def write(self, tag_columns, ts, fields, *, field_valid=None,
+              op: int = OP_PUT, skip_wal: bool = False):
+        self.client.write_regions([{
+            "region_id": self.meta.region_id, "op": int(op),
+            "skip_wal": skip_wal, "tag_columns": tag_columns, "ts": ts,
+            "fields": fields, "field_valid": field_valid,
+        }])
+        self._stats_cache = None
+
+    def flush(self):
+        return True if self.client.flush_region(self.meta.region_id) \
+            else None
+
+    def truncate(self):
+        self.client.truncate_region(self.meta.region_id)
+        self._stats_cache = None
+
+    @property
+    def data_version(self):
+        v = self.client.data_versions([self.meta.region_id])
+        return v.get(str(self.meta.region_id))
+
+
+class RemoteTable(Table):
+    """Table over remote regions; scans group regions per datanode."""
+
+    remote = True
+
+    def __init__(self, info, regions: list[RemoteRegion]):
+        super().__init__(info, regions)
+
+    # ------------------------------------------------------------------
+    def _by_datanode(self, regions) -> list[tuple[object, list[int]]]:
+        groups: dict[int, tuple[object, list[int]]] = {}
+        for r in regions:
+            key = id(r.client)
+            if key not in groups:
+                groups[key] = (r.client, [])
+            groups[key][1].append(r.meta.region_id)
+        return list(groups.values())
+
+    def scan(self, *, ts_min=None, ts_max=None, field_names=None,
+             matchers=None, fulltext=None) -> TableScanData:
+        from greptimedb_tpu import cancellation
+        from greptimedb_tpu.query import stats
+
+        names = (field_names if field_names is not None
+                 else self.field_names)
+        scan_regions = self.regions
+        if self.partition_rule is not None and matchers:
+            keep = self.partition_rule.prune(matchers)
+            if keep is not None:
+                scan_regions = [
+                    self.regions[i] for i in keep if i < len(self.regions)
+                ]
+                stats.add("regions_pruned",
+                          len(self.regions) - len(scan_regions))
+        merged = SeriesRegistry(self.tag_names)
+        chunks = []
+        for client, rids in self._by_datanode(scan_regions):
+            cancellation.checkpoint()
+            rows, tag_values, dn_stats = client.region_scan(
+                rids, ts_min=ts_min, ts_max=ts_max, fields=names,
+                matchers=matchers, fulltext=fulltext,
+            )
+            stats.add("regions_scanned", dn_stats.get(
+                "regions_scanned", len(rids)
+            ))
+            stats.note(
+                f"datanode_{client.addr}",
+                {"rows": dn_stats.get("rows_scanned", 0),
+                 "regions": dn_stats.get("regions_scanned", 0)},
+            )
+            if rows is None or len(rows) == 0:
+                continue
+            if self.tag_names:
+                remap = merged.intern_rows([
+                    np.asarray(tag_values.get(t, []), object)
+                    for t in self.tag_names
+                ])
+                rows.sid = remap[rows.sid]
+            elif merged.num_series == 0 and len(rows):
+                merged.intern_rows([], n=1)
+            chunks.append(rows)
+        if not chunks:
+            return TableScanData(None, merged, names)
+        rows = chunks[0] if len(chunks) == 1 else _concat_rows(chunks,
+                                                               names)
+        return TableScanData(rows, merged, names)
+
+    # ------------------------------------------------------------------
+    def _dispatch_writes(self, puts, *, op: int, skip_wal: bool):
+        """One DoPut stream per datanode, carrying all of its regions'
+        batches (instead of one RPC per region)."""
+        groups: dict[int, tuple[object, list[dict]]] = {}
+        for r_idx, tag_columns, ts, fields, field_valid in puts:
+            region = self.regions[r_idx]
+            key = id(region.client)
+            if key not in groups:
+                groups[key] = (region.client, [])
+            groups[key][1].append({
+                "region_id": region.meta.region_id, "op": int(op),
+                "skip_wal": skip_wal, "tag_columns": tag_columns,
+                "ts": ts, "fields": fields, "field_valid": field_valid,
+            })
+            region._stats_cache = None
+        for client, items in groups.values():
+            client.write_regions(items)
+
+    def flush(self):
+        for client, rids in self._by_datanode(self.regions):
+            for rid in rids:
+                client.flush_region(rid)
+
+    def truncate(self):
+        for client, rids in self._by_datanode(self.regions):
+            for rid in rids:
+                client.truncate_region(rid)
+
+    def data_version(self) -> tuple:
+        versions = {}
+        for client, rids in self._by_datanode(self.regions):
+            versions.update(client.data_versions(rids))
+        return (
+            tuple(versions.get(str(r.meta.region_id))
+                  for r in self.regions),
+            tuple(self.schema.column_names),
+            tuple(self.tag_names),
+        )
+
+    def row_count(self) -> int:
+        total = 0
+        for client, rids in self._by_datanode(self.regions):
+            for st in client.region_stats(rids).values():
+                total += st.get("memtable_rows", 0) + st.get("sst_rows", 0)
+        return total
+
+
+def remote_regions_for(info, routes: dict[int, int],
+                       clients: dict[int, object]) -> list[RemoteRegion]:
+    """Build region proxies for a table from metasrv routes."""
+    from greptimedb_tpu.catalog.manager import region_options_from_table
+    from greptimedb_tpu.errors import RegionNotFoundError
+    from greptimedb_tpu.storage.region import RegionMetadata
+
+    regions = []
+    opts = region_options_from_table(info.options)
+    for rid in info.region_ids():
+        nid = routes.get(rid)
+        if nid is None or nid not in clients:
+            raise RegionNotFoundError(
+                f"region {rid} of {info.name} has no routable datanode "
+                f"(route={nid})"
+            )
+        meta = RegionMetadata(
+            region_id=rid, table=info.name,
+            tag_names=[c.name for c in info.schema.tag_columns],
+            field_names=[c.name for c in info.schema.field_columns],
+            ts_name=info.schema.time_index.name,
+            options=opts,
+            fulltext_fields=[
+                c.name for c in info.schema.field_columns
+                if getattr(c, "fulltext", False)
+            ],
+        )
+        regions.append(RemoteRegion(meta, clients[nid]))
+    return regions
+
+
+def region_meta_doc(info, rid: int) -> dict:
+    from greptimedb_tpu.catalog.manager import region_options_from_table
+    from greptimedb_tpu.storage.region import RegionMetadata
+
+    meta = RegionMetadata(
+        region_id=rid, table=info.name,
+        tag_names=[c.name for c in info.schema.tag_columns],
+        field_names=[c.name for c in info.schema.field_columns],
+        ts_name=info.schema.time_index.name,
+        options=region_options_from_table(info.options),
+        fulltext_fields=[
+            c.name for c in info.schema.field_columns
+            if getattr(c, "fulltext", False)
+        ],
+    )
+    return region_meta_to_json(meta)

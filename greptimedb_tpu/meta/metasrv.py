@@ -24,6 +24,7 @@ from greptimedb_tpu.meta.kv import KvBackend
 from greptimedb_tpu.meta.procedure import Procedure, ProcedureManager, Status
 
 ROUTE_PREFIX = "__route/"
+PEER_PREFIX = "__peer/"
 LEASE_SECS = 10.0
 
 
@@ -82,13 +83,23 @@ class Metasrv:
     # ------------------------------------------------------------------
     # node lifecycle + heartbeats
     # ------------------------------------------------------------------
-    def register_node(self, node_id: int):
+    def register_node(self, node_id: int, addr: str | None = None):
         with self._lock:
             self.nodes[node_id] = NodeInfo(node_id)
             self.detectors[node_id] = PhiAccrualFailureDetector(
                 threshold=self.phi_threshold
             )
             self._mailbox.setdefault(node_id, [])
+            if addr:
+                # persisted peer address book: frontends resolve region
+                # routes to datanode Flight addresses through this
+                self.kv.put_json(PEER_PREFIX + str(node_id), addr)
+
+    def peers(self) -> dict[int, str]:
+        return {
+            int(k[len(PEER_PREFIX):]): json.loads(v)
+            for k, v in self.kv.range(PEER_PREFIX)
+        }
 
     def heartbeat(self, node_id: int, region_stats: dict,
                   now_ms: float | None = None) -> list[dict]:

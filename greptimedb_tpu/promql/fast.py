@@ -149,6 +149,8 @@ def _build_entry(table, fieldname: str, version) -> _Entry | None:
     HBM-resident grid. Resolution is the gcd of observed sample intervals
     (coarsened if the grid would blow the cell cap, same approximation as
     ops/window.plan_grid_and_windows)."""
+    if getattr(table, "remote", False):
+        return None  # distributed tables: grids live on the datanodes
     import jax.numpy as jnp
 
     from greptimedb_tpu.ops import grid as G

@@ -1331,6 +1331,10 @@ def _group_ids_from_sids(plan, registry, active: np.ndarray):
 def execute_range_device(engine, plan, table):
     """Try to run a RANGE plan on the device grid cache. Returns a
     QueryResult, or None to fall back to the host path."""
+    if getattr(table, "remote", False):
+        # distributed tables: rows live on datanode processes (each of
+        # which runs its own device paths); the frontend merges on host
+        return None
     items = plan_lowering(plan, table)
     if items is None:
         return None

@@ -355,6 +355,7 @@ class QueryEngine:
             stats.add("series_matched", sum(
                 len(r.series.match_sids(plan.scan.matchers))
                 for r in table.regions
+                if not getattr(r, "remote", False)
             ))
         src = RowsSource(data.rows, data.registry, table.tag_names,
                          table.ts_name)

@@ -160,11 +160,109 @@ class FlightServer(flight.FlightServerBase):
             table = self._pending.pop(ticket.ticket, None)
         if table is None:
             sql = ticket.ticket.decode("utf-8")
+            if sql.startswith("{") and '"rpc"' in sql[:40]:
+                try:
+                    return flight.RecordBatchStream(self._region_rpc(sql))
+                except flight.FlightServerError:
+                    raise
+                except Exception as e:  # noqa: BLE001 - RPC boundary
+                    raise flight.FlightServerError(str(e)) from e
             try:
                 table = self._run_sql(sql)
             except Exception as e:  # noqa: BLE001 - RPC boundary
                 raise flight.FlightServerError(str(e)) from e
         return flight.RecordBatchStream(table)
+
+    # ---- region server (distributed data plane) -----------------------
+    def _region_server(self):
+        rs = getattr(self.instance, "region_server", None)
+        if rs is None:
+            raise flight.FlightServerError(
+                "this node does not serve region requests"
+            )
+        return rs
+
+    def _region_rpc(self, raw: str) -> pa.Table:
+        import json
+
+        from greptimedb_tpu.dist import codec as dist_codec
+
+        doc = json.loads(raw)
+        rpc = doc.get("rpc")
+        if rpc == "region_scan":
+            rs = self._region_server()
+            rows, tag_values, names, stats = rs.scan(
+                doc["region_ids"],
+                ts_min=doc.get("ts_min"), ts_max=doc.get("ts_max"),
+                field_names=doc.get("fields"),
+                matchers=doc.get("matchers"),
+                fulltext=(
+                    [tuple(f) for f in doc["fulltext"]]
+                    if doc.get("fulltext") else None
+                ),
+            )
+            return dist_codec.scan_to_arrow(
+                rows, tag_values, names, extra_meta={"gtdb:stats": stats}
+            )
+        if rpc == "partial_sql":
+            from greptimedb_tpu.dist.merge import exec_partial
+
+            return exec_partial(self.instance, doc)
+        raise flight.FlightServerError(f"unknown rpc: {rpc}")
+
+    def do_action(self, context, action: flight.Action):
+        import json
+
+        body = json.loads(action.body.to_pybytes() or b"{}")
+        try:
+            out = self._do_action(action.type, body)
+        except flight.FlightServerError:
+            raise
+        except Exception as e:  # noqa: BLE001 - RPC boundary
+            raise flight.FlightServerError(str(e)) from e
+        return [flight.Result(json.dumps(out or {}).encode())]
+
+    def _do_action(self, kind: str, body: dict) -> dict | None:
+        rs = self._region_server()
+        if kind == "open_region":
+            rs.open_region(body["meta"])
+        elif kind == "close_region":
+            rs.close_region(int(body["region_id"]))
+        elif kind == "drop_region":
+            rs.drop_region(int(body["region_id"]))
+        elif kind == "flush_region":
+            return {"flushed": rs.flush_region(int(body["region_id"]))}
+        elif kind == "truncate_region":
+            rs.truncate_region(int(body["region_id"]))
+        elif kind == "alter_region":
+            rs.alter_region(int(body["region_id"]), body["op"],
+                            body["name"])
+        elif kind == "region_stats":
+            return {"stats": rs.region_stats(
+                [int(r) for r in body["region_ids"]]
+            )}
+        elif kind == "data_versions":
+            return {"versions": rs.data_versions(
+                [int(r) for r in body["region_ids"]]
+            )}
+        elif kind == "list_regions":
+            return {"region_ids": rs.region_ids()}
+        else:
+            raise flight.FlightServerError(f"unknown action: {kind}")
+        return None
+
+    def list_actions(self, context):
+        return [
+            ("open_region", "open a region on this datanode"),
+            ("close_region", "close a region"),
+            ("drop_region", "drop a region"),
+            ("flush_region", "flush a region's memtable"),
+            ("truncate_region", "truncate a region"),
+            ("alter_region", "apply a schema change to a region"),
+            ("region_stats", "per-region row/byte statistics"),
+            ("data_versions", "per-region logical data versions"),
+            ("list_regions", "region ids served by this datanode"),
+        ]
 
     def get_flight_info(self, context, descriptor: flight.FlightDescriptor):
         sql = (descriptor.command or b"").decode("utf-8")
@@ -187,6 +285,8 @@ class FlightServer(flight.FlightServerBase):
         if not path:
             raise flight.FlightServerError("DoPut needs a table-name path")
         name = path[0].decode("utf-8")
+        if name == "region_write":
+            return self._do_put_regions(reader)
         inst = self.instance
         db = "public"
         if "." in name:
@@ -211,6 +311,33 @@ class FlightServer(flight.FlightServerBase):
             except Exception as e:  # noqa: BLE001 - RPC boundary
                 raise flight.FlightServerError(str(e)) from e
             inst._notify_flows(db, name, table, data, valid)
+
+    def _do_put_regions(self, reader):
+        """Per-region columnar writes: each batch's app_metadata names
+        the target region (RegionPutRequest analog)."""
+        import json
+
+        from greptimedb_tpu.dist import codec as dist_codec
+
+        rs = self._region_server()
+        for chunk in reader:
+            if chunk.data is None:
+                continue
+            meta = json.loads(
+                chunk.app_metadata.to_pybytes()
+                if chunk.app_metadata else b"{}"
+            )
+            tag_columns, ts, fields, valids = dist_codec.batch_to_write(
+                chunk.data
+            )
+            try:
+                rs.write(
+                    int(meta["region_id"]), tag_columns, ts, fields,
+                    valids, op=int(meta.get("op", 0) or 0),
+                    skip_wal=bool(meta.get("skip_wal", False)),
+                )
+            except Exception as e:  # noqa: BLE001 - RPC boundary
+                raise flight.FlightServerError(str(e)) from e
 
 
 class FlightFrontend:

@@ -840,6 +840,20 @@ def _table_label_values(t, label: str) -> set:
     from greptimedb_tpu import metric_engine as ME
 
     out: set = set()
+    base = t.physical if isinstance(t, ME.LogicalTable) else t
+    if getattr(base, "remote", False):
+        # distributed tables: series registries live on the datanodes;
+        # a field-less scan ships the merged registry back
+        matchers = (
+            [(ME.TABLE_ID_TAG, "eq", t._tid)]
+            if isinstance(t, ME.LogicalTable) else None
+        )
+        data = base.scan(field_names=[], matchers=matchers)
+        if label in data.registry.tag_names:
+            return {
+                v for v in data.registry.tag_values(label) if v != ""
+            }
+        return out
     if isinstance(t, ME.LogicalTable):
         for region in t.regions:
             sids = region.series.match_sids(

@@ -62,6 +62,10 @@ def _make_handler(metasrv: Metasrv, kv: KvBackend):
                 return self._json(200, {
                     str(r): n for r, n in metasrv._all_routes().items()
                 })
+            if path == "/peers":
+                return self._json(200, {
+                    str(n): a for n, a in metasrv.peers().items()
+                })
             if path.startswith("/route/"):
                 try:
                     rid = int(path.rsplit("/", 1)[-1])
@@ -78,7 +82,20 @@ def _make_handler(metasrv: Metasrv, kv: KvBackend):
                 return self._json(400, {"error": f"bad json: {e}"})
             try:
                 if path == "/register":
-                    metasrv.register_node(int(doc["node_id"]))
+                    metasrv.register_node(int(doc["node_id"]),
+                                          doc.get("addr"))
+                    return self._json(200, {})
+                if path == "/allocate":
+                    routes = metasrv.allocate_regions(
+                        [int(r) for r in doc["region_ids"]]
+                    )
+                    return self._json(200, {
+                        "routes": {str(r): n for r, n in routes.items()}
+                    })
+                if path == "/remove_routes":
+                    metasrv.remove_routes(
+                        [int(r) for r in doc["region_ids"]]
+                    )
                     return self._json(200, {})
                 if path == "/heartbeat":
                     instructions = metasrv.heartbeat(
