@@ -1,0 +1,281 @@
+"""Distributed query execution: the MergeScan split on the frontend.
+
+For decomposable shapes the commutative part of the plan ships to each
+datanode (which executes it over ITS regions — device fast paths
+included) and only partial states cross the wire; the frontend merges
+partials and runs the non-commutative remainder (HAVING / ORDER BY /
+LIMIT / post-projection) locally. Exactly the reference's split:
+MergeScanExec + the commutativity analyzer
+(/root/reference/src/query/src/dist_plan/merge_scan.rs:124,
+src/query/src/dist_plan/analyzer.rs:38-45).
+
+Shapes:
+- plain GROUP BY aggregates with count/sum/min/max/avg (avg decomposed
+  into sum+count partials);
+- RANGE queries whose BY keys cover the full tag set (series are
+  hash-routed by the full tag tuple, so per-datanode results are
+  disjoint) with no FILL — partial = the plan minus sort/limit, merge =
+  concatenation.
+
+Everything else falls back to remote region scans (data shipping),
+which stays correct for the whole SQL surface.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from greptimedb_tpu.dist import plan_codec
+from greptimedb_tpu.query import stats
+from greptimedb_tpu.query.executor import Col, QueryResult
+from greptimedb_tpu.query.planner import AggSpec, SelectPlan
+from greptimedb_tpu.sql import ast as A
+
+_DECOMPOSABLE = {"count", "sum", "min", "max", "mean"}
+
+_NULL = object()  # group-key sentinel for SQL NULL
+
+
+def try_dist_query(instance, plan: SelectPlan, table):
+    """Push a decomposable plan down per datanode; None = fall back."""
+    if not getattr(table, "remote", False):
+        return None
+    try:
+        if plan.kind == "aggregate":
+            return _dist_aggregate(instance, plan, table)
+        if plan.kind == "range":
+            return _dist_range(instance, plan, table)
+    except Exception:  # noqa: BLE001 - fall back to data shipping
+        stats.add("dist_pushdown_errors", 1)
+        return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# shared plumbing
+# ---------------------------------------------------------------------------
+
+
+def _fan_out(instance, table, partial: SelectPlan):
+    """Ship `partial` to every datanode holding un-pruned regions of
+    `table`; yields (addr, QueryResult)."""
+    from greptimedb_tpu.servers.remote import arrow_to_result
+
+    doc_plan = plan_codec.encode(partial)
+    info_json = table.info.to_json()
+    scan_regions = table.regions
+    if table.partition_rule is not None and partial.scan.matchers:
+        keep = table.partition_rule.prune(partial.scan.matchers)
+        if keep is not None:
+            scan_regions = [
+                table.regions[i] for i in keep
+                if i < len(table.regions)
+            ]
+            stats.add("regions_pruned",
+                      len(table.regions) - len(scan_regions))
+    outs = []
+    for client, rids in table._by_datanode(scan_regions):
+        arrow = client.partial_sql({
+            "mode": "plan", "plan": doc_plan, "table": info_json,
+            "region_ids": rids,
+        })
+        meta = arrow.schema.metadata or {}
+        stage = json.loads(meta.get(b"gtdb:stage_stats", b"{}"))
+        path = meta.get(b"gtdb:exec_path", b"?").decode()
+        counters = stage.get("counters", {})
+        stats.note(f"datanode_{client.addr}", json.dumps({
+            "exec_path": path,
+            "rows_scanned": counters.get("rows_scanned", 0),
+            "regions_scanned": counters.get("regions_scanned", 0),
+            "partial_rows": arrow.num_rows,
+        }))
+        outs.append((client.addr, arrow_to_result(arrow)))
+    stats.add("dist_partial_datanodes", len(outs))
+    return outs
+
+
+def _col_from_values(vals: list) -> Col:
+    """python values (with _NULL sentinels) -> Col with validity."""
+    valid = np.asarray([v is not _NULL for v in vals], bool)
+    is_str = any(isinstance(v, str) for v in vals if v is not _NULL)
+    fill = "" if is_str else 0
+    clean = [fill if v is _NULL else v for v in vals]
+    arr = (np.asarray(clean, object) if is_str
+           else np.asarray(clean))
+    return Col(arr, None if valid.all() else valid)
+
+
+def _key_tuple(cols: list[Col], i: int) -> tuple:
+    out = []
+    for c in cols:
+        if c.validity is not None and not c.validity[i]:
+            out.append(_NULL)
+        else:
+            v = c.values[i]
+            out.append(v.item() if isinstance(v, np.generic) else v)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# plain aggregates
+# ---------------------------------------------------------------------------
+
+
+def _dist_aggregate(instance, plan: SelectPlan, table):
+    if any(a.op not in _DECOMPOSABLE or a.distinct for a in plan.aggs):
+        return None
+    # partial aggs: stable derived keys; avg splits into sum + count
+    partial_aggs: list[AggSpec] = []
+    for a in plan.aggs:
+        if a.op == "mean":
+            partial_aggs.append(AggSpec(f"{a.key}__s", "sum", a.arg))
+            partial_aggs.append(AggSpec(f"{a.key}__c", "count", a.arg))
+        else:
+            partial_aggs.append(AggSpec(f"{a.key}__p", a.op, a.arg))
+    partial = SelectPlan(
+        kind="aggregate", table_name=plan.table_name, scan=plan.scan,
+        keys=plan.keys, aggs=partial_aggs,
+        post_items=(
+            [(A.Column(k.key), k.key) for k in plan.keys]
+            + [(A.Column(p.key), p.key) for p in partial_aggs]
+        ),
+    )
+    results = _fan_out(instance, table, partial)
+
+    nk = len(plan.keys)
+    groups: dict[tuple, dict] = {}
+    order: list[tuple] = []
+    for _addr, res in results:
+        key_cols = res.cols[:nk]
+        agg_cols = res.cols[nk:]
+        for i in range(res.num_rows):
+            key = _key_tuple(key_cols, i)
+            st = groups.get(key)
+            if st is None:
+                st = {p.key: None for p in partial_aggs}
+                groups[key] = st
+                order.append(key)
+            for j, p in enumerate(partial_aggs):
+                c = agg_cols[j]
+                if c.validity is not None and not c.validity[i]:
+                    continue
+                v = c.values[i]
+                v = v.item() if isinstance(v, np.generic) else v
+                cur = st[p.key]
+                if cur is None:
+                    st[p.key] = v
+                elif p.op in ("sum", "count"):
+                    st[p.key] = cur + v
+                elif p.op == "min":
+                    st[p.key] = min(cur, v)
+                elif p.op == "max":
+                    st[p.key] = max(cur, v)
+    g = len(order)
+    agg_cols_map: dict[str, Col] = {}
+    for ki, k in enumerate(plan.keys):
+        vals = [key[ki] for key in order]
+        agg_cols_map[k.key] = _col_from_values(vals)
+    for a in plan.aggs:
+        if a.op == "mean":
+            s = [groups[key][f"{a.key}__s"] for key in order]
+            c = [groups[key][f"{a.key}__c"] for key in order]
+            valid = np.asarray(
+                [sv is not None and cv not in (None, 0)
+                 for sv, cv in zip(s, c)], bool,
+            )
+            vals = np.asarray([
+                (sv / cv) if ok else 0.0
+                for sv, cv, ok in zip(s, c, valid)
+            ], np.float64)
+            agg_cols_map[a.key] = Col(vals,
+                                      None if valid.all() else valid)
+        elif a.op == "count":
+            vals = np.asarray([
+                groups[key][f"{a.key}__p"] or 0 for key in order
+            ], np.int64)
+            agg_cols_map[a.key] = Col(vals)
+        else:
+            p = [
+                _NULL if groups[key][f"{a.key}__p"] is None
+                else groups[key][f"{a.key}__p"] for key in order
+            ]
+            agg_cols_map[a.key] = _col_from_values(p)
+    engine = instance.query_engine
+    engine._record_path("aggregate", "dist:partial")
+    return engine._post_project(plan, agg_cols_map, g)
+
+
+# ---------------------------------------------------------------------------
+# RANGE with series-disjoint groups
+# ---------------------------------------------------------------------------
+
+
+def _dist_range(instance, plan: SelectPlan, table):
+    tags = set(table.tag_names)
+    if not tags:
+        return None
+    by = {
+        k.expr.name for k in plan.keys
+        if isinstance(k.expr, A.Column)
+    }
+    if len(by) != len(plan.keys) or by != tags:
+        return None  # groups span datanodes; fall back
+    if plan.fill is not None or any(
+        r.fill is not None for r in plan.range_items
+    ):
+        # fill grids span the GLOBAL time range; per-datanode grids
+        # would differ. Fall back to data shipping.
+        return None
+    if plan.having is not None or plan.distinct:
+        # the concat merge applies only sort/limit; HAVING/DISTINCT
+        # would be silently dropped — fall back
+        return None
+    # ship the visible items PLUS the plan's internal columns (__ts,
+    # group keys, range-item values): the final ORDER BY may reference
+    # them (the planner rewrites `ts` -> __ts etc.)
+    names = [nm for _, nm in plan.post_items]
+    internal = ["__ts"] + [k.key for k in plan.keys] + [
+        r.key for r in plan.range_items
+    ]
+    partial_items = list(plan.post_items) + [
+        (A.Column(key), key) for key in internal
+    ]
+    partial = SelectPlan(
+        kind="range", table_name=plan.table_name, scan=plan.scan,
+        keys=plan.keys, range_items=plan.range_items,
+        post_items=partial_items, align_ms=plan.align_ms,
+        align_to=plan.align_to, fill=None,
+        ts_out_name=plan.ts_out_name,
+    )
+    results = _fan_out(instance, table, partial)
+    parts = [res for _addr, res in results if res.num_rows]
+    if not parts:
+        return QueryResult(names, [Col(np.zeros(0)) for _ in names])
+
+    def concat(i):
+        vals = np.concatenate([
+            np.asarray(p.cols[i].values) for p in parts
+        ])
+        valid = np.concatenate([
+            (p.cols[i].validity if p.cols[i].validity is not None
+             else np.ones(p.num_rows, bool))
+            for p in parts
+        ])
+        return Col(vals, None if valid.all() else valid)
+
+    cols = [concat(i) for i in range(len(names))]
+    from greptimedb_tpu.query.executor import DictSource
+
+    n_rows = len(cols[0]) if cols else 0
+    extra = DictSource({
+        key: concat(len(names) + j) for j, key in enumerate(internal)
+    }, n_rows)
+    engine = instance.query_engine
+    cols = engine._order_limit(plan, cols, names, extra_src=extra)
+    engine._record_path("range", "dist:partial")
+    types = {}
+    for _addr, res in results:
+        types.update(res.types)
+    return QueryResult(names, cols, types)

@@ -23,22 +23,16 @@ def exec_partial(instance, doc: dict):
     from greptimedb_tpu.catalog.table import Table
     from greptimedb_tpu.query import stats as qstats
     from greptimedb_tpu.servers.flight import result_to_arrow
-    from greptimedb_tpu.sql.parser import parse_sql
 
     info = TableInfo.from_json(doc["table"])
     rs = instance.region_server
     regions = [rs._region(int(r)) for r in doc["region_ids"]]
     table = Table(info, regions)
-    stmts = parse_sql(doc["sql"])
-    if len(stmts) != 1:
-        raise ValueError("partial_sql takes exactly one statement")
-    from greptimedb_tpu.query.planner import plan_select
+    if doc.get("mode") != "plan":
+        raise ValueError("partial_sql requires mode='plan'")
+    from greptimedb_tpu.dist import plan_codec
 
-    plan = plan_select(
-        stmts[0], ts_name=info.schema.time_index.name,
-        tag_names=[c.name for c in info.schema.tag_columns],
-        all_columns=info.schema.column_names,
-    )
+    plan = plan_codec.decode(doc["plan"])
     with qstats.collect() as collected:
         res = instance.query_engine.execute(plan, table)
     out = result_to_arrow(res)

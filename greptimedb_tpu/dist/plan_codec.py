@@ -1,0 +1,88 @@
+"""Serialized-plan shipping: SelectPlan (+ its AST exprs) <-> JSON.
+
+The committed plan-shipping codec of the distributed query path — the
+role substrait plays in the reference
+(/root/reference/src/common/substrait/src/df_substrait.rs:33-56
+encode/decode of the sub-plan below MergeScanExec). Every node is a
+dataclass (sql/ast.py, query/planner.py), so one generic codec covers
+the whole plan tree; non-dataclass leaves (Decimal, ConcreteDataType,
+numpy scalars, tuples) get explicit tags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from decimal import Decimal
+
+import numpy as np
+
+from greptimedb_tpu.datatypes.types import ConcreteDataType
+from greptimedb_tpu.query import planner as P
+from greptimedb_tpu.sql import ast as A
+
+_REGISTRY: dict[str, type] = {}
+
+
+def _register(mod):
+    for name in dir(mod):
+        obj = getattr(mod, name)
+        if isinstance(obj, type) and dataclasses.is_dataclass(obj):
+            _REGISTRY[obj.__name__] = obj
+
+
+_register(A)
+_register(P)
+assert "SelectPlan" in _REGISTRY and "Select" in _REGISTRY, (
+    "plan codec registry failed to populate"
+)
+
+
+def encode(v):
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, Decimal):
+        return {"__d": str(v)}
+    if isinstance(v, ConcreteDataType):
+        return {"__dt": v.name}
+    if isinstance(v, re.Pattern):
+        # LIKE / regex matchers carry compiled patterns
+        return {"__re": v.pattern, "fl": v.flags}
+    if isinstance(v, tuple):
+        return {"__t": [encode(x) for x in v]}
+    if isinstance(v, list):
+        return [encode(x) for x in v]
+    if isinstance(v, dict):
+        return {"__m": [[encode(k), encode(x)] for k, x in v.items()]}
+    if dataclasses.is_dataclass(v):
+        cls = type(v).__name__
+        if cls not in _REGISTRY:
+            raise TypeError(f"unregistered plan node: {cls}")
+        return {"__c": cls, "f": {
+            f.name: encode(getattr(v, f.name))
+            for f in dataclasses.fields(v)
+        }}
+    raise TypeError(f"cannot encode {type(v).__name__} in a plan")
+
+
+def decode(v):
+    if isinstance(v, list):
+        return [decode(x) for x in v]
+    if not isinstance(v, dict):
+        return v
+    if "__d" in v:
+        return Decimal(v["__d"])
+    if "__dt" in v:
+        return ConcreteDataType.from_name(v["__dt"])
+    if "__re" in v:
+        return re.compile(v["__re"], v.get("fl", 0))
+    if "__t" in v:
+        return tuple(decode(x) for x in v["__t"])
+    if "__m" in v:
+        return {decode(k): decode(x) for k, x in v["__m"]}
+    cls = _REGISTRY.get(v.get("__c", ""))
+    if cls is None:
+        raise TypeError(f"unknown plan node: {v.get('__c')!r}")
+    return cls(**{k: decode(x) for k, x in v["f"].items()})

@@ -829,6 +829,15 @@ class Standalone:
             stmt, ts_name=ts_name, tag_names=tag_names,
             all_columns=all_columns,
         )
+        if table is not None and getattr(table, "remote", False):
+            # distributed tables: try the MergeScan split first (partial
+            # plans execute datanode-side, only partial states cross the
+            # wire); None falls through to remote region scans
+            from greptimedb_tpu.dist.dist_query import try_dist_query
+
+            res = try_dist_query(self, plan, table)
+            if res is not None:
+                return res
         return self.query_engine.execute(plan, table)
 
     def plan(self, stmt: A.Select, ctx: QueryContext):

@@ -228,3 +228,146 @@ def test_metric_engine_over_dist(harness):
     fe.execute_sql("drop table m1")
     assert fe.sql("select count(greptime_value) from m2").rows()[0][0] == 1
     assert fe.sql("select count(greptime_value) from m0").rows()[0][0] == 1
+
+
+def test_partial_aggregate_pushdown(harness, standalone_ref):
+    """Decomposable GROUP BY aggregates ship partial plans to the
+    datanodes; only partial states cross the wire (MergeScan split)."""
+    from greptimedb_tpu.query import stats as qstats
+
+    fe = harness.frontend
+    _seed(fe)
+    cases = [
+        "select dc, avg(usage), count(usage), sum(mem) from cpu "
+        "group by dc order by dc",
+        "select count(usage), min(usage), max(mem) from cpu",
+        "select dc, sum(usage) from cpu group by dc "
+        "having sum(usage) > 100 order by sum(usage) desc limit 2",
+        "select dc, avg(usage) from cpu where host != 'h0' "
+        "group by dc order by dc",
+        "select dc, host, max(usage) from cpu group by dc, host "
+        "order by dc, host limit 5",
+    ]
+    for sql in cases:
+        with qstats.collect() as st:
+            got = fe.sql(sql).rows()
+        want = standalone_ref.sql(sql).rows()
+        assert got == want, sql
+        assert st.counters.get("dist_partial_datanodes", 0) == 3, sql
+        assert not st.counters.get("dist_pushdown_errors"), sql
+        assert any(k.startswith("datanode_") for k in st.notes), sql
+
+
+def test_range_pushdown_series_disjoint(harness, standalone_ref):
+    from greptimedb_tpu.query import stats as qstats
+
+    fe = harness.frontend
+    _seed(fe)
+    sql = ("select ts, host, dc, avg(usage) range '10s' from cpu "
+           "align '10s' order by ts, host limit 30")
+    with qstats.collect() as st:
+        got = fe.sql(sql).rows()
+    want = standalone_ref.sql(sql).rows()
+    assert got == want
+    assert st.counters.get("dist_partial_datanodes", 0) == 3
+    assert not st.counters.get("dist_pushdown_errors")
+
+
+def test_explain_analyze_shows_per_datanode_metrics(harness):
+    fe = harness.frontend
+    _seed(fe)
+    r = fe.sql("explain analyze select dc, avg(usage) from cpu "
+               "group by dc")
+    text = "\n".join(str(row[0]) for row in r.rows())
+    assert "datanode_" in text
+    assert "rows_scanned" in text
+
+
+def test_plan_codec_round_trip():
+    from greptimedb_tpu.dist import plan_codec
+    from greptimedb_tpu.query.planner import plan_select
+    from greptimedb_tpu.sql.parser import parse_sql
+
+    for sql in [
+        "select dc, avg(usage), count(*) from cpu where host != 'h0' "
+        "and ts >= 1000 group by dc having avg(usage) > 1 "
+        "order by dc limit 3",
+        "select ts, host, min(usage) range '30s' from cpu "
+        "align '10s' by (host) order by ts",
+        "select host, usage * 2 + 1 from cpu where usage > 0.5 "
+        "and host like 'h%'",
+    ]:
+        stmt = parse_sql(sql)[0]
+        plan = plan_select(stmt, ts_name="ts",
+                           tag_names=["host", "dc"],
+                           all_columns=["ts", "host", "dc", "usage"])
+        doc = plan_codec.encode(plan)
+        import json
+
+        back = plan_codec.decode(json.loads(json.dumps(doc)))
+        assert back == plan, sql
+
+
+def test_pushdown_with_nulls(harness, standalone_ref):
+    """Partial-state merge must respect SQL null semantics (sum of an
+    all-null datanode partial, count skipping nulls, avg division)."""
+    fe = harness.frontend
+    for inst in (fe, standalone_ref):
+        inst.execute_sql(
+            "create table sparse (ts timestamp time index, host string "
+            "primary key, v double, w double) with (num_regions = 3)"
+        )
+        inst.execute_sql(
+            "insert into sparse (host, ts, v) values "
+            "('a', 1000, 1.0), ('b', 1000, 2.0), ('c', 1000, 3.0)"
+        )
+        inst.execute_sql(
+            "insert into sparse (host, ts, w) values ('a', 2000, 5.0)"
+        )
+    for sql in [
+        "select host, count(w), sum(w), avg(w) from sparse "
+        "group by host order by host",
+        "select count(w), min(w), max(w), avg(v) from sparse",
+    ]:
+        assert fe.sql(sql).rows() == standalone_ref.sql(sql).rows(), sql
+
+
+def test_range_having_distinct_fall_back_correctly(harness,
+                                                   standalone_ref):
+    """RANGE + HAVING/DISTINCT are not concat-mergeable; the pushdown
+    must bail and the fallback must still give standalone-equal rows."""
+    fe = harness.frontend
+    _seed(fe)
+    for sql in [
+        "select ts, host, dc, max(usage) range '10s' as m from cpu "
+        "align '10s' having m > 10 order by ts, host",
+        "select distinct dc, count(usage) range '1h' from cpu "
+        "align '1h' by (host, dc) order by dc",
+    ]:
+        assert fe.sql(sql).rows() == standalone_ref.sql(sql).rows(), sql
+
+
+def test_pushdown_prunes_partitioned_regions(harness, standalone_ref):
+    """PARTITION ON routing: a pushdown with a partition-key matcher
+    must skip datanodes whose regions cannot match."""
+    from greptimedb_tpu.query import stats as qstats
+
+    fe = harness.frontend
+    for inst in (fe, standalone_ref):
+        inst.execute_sql(
+            "create table part (ts timestamp time index, host string "
+            "primary key, v double) partition on columns (host) ("
+            "host < 'h3', host >= 'h3' and host < 'h6', host >= 'h6')"
+        )
+        values = ", ".join(
+            f"('h{i}', {1_700_000_000_000 + p * 1000}, {i + p})"
+            for p in range(3) for i in range(9)
+        )
+        inst.execute_sql(f"insert into part (host, ts, v) values {values}")
+    sql = ("select host, sum(v) from part where host = 'h1' "
+           "group by host")
+    with qstats.collect() as st:
+        got = fe.sql(sql).rows()
+    assert got == standalone_ref.sql(sql).rows()
+    assert st.counters.get("regions_pruned", 0) == 2
+    assert st.counters.get("dist_partial_datanodes", 0) == 1
