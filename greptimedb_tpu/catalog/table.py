@@ -201,15 +201,7 @@ class Table:
 
         from greptimedb_tpu.query import stats
 
-        scan_regions = self.regions
-        if self.partition_rule is not None and matchers:
-            keep = self.partition_rule.prune(matchers)
-            if keep is not None:
-                scan_regions = [
-                    self.regions[i] for i in keep if i < len(self.regions)
-                ]
-                stats.add("regions_pruned",
-                          len(self.regions) - len(scan_regions))
+        scan_regions = self.pruned_regions(matchers)
         stats.add("regions_scanned", len(scan_regions))
         merged = SeriesRegistry(self.tag_names)
         chunks: list[ColumnarRows] = []
@@ -241,6 +233,22 @@ class Table:
             return TableScanData(None, merged, names)
         rows = chunks[0] if len(chunks) == 1 else _concat_rows_full(chunks, names)
         return TableScanData(rows, merged, names)
+
+    def pruned_regions(self, matchers) -> list:
+        """Regions that can match `matchers` under the partition rule
+        (all of them when unpartitioned / unprunable). The ONE pruning
+        implementation shared by local scans, remote scans, and the
+        distributed partial fan-out."""
+        if self.partition_rule is None or not matchers:
+            return self.regions
+        keep = self.partition_rule.prune(matchers)
+        if keep is None:
+            return self.regions
+        from greptimedb_tpu.query import stats
+
+        out = [self.regions[i] for i in keep if i < len(self.regions)]
+        stats.add("regions_pruned", len(self.regions) - len(out))
+        return out
 
     def flush(self):
         for r in self.regions:

@@ -59,28 +59,30 @@ def try_dist_query(instance, plan: SelectPlan, table):
 
 
 def _fan_out(instance, table, partial: SelectPlan):
-    """Ship `partial` to every datanode holding un-pruned regions of
-    `table`; yields (addr, QueryResult)."""
+    """Ship `partial` concurrently to every datanode holding un-pruned
+    regions of `table`; returns [(addr, QueryResult)]."""
+    from concurrent.futures import ThreadPoolExecutor
+
     from greptimedb_tpu.servers.remote import arrow_to_result
 
     doc_plan = plan_codec.encode(partial)
     info_json = table.info.to_json()
-    scan_regions = table.regions
-    if table.partition_rule is not None and partial.scan.matchers:
-        keep = table.partition_rule.prune(partial.scan.matchers)
-        if keep is not None:
-            scan_regions = [
-                table.regions[i] for i in keep
-                if i < len(table.regions)
-            ]
-            stats.add("regions_pruned",
-                      len(table.regions) - len(scan_regions))
-    outs = []
-    for client, rids in table._by_datanode(scan_regions):
-        arrow = client.partial_sql({
+    scan_regions = table.pruned_regions(partial.scan.matchers)
+    groups = table._by_datanode(scan_regions)
+
+    def one(client, rids):
+        return client.partial_sql({
             "mode": "plan", "plan": doc_plan, "table": info_json,
             "region_ids": rids,
         })
+
+    if len(groups) <= 1:
+        arrows = [one(c, r) for c, r in groups]
+    else:
+        with ThreadPoolExecutor(max_workers=len(groups)) as pool:
+            arrows = list(pool.map(lambda g: one(*g), groups))
+    outs = []
+    for (client, _rids), arrow in zip(groups, arrows):
         meta = arrow.schema.metadata or {}
         stage = json.loads(meta.get(b"gtdb:stage_stats", b"{}"))
         path = meta.get(b"gtdb:exec_path", b"?").decode()
@@ -169,9 +171,16 @@ def _dist_aggregate(instance, plan: SelectPlan, table):
                 elif p.op in ("sum", "count"):
                     st[p.key] = cur + v
                 elif p.op == "min":
-                    st[p.key] = min(cur, v)
+                    # numpy semantics: NaN propagates regardless of
+                    # datanode iteration order (python min() does not)
+                    st[p.key] = float(np.minimum(cur, v))
                 elif p.op == "max":
-                    st[p.key] = max(cur, v)
+                    st[p.key] = float(np.maximum(cur, v))
+    if not order and not plan.keys:
+        # global aggregate over zero partials must still yield ONE row
+        # (count=0, NULL extremes) — standalone's empty-input semantics
+        order.append(())
+        groups[()] = {p.key: None for p in partial_aggs}
     g = len(order)
     agg_cols_map: dict[str, Col] = {}
     for ki, k in enumerate(plan.keys):

@@ -1,10 +1,11 @@
-"""Partial-plan shipping + merge (the MergeScan split).
+"""Partial-plan execution on the datanode (the MergeScan split).
 
-Datanode side: exec_partial() executes a SQL fragment over the named
-local regions and streams the partial result back (the sub-plan below
-MergeScanExec, /root/reference/src/query/src/dist_plan/merge_scan.rs).
-Frontend side (dist/dist_query.py) decides decomposability, rewrites
-aggregates into partial form, and merges.
+exec_partial() decodes a shipped SelectPlan (dist/plan_codec.py) and
+executes it over the named local regions, streaming the partial result
+back (the sub-plan below MergeScanExec,
+/root/reference/src/query/src/dist_plan/merge_scan.rs). The frontend
+side (dist/dist_query.py) decides decomposability, rewrites aggregates
+into partial form, and merges.
 """
 
 from __future__ import annotations
@@ -28,6 +29,10 @@ def exec_partial(instance, doc: dict):
     rs = instance.region_server
     regions = [rs._region(int(r)) for r in doc["region_ids"]]
     table = Table(info, regions)
+    # the frontend already partition-pruned and shipped exactly the
+    # regions to read; re-pruning here would misindex the local subset
+    # (the rule's indices are GLOBAL partition positions)
+    table.partition_rule = None
     if doc.get("mode") != "plan":
         raise ValueError("partial_sql requires mode='plan'")
     from greptimedb_tpu.dist import plan_codec

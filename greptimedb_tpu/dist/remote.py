@@ -138,15 +138,7 @@ class RemoteTable(Table):
 
         names = (field_names if field_names is not None
                  else self.field_names)
-        scan_regions = self.regions
-        if self.partition_rule is not None and matchers:
-            keep = self.partition_rule.prune(matchers)
-            if keep is not None:
-                scan_regions = [
-                    self.regions[i] for i in keep if i < len(self.regions)
-                ]
-                stats.add("regions_pruned",
-                          len(self.regions) - len(scan_regions))
+        scan_regions = self.pruned_regions(matchers)
         merged = SeriesRegistry(self.tag_names)
         chunks = []
         for client, rids in self._by_datanode(scan_regions):

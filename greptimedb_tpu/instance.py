@@ -144,6 +144,9 @@ def _enable_xla_persistent_cache(data_root: str):
         import jax
 
         path = os.path.join(os.path.abspath(data_root), ".xla_cache")
+        # jax won't create the directory itself; a missing dir turns
+        # every cache write into a warning
+        os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

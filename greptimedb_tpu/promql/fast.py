@@ -85,6 +85,7 @@ class _Entry:
     s_pad: int
     nbytes: int
     last_used: float = 0.0
+    mesh: object = None         # series-axis sharding mesh (None = 1 dev)
     # per-entry derived caches (device-resident, so queries move no masks)
     match_cache: dict = field(default_factory=dict)
     group_cache: dict = field(default_factory=dict)
@@ -98,7 +99,7 @@ class SelectorGridCache:
         self._entries: dict[tuple, _Entry] = {}
         self._lock = threading.Lock()
 
-    def get_entry(self, table, fieldname: str) -> _Entry | None:
+    def get_entry(self, table, fieldname: str, mesh=None) -> _Entry | None:
         key = (id(table), fieldname)
         version = table.data_version()
         with self._lock:
@@ -106,7 +107,7 @@ class SelectorGridCache:
             if e is not None and e.table is table and e.version == version:
                 e.last_used = time.monotonic()
                 return e
-        e = _build_entry(table, fieldname, version)
+        e = _build_entry(table, fieldname, version, mesh=mesh)
         if e is None:
             return None
         with self._lock:
@@ -144,7 +145,21 @@ class SelectorGridCache:
 _CACHE = SelectorGridCache()
 
 
-def _build_entry(table, fieldname: str, version) -> _Entry | None:
+def _series_sharding(mesh, ndim: int):
+    """NamedSharding partitioning axis 0 (series) over the mesh; None
+    when single-device."""
+    if mesh is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+
+    spec = [None] * ndim
+    spec[0] = AXIS_SHARD
+    return NamedSharding(mesh, P(*spec))
+
+
+def _build_entry(table, fieldname: str, version, mesh=None) -> _Entry | None:
     """Scan the whole table once and gridify every series onto one
     HBM-resident grid. Resolution is the gcd of observed sample intervals
     (coarsened if the grid would blow the cell cap, same approximation as
@@ -184,6 +199,12 @@ def _build_entry(table, fieldname: str, version) -> _Entry | None:
     t_max = int(uniq_ts[-1])
     s = registry.num_series
     s_pad = _pow2_bucket(s)
+    if mesh is not None:
+        from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+
+        # series axis shards over the mesh; pow2 buckets >= 8 divide an
+        # 8-way mesh evenly, smaller grids pad up to it
+        s_pad = max(s_pad, mesh.shape[AXIS_SHARD])
     # keep grid bytes within half the cache budget: coarsen res as needed
     # (sacrifices exact window alignment on pathological intervals; such
     # queries then fail the alignment check and use the generic path)
@@ -210,6 +231,15 @@ def _build_entry(table, fieldname: str, version) -> _Entry | None:
         jnp.asarray(mask),
         s_pad, nc,
     )
+    if mesh is not None:
+        # resident grids shard over the series axis; queries then run
+        # SPMD with XLA-inserted collectives for cross-shard group folds
+        import jax
+
+        sh2 = _series_sharding(mesh, 2)
+        gvals = jax.device_put(gvals, sh2)
+        ghas = jax.device_put(ghas, sh2)
+        gtsg = jax.device_put(gtsg, sh2)
     gvals.block_until_ready()
     nbytes = s_pad * nc * 9
     _FAST_HITS.labels("grid_build").inc()
@@ -217,10 +247,12 @@ def _build_entry(table, fieldname: str, version) -> _Entry | None:
         "greptime_promql_grid_build_seconds",
         "wall seconds of the last selector grid build",
     ).set(time.perf_counter() - t0_build)
-    return _Entry(
+    entry = _Entry(
         table, fieldname, version, registry, spec, gvals, ghas, gtsg,
         s, s_pad, nbytes,
     )
+    entry.mesh = mesh
+    return entry
 
 
 # ----------------------------------------------------------------------
@@ -307,7 +339,13 @@ def _matcher_mask_dev(entry: _Entry, matchers):
     else:
         mask[: entry.num_series] = True
     any_match = bool(mask.any())
-    dev = jnp.asarray(mask)
+    sh = _series_sharding(getattr(entry, "mesh", None), 1)
+    if sh is not None:
+        import jax
+
+        dev = jax.device_put(mask, sh)
+    else:
+        dev = jnp.asarray(mask)
     if len(entry.match_cache) >= 128:
         entry.match_cache.pop(next(iter(entry.match_cache)))
     entry.match_cache[key] = (dev, any_match)
@@ -332,11 +370,20 @@ def _grouping_dev(entry: _Entry, table, grouping, without: bool):
         and ((nm not in grouping) if without else (nm in grouping))
     ]
     s = entry.num_series
+    sh = _series_sharding(getattr(entry, "mesh", None), 1)
+
+    def put(arr):
+        if sh is not None:
+            import jax
+
+            return jax.device_put(arr, sh)
+        return jnp.asarray(arr)
+
     if not cols or s == 0:
         labels = [{}]
         gid = np.zeros(entry.s_pad, np.int32)
         gid[s:] = 1
-        out = (labels, jnp.asarray(gid), 1)
+        out = (labels, put(gid), 1)
         entry.group_cache[key] = out
         return out
     sub = codes[:s, cols]
@@ -352,7 +399,7 @@ def _grouping_dev(entry: _Entry, table, grouping, without: bool):
     g = len(uniq)
     gid = np.full(entry.s_pad, g, np.int32)
     gid[:s] = inv.astype(np.int32)
-    out = (labels, jnp.asarray(gid), g)
+    out = (labels, put(gid), g)
     if len(entry.group_cache) >= 128:
         entry.group_cache.pop(next(iter(entry.group_cache)))
     entry.group_cache[key] = out
@@ -430,7 +477,10 @@ def try_fast(engine, e, ev):
         fieldname = engine._value_field(table, field_sel)
     except Exception:
         return None
-    entry = _CACHE.get_entry(table, fieldname)
+    mesh = getattr(
+        getattr(engine.instance, "query_engine", None), "mesh", None
+    )
+    entry = _CACHE.get_entry(table, fieldname, mesh=mesh)
     if entry is None:
         _FAST_HITS.labels("fallback").inc()
         return None

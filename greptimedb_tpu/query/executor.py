@@ -525,7 +525,7 @@ class QueryEngine:
         with stats.timed("reduce_ms"):
             results, path = grouped_reduce(
                 specs, values, gid, valid_map, g, ts=ts,
-                prefer_device=self.prefer_device,
+                prefer_device=self.prefer_device, mesh=self.mesh,
             )
         stats.add("agg_groups", g)
         self._record_path("aggregate", path)

@@ -239,7 +239,34 @@ _2_31M = 2**31 - 1
 _FUSED = None
 
 
-def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts):
+def _make_row_put(mesh):
+    """Host->device placement for row-axis arrays: single-device
+    jnp.asarray, or row-axis sharding over the mesh (SURVEY.md §2.7 #2 —
+    data-parallel GROUP BY; XLA inserts the cross-shard collectives for
+    the segment folds)."""
+    import jax
+    import jax.numpy as jnp
+
+    if mesh is None:
+        return jnp.asarray, jnp.asarray
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+
+    s_rows = NamedSharding(mesh, P(AXIS_SHARD))
+    s_stacked = NamedSharding(mesh, P(None, AXIS_SHARD))
+
+    def put1(x):
+        return jax.device_put(np.asarray(x), s_rows)
+
+    def put2(x):
+        return jax.device_put(np.asarray(x), s_stacked)
+
+    return put2, put1
+
+
+def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts,
+                         mesh=None):
     """Single-program GROUP BY. specs: (name, op, vkey|None, q). Returns
     {name: (np values, np valid|None)}."""
     global _FUSED
@@ -250,6 +277,12 @@ def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts):
 
     n = len(gid)
     nb = bucket_size(n)
+    if mesh is not None:
+        from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+
+        shards = mesh.shape[AXIS_SHARD]
+        nb = max(nb, shards)  # bucket sizes are powers of two
+    put2, put1 = _make_row_put(mesh)
     gb = _pad_group_count(g)
     blocks = max(1, min(nb, (1 << 20) // gb))
 
@@ -267,14 +300,14 @@ def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts):
     # stacked dynamic inputs
     vkeys = sorted({vk for _, _, vk, _ in specs if vk is not None})
     vidx = {k: i for i, k in enumerate(vkeys)}
-    d_vals = jnp.asarray(np.stack([
+    d_vals = put2(np.stack([
         pad_to(values[k].astype(np.float32, copy=False), nb)
         for k in vkeys
-    ])) if vkeys else jnp.zeros((1, nb), jnp.float32)
-    d_masks = jnp.asarray(np.stack([
+    ])) if vkeys else put2(np.zeros((1, nb), np.float32))
+    d_masks = put2(np.stack([
         pad_to(m, nb, fill=False) for m in mask_arrays
     ]))
-    d_gid = jnp.asarray(pad_to(gid.astype(np.int32), nb))
+    d_gid = put1(pad_to(gid.astype(np.int32), nb))
     if ts is not None and any(
         op in ("first_value", "last_value") for _, op, _, _ in specs
     ):
@@ -283,8 +316,8 @@ def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts):
         tslo = (rel & _2_31M).astype(np.int32)
     else:
         tshi = tslo = np.zeros(n, np.int32)
-    d_tshi = jnp.asarray(pad_to(tshi, nb))
-    d_tslo = jnp.asarray(pad_to(tslo, nb))
+    d_tshi = put1(pad_to(tshi, nb))
+    d_tslo = put1(pad_to(tslo, nb))
 
     items = tuple(
         (op, vidx[vk] if vk is not None else -1,
@@ -342,6 +375,7 @@ def grouped_reduce(
     *,
     ts: np.ndarray | None = None,
     prefer_device: bool | None = None,
+    mesh=None,
 ) -> tuple[dict, str]:
     """specs: list of (out_name, op, value_key|None, q|None). values: key ->
     per-row array. valid_map: key -> bool array (all-valid if missing).
@@ -363,7 +397,9 @@ def grouped_reduce(
     ):
         path = "host:dtype"
     if path == "device":
-        return _device_reduce_fused(specs, values, gid, valid_map, g, ts), path
+        return _device_reduce_fused(
+            specs, values, gid, valid_map, g, ts, mesh=mesh
+        ), path
     out = {}
     for name, op, vk, q in specs:
         v = values[vk] if vk is not None else None

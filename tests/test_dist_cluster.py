@@ -371,3 +371,58 @@ def test_pushdown_prunes_partitioned_regions(harness, standalone_ref):
     assert got == standalone_ref.sql(sql).rows()
     assert st.counters.get("regions_pruned", 0) == 2
     assert st.counters.get("dist_partial_datanodes", 0) == 1
+
+
+def test_pushdown_multi_region_datanode_partition_prune(tmp_path):
+    """A datanode holding 2+ regions of a partitioned table must not
+    re-prune the shipped subset with GLOBAL partition indices (that
+    silently dropped the second region's rows)."""
+    from greptimedb_tpu.query import stats as qstats
+
+    h = DistHarness(tmp_path, n_datanodes=2)  # 4 partitions over 2 nodes
+    try:
+        fe = h.frontend
+        fe.execute_sql(
+            "create table part (ts timestamp time index, host string "
+            "primary key, v double) partition on columns (host) ("
+            "host < 'h2', host < 'h4', host < 'h6', host >= 'h6')"
+        )
+        values = ", ".join(
+            f"('h{i}', {1_700_000_000_000 + p * 1000}, {i + p})"
+            for p in range(2) for i in range(8)
+        )
+        fe.execute_sql(f"insert into part (host, ts, v) values {values}")
+        table = fe.catalog.table("public", "part")
+        owners = [id(r.client) for r in table.regions]
+        assert len(set(owners)) == 2
+        # h2 -> partition 1, h7 -> partition 3; round-robin puts BOTH on
+        # the same datanode, whose local region list is [r1, r3]. The
+        # old datanode-side re-prune applied GLOBAL keep indices [1, 3]
+        # to that 2-element list, silently dropping partition 1's rows.
+        assert owners[1] == owners[3]
+        sql = ("select host, sum(v) from part "
+               "where host in ('h2', 'h7') group by host order by host")
+        with qstats.collect() as st:
+            got = fe.sql(sql).rows()
+        assert got == [["h2", 5.0], ["h7", 15.0]]
+        assert st.counters.get("regions_pruned", 0) == 2
+        assert not st.counters.get("dist_pushdown_errors")
+    finally:
+        h.close()
+
+
+def test_global_aggregate_all_regions_pruned(harness, standalone_ref):
+    """Pruning every region away must still yield standalone's one-row
+    global aggregate (count=0, NULL extremes)."""
+    fe = harness.frontend
+    for inst in (fe, standalone_ref):
+        inst.execute_sql(
+            "create table p2 (ts timestamp time index, host string "
+            "primary key, v double) partition on columns (host) ("
+            "host < 'm', host >= 'm')"
+        )
+        inst.execute_sql(
+            "insert into p2 (host, ts, v) values ('a', 1000, 1.0)"
+        )
+    sql = "select count(v), min(v), sum(v) from p2 where host = 'a' and host = 'zz'"
+    assert fe.sql(sql).rows() == standalone_ref.sql(sql).rows()

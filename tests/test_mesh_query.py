@@ -130,3 +130,75 @@ def test_cluster_sql_on_mesh(tmp_path, rng, devices):
     assert em.last_exec_path == "device"
     _compare(rh, rm)
     cluster.shutdown()
+
+
+def test_groupby_on_8device_mesh_matches_host(inst, devices):
+    """Plain GROUP BY: the fused reduce program runs row-sharded over
+    the mesh (VERDICT r3 task #2); results must equal the host path."""
+    mesh = M.make_mesh(devices)
+    em = QueryEngine(prefer_device=True, mesh=mesh)
+    eh = QueryEngine(prefer_device=False)
+    q = ("SELECT host, count(u), sum(u), avg(u), min(v), max(v), "
+         "stddev_samp(u) FROM cpu GROUP BY host ORDER BY host")
+    rh = _run(eh, inst, q)
+    rm = _run(em, inst, q)
+    assert em.last_exec_path == "device"
+    _compare(rh, rm)
+
+
+def test_promql_fast_on_8device_mesh_matches_host(tmp_path, rng, devices):
+    """PromQL sum by (dc)(rate(...)): the selector-grid fast path runs
+    series-sharded over the mesh; equality vs the single-device path."""
+    from greptimedb_tpu.parallel import mesh as M2
+    from greptimedb_tpu.promql import fast as F
+    from greptimedb_tpu.promql.engine import PromEngine
+
+    def build(home, mesh):
+        rng = np.random.default_rng(7)  # identical data in both builds
+        i = Standalone(str(home), prefer_device=True, mesh=mesh,
+                       warm_start=False)
+        i.execute_sql(
+            "create table http_requests (ts timestamp time index, "
+            "host string primary key, dc string primary key, "
+            "greptime_value double)"
+        )
+        tab = i.catalog.table("public", "http_requests")
+        n_hosts, t = 24, 120
+        ts = np.tile(np.arange(t) * 10_000, n_hosts).astype(np.int64)
+        hosts = np.repeat(
+            [f"h{k:02d}" for k in range(n_hosts)], t
+        ).astype(object)
+        dcs = np.repeat(
+            [f"dc{k % 3}" for k in range(n_hosts)], t
+        ).astype(object)
+        vals = np.cumsum(rng.random(n_hosts * t), 0)
+        tab.write({"host": hosts, "dc": dcs}, ts,
+                  {"greptime_value": vals})
+        return i
+
+    F.invalidate_cache()
+    mesh = M2.make_mesh(devices)
+    i1 = build(tmp_path / "a", None)
+    im = build(tmp_path / "b", mesh)
+    q = "sum by (dc) (rate(http_requests[2m]))"
+    t0, t1 = 0, 119 * 10_000
+    try:
+        r1, _ = PromEngine(i1).query_range(q, t0, t1, 60_000)
+        F.invalidate_cache()
+        rm, _ = PromEngine(im).query_range(q, t0, t1, 60_000)
+        # the grid really is sharded over 8 devices
+        entry = next(iter(F._CACHE._entries.values()))
+        assert entry.mesh is mesh
+        assert len(entry.vals.devices()) == 8
+        assert [frozenset(lb.items()) for lb in r1.labels] == \
+               [frozenset(lb.items()) for lb in rm.labels]
+        np.testing.assert_allclose(
+            np.where(r1.present, r1.values, 0.0),
+            np.where(rm.present, rm.values, 0.0),
+            rtol=2e-4, atol=1e-3,
+        )
+        assert (r1.present == rm.present).all()
+    finally:
+        F.invalidate_cache()
+        i1.close()
+        im.close()
