@@ -54,6 +54,9 @@ def build_parser() -> argparse.ArgumentParser:
         start.add_argument("--datanode-addrs", default=None,
                            help="comma-separated datanode flight "
                                 "addresses (frontend)")
+        start.add_argument("--flownode-addr", default=None,
+                           help="flownode flight address for flow "
+                                "mirroring (frontend)")
         start.add_argument("--node-id", type=int, default=None)
         start.add_argument("--no-flows", action="store_true")
 
@@ -117,6 +120,7 @@ def main(argv=None):
                 args.datanode_addrs.split(",")
                 if args.datanode_addrs else None
             ),
+            "frontend.flownode_addr": args.flownode_addr,
             "flow.enable": False if args.no_flows else None,
         },
     )
@@ -396,7 +400,10 @@ def _start_frontend(opts):
         # datanode processes, full SQL engine here (dist/frontend.py)
         from greptimedb_tpu.dist.frontend import DistInstance
 
-        inst = DistInstance(opts.get("data_home"), meta_addr)
+        inst = DistInstance(
+            opts.get("data_home"), meta_addr,
+            flownode_addr=opts.get("frontend.flownode_addr") or None,
+        )
         target = f"metasrv {meta_addr}"
     else:
         # legacy single-datanode proxy: forward statements over Flight
@@ -433,6 +440,26 @@ def _start_metasrv(opts):
 
 
 def _start_flownode(opts):
+    meta_addr = opts.get("metasrv.addr") or ""
+    if meta_addr:
+        # distributed flownode: shared-kv catalog (source/sink tables
+        # are RemoteTables over the datanodes), flows local, mirrored
+        # deltas arrive over Flight (dist/frontend.py flow mirroring)
+        from greptimedb_tpu.dist.frontend import DistInstance
+
+        inst = DistInstance(opts.get("data_home"), meta_addr)
+        inst.enable_flows(
+            tick_interval_s=opts.get("flow.tick_interval_s", 1.0)
+        )
+        closers = [inst.close]
+        _flight_server(inst, opts, closers)
+        server = _http_server(inst, opts, closers)
+        print(
+            f"greptimedb-tpu flownode (dist, metasrv {meta_addr}) "
+            f"flight on {opts.get('grpc.addr')}", flush=True,
+        )
+        _telemetry(opts, closers, mode="flownode")
+        return _serve_until_signal(closers)
     inst = _make_instance(opts)   # flows on by default
     closers = [inst.close]
     server = _http_server(inst, opts, closers)

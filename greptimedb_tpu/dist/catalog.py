@@ -3,15 +3,19 @@ allocated across datanode processes.
 
 Counterpart of the reference's kv-backed catalog + DDL procedures
 (/root/reference/src/catalog/src/kvbackend/manager.rs,
-src/common/meta/src/ddl/create_table.rs): CREATE TABLE allocates region
-routes through the metasrv selector, opens each region on its owning
-datanode over Flight, and persists the table info in the shared kv so
-any frontend can assemble the table.
+src/common/meta/src/key/): every database / table / view is its OWN kv
+key, so concurrent writers (frontends running DDL, a flownode creating
+its sink table) never clobber each other's entries — only same-name
+writes race, matching the reference's per-key table metadata. Table ids
+allocate through a CAS counter. CREATE TABLE allocates region routes
+through the metasrv selector and opens each region on its owning
+datanode over Flight.
 """
 
 from __future__ import annotations
 
 import json
+import time
 
 from greptimedb_tpu.catalog.manager import (
     DEFAULT_SCHEMA,
@@ -27,12 +31,19 @@ from greptimedb_tpu.dist.remote import (
     remote_regions_for,
 )
 from greptimedb_tpu.errors import (
+    DatabaseNotFoundError,
     InvalidArgumentError,
+    TableAlreadyExistsError,
     TableNotFoundError,
     UnsupportedError,
 )
 
-CATALOG_KEY = "__catalog"
+DB_PREFIX = "__cat/db/"
+TABLE_PREFIX = "__cat/table/"
+VIEW_PREFIX = "__cat/view/"
+NEXT_ID_KEY = "__cat/next_id"
+
+_MISS_REFRESH_INTERVAL_S = 2.0
 
 
 class DistCatalogManager(CatalogManager):
@@ -41,6 +52,7 @@ class DistCatalogManager(CatalogManager):
     def __init__(self, engine, meta: MetaClient):
         self.meta = meta
         self._clients: dict[int, DatanodeClient] = {}
+        self._last_miss_refresh = 0.0
         # base __init__ runs _load(), which needs self.meta/_clients
         super().__init__(engine)
 
@@ -58,44 +70,158 @@ class DistCatalogManager(CatalogManager):
         return cli
 
     # ------------------------------------------------------------------
-    # persistence: the shared kv instead of the local object store
+    # persistence: one kv key per database / table / view
     # ------------------------------------------------------------------
     def _load(self):
-        raw = self.meta.kv_get(CATALOG_KEY)
-        if raw is None:
-            return
-        doc = json.loads(raw)
-        self._next_table_id = doc.get("next_table_id", 1024)
-        self._views = {
-            db: dict(views) for db, views in doc.get("views", {}).items()
-        }
-        for db_name, tables in doc.get("databases", {}).items():
-            db = self._databases.setdefault(db_name, {})
-            infos = [TableInfo.from_json(t) for t in tables]
-            for info in infos:
-                # ids advance BEFORE any open: a mid-load create must
-                # never reuse a persisted table's id
-                self._next_table_id = max(
-                    self._next_table_id, info.table_id + 1
-                )
-            # physical (mito) first so logical metric tables resolve
-            # their shared physical table without creating a duplicate
-            for info in sorted(infos, key=lambda i: i.engine == "metric"):
-                try:
-                    db[info.name] = self._open_table(info)
-                except Exception as e:  # noqa: BLE001 - startup isolation
-                    db[info.name] = _BrokenTable(info, e)
+        for key, _ in self.meta.kv_range(DB_PREFIX):
+            self._databases.setdefault(key[len(DB_PREFIX):], {})
+        for key, raw in self.meta.kv_range(VIEW_PREFIX):
+            db, _, name = key[len(VIEW_PREFIX):].partition("/")
+            self._views.setdefault(db, {})[name] = raw
+        infos = []
+        for _key, raw in self.meta.kv_range(TABLE_PREFIX):
+            info = TableInfo.from_json(json.loads(raw))
+            infos.append(info)
+            # ids advance BEFORE any open: a mid-load create must never
+            # reuse a persisted table's id
+            self._next_table_id = max(
+                self._next_table_id, info.table_id + 1
+            )
+        # physical (mito) first so logical metric tables resolve their
+        # shared physical table without creating a duplicate
+        for info in sorted(infos, key=lambda i: i.engine == "metric"):
+            db = self._databases.setdefault(info.database, {})
+            try:
+                db[info.name] = self._open_table(info)
+            except Exception as e:  # noqa: BLE001 - startup isolation
+                db[info.name] = _BrokenTable(info, e)
 
     def _persist(self):
-        doc = {
-            "next_table_id": self._next_table_id,
-            "databases": {
-                db: [t.info.to_json() for t in tables.values()]
-                for db, tables in self._databases.items()
-            },
-            "views": {db: dict(v) for db, v in self._views.items() if v},
-        }
-        self.meta.kv_put(CATALOG_KEY, json.dumps(doc))
+        # whole-catalog writes would lose other processes' concurrent
+        # DDL, so every mutator here overrides the base and persists
+        # its OWN key. The only base caller left is __init__'s
+        # public-database seeding, which this covers.
+        self.meta.kv_put(DB_PREFIX + DEFAULT_SCHEMA, "1")
+
+    def _put_table(self, info: TableInfo):
+        self.meta.kv_put(
+            f"{TABLE_PREFIX}{info.database}/{info.name}",
+            json.dumps(info.to_json()),
+        )
+
+    def _del_table(self, database: str, name: str):
+        self.meta.kv_delete(f"{TABLE_PREFIX}{database}/{name}")
+
+    def _alloc_table_id(self) -> int:
+        while True:
+            cur = self.meta.kv_get(NEXT_ID_KEY)
+            nxt = max(int(cur) if cur else 1024, self._next_table_id)
+            if self.meta.kv_cas(NEXT_ID_KEY, cur, str(nxt + 1)):
+                self._next_table_id = nxt + 1
+                return nxt
+
+    # ------------------------------------------------------------------
+    # databases + views (per-key persistence)
+    # ------------------------------------------------------------------
+    def create_database(self, name: str, *, if_not_exists: bool = False):
+        with self._lock:
+            if name in self._databases:
+                if if_not_exists:
+                    return
+                raise InvalidArgumentError(
+                    f"database already exists: {name}"
+                )
+            self._databases[name] = {}
+            self.meta.kv_put(DB_PREFIX + name, "1")
+
+    def drop_database(self, name: str, *, if_exists: bool = False):
+        with self._lock:
+            if name not in self._databases:
+                if if_exists:
+                    return
+                raise DatabaseNotFoundError(f"database not found: {name}")
+            if name == DEFAULT_SCHEMA:
+                raise InvalidArgumentError(
+                    "cannot drop the public database"
+                )
+            for tname in list(self._databases[name]):
+                self.drop_table(name, tname)
+            del self._databases[name]
+            for vname in list(self._views.pop(name, {})):
+                self.meta.kv_delete(f"{VIEW_PREFIX}{name}/{vname}")
+            self.meta.kv_delete(DB_PREFIX + name)
+
+    def create_view(self, database: str, name: str, sql_text: str,
+                    *, or_replace: bool = False):
+        with self._lock:
+            self._db(database)
+            if name in self._databases.get(database, {}):
+                raise InvalidArgumentError(
+                    f"a table named {name!r} already exists"
+                )
+            views = self._views.setdefault(database, {})
+            if name in views and not or_replace:
+                raise InvalidArgumentError(f"view already exists: {name}")
+            views[name] = sql_text
+            self.meta.kv_put(f"{VIEW_PREFIX}{database}/{name}", sql_text)
+
+    def drop_view(self, database: str, name: str, *,
+                  if_exists: bool = False):
+        with self._lock:
+            views = self._views.get(database, {})
+            if name not in views:
+                if if_exists:
+                    return
+                raise TableNotFoundError(f"view not found: {name}")
+            del views[name]
+            self.meta.kv_delete(f"{VIEW_PREFIX}{database}/{name}")
+
+    # ------------------------------------------------------------------
+    # tables
+    # ------------------------------------------------------------------
+    def create_table(self, database: str, name: str, schema, *,
+                     engine: str = "mito", options: dict | None = None,
+                     num_regions: int = 1, if_not_exists: bool = False,
+                     partition: dict | None = None):
+        with self._lock:
+            db = self._db(database)
+            if name in self._views.get(database, {}):
+                raise InvalidArgumentError(
+                    f"a view named {name!r} already exists"
+                )
+            if name in db:
+                if if_not_exists:
+                    return db[name]
+                raise TableAlreadyExistsError(
+                    f"table already exists: {name}"
+                )
+            schema.time_index  # raises unless a TIME INDEX exists
+            info = TableInfo(
+                table_id=self._alloc_table_id(),
+                name=name, database=database, schema=schema,
+                engine=engine, options=options or {},
+                num_regions=max(1, num_regions), partition=partition,
+                created_ms=int(time.time() * 1000),
+            )
+            table = self._open_table(info)
+            db[name] = table
+            self._put_table(info)
+            return table
+
+    def rename_table(self, database: str, old: str, new: str):
+        with self._lock:
+            db = self._db(database)
+            if new in db:
+                raise TableAlreadyExistsError(
+                    f"table already exists: {new}"
+                )
+            table = db.pop(old, None)
+            if table is None:
+                raise TableNotFoundError(f"table not found: {old}")
+            table.info.name = new
+            db[new] = table
+            self._del_table(database, old)
+            self._put_table(table.info)
 
     # ------------------------------------------------------------------
     # table assembly: allocate + open regions across datanodes
@@ -142,7 +268,7 @@ class DistCatalogManager(CatalogManager):
             if table.info.engine == "metric":
                 # logical drop only: the physical regions are SHARED
                 # with every other metric table on this database
-                self._persist()
+                self._del_table(database, name)
                 return
             rids = table.info.region_ids()
             for r in getattr(table, "regions", []):
@@ -154,7 +280,7 @@ class DistCatalogManager(CatalogManager):
                 self.meta.remove_routes(rids)
             except Exception:  # noqa: BLE001
                 pass
-            self._persist()
+            self._del_table(database, name)
 
     # ------------------------------------------------------------------
     # alter: fan the region-level change to owning datanodes
@@ -188,7 +314,7 @@ class DistCatalogManager(CatalogManager):
                 candidate = table.info.schema.with_column(col)
                 ME.widen_physical_for(self, database, physical, candidate)
                 table.info.schema = candidate
-                self._persist()
+                self._put_table(table.info)
                 return
             table.info.schema = table.info.schema.with_column(col)
             op = ("add_tag" if col.semantic_type == SemanticType.TAG
@@ -199,7 +325,7 @@ class DistCatalogManager(CatalogManager):
                     r.meta.tag_names.append(col.name)
                 else:
                     r.meta.field_names.append(col.name)
-            self._persist()
+            self._put_table(table.info)
 
     def alter_drop_column(self, database: str, name: str, col_name: str):
         with self._lock:
@@ -213,7 +339,7 @@ class DistCatalogManager(CatalogManager):
             if table.info.engine == "metric":
                 # logical drop only: the physical column is shared with
                 # every other metric table
-                self._persist()
+                self._put_table(table.info)
                 return
             for r in table.regions:
                 r.client.alter_region(
@@ -221,9 +347,36 @@ class DistCatalogManager(CatalogManager):
                 )
                 if col_name in r.meta.field_names:
                     r.meta.field_names.remove(col_name)
-            self._persist()
+            self._put_table(table.info)
 
     # ------------------------------------------------------------------
+    def refresh(self) -> None:
+        """Re-read the shared kv catalog: pick up tables/views created
+        by OTHER frontends since this process loaded (flownodes see
+        source/sink tables appear; region proxies are cheap to
+        rebuild)."""
+        with self._lock:
+            self._databases = {}
+            self._views = {}
+            self._load()
+            if DEFAULT_SCHEMA not in self._databases:
+                self._databases[DEFAULT_SCHEMA] = {}
+
+    def table(self, database: str, name: str):
+        """Base lookup, refreshing from the shared kv on a miss (rate-
+        limited): another process — frontend DDL, a flownode creating
+        its sink — may have created the table after this catalog
+        loaded."""
+        try:
+            return super().table(database, name)
+        except (TableNotFoundError, DatabaseNotFoundError):
+            now = time.monotonic()
+            if now - self._last_miss_refresh < _MISS_REFRESH_INTERVAL_S:
+                raise
+            self._last_miss_refresh = now
+            self.refresh()
+            return super().table(database, name)
+
     def close(self):
         for cli in self._clients.values():
             cli.close()

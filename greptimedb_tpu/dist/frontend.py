@@ -21,7 +21,8 @@ from greptimedb_tpu.storage.engine import EngineConfig
 
 class DistInstance(Standalone):
     def __init__(self, data_home: str, metasrv_addr: str, *,
-                 prefer_device: bool | None = None):
+                 prefer_device: bool | None = None,
+                 flownode_addr: str | None = None):
         # the local engine only backs frontend-local scratch (scripts,
         # slow-query log); table data never lands here
         super().__init__(
@@ -35,9 +36,139 @@ class DistInstance(Standalone):
         self.meta = MetaClient(metasrv_addr)
         self.catalog = DistCatalogManager(self.engine, self.meta)
         self.distributed = True
+        self.flownode_addr = flownode_addr
+        self._flow_client = None
+        self._flow_sources: set[tuple[str, str]] = set()
+        self._flow_sources_at = 0.0
+
+    def _flownode(self):
+        if self.flownode_addr is None:
+            return None
+        if self._flow_client is None:
+            from greptimedb_tpu.dist.client import DatanodeClient
+
+            self._flow_client = DatanodeClient(self.flownode_addr)
+        return self._flow_client
+
+    # ------------------------------------------------------------------
+    # flow statements forward to the flownode process (the reference's
+    # frontend -> flownode DDL path, src/operator/src/flow.rs)
+    # ------------------------------------------------------------------
+    def _create_flow(self, stmt, ctx):
+        from greptimedb_tpu.errors import UnsupportedError
+        from greptimedb_tpu.flow.manager import _render_flow_sql
+        from greptimedb_tpu.instance import Output
+
+        if self.flows is not None:
+            # flows enabled on THIS process: we ARE the flownode
+            return super()._create_flow(stmt, ctx)
+        cli = self._flownode()
+        if cli is None:
+            raise UnsupportedError(
+                "this frontend has no flownode configured "
+                "(--flownode-addr)"
+            )
+        cli.action("create_flow", {
+            "sql": _render_flow_sql(stmt),
+            "db": getattr(ctx, "database", "public"),
+        })
+        self._flow_sources_at = 0.0  # re-fetch the source registry
+        return Output.rows(0)
+
+    def _drop_flow(self, stmt, ctx):
+        from greptimedb_tpu.errors import UnsupportedError
+        from greptimedb_tpu.instance import Output
+
+        if self.flows is not None:
+            return super()._drop_flow(stmt, ctx)
+        cli = self._flownode()
+        if cli is None:
+            raise UnsupportedError("no flownode configured")
+        cli.action("drop_flow", {
+            "name": stmt.name, "if_exists": stmt.if_exists,
+        })
+        self._flow_sources_at = 0.0
+        return Output.rows(0)
+
+    def _show_flows(self):
+        from greptimedb_tpu.instance import _result_from_lists
+
+        if self.flows is not None:
+            return super()._show_flows()
+        cli = self._flownode()
+        if cli is None:
+            return _result_from_lists(["Flows"], [[]])
+        infos = cli.action("flow_infos").get("flows", [])
+        return _result_from_lists(
+            ["Flows"], [[f["name"] for f in infos]]
+        )
+
+    # ------------------------------------------------------------------
+    # mirroring: source-table inserts stream to the flownode
+    # (src/operator/src/insert.rs:284-317 mirror path)
+    # ------------------------------------------------------------------
+    def _mirror_sources(self) -> set[tuple[str, str]]:
+        import time
+
+        cli = self._flownode()
+        if cli is None:
+            return set()
+        now = time.monotonic()
+        if now - self._flow_sources_at > 5.0:
+            try:
+                self._flow_sources = {
+                    (db, t) for db, t in
+                    cli.action("flow_sources").get("sources", [])
+                }
+            except Exception:  # noqa: BLE001 - flownode may be down
+                self._flow_sources = set()
+            self._flow_sources_at = now
+        return self._flow_sources
+
+    def _notify_flows(self, db, name, table, data, valid):
+        # local in-process flows still work (flows enabled directly on
+        # this instance, e.g. tests)
+        super()._notify_flows(db, name, table, data, valid)
+        if (db, name) not in self._mirror_sources():
+            return
+        # the user's INSERT has already durably landed on the datanodes;
+        # NOTHING in the mirror (batch conversion included) may fail it
+        try:
+            import numpy as np
+            import pyarrow as pa
+            import pyarrow.flight as flight
+
+            arrays = []
+            names = []
+            for cname, vals in data.items():
+                vals = np.asarray(vals)
+                v = valid.get(cname) if valid else None
+                mask = None if v is None or v.all() else ~np.asarray(v)
+                if vals.dtype == object:
+                    arrays.append(pa.array(vals, pa.string(), mask=mask))
+                else:
+                    arrays.append(pa.array(vals, mask=mask))
+                names.append(cname)
+            batch = pa.RecordBatch.from_arrays(arrays, names=names)
+            cli = self._flownode()
+            descriptor = flight.FlightDescriptor.for_path(
+                f"flow_mirror:{db}.{name}"
+            )
+            writer, _ = cli._client().do_put(descriptor, batch.schema)
+            writer.write_batch(batch)
+            writer.close()
+        except Exception:  # noqa: BLE001 - mirroring is best-effort
+            from greptimedb_tpu.telemetry.metrics import global_registry
+
+            global_registry.counter(
+                "gtpu_flow_mirror_errors_total",
+                "failed source-delta mirrors to the flownode",
+            ).inc()
 
     def close(self):
         try:
+            if self._flow_client is not None:
+                self._flow_client.close()
             self.catalog.close()
         finally:
             super().close()

@@ -151,6 +151,14 @@ class FlowManager:
         with self._lock:
             return self._flows.get(name)
 
+    def flow_sources(self) -> list[tuple[str, str]]:
+        """(db, source_table) pairs that feed some flow — what a
+        frontend needs to decide which inserts to mirror."""
+        with self._lock:
+            return sorted({
+                (f.db, f.source_table) for f in self._flows.values()
+            })
+
     def flow_infos(self) -> list[dict]:
         with self._lock:
             return [
@@ -697,9 +705,12 @@ def _is_time_bucket(e: A.Expr, ts_name: str) -> bool:
 
 
 def _render_flow_sql(stmt: A.CreateFlow) -> str:
-    """Re-render CREATE FLOW for persistence (the original text is not
-    kept by the parser)."""
-    parts = [f"CREATE FLOW IF NOT EXISTS {stmt.name} SINK TO "
+    """Re-render CREATE FLOW for persistence/forwarding (the original
+    text is not kept by the parser). IF NOT EXISTS renders only when
+    the statement had it — a forwarded duplicate-name CREATE must still
+    raise on the flownode."""
+    ine = "IF NOT EXISTS " if stmt.if_not_exists else ""
+    parts = [f"CREATE FLOW {ine}{stmt.name} SINK TO "
              f"{stmt.sink_table}"]
     if stmt.expire_after_s is not None:
         parts.append(f"EXPIRE AFTER '{stmt.expire_after_s}s'")

@@ -223,6 +223,9 @@ class FlightServer(flight.FlightServerBase):
         return [flight.Result(json.dumps(out or {}).encode())]
 
     def _do_action(self, kind: str, body: dict) -> dict | None:
+        if kind in ("create_flow", "drop_flow", "flow_infos",
+                    "flow_sources"):
+            return self._flow_action(kind, body)
         rs = self._region_server()
         if kind == "open_region":
             rs.open_region(body["meta"])
@@ -250,6 +253,63 @@ class FlightServer(flight.FlightServerBase):
         else:
             raise flight.FlightServerError(f"unknown action: {kind}")
         return None
+
+    # ---- flownode service (wire-level flow DDL + source registry) -----
+    def _flow_action(self, kind: str, body: dict) -> dict:
+        inst = self.instance
+        flows = getattr(inst, "flows", None)
+        if flows is None:
+            raise flight.FlightServerError(
+                "this node does not run flows"
+            )
+        if kind == "create_flow":
+            refresh = getattr(inst.catalog, "refresh", None)
+            if refresh is not None:
+                refresh()  # the source table may be newer than our load
+            outs = inst.execute_sql(
+                body["sql"], QueryContext(database=body.get("db")
+                                          or "public")
+            )
+            return {"affected": outs[-1].affected_rows or 0}
+        if kind == "drop_flow":
+            flows.drop_flow(body["name"],
+                            if_exists=bool(body.get("if_exists")))
+            return {}
+        if kind == "flow_infos":
+            return {"flows": flows.flow_infos()}
+        if kind == "flow_sources":
+            return {"sources": flows.flow_sources()}
+        raise flight.FlightServerError(f"unknown flow action: {kind}")
+
+    def _do_put_flow_mirror(self, name: str, reader):
+        """Mirrored source-table delta batches from a frontend (the
+        reference's frontend->flownode insert mirroring,
+        /root/reference/src/operator/src/insert.rs:284-317)."""
+        inst = self.instance
+        if getattr(inst, "flows", None) is None:
+            raise flight.FlightServerError("this node does not run flows")
+        db, _, tname = name.partition(".")
+        # DistCatalogManager.table() refreshes from the shared kv on a
+        # miss, so a just-created source table resolves here
+        table = inst.catalog.table(db, tname)
+        for chunk in reader:
+            if chunk.data is None:
+                continue
+            batch = chunk.data
+            data: dict = {}
+            valid: dict = {}
+            for i in range(batch.num_columns):
+                cname = batch.schema.field(i).name
+                arr = batch.column(i)
+                if pa.types.is_timestamp(arr.type):
+                    arr = arr.cast(pa.timestamp("ms"))
+                hc = HostColumn.from_arrow(cname, arr)
+                data[cname] = hc.values
+                valid[cname] = hc.valid_mask
+            try:
+                inst.flows.on_insert(db, tname, table, data, valid)
+            except Exception as e:  # noqa: BLE001 - RPC boundary
+                raise flight.FlightServerError(str(e)) from e
 
     def list_actions(self, context):
         return [
@@ -287,6 +347,8 @@ class FlightServer(flight.FlightServerBase):
         name = path[0].decode("utf-8")
         if name == "region_write":
             return self._do_put_regions(reader)
+        if name.startswith("flow_mirror:"):
+            return self._do_put_flow_mirror(name[12:], reader)
         inst = self.instance
         db = "public"
         if "." in name:

@@ -426,3 +426,132 @@ def test_global_aggregate_all_regions_pruned(harness, standalone_ref):
         )
     sql = "select count(v), min(v), sum(v) from p2 where host = 'a' and host = 'zz'"
     assert fe.sql(sql).rows() == standalone_ref.sql(sql).rows()
+
+
+@pytest.fixture()
+def flow_harness(tmp_path):
+    """DistHarness + a flownode process wired for mirroring."""
+    h = DistHarness(tmp_path)
+    fn_inst = DistInstance(str(tmp_path / "flownode"), h.meta_addr,
+                           prefer_device=False)
+    fn_inst.enable_flows()
+    fn_inst.flows.tick_interval_s = 3600  # manual flushes in tests
+    fn_flight = FlightFrontend(fn_inst, port=0).start()
+    h.frontend.flownode_addr = f"127.0.0.1:{fn_flight.server.port}"
+    yield h, fn_inst
+    fn_flight.close()
+    fn_inst.close()
+    h.close()
+
+
+def test_wire_level_flow_mirroring(flow_harness, tmp_path):
+    """The reference's frontend->flownode loop over real sockets
+    (src/operator/src/insert.rs:284-317, src/flow/src/adapter.rs):
+    CREATE FLOW forwards to the flownode, source inserts mirror as
+    Flight batches, the flownode writes the sink through the shared
+    catalog — and the result is served by a DIFFERENT process."""
+    h, fn_inst = flow_harness
+    fe = h.frontend
+    fe.execute_sql(
+        "create table requests (host string primary key, "
+        "latency double, ts timestamp time index) "
+        "with (num_regions = 3)"
+    )
+    fe.execute_sql(
+        "create flow req_stats sink to req_summary as "
+        "select date_bin('1 minute', ts) as time_window, host, "
+        "count(*) as total, avg(latency) as avg_latency "
+        "from requests group by time_window, host"
+    )
+    # the flow lives on the flownode, visible through the frontend
+    assert fe.sql("show flows").rows() == [["req_stats"]]
+    assert fn_inst.flows.flow_names() == ["req_stats"]
+
+    fe.execute_sql(
+        "insert into requests values "
+        "('h1', 10.0, 1700000000000), "
+        "('h1', 20.0, 1700000010000), "
+        "('h2', 30.0, 1700000020000)"
+    )
+    fn_inst.flows.flush_all()
+
+    # sink rows were written through the flownode's dist catalog onto
+    # the datanodes; a SEPARATE frontend process serves them
+    fe2 = DistInstance(str(tmp_path / "fe2"), h.meta_addr,
+                       prefer_device=False)
+    try:
+        rows = fe2.sql(
+            "select host, total, avg_latency from req_summary "
+            "order by host"
+        ).rows()
+        assert rows == [["h1", 2, 15.0], ["h2", 1, 30.0]]
+    finally:
+        fe2.close()
+
+    # incremental: more mirrored deltas fold into the same windows
+    fe.execute_sql(
+        "insert into requests values ('h1', 60.0, 1700000030000)"
+    )
+    fn_inst.flows.flush_all()
+    rows = fe.sql(
+        "select host, total, avg_latency from req_summary "
+        "order by host"
+    ).rows()
+    assert rows == [["h1", 3, 30.0], ["h2", 1, 30.0]]
+
+    # DROP FLOW forwards too
+    fe.execute_sql("drop flow req_stats")
+    assert fn_inst.flows.flow_names() == []
+
+
+def test_concurrent_catalog_writers_do_not_clobber(harness, tmp_path):
+    """Per-key kv catalog: a writer with a stale in-memory view must not
+    erase tables other processes created after its load (the old
+    whole-doc persist lost them)."""
+    fe = harness.frontend
+    fe2 = DistInstance(str(tmp_path / "fe2"), harness.meta_addr,
+                       prefer_device=False)  # loads an empty catalog
+    try:
+        fe.execute_sql(
+            "create table from_fe (ts timestamp time index, v double)"
+        )
+        # fe2's memory predates from_fe; its own DDL must not erase it
+        fe2.execute_sql(
+            "create table from_fe2 (ts timestamp time index, v double)"
+        )
+        fe3 = DistInstance(str(tmp_path / "fe3"), harness.meta_addr,
+                           prefer_device=False)
+        try:
+            names = fe3.catalog.table_names("public")
+            assert "from_fe" in names and "from_fe2" in names
+        finally:
+            fe3.close()
+        # distinct CAS-allocated table ids even across stale writers
+        t1 = fe3_id = None
+        t1 = fe.catalog.table("public", "from_fe").info.table_id
+        t2 = fe2.catalog.table("public", "from_fe2").info.table_id
+        assert t1 != t2
+    finally:
+        fe2.close()
+
+
+def test_duplicate_flow_name_raises_through_the_wire(flow_harness):
+    h, fn_inst = flow_harness
+    fe = h.frontend
+    fe.execute_sql(
+        "create table src (ts timestamp time index, v double)"
+    )
+    fe.execute_sql(
+        "create flow f1 sink to s1 as select date_bin('1 minute', ts) "
+        "as w, count(*) as n from src group by w"
+    )
+    with pytest.raises(Exception, match="exists"):
+        fe.execute_sql(
+            "create flow f1 sink to s2 as select date_bin('1 minute', "
+            "ts) as w, sum(v) as n from src group by w"
+        )
+    # IF NOT EXISTS still no-ops quietly
+    fe.execute_sql(
+        "create flow if not exists f1 sink to s2 as select "
+        "date_bin('1 minute', ts) as w, sum(v) as n from src group by w"
+    )

@@ -127,10 +127,19 @@ def topology(tmp_path):
         time.sleep(0.2)
     assert len(peers) >= 3, f"datanodes never registered: {peers}"
 
+    flow_port = _free_port()
+    spawn(["flownode", "start", "--data-home", str(tmp_path / "flow"),
+           "--flight-addr", f"127.0.0.1:{flow_port}",
+           "--metasrv-addr", f"127.0.0.1:{meta_port}",
+           "--http-addr", "", "--mysql-addr", "", "--postgres-addr",
+           ""], "flownode")
+    _wait_port(flow_port)
+
     fe_port = _free_port()
     spawn(["frontend", "start", "--data-home", str(tmp_path / "fe"),
            "--http-addr", f"127.0.0.1:{fe_port}",
            "--metasrv-addr", f"127.0.0.1:{meta_port}",
+           "--flownode-addr", f"127.0.0.1:{flow_port}",
            "--mysql-addr", "", "--postgres-addr", "", "--flight-addr",
            ""], "frontend")
     _wait_http(f"127.0.0.1:{fe_port}", path="/health")
@@ -188,3 +197,36 @@ def test_multiprocess_distributed_query(topology):
         ):
             spread += 1
     assert spread >= 2
+
+
+def test_multiprocess_flow_mirroring(topology):
+    """Insert via the frontend process; the flow result appears in a
+    sink table computed by the SEPARATE flownode process."""
+    fe = topology["frontend"]
+    _sql(fe, "create table reqs (host string primary key, "
+             "latency double, ts timestamp time index) "
+             "with (num_regions = 3)")
+    _sql(fe, "create flow lat_stats sink to lat_summary as "
+             "select date_bin('1 minute', ts) as w, host, "
+             "count(*) as total, avg(latency) as avg_lat "
+             "from reqs group by w, host")
+    doc = _sql(fe, "show flows")
+    assert _rows(doc) == [["lat_stats"]]
+    _sql(fe, "insert into reqs values "
+             "('a', 10.0, 1700000000000), ('a', 30.0, 1700000010000), "
+             "('b', 50.0, 1700000020000)")
+    # the flownode ticks every second; poll the sink via the frontend
+    deadline = time.time() + 60
+    rows = []
+    while time.time() < deadline:
+        try:
+            rows = _rows(_sql(
+                fe, "select host, total, avg_lat from lat_summary "
+                    "order by host"
+            ))
+            if len(rows) == 2:
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert rows == [["a", 2, 20.0], ["b", 1, 50.0]]
