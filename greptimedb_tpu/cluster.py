@@ -190,11 +190,18 @@ class Cluster:
         region.flush()
 
     def upgrade_region_on(self, node_id: int, region_id: int) -> None:
-        region = self.datanodes[node_id].region(region_id)
+        dn = self.datanodes[node_id]
+        if not dn.has_region(region_id):
+            # crash-resume: the candidate open was in-memory only and a
+            # restart lost it; re-opening from the shared store (+ WAL
+            # replay) is exactly the open_candidate step re-done
+            dn.open_region(self._region_meta(region_id), writable=True)
+            return
+        region = dn.region(region_id)
         # re-open to pick up SSTs flushed by the downgrade step
         meta = region.meta
-        self.datanodes[node_id].close_region(region_id)
-        self.datanodes[node_id].open_region(meta, writable=True)
+        dn.close_region(region_id)
+        dn.open_region(meta, writable=True)
 
     def close_region_on(self, node_id: int, region_id: int) -> None:
         dn = self.datanodes.get(node_id)
