@@ -118,6 +118,10 @@ class _Entry:
     # fields whose "n" state IS entry.nrow (every row valid): stored and
     # transferred once, aliased everywhere else
     n_aliased: set = dc_field(default_factory=set)
+    # static program specs this entry has executed (insertion-ordered:
+    # dict keys); persisted so a restart can precompile them during
+    # warm (cold-start killer)
+    program_specs: dict = dc_field(default_factory=dict)
 
     def recount_bytes(self) -> int:
         per = self.num_series * self.nb * 4
@@ -741,6 +745,101 @@ def load_entry_snapshot(table, r0: int, align_to: int, mesh=None,
     return None
 
 
+def _program_specs_path(entry: _Entry, region) -> str:
+    return (f"{region.prefix}/{_SNAP_DIRNAME}/"
+            f"programs_{entry.res}_{entry.phase}.json")
+
+
+def _persist_program_specs(entry: _Entry, table) -> None:
+    """Record the static jit specs this entry has served (capped), so a
+    restarted process can precompile them during warm — the first query
+    after restore then pays steady-state latency, not trace + XLA
+    compile-cache load (VERDICT r3 cold-start task)."""
+    if len(table.regions) != 1:
+        return
+    import json as _json
+
+    region = table.regions[0]
+    # most-RECENT 8 (insertion order): the specs a restart will actually
+    # be asked for again
+    specs = list(entry.program_specs)[-8:]
+    doc = [
+        {"stride": st, "n_steps": ns, "g": g, "fold": fo,
+         "nanenc": ne, "items": [list(it) for it in items]}
+        for st, ns, g, fo, ne, items in specs
+    ]
+    try:
+        region.store.write(
+            _program_specs_path(entry, region),
+            _json.dumps(doc).encode(),
+        )
+    except Exception:  # noqa: BLE001 - advisory metadata only
+        pass
+
+
+def precompile_programs(entry: _Entry, table) -> int:
+    """Re-invoke the range program for every persisted spec with the
+    restored grids (values are irrelevant — static spec + array
+    shapes/dtypes pin the XLA program), so the compilations land in the
+    jit cache before the first real query. Returns programs compiled."""
+    if len(table.regions) != 1:
+        return 0
+    import json as _json
+
+    import jax.numpy as jnp
+
+    region = table.regions[0]
+    try:
+        raw = region.store.read(_program_specs_path(entry, region))
+        doc = _json.loads(raw)
+    except Exception:  # noqa: BLE001 - no specs file: nothing to do
+        return 0
+    # the prelude program runs before every query; compile it too (the
+    # matcher-less variant the flagship shape uses)
+    try:
+        run_prelude(entry, None, -(2**31) + 1, 2**31 - 1)
+    except Exception:  # noqa: BLE001
+        pass
+    program = get_program()
+    _, put1 = _make_put(getattr(entry, "mesh", None))
+    done = 0
+    for s in doc:
+        try:
+            items = tuple(
+                (op, int(w), fname) for op, w, fname in s["items"]
+            )
+            arrs = {}
+            usable = True
+            for _op, _w, fname in items:
+                if fname not in entry.fields:
+                    usable = False
+                    break
+                d = arrs.setdefault(fname, {})
+                for bk in _STATE_KEYS[_op]:
+                    if bk not in entry.fields[fname]:
+                        usable = False
+                        break
+                    d[bk] = entry.fields[fname][bk]
+            if not usable:
+                continue
+            spec = (int(s["stride"]), int(s["n_steps"]), int(s["g"]),
+                    bool(s["fold"]), bool(s["nanenc"]), items)
+            out = program(
+                arrs,
+                put1(np.zeros(entry.num_series, np.int32)),
+                put1(np.ones(entry.num_series, bool)),
+                jnp.int32(0), jnp.int32(-(2**31) + 1),
+                jnp.int32(2**31 - 1),
+                spec=spec,
+            )
+            out.block_until_ready()
+            entry.program_specs[spec] = True
+            done += 1
+        except Exception:  # noqa: BLE001 - best-effort warm
+            continue
+    return done
+
+
 def persist_entry_async(entry: _Entry, table) -> None:
     if entry.host_snap is None:
         return
@@ -802,6 +901,7 @@ def warm_from_snapshots(engine, catalog) -> int:
                     )
             if inserted:
                 force_resident(entry)
+                precompile_programs(entry, table)
                 restored += 1
         except Exception:
             continue
@@ -1486,13 +1586,20 @@ def execute_range_device(engine, plan, table):
         entry.nan_ok.get(fname, fname == "__rows__") for fname, _ in items
     )
     program = get_program()
+    prog_spec = (stride, n_steps, g, memo["fold"], nanenc, prog_items)
     with stats.timed("device_exec_ms"):
         out = program(
             arrs, memo["gid"], memo["mask"],
             memo["delta"], memo["lo"], memo["hi"],
-            spec=(stride, n_steps, g, memo["fold"], nanenc, prog_items),
+            spec=prog_spec,
         )
         out = np.asarray(out)
+    if prog_spec not in entry.program_specs:
+        entry.program_specs[prog_spec] = True
+        threading.Thread(
+            target=_persist_program_specs, args=(entry, table),
+            daemon=True, name="program-specs-persist",
+        ).start()
     stats.add("device_readback_bytes", out.nbytes)
     stats.add("range_groups", g)
     stats.add("range_steps", n_steps)

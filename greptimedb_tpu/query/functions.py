@@ -308,6 +308,10 @@ def eval_scalar_function(e: A.FuncCall, src: ColumnSource) -> Col:
             np.asarray([str(v).startswith(prefix) for v in c.values], bool),
             c.validity,
         )
+    if name in ("ends_with", "reverse", "repeat", "replace", "lpad",
+                "rpad", "split_part", "left", "right", "strpos",
+                "position", "instr"):
+        return _string_fn(name, args, src)
 
     # ---- misc ---------------------------------------------------------
     if name == "arrow_typeof" or name == "typeof":
@@ -328,6 +332,58 @@ def eval_scalar_function(e: A.FuncCall, src: ColumnSource) -> Col:
         return out
 
     raise UnsupportedError(f"unknown function: {name}")
+
+
+def _string_fn(name: str, args, src) -> Col:
+    """Per-row string transforms sharing one map/validity wrapper."""
+    c = eval_expr(args[0], src)
+    a = [_const_arg(x) for x in args[1:]]
+
+    if name == "ends_with":
+        fn, dtype = (lambda s: s.endswith(str(a[0]))), bool
+    elif name == "reverse":
+        fn, dtype = (lambda s: s[::-1]), object
+    elif name == "repeat":
+        k = max(int(a[0]), 0)
+        fn, dtype = (lambda s: s * k), object
+    elif name == "replace":
+        frm, to = str(a[0]), str(a[1])
+        fn, dtype = (lambda s: s.replace(frm, to)), object
+    elif name in ("lpad", "rpad"):
+        width = int(a[0])
+        fill = (str(a[1]) if len(a) > 1 else " ") or " "
+
+        def fn(s):  # noqa: E306
+            if width <= 0:
+                return ""          # postgres: non-positive width -> ''
+            if len(s) >= width:
+                return s[:width]
+            add = (fill * (width - len(s)))[:width - len(s)]
+            return add + s if name == "lpad" else s + add
+
+        dtype = object
+    elif name == "split_part":
+        sep, idx = str(a[0]), int(a[1])   # 1-based, like postgres
+
+        def fn(s):  # noqa: E306
+            parts = s.split(sep)
+            return parts[idx - 1] if 1 <= idx <= len(parts) else ""
+
+        dtype = object
+    elif name in ("left", "right"):
+        k = int(a[0])
+        if name == "left":
+            fn = lambda s: s[:k]          # noqa: E731 - k<0 drops tail
+        else:
+            fn = lambda s: "" if k == 0 else s[-k:]  # noqa: E731
+        dtype = object
+    else:  # strpos / position / instr
+        needle = str(a[0])
+        fn, dtype = (lambda s: s.find(needle) + 1), np.int64
+
+    return Col(
+        np.asarray([fn(str(v)) for v in c.values], dtype), c.validity
+    )
 
 
 def _parse_interval(text: str) -> int:

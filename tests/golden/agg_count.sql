@@ -1,0 +1,35 @@
+-- COUNT semantics (reference sqlness: common/aggregate/count.sql)
+
+CREATE TABLE c (v DOUBLE, s STRING, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO c (v, s, ts) VALUES (1.0, 'a', 1000), (2.0, 'b', 2000);
+
+INSERT INTO c (ts) VALUES (3000);
+
+SELECT count(*) FROM c;
+----
+count(*)
+3
+
+SELECT count(v) FROM c;
+----
+count(v)
+2
+
+SELECT count(s) FROM c;
+----
+count(s)
+2
+
+SELECT count(*) FROM c WHERE v > 10;
+----
+count(*)
+0
+
+SELECT count(*), count(v), count(s) FROM c;
+----
+count(*)|count(v)|count(s)
+3|2|2
+
+DROP TABLE c;
+

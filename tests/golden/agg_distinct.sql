@@ -1,0 +1,40 @@
+-- DISTINCT aggregates + SELECT DISTINCT (common/aggregate/distinct.sql)
+
+CREATE TABLE d (host STRING, v BIGINT, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host));
+
+INSERT INTO d (host, v, ts) VALUES ('a', 1, 1000), ('a', 1, 2000), ('b', 2, 1000), ('b', 3, 2000), ('c', 1, 1000);
+
+SELECT count(DISTINCT host) FROM d;
+----
+count(DISTINCT host)
+3
+
+SELECT count(DISTINCT v) FROM d;
+----
+count(DISTINCT v)
+3
+
+SELECT DISTINCT v FROM d ORDER BY v;
+----
+v
+1
+2
+3
+
+SELECT DISTINCT host, v FROM d ORDER BY host, v;
+----
+host|v
+a|1
+b|2
+b|3
+c|1
+
+SELECT host, count(DISTINCT v) FROM d GROUP BY host ORDER BY host;
+----
+host|count(DISTINCT v)
+a|1
+b|2
+c|1
+
+DROP TABLE d;
+

@@ -1,0 +1,33 @@
+-- Variance / stddev / percentile family (common/function: aggregate fns)
+
+CREATE TABLE st (v DOUBLE, g STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(g));
+
+INSERT INTO st (v, g, ts) VALUES (2, 'a', 1000), (4, 'a', 2000), (4, 'a', 3000), (4, 'a', 4000), (5, 'a', 5000), (5, 'a', 6000), (7, 'a', 7000), (9, 'a', 8000);
+
+SELECT stddev_pop(v) FROM st;
+----
+stddev_pop(v)
+2.0
+
+SELECT stddev_samp(v) FROM st;
+----
+stddev_samp(v)
+2.13809
+
+SELECT var_pop(v), var_samp(v) FROM st;
+----
+var_pop(v)|var_samp(v)
+4.0|4.57143
+
+SELECT min(v), max(v) FROM st;
+----
+min(v)|max(v)
+2.0|9.0
+
+SELECT percentile_cont(0.5) WITHIN GROUP (ORDER BY v) FROM st;
+----
+percentile_cont(0.5, v)
+4.5
+
+DROP TABLE st;
+

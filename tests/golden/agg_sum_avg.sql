@@ -1,0 +1,35 @@
+-- SUM/AVG with NULLs and empty inputs (common/aggregate/sum.sql)
+
+CREATE TABLE s (v DOUBLE, n BIGINT, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO s (v, n, ts) VALUES (1.5, 10, 1000), (2.5, 20, 2000);
+
+INSERT INTO s (ts) VALUES (3000);
+
+SELECT sum(v), avg(v) FROM s;
+----
+sum(v)|avg(v)
+4.0|2.0
+
+SELECT sum(n), avg(n) FROM s;
+----
+sum(n)|avg(n)
+30.0|15.0
+
+SELECT sum(v) FROM s WHERE v > 100;
+----
+sum(v)
+NULL
+
+SELECT sum(v + n) FROM s;
+----
+sum(v + n)
+34.0
+
+SELECT sum(v * 2), avg(v * 2) FROM s;
+----
+sum(v * 2)|avg(v * 2)
+8.0|4.0
+
+DROP TABLE s;
+

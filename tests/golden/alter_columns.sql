@@ -1,0 +1,44 @@
+-- ALTER TABLE add/drop columns (common/alter)
+
+CREATE TABLE al (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO al (ts, host, v) VALUES (1000, 'a', 1.5);
+
+ALTER TABLE al ADD COLUMN mem DOUBLE;
+
+INSERT INTO al (ts, host, v, mem) VALUES (2000, 'a', 2.5, 90.0);
+
+SELECT ts, v, mem FROM al ORDER BY ts;
+----
+ts|v|mem
+1000|1.5|NULL
+2000|2.5|90.0
+
+ALTER TABLE al ADD COLUMN dc STRING;
+
+SELECT ts, dc FROM al ORDER BY ts;
+----
+ts|dc
+1000|NULL
+2000|NULL
+
+ALTER TABLE al DROP COLUMN mem;
+
+DESCRIBE al;
+----
+Column|Type|Key|Null|Default|Semantic Type
+ts|TIMESTAMP(3)|PRI|NO||TIMESTAMP
+host|STRING|PRI|NO||TAG
+v|DOUBLE||YES||FIELD
+dc|STRING||YES||FIELD
+
+ALTER TABLE al DROP COLUMN ts;
+----
+ERROR
+
+ALTER TABLE al DROP COLUMN host;
+----
+ERROR
+
+DROP TABLE al;
+

@@ -1,0 +1,24 @@
+-- ALTER TABLE RENAME (common/alter/rename.sql)
+
+CREATE TABLE old_name (ts TIMESTAMP TIME INDEX, v DOUBLE);
+
+INSERT INTO old_name (ts, v) VALUES (1000, 42.0);
+
+ALTER TABLE old_name RENAME new_name;
+
+SELECT v FROM new_name;
+----
+v
+42.0
+
+SELECT v FROM old_name;
+----
+ERROR
+
+SHOW TABLES LIKE 'new%';
+----
+Tables
+new_name
+
+DROP TABLE new_name;
+

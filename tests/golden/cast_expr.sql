@@ -1,0 +1,37 @@
+-- CAST between types (common/types + select/cast)
+
+SELECT CAST(1.9 AS BIGINT);
+----
+CAST(1.9 AS int64)
+1
+
+SELECT CAST('42' AS BIGINT);
+----
+CAST('42' AS int64)
+42
+
+SELECT CAST(42 AS DOUBLE);
+----
+CAST(42 AS float64)
+42.0
+
+SELECT CAST('3.5' AS DOUBLE) * 2;
+----
+CAST('3.5' AS float64) * 2
+7.0
+
+SELECT CAST(1 AS BOOLEAN);
+----
+CAST(1 AS bool)
+true
+
+SELECT CAST('1970-01-01 00:00:01' AS TIMESTAMP);
+----
+CAST('1970-01-01 00:00:01' AS timestamp_ms)
+1000
+
+SELECT CAST(2.5 AS STRING);
+----
+CAST(2.5 AS string)
+2.5
+

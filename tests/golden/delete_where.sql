@@ -1,0 +1,29 @@
+-- DELETE with predicates (common/delete)
+
+CREATE TABLE del (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO del (ts, host, v) VALUES (1000, 'a', 1), (2000, 'a', 2), (1000, 'b', 3), (2000, 'b', 4);
+
+DELETE FROM del WHERE host = 'a' AND ts = 1000;
+
+SELECT host, ts, v FROM del ORDER BY host, ts;
+----
+host|ts|v
+a|2000|2.0
+b|1000|3.0
+b|2000|4.0
+
+DELETE FROM del WHERE host = 'b';
+
+SELECT host, ts, v FROM del ORDER BY host, ts;
+----
+host|ts|v
+a|2000|2.0
+
+SELECT count(*) FROM del;
+----
+count(*)
+1
+
+DROP TABLE del;
+

@@ -1,0 +1,37 @@
+-- DROP / TRUNCATE semantics (common/drop, common/truncate)
+
+CREATE TABLE dt (ts TIMESTAMP TIME INDEX, v DOUBLE);
+
+INSERT INTO dt (ts, v) VALUES (1000, 1), (2000, 2);
+
+SELECT count(*) FROM dt;
+----
+count(*)
+2
+
+TRUNCATE TABLE dt;
+
+SELECT count(*) FROM dt;
+----
+count(*)
+0
+
+INSERT INTO dt (ts, v) VALUES (3000, 3);
+
+SELECT v FROM dt;
+----
+v
+3.0
+
+DROP TABLE dt;
+
+DROP TABLE dt;
+----
+ERROR
+
+DROP TABLE IF EXISTS dt;
+
+SELECT count(*) FROM dt;
+----
+ERROR
+

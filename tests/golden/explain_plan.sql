@@ -1,0 +1,15 @@
+-- EXPLAIN output shape (common/tql-explain-analyze, EXPLAIN SELECT)
+
+CREATE TABLE ex (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO ex (ts, host, v) VALUES (1000, 'a', 1);
+
+EXPLAIN SELECT host, sum(v) FROM ex WHERE ts > 0 GROUP BY host;
+----
+plan
+SelectPlan[aggregate] table=ex
+  Scan: ts=[1, None] matchers=[] residual=None
+  Aggregate: keys=['host'] aggs=['sum(v)']
+
+DROP TABLE ex;
+

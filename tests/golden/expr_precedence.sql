@@ -1,0 +1,47 @@
+-- operator precedence + arithmetic edge cases (common/select/arithmetic)
+
+SELECT 2 + 3 * 4;
+----
+2 + 3 * 4
+14
+
+SELECT (2 + 3) * 4;
+----
+2 + 3 * 4
+20
+
+SELECT 10 / 4;
+----
+10 / 4
+2
+
+SELECT 10.0 / 4;
+----
+10.0 / 4
+2.5
+
+SELECT 10 % 3;
+----
+10 % 3
+1
+
+SELECT -2 * 3;
+----
+-2 * 3
+-6
+
+SELECT 2 * 3 > 5 AND 1 < 2;
+----
+2 * 3 > 5 AND 1 < 2
+true
+
+SELECT NOT true OR true;
+----
+NOT True OR True
+true
+
+SELECT 1 + 2 = 3;
+----
+1 + 2 = 3
+true
+

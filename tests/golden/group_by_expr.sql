@@ -1,0 +1,34 @@
+-- GROUP BY expressions / aliases / positions (common/aggregate)
+
+CREATE TABLE ge (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO ge (ts, host, v) VALUES
+  (1000, 'web-1', 1), (2000, 'web-2', 2), (3000, 'db-1', 10), (4000, 'db-2', 20);
+
+SELECT substr(host, 1, 2) AS grp, sum(v) FROM ge GROUP BY grp ORDER BY grp;
+----
+grp|sum(v)
+db|30.0
+we|3.0
+
+SELECT date_bin('2 seconds', ts) AS w, count(*) FROM ge GROUP BY w ORDER BY w;
+----
+w|count(*)
+0|1
+2000|2
+4000|1
+
+SELECT host, sum(v) FROM ge GROUP BY host HAVING sum(v) >= 10 ORDER BY host;
+----
+host|sum(v)
+db-1|10.0
+db-2|20.0
+
+SELECT upper(substr(host, 1, 2)) AS g2, max(v) FROM ge GROUP BY g2 ORDER BY g2;
+----
+g2|max(v)
+DB|20.0
+WE|2.0
+
+DROP TABLE ge;
+

@@ -1,0 +1,36 @@
+-- INSERT variants (common/insert)
+
+CREATE TABLE iv (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE, note STRING DEFAULT 'none');
+
+INSERT INTO iv VALUES (1000, 'a', 1.0, 'x');
+
+INSERT INTO iv (host, ts) VALUES ('b', 2000);
+
+INSERT INTO iv (ts, host, v) VALUES (3000, 'c', 3.0), (4000, 'd', 4.0);
+
+SELECT ts, host, v, note FROM iv ORDER BY ts;
+----
+ts|host|v|note
+1000|a|1.0|x
+2000|b|NULL|none
+3000|c|3.0|none
+4000|d|4.0|none
+
+CREATE TABLE iv2 (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO iv2 SELECT ts, host, v FROM iv WHERE v > 2;
+
+SELECT host, v FROM iv2 ORDER BY host;
+----
+host|v
+c|3.0
+d|4.0
+
+INSERT INTO iv (ts, host, bogus) VALUES (5000, 'e', 1);
+----
+ERROR
+
+DROP TABLE iv;
+
+DROP TABLE iv2;
+

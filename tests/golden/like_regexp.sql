@@ -1,0 +1,36 @@
+-- LIKE / regexp matching (common/select)
+
+CREATE TABLE lk (s STRING, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO lk (s, ts) VALUES ('apple', 1000), ('banana', 2000), ('cherry', 3000), ('Avocado', 4000);
+
+SELECT s FROM lk WHERE s LIKE 'a%' ORDER BY s;
+----
+s
+apple
+
+SELECT s FROM lk WHERE s LIKE '%an%' ORDER BY s;
+----
+s
+banana
+
+SELECT s FROM lk WHERE s LIKE '_herry' ORDER BY s;
+----
+s
+cherry
+
+SELECT s FROM lk WHERE s NOT LIKE 'a%' ORDER BY s;
+----
+s
+Avocado
+banana
+cherry
+
+SELECT s FROM lk WHERE regexp_match(s, '^[ab]') ORDER BY s;
+----
+s
+apple
+banana
+
+DROP TABLE lk;
+

@@ -1,0 +1,52 @@
+-- math scalar functions (common/function/math)
+
+SELECT abs(-3.5), abs(2);
+----
+abs(-3.5)|abs(2)
+3.5|2.0
+
+SELECT floor(2.7), ceil(2.1);
+----
+floor(2.7)|ceil(2.1)
+2.0|3.0
+
+SELECT round(2.567, 2);
+----
+round(2.567, 2)
+2.57
+
+SELECT sqrt(16.0);
+----
+sqrt(16.0)
+4.0
+
+SELECT power(2, 10);
+----
+power(2, 10)
+1024.0
+
+SELECT mod(10, 3);
+----
+mod(10, 3)
+1
+
+SELECT exp(0.0), ln(1.0);
+----
+exp(0.0)|ln(1.0)
+1.0|0.0
+
+SELECT log10(1000.0);
+----
+log10(1000.0)
+3.0
+
+SELECT sin(0.0), cos(0.0);
+----
+sin(0.0)|cos(0.0)
+0.0|1.0
+
+SELECT greatest(1, 2), least(1, 2);
+----
+greatest(1, 2)|least(1, 2)
+2.0|1.0
+

@@ -1,0 +1,44 @@
+-- NULL comparison / IS NULL / coalesce (common/select + function)
+
+CREATE TABLE nl (v DOUBLE, s STRING, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO nl (v, s, ts) VALUES (1.0, 'x', 1000);
+
+INSERT INTO nl (ts) VALUES (2000);
+
+SELECT v IS NULL, s IS NOT NULL FROM nl ORDER BY ts;
+----
+v IS NULL|s IS NOT NULL
+false|true
+true|false
+
+SELECT count(*) FROM nl WHERE v IS NULL;
+----
+count(*)
+1
+
+SELECT coalesce(v, -1.0) FROM nl ORDER BY ts;
+----
+coalesce(v, -1.0)
+1.0
+-1.0
+
+SELECT coalesce(s, 'missing') FROM nl ORDER BY ts;
+----
+coalesce(s, 'missing')
+x
+missing
+
+SELECT v = NULL FROM nl ORDER BY ts;
+----
+v = NULL
+NULL
+NULL
+
+SELECT nullif(1, 1), nullif(2, 1);
+----
+nullif(1, 1)|nullif(2, 1)
+NULL|2
+
+DROP TABLE nl;
+

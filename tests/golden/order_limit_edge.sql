@@ -1,0 +1,51 @@
+-- ORDER BY / LIMIT / OFFSET edge cases (common/order)
+
+CREATE TABLE ol (v BIGINT, s STRING, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO ol (v, s, ts) VALUES (3, 'c', 1000), (1, 'a', 2000), (2, 'b', 3000);
+
+SELECT v FROM ol ORDER BY v DESC;
+----
+v
+3
+2
+1
+
+SELECT v FROM ol ORDER BY v LIMIT 2;
+----
+v
+1
+2
+
+SELECT v FROM ol ORDER BY v LIMIT 1 OFFSET 1;
+----
+v
+2
+
+SELECT v FROM ol ORDER BY v LIMIT 0;
+----
+v
+
+SELECT v, s FROM ol ORDER BY s DESC, v ASC;
+----
+v|s
+3|c
+2|b
+1|a
+
+SELECT v FROM ol ORDER BY v + 0 DESC;
+----
+v
+3
+2
+1
+
+SELECT v AS k FROM ol ORDER BY k;
+----
+k
+1
+2
+3
+
+DROP TABLE ol;
+

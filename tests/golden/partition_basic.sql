@@ -1,0 +1,33 @@
+-- PARTITION ON expressions (partition.sql)
+
+CREATE TABLE pt (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE)
+PARTITION ON COLUMNS (host) (host < 'h5', host >= 'h5');
+
+INSERT INTO pt (ts, host, v) VALUES (1000, 'h1', 1), (1000, 'h7', 7), (2000, 'h3', 3), (2000, 'h9', 9);
+
+SELECT host, v FROM pt ORDER BY host;
+----
+host|v
+h1|1.0
+h3|3.0
+h7|7.0
+h9|9.0
+
+SELECT host, v FROM pt WHERE host = 'h7';
+----
+host|v
+h7|7.0
+
+SELECT sum(v) FROM pt;
+----
+sum(v)
+20.0
+
+SELECT partition_name FROM information_schema.partitions WHERE table_name = 'pt';
+----
+partition_name
+p0
+p1
+
+DROP TABLE pt;
+

@@ -1,0 +1,31 @@
+-- RANGE BY () / ALIGN TO semantics (common/range/by.sql)
+
+CREATE TABLE rb (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO rb (ts, host, v) VALUES
+  (0, 'a', 2), (0, 'b', 4), (60000, 'a', 6), (60000, 'b', 8);
+
+SELECT ts, sum(v) RANGE '1m' FROM rb ALIGN '1m' BY () ORDER BY ts;
+----
+ts|sum(v) RANGE 60000ms
+0|6.0
+60000|14.0
+
+SELECT ts, host, min(v) RANGE '2m' FROM rb ALIGN '1m' BY (host) ORDER BY ts, host;
+----
+ts|host|min(v) RANGE 120000ms
+-60000|a|2.0
+-60000|b|4.0
+0|a|2.0
+0|b|4.0
+60000|a|6.0
+60000|b|8.0
+
+SELECT ts, count(v) RANGE '1m' FROM rb ALIGN '1m' TO '1970-01-01 00:00:30' BY () ORDER BY ts;
+----
+ts|count(v) RANGE 60000ms
+-30000|2.0
+30000|2.0
+
+DROP TABLE rb;
+

@@ -1,0 +1,30 @@
+-- expressions over RANGE aggregates (common/range/nest.sql)
+
+CREATE TABLE rn (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO rn (ts, host, v) VALUES (0, 'a', 10), (10000, 'a', 20), (0, 'b', 100), (10000, 'b', 200);
+
+SELECT ts, host, max(v) RANGE '10s' - min(v) RANGE '10s' FROM rn ALIGN '10s' BY (host) ORDER BY ts, host;
+----
+ts|host|max(v) RANGE 10000ms - min(v) RANGE 10000ms
+0|a|0.0
+0|b|0.0
+10000|a|0.0
+10000|b|0.0
+
+SELECT ts, host, (avg(v) RANGE '20s') * 2 AS dbl FROM rn ALIGN '20s' BY (host) ORDER BY ts, host;
+----
+ts|host|dbl
+0|a|30.0
+0|b|300.0
+
+SELECT ts, host, sum(v*2) RANGE '10s' FROM rn ALIGN '10s' BY (host) ORDER BY ts, host;
+----
+ts|host|sum(v * 2) RANGE 10000ms
+0|a|20.0
+0|b|200.0
+10000|a|40.0
+10000|b|400.0
+
+DROP TABLE rn;
+

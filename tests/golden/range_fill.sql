@@ -1,0 +1,51 @@
+-- RANGE ... FILL variants (common/range/fill.sql)
+
+CREATE TABLE r (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO r (ts, host, v) VALUES (0, 'a', 1), (10000, 'a', 3), (40000, 'a', 9);
+
+SELECT ts, host, avg(v) RANGE '10s' FROM r ALIGN '10s' BY (host) ORDER BY ts;
+----
+ts|host|avg(v) RANGE 10000ms
+0|a|1.0
+10000|a|3.0
+40000|a|9.0
+
+SELECT ts, host, avg(v) RANGE '10s' FILL NULL FROM r ALIGN '10s' BY (host) ORDER BY ts;
+----
+ts|host|avg(v) RANGE 10000ms
+0|a|1.0
+10000|a|3.0
+20000|a|NULL
+30000|a|NULL
+40000|a|9.0
+
+SELECT ts, host, avg(v) RANGE '10s' FILL PREV FROM r ALIGN '10s' BY (host) ORDER BY ts;
+----
+ts|host|avg(v) RANGE 10000ms
+0|a|1.0
+10000|a|3.0
+20000|a|3.0
+30000|a|3.0
+40000|a|9.0
+
+SELECT ts, host, avg(v) RANGE '10s' FILL 0 FROM r ALIGN '10s' BY (host) ORDER BY ts;
+----
+ts|host|avg(v) RANGE 10000ms
+0|a|1.0
+10000|a|3.0
+20000|a|0.0
+30000|a|0.0
+40000|a|9.0
+
+SELECT ts, host, avg(v) RANGE '10s' FILL LINEAR FROM r ALIGN '10s' BY (host) ORDER BY ts;
+----
+ts|host|avg(v) RANGE 10000ms
+0|a|1.0
+10000|a|3.0
+20000|a|5.0
+30000|a|7.0
+40000|a|9.0
+
+DROP TABLE r;
+

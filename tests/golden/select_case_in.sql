@@ -1,0 +1,39 @@
+-- CASE / IN / BETWEEN expressions (common/select)
+
+CREATE TABLE sc (v BIGINT, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO sc (v, ts) VALUES (1, 1000), (2, 2000), (3, 3000), (4, 4000);
+
+SELECT v, CASE WHEN v < 2 THEN 'low' WHEN v < 4 THEN 'mid' ELSE 'high' END AS c FROM sc ORDER BY v;
+----
+v|c
+1|low
+2|mid
+3|mid
+4|high
+
+SELECT v FROM sc WHERE v IN (2, 4) ORDER BY v;
+----
+v
+2
+4
+
+SELECT v FROM sc WHERE v NOT IN (2, 4) ORDER BY v;
+----
+v
+1
+3
+
+SELECT v FROM sc WHERE v BETWEEN 2 AND 3 ORDER BY v;
+----
+v
+2
+3
+
+SELECT CASE 2 WHEN 1 THEN 'one' WHEN 2 THEN 'two' END;
+----
+CASE ...
+two
+
+DROP TABLE sc;
+

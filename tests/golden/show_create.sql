@@ -1,0 +1,38 @@
+-- SHOW CREATE TABLE fidelity (common/show/show_create.sql)
+
+CREATE TABLE scr (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE NOT NULL, note STRING DEFAULT 'info', n BIGINT DEFAULT 7) WITH (ttl = '1h');
+
+SHOW CREATE TABLE scr;
+----
+Table|Create Table
+scr|CREATE TABLE IF NOT EXISTS `scr` (
+  `ts` TIMESTAMP(3) NOT NULL,
+  `host` STRING NOT NULL,
+  `v` DOUBLE NOT NULL,
+  `note` STRING DEFAULT 'info',
+  `n` BIGINT DEFAULT 7,
+  TIME INDEX (`ts`),
+  PRIMARY KEY (`host`)
+)
+ENGINE=mito
+WITH('ttl'='1h')
+
+DROP TABLE scr;
+
+CREATE TABLE scr2 (ts TIMESTAMP TIME INDEX, a STRING, b STRING, v DOUBLE, PRIMARY KEY (a, b));
+
+SHOW CREATE TABLE scr2;
+----
+Table|Create Table
+scr2|CREATE TABLE IF NOT EXISTS `scr2` (
+  `ts` TIMESTAMP(3) NOT NULL,
+  `a` STRING NOT NULL,
+  `b` STRING NOT NULL,
+  `v` DOUBLE,
+  TIME INDEX (`ts`),
+  PRIMARY KEY (`a`, `b`)
+)
+ENGINE=mito
+
+DROP TABLE scr2;
+

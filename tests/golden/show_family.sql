@@ -1,0 +1,38 @@
+-- SHOW statements (common/show)
+
+CREATE DATABASE showdb;
+
+CREATE TABLE st1 (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE DEFAULT 7);
+
+SHOW TABLES;
+----
+Tables
+st1
+
+SHOW TABLES LIKE 'st%';
+----
+Tables
+st1
+
+SHOW DATABASES LIKE 'show%';
+----
+Database
+showdb
+
+SHOW COLUMNS FROM st1;
+----
+Column|Type|Null|Key|Default
+ts|timestamp_ms|No|TIME INDEX|
+host|string|No|PRI|
+v|float64|Yes||7
+
+SHOW INDEX FROM st1;
+----
+Table|Key_name|Seq_in_index|Column_name
+st1|PRIMARY|1|host
+st1|TIME INDEX|1|ts
+
+DROP TABLE st1;
+
+DROP DATABASE showdb;
+

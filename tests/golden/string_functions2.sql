@@ -1,0 +1,42 @@
+-- more string functions (common/function/string)
+
+SELECT reverse('abc');
+----
+reverse('abc')
+cba
+
+SELECT lpad('7', 3, '0'), rpad('7', 3, '0');
+----
+lpad('7', 3, '0')|rpad('7', 3, '0')
+007|700
+
+SELECT split_part('a,b,c', ',', 2);
+----
+split_part('a,b,c', ',', 2)
+b
+
+SELECT starts_with('greptime', 'grep'), ends_with('greptime', 'time');
+----
+starts_with('greptime', 'grep')|ends_with('greptime', 'time')
+true|true
+
+SELECT strpos('greptime', 'ep');
+----
+strpos('greptime', 'ep')
+3
+
+SELECT repeat('ab', 3);
+----
+repeat('ab', 3)
+ababab
+
+SELECT char_length('hello');
+----
+char_length('hello')
+5
+
+SELECT left('greptime', 4), right('greptime', 4);
+----
+left('greptime', 4)|right('greptime', 4)
+grep|time
+

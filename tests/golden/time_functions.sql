@@ -1,0 +1,27 @@
+-- date/time scalar functions (common/function/time.sql)
+
+SELECT date_bin('1 hour', CAST(5400000 AS TIMESTAMP));
+----
+date_bin(INTERVAL '1 hour', CAST(5400000 AS timestamp_ms))
+3600000
+
+SELECT date_trunc('day', CAST('1970-01-02 13:14:15' AS TIMESTAMP));
+----
+date_trunc('day', CAST('1970-01-02 13:14:15' AS timestamp_ms))
+86400000
+
+SELECT extract(hour FROM CAST('1970-01-01 05:30:00' AS TIMESTAMP));
+----
+extract('hour', CAST('1970-01-01 05:30:00' AS timestamp_ms))
+5.0
+
+SELECT extract(minute FROM CAST('1970-01-01 05:30:00' AS TIMESTAMP));
+----
+extract('minute', CAST('1970-01-01 05:30:00' AS timestamp_ms))
+30.0
+
+SELECT to_unixtime('1970-01-02 00:00:00');
+----
+to_unixtime('1970-01-02 00:00:00')
+86400
+

@@ -1,0 +1,30 @@
+-- timestamp arithmetic + interval literals (common/timestamp)
+
+CREATE TABLE ta (ts TIMESTAMP TIME INDEX, v DOUBLE);
+
+INSERT INTO ta (ts, v) VALUES (3600000, 1.0), (7200000, 2.0);
+
+SELECT ts + INTERVAL '1 hour' FROM ta ORDER BY ts;
+----
+ts + INTERVAL '1 hour'
+7200000
+10800000
+
+SELECT ts - INTERVAL '30 minutes' FROM ta ORDER BY ts;
+----
+ts - INTERVAL '30 minutes'
+1800000
+5400000
+
+SELECT count(*) FROM ta WHERE ts > '1970-01-01 00:30:00';
+----
+count(*)
+2
+
+SELECT v FROM ta WHERE ts = CAST('1970-01-01 01:00:00' AS TIMESTAMP);
+----
+v
+1.0
+
+DROP TABLE ta;
+

@@ -1,0 +1,24 @@
+-- Timestamp precisions + literals (common/timestamp)
+
+CREATE TABLE tp (ts TIMESTAMP(3) TIME INDEX, v DOUBLE);
+
+INSERT INTO tp (ts, v) VALUES ('1970-01-01 00:00:01', 1.0), ('1970-01-01 00:00:02.500', 2.0);
+
+SELECT ts, v FROM tp ORDER BY ts;
+----
+ts|v
+1000|1.0
+2500|2.0
+
+SELECT count(*) FROM tp WHERE ts >= '1970-01-01 00:00:02';
+----
+count(*)
+1
+
+SELECT max(ts) FROM tp;
+----
+max(ts)
+2500.0
+
+DROP TABLE tp;
+

@@ -1,0 +1,43 @@
+-- TQL aggregation + scalar binary ops (common/tql)
+
+CREATE TABLE v (ts TIMESTAMP TIME INDEX, dc STRING PRIMARY KEY, greptime_value DOUBLE);
+
+INSERT INTO v (ts, dc, greptime_value) VALUES
+  (0, 'east', 4), (0, 'west', 6), (10000, 'east', 8), (10000, 'west', 12);
+
+TQL EVAL (0, 10, '10s') sum(v);
+----
+ts|value
+0|10.0
+10000|20.0
+
+TQL EVAL (0, 10, '10s') avg(v);
+----
+ts|value
+0|5.0
+10000|10.0
+
+TQL EVAL (0, 10, '10s') max(v) - min(v);
+----
+ts|value
+0|2.0
+10000|4.0
+
+TQL EVAL (0, 10, '10s') v * 2;
+----
+ts|value|dc
+0|8.0|east
+0|12.0|west
+10000|16.0|east
+10000|24.0|west
+
+TQL EVAL (0, 10, '10s') sum by (dc) (v + 1);
+----
+ts|value|dc
+0|5.0|east
+0|7.0|west
+10000|9.0|east
+10000|13.0|west
+
+DROP TABLE v;
+

@@ -1,0 +1,33 @@
+-- TQL basics: selector + range eval (common/tql)
+
+CREATE TABLE http_requests (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, greptime_value DOUBLE);
+
+INSERT INTO http_requests (ts, host, greptime_value) VALUES
+  (0, 'a', 1), (10000, 'a', 2), (20000, 'a', 3),
+  (0, 'b', 10), (10000, 'b', 20), (20000, 'b', 30);
+
+TQL EVAL (0, 20, '10s') http_requests;
+----
+ts|value|__name__|host
+0|1.0|http_requests|a
+0|10.0|http_requests|b
+10000|2.0|http_requests|a
+10000|20.0|http_requests|b
+20000|3.0|http_requests|a
+20000|30.0|http_requests|b
+
+TQL EVAL (10, 20, '10s') http_requests{host="a"};
+----
+ts|value|__name__|host
+10000|2.0|http_requests|a
+20000|3.0|http_requests|a
+
+TQL EVAL (0, 20, '10s') sum(http_requests);
+----
+ts|value
+0|11.0
+10000|22.0
+20000|33.0
+
+DROP TABLE http_requests;
+

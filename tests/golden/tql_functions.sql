@@ -1,0 +1,37 @@
+-- TQL scalar function coverage (promql/)
+
+CREATE TABLE fx (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, greptime_value DOUBLE);
+
+INSERT INTO fx (ts, host, greptime_value) VALUES (0, 'a', -4), (0, 'b', 9);
+
+TQL EVAL (0, 0, '10s') abs(fx);
+----
+ts|value|host
+0|4.0|a
+0|9.0|b
+
+TQL EVAL (0, 0, '10s') sqrt(abs(fx));
+----
+ts|value|host
+0|2.0|a
+0|3.0|b
+
+TQL EVAL (0, 0, '10s') clamp_min(fx, 0);
+----
+ts|value|host
+0|0.0|a
+0|9.0|b
+
+TQL EVAL (0, 0, '10s') ceil(fx / 2);
+----
+ts|value|host
+0|-2.0|a
+0|5.0|b
+
+TQL EVAL (0, 0, '10s') topk(1, fx);
+----
+ts|value|__name__|host
+0|9.0|fx|b
+
+DROP TABLE fx;
+

@@ -1,0 +1,31 @@
+-- TQL label matchers (=, !=, =~, !~) (common/tql)
+
+CREATE TABLE lm (ts TIMESTAMP TIME INDEX, env STRING, dc STRING, greptime_value DOUBLE, PRIMARY KEY (env, dc));
+
+INSERT INTO lm (ts, env, dc, greptime_value) VALUES
+  (0, 'prod', 'east', 1), (0, 'prod', 'west', 2), (0, 'dev', 'east', 3);
+
+TQL EVAL (0, 0, '10s') lm{env="prod"};
+----
+ts|value|__name__|dc|env
+0|1.0|lm|east|prod
+0|2.0|lm|west|prod
+
+TQL EVAL (0, 0, '10s') lm{env!="prod"};
+----
+ts|value|__name__|dc|env
+0|3.0|lm|east|dev
+
+TQL EVAL (0, 0, '10s') lm{dc=~"ea.*"};
+----
+ts|value|__name__|dc|env
+0|1.0|lm|east|prod
+0|3.0|lm|east|dev
+
+TQL EVAL (0, 0, '10s') lm{env="prod", dc!~"we.*"};
+----
+ts|value|__name__|dc|env
+0|1.0|lm|east|prod
+
+DROP TABLE lm;
+

@@ -1,0 +1,27 @@
+-- TQL rate/increase/delta over counters (common/tql + promql/)
+
+CREATE TABLE m (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, greptime_value DOUBLE);
+
+INSERT INTO m (ts, host, greptime_value) VALUES
+  (0, 'a', 0), (30000, 'a', 30), (60000, 'a', 60), (90000, 'a', 90);
+
+TQL EVAL (60, 90, '30s') rate(m[1m]);
+----
+ts|value|host
+60000|1.0|a
+90000|1.0|a
+
+TQL EVAL (60, 90, '30s') increase(m[1m]);
+----
+ts|value|host
+60000|60.0|a
+90000|60.0|a
+
+TQL EVAL (60, 90, '30s') delta(m[1m]);
+----
+ts|value|host
+60000|60.0|a
+90000|60.0|a
+
+DROP TABLE m;
+

@@ -1,0 +1,33 @@
+-- Float semantics: precision, infinities via division, NaN ordering (common/types/float)
+
+CREATE TABLE f (v DOUBLE, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO f (v, ts) VALUES (0.1, 1000), (0.2, 2000), (1e300, 3000), (-1e300, 4000);
+
+SELECT sum(v) FROM f WHERE ts < 3000;
+----
+sum(v)
+0.3
+
+SELECT v FROM f ORDER BY v LIMIT 1;
+----
+v
+-1e+300
+
+SELECT v * 2 FROM f WHERE ts = 3000;
+----
+v * 2
+2e+300
+
+SELECT round(0.1 + 0.2, 10);
+----
+round(0.1 + 0.2, 10)
+0.3
+
+SELECT 1.0 / 3.0;
+----
+1.0 / 3.0
+0.333333
+
+DROP TABLE f;
+

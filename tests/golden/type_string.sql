@@ -1,0 +1,45 @@
+-- String type + functions (common/types/string)
+
+CREATE TABLE str (s STRING, ts TIMESTAMP TIME INDEX);
+
+INSERT INTO str (s, ts) VALUES ('Hello', 1000), ('', 2000), ('with ''quote', 3000);
+
+SELECT s, length(s) FROM str ORDER BY ts;
+----
+s|length(s)
+Hello|5
+|0
+with 'quote|11
+
+SELECT upper(s), lower(s) FROM str WHERE ts = 1000;
+----
+upper(s)|lower(s)
+HELLO|hello
+
+SELECT concat(s, '!') FROM str WHERE ts = 1000;
+----
+concat(s, '!')
+Hello!
+
+SELECT substr('greptime', 1, 5);
+----
+substr('greptime', 1, 5)
+grept
+
+SELECT trim('  pad  ');
+----
+trim('  pad  ')
+pad
+
+SELECT replace('aaa', 'a', 'b');
+----
+replace('aaa', 'a', 'b')
+bbb
+
+SELECT s FROM str WHERE s LIKE 'He%';
+----
+s
+Hello
+
+DROP TABLE str;
+

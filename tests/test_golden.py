@@ -45,7 +45,9 @@ def _fmt_value(v) -> str:
 def format_result(res) -> list[str]:
     lines = ["|".join(res.names)]
     for row in res.rows():
-        lines.append("|".join(_fmt_value(v) for v in row))
+        # multi-line cells (SHOW CREATE TABLE) expand to file lines so
+        # expected blocks stay diffable
+        lines.extend("|".join(_fmt_value(v) for v in row).split("\n"))
     return lines
 
 
