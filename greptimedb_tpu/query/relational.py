@@ -190,10 +190,20 @@ def _subselect(inst, q, ctx, env) -> QueryResult:
     return execute(inst, q, ctx, {})
 
 
-def _rewrite_subqueries(inst, e, ctx, env):
+def _rewrite_subqueries(inst, e, ctx, env, corr: list | None = None):
     """Replace uncorrelated subquery expressions with literal values.
-    Correlated references surface naturally as unknown-column errors from
-    the inner evaluation."""
+    Correlated ones (when `corr` is given) decorrelate into placeholder
+    columns computed over the outer frame (query/decorrelate.py)."""
+    if corr is not None and isinstance(
+        e, (A.ScalarSubquery, A.InSubquery, A.Exists)
+    ):
+        from greptimedb_tpu.query.decorrelate import try_decorrelate
+
+        spec = try_decorrelate(inst, e, ctx, env,
+                               key=f"__corr_{len(corr)}")
+        if spec is not None:
+            corr.append(spec)
+            return A.Column(spec.key)
     if isinstance(e, A.ScalarSubquery):
         qr = _subselect(inst, e.query, ctx, env)
         if len(qr.names) != 1:
@@ -218,12 +228,13 @@ def _rewrite_subqueries(inst, e, ctx, env):
             A.Literal(v.item() if hasattr(v, "item") else v) for v in uniq
         ]
         return A.InList(
-            _rewrite_subqueries(inst, e.operand, ctx, env), items, e.negated
+            _rewrite_subqueries(inst, e.operand, ctx, env, corr),
+            items, e.negated
         )
     if isinstance(e, A.Exists):
         qr = _subselect(inst, e.query, ctx, env)
         return A.Literal((qr.num_rows == 0) if e.negated else (qr.num_rows > 0))
-    rec = lambda x: _rewrite_subqueries(inst, x, ctx, env)  # noqa: E731
+    rec = lambda x: _rewrite_subqueries(inst, x, ctx, env, corr)  # noqa: E731
     if isinstance(e, A.BinaryOp):
         return A.BinaryOp(e.op, rec(e.left), rec(e.right))
     if isinstance(e, A.UnaryOp):
@@ -291,8 +302,11 @@ def _qualify(e):
 
 
 def _execute_select(inst, stmt: A.Select, ctx, env) -> QueryResult:
-    # 1. materialize uncorrelated subquery expressions
-    rw = lambda e: _rewrite_subqueries(inst, e, ctx, env)  # noqa: E731
+    # 1. materialize uncorrelated subquery expressions; correlated ones
+    # decorrelate into __corr_i placeholder columns (computed over the
+    # outer frame in step 3b)
+    corr: list = []
+    rw = lambda e: _rewrite_subqueries(inst, e, ctx, env, corr)  # noqa: E731
     stmt = A.Select(
         items=[A.SelectItem(rw(it.expr), it.alias) for it in stmt.items],
         from_table=stmt.from_table,
@@ -308,11 +322,14 @@ def _execute_select(inst, stmt: A.Select, ctx, env) -> QueryResult:
         source=stmt.source, ctes=[],
     )
 
-    # 2. single base table (not a CTE/view)? delegate to the fast path
+    # 2. single base table (not a CTE/view)? delegate to the fast path —
+    # unless correlated placeholders need the frame machinery
     src = stmt.source
+    if src is None and corr and stmt.from_table:
+        src = A.TableName(stmt.from_table)
     if src is None:
         return inst._select_single(stmt, ctx)
-    if isinstance(src, A.TableName):
+    if isinstance(src, A.TableName) and not corr:
         if src.name not in env:
             db, name = inst._resolve(src.name, ctx)
             if inst.catalog.maybe_view(db, name) is None:
@@ -327,6 +344,19 @@ def _execute_select(inst, stmt: A.Select, ctx, env) -> QueryResult:
     conjuncts = [_qualify(c) for c in split_conjuncts(stmt.where)]
     frame, remaining = _eval_source(inst, src, ctx, env, conjuncts)
     fsrc = FrameSource(frame)
+
+    # 3b. correlated placeholders: one inner evaluation each, then a
+    # vectorized lookup keyed by the outer rows (semi/anti/left join)
+    if corr:
+        from greptimedb_tpu.query.decorrelate import compute_corr_col
+
+        for spec in corr:
+            col = compute_corr_col(inst, spec, fsrc, ctx, env, _qualify)
+            frame = Frame(
+                frame.quals + [None], frame.names + [spec.key],
+                frame.cols + [col],
+            )
+            fsrc = FrameSource(frame)
 
     if remaining:
         cond = remaining[0]
@@ -355,6 +385,7 @@ def _execute_select(inst, stmt: A.Select, ctx, env) -> QueryResult:
     star_columns = [
         n if q is None else f"{q}.{n}"
         for q, n in zip(frame.quals, frame.names)
+        if not n.startswith("__corr_")  # decorrelation internals
     ]
     plan = plan_select(sel, ts_name=None, tag_names=[],
                        all_columns=star_columns)
@@ -372,6 +403,23 @@ def _execute_select(inst, stmt: A.Select, ctx, env) -> QueryResult:
     engine = inst.query_engine
     if plan.kind == "plain":
         return engine._execute_plain(plan, fsrc, None)
+    if corr:
+        # placeholder columns are ROW-level; post-aggregate expressions
+        # (HAVING, select exprs over groups) evaluate at GROUP level
+        from greptimedb_tpu.query.expr import collect_columns
+
+        refs: set = set()
+        for e, _ in plan.post_items:
+            collect_columns(e, refs)
+        if plan.having is not None:
+            collect_columns(plan.having, refs)
+        for o in plan.order_by:
+            collect_columns(o.expr, refs)
+        if any(r.startswith("__corr_") for r in refs):
+            raise UnsupportedError(
+                "correlated subqueries in HAVING or post-aggregate "
+                "select expressions are not supported yet"
+            )
     return engine._execute_aggregate(plan, fsrc, None)
 
 

@@ -1,0 +1,69 @@
+-- Correlated subqueries: EXISTS / NOT EXISTS / IN / scalar (reference
+
+CREATE TABLE orders (ts TIMESTAMP TIME INDEX, cust STRING PRIMARY KEY, amount DOUBLE);
+
+INSERT INTO orders (ts, cust, amount) VALUES (1000, 'a', 10), (2000, 'a', 20), (1000, 'b', 5), (3000, 'c', 50);
+
+CREATE TABLE vip (ts TIMESTAMP TIME INDEX, name STRING PRIMARY KEY, tier BIGINT);
+
+INSERT INTO vip (ts, name, tier) VALUES (1000, 'a', 1), (1000, 'c', 2);
+
+SELECT cust, amount FROM orders o WHERE EXISTS (SELECT 1 FROM vip v WHERE v.name = o.cust) ORDER BY cust, amount;
+----
+cust|amount
+a|10.0
+a|20.0
+c|50.0
+
+SELECT cust FROM orders o WHERE NOT EXISTS (SELECT 1 FROM vip v WHERE v.name = o.cust) ORDER BY cust;
+----
+cust
+b
+
+SELECT cust FROM orders o WHERE EXISTS (SELECT 1 FROM vip v WHERE v.name = o.cust AND v.tier >= 2) ORDER BY cust;
+----
+cust
+c
+
+SELECT cust, amount, (SELECT max(tier) FROM vip v WHERE v.name = o.cust) AS t FROM orders o ORDER BY cust, amount;
+----
+cust|amount|t
+a|10.0|1.0
+a|20.0|1.0
+b|5.0|NULL
+c|50.0|2.0
+
+SELECT cust, (SELECT count(*) FROM vip v WHERE v.name = o.cust) AS n FROM orders o WHERE amount > 15 ORDER BY cust;
+----
+cust|n
+a|1
+c|1
+
+SELECT cust, amount FROM orders o WHERE amount IN (SELECT tier * 10 FROM vip v WHERE v.name = o.cust) ORDER BY cust;
+----
+cust|amount
+a|10.0
+
+SELECT cust, amount FROM orders o WHERE amount NOT IN (SELECT tier * 10 FROM vip v WHERE v.name = o.cust) ORDER BY cust, amount;
+----
+cust|amount
+a|20.0
+b|5.0
+c|50.0
+
+SELECT cust, sum(amount) AS s FROM orders o WHERE EXISTS (SELECT 1 FROM vip v WHERE v.name = o.cust) GROUP BY cust ORDER BY cust;
+----
+cust|s
+a|30.0
+c|50.0
+
+SELECT o.cust, (SELECT sum(amount) FROM orders o2 WHERE o2.cust = o.cust) AS total FROM orders o WHERE o.ts = 1000 ORDER BY o.cust;
+----
+cust|total
+a|30.0
+b|5.0
+
+DROP TABLE orders;
+
+DROP TABLE vip;
+
