@@ -139,6 +139,7 @@ _TAIL_PRIORITY = [
     "tsbs_lastpoint_sql_ms",
     "tsbs_groupby_orderby_limit_sql_ms",
     "promql_1m_series_range_p50_ms",
+    "promql_histogram_100k_p50_ms",
 ]
 _HEADLINE = "tsbs_double_groupby_all_sql_ms"
 
@@ -427,6 +428,10 @@ def phase1(tmp: str):
         # XLA program; per-query cost is independent of the series count.
         _bench_promql_1m(inst)
 
+        # histogram_quantile over 100k+ bucket series (VERDICT r3 task
+        # #6): previously generic-engine-only; now one fused program
+        _bench_promql_histogram(inst)
+
         # headline: double-groupby-all (LAST line — driver parses it)
         adj, med_wall, med_floor = _measure(
             inst, query, result_elems=len(FIELD_NAMES) * HOSTS * 12,
@@ -520,6 +525,93 @@ def _bench_promql_1m(inst):
     )
     print(json.dumps({
         "metric": "promql_1m_series_range_p50_ms",
+        "value": round(adj, 3),
+        "unit": "ms",
+        "vs_baseline": round(target_ms / adj, 2),
+        "raw_wall_ms_median": round(med_wall, 3),
+        "tunnel_floor_ms_median": round(med_floor, 3),
+    }))
+
+
+def _bench_promql_histogram(inst):
+    """histogram_quantile(0.9, rate(...[1m]))` over 100k bucket series
+    (12,500 histograms x 8 le buckets), 10 samples at 30s — the shape
+    that used to fall to the generic engine (VERDICT r3 missing #7)."""
+    from greptimedb_tpu.promql.engine import PromEngine
+    from greptimedb_tpu.servers.http import _prom_matrix_json
+
+    n_groups = 12_500
+    les = ["0.05", "0.1", "0.25", "0.5", "1", "2.5", "5", "+Inf"]
+    n_series = n_groups * len(les)
+    n_samples = 10
+    interval = 30_000
+    t0_data = 1_700_000_000_000
+    target_ms = 50.0
+
+    n_services = 50
+    inst.execute_sql(
+        "create table hist_bucket (ts timestamp time index, "
+        "pod string, svc string, le string, greptime_value double, "
+        "primary key (pod, svc, le))"
+    )
+    table = inst.catalog.table("public", "hist_bucket")
+    pods = np.repeat(
+        np.asarray([f"pod_{i}" for i in range(n_groups)], object),
+        len(les),
+    )
+    svcs = np.repeat(
+        np.asarray([f"svc_{i % n_services}" for i in range(n_groups)],
+                   object),
+        len(les),
+    )
+    le_col = np.tile(np.asarray(les, object), n_groups)
+    rng = np.random.default_rng(13)
+    # cumulative-over-time and cumulative-over-buckets counters
+    per_bucket = rng.random((n_series,)) * 5.0
+    base = np.cumsum(per_bucket.reshape(n_groups, len(les)),
+                     axis=1).ravel()
+    t_load = time.perf_counter()
+    for s in range(n_samples):
+        ts = np.full(n_series, t0_data + s * interval, np.int64)
+        table.write(
+            {"pod": pods, "svc": svcs, "le": le_col}, ts,
+            {"greptime_value": base * (s + 1)},
+            skip_wal=True,
+        )
+    print(
+        f"# histogram bench: {n_series} bucket series "
+        f"({n_groups} pods, {n_services} services) in "
+        f"{time.perf_counter() - t_load:.1f}s",
+        file=sys.stderr,
+    )
+    # the at-scale dashboard shape: quantile over service-level
+    # histograms folded from ALL 100k pod-level bucket series
+    q = ("histogram_quantile(0.9, "
+         "sum by (le, svc) (rate(hist_bucket[1m])))")
+    start = t0_data + 60_000
+    end = t0_data + (n_samples - 1) * interval
+    step = 30_000
+
+    def run():
+        engine = PromEngine(inst)
+        val, ev = engine.query_range(q, start, end, step)
+        resp = _prom_matrix_json(val, ev)
+        assert len(resp["data"]["result"]) == n_services
+        return resp
+
+    t_warm = time.perf_counter()
+    run()
+    print(
+        f"# histogram warm-up (grid build + compile): "
+        f"{time.perf_counter() - t_warm:.1f}s",
+        file=sys.stderr,
+    )
+    n_steps = (end - start) // step + 1
+    adj, med_wall, med_floor = _measure_fn(
+        run, label=q, result_elems=n_services * n_steps, runs=12,
+    )
+    print(json.dumps({
+        "metric": "promql_histogram_100k_p50_ms",
         "value": round(adj, 3),
         "unit": "ms",
         "vs_baseline": round(target_ms / adj, 2),

@@ -563,6 +563,11 @@ class PromEngine:
             return self._range_function(name, e, ev)
         if name == "histogram_quantile":
             phi = self._const_scalar(e.args[0], ev)
+            from greptimedb_tpu.promql import fast as _fast
+
+            res = _fast.try_fast_histogram(self, phi, e.args[1], ev)
+            if res is not None:
+                return res
             v = self._eval(e.args[1], ev)
             return _histogram_quantile(v, phi, ev)
         if name == "scalar":

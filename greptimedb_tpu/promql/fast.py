@@ -446,15 +446,162 @@ def _fused_query(
 # entry point
 # ----------------------------------------------------------------------
 
-def try_fast(engine, e, ev):
-    """Serve `agg(range_fn(selector))` / `agg(selector)` from the grid
-    cache. Returns a VectorValue, or None to fall back to the generic
-    path."""
-    from greptimedb_tpu.promql.engine import VectorValue, _empty_vector
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "fname", "agg_op", "g_agg", "g", "b", "range_ticks",
+        "range_seconds", "l_cells", "tps", "fargs", "lookback_ticks",
+    ),
+)
+def _fused_hist_query(
+    vals, has, tsg, smask, gid, slot, le, lo, hi, t_end, phi, *,
+    fname: str, agg_op: str, g_agg: int, g: int, b: int,
+    range_ticks: int, range_seconds: float, l_cells: int, tps: float,
+    fargs: tuple, lookback_ticks: int,
+):
+    """histogram_quantile(phi, [sum by (le, ...)] (range_fn(sel))) as
+    ONE XLA program: per-series range function, optional cross-series
+    sum, scatter into (group, bucket) slots, quantile fold — no
+    per-series host work at any cardinality (the fast-path answer to
+    the reference's HistogramFold plan,
+    /root/reference/src/promql/src/extension_plan/histogram_fold.rs)."""
+    import jax.numpy as jnp
 
-    if not isinstance(e, Agg) or e.op not in _SIMPLE_AGGS:
+    from greptimedb_tpu.ops import promql as K
+    from greptimedb_tpu.ops import window as W
+
+    has = has & smask[:, None]
+    if fname == "__instant__":
+        out, pres = W.instant_lookback(
+            vals, has, tsg, hi, t_end, lookback_ticks
+        )
+    else:
+        win = _WinShim(lo, hi, t_end, range_ticks, range_seconds,
+                       l_cells)
+        out, pres = K.eval_range_function(
+            fname, vals, has, tsg, win, _SpecShim(tps), args=fargs
+        )
+    if agg_op:
+        # inner `sum by (le, ...)`: (S_pad, J) -> (G_agg, J); slot then
+        # maps the AGGREGATED series into histogram cells. An aggregated
+        # series EXISTS iff any member survived the matcher (the
+        # generic engine's vector membership).
+        src_exists = jax.ops.segment_sum(
+            smask.astype(jnp.float32), gid, num_segments=g_agg + 1,
+        )[:g_agg] > 0
+        out, pres = K.aggregate_across_series(
+            out, pres, gid, g_agg + 1, agg_op
+        )
+        out = out[:g_agg]
+        pres = pres[:g_agg]
+        sel_mask = src_exists
+    else:
+        sel_mask = smask
+    # -> (G, B, J) via unique (group, bucket) slots
+    seg = jnp.where(sel_mask & (slot >= 0), slot, jnp.int32(g * b))
+    bsum = jax.ops.segment_sum(
+        jnp.where(pres, out, 0.0).astype(jnp.float32), seg,
+        num_segments=g * b + 1,
+    )[:-1].reshape(g, b, -1)
+    bpres = jax.ops.segment_sum(
+        pres.astype(jnp.float32), seg, num_segments=g * b + 1,
+    )[:-1].reshape(g, b, -1) > 0
+    # Prometheus: a histogram without a +Inf bucket is undefined. The
+    # +Inf bound is rank b-1 of the global layout; a group qualifies
+    # only if a MATCHER-SURVIVING series fills that cell (the host
+    # grouping is matcher-blind, so this must fold on device)
+    inf_seg = jnp.where(
+        sel_mask & (slot >= 0) & (slot % b == b - 1),
+        slot // b, jnp.int32(g),
+    )
+    has_inf = jax.ops.segment_sum(
+        jnp.ones(inf_seg.shape[0], jnp.float32), inf_seg,
+        num_segments=g + 1,
+    )[:g] > 0
+    q_out, q_ok = K.histogram_quantile(
+        le, bsum.transpose(0, 2, 1), bpres.transpose(0, 2, 1), phi,
+    )
+    q_ok = q_ok & has_inf[:, None]
+    return jnp.concatenate([q_out, q_ok.astype(q_out.dtype)])
+
+
+def _hist_grouping(entry: _Entry, table):
+    """(labels, slot (S_pad,) int32, le (B,) f64, G, B) — groups are the
+    label sets minus `le`; bucket index = rank of the series' le bound.
+    None when the layout can't serve the fast path (no le tag, unparsable
+    bounds, duplicate (group, le) series, or no +Inf bucket)."""
+    key = ("__hist__",)
+    hit = entry.group_cache.get(key)
+    if hit is not None:
+        return hit
+    reg = entry.registry
+    if "le" not in reg.tag_names:
         return None
-    inner = e.expr
+    li = reg.tag_names.index("le")
+    s = entry.num_series
+    codes = reg.codes_matrix()[:s]
+    le_raw = reg.tag_values("le")[:s]
+    le_vals = np.full(s, np.nan)
+    for i, t in enumerate(le_raw):
+        if t == "":
+            continue
+        try:
+            le_vals[i] = float(t.replace("+Inf", "inf"))
+        except ValueError:
+            pass
+    valid = np.isfinite(le_vals) | np.isposinf(le_vals)
+    if not valid.any():
+        return None
+    visible = set(table.tag_names)
+    gcols = [
+        i for i, nm in enumerate(reg.tag_names)
+        if nm != "le" and nm in visible and not nm.startswith("__")
+    ]
+    uniq_le = np.unique(le_vals[valid])
+    if not np.isposinf(uniq_le[-1]):
+        return None  # no +Inf bucket: undefined histogram
+    b = len(uniq_le)
+    bidx = np.searchsorted(uniq_le, le_vals[valid])
+    if gcols:
+        sub = codes[valid][:, gcols]
+        uniq_g, ginv = np.unique(sub, axis=0, return_inverse=True)
+        g = len(uniq_g)
+    else:
+        uniq_g = np.zeros((1, 0), codes.dtype)
+        ginv = np.zeros(int(valid.sum()), np.int64)
+        g = 1
+    slots = ginv * b + bidx
+    if len(np.unique(slots)) != len(slots):
+        return None  # duplicate (group, le): conflicting bucket series
+    slot_full = np.full(entry.s_pad, -1, np.int32)
+    slot_full[np.nonzero(valid)[0]] = slots.astype(np.int32)
+    labels = []
+    for row in uniq_g:
+        lab = {}
+        for ci, code in zip(gcols, row):
+            v = reg.dicts[ci].decode(int(code))
+            if v != "" and reg.tag_names[ci] != "__name__":
+                lab[reg.tag_names[ci]] = v
+        labels.append(lab)
+    sh = _series_sharding(getattr(entry, "mesh", None), 1)
+    if sh is not None:
+        d_slot = jax.device_put(slot_full, sh)
+    else:
+        import jax.numpy as jnp
+
+        d_slot = jnp.asarray(slot_full)
+    out = (labels, d_slot, uniq_le, g, b)
+    if len(entry.group_cache) >= 128:
+        entry.group_cache.pop(next(iter(entry.group_cache)))
+    entry.group_cache[key] = out
+    return out
+
+
+def _resolve_fast_selector(engine, inner, ev):
+    """Shared scaffold for the fast paths: match `range_fn(sel)` /
+    bare instant selector, resolve table + grid entry, plan windows.
+    Returns (entry, table, raw_matchers, fname, fargs, win) on success,
+    "empty" for a resolvable-but-empty selector, None to fall back."""
     fargs: tuple = ()
     if isinstance(inner, Call) and inner.name in _PREFIX_FNS:
         sel = inner.args[-1]
@@ -475,7 +622,7 @@ def try_fast(engine, e, ev):
         return None
     try:
         fieldname = engine._value_field(table, field_sel)
-    except Exception:
+    except Exception:  # noqa: BLE001 - resolution failure: generic path
         return None
     mesh = getattr(
         getattr(engine.instance, "query_engine", None), "mesh", None
@@ -486,7 +633,7 @@ def try_fast(engine, e, ev):
         return None
     if entry.num_series == 0:
         _FAST_HITS.labels("hit").inc()
-        return _empty_vector(ev)
+        return "empty"
     win = _plan_windows(
         entry, ev, range_ms, sel.offset_ms,
         align_range=fname != "__instant__",
@@ -494,6 +641,135 @@ def try_fast(engine, e, ev):
     if win is None:
         _FAST_HITS.labels("fallback").inc()
         return None
+    return entry, table, raw_matchers, fname, fargs, win
+
+
+def _hist_slots_from_labels(labels):
+    """Histogram cells over AGGREGATED series labels (small lists):
+    (out_labels, slot array, le array, G, B) or None."""
+    keys, le_vals = [], []
+    for lab in labels:
+        le = lab.get("le")
+        v = None
+        if le is not None:
+            try:
+                v = float(str(le).replace("+Inf", "inf"))
+            except ValueError:
+                pass
+        le_vals.append(v)
+        keys.append(tuple(sorted(
+            (k, val) for k, val in lab.items()
+            if k not in ("le", "__name__")
+        )))
+    valid = [i for i, v in enumerate(le_vals) if v is not None]
+    if not valid:
+        return None
+    uniq_le = np.unique(np.asarray([le_vals[i] for i in valid]))
+    if not np.isposinf(uniq_le[-1]):
+        return None
+    b = len(uniq_le)
+    uniq_keys = sorted({keys[i] for i in valid})
+    kidx = {k: i for i, k in enumerate(uniq_keys)}
+    g = len(uniq_keys)
+    slot = np.full(len(labels), -1, np.int32)
+    seen = set()
+    for i in valid:
+        s = kidx[keys[i]] * b + int(
+            np.searchsorted(uniq_le, le_vals[i])
+        )
+        if s in seen:
+            return None  # duplicate (group, le)
+        seen.add(s)
+        slot[i] = s
+    out_labels = [dict(k) for k in uniq_keys]
+    return out_labels, slot, uniq_le, g, b
+
+
+def try_fast_histogram(engine, phi: float, inner, ev):
+    """Serve `histogram_quantile(phi, range_fn(sel))`,
+    `histogram_quantile(phi, sel)`, and
+    `histogram_quantile(phi, sum by (le, ...)(range_fn(sel)))` from the
+    grid cache. Returns a VectorValue, or None to fall back."""
+    from greptimedb_tpu.promql.engine import VectorValue, _empty_vector
+
+    agg = None
+    if isinstance(inner, Agg) and inner.op == "sum" \
+            and not inner.without and inner.grouping \
+            and "le" in inner.grouping:
+        agg = inner
+        inner = inner.expr
+
+    resolved = _resolve_fast_selector(engine, inner, ev)
+    if resolved is None:
+        return None
+    if resolved == "empty":
+        return _empty_vector(ev)
+    entry, table, raw_matchers, fname, fargs, win = resolved
+    import jax.numpy as jnp
+
+    if agg is not None:
+        agg_labels, d_gid, g_agg = _grouping_dev(
+            entry, table, agg.grouping, agg.without
+        )
+        slots = _hist_slots_from_labels(agg_labels)
+        if slots is None:
+            _FAST_HITS.labels("fallback").inc()
+            return None
+        labels, slot_np, uniq_le, g, b = slots
+        d_slot = jnp.asarray(slot_np)
+        agg_op = "sum"
+    else:
+        grouping = _hist_grouping(entry, table)
+        if grouping is None:
+            _FAST_HITS.labels("fallback").inc()
+            return None
+        labels, d_slot, uniq_le, g, b = grouping
+        d_gid = jnp.zeros(entry.s_pad, jnp.int32)
+        g_agg = 1
+        agg_op = ""
+    lo, hi, t_end, range_ticks, range_seconds, l_cells = win
+    matchers = engine._to_registry_matchers(raw_matchers, table)
+    smask, any_match = _matcher_mask_dev(entry, matchers)
+    if not any_match:
+        _FAST_HITS.labels("hit").inc()
+        return _empty_vector(ev)
+    lookback_ticks = max(int(ev.lookback_ms // entry.spec.unit), 1)
+    packed = _fused_hist_query(
+        entry.vals, entry.has, entry.tsg, smask, d_gid, d_slot,
+        jnp.asarray(uniq_le, jnp.float32), lo, hi, t_end,
+        jnp.float32(phi),
+        fname=fname, agg_op=agg_op, g_agg=g_agg, g=g, b=b,
+        range_ticks=range_ticks,
+        range_seconds=range_seconds, l_cells=l_cells,
+        tps=entry.spec.tps, fargs=fargs, lookback_ticks=lookback_ticks,
+    )
+    packed_np = np.asarray(packed, np.float64)
+    vals_np = packed_np[:g]
+    pres_np = packed_np[g:] != 0.0
+    keep = pres_np.any(axis=1)
+    _FAST_HITS.labels("hit").inc()
+    if not keep.all():
+        idx = np.nonzero(keep)[0]
+        return VectorValue(
+            [labels[i] for i in idx], vals_np[idx], pres_np[idx]
+        )
+    return VectorValue(list(labels), vals_np, pres_np)
+
+
+def try_fast(engine, e, ev):
+    """Serve `agg(range_fn(selector))` / `agg(selector)` from the grid
+    cache. Returns a VectorValue, or None to fall back to the generic
+    path."""
+    from greptimedb_tpu.promql.engine import VectorValue, _empty_vector
+
+    if not isinstance(e, Agg) or e.op not in _SIMPLE_AGGS:
+        return None
+    resolved = _resolve_fast_selector(engine, e.expr, ev)
+    if resolved is None:
+        return None
+    if resolved == "empty":
+        return _empty_vector(ev)
+    entry, table, raw_matchers, fname, fargs, win = resolved
     lo, hi, t_end, range_ticks, range_seconds, l_cells = win
     matchers = engine._to_registry_matchers(raw_matchers, table)
     smask, any_match = _matcher_mask_dev(entry, matchers)

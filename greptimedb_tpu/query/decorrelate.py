@@ -393,7 +393,11 @@ def compute_corr_col(inst, spec: CorrSpec, fsrc, ctx, env,
             _norm(vcol.values[i]) if bool(vcol.valid_mask[i]) else _NULL
         )
     default = _NULL
-    if spec.empty_default is not None:
+    if spec.empty_default is not None and any(
+        k is None or k not in by_key for k in okeys
+    ):
+        # lazy: only outer rows with NO matching inner rows need the
+        # empty-input aggregate value
         dq = relational.execute(inst, spec.empty_default, ctx, env)
         if dq.num_rows == 1:
             dc = dq.cols[0]
