@@ -90,6 +90,14 @@ class HostColumn:
             if arr.null_count:
                 arr = arr.fill_null(0)
             values = np.asarray(arr).astype(np.int64)
+        elif dt.is_interval():
+            # normalize any duration unit to the type's int64-ms
+            # representation (a duration("s") 5 must become 5000, and
+            # even duration("ms") must land as int64, not timedelta64)
+            arr = arr.cast(pa.duration("ms")).cast(pa.int64())
+            if arr.null_count:
+                arr = arr.fill_null(0)
+            values = np.asarray(arr)
         else:
             if arr.null_count:
                 arr = arr.fill_null(0)

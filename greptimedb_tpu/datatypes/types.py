@@ -38,6 +38,11 @@ class TypeId(enum.Enum):
     TIMESTAMP_NANOSECOND = "timestamp_ns"
     DATE = "date"
     JSON = "json"
+    # INTERVAL: a duration, stored as int64 milliseconds (the reference
+    # carries IntervalMonthDayNano, src/common/time/src/interval.rs; the
+    # fixed-ms form covers the arithmetic/ordering surface this engine
+    # computes with)
+    INTERVAL = "interval"
     # Decimal128 (reference: src/common/decimal/): exact (precision,
     # scale) at the schema/wire/Parquet boundary; the in-memory and
     # on-device representation is float64 (the TPU computes in floats —
@@ -140,6 +145,13 @@ class ConcreteDataType:
         return ConcreteDataType(TypeId.DATE)
 
     @staticmethod
+    def interval() -> "ConcreteDataType":
+        return ConcreteDataType(TypeId.INTERVAL)
+
+    def is_interval(self) -> bool:
+        return self.id == TypeId.INTERVAL
+
+    @staticmethod
     def decimal128(precision: int = 38, scale: int = 10
                    ) -> "ConcreteDataType":
         if not (1 <= precision <= 38):
@@ -203,6 +215,8 @@ class ConcreteDataType:
             return pa.binary()
         if t == TypeId.DATE:
             return pa.date32()
+        if t == TypeId.INTERVAL:
+            return pa.duration("ms")
         if self.is_timestamp():
             return pa.timestamp(_TS_UNITS[t])
         return pa.type_for_alias(t.value)
@@ -213,7 +227,7 @@ class ConcreteDataType:
             return np.dtype(np.bool_)
         if t in (TypeId.STRING, TypeId.JSON, TypeId.BINARY):
             return np.dtype(object)
-        if self.is_timestamp() or t == TypeId.DATE:
+        if self.is_timestamp() or t in (TypeId.DATE, TypeId.INTERVAL):
             return np.dtype(np.int64)
         if t == TypeId.DECIMAL:
             return np.dtype(np.float64)
@@ -237,6 +251,8 @@ class ConcreteDataType:
             )
         if pa.types.is_date(dt):
             return ConcreteDataType.date()
+        if pa.types.is_duration(dt):
+            return ConcreteDataType.interval()
         if pa.types.is_decimal(dt):
             return ConcreteDataType.decimal128(dt.precision, dt.scale)
         if pa.types.is_string(dt) or pa.types.is_large_string(dt):

@@ -202,3 +202,36 @@ def multi_aggregate(values, seg, mask, num_segments: int, ops: tuple[str, ...]):
         else:
             raise ValueError(f"unknown aggregate op: {op}")
     return results
+
+
+# ----------------------------------------------------------------------
+# segmented scans (window-function running frames)
+# ----------------------------------------------------------------------
+
+@jax.jit
+def segmented_cumsum(values: jax.Array, reset: jax.Array) -> jax.Array:
+    """Per-segment running sum: `reset[i]` marks the first row of a
+    segment (partition). One associative_scan — O(log n) depth on
+    device, the running-aggregate half of SQL window frames
+    (ref: DataFusion WindowAggExec via src/query/src/datafusion.rs)."""
+    def comb(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av + bv), af | bf
+
+    v, _ = jax.lax.associative_scan(comb, (values, reset))
+    return v
+
+
+@functools.partial(jax.jit, static_argnames=("take_max",))
+def segmented_cumextreme(values: jax.Array, reset: jax.Array,
+                         *, take_max: bool) -> jax.Array:
+    """Per-segment running max (or min) via one associative scan."""
+    def comb(a, b):
+        av, af = a
+        bv, bf = b
+        merged = jnp.maximum(av, bv) if take_max else jnp.minimum(av, bv)
+        return jnp.where(bf, bv, merged), af | bf
+
+    v, _ = jax.lax.associative_scan(comb, (values, reset))
+    return v

@@ -533,10 +533,17 @@ def _hist_grouping(entry: _Entry, table):
     key = ("__hist__",)
     hit = entry.group_cache.get(key)
     if hit is not None:
-        return hit
+        return None if hit == "unservable" else hit
+
+    def reject():
+        # negative-cache: an unservable layout must not re-pay the
+        # O(series) le-parsing on every query before falling back
+        entry.group_cache[key] = "unservable"
+        return None
+
     reg = entry.registry
     if "le" not in reg.tag_names:
-        return None
+        return reject()
     li = reg.tag_names.index("le")
     s = entry.num_series
     codes = reg.codes_matrix()[:s]
@@ -551,7 +558,7 @@ def _hist_grouping(entry: _Entry, table):
             pass
     valid = np.isfinite(le_vals) | np.isposinf(le_vals)
     if not valid.any():
-        return None
+        return reject()
     visible = set(table.tag_names)
     gcols = [
         i for i, nm in enumerate(reg.tag_names)
@@ -559,7 +566,7 @@ def _hist_grouping(entry: _Entry, table):
     ]
     uniq_le = np.unique(le_vals[valid])
     if not np.isposinf(uniq_le[-1]):
-        return None  # no +Inf bucket: undefined histogram
+        return reject()  # no +Inf bucket: undefined histogram
     b = len(uniq_le)
     bidx = np.searchsorted(uniq_le, le_vals[valid])
     if gcols:
@@ -572,7 +579,7 @@ def _hist_grouping(entry: _Entry, table):
         g = 1
     slots = ginv * b + bidx
     if len(np.unique(slots)) != len(slots):
-        return None  # duplicate (group, le): conflicting bucket series
+        return reject()  # duplicate (group, le): conflicting buckets
     slot_full = np.full(entry.s_pad, -1, np.int32)
     slot_full[np.nonzero(valid)[0]] = slots.astype(np.int32)
     labels = []

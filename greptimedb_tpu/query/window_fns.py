@@ -106,19 +106,26 @@ def replace_window_calls(e: A.Expr, mapping: dict) -> A.Expr:
     return e
 
 
-def _frame_mode(spec: A.WindowSpec) -> str:
-    """-> 'running' (RANGE: peers share the frame end) |
-    'running_rows' (ROWS: strictly per-row) | 'whole'."""
+def _frame_mode(spec: A.WindowSpec) -> tuple[str, int | None]:
+    """-> (mode, k): 'running' (RANGE: peers share the frame end) |
+    'running_rows' (ROWS: strictly per-row) | 'whole' |
+    'rows_pre' (ROWS BETWEEN k PRECEDING AND CURRENT ROW, k in slot)."""
     if spec.frame is None:
-        return "running" if spec.order_by else "whole"
+        return ("running" if spec.order_by else "whole"), None
     text = spec.frame.upper()
     body = text.split("BETWEEN", 1)[-1].strip()
     if body == "UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING":
-        return "whole"
+        return "whole", None
     if body == "UNBOUNDED PRECEDING AND CURRENT ROW":
         if not spec.order_by:
-            return "whole"
-        return "running_rows" if text.startswith("ROWS") else "running"
+            return "whole", None
+        return ("running_rows" if text.startswith("ROWS")
+                else "running"), None
+    import re as _re
+
+    m = _re.fullmatch(r"(\d+)\s+PRECEDING\s+AND\s+CURRENT\s+ROW", body)
+    if m and text.startswith("ROWS"):
+        return "rows_pre", int(m.group(1))
     raise UnsupportedError(f"window frame not supported: {spec.frame}")
 
 
@@ -139,7 +146,7 @@ def eval_window(fc: A.FuncCall, src) -> Col:
     n = src.num_rows
     if n == 0:
         return Col(np.zeros(0))
-    mode = _frame_mode(spec)
+    mode, frame_k = _frame_mode(spec)
 
     # ---- partition ids + intra-partition order ------------------------
     part_keys = [_key_codes(eval_expr(p, src)) for p in spec.partition_by]
@@ -180,7 +187,8 @@ def eval_window(fc: A.FuncCall, src) -> Col:
         peer_start = part_start.copy()
 
     out_ordered, validity_ordered = _dispatch(
-        fc, src, mode, order, part_start, peer_start, n
+        fc, src, mode, order, part_start, peer_start, n,
+        frame_k=frame_k,
     )
     inv = np.empty(n, np.int64)
     inv[order] = np.arange(n)
@@ -211,7 +219,8 @@ def _partition_index(part_start: np.ndarray) -> np.ndarray:
     return idx - start_idx
 
 
-def _dispatch(fc, src, mode, order, part_start, peer_start, n):
+def _dispatch(fc, src, mode, order, part_start, peer_start, n, *,
+              frame_k: int | None = None):
     name = fc.name
     within = _partition_index(part_start)
     part_id = np.cumsum(part_start) - 1
@@ -302,6 +311,20 @@ def _dispatch(fc, src, mode, order, part_start, peer_start, n):
         first_pos = np.maximum.accumulate(
             np.where(part_start, np.arange(n), 0)
         )
+        if mode == "rows_pre":
+            # frame = [max(i - k, partition start), i]
+            fs = np.maximum(np.arange(n) - frame_k, first_pos)
+            if name == "first_value":
+                return vals[fs], valid[fs]
+            if name == "last_value":
+                return vals, valid
+            from greptimedb_tpu.query.expr import eval_const
+
+            k2 = int(eval_const(fc.args[1])) - 1
+            # membership BEFORE clamping: a frame with < N rows is NULL
+            ok = (fs + k2) <= np.arange(n)
+            pos = np.minimum(fs + k2, n - 1)
+            return vals[pos], ok & valid[pos]
         if name == "first_value":
             return vals[first_pos], valid[first_pos]
         if name == "nth_value":
@@ -344,7 +367,7 @@ def _dispatch(fc, src, mode, order, part_start, peer_start, n):
         vals = col.values[order]
         valid = col.valid_mask[order]
         return _agg_over(name, vals, valid, mode, part_start, peer_start,
-                         part_id, n)
+                         part_id, n, frame_k=frame_k)
 
     raise UnsupportedError(f"window function {name!r} not supported")
 
@@ -358,7 +381,78 @@ def _part_last(part_start: np.ndarray, n: int) -> np.ndarray:
     return ends
 
 
-def _agg_over(name, vals, valid, mode, part_start, peer_start, part_id, n):
+# rows at/above this run the running scans on the device (segmented
+# associative scans, ops/segment.py); below it host numpy wins on
+# dispatch latency
+DEVICE_THRESHOLD = 262_144
+
+
+def _x64_enabled() -> bool:
+    import jax
+
+    try:
+        return bool(jax.config.read("jax_enable_x64"))
+    except Exception:  # noqa: BLE001 - config API drift
+        return False
+
+
+def _running_scans(numeric, cnt, valid, part_start, name, n):
+    """(run_sum, run_cnt, run_minmax|None, path) — running aggregates
+    within partitions, on device for large inputs."""
+    from greptimedb_tpu.query import stats
+
+    want_mm = name in ("min", "max")
+    if n >= DEVICE_THRESHOLD and _x64_enabled():
+        # without x64 a device prefix sum would accumulate in f32 (and
+        # min/max would round the VALUES to f32), silently diverging
+        # from the host's f64 — stay host then
+        import jax.numpy as jnp
+
+        from greptimedb_tpu.ops import segment as S
+
+        with stats.timed("window_device_ms"):
+            d_reset = jnp.asarray(part_start)
+            run_sum = np.asarray(S.segmented_cumsum(
+                jnp.asarray(numeric, jnp.float64), d_reset
+            ))
+            run_cnt = np.asarray(S.segmented_cumsum(
+                jnp.asarray(cnt, jnp.int64), d_reset
+            ))
+            run_mm = None
+            if want_mm:
+                masked = np.where(valid, numeric,
+                                  -np.inf if name == "max" else np.inf)
+                run_mm = np.asarray(S.segmented_cumextreme(
+                    jnp.asarray(masked, jnp.float64), d_reset,
+                    take_max=name == "max",
+                ))
+        stats.note("exec_path_window", "device")
+        return run_sum, run_cnt, run_mm, "device"
+    csum = np.cumsum(numeric)
+    ccnt = np.cumsum(cnt)
+    starts = np.where(part_start)[0]
+    base_sum = np.repeat(
+        np.append(0.0, csum[starts[1:] - 1]),
+        np.diff(np.append(starts, n)),
+    )
+    base_cnt = np.repeat(
+        np.append(0, ccnt[starts[1:] - 1]),
+        np.diff(np.append(starts, n)),
+    )
+    run_mm = None
+    if want_mm:
+        masked = np.where(valid, numeric,
+                          -np.inf if name == "max" else np.inf)
+        op = np.maximum if name == "max" else np.minimum
+        run_mm = np.empty(n)
+        for s, e in zip(starts, np.append(starts[1:], n)):
+            run_mm[s:e] = op.accumulate(masked[s:e])
+    stats.note("exec_path_window", "host")
+    return csum - base_sum, ccnt - base_cnt, run_mm, "host"
+
+
+def _agg_over(name, vals, valid, mode, part_start, peer_start, part_id, n,
+              *, frame_k: int | None = None):
     numeric = np.where(valid, vals.astype(np.float64, copy=False), 0.0) \
         if vals.dtype != object else None
     if numeric is None:
@@ -382,30 +476,16 @@ def _agg_over(name, vals, valid, mode, part_start, peer_start, part_id, n):
         getattr(op, "at")(red, part_id, masked)
         c = np.bincount(part_id, weights=cnt, minlength=nparts)
         return red[part_id], (c[part_id] > 0)
+    if mode == "rows_pre":
+        return _agg_rows_pre(name, numeric, cnt, valid, part_start, n,
+                             frame_k)
     # running: cumulative within partition, then peers share the value at
     # the END of their peer group (SQL default RANGE frame)
-    csum = np.cumsum(numeric)
-    ccnt = np.cumsum(cnt)
-    starts = np.where(part_start)[0]
-    base_sum = np.repeat(
-        np.append(0.0, csum[starts[1:] - 1]),
-        np.diff(np.append(starts, n)),
+    run_sum, run_cnt, run_mm, _path = _running_scans(
+        numeric, cnt, valid, part_start, name, n
     )
-    base_cnt = np.repeat(
-        np.append(0, ccnt[starts[1:] - 1]),
-        np.diff(np.append(starts, n)),
-    )
-    run_sum = csum - base_sum
-    run_cnt = ccnt - base_cnt
     if name in ("min", "max"):
-        masked = np.where(valid, numeric,
-                          -np.inf if name == "max" else np.inf)
-        op = np.maximum if name == "max" else np.minimum
-        run = np.empty(n)
-        starts = np.where(part_start)[0]
-        for s, e in zip(starts, np.append(starts[1:], n)):
-            # accumulate is vectorized per partition slice
-            run[s:e] = op.accumulate(masked[s:e])
+        run = run_mm
     elif name == "count":
         run = run_cnt
     elif name in ("avg", "mean"):
@@ -428,3 +508,53 @@ def _agg_over(name, vals, valid, mode, part_start, peer_start, part_id, n):
     if name == "count":
         return run.astype(np.int64), None
     return run, (run_cnt_b > 0)
+
+
+def _agg_rows_pre(name, numeric, cnt, valid, part_start, n, k: int):
+    """ROWS BETWEEN k PRECEDING AND CURRENT ROW: sliding frames via
+    prefix-sum differences (sum/count/avg) or a windowed reduce
+    (min/max)."""
+    start_idx = np.maximum.accumulate(
+        np.where(part_start, np.arange(n), 0)
+    )
+    fs = np.maximum(np.arange(n) - k, start_idx)  # frame start
+    if name in ("sum", "avg", "mean", "count"):
+        csum = np.cumsum(numeric)
+        ccnt = np.cumsum(cnt)
+        # window = csum[i] - csum[fs-1] (fs==0 -> 0)
+        prev = fs - 1
+        base_s = np.where(prev >= 0, csum[np.maximum(prev, 0)], 0.0)
+        base_c = np.where(prev >= 0, ccnt[np.maximum(prev, 0)], 0)
+        w_sum = csum - base_s
+        w_cnt = ccnt - base_c
+        if name == "count":
+            return w_cnt.astype(np.int64), None
+        if name in ("avg", "mean"):
+            return w_sum / np.maximum(w_cnt, 1), (w_cnt > 0)
+        return w_sum, (w_cnt > 0)
+    if name in ("min", "max"):
+        ident = -np.inf if name == "max" else np.inf
+        masked = np.where(valid, numeric, ident)
+        # windowed reduce over k+1 trailing positions, partition-
+        # clipped; processed in row chunks so peak memory is bounded at
+        # chunk*(k+1) elements instead of n*(k+1)
+        pad = np.concatenate([np.full(k, ident), masked])
+        out = np.empty(n)
+        chunk = max(1, (1 << 22) // (k + 1))
+        offs = np.arange(-k, 1)[None, :]
+        for s in range(0, n, chunk):
+            e = min(s + chunk, n)
+            win = np.lib.stride_tricks.sliding_window_view(
+                pad[s:e + k], k + 1
+            )
+            rel = offs + np.arange(s, e)[:, None]
+            w = np.where(rel >= fs[s:e, None], win, ident)
+            out[s:e] = w.max(axis=1) if name == "max" else w.min(axis=1)
+        # validity: any valid row inside the frame
+        ccnt = np.cumsum(cnt)
+        prev = fs - 1
+        base_c = np.where(prev >= 0, ccnt[np.maximum(prev, 0)], 0)
+        return out, (ccnt - base_c > 0)
+    raise UnsupportedError(
+        f"{name}() with a ROWS k PRECEDING frame is not supported"
+    )
