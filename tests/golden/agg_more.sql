@@ -1,0 +1,41 @@
+-- additional aggregate coverage (common/aggregate + function)
+
+CREATE TABLE am (g STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(g));
+
+INSERT INTO am (g, v, ts) VALUES ('a', 1, 1000), ('a', 2, 2000), ('a', 3, 3000), ('b', 10, 1000), ('b', 30, 2000);
+
+SELECT g, first_value(v ORDER BY ts) AS f, last_value(v ORDER BY ts) AS l FROM am GROUP BY g ORDER BY g;
+----
+g|f|l
+a|1.0|3.0
+b|10.0|30.0
+
+SELECT g, var_pop(v) FROM am GROUP BY g ORDER BY g;
+----
+g|var_pop(v)
+a|0.666667
+b|100.0
+
+SELECT median(v) FROM am;
+----
+median(v)
+3.0
+
+SELECT g, count(*) FROM am GROUP BY g ORDER BY count(*) DESC;
+----
+g|count(*)
+a|3
+b|2
+
+SELECT sum(v) + count(*) FROM am;
+----
+sum(v) + count(*)
+51.0
+
+SELECT avg(v * v) - avg(v) * avg(v) AS variance FROM am WHERE g = 'a';
+----
+variance
+0.666667
+
+DROP TABLE am;
+

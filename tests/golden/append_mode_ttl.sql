@@ -1,0 +1,28 @@
+-- table WITH options: append_mode, merge_mode (common/create + mito)
+
+CREATE TABLE ap (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE) WITH (append_mode = 'true');
+
+INSERT INTO ap (ts, host, v) VALUES (1000, 'a', 1.0);
+
+INSERT INTO ap (ts, host, v) VALUES (1000, 'a', 2.0);
+
+SELECT count(*) FROM ap;
+----
+count(*)
+2
+
+CREATE TABLE lww (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO lww (ts, host, v) VALUES (1000, 'a', 1.0);
+
+INSERT INTO lww (ts, host, v) VALUES (1000, 'a', 2.0);
+
+SELECT v FROM lww;
+----
+v
+2.0
+
+DROP TABLE ap;
+
+DROP TABLE lww;
+

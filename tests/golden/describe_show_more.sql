@@ -1,0 +1,35 @@
+-- DESCRIBE / SHOW FULL / information_schema columns (common/describe)
+
+CREATE TABLE dsm (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE NOT NULL, note STRING DEFAULT 'x');
+
+DESCRIBE dsm;
+----
+Column|Type|Key|Null|Default|Semantic Type
+ts|TIMESTAMP(3)|PRI|NO||TIMESTAMP
+host|STRING|PRI|NO||TAG
+v|DOUBLE||NO||FIELD
+note|STRING||YES|x|FIELD
+
+SHOW FULL COLUMNS FROM dsm;
+----
+Column|Type|Null|Key|Default|Semantic Type
+ts|timestamp_ms|No|TIME INDEX||TIMESTAMP
+host|string|No|PRI||TAG
+v|float64|No|||FIELD
+note|string|Yes||x|FIELD
+
+SELECT column_name, data_type, semantic_type FROM information_schema.columns WHERE table_name = 'dsm' ORDER BY column_name;
+----
+column_name|data_type|semantic_type
+host|string|TAG
+note|string|FIELD
+ts|timestamp_ms|TIMESTAMP
+v|float64|FIELD
+
+SELECT table_name, table_type FROM information_schema.tables WHERE table_name = 'dsm';
+----
+table_name|table_type
+dsm|BASE TABLE
+
+DROP TABLE dsm;
+

@@ -1,0 +1,24 @@
+-- TQL subqueries + offset (promql/)
+
+CREATE TABLE sq (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, greptime_value DOUBLE);
+
+INSERT INTO sq (ts, host, greptime_value) VALUES
+  (0, 'a', 0), (30000, 'a', 30), (60000, 'a', 60), (90000, 'a', 90), (120000, 'a', 120);
+
+TQL EVAL (120, 120, '30s') sq offset 1m;
+----
+ts|value|__name__|host
+120000|60.0|sq|a
+
+TQL EVAL (120, 120, '30s') max_over_time(sq[1m:30s]);
+----
+ts|value|host
+120000|120.0|a
+
+TQL EVAL (120, 120, '30s') avg_over_time(rate(sq[1m])[1m:30s]);
+----
+ts|value|host
+120000|1.0|a
+
+DROP TABLE sq;
+

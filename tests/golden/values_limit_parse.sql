@@ -1,0 +1,28 @@
+-- parser edge cases: quoted identifiers, comments, negative literals
+
+CREATE TABLE pe (ts TIMESTAMP TIME INDEX, "select" DOUBLE, v DOUBLE);
+
+INSERT INTO pe (ts, "select", v) VALUES (1000, -1.5, 2e3);
+
+SELECT "select", v FROM pe;
+----
+select|v
+-1.5|2000.0
+
+SELECT v FROM pe WHERE v = 2000.0;
+----
+v
+2000.0
+
+SELECT 1 + /* inline */ 2;
+----
+1 + 2
+3
+
+SELECT 'it''s quoted';
+----
+'it''s quoted'
+it's quoted
+
+DROP TABLE pe;
+
