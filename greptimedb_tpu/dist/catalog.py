@@ -356,6 +356,21 @@ class DistCatalogManager(CatalogManager):
         source/sink tables appear; region proxies are cheap to
         rebuild)."""
         with self._lock:
+            # drop clients whose node re-registered at a new address
+            # (a restarted datanode binds a fresh port) — otherwise the
+            # post-failover retry redials the dead socket forever
+            try:
+                peers = self.meta.peers()
+            except Exception:  # noqa: BLE001 - metasrv momentarily away
+                peers = None
+            if peers is not None:
+                for nid, cli in list(self._clients.items()):
+                    if peers.get(nid) != cli.addr:
+                        try:
+                            cli.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        del self._clients[nid]
             self._databases = {}
             self._views = {}
             self._load()

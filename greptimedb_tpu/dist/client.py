@@ -12,12 +12,22 @@ import json
 import threading
 import urllib.request
 
-from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.errors import DatanodeUnavailableError, GreptimeError
 
 
 def _strip_flight_error(e) -> str:
     msg = str(e).split("gRPC client debug context")[0]
     return msg.split(". Detail: Failed")[0].strip().rstrip(". ")
+
+
+def _is_unavailable(e) -> bool:
+    import pyarrow.flight as flight
+
+    if isinstance(e, (flight.FlightUnavailableError,
+                      flight.FlightTimedOutError, ConnectionError)):
+        return True
+    return "unavailable" in str(e).lower() or \
+        "failed to connect" in str(e).lower()
 
 
 class DatanodeClient:
@@ -45,6 +55,19 @@ class DatanodeClient:
                     pass
                 self._conn = None
 
+    def _raise(self, e):
+        """Map a Flight error: unreachable datanodes raise the
+        RETRYABLE DatanodeUnavailableError (and drop the cached
+        connection so the next call redials — failover may have moved
+        the regions)."""
+        if _is_unavailable(e):
+            self.close()
+            raise DatanodeUnavailableError(
+                f"datanode {self.addr} unreachable: "
+                f"{_strip_flight_error(e)}"
+            ) from None
+        raise GreptimeError(_strip_flight_error(e)) from None
+
     # ---- actions ------------------------------------------------------
     def action(self, kind: str, body: dict | None = None) -> dict:
         import pyarrow.flight as flight
@@ -54,7 +77,7 @@ class DatanodeClient:
                 flight.Action(kind, json.dumps(body or {}).encode())
             ))
         except flight.FlightError as e:
-            raise GreptimeError(_strip_flight_error(e)) from None
+            self._raise(e)
         if not results:
             return {}
         return json.loads(results[0].body.to_pybytes() or b"{}")
@@ -114,7 +137,7 @@ class DatanodeClient:
             )
             table = reader.read_all()
         except flight.FlightError as e:
-            raise GreptimeError(_strip_flight_error(e)) from None
+            self._raise(e)
         meta = table.schema.metadata or {}
         stats = json.loads(meta.get(b"gtdb:stats", b"{}"))
         names = (fields if fields is not None else [
@@ -135,7 +158,7 @@ class DatanodeClient:
             ))
             return reader.read_all()
         except flight.FlightError as e:
-            raise GreptimeError(_strip_flight_error(e)) from None
+            self._raise(e)
 
     def write_regions(self, puts: list[dict]):
         """puts: [{region_id, op, skip_wal, tag_columns, ts, fields,
@@ -173,7 +196,7 @@ class DatanodeClient:
                 writer.write_with_metadata(batch, meta)
             writer.close()
         except flight.FlightError as e:
-            raise GreptimeError(_strip_flight_error(e)) from None
+            self._raise(e)
 
 
 class MetaClient:

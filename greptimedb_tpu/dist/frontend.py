@@ -41,6 +41,23 @@ class DistInstance(Standalone):
         self._flow_sources: set[tuple[str, str]] = set()
         self._flow_sources_at = 0.0
 
+    def execute_statement(self, stmt, ctx):
+        from greptimedb_tpu.errors import DatanodeUnavailableError
+        from greptimedb_tpu.sql import ast as A
+
+        try:
+            return super().execute_statement(stmt, ctx)
+        except DatanodeUnavailableError:
+            # failover may have moved the dead node's regions: refresh
+            # routes from the metasrv and retry ONCE. Reads only — a
+            # partially-applied write must not replay (append-mode
+            # tables would duplicate rows).
+            if not isinstance(stmt, (A.Select, A.SetOp, A.Tql,
+                                     A.Explain, A.DescribeTable)):
+                raise
+            self.catalog.refresh()
+            return super().execute_statement(stmt, ctx)
+
     def _flownode(self):
         if self.flownode_addr is None:
             return None

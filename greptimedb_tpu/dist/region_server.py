@@ -96,6 +96,10 @@ class RegionServer:
     def truncate_region(self, region_id: int) -> None:
         self._region(region_id).truncate()
 
+    def set_region_writable(self, region_id: int, writable: bool) -> None:
+        """Migration fencing: a downgraded leader rejects writes."""
+        self._region(region_id).writable = writable
+
     def alter_region(self, region_id: int, op: str, name: str) -> None:
         """Schema change on an open region (ALTER TABLE fan-out)."""
         region = self._region(region_id)

@@ -1,0 +1,130 @@
+"""Wire-topology cluster operations for the metasrv.
+
+The RegionMigrationProcedure state machine (meta/metasrv.py) drives a
+`cluster` attached to the metasrv. In-process deployments attach
+cluster.Cluster; a ROLE-PROCESS deployment attaches this adapter, which
+executes the same open/downgrade/upgrade/close steps against datanode
+PROCESSES over Arrow Flight — the reference's region-failover path
+(/root/reference/src/meta-srv/src/procedure/region_migration/, with
+heartbeat-fed phi detectors triggering RegionFailoverProcedures).
+
+Prerequisite, exactly as in the reference: datanodes share an object
+store (and, for unflushed-row survival, a shared/object WAL) so a
+region's data is reachable from its new owner.
+"""
+
+from __future__ import annotations
+
+import time
+
+from greptimedb_tpu.catalog.manager import _REGION_SHIFT
+from greptimedb_tpu.dist.catalog import TABLE_PREFIX
+from greptimedb_tpu.errors import IllegalStateError, RegionNotFoundError
+
+_META_TTL_S = 5.0
+
+
+class WireCluster:
+    def __init__(self, metasrv):
+        self.metasrv = metasrv
+        self._clients: dict[int, object] = {}
+        # table_id -> (meta_doc builder input, fetched_at): failing over
+        # R regions must not rescan the whole catalog R times
+        self._info_cache: dict[int, tuple[object, float]] = {}
+
+    # ------------------------------------------------------------------
+    def _client(self, node_id: int):
+        """Client for a node's CURRENT address — a restarted datanode
+        re-registers on a new port, so the cache re-resolves."""
+        addr = self.metasrv.peers().get(node_id)
+        if addr is None:
+            raise IllegalStateError(
+                f"datanode {node_id} has no registered address"
+            )
+        cli = self._clients.get(node_id)
+        if cli is not None and cli.addr != addr:
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001
+                pass
+            cli = None
+        if cli is None:
+            from greptimedb_tpu.dist.client import DatanodeClient
+
+            cli = DatanodeClient(addr)
+            self._clients[node_id] = cli
+        return cli
+
+    def _table_info(self, table_id: int):
+        import json
+
+        from greptimedb_tpu.catalog.manager import TableInfo
+
+        hit = self._info_cache.get(table_id)
+        if hit is not None and time.monotonic() - hit[1] < _META_TTL_S:
+            return hit[0]
+        for _key, raw in self.metasrv.kv.range(TABLE_PREFIX):
+            info_doc = json.loads(raw)
+            if info_doc.get("table_id") == table_id:
+                info = TableInfo.from_json(info_doc)
+                self._info_cache[table_id] = (info, time.monotonic())
+                return info
+        raise RegionNotFoundError(
+            f"table {table_id} is not in the catalog"
+        )
+
+    def _region_meta_doc(self, region_id: int) -> dict:
+        from greptimedb_tpu.dist.remote import region_meta_doc
+
+        return region_meta_doc(
+            self._table_info(region_id >> _REGION_SHIFT), region_id
+        )
+
+    # ------------------------------------------------------------------
+    # the procedure-facing surface (cluster.Cluster contract)
+    # ------------------------------------------------------------------
+    def open_region_on(self, node_id: int, region_id: int, *,
+                       writable: bool) -> None:
+        cli = self._client(node_id)
+        cli.open_region(self._region_meta_doc(region_id))
+        if not writable:
+            cli.action("set_region_writable",
+                       {"region_id": region_id, "writable": False})
+
+    def downgrade_region_on(self, node_id: int, region_id: int) -> None:
+        """Graceful handover FENCES the old leader (writes rejected),
+        then flushes it; a DEAD node (the failover case) is skipped."""
+        try:
+            cli = self._client(node_id)
+            cli.action("set_region_writable",
+                       {"region_id": region_id, "writable": False})
+            cli.flush_region(region_id)
+        except Exception:  # noqa: BLE001 - dead/unreachable source
+            pass
+
+    def upgrade_region_on(self, node_id: int, region_id: int) -> None:
+        # close + reopen, NOT a bare open: the candidate was opened
+        # before the leader's downgrade flush, so its manifest snapshot
+        # predates those SSTs; reopening replays the manifest (and WAL)
+        # — the same reason cluster.Cluster.upgrade_region_on reopens
+        cli = self._client(node_id)
+        try:
+            cli.action("close_region", {"region_id": region_id})
+        except Exception:  # noqa: BLE001 - candidate open may be gone
+            pass
+        cli.open_region(self._region_meta_doc(region_id))
+
+    def close_region_on(self, node_id: int, region_id: int) -> None:
+        try:
+            self._client(node_id).action(
+                "close_region", {"region_id": region_id}
+            )
+        except Exception:  # noqa: BLE001 - dead/unreachable source
+            pass
+
+    def close(self):
+        for cli in self._clients.values():
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001
+                pass

@@ -119,6 +119,14 @@ class StorageError(GreptimeError):
     status_code = StatusCode.STORAGE_UNAVAILABLE
 
 
+class DatanodeUnavailableError(GreptimeError):
+    """A datanode process is unreachable (connection refused/timeout) —
+    retryable after a route refresh (failover may have moved its
+    regions)."""
+
+    status_code = StatusCode.STORAGE_UNAVAILABLE
+
+
 class FlowNotFoundError(GreptimeError):
     status_code = StatusCode.FLOW_NOT_FOUND
 

@@ -240,6 +240,9 @@ class FlightServer(flight.FlightServerBase):
         elif kind == "alter_region":
             rs.alter_region(int(body["region_id"]), body["op"],
                             body["name"])
+        elif kind == "set_region_writable":
+            rs.set_region_writable(int(body["region_id"]),
+                                   bool(body["writable"]))
         elif kind == "region_stats":
             return {"stats": rs.region_stats(
                 [int(r) for r in body["region_ids"]]

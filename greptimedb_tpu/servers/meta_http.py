@@ -157,6 +157,20 @@ class MetasrvServer:
             else MemoryKv()
         )
         self.metasrv = Metasrv(self.kv, selector=selector)
+        # region failover/migration executes against datanode PROCESSES
+        # over Flight (dist/wire_cluster.py); procedures resume across
+        # metasrv restarts via the persisted procedure store
+        from greptimedb_tpu.dist.wire_cluster import WireCluster
+        from greptimedb_tpu.meta.metasrv import RegionMigrationProcedure
+
+        self.metasrv.cluster = WireCluster(self.metasrv)
+        self.metasrv.procedures.register_loader(
+            RegionMigrationProcedure.type_name, RegionMigrationProcedure
+        )
+        # recovery happens ON LEADERSHIP (see _tick_loop): an HA standby
+        # sharing this kv must not double-drive procedures the live
+        # leader is still executing
+        self._recovered = False
         self.addr = addr
         self.port = port
         # HA: candidates sharing a kv (same data_home) elect ONE leader
@@ -177,7 +191,16 @@ class MetasrvServer:
         while not self._stop.wait(1.0):
             try:
                 if self.election.is_leader:
+                    if not self._recovered:
+                        # first tick as leader: resume procedures a
+                        # crashed predecessor left 'running'
+                        self._recovered = True
+                        self.metasrv.procedures.recover(self.metasrv)
                     self.metasrv.tick()
+                else:
+                    # leadership lost: a later re-acquisition must
+                    # re-check the procedure store
+                    self._recovered = False
             except Exception:
                 pass
 

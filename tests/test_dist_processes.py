@@ -116,7 +116,7 @@ def topology(tmp_path):
         _wait_port(port)
 
     # wait until every datanode registered its peer address
-    deadline = time.time() + 60
+    deadline = time.time() + 120
     while time.time() < deadline:
         with urllib.request.urlopen(
             f"http://127.0.0.1:{meta_port}/peers", timeout=2
@@ -216,7 +216,7 @@ def test_multiprocess_flow_mirroring(topology):
              "('a', 10.0, 1700000000000), ('a', 30.0, 1700000010000), "
              "('b', 50.0, 1700000020000)")
     # the flownode ticks every second; poll the sink via the frontend
-    deadline = time.time() + 60
+    deadline = time.time() + 120
     rows = []
     while time.time() < deadline:
         try:
