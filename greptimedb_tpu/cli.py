@@ -378,13 +378,32 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst,
                     "node_id": node_id, "region_stats": stats,
                 })
                 for ins in resp.get("instructions") or []:
-                    # mailbox instructions (migrations etc.) are logged;
-                    # region movement over this HTTP topology is handled
-                    # by the in-process cluster layer (cluster.py)
-                    if ins.get("type") != "grant_lease":
+                    if ins.get("type") == "grant_lease":
+                        rs = getattr(inst, "region_server", None)
+                        if rs is not None:
+                            rs.renew_leases(
+                                ins.get("regions") or [],
+                                float(ins.get("lease_secs", 10.0)),
+                            )
+                    else:
+                        # other mailbox instructions are logged; region
+                        # movement is driven by the metasrv directly
+                        # over Flight (dist/wire_cluster.py)
                         print(f"# metasrv instruction: {ins}", flush=True)
             except Exception:
                 registered = False
+            # lease enforcement runs even (especially) when heartbeats
+            # fail: a partitioned node fences its regions instead of
+            # split-braining with a failover target. Nothing here may
+            # kill the loop — a dead loop means no fencing at all.
+            try:
+                rs = getattr(inst, "region_server", None)
+                if rs is not None:
+                    for rid in rs.enforce_leases():
+                        print(f"# region {rid} lease expired: fenced",
+                              flush=True)
+            except Exception:
+                pass
             if stop.wait(2.0):
                 return
 

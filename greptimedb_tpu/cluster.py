@@ -181,7 +181,8 @@ class Cluster:
             raise IllegalStateError(f"datanode {node_id} is down")
         dn.open_region(self._region_meta(region_id), writable=writable)
 
-    def downgrade_region_on(self, node_id: int, region_id: int) -> None:
+    def downgrade_region_on(self, node_id: int, region_id: int, *,
+                            failover: bool = False) -> None:
         dn = self.datanodes.get(node_id)
         if dn is None or not dn.alive or not dn.has_region(region_id):
             return  # dead leader: failover path

@@ -180,6 +180,19 @@ class DatanodeClient:
             }).encode()
             batches.append((batch, meta))
         descriptor = flight.FlightDescriptor.for_path("region_write")
+
+        def finish(writer, reader):
+            # done_writing + draining the response BLOCKS until the
+            # server handler returns — close() alone completes the
+            # stream without waiting, so an acknowledged write could
+            # still be mid-apply server-side
+            writer.done_writing()
+            try:
+                reader.read()
+            except StopIteration:
+                pass
+            writer.close()
+
         try:
             writer, reader = self._client().do_put(
                 descriptor, batches[0][0].schema
@@ -188,13 +201,13 @@ class DatanodeClient:
             for batch, meta in batches:
                 if batch.schema != schema:
                     # schema changes mid-stream need a fresh stream
-                    writer.close()
+                    finish(writer, reader)
                     writer, reader = self._client().do_put(
                         descriptor, batch.schema
                     )
                     schema = batch.schema
                 writer.write_with_metadata(batch, meta)
-            writer.close()
+            finish(writer, reader)
         except flight.FlightError as e:
             self._raise(e)
 

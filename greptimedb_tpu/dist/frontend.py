@@ -171,8 +171,17 @@ class DistInstance(Standalone):
             descriptor = flight.FlightDescriptor.for_path(
                 f"flow_mirror:{db}.{name}"
             )
-            writer, _ = cli._client().do_put(descriptor, batch.schema)
+            writer, reader = cli._client().do_put(
+                descriptor, batch.schema
+            )
             writer.write_batch(batch)
+            # drain the ack so the flownode has APPLIED the delta before
+            # this insert returns (a following flush must see it)
+            writer.done_writing()
+            try:
+                reader.read()
+            except StopIteration:
+                pass
             writer.close()
         except Exception:  # noqa: BLE001 - mirroring is best-effort
             from greptimedb_tpu.telemetry.metrics import global_registry

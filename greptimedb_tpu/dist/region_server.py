@@ -33,6 +33,13 @@ class RegionServer:
         self._path = os.path.join(data_home, REGIONS_FILE)
         self._lock = threading.Lock()
         self._metas: dict[int, dict] = {}
+        # region alive-keeping (the reference's RegionAliveKeeper,
+        # src/datanode/src/alive_keeper.rs:44-113): metasrv lease grants
+        # set per-region deadlines; expiry FENCES the region (writes
+        # rejected) so a partitioned node cannot split-brain with the
+        # failover target
+        self._lease_deadline: dict[int, float] = {}
+        self._fenced: set[int] = set()
         if os.path.exists(self._path):
             with open(self._path) as f:
                 self._metas = {int(k): v for k, v in json.load(f).items()}
@@ -53,19 +60,28 @@ class RegionServer:
         self.engine.open_region(meta)
         with self._lock:
             self._metas[meta.region_id] = meta_doc
+            # fresh hosting = fresh lease state: a stale lapsed deadline
+            # from a PREVIOUS hosting would close a migrated-back
+            # candidate at the next grant
+            self._lease_deadline.pop(meta.region_id, None)
+            self._fenced.discard(meta.region_id)
             self._persist()
+
+    def _forget_region(self, region_id: int) -> None:
+        self._metas.pop(region_id, None)
+        self._lease_deadline.pop(region_id, None)
+        self._fenced.discard(region_id)
+        self._persist()
 
     def close_region(self, region_id: int) -> None:
         self.engine.close_region(region_id)
         with self._lock:
-            self._metas.pop(region_id, None)
-            self._persist()
+            self._forget_region(region_id)
 
     def drop_region(self, region_id: int) -> None:
         self.engine.drop_region(region_id)
         with self._lock:
-            self._metas.pop(region_id, None)
-            self._persist()
+            self._forget_region(region_id)
 
     def region_ids(self) -> list[int]:
         with self._lock:
@@ -99,6 +115,60 @@ class RegionServer:
     def set_region_writable(self, region_id: int, writable: bool) -> None:
         """Migration fencing: a downgraded leader rejects writes."""
         self._region(region_id).writable = writable
+
+    # ---- region alive-keeping ----------------------------------------
+    def renew_leases(self, region_ids, lease_secs: float,
+                     now: float | None = None) -> None:
+        """Apply a metasrv grant_lease instruction: granted regions get
+        fresh deadlines (and un-fence); hosted regions ABSENT from the
+        grant whose lease already lapsed are closed — the metasrv no
+        longer routes them here (failover moved them)."""
+        import time as _time
+
+        now = _time.monotonic() if now is None else now
+        granted = {int(r) for r in region_ids}
+        with self._lock:
+            for rid in granted:
+                self._lease_deadline[rid] = now + float(lease_secs)
+            refence = [r for r in self._fenced if r in granted]
+        for rid in refence:
+            try:
+                self._region(rid).writable = True
+                with self._lock:
+                    self._fenced.discard(rid)
+            except RegionNotFoundError:
+                pass
+        for rid in self.region_ids():
+            if rid in granted:
+                continue
+            with self._lock:
+                dl = self._lease_deadline.get(rid)
+            if dl is not None and now > dl:
+                self.close_region(rid)  # clears its lease state too
+
+    def enforce_leases(self, now: float | None = None) -> list[int]:
+        """Fence every hosted region whose lease lapsed (called on the
+        heartbeat cadence, ESPECIALLY when heartbeats fail — that is
+        when the metasrv may be failing this node over). Returns newly
+        fenced region ids."""
+        import time as _time
+
+        now = _time.monotonic() if now is None else now
+        newly = []
+        with self._lock:
+            expired = [
+                rid for rid, dl in self._lease_deadline.items()
+                if now > dl and rid not in self._fenced
+            ]
+        for rid in expired:
+            try:
+                self._region(rid).writable = False
+            except RegionNotFoundError:
+                continue
+            with self._lock:
+                self._fenced.add(rid)
+            newly.append(rid)
+        return newly
 
     def alter_region(self, region_id: int, op: str, name: str) -> None:
         """Schema change on an open region (ALTER TABLE fan-out)."""

@@ -26,7 +26,10 @@ _META_TTL_S = 5.0
 
 class WireCluster:
     def __init__(self, metasrv):
+        import threading
+
         self.metasrv = metasrv
+        self._lock = threading.Lock()
         self._clients: dict[int, object] = {}
         # table_id -> (meta_doc builder input, fetched_at): failing over
         # R regions must not rescan the whole catalog R times
@@ -35,24 +38,29 @@ class WireCluster:
     # ------------------------------------------------------------------
     def _client(self, node_id: int):
         """Client for a node's CURRENT address — a restarted datanode
-        re-registers on a new port, so the cache re-resolves."""
+        re-registers on a new port, so the cache re-resolves. Procedure
+        threads run concurrently; the cache is locked."""
         addr = self.metasrv.peers().get(node_id)
         if addr is None:
             raise IllegalStateError(
                 f"datanode {node_id} has no registered address"
             )
-        cli = self._clients.get(node_id)
-        if cli is not None and cli.addr != addr:
+        with self._lock:
+            cli = self._clients.get(node_id)
+            stale = cli if cli is not None and cli.addr != addr else None
+            if stale is not None:
+                del self._clients[node_id]
+                cli = None
+            if cli is None:
+                from greptimedb_tpu.dist.client import DatanodeClient
+
+                cli = DatanodeClient(addr)
+                self._clients[node_id] = cli
+        if stale is not None:
             try:
-                cli.close()
+                stale.close()
             except Exception:  # noqa: BLE001
                 pass
-            cli = None
-        if cli is None:
-            from greptimedb_tpu.dist.client import DatanodeClient
-
-            cli = DatanodeClient(addr)
-            self._clients[node_id] = cli
         return cli
 
     def _table_info(self, table_id: int):
@@ -60,14 +68,18 @@ class WireCluster:
 
         from greptimedb_tpu.catalog.manager import TableInfo
 
-        hit = self._info_cache.get(table_id)
+        with self._lock:
+            hit = self._info_cache.get(table_id)
         if hit is not None and time.monotonic() - hit[1] < _META_TTL_S:
             return hit[0]
         for _key, raw in self.metasrv.kv.range(TABLE_PREFIX):
             info_doc = json.loads(raw)
             if info_doc.get("table_id") == table_id:
                 info = TableInfo.from_json(info_doc)
-                self._info_cache[table_id] = (info, time.monotonic())
+                with self._lock:
+                    self._info_cache[table_id] = (
+                        info, time.monotonic()
+                    )
                 return info
         raise RegionNotFoundError(
             f"table {table_id} is not in the catalog"
@@ -91,16 +103,21 @@ class WireCluster:
             cli.action("set_region_writable",
                        {"region_id": region_id, "writable": False})
 
-    def downgrade_region_on(self, node_id: int, region_id: int) -> None:
+    def downgrade_region_on(self, node_id: int, region_id: int, *,
+                            failover: bool = False) -> None:
         """Graceful handover FENCES the old leader (writes rejected),
-        then flushes it; a DEAD node (the failover case) is skipped."""
+        then flushes it. A MANUAL migration propagates failures — a
+        live-but-slow source that skipped the fence+flush would lose
+        acknowledged rows; only the failover path (source presumed
+        dead) swallows them."""
         try:
             cli = self._client(node_id)
             cli.action("set_region_writable",
                        {"region_id": region_id, "writable": False})
             cli.flush_region(region_id)
-        except Exception:  # noqa: BLE001 - dead/unreachable source
-            pass
+        except Exception:  # noqa: BLE001
+            if not failover:
+                raise
 
     def upgrade_region_on(self, node_id: int, region_id: int) -> None:
         # close + reopen, NOT a bare open: the candidate was opened

@@ -271,7 +271,10 @@ class RegionMigrationProcedure(Procedure):
         if self.state == "downgrade_leader":
             # graceful: flush the leader so the candidate sees all data;
             # on failover the old node is dead and this is a no-op
-            cluster.downgrade_region_on(self.from_node, self.region_id)
+            cluster.downgrade_region_on(
+                self.from_node, self.region_id,
+                failover=self.reason == "failover",
+            )
             self.state = "upgrade_candidate"
             return Status.executing()
         if self.state == "upgrade_candidate":

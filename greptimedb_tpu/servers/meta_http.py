@@ -193,9 +193,11 @@ class MetasrvServer:
                 if self.election.is_leader:
                     if not self._recovered:
                         # first tick as leader: resume procedures a
-                        # crashed predecessor left 'running'
-                        self._recovered = True
+                        # crashed predecessor left 'running'. The flag
+                        # flips only AFTER recover() succeeds so a
+                        # transient kv failure is retried next tick.
                         self.metasrv.procedures.recover(self.metasrv)
+                        self._recovered = True
                     self.metasrv.tick()
                 else:
                     # leadership lost: a later re-acquisition must
@@ -226,3 +228,6 @@ class MetasrvServer:
         if self._srv is not None:
             self._srv.shutdown()
             self._srv.server_close()
+        cluster = getattr(self.metasrv, "cluster", None)
+        if cluster is not None and hasattr(cluster, "close"):
+            cluster.close()

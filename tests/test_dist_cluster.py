@@ -687,3 +687,60 @@ def test_wire_graceful_migration_carries_unflushed_rows(tmp_path):
         assert dn_inst.engine.region(rid) is not None
     finally:
         h.close()
+
+
+def test_region_alive_keeper_fences_and_closes(tmp_path):
+    """RegionAliveKeeper semantics (reference alive_keeper.rs): lease
+    expiry fences writes; a later grant excluding the region closes it;
+    re-granting un-fences."""
+    import numpy as np
+
+    inst = Standalone(
+        engine_config=EngineConfig(data_root=str(tmp_path / "dn"),
+                                   enable_background=False),
+        prefer_device=False, warm_start=False,
+    )
+    rs = RegionServer(inst.engine, str(tmp_path / "dn"))
+    try:
+        from greptimedb_tpu.dist.remote import region_meta_doc
+        from greptimedb_tpu.catalog.manager import TableInfo
+        from greptimedb_tpu.datatypes.schema import (
+            ColumnSchema, Schema, SemanticType,
+        )
+        from greptimedb_tpu.datatypes.types import ConcreteDataType as T
+        from greptimedb_tpu.errors import RegionReadonlyError
+
+        info = TableInfo(
+            table_id=9, name="t", database="public",
+            schema=Schema([
+                ColumnSchema("ts", T.timestamp_millisecond(),
+                             SemanticType.TIMESTAMP, nullable=False),
+                ColumnSchema("v", T.float64(), SemanticType.FIELD),
+            ]),
+        )
+        rid = info.region_ids()[0]
+        rs.open_region(region_meta_doc(info, rid))
+
+        def write_one(ts):
+            rs.write(rid, {}, np.asarray([ts], np.int64),
+                     {"v": np.asarray([1.0])}, None, op=0)
+
+        write_one(1000)  # no lease known yet: never fenced
+        rs.renew_leases([rid], lease_secs=10.0, now=0.0)
+        assert rs.enforce_leases(now=5.0) == []
+        write_one(2000)
+        # lease lapses: the region fences
+        assert rs.enforce_leases(now=11.0) == [rid]
+        import pytest as _pytest
+
+        with _pytest.raises(RegionReadonlyError):
+            write_one(3000)
+        # re-grant: un-fenced, writable again
+        rs.renew_leases([rid], lease_secs=10.0, now=12.0)
+        write_one(4000)
+        # a grant EXCLUDING the region after lapse closes it (routes
+        # moved away in a failover)
+        rs.renew_leases([], lease_secs=10.0, now=30.0)
+        assert rid not in rs.region_ids()
+    finally:
+        inst.close()
