@@ -398,9 +398,10 @@ def phase1(tmp: str):
              "SELECT ts, usage_user, usage_system FROM cpu "
              "WHERE usage_user > 90.0 AND hostname = 'host_17'"),
             # high-cpu-all: row filter over EVERY host returning full
-            # rows (reference: 3,619 ms local). Benched HONESTLY on the
-            # host path — the grid cache leaves row-level filter scans
-            # to numpy (VERDICT r3 weak #7)
+            # rows (reference: 3,619 ms local). Served by the merged-scan
+            # cache (storage/region.py): the deduped columnar row set is
+            # the steady state, so each query pays only the vectorized
+            # predicate + one flatnonzero gather — no SST re-read/dedup
             ("tsbs_high_cpu_all_sql_ms", 3619.47, None, False, 12,
              "SELECT * FROM cpu WHERE usage_user > 90.0"),
         ]

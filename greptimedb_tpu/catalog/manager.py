@@ -453,6 +453,7 @@ class CatalogManager:
                     if col.name not in region.meta.field_names:
                         region.meta.field_names.append(col.name)
                         region.memtable.field_names.append(col.name)
+                region.invalidate_scan_cache()
             self._persist()
 
     def alter_drop_column(self, database: str, name: str, col_name: str):
@@ -475,6 +476,7 @@ class CatalogManager:
                     region.meta.field_names.remove(col_name)
                 if col_name in region.memtable.field_names:
                     region.memtable.field_names.remove(col_name)
+                region.invalidate_scan_cache()
             self._persist()
 
     def rename_table(self, database: str, old: str, new: str):

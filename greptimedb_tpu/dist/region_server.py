@@ -189,6 +189,7 @@ class RegionServer:
                     region.memtable.field_names.remove(name)
             else:
                 raise ValueError(f"unknown alter op: {op}")
+        region.invalidate_scan_cache()
         with self._lock:
             doc = self._metas.get(region_id)
             if doc is not None:

@@ -75,6 +75,87 @@ class ScanResult:
         return 0 if self.rows is None else len(self.rows)
 
 
+# ----------------------------------------------------------------------
+# merged-scan cache: the page-cache-hot analog. The reference answers
+# repeated scans out of its SST page cache + row-group caches
+# (/root/reference/src/mito2/src/cache/); here the equivalent steady
+# state is the fully merged + deduped columnar row set per region, keyed
+# by the region's logical data_version, so repeated full-table scans
+# (row-filter queries like TSBS high-cpu-all) skip the SST read, concat
+# and dedup entirely and pay only the per-query filter/projection.
+_SCAN_CACHE_MIN_ROWS = 1_000_000         # below this a cold scan is cheap
+_SCAN_CACHE_TOTAL_BYTES = 6 * 1024**3    # global LRU budget
+
+
+class _ScanCachePool:
+    """Tracks cached-scan bytes across regions; LRU-evicts over budget."""
+
+    def __init__(self, budget: int):
+        self.budget = budget
+        self._lock = threading.Lock()
+        self._entries: dict[int, tuple] = {}  # id(region) -> (region, bytes)
+        self._order: list[int] = []
+
+    def store(self, region, entry: tuple, nbytes: int):
+        """Install `entry` as region._scan_cache and account it. The cache
+        attribute is only ever set/cleared under this pool lock, so
+        eviction can't race a concurrent install and desync accounting."""
+        with self._lock:
+            k = id(region)
+            if k in self._entries:
+                self._order.remove(k)
+            region._scan_cache = entry
+            self._entries[k] = (region, nbytes)
+            self._order.append(k)
+            total = sum(b for _, b in self._entries.values())
+            while total > self.budget and len(self._order) > 1:
+                ev = self._order.pop(0)
+                reg, b = self._entries.pop(ev)
+                reg._scan_cache = None
+                total -= b
+
+    def touch(self, region):
+        with self._lock:
+            k = id(region)
+            if k in self._order:
+                self._order.remove(k)
+                self._order.append(k)
+
+    def drop(self, region):
+        with self._lock:
+            region._scan_cache = None
+            self._entries.pop(id(region), None)
+            k = id(region)
+            if k in self._order:
+                self._order.remove(k)
+
+
+_scan_pool = _ScanCachePool(_SCAN_CACHE_TOTAL_BYTES)
+
+
+def _shallow_rows(rows: ColumnarRows, names) -> ColumnarRows:
+    """New container sharing the cached arrays: callers replace attributes
+    (e.g. sid remap) but never mutate the arrays in place."""
+    return ColumnarRows(
+        sid=rows.sid, ts=rows.ts, seq=rows.seq, op=rows.op,
+        fields={n: rows.fields[n] for n in names},
+        field_valid=(
+            {n: rows.field_valid[n] for n in names if n in rows.field_valid}
+            if rows.field_valid else None
+        ),
+    )
+
+
+def _rows_nbytes(rows: ColumnarRows) -> int:
+    n = rows.sid.nbytes + rows.ts.nbytes + rows.seq.nbytes + rows.op.nbytes
+    for a in rows.fields.values():
+        n += a.nbytes
+    if rows.field_valid:
+        for a in rows.field_valid.values():
+            n += a.nbytes
+    return n
+
+
 class Region:
     def __init__(
         self,
@@ -111,6 +192,7 @@ class Region:
         self._pending_new_series: list[tuple[int, list[str]]] = []
         self._seq = self.manifest.state.committed_sequence
         self._truncate_epoch = 0
+        self._scan_cache: tuple | None = None  # (data_version, ColumnarRows)
         self._lock = threading.RLock()
         self.writable = True
         self._replay()
@@ -346,10 +428,29 @@ class Region:
             ts_min = int(_time.time() * 1000) - self.meta.options.ttl_ms
         names = (field_names if field_names is not None
                  else self.meta.field_names)
+        # merged-scan cache: answer out of the deduped columnar row set
+        # when the region's logical data hasn't changed since it was built
+        if sids is None and fulltext is None and not raw:
+            hit = self._scan_cached(names, ts_min, ts_max)
+            if hit is not None:
+                return hit
         chunks: list[ColumnarRows] = []
+        scan_names = names
         with self._lock:
             ssts = list(self.manifest.state.ssts)
             tables = [self.memtable] + list(self._frozen)
+            # version captured at snapshot time: writes landing during the
+            # merge below must NOT be stamped as included in the cache
+            snap_key = (self.data_version, tuple(self.meta.field_names))
+            if (sids is None and fulltext is None and not raw
+                    and ts_min is None and ts_max is None):
+                approx = (sum(m.rows for m in ssts)
+                          + sum(t.rows for t in tables))
+                if (approx >= _SCAN_CACHE_MIN_ROWS
+                        and set(names) != set(self.meta.field_names)):
+                    # cache-build candidate: read every field once so
+                    # alternating projections all hit the same entry
+                    scan_names = list(self.meta.field_names)
         # fulltext row-group pruning is VALUE-based: under last-write-
         # wins dedup, skipping a group that holds a newer overwrite or
         # tombstone would resurrect the shadowed row. Append-mode
@@ -359,11 +460,11 @@ class Region:
         ft = fulltext if self.meta.options.append_mode else None
         for meta in ssts:
             r = read_sst(self.store, meta, ts_min=ts_min, ts_max=ts_max,
-                         field_names=names, sids=sids, fulltext=ft)
+                         field_names=scan_names, sids=sids, fulltext=ft)
             if r is not None:
                 chunks.append(r)
         for mt in tables:
-            r = mt.scan(ts_min, ts_max, names)
+            r = mt.scan(ts_min, ts_max, scan_names)
             if r is not None:
                 if sids is not None:
                     sel = np.isin(r.sid, sids)
@@ -375,20 +476,71 @@ class Region:
         # always normalize through _concat_rows: it back-fills fields that a
         # chunk written before an ALTER ADD COLUMN does not have.
         only = chunks[0] if len(chunks) == 1 else None
-        if only is not None and all(n in only.fields for n in names):
+        if only is not None and all(n in only.fields for n in scan_names):
             rows = only
         else:
-            rows = _concat_rows(chunks, names)
+            rows = _concat_rows(chunks, scan_names)
         if not raw and not self.meta.options.append_mode:
             rows = dedup_rows(rows, merge_mode=self.meta.options.merge_mode)
         else:
             order = np.lexsort((rows.seq, rows.ts, rows.sid))
             rows = _slice_rows(rows, order)
+        if self._maybe_cache_scan(snap_key, rows, ts_min, ts_max,
+                                  sids, fulltext, raw):
+            # the cached object must never escape: callers mutate the
+            # returned container in place (e.g. table-level sid remap)
+            rows = _shallow_rows(rows, names)
+        elif scan_names is not names:
+            rows = _shallow_rows(rows, names)
         return ScanResult(rows, self.series, names)
 
+    # -- merged-scan cache ---------------------------------------------
+    def _scan_cached(self, names, ts_min, ts_max) -> ScanResult | None:
+        cached = self._scan_cache
+        if cached is None:
+            return None
+        key = (self.data_version, tuple(self.meta.field_names))
+        if cached[0] != key:
+            # stale entry can never be served again — release its arrays
+            # instead of pinning gigabytes until budget pressure
+            _scan_pool.drop(self)
+            return None
+        rows: ColumnarRows = cached[1]
+        if any(n not in rows.fields for n in names):
+            return None
+        _scan_pool.touch(self)
+        out = _shallow_rows(rows, names)
+        if ts_min is not None or ts_max is not None:
+            lo = ts_min if ts_min is not None else -(2**63)
+            hi = ts_max if ts_max is not None else 2**63 - 1
+            sel = (out.ts >= lo) & (out.ts <= hi)
+            if not sel.all():
+                out = _slice_rows(out, sel)
+        return ScanResult(out, self.series, names)
+
+    def _maybe_cache_scan(self, snap_key, rows, ts_min, ts_max, sids,
+                          fulltext, raw) -> bool:
+        """Cache an unbounded scan; hits serve any field subset of it."""
+        if (raw or sids is not None or fulltext is not None
+                or ts_min is not None or ts_max is not None
+                or len(rows) < _SCAN_CACHE_MIN_ROWS):
+            return False
+        nbytes = _rows_nbytes(rows)
+        if nbytes > _scan_pool.budget:
+            return False
+        _scan_pool.store(self, (snap_key, rows), nbytes)
+        return True
+
     # ------------------------------------------------------------------
+    def invalidate_scan_cache(self):
+        """Explicit invalidation for schema changes (ALTER drops/adds can
+        leave data_version + field_names identical, e.g. drop+re-add of
+        the trailing column with no intervening writes)."""
+        _scan_pool.drop(self)
+
     def truncate(self):
         with self._lock:
+            _scan_pool.drop(self)
             self._truncate_epoch += 1
             entry_id = self.wal.next_entry_id - 1
             self.memtable = Memtable(
@@ -408,6 +560,7 @@ class Region:
             self.wal.obsolete(entry_id)
 
     def close(self):
+        _scan_pool.drop(self)
         self.wal.close()
 
 
