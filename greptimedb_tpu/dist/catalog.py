@@ -203,9 +203,28 @@ class DistCatalogManager(CatalogManager):
                 num_regions=max(1, num_regions), partition=partition,
                 created_ms=int(time.time() * 1000),
             )
-            table = self._open_table(info)
+            # guard the kv key with CAS(expect-absent): two frontends
+            # racing on the same name must not both win (the local dict
+            # check only sees THIS process's view) — ADVICE r4
+            key = f"{TABLE_PREFIX}{database}/{name}"
+            if not self.meta.kv_cas(key, None, json.dumps(info.to_json())):
+                if if_not_exists:
+                    # the racing winner's table: open from its kv doc
+                    raw = self.meta.kv_get(key)
+                    won = TableInfo.from_json(json.loads(raw))
+                    db[name] = self._open_table(won)
+                    return db[name]
+                raise TableAlreadyExistsError(
+                    f"table already exists: {name}"
+                )
+            try:
+                table = self._open_table(info)
+            except Exception:
+                # roll the claim back: a failed region placement must
+                # not leave a phantom kv entry blocking the name forever
+                self.meta.kv_delete(key)
+                raise
             db[name] = table
-            self._put_table(info)
             return table
 
     def rename_table(self, database: str, old: str, new: str):

@@ -1,24 +1,41 @@
 """Distributed query execution: the MergeScan split on the frontend.
 
-For decomposable shapes the commutative part of the plan ships to each
-datanode (which executes it over ITS regions — device fast paths
-included) and only partial states cross the wire; the frontend merges
-partials and runs the non-commutative remainder (HAVING / ORDER BY /
-LIMIT / post-projection) locally. Exactly the reference's split:
-MergeScanExec + the commutativity analyzer
-(/root/reference/src/query/src/dist_plan/merge_scan.rs:124,
-src/query/src/dist_plan/analyzer.rs:38-45).
+The commutative part of a plan ships to each datanode (which executes it
+over ITS regions — device fast paths included) and only partial states
+cross the wire; the frontend merges partials vectorized (numpy group-by
+on key codes, no per-row Python) and runs the non-commutative remainder
+(final HAVING / DISTINCT / ORDER BY / LIMIT / post-projection) locally.
+The capability counterpart of the reference's commutativity analyzer +
+MergeScanExec (/root/reference/src/query/src/dist_plan/analyzer.rs:38-45,
+src/query/src/dist_plan/commutativity.rs:164-189,
+merge_scan.rs:124,184-280 — where partial-batch merging is vectorized
+arrow compute, here it is vectorized numpy over key codes).
 
-Shapes:
-- plain GROUP BY aggregates with count/sum/min/max/avg (avg decomposed
-  into sum+count partials);
-- RANGE queries whose BY keys cover the full tag set (series are
-  hash-routed by the full tag tuple, so per-datanode results are
-  disjoint) with no FILL — partial = the plan minus sort/limit, merge =
-  concatenation.
+Pushdown lattice (what ships below the merge):
+- **plain** SELECT (filters, projections, scalar exprs): fully
+  commutative — the whole plan ships; ORDER BY + LIMIT push down as
+  per-datanode top-k partials when a LIMIT exists; DISTINCT pushes down
+  and is re-applied post-merge. Window functions fall back (partitions
+  span datanodes).
+- **aggregate** GROUP BY with count/sum/min/max/avg/var*/stddev*:
+  rewritten to partial states (avg -> sum+count, var/stddev ->
+  sum+count+sum-of-squares); COUNT(DISTINCT x) ships as a GROUP BY
+  (keys, x) partial and the frontend counts distinct codes. The merge
+  is dtype-preserving: integer/timestamp min/max never round-trip
+  through float (BIGINTs above 2^53 stay exact), strings merge via
+  lexsort, floats keep NaN propagation.
+- **range** RANGE..ALIGN..BY where the BY keys cover the full tag set
+  (series are hash-routed by the full tag tuple, so per-datanode groups
+  are disjoint): the whole range plan ships, including HAVING (row-wise
+  over disjoint rows) and FILL — fill grids are made identical on every
+  datanode by negotiating the GLOBAL time extent first (a min/max(ts)
+  partial-aggregate round) and shipping it as an explicit grid override.
+  Without ORDER BY the merged rows get the standalone default
+  (ts, group-keys) order.
 
-Everything else falls back to remote region scans (data shipping),
-which stays correct for the whole SQL surface.
+Everything else falls back to remote region scans (data shipping, with
+filters/projection/ts-bounds still pushed to the datanode), which stays
+correct for the whole SQL surface.
 """
 
 from __future__ import annotations
@@ -29,13 +46,22 @@ import numpy as np
 
 from greptimedb_tpu.dist import plan_codec
 from greptimedb_tpu.query import stats
-from greptimedb_tpu.query.executor import Col, QueryResult
-from greptimedb_tpu.query.planner import AggSpec, SelectPlan
+from greptimedb_tpu.query.executor import (
+    Col,
+    DictSource,
+    QueryResult,
+    _distinct_indices,
+    _slice_result,
+    _sort_indices,
+)
+from greptimedb_tpu.query.planner import AggSpec, KeySpec, SelectPlan
 from greptimedb_tpu.sql import ast as A
 
-_DECOMPOSABLE = {"count", "sum", "min", "max", "mean"}
-
-_NULL = object()  # group-key sentinel for SQL NULL
+_DECOMPOSABLE = {
+    "count", "sum", "min", "max", "mean",
+    "var_samp", "var_pop", "stddev_samp", "stddev_pop",
+}
+_VARIANCE_OPS = {"var_samp", "var_pop", "stddev_samp", "stddev_pop"}
 
 
 def try_dist_query(instance, plan: SelectPlan, table):
@@ -43,6 +69,8 @@ def try_dist_query(instance, plan: SelectPlan, table):
     if not getattr(table, "remote", False):
         return None
     try:
+        if plan.kind == "plain":
+            return _dist_plain(instance, plan, table)
         if plan.kind == "aggregate":
             return _dist_aggregate(instance, plan, table)
         if plan.kind == "range":
@@ -98,26 +126,186 @@ def _fan_out(instance, table, partial: SelectPlan):
     return outs
 
 
-def _col_from_values(vals: list) -> Col:
-    """python values (with _NULL sentinels) -> Col with validity."""
-    valid = np.asarray([v is not _NULL for v in vals], bool)
-    is_str = any(isinstance(v, str) for v in vals if v is not _NULL)
-    fill = "" if is_str else 0
-    clean = [fill if v is _NULL else v for v in vals]
-    arr = (np.asarray(clean, object) if is_str
-           else np.asarray(clean))
-    return Col(arr, None if valid.all() else valid)
+def _cat_col(parts: list[QueryResult], i: int) -> Col:
+    """Concatenate column i across partial results (values + validity).
+
+    Dtype comes from parts with at least one VALID row: a datanode with
+    no matching rows returns a float64 NULL placeholder which must not
+    promote exact int64 partials (BIGINT min above 2^53) to float."""
+    arrs = [np.asarray(p.cols[i].values) for p in parts]
+    valids = [
+        (p.cols[i].validity if p.cols[i].validity is not None
+         else np.ones(p.num_rows, bool))
+        for p in parts
+    ]
+    if any(a.dtype == object or a.dtype.kind in "US" for a in arrs):
+        arrs = [a.astype(object) for a in arrs]
+    else:
+        informative = {a.dtype for a, v in zip(arrs, valids) if v.any()}
+        if len(informative) == 1:
+            target = informative.pop()
+            arrs = [
+                a if a.dtype == target
+                else (np.zeros(len(a), target) if not v.any()
+                      else a.astype(target))
+                for a, v in zip(arrs, valids)
+            ]
+    vals = np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
+    valid = np.concatenate(valids)
+    return Col(vals, None if valid.all() else valid)
 
 
-def _key_tuple(cols: list[Col], i: int) -> tuple:
-    out = []
-    for c in cols:
-        if c.validity is not None and not c.validity[i]:
-            out.append(_NULL)
+def _factorize(col: Col) -> np.ndarray:
+    """Per-row codes (int64); NULL rows code to -1."""
+    v = col.values
+    if v.dtype == object or v.dtype.kind in "US":
+        _, inv = np.unique(v.astype(str), return_inverse=True)
+    else:
+        _, inv = np.unique(v, return_inverse=True)
+    codes = inv.astype(np.int64)
+    if col.validity is not None:
+        codes[~col.validity] = -1
+    return codes
+
+
+def _group_rows(key_cols: list[Col], n: int):
+    """Group rows by key-tuple codes. Returns (gid, g, rep) where rep[k]
+    is the row index of group k's first occurrence (groups ordered by
+    first appearance, so single-datanode results keep datanode order)."""
+    if not key_cols:
+        if n == 0:
+            return np.zeros(0, np.int64), 0, np.zeros(0, np.int64)
+        return np.zeros(n, np.int64), 1, np.zeros(1, np.int64)
+    combined = _factorize(key_cols[0]) + 1
+    for c in key_cols[1:]:
+        codes = _factorize(c) + 1
+        card = int(codes.max()) + 1 if len(codes) else 1
+        combined = combined * card + codes
+    uniq, first, gid = np.unique(
+        combined, return_index=True, return_inverse=True
+    )
+    order = np.argsort(first, kind="stable")
+    remap = np.empty(len(uniq), np.int64)
+    remap[order] = np.arange(len(uniq))
+    return remap[gid.astype(np.int64)], len(uniq), first[order]
+
+
+def _merge_sum(col: Col, gid: np.ndarray, g: int):
+    """Per-group sums, dtype-preserving (int64 sums stay int64)."""
+    valid = col.valid_mask
+    vals = col.values
+    dtype = vals.dtype if vals.dtype.kind in "iuf" else np.float64
+    acc = np.zeros(g, dtype)
+    np.add.at(acc, gid[valid], vals[valid].astype(dtype, copy=False))
+    seen = np.zeros(g, bool)
+    seen[gid[valid]] = True
+    return acc, seen
+
+
+def _merge_minmax(op: str, col: Col, gid: np.ndarray, g: int):
+    """Per-group min/max, dtype-preserving (ADVICE r4): delegates to the
+    one typed kernel shared with the host reduce."""
+    from greptimedb_tpu.query.reduce import grouped_minmax_typed
+
+    return grouped_minmax_typed(op, col.values, col.valid_mask, gid, g)
+
+
+# ---------------------------------------------------------------------------
+# plain SELECT
+# ---------------------------------------------------------------------------
+
+
+def _dist_plain(instance, plan: SelectPlan, table):
+    from greptimedb_tpu.query import window_fns as W
+
+    win: list = []
+    for e, _ in plan.items:
+        W.collect_window_calls(e, win)
+    for o in plan.order_by:
+        W.collect_window_calls(o.expr, win)
+    if win:
+        return None  # window partitions span datanodes
+    names = [nm for _, nm in plan.items]
+    # final sort keys: output-name refs sort on merged outputs; other
+    # expressions ship as derived __ob columns computed datanode-side
+    ob_specs: list[tuple[str, bool, bool | None]] = []
+    extra_items: list = []
+    for i, o in enumerate(plan.order_by):
+        if isinstance(o.expr, A.Column) and o.expr.name in names:
+            ob_specs.append((o.expr.name, o.asc, o.nulls_first))
         else:
-            v = c.values[i]
-            out.append(v.item() if isinstance(v, np.generic) else v)
-    return tuple(out)
+            nm = f"__ob{i}"
+            extra_items.append((o.expr, nm))
+            ob_specs.append((nm, o.asc, o.nulls_first))
+    push_limit = None
+    partial_order: list = []
+    if plan.limit is not None and not (plan.distinct and extra_items):
+        # per-datanode top-k: any global top-k row is in its datanode's
+        # local top-k under the same total order. With DISTINCT the
+        # datanode dedups over items + __ob extras (weaker than the
+        # visible tuple) — duplicates would fill the local top-k and
+        # truncate rows the global distinct needs, so don't push LIMIT
+        # below a weakened DISTINCT.
+        push_limit = (plan.offset or 0) + plan.limit
+        partial_order = plan.order_by
+    partial = SelectPlan(
+        kind="plain", table_name=plan.table_name, scan=plan.scan,
+        items=list(plan.items) + extra_items,
+        order_by=partial_order, limit=push_limit,
+        distinct=plan.distinct,
+    )
+    results = _fan_out(instance, table, partial)
+    types: dict = {}
+    for _addr, res in results:
+        types.update(res.types)
+    parts = [res for _addr, res in results if res.num_rows]
+    if not parts:
+        return QueryResult(names, [Col(np.zeros(0)) for _ in names], types)
+    total = len(plan.items) + len(extra_items)
+    cols = [_cat_col(parts, i) for i in range(total)]
+    vis = cols[:len(names)]
+    if plan.distinct:
+        didx = _distinct_indices(vis)
+        cols = _slice_result(cols, didx)
+        vis = cols[:len(names)]
+    if ob_specs:
+        by_name = dict(zip(names + [nm for _, nm in extra_items], cols))
+        idx = _sort_indices(
+            [by_name[nm] for nm, _, _ in ob_specs],
+            [asc for _, asc, _ in ob_specs],
+            [nf for _, _, nf in ob_specs],
+        )
+        vis = _slice_result(vis, idx)
+    off = plan.offset or 0
+    if off or plan.limit is not None:
+        end = None if plan.limit is None else off + plan.limit
+        vis = _slice_result(vis, slice(off, end))
+    instance.query_engine._record_path("plain", "dist:partial")
+    return QueryResult(names, vis, types)
+
+
+def _rep_key_cols(plan_keys, key_cat: list[Col], rep: np.ndarray) -> dict:
+    """Group-key output columns from each group's representative row."""
+    return {
+        k.key: Col(
+            c.values[rep],
+            None if c.validity is None else c.validity[rep],
+        )
+        for k, c in zip(plan_keys, key_cat)
+    }
+
+
+def _empty_agg_cols(plan: SelectPlan) -> dict:
+    """Zero-partial aggregate output: empty columns for keyed plans, the
+    standalone one-row shape (count=0, NULL others) for global ones."""
+    n = 0 if plan.keys else 1
+    cols = {k.key: Col(np.zeros(n, object)) for k in plan.keys}
+    for a in plan.aggs:
+        if a.op in ("count", "count_distinct"):
+            cols[a.key] = Col(np.zeros(n, np.int64))
+        else:
+            cols[a.key] = Col(np.zeros(n), np.zeros(n, bool))
+    return cols
 
 
 # ---------------------------------------------------------------------------
@@ -126,16 +314,28 @@ def _key_tuple(cols: list[Col], i: int) -> tuple:
 
 
 def _dist_aggregate(instance, plan: SelectPlan, table):
+    if any(a.op == "count_distinct" for a in plan.aggs):
+        return _dist_count_distinct(instance, plan, table)
     if any(a.op not in _DECOMPOSABLE or a.distinct for a in plan.aggs):
         return None
-    # partial aggs: stable derived keys; avg splits into sum + count
+    # partial aggs: stable derived keys; avg -> sum+count, var/stddev ->
+    # sum+count+sum-of-squares (squares computed datanode-side in f64)
     partial_aggs: list[AggSpec] = []
     for a in plan.aggs:
         if a.op == "mean":
             partial_aggs.append(AggSpec(f"{a.key}__s", "sum", a.arg))
             partial_aggs.append(AggSpec(f"{a.key}__c", "count", a.arg))
+        elif a.op in _VARIANCE_OPS:
+            from greptimedb_tpu.datatypes.types import ConcreteDataType
+
+            arg_f = A.Cast(a.arg, ConcreteDataType.float64())
+            sq = A.BinaryOp("*", arg_f, arg_f)
+            partial_aggs.append(AggSpec(f"{a.key}__s", "sum", arg_f))
+            partial_aggs.append(AggSpec(f"{a.key}__c", "count", a.arg))
+            partial_aggs.append(AggSpec(f"{a.key}__s2", "sum", sq))
         else:
             partial_aggs.append(AggSpec(f"{a.key}__p", a.op, a.arg))
+    # dedupe derived keys (two avg(x) items share nothing: keys differ)
     partial = SelectPlan(
         kind="aggregate", table_name=plan.table_name, scan=plan.scan,
         keys=plan.keys, aggs=partial_aggs,
@@ -145,80 +345,134 @@ def _dist_aggregate(instance, plan: SelectPlan, table):
         ),
     )
     results = _fan_out(instance, table, partial)
-
+    parts = [res for _addr, res in results if res.num_rows]
     nk = len(plan.keys)
-    groups: dict[tuple, dict] = {}
-    order: list[tuple] = []
-    for _addr, res in results:
-        key_cols = res.cols[:nk]
-        agg_cols = res.cols[nk:]
-        for i in range(res.num_rows):
-            key = _key_tuple(key_cols, i)
-            st = groups.get(key)
-            if st is None:
-                st = {p.key: None for p in partial_aggs}
-                groups[key] = st
-                order.append(key)
-            for j, p in enumerate(partial_aggs):
-                c = agg_cols[j]
-                if c.validity is not None and not c.validity[i]:
-                    continue
-                v = c.values[i]
-                v = v.item() if isinstance(v, np.generic) else v
-                cur = st[p.key]
-                if cur is None:
-                    st[p.key] = v
-                elif p.op in ("sum", "count"):
-                    st[p.key] = cur + v
-                elif p.op == "min":
-                    # numpy semantics: NaN propagates regardless of
-                    # datanode iteration order (python min() does not)
-                    st[p.key] = float(np.minimum(cur, v))
-                elif p.op == "max":
-                    st[p.key] = float(np.maximum(cur, v))
-    if not order and not plan.keys:
-        # global aggregate over zero partials must still yield ONE row
-        # (count=0, NULL extremes) — standalone's empty-input semantics
-        order.append(())
-        groups[()] = {p.key: None for p in partial_aggs}
-    g = len(order)
-    agg_cols_map: dict[str, Col] = {}
-    for ki, k in enumerate(plan.keys):
-        vals = [key[ki] for key in order]
-        agg_cols_map[k.key] = _col_from_values(vals)
+    if not parts:
+        return instance.query_engine._post_project(
+            plan, _empty_agg_cols(plan), 0 if plan.keys else 1
+        )
+
+    key_cat = [_cat_col(parts, i) for i in range(nk)]
+    n_rows = len(key_cat[0]) if key_cat else sum(p.num_rows for p in parts)
+    gid, g, rep = _group_rows(key_cat, n_rows)
+    agg_cols = _rep_key_cols(plan.keys, key_cat, rep)
+    merged: dict[str, tuple] = {}
+    for j, p in enumerate(partial_aggs):
+        c = _cat_col(parts, nk + j)
+        if p.op in ("sum", "count"):
+            merged[p.key] = _merge_sum(c, gid, g)
+        else:
+            merged[p.key] = _merge_minmax(p.op, c, gid, g)
     for a in plan.aggs:
         if a.op == "mean":
-            s = [groups[key][f"{a.key}__s"] for key in order]
-            c = [groups[key][f"{a.key}__c"] for key in order]
-            valid = np.asarray(
-                [sv is not None and cv not in (None, 0)
-                 for sv, cv in zip(s, c)], bool,
+            s, sv = merged[f"{a.key}__s"]
+            cnt, _cv = merged[f"{a.key}__c"]
+            ok = sv & (cnt > 0)
+            vals = np.divide(
+                s.astype(np.float64), np.maximum(cnt, 1),
+                where=ok, out=np.zeros(g),
             )
-            vals = np.asarray([
-                (sv / cv) if ok else 0.0
-                for sv, cv, ok in zip(s, c, valid)
-            ], np.float64)
-            agg_cols_map[a.key] = Col(vals,
-                                      None if valid.all() else valid)
+            agg_cols[a.key] = Col(vals, None if ok.all() else ok)
+        elif a.op in _VARIANCE_OPS:
+            s, _sv = merged[f"{a.key}__s"]
+            cnt, _cv = merged[f"{a.key}__c"]
+            s2, _s2v = merged[f"{a.key}__s2"]
+            need = 2 if a.op in ("var_samp", "stddev_samp") else 1
+            ok = cnt >= need
+            cs = np.maximum(cnt, 1).astype(np.float64)
+            m2 = s2 - (s * s) / cs
+            denom = cs - 1 if a.op in ("var_samp", "stddev_samp") else cs
+            vals = np.divide(np.maximum(m2, 0.0), np.maximum(denom, 1),
+                             where=ok, out=np.zeros(g))
+            if a.op.startswith("stddev"):
+                vals = np.sqrt(vals)
+            agg_cols[a.key] = Col(vals, None if ok.all() else ok)
         elif a.op == "count":
-            vals = np.asarray([
-                groups[key][f"{a.key}__p"] or 0 for key in order
-            ], np.int64)
-            agg_cols_map[a.key] = Col(vals)
+            cnt, _ = merged[f"{a.key}__p"]
+            agg_cols[a.key] = Col(cnt.astype(np.int64))
         else:
-            p = [
-                _NULL if groups[key][f"{a.key}__p"] is None
-                else groups[key][f"{a.key}__p"] for key in order
-            ]
-            agg_cols_map[a.key] = _col_from_values(p)
+            vals, seen = merged[f"{a.key}__p"]
+            agg_cols[a.key] = Col(vals, None if seen.all() else seen)
     engine = instance.query_engine
     engine._record_path("aggregate", "dist:partial")
-    return engine._post_project(plan, agg_cols_map, g)
+    return engine._post_project(plan, agg_cols, g)
+
+
+def _dist_count_distinct(instance, plan: SelectPlan, table):
+    """COUNT(DISTINCT x): ship GROUP BY (keys, x), count distinct codes
+    on the frontend. Only the single-distinct-agg shape pushes down."""
+    if len(plan.aggs) != 1 or plan.aggs[0].op != "count_distinct":
+        return None
+    a = plan.aggs[0]
+    if a.arg is None:
+        return None
+    dv = KeySpec("__dv", a.arg, "__dv")
+    partial = SelectPlan(
+        kind="aggregate", table_name=plan.table_name, scan=plan.scan,
+        keys=list(plan.keys) + [dv], aggs=[],
+        post_items=(
+            [(A.Column(k.key), k.key) for k in plan.keys]
+            + [(A.Column("__dv"), "__dv")]
+        ),
+    )
+    results = _fan_out(instance, table, partial)
+    parts = [res for _addr, res in results if res.num_rows]
+    nk = len(plan.keys)
+    if not parts:
+        return instance.query_engine._post_project(
+            plan, _empty_agg_cols(plan), 0 if plan.keys else 1
+        )
+    key_cat = [_cat_col(parts, i) for i in range(nk)]
+    n_rows = sum(p.num_rows for p in parts)
+    gid, g, rep = _group_rows(key_cat, n_rows)
+    agg_cols = _rep_key_cols(plan.keys, key_cat, rep)
+    dv_col = _cat_col(parts, nk)
+    codes = _factorize(dv_col)
+    keep = codes >= 0  # COUNT(DISTINCT) ignores NULLs
+    card = int(codes.max()) + 1 if keep.any() else 1
+    uniq_pairs = np.unique(gid[keep] * card + codes[keep])
+    counts = np.bincount((uniq_pairs // card).astype(np.int64),
+                         minlength=g).astype(np.int64)
+    agg_cols[a.key] = Col(counts)
+    engine = instance.query_engine
+    engine._record_path("aggregate", "dist:partial")
+    return engine._post_project(plan, agg_cols, g)
 
 
 # ---------------------------------------------------------------------------
 # RANGE with series-disjoint groups
 # ---------------------------------------------------------------------------
+
+
+def _global_ts_extent(instance, plan: SelectPlan, table):
+    """Negotiate the global scanned-ts extent (min, max) across datanodes
+    via a tiny partial-aggregate round, so every datanode builds the SAME
+    fill grid (the reference reads this off the merged stream; with fill
+    pushed down it must be agreed in advance)."""
+    ts_col = A.Column(table.ts_name)
+    partial = SelectPlan(
+        kind="aggregate", table_name=plan.table_name, scan=plan.scan,
+        keys=[], aggs=[
+            AggSpec("__tmin", "min", ts_col),
+            AggSpec("__tmax", "max", ts_col),
+        ],
+        post_items=[(A.Column("__tmin"), "__tmin"),
+                    (A.Column("__tmax"), "__tmax")],
+    )
+    results = _fan_out(instance, table, partial)
+    mins: list[int] = []
+    maxs: list[int] = []
+    for _addr, res in results:
+        if not res.num_rows:
+            continue
+        lo, hi = res.cols[0], res.cols[1]
+        if lo.validity is not None and not lo.validity[0]:
+            continue
+        mins.append(int(np.asarray(lo.values)[0]))
+        maxs.append(int(np.asarray(hi.values)[0]))
+    if not mins:
+        return None
+    return min(mins), max(maxs)
 
 
 def _dist_range(instance, plan: SelectPlan, table):
@@ -229,62 +483,87 @@ def _dist_range(instance, plan: SelectPlan, table):
         k.expr.name for k in plan.keys
         if isinstance(k.expr, A.Column)
     }
-    if len(by) != len(plan.keys) or by != tags:
+    if len(by) != len(plan.keys) or not by >= tags:
         return None  # groups span datanodes; fall back
-    if plan.fill is not None or any(
+    names = [nm for _, nm in plan.post_items]
+    has_fill = plan.fill is not None or any(
         r.fill is not None for r in plan.range_items
-    ):
-        # fill grids span the GLOBAL time range; per-datanode grids
-        # would differ. Fall back to data shipping.
-        return None
-    if plan.having is not None or plan.distinct:
-        # the concat merge applies only sort/limit; HAVING/DISTINCT
-        # would be silently dropped — fall back
-        return None
+    )
+    grid = None
+    if has_fill:
+        # fill grids span the GLOBAL time range; agree on it first and
+        # ship it as an explicit override so per-datanode grids match
+        grid = _global_ts_extent(instance, plan, table)
+        if grid is None:
+            # zero rows anywhere: fall back so the empty result carries
+            # the standalone-typed schema
+            return None
     # ship the visible items PLUS the plan's internal columns (__ts,
     # group keys, range-item values): the final ORDER BY may reference
     # them (the planner rewrites `ts` -> __ts etc.)
-    names = [nm for _, nm in plan.post_items]
     internal = ["__ts"] + [k.key for k in plan.keys] + [
         r.key for r in plan.range_items
     ]
     partial_items = list(plan.post_items) + [
         (A.Column(key), key) for key in internal
     ]
+    push_limit = None
+    partial_order: list = []
+    if plan.limit is not None and not plan.distinct:
+        # (range partials always carry internal columns, so a datanode-
+        # side DISTINCT is weaker than the visible tuple — see
+        # _dist_plain for why LIMIT must not push below it)
+        push_limit = (plan.offset or 0) + plan.limit
+        partial_order = plan.order_by
     partial = SelectPlan(
         kind="range", table_name=plan.table_name, scan=plan.scan,
         keys=plan.keys, range_items=plan.range_items,
         post_items=partial_items, align_ms=plan.align_ms,
-        align_to=plan.align_to, fill=None,
+        align_to=plan.align_to, fill=plan.fill,
+        having=plan.having,  # row-wise over datanode-disjoint groups
+        distinct=plan.distinct,  # weaker datanode-side; re-applied below
+        order_by=partial_order, limit=push_limit,
         ts_out_name=plan.ts_out_name,
+        grid_ts_min=None if grid is None else grid[0],
+        grid_ts_max=None if grid is None else grid[1],
     )
     results = _fan_out(instance, table, partial)
     parts = [res for _addr, res in results if res.num_rows]
-    if not parts:
-        return QueryResult(names, [Col(np.zeros(0)) for _ in names])
-
-    def concat(i):
-        vals = np.concatenate([
-            np.asarray(p.cols[i].values) for p in parts
-        ])
-        valid = np.concatenate([
-            (p.cols[i].validity if p.cols[i].validity is not None
-             else np.ones(p.num_rows, bool))
-            for p in parts
-        ])
-        return Col(vals, None if valid.all() else valid)
-
-    cols = [concat(i) for i in range(len(names))]
-    from greptimedb_tpu.query.executor import DictSource
-
-    n_rows = len(cols[0]) if cols else 0
-    extra = DictSource({
-        key: concat(len(names) + j) for j, key in enumerate(internal)
-    }, n_rows)
-    engine = instance.query_engine
-    cols = engine._order_limit(plan, cols, names, extra_src=extra)
-    engine._record_path("range", "dist:partial")
-    types = {}
+    types: dict = {}
     for _addr, res in results:
         types.update(res.types)
-    return QueryResult(names, cols, types)
+    if not parts:
+        return QueryResult(names, [Col(np.zeros(0)) for _ in names], types)
+    total = len(partial_items)
+    cols = [_cat_col(parts, i) for i in range(total)]
+    vis = cols[:len(names)]
+    by_name = dict(zip(names + internal, cols))
+    n_rows = len(cols[0]) if cols else 0
+    if plan.distinct:
+        didx = _distinct_indices(vis)
+        cols = _slice_result(cols, didx)
+        vis = cols[:len(names)]
+        by_name = dict(zip(names + internal, cols))
+        n_rows = len(didx)
+    engine = instance.query_engine
+    if plan.order_by:
+        extra = DictSource(
+            {key: by_name[key] for key in internal}, n_rows
+        )
+        vis = engine._order_limit(plan, vis, names, extra_src=extra)
+    else:
+        # standalone default order: ts-major, then groups ranked by key
+        # values (ADVICE r4: concat order interleaved datanode blocks)
+        sort_cols = [by_name["__ts"]] + [
+            by_name[k.key] for k in plan.keys
+        ]
+        idx = _sort_indices(
+            sort_cols, [True] * len(sort_cols), [None] * len(sort_cols)
+        )
+        vis = _slice_result(vis, idx)
+        off = plan.offset or 0
+        if off or plan.limit is not None:
+            end = None if plan.limit is None else off + plan.limit
+            vis = _slice_result(vis, slice(off, end))
+    engine._record_path("range", "dist:partial")
+    return QueryResult(names, vis, types)

@@ -1520,6 +1520,11 @@ def execute_range_device(engine, plan, table):
     active, ts_min_f, ts_max_f = run_prelude(entry, sid_mask, lo, hi)
     if ts_min_f is None:
         return empty
+    if plan.grid_ts_min is not None:
+        # distributed fill-grid override (see dist/dist_query.py): use
+        # the negotiated global extent so per-datanode grids match
+        ts_min_f = plan.grid_ts_min
+        ts_max_f = plan.grid_ts_max
 
     # window math — identical to the host path (executor._execute_range)
     align_to = plan.align_to % align if plan.align_to else 0

@@ -605,8 +605,12 @@ class QueryEngine:
         gid, g, key_cols = self._group_ids(plan, src)
 
         ts = rows.ts
-        ts_min = int(ts.min())
-        ts_max = int(ts.max())
+        # distributed fill-grid override: the frontend negotiated the
+        # global scanned extent so every datanode's grid is identical
+        ts_min = (plan.grid_ts_min if plan.grid_ts_min is not None
+                  else int(ts.min()))
+        ts_max = (plan.grid_ts_max if plan.grid_ts_max is not None
+                  else int(ts.max()))
         max_range = max(r.range_ms for r in plan.range_items)
         # steps t with (t, t+range) ∩ data ≠ ∅:  t > ts_min - range, t <= ts_max
         j_first = -((-(ts_min - max_range + 1 - align_to)) // align)

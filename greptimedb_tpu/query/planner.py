@@ -88,6 +88,12 @@ class SelectPlan:
     align_to: int = 0
     fill: str | None = None
     ts_out_name: str | None = None
+    # explicit RANGE grid extent override (ms): set by the distributed
+    # planner so every datanode builds the same fill grid (the global
+    # scanned-ts extent, negotiated in dist/dist_query.py); None = derive
+    # from the scanned data as usual
+    grid_ts_min: int | None = None
+    grid_ts_max: int | None = None
 
     def explain_lines(self) -> list[str]:
         out = [f"SelectPlan[{self.kind}] table={self.table_name}"]

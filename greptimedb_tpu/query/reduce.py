@@ -42,6 +42,40 @@ def dev_block_ids(n: int, blocks: int):
 # host path
 # ----------------------------------------------------------------------
 
+def grouped_minmax_typed(op: str, values, valid, gid, g: int):
+    """Per-group min/max preserving the input dtype: BIGINT/timestamp
+    extremes above 2^53 never round-trip through float, strings order
+    lexicographically via lexsort, floats keep numpy NaN propagation.
+    Shared by the host reduce and the distributed partial merge
+    (dist/dist_query.py). Returns (out_values, present_mask)."""
+    present = np.zeros(g, bool)
+    present[gid[valid]] = True
+    if values.dtype == object or values.dtype.kind in "US":
+        vv = values[valid].astype(str)
+        gg = gid[valid]
+        order = np.lexsort((vv, gg))
+        gs = gg[order]
+        edge = np.ones(len(gs), bool)
+        if op == "min":
+            edge[1:] = gs[1:] != gs[:-1]
+        else:
+            edge[:-1] = gs[:-1] != gs[1:]
+        out = np.full(g, "", object)
+        out[gs[edge]] = values[valid][order][edge]
+        return out, present
+    ufunc = np.minimum if op == "min" else np.maximum
+    if values.dtype.kind in "iu":
+        info = np.iinfo(values.dtype)
+        init = info.max if op == "min" else info.min
+        out = np.full(g, init, values.dtype)
+        ufunc.at(out, gid[valid], values[valid])
+        return np.where(present, out, 0), present
+    v = values.astype(np.float64, copy=False)
+    out = np.full(g, np.inf if op == "min" else -np.inf)
+    ufunc.at(out, gid[valid], v[valid])
+    return np.where(present, out, 0.0), present
+
+
 def _host_reduce(op: str, values, valid, gid, g: int, q: float | None,
                  order_ts=None):
     """One aggregate over host arrays. values may be None for count(*).
@@ -68,6 +102,18 @@ def _host_reduce(op: str, values, valid, gid, g: int, q: float | None,
         )
         return np.bincount(pairs[0], minlength=g).astype(np.int64), None
 
+    # dtype-preserving paths BEFORE the f64 cast: BIGINT/timestamp
+    # extremes and sums above 2^53 must stay exact, and strings order
+    # lexicographically (the reference's arrow kernels are typed too)
+    if op in ("min", "max"):
+        return grouped_minmax_typed(op, values, valid, gid, g)
+    if op == "sum" and values.dtype.kind in "iu":
+        present = np.zeros(g, bool)
+        present[gid[valid]] = True
+        out = np.zeros(g, np.int64)
+        np.add.at(out, gid[valid], values[valid].astype(np.int64))
+        return out, present
+
     v = values.astype(np.float64, copy=False)
     vm = np.where(valid, v, 0.0)
     cnt = np.bincount(gid[valid], minlength=g)
@@ -78,12 +124,6 @@ def _host_reduce(op: str, values, valid, gid, g: int, q: float | None,
     if op == "mean":
         s = np.bincount(gid, weights=vm, minlength=g)
         return s / np.maximum(cnt, 1), present
-    if op in ("min", "max"):
-        fill = np.inf if op == "min" else -np.inf
-        out = np.full(g, fill)
-        ufunc = np.minimum if op == "min" else np.maximum
-        ufunc.at(out, gid[valid], v[valid])
-        return np.where(present, out, 0.0), present
     if op in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
         s = np.bincount(gid, weights=vm, minlength=g)
         mean = s / np.maximum(cnt, 1)

@@ -126,6 +126,19 @@ def _frame_mode(spec: A.WindowSpec) -> tuple[str, int | None]:
     m = _re.fullmatch(r"(\d+)\s+PRECEDING\s+AND\s+CURRENT\s+ROW", body)
     if m and text.startswith("ROWS"):
         return "rows_pre", int(m.group(1))
+    if "BETWEEN" not in text:
+        # shorthand: 'ROWS k PRECEDING' == BETWEEN k PRECEDING AND
+        # CURRENT ROW (SQL standard default frame end). Without BETWEEN
+        # the split above kept the ROWS/RANGE keyword — strip it.
+        short = _re.sub(r"^(ROWS|RANGE)\s+", "", body)
+        m = _re.fullmatch(r"(\d+)\s+PRECEDING", short)
+        if m and text.startswith("ROWS"):
+            return "rows_pre", int(m.group(1))
+        if short == "UNBOUNDED PRECEDING":
+            if not spec.order_by:
+                return "whole", None
+            return ("running_rows" if text.startswith("ROWS")
+                    else "running"), None
     raise UnsupportedError(f"window frame not supported: {spec.frame}")
 
 

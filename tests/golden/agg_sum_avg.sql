@@ -14,7 +14,7 @@ sum(v)|avg(v)
 SELECT sum(n), avg(n) FROM s;
 ----
 sum(n)|avg(n)
-30.0|15.0
+30|15.0
 
 SELECT sum(v) FROM s WHERE v > 100;
 ----

@@ -28,10 +28,10 @@ c
 SELECT cust, amount, (SELECT max(tier) FROM vip v WHERE v.name = o.cust) AS t FROM orders o ORDER BY cust, amount;
 ----
 cust|amount|t
-a|10.0|1.0
-a|20.0|1.0
+a|10.0|1
+a|20.0|1
 b|5.0|NULL
-c|50.0|2.0
+c|50.0|2
 
 SELECT cust, (SELECT count(*) FROM vip v WHERE v.name = o.cust) AS n FROM orders o WHERE amount > 15 ORDER BY cust;
 ----

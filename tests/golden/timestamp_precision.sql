@@ -18,7 +18,7 @@ count(*)
 SELECT max(ts) FROM tp;
 ----
 max(ts)
-2500.0
+2500
 
 DROP TABLE tp;
 

@@ -347,6 +347,259 @@ def test_range_having_distinct_fall_back_correctly(harness,
         assert fe.sql(sql).rows() == standalone_ref.sql(sql).rows(), sql
 
 
+def test_plain_select_pushdown(harness, standalone_ref):
+    """Plain SELECT (filters/projections/scalar exprs) is fully
+    commutative: the whole plan ships; ORDER BY + LIMIT push as
+    per-datanode top-k partials (commutativity.rs:164-189 analog)."""
+    from greptimedb_tpu.query import stats as qstats
+
+    fe = harness.frontend
+    _seed(fe)
+    cases = [
+        "select host, usage * 2 + 1 as d from cpu where usage > 3 "
+        "order by d, host limit 7",
+        "select distinct dc from cpu order by dc",
+        "select ts, host, usage from cpu where host like 'h1%' "
+        "order by ts desc, host limit 4",
+        "select host, usage from cpu order by usage desc, host limit 3",
+    ]
+    for sql in cases:
+        with qstats.collect() as st:
+            got = fe.sql(sql).rows()
+        want = standalone_ref.sql(sql).rows()
+        assert got == want, sql
+        assert st.counters.get("dist_partial_datanodes", 0) >= 1, sql
+        assert not st.counters.get("dist_pushdown_errors"), sql
+
+
+def test_plain_pushdown_limits_wire_rows(harness):
+    """A pushed top-k must ship at most k rows per datanode, not the
+    whole table."""
+    import json as _json
+
+    from greptimedb_tpu.query import stats as qstats
+
+    fe = harness.frontend
+    _seed(fe)
+    with qstats.collect() as st:
+        fe.sql("select host, usage from cpu order by usage desc limit 3")
+    partial_rows = sum(
+        _json.loads(v)["partial_rows"]
+        for k, v in st.notes.items() if k.startswith("datanode_")
+    )
+    assert 0 < partial_rows <= 9  # <= limit x 3 datanodes, not 80
+
+
+def test_variance_stddev_pushdown(harness, standalone_ref):
+    """var/stddev decompose into sum+count+sum-of-squares partials."""
+    from greptimedb_tpu.query import stats as qstats
+
+    fe = harness.frontend
+    _seed(fe)
+    for sql in [
+        "select dc, var(usage), stddev(usage) from cpu group by dc "
+        "order by dc",
+        "select var_pop(usage), stddev_pop(mem) from cpu",
+    ]:
+        with qstats.collect() as st:
+            got = fe.sql(sql).rows()
+        want = standalone_ref.sql(sql).rows()
+        assert len(got) == len(want), sql
+        for grow, wrow in zip(got, want):
+            for gv, wv in zip(grow, wrow):
+                if isinstance(gv, float):
+                    assert abs(gv - wv) < 1e-9 * max(1.0, abs(wv)), sql
+                else:
+                    assert gv == wv, sql
+        assert st.counters.get("dist_partial_datanodes", 0) == 3, sql
+        assert not st.counters.get("dist_pushdown_errors"), sql
+
+
+def test_count_distinct_pushdown(harness, standalone_ref):
+    """COUNT(DISTINCT x) ships as GROUP BY (keys, x); the frontend
+    counts distinct codes — values, not rows, cross the wire."""
+    from greptimedb_tpu.query import stats as qstats
+
+    fe = harness.frontend
+    _seed(fe)
+    for sql in [
+        "select dc, count(distinct host) from cpu group by dc order by dc",
+        "select count(distinct dc) from cpu",
+    ]:
+        with qstats.collect() as st:
+            got = fe.sql(sql).rows()
+        assert got == standalone_ref.sql(sql).rows(), sql
+        assert st.counters.get("dist_partial_datanodes", 0) == 3, sql
+        assert not st.counters.get("dist_pushdown_errors"), sql
+
+
+def test_minmax_merge_preserves_dtype(harness, standalone_ref):
+    """BIGINT/timestamp extremes above 2^53 must merge exactly (no float
+    round-trip) and keep integer output type across 3 datanodes."""
+    fe = harness.frontend
+    big = 2**53
+    for inst in (fe, standalone_ref):
+        inst.execute_sql(
+            "create table big (ts timestamp time index, host string "
+            "primary key, n bigint) with (num_regions = 3)"
+        )
+        inst.execute_sql(
+            "insert into big (host, ts, n) values "
+            f"('a', 1000, {big + 1}), ('b', 2000, {big + 3}), "
+            f"('c', 3000, {big + 5})"
+        )
+    sql = "select min(n), max(n), min(ts), max(ts) from big"
+    got = fe.sql(sql).rows()
+    assert got == standalone_ref.sql(sql).rows()
+    assert got[0][0] == big + 1 and got[0][1] == big + 5
+    assert all(isinstance(v, int) for v in got[0])
+
+
+def test_string_minmax_pushdown(harness, standalone_ref):
+    fe = harness.frontend
+    _seed(fe)
+    sql = "select dc, min(host), max(host) from cpu group by dc order by dc"
+    assert fe.sql(sql).rows() == standalone_ref.sql(sql).rows()
+
+
+def test_range_fill_pushdown_global_grid(harness, standalone_ref):
+    """RANGE + FILL pushes down after negotiating the GLOBAL ts extent:
+    per-datanode fill grids must be identical to standalone's."""
+    from greptimedb_tpu.query import stats as qstats
+
+    fe = harness.frontend
+    _seed(fe)
+    # make the per-datanode extents differ: one host gets extra points
+    for inst in (fe, standalone_ref):
+        inst.execute_sql(
+            "insert into cpu (host, dc, ts, usage, mem) values "
+            "('h0', 'dc0', 1700000200000, 42.0, 1.0)"
+        )
+    for sql in [
+        "select ts, host, dc, avg(usage) range '10s' fill prev from cpu "
+        "align '10s' order by ts, host",
+        "select ts, host, dc, max(usage) range '10s' fill 0 from cpu "
+        "align '10s' order by ts, host limit 40",
+        "select ts, host, dc, sum(usage) range '10s' fill linear "
+        "from cpu align '10s' order by ts, host",
+    ]:
+        with qstats.collect() as st:
+            got = fe.sql(sql).rows()
+        want = standalone_ref.sql(sql).rows()
+        assert got == want, sql
+        assert st.counters.get("dist_partial_datanodes", 0) >= 3, sql
+        assert not st.counters.get("dist_pushdown_errors"), sql
+
+
+def test_range_having_now_pushes_down(harness, standalone_ref):
+    """HAVING over datanode-disjoint range rows ships with the partial
+    (row-wise predicate), no longer a fallback."""
+    from greptimedb_tpu.query import stats as qstats
+
+    fe = harness.frontend
+    _seed(fe)
+    sql = ("select ts, host, dc, max(usage) range '10s' as m from cpu "
+           "align '10s' having m > 10 order by ts, host")
+    with qstats.collect() as st:
+        got = fe.sql(sql).rows()
+    assert got == standalone_ref.sql(sql).rows()
+    assert st.counters.get("dist_partial_datanodes", 0) == 3
+    assert not st.counters.get("dist_pushdown_errors")
+
+
+def test_range_default_order_matches_standalone(harness, standalone_ref):
+    """No ORDER BY: merged rows must come back in standalone's default
+    (ts, group keys) order, not interleaved datanode blocks (ADVICE r4)."""
+    fe = harness.frontend
+    _seed(fe)
+    sql = ("select ts, host, dc, avg(usage) range '10s' from cpu "
+           "align '10s'")
+    assert fe.sql(sql).rows() == standalone_ref.sql(sql).rows()
+
+
+def test_join_scan_sides_push_down(harness, standalone_ref):
+    """Join branches route through _select_single, so each scan side
+    ships its filter/projection to the datanodes."""
+    from greptimedb_tpu.query import stats as qstats
+
+    fe = harness.frontend
+    _seed(fe)
+    sql = (
+        "select a.host, a.usage, b.mem from "
+        "(select host, ts, usage from cpu where usage > 3) a join "
+        "(select host, ts, mem from cpu where mem < 105) b "
+        "on a.host = b.host and a.ts = b.ts "
+        "order by a.host, a.usage limit 10"
+    )
+    with qstats.collect() as st:
+        got = fe.sql(sql).rows()
+    assert got == standalone_ref.sql(sql).rows()
+    # both scan sides fanned out partial plans
+    assert st.counters.get("dist_partial_datanodes", 0) >= 2
+    assert not st.counters.get("dist_pushdown_errors")
+
+
+def test_distinct_limit_not_truncated_by_partial(harness, standalone_ref):
+    """LIMIT must not push below a datanode-side DISTINCT that dedups
+    over a WIDER tuple than the visible one (code-review r5 repro)."""
+    fe = harness.frontend
+    for inst in (fe, standalone_ref):
+        inst.execute_sql(
+            "create table m (ts timestamp time index, host string "
+            "primary key, v double) with (num_regions = 3)"
+        )
+        vals = ", ".join(
+            f"('h1', {1000 + i * 10_000}, 5.0)" for i in range(6)
+        )
+        inst.execute_sql(f"insert into m (host, ts, v) values {vals}, "
+                         "('h1', 70000, 6.0), ('h2', 1000, 7.0)")
+    for sql in [
+        "select distinct host, avg(v) range '10s' as a from m "
+        "align '10s' order by host, a limit 3",
+        "select distinct host, v from m order by host, v limit 3",
+    ]:
+        assert fe.sql(sql).rows() == standalone_ref.sql(sql).rows(), sql
+
+
+def test_empty_keyed_aggregate_stays_pushed(harness, standalone_ref):
+    """All-datanodes-empty keyed aggregates must merge to zero rows
+    without tripping the fallback (code-review r5 repro)."""
+    from greptimedb_tpu.query import stats as qstats
+
+    fe = harness.frontend
+    _seed(fe)
+    sql = "select dc, sum(usage) from cpu where usage > 1e9 group by dc"
+    with qstats.collect() as st:
+        got = fe.sql(sql).rows()
+    assert got == standalone_ref.sql(sql).rows()
+    assert not st.counters.get("dist_pushdown_errors")
+    assert st.counters.get("dist_partial_datanodes", 0) == 3
+
+
+def test_failed_create_rolls_back_kv_claim(tmp_path):
+    """A create that fails region placement must delete its kv claim so
+    the name is reusable (code-review r5 repro)."""
+    from greptimedb_tpu.dist.client import MetaClient
+    from greptimedb_tpu.dist.frontend import DistInstance
+    from greptimedb_tpu.servers.meta_http import MetasrvServer
+
+    meta = MetasrvServer(addr="127.0.0.1", port=0,
+                         data_home=str(tmp_path / "meta")).start()
+    try:
+        fe = DistInstance(str(tmp_path / "fe"),
+                          f"127.0.0.1:{meta.port}", prefer_device=False)
+        ddl = ("create table t1 (ts timestamp time index, host string "
+               "primary key, v double)")
+        with pytest.raises(Exception):
+            fe.execute_sql(ddl)  # no datanodes registered -> placement fails
+        assert MetaClient(f"127.0.0.1:{meta.port}").kv_get(
+            "__cat/table/public/t1"
+        ) is None
+        fe.close()
+    finally:
+        meta.close()
+
+
 def test_pushdown_prunes_partitioned_regions(harness, standalone_ref):
     """PARTITION ON routing: a pushdown with a partition-key matcher
     must skip datanodes whose regions cannot match."""

@@ -202,6 +202,18 @@ def test_window_rows_preceding_frames(tmp_path):
             "from w order by g, ts"
         ).rows()
         assert [x[0] for x in r] == [1.0, 2.0, 3.0, 4.0, 10.0, 20.0]
+        # shorthand frame: 'ROWS k PRECEDING' == BETWEEN k PRECEDING AND
+        # CURRENT ROW (ADVICE r4)
+        r = inst.sql(
+            "select sum(v) over (partition by g order by ts "
+            "rows 1 preceding) as s from w order by g, ts"
+        ).rows()
+        assert [x[0] for x in r] == [1.0, 3.0, 5.0, 7.0, 10.0, 30.0]
+        r = inst.sql(
+            "select sum(v) over (partition by g order by ts "
+            "rows unbounded preceding) as s from w order by g, ts"
+        ).rows()
+        assert [x[0] for x in r] == [1.0, 3.0, 6.0, 10.0, 10.0, 30.0]
     finally:
         inst.close()
 
