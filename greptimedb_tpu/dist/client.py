@@ -94,6 +94,12 @@ class DatanodeClient:
             .get("flushed")
         )
 
+    def compact_region(self, region_id: int) -> bool:
+        return bool(
+            self.action("compact_region", {"region_id": region_id})
+            .get("compacted")
+        )
+
     def truncate_region(self, region_id: int):
         self.action("truncate_region", {"region_id": region_id})
 
@@ -121,11 +127,16 @@ class DatanodeClient:
 
         from greptimedb_tpu.dist.codec import arrow_to_scan
 
+        from greptimedb_tpu.dist import plan_codec
+
         ticket = {
             "rpc": "region_scan", "region_ids": list(region_ids),
             "ts_min": ts_min, "ts_max": ts_max, "fields": fields,
+            # plan-codec encoding: regex matchers (=~) carry compiled
+            # patterns which plain JSON cannot ship
             "matchers": (
-                [[m[0], m[1], m[2]] for m in matchers] if matchers else None
+                [[m[0], m[1], plan_codec.encode(m[2])] for m in matchers]
+                if matchers else None
             ),
             "fulltext": (
                 [list(f) for f in fulltext] if fulltext else None

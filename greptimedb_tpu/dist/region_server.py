@@ -109,6 +109,11 @@ class RegionServer:
     def flush_region(self, region_id: int) -> bool:
         return self._region(region_id).flush() is not None
 
+    def compact_region(self, region_id: int) -> bool:
+        from greptimedb_tpu.storage.compaction import compact_once
+
+        return bool(compact_once(self._region(region_id)))
+
     def truncate_region(self, region_id: int) -> None:
         self._region(region_id).truncate()
 

@@ -103,6 +103,9 @@ class RemoteRegion:
         return True if self.client.flush_region(self.meta.region_id) \
             else None
 
+    def compact(self) -> bool:
+        return bool(self.client.compact_region(self.meta.region_id))
+
     def truncate(self):
         self.client.truncate_region(self.meta.region_id)
         self._stats_cache = None

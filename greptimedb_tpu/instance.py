@@ -444,25 +444,18 @@ class Standalone:
                 if name == "flush_table":
                     if region.flush() is not None:
                         n += 1
-                else:
-                    from greptimedb_tpu.storage.compaction import (
-                        compact_once,
-                    )
-
-                    if compact_once(region):
-                        n += 1
+                elif region.compact():
+                    n += 1
             return Output.records(_result_from_lists(
                 [f"ADMIN {name}('{ident}')"], [[n]]
             ))
         if name in ("flush_region", "compact_region"):
             rid = const_int(0)
-            region = self.engine.region(rid)
+            region = self._region_by_id(rid)
             if name == "flush_region":
                 n = 1 if region.flush() is not None else 0
             else:
-                from greptimedb_tpu.storage.compaction import compact_once
-
-                n = 1 if compact_once(region) else 0
+                n = 1 if region.compact() else 0
             return Output.records(_result_from_lists(
                 [f"ADMIN {name}({rid})"], [[n]]
             ))
@@ -1040,6 +1033,23 @@ class Standalone:
         return _result_from_lists(["Flows"], [self.flows.flow_names()])
 
     # ------------------------------------------------------------------
+    def _region_by_id(self, rid: int):
+        """Region handle for ADMIN by-id calls: the local engine's region
+        in standalone; on a distributed frontend (which owns no storage)
+        the catalog's remote-region proxy for that id."""
+        from greptimedb_tpu.errors import RegionNotFoundError
+
+        try:
+            return self.engine.region(rid)
+        except RegionNotFoundError:
+            for db in self.catalog.database_names():
+                for tname in self.catalog.table_names(db):
+                    table = self.catalog.maybe_table(db, tname)
+                    for region in (table.regions if table else []):
+                        if region.meta.region_id == rid:
+                            return region
+            raise
+
     def _resolve(self, name: str, ctx: QueryContext) -> tuple[str, str]:
         if "." in name:
             db, t = name.split(".", 1)

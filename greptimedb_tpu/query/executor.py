@@ -449,30 +449,34 @@ class QueryEngine:
         if not plan.keys:
             return np.zeros(n, dtype=np.int64), 1, {}
         code_cols = []
-        decoders = []  # (uniq_values, validity|None) or None for direct
+        decoders = []  # (vocab array, null_code | None)
         for k in plan.keys:
             e = k.expr
             if isinstance(e, A.Column) and e.name in src.tag_names:
                 codes, vocab = src.tag_codes_per_row(e.name)
                 code_cols.append(codes.astype(np.int64))
-                decoders.append(("vocab", vocab))
+                decoders.append((vocab, None))
+                continue
+            c = eval_expr(e, src)
+            v = c.values
+            if v.dtype == object or v.dtype.kind in ("U", "S"):
+                uniq, inv = np.unique(v.astype(str), return_inverse=True)
+                codes = inv.astype(np.int64)
+                vocab = uniq.astype(object)
+                null_fill = ""
             else:
-                c = eval_expr(e, src)
-                v = c.values
-                if v.dtype == object or v.dtype.kind in ("U", "S"):
-                    uniq, inv = np.unique(v.astype(str), return_inverse=True)
-                    code_cols.append(inv.astype(np.int64))
-                    decoders.append(("vocab", uniq.astype(object)))
-                else:
-                    code_cols.append(None)
-                    decoders.append(("raw", c))
-        # normalize raw numeric keys to codes
-        for i, cc in enumerate(code_cols):
-            if cc is None:
-                c = decoders[i][1]
-                uniq, inv = np.unique(c.values, return_inverse=True)
-                code_cols[i] = inv.astype(np.int64)
-                decoders[i] = ("vocab", uniq)
+                uniq, inv = np.unique(v, return_inverse=True)
+                codes = inv.astype(np.int64)
+                vocab = uniq
+                null_fill = uniq[0] if len(uniq) else 0
+            null_code = None
+            if c.validity is not None and not c.validity.all():
+                # NULL is its own group, distinct from every value
+                null_code = len(vocab)
+                codes = np.where(c.validity, codes, null_code)
+                vocab = np.append(vocab, null_fill)
+            code_cols.append(codes)
+            decoders.append((vocab, null_code))
         combined = code_cols[0]
         cards = [int(cc.max()) + 1 if len(cc) else 1 for cc in code_cols]
         for cc, card in zip(code_cols[1:], cards[1:]):
@@ -486,9 +490,15 @@ class QueryEngine:
             card = cards[i]
             code_i = rem % card
             rem = rem // card
-            vocab = decoders[i][1]
-            vals = vocab[code_i] if isinstance(vocab, np.ndarray) else vocab.values[code_i]
-            key_cols[plan.keys[i].key] = Col(np.asarray(vals))
+            vocab, null_code = decoders[i]
+            vals = (vocab[code_i] if isinstance(vocab, np.ndarray)
+                    else vocab.values[code_i])
+            validity = None
+            if null_code is not None:
+                validity = code_i != null_code
+                if validity.all():
+                    validity = None
+            key_cols[plan.keys[i].key] = Col(np.asarray(vals), validity)
         return gid.astype(np.int64), g, key_cols
 
     def _execute_aggregate(self, plan, src: RowsSource, table) -> QueryResult:

@@ -25,7 +25,15 @@ from greptimedb_tpu.session import QueryContext
 
 
 def result_to_arrow(res) -> pa.Table:
-    """QueryResult -> Arrow table (timestamps become timestamp[ms])."""
+    """QueryResult -> Arrow table (timestamps become timestamp[ms]).
+
+    Declared result types that arrow cannot carry natively here (e.g.
+    DECIMAL held as scaled float64 + (p,s) typing, INTERVAL as int64 ms)
+    ride as schema metadata so the receiving side restores them — the
+    RecordBatch extension-metadata trick the reference uses on Flight
+    (/root/reference/src/common/grpc/src/flight.rs:45)."""
+    import json as _json
+
     arrays = []
     fields = []
     for name, col in zip(res.names, res.cols):
@@ -41,7 +49,13 @@ def result_to_arrow(res) -> pa.Table:
             arr = pa.array(vals, mask=mask)
         arrays.append(arr)
         fields.append(pa.field(name, arr.type))
-    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+    tbl = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+    declared = {n: dt.name for n, dt in res.types.items() if dt is not None}
+    if declared:
+        meta = dict(tbl.schema.metadata or {})
+        meta[b"gtdb:types"] = _json.dumps(declared).encode()
+        tbl = tbl.replace_schema_metadata(meta)
+    return tbl
 
 
 class _BearerMiddleware(flight.ServerMiddleware):
@@ -190,12 +204,18 @@ class FlightServer(flight.FlightServerBase):
         doc = json.loads(raw)
         rpc = doc.get("rpc")
         if rpc == "region_scan":
+            from greptimedb_tpu.dist import plan_codec
+
             rs = self._region_server()
             rows, tag_values, names, stats = rs.scan(
                 doc["region_ids"],
                 ts_min=doc.get("ts_min"), ts_max=doc.get("ts_max"),
                 field_names=doc.get("fields"),
-                matchers=doc.get("matchers"),
+                matchers=(
+                    [(m[0], m[1], plan_codec.decode(m[2]))
+                     for m in doc["matchers"]]
+                    if doc.get("matchers") else None
+                ),
                 fulltext=(
                     [tuple(f) for f in doc["fulltext"]]
                     if doc.get("fulltext") else None
@@ -235,6 +255,8 @@ class FlightServer(flight.FlightServerBase):
             rs.drop_region(int(body["region_id"]))
         elif kind == "flush_region":
             return {"flushed": rs.flush_region(int(body["region_id"]))}
+        elif kind == "compact_region":
+            return {"compacted": rs.compact_region(int(body["region_id"]))}
         elif kind == "truncate_region":
             rs.truncate_region(int(body["region_id"]))
         elif kind == "alter_region":

@@ -33,11 +33,17 @@ class Output:
 
 
 def arrow_to_result(table) -> QueryResult:
+    import pyarrow as pa
+
     names = []
     cols = []
     types = {}
-    import pyarrow as pa
-
+    declared = {}
+    meta = table.schema.metadata or {}
+    if b"gtdb:types" in meta:
+        # declared sender-side types (DECIMAL scale, INTERVAL...) that
+        # the arrow physical type alone cannot express
+        declared = json.loads(meta[b"gtdb:types"])
     for field in table.schema:
         arr = table.column(field.name)
         if isinstance(arr, pa.ChunkedArray):
@@ -48,7 +54,12 @@ def arrow_to_result(table) -> QueryResult:
         names.append(field.name)
         valid = hc.valid_mask
         cols.append(Col(hc.values, None if valid.all() else valid))
-        types[field.name] = ConcreteDataType.from_arrow(field.type)
+        if field.name in declared:
+            types[field.name] = ConcreteDataType.from_name(
+                declared[field.name]
+            )
+        else:
+            types[field.name] = ConcreteDataType.from_arrow(field.type)
     return QueryResult(names, cols, types)
 
 

@@ -532,6 +532,13 @@ class Region:
         return True
 
     # ------------------------------------------------------------------
+    def compact(self) -> bool:
+        """Run one compaction round if the TWCS picker selects files.
+        The uniform surface shared with RemoteRegion.compact()."""
+        from greptimedb_tpu.storage.compaction import compact_once
+
+        return bool(compact_once(self))
+
     def invalidate_scan_cache(self):
         """Explicit invalidation for schema changes (ALTER drops/adds can
         leave data_version + field_names identical, e.g. drop+re-add of

@@ -217,12 +217,16 @@ class SeriesRegistry:
             elif op == "nin":
                 ok_codes = ~np.isin(vals.astype(str), list(value))
             elif op == "re":
+                # dtype=bool: an EMPTY comprehension defaults to float64
+                # and `keep &= ...` explodes on a zero-series region
                 ok_codes = np.asarray(
-                    [bool(value.fullmatch(str(v))) for v in vals]
+                    [bool(value.fullmatch(str(v))) for v in vals],
+                    dtype=bool,
                 )
             elif op == "nre":
                 ok_codes = np.asarray(
-                    [not value.fullmatch(str(v)) for v in vals]
+                    [not value.fullmatch(str(v)) for v in vals],
+                    dtype=bool,
                 )
             else:
                 raise ValueError(op)
