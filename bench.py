@@ -539,6 +539,42 @@ def _bench_promql_1m(inst):
         "tunnel_floor_ms_median": round(med_floor, 3),
     }))
 
+    # round-5 fast paths over the same 1M-series table (VERDICT r4 #4):
+    # topk, vector/vector division, quantile_over_time — each one fused
+    # XLA program, < 100 ms p50 target
+    extra_target = 100.0
+    for metric, q2, expect, elems in [
+        ("promql_1m_topk_p50_ms",
+         "topk(5, rate(prom_bench[1m]))", 5, 5 * n_steps),
+        ("promql_1m_vector_div_p50_ms",
+         "sum by (dc) (rate(prom_bench[1m]) / "
+         "last_over_time(prom_bench[1m]))", 32, 32 * n_steps),
+        ("promql_1m_quantile_over_time_p50_ms",
+         "sum by (dc) (quantile_over_time(0.9, prom_bench[2m]))", 32,
+         32 * n_steps),
+    ]:
+        def run2(q2=q2, expect=expect):
+            engine = PromEngine(inst)
+            val, ev2 = engine.query_range(q2, start, end, step)
+            resp = _prom_matrix_json(val, ev2)
+            assert len(resp["data"]["result"]) >= expect, (
+                q2, len(resp["data"]["result"])
+            )
+            return resp
+
+        run2()  # compile
+        adj2, med_wall2, med_floor2 = _measure_fn(
+            run2, label=q2, result_elems=elems, runs=11,
+        )
+        print(json.dumps({
+            "metric": metric,
+            "value": round(adj2, 3),
+            "unit": "ms",
+            "vs_baseline": round(extra_target / adj2, 2),
+            "raw_wall_ms_median": round(med_wall2, 3),
+            "tunnel_floor_ms_median": round(med_floor2, 3),
+        }))
+
 
 def _bench_promql_histogram(inst):
     """histogram_quantile(0.9, rate(...[1m]))` over 100k bucket series

@@ -193,6 +193,10 @@ class PromEngine:
             from greptimedb_tpu.promql import fast as F
 
             hit = F.try_fast(self, e, ev)
+            if hit is None:
+                hit = F.try_fast_topk(self, e, ev)
+            if hit is None and isinstance(e.expr, Binary):
+                hit = F.try_fast_binary(self, e.expr, ev, agg=e)
             if hit is not None:
                 return hit
             return self._eval_agg(e, ev)
@@ -534,6 +538,11 @@ class PromEngine:
     # binary operators
     # ------------------------------------------------------------------
     def _eval_binary(self, e: Binary, ev: EvalParams):
+        from greptimedb_tpu.promql import fast as F
+
+        hit = F.try_fast_binary(self, e, ev)
+        if hit is not None:
+            return hit
         lhs = self._eval(e.lhs, ev)
         rhs = self._eval(e.rhs, ev)
         op = e.op

@@ -37,7 +37,13 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
-from greptimedb_tpu.promql.parser import Agg, Call, VectorSelector
+from greptimedb_tpu.promql.parser import (
+    Agg,
+    Binary,
+    Call,
+    NumberLit,
+    VectorSelector,
+)
 from greptimedb_tpu.telemetry.metrics import global_registry
 
 # range functions computable from per-series prefix sums: O(S*T) memory,
@@ -47,7 +53,16 @@ _PREFIX_FNS = frozenset({
     "sum_over_time", "count_over_time", "avg_over_time",
     "last_over_time", "first_over_time", "present_over_time",
     "changes", "resets",
+    "min_over_time", "max_over_time", "stddev_over_time",
+    "stdvar_over_time", "mad_over_time", "deriv",
+    "quantile_over_time", "predict_linear", "holt_winters",
 })
+# leading scalar-literal argument count per arg-taking range function
+_FN_LEAD_ARGS = {
+    "quantile_over_time": 1, "predict_linear": 0, "holt_winters": 0,
+}
+# trailing scalar args (after the selector)
+_FN_TRAIL_ARGS = {"predict_linear": 1, "holt_winters": 2}
 _SIMPLE_AGGS = frozenset(
     {"sum", "avg", "min", "max", "count", "group", "stddev", "stdvar"}
 )
@@ -281,6 +296,24 @@ class _SpecShim:
     tps: float
 
 
+def _eval_side(vals, has, tsg, smask, lo, hi, t_end, *, fname,
+               range_ticks, range_seconds, l_cells, tps, fargs,
+               lookback_ticks):
+    """Instant-lookback / range-function evaluation of one masked grid —
+    the shared (jit-traced) front half of every fused query."""
+    from greptimedb_tpu.ops import promql as K
+    from greptimedb_tpu.ops import window as W
+
+    has = has & smask[:, None]
+    if fname == "__instant__":
+        return W.instant_lookback(vals, has, tsg, hi, t_end,
+                                  lookback_ticks)
+    win = _WinShim(lo, hi, t_end, range_ticks, range_seconds, l_cells)
+    return K.eval_range_function(
+        fname, vals, has, tsg, win, _SpecShim(tps), args=fargs
+    )
+
+
 def _plan_windows(entry: _Entry, ev, range_ms: int, offset_ms: int,
                   *, align_range: bool = True):
     """Window cell indices against the cached grid, or None if the query's
@@ -421,20 +454,15 @@ def _fused_query(
     """The whole query as one XLA program: matcher mask, range function or
     instant lookback, cross-series aggregation."""
     from greptimedb_tpu.ops import promql as K
-    from greptimedb_tpu.ops import window as W
 
     import jax.numpy as jnp
 
-    has = has & smask[:, None]
-    if fname == "__instant__":
-        out, pres = W.instant_lookback(
-            vals, has, tsg, hi, t_end, lookback_ticks
-        )
-    else:
-        win = _WinShim(lo, hi, t_end, range_ticks, range_seconds, l_cells)
-        out, pres = K.eval_range_function(
-            fname, vals, has, tsg, win, _SpecShim(tps), args=fargs
-        )
+    out, pres = _eval_side(
+        vals, has, tsg, smask, lo, hi, t_end, fname=fname,
+        range_ticks=range_ticks, range_seconds=range_seconds,
+        l_cells=l_cells, tps=tps, fargs=fargs,
+        lookback_ticks=lookback_ticks,
+    )
     vals_g, pres_g = K.aggregate_across_series(out, pres, gid, g + 1, op)
     # single packed (2G, J) buffer: one device->host transfer per query
     return jnp.concatenate([
@@ -468,19 +496,13 @@ def _fused_hist_query(
     import jax.numpy as jnp
 
     from greptimedb_tpu.ops import promql as K
-    from greptimedb_tpu.ops import window as W
 
-    has = has & smask[:, None]
-    if fname == "__instant__":
-        out, pres = W.instant_lookback(
-            vals, has, tsg, hi, t_end, lookback_ticks
-        )
-    else:
-        win = _WinShim(lo, hi, t_end, range_ticks, range_seconds,
-                       l_cells)
-        out, pres = K.eval_range_function(
-            fname, vals, has, tsg, win, _SpecShim(tps), args=fargs
-        )
+    out, pres = _eval_side(
+        vals, has, tsg, smask, lo, hi, t_end, fname=fname,
+        range_ticks=range_ticks, range_seconds=range_seconds,
+        l_cells=l_cells, tps=tps, fargs=fargs,
+        lookback_ticks=lookback_ticks,
+    )
     if agg_op:
         # inner `sum by (le, ...)`: (S_pad, J) -> (G_agg, J); slot then
         # maps the AGGREGATED series into histogram cells. An aggregated
@@ -611,7 +633,21 @@ def _resolve_fast_selector(engine, inner, ev):
     "empty" for a resolvable-but-empty selector, None to fall back."""
     fargs: tuple = ()
     if isinstance(inner, Call) and inner.name in _PREFIX_FNS:
-        sel = inner.args[-1]
+        # scalar-literal args ride as static fargs (phi, horizon, sf/tf)
+        # in their EXACT generic-path positions — a misplaced scalar must
+        # fall back so the generic engine rejects it consistently
+        lead = _FN_LEAD_ARGS.get(inner.name, 0)
+        trail = _FN_TRAIL_ARGS.get(inner.name, 0)
+        args = inner.args
+        if len(args) != lead + 1 + trail:
+            return None
+        if not all(isinstance(a, NumberLit)
+                   for a in args[:lead] + args[lead + 1:]):
+            return None
+        fargs = tuple(
+            float(a.value) for a in args[:lead] + args[lead + 1:]
+        )
+        sel = args[lead]
         if not isinstance(sel, VectorSelector) or sel.range_ms is None:
             return None
         fname = inner.name
@@ -636,17 +672,14 @@ def _resolve_fast_selector(engine, inner, ev):
     )
     entry = _CACHE.get_entry(table, fieldname, mesh=mesh)
     if entry is None:
-        _FAST_HITS.labels("fallback").inc()
         return None
     if entry.num_series == 0:
-        _FAST_HITS.labels("hit").inc()
         return "empty"
     win = _plan_windows(
         entry, ev, range_ms, sel.offset_ms,
         align_range=fname != "__instant__",
     )
     if win is None:
-        _FAST_HITS.labels("fallback").inc()
         return None
     return entry, table, raw_matchers, fname, fargs, win
 
@@ -708,8 +741,10 @@ def try_fast_histogram(engine, phi: float, inner, ev):
 
     resolved = _resolve_fast_selector(engine, inner, ev)
     if resolved is None:
+        _FAST_HITS.labels("fallback").inc()
         return None
     if resolved == "empty":
+        _FAST_HITS.labels("hit").inc()
         return _empty_vector(ev)
     entry, table, raw_matchers, fname, fargs, win = resolved
     import jax.numpy as jnp
@@ -773,8 +808,10 @@ def try_fast(engine, e, ev):
         return None
     resolved = _resolve_fast_selector(engine, e.expr, ev)
     if resolved is None:
+        _FAST_HITS.labels("fallback").inc()
         return None
     if resolved == "empty":
+        _FAST_HITS.labels("hit").inc()
         return _empty_vector(ev)
     entry, table, raw_matchers, fname, fargs, win = resolved
     lo, hi, t_end, range_ticks, range_seconds, l_cells = win
@@ -803,6 +840,368 @@ def try_fast(engine, e, ev):
             [labels[i] for i in idx], vals_np[idx], pres_np[idx]
         )
     return VectorValue(list(labels), vals_np, pres_np)
+
+
+# ----------------------------------------------------------------------
+# per-series output labels (sid-aligned): topk and vector-vector outputs
+# keep series identity, and building a million label dicts per QUERY
+# would be the Python cliff the fast path exists to avoid — build them
+# once per grid entry (same lifetime as the registry snapshot) instead
+# ----------------------------------------------------------------------
+
+def _series_labels(entry: _Entry, table) -> list[dict]:
+    """Per-sid tag dicts (no __name__), aligned with the entry's sid
+    space; built once per entry (cached on it, like group_cache)."""
+    hit = entry.group_cache.get("__series_labels__")
+    if hit is not None:
+        return hit
+    reg = entry.registry
+    visible = set(table.tag_names)
+    tag_names = [t for t in reg.tag_names
+                 if t in visible and not t.startswith("__")]
+    cols = {t: reg.tag_values(t) for t in tag_names}
+    labels = []
+    for s in range(entry.num_series):
+        labels.append({
+            t: str(cols[t][s]) for t in tag_names if cols[t][s] != ""
+        })
+    entry.group_cache["__series_labels__"] = labels
+    return labels
+
+
+def _series_labels_for(entry: _Entry, table, sids) -> list[dict]:
+    """Tag dicts for just the requested sids (topk winners: O(k), not
+    O(num_series)); memoized per entry alongside the bulk cache."""
+    bulk = entry.group_cache.get("__series_labels__")
+    if bulk is not None:
+        return [dict(bulk[int(s)]) for s in sids]
+    memo = entry.group_cache.setdefault("__series_labels_memo__", {})
+    reg = entry.registry
+    visible = set(table.tag_names)
+    out = []
+    for s in sids:
+        s = int(s)
+        lab = memo.get(s)
+        if lab is None:
+            lab = {
+                k: str(v) for k, v in reg.series_tags(s).items()
+                if v != "" and k in visible and not k.startswith("__")
+            }
+            memo[s] = lab
+        out.append(dict(lab))
+    return out
+
+
+# ----------------------------------------------------------------------
+# topk / bottomk over the grid cache
+# ----------------------------------------------------------------------
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fname", "k", "largest", "range_ticks",
+                     "range_seconds", "l_cells", "tps", "fargs",
+                     "lookback_ticks"),
+)
+def _fused_topk(
+    vals, has, tsg, smask, lo, hi, t_end, *,
+    fname: str, k: int, largest: bool, range_ticks: int,
+    range_seconds: float, l_cells: int, tps: float, fargs: tuple,
+    lookback_ticks: int,
+):
+    """range_fn/selector + per-step top-k as ONE XLA program; only the
+    (k, J) winners cross back to the host (the extension-plan analog of
+    the reference's TopK over SeriesDivide)."""
+    import jax.numpy as jnp
+
+    out, pres = _eval_side(
+        vals, has, tsg, smask, lo, hi, t_end, fname=fname,
+        range_ticks=range_ticks, range_seconds=range_seconds,
+        l_cells=l_cells, tps=tps, fargs=fargs,
+        lookback_ticks=lookback_ticks,
+    )
+    # sort key (always descending): present samples clamped to a finite
+    # range so genuine +-Inf values still rank above/below every absent
+    # slot (-inf fill); present NaN ranks below every real value but
+    # above absence (generic np.argsort puts NaN last), staying finite
+    # so the presence check keeps it when k exceeds the real winners
+    big = jnp.asarray(3.0e38, out.dtype)
+    nan_key = jnp.asarray(-3.2e38, out.dtype)
+    base = jnp.clip(out, -big, big)
+    k_dir = base if largest else -base
+    key = jnp.where(
+        pres, jnp.where(jnp.isnan(out), nan_key, k_dir), -jnp.inf
+    )
+    top_key, top_idx = jax.lax.top_k(key.T, k)       # (J, k)
+    # presence gathered from the real mask; finite-key check drops the
+    # absent fill slots when fewer than k series are present
+    top_pres = (
+        jnp.take_along_axis(pres.T, top_idx, axis=1)
+        & jnp.isfinite(top_key)
+    )
+    top_vals = jnp.take_along_axis(out.T, top_idx, axis=1)
+    return top_vals, top_idx.astype(jnp.int32), top_pres
+
+
+def try_fast_topk(engine, e, ev):
+    """Serve global `topk/bottomk(k, range_fn(sel))` from the grid
+    cache; grouped topk falls back to the generic engine."""
+    from greptimedb_tpu.promql.engine import VectorValue, _empty_vector
+
+    if not isinstance(e, Agg) or e.op not in ("topk", "bottomk"):
+        return None
+    if e.grouping or e.without:
+        return None
+    if not isinstance(e.param, NumberLit):
+        return None
+    k = int(e.param.value)
+    if k <= 0:
+        return _empty_vector(ev)
+    resolved = _resolve_fast_selector(engine, e.expr, ev)
+    if resolved is None:
+        _FAST_HITS.labels("fallback").inc()
+        return None
+    if resolved == "empty":
+        _FAST_HITS.labels("hit").inc()
+        return _empty_vector(ev)
+    entry, table, raw_matchers, fname, fargs, win = resolved
+    lo, hi, t_end, range_ticks, range_seconds, l_cells = win
+    matchers = engine._to_registry_matchers(raw_matchers, table)
+    smask, any_match = _matcher_mask_dev(entry, matchers)
+    if not any_match:
+        _FAST_HITS.labels("hit").inc()
+        return _empty_vector(ev)
+    lookback_ticks = max(int(ev.lookback_ms // entry.spec.unit), 1)
+    kk = min(k, entry.num_series)
+    top_vals, top_idx, top_pres = _fused_topk(
+        entry.vals, entry.has, entry.tsg, smask, lo, hi, t_end,
+        fname=fname, k=kk, largest=e.op == "topk",
+        range_ticks=range_ticks, range_seconds=range_seconds,
+        l_cells=l_cells, tps=entry.spec.tps, fargs=fargs,
+        lookback_ticks=lookback_ticks,
+    )
+    top_vals = np.asarray(top_vals, np.float64)   # (J, k)
+    top_idx = np.asarray(top_idx)
+    top_pres = np.asarray(top_pres)
+    j = top_vals.shape[0]
+    sids = np.unique(top_idx[top_pres])
+    if len(sids) == 0:
+        _FAST_HITS.labels("hit").inc()
+        return _empty_vector(ev)
+    pos = {int(s): i for i, s in enumerate(sids)}
+    vals_out = np.zeros((len(sids), j))
+    pres_out = np.zeros((len(sids), j), bool)
+    steps, ranks = np.nonzero(top_pres)
+    rows_ = np.asarray([pos[int(s)] for s in top_idx[steps, ranks]])
+    vals_out[rows_, steps] = top_vals[steps, ranks]
+    pres_out[rows_, steps] = True
+    labels = _series_labels_for(entry, table, sids)
+    if fname == "__instant__":
+        for lab in labels:
+            lab["__name__"] = table.name
+    _FAST_HITS.labels("hit").inc()
+    return VectorValue(labels, vals_out, pres_out)
+
+
+# ----------------------------------------------------------------------
+# vector <op> vector over the grid cache: label matching on sid codes
+# ----------------------------------------------------------------------
+
+_BINARY_FAST_OPS = frozenset({
+    "+", "-", "*", "/", "%", "^",
+    ">", "<", ">=", "<=", "==", "!=",
+})
+
+
+def _apply_op_dev(op: str, a, b):
+    import jax.numpy as jnp
+
+    if op == "+":
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    if op == "/":
+        return a / b
+    if op == "%":
+        return jnp.fmod(a, b)
+    if op == "^":
+        return jnp.power(a, b)
+    return {
+        ">": a > b, "<": a < b, ">=": a >= b, "<=": a <= b,
+        "==": a == b, "!=": a != b,
+    }[op]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "fname_l", "fname_r", "op", "bool_mod", "agg_op", "g",
+        "range_ticks_l", "range_ticks_r", "range_seconds_l",
+        "range_seconds_r", "l_cells_l", "l_cells_r", "tps",
+        "fargs_l", "fargs_r", "lookback_ticks",
+    ),
+)
+def _fused_binary(
+    vals_l, has_l, tsg_l, smask_l, lo_l, hi_l, t_end_l,
+    vals_r, has_r, tsg_r, smask_r, lo_r, hi_r, t_end_r,
+    gid, *,
+    fname_l: str, fname_r: str, op: str, bool_mod: bool, agg_op: str,
+    g: int, range_ticks_l: int, range_ticks_r: int,
+    range_seconds_l: float, range_seconds_r: float,
+    l_cells_l: int, l_cells_r: int, tps: float,
+    fargs_l: tuple, fargs_r: tuple, lookback_ticks: int,
+):
+    """vector<op>vector (one-to-one, default matching) fused on device:
+    both sides share the table's sid space, so label matching IS sid
+    alignment — no per-series host work (the reference vectorizes this
+    as a DataFusion join on label columns; here the dictionary codes are
+    already the join keys). Optional trailing aggregation."""
+    import jax.numpy as jnp
+
+    from greptimedb_tpu.ops import promql as K
+
+    out_l, pres_l = _eval_side(
+        vals_l, has_l, tsg_l, smask_l, lo_l, hi_l, t_end_l,
+        fname=fname_l, range_ticks=range_ticks_l,
+        range_seconds=range_seconds_l, l_cells=l_cells_l, tps=tps,
+        fargs=fargs_l, lookback_ticks=lookback_ticks,
+    )
+    out_r, pres_r = _eval_side(
+        vals_r, has_r, tsg_r, smask_r, lo_r, hi_r, t_end_r,
+        fname=fname_r, range_ticks=range_ticks_r,
+        range_seconds=range_seconds_r, l_cells=l_cells_r, tps=tps,
+        fargs=fargs_r, lookback_ticks=lookback_ticks,
+    )
+    pres = pres_l & pres_r
+    res = _apply_op_dev(op, out_l, out_r)
+    if op in (">", "<", ">=", "<=", "==", "!="):
+        if bool_mod:
+            out = res.astype(out_l.dtype)
+        else:
+            # filtering comparison keeps the LEFT operand's sample
+            pres = pres & res
+            out = out_l
+    else:
+        out = res.astype(out_l.dtype)
+    if agg_op:
+        vals_g, pres_g = K.aggregate_across_series(out, pres, gid,
+                                                   g + 1, agg_op)
+        return jnp.concatenate([
+            vals_g[:g], pres_g[:g].astype(vals_g.dtype),
+        ])
+    return jnp.concatenate([out, pres.astype(out.dtype)])
+
+
+def _operand_shape_fast(expr) -> bool:
+    """Static AST pre-check, BEFORE any entry resolution: a grid build
+    can scan the whole table, so reject non-fast shapes for free."""
+    if isinstance(expr, VectorSelector):
+        return expr.range_ms is None
+    return isinstance(expr, Call) and expr.name in _PREFIX_FNS
+
+
+def _resolve_binary(engine, e, ev):
+    """Both operands fast-resolve over the SAME series registry ->
+    (entry_l, side_l, entry_r, side_r, table) or "empty" or None."""
+    if not isinstance(e, Binary) or e.op not in _BINARY_FAST_OPS:
+        return None
+    m = e.matching
+    if m.explicit or m.labels or m.group or m.include:
+        return None  # only default one-to-one matching rides sid codes
+    if not (_operand_shape_fast(e.lhs) and _operand_shape_fast(e.rhs)):
+        return None
+    left = _resolve_fast_selector(engine, e.lhs, ev)
+    if left is None:
+        return None
+    right = _resolve_fast_selector(engine, e.rhs, ev)
+    if right is None:
+        return None
+    if left == "empty" or right == "empty":
+        return "empty"
+    entry_l, table_l, matchers_l, fname_l, fargs_l, win_l = left
+    entry_r, table_r, matchers_r, fname_r, fargs_r, win_r = right
+    if entry_l.registry is not entry_r.registry:
+        return None  # different sid spaces: generic label matching
+    return (left, right, table_l)
+
+
+def try_fast_binary(engine, e, ev, *, agg=None):
+    """Serve `vecL <op> vecR` (and `agg(...)` around it) when both sides
+    live on the same table's grid cache. Returns VectorValue or None."""
+    from greptimedb_tpu.promql.engine import VectorValue, _empty_vector
+
+    if agg is not None and agg.op not in _SIMPLE_AGGS:
+        return None
+    resolved = _resolve_binary(engine, e, ev)
+    if resolved is None:
+        return None
+    if resolved == "empty":
+        return _empty_vector(ev)
+    left, right, table = resolved
+    entry_l, _tl, raw_m_l, fname_l, fargs_l, win_l = left
+    entry_r, _tr, raw_m_r, fname_r, fargs_r, win_r = right
+    agg_op = ""
+    gid = None
+    g = 1
+    labels = None
+    if agg is not None:
+        labels, gid, g = _grouping_dev(entry_l, table, agg.grouping,
+                                       agg.without)
+        agg_op = agg.op
+    import jax.numpy as jnp
+
+    smask_l, any_l = _matcher_mask_dev(
+        entry_l, engine._to_registry_matchers(raw_m_l, table))
+    smask_r, any_r = _matcher_mask_dev(
+        entry_r, engine._to_registry_matchers(raw_m_r, table))
+    if not (any_l and any_r):
+        _FAST_HITS.labels("hit").inc()
+        return _empty_vector(ev)
+    lo_l, hi_l, t_end_l, rt_l, rs_l, lc_l = win_l
+    lo_r, hi_r, t_end_r, rt_r, rs_r, lc_r = win_r
+    if gid is None:
+        gid = jnp.zeros(entry_l.s_pad, jnp.int32)
+    lookback_ticks = max(int(ev.lookback_ms // entry_l.spec.unit), 1)
+    packed = _fused_binary(
+        entry_l.vals, entry_l.has, entry_l.tsg, smask_l,
+        lo_l, hi_l, t_end_l,
+        entry_r.vals, entry_r.has, entry_r.tsg, smask_r,
+        lo_r, hi_r, t_end_r,
+        gid,
+        fname_l=fname_l, fname_r=fname_r, op=e.op,
+        bool_mod=bool(e.bool_mod), agg_op=agg_op, g=g,
+        range_ticks_l=rt_l, range_ticks_r=rt_r,
+        range_seconds_l=rs_l, range_seconds_r=rs_r,
+        l_cells_l=lc_l, l_cells_r=lc_r, tps=entry_l.spec.tps,
+        fargs_l=fargs_l, fargs_r=fargs_r,
+        lookback_ticks=lookback_ticks,
+    )
+    packed_np = np.asarray(packed, np.float64)
+    if agg_op:
+        vals_np = packed_np[:g]
+        pres_np = packed_np[g:] != 0.0
+        keep = pres_np.any(axis=1)
+        _FAST_HITS.labels("hit").inc()
+        if not keep.all():
+            idx = np.nonzero(keep)[0]
+            return VectorValue(
+                [labels[i] for i in idx], vals_np[idx], pres_np[idx]
+            )
+        return VectorValue(list(labels), vals_np, pres_np)
+    s = entry_l.num_series
+    s_pad = entry_l.s_pad
+    vals_np = packed_np[:s_pad][:s]
+    pres_np = packed_np[s_pad:][:s] != 0.0
+    keep = pres_np.any(axis=1)
+    base = _series_labels(entry_l, table)
+    _FAST_HITS.labels("hit").inc()
+    if not keep.all():
+        idx = np.nonzero(keep)[0]
+        return VectorValue(
+            [base[int(i)] for i in idx], vals_np[idx], pres_np[idx]
+        )
+    return VectorValue(list(base), vals_np, pres_np)
 
 
 def invalidate_cache():

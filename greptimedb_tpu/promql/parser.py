@@ -135,7 +135,8 @@ _TOKEN_RE = re.compile(r"""
   | (?P<number>
         0x[0-9a-fA-F]+
       | (?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?
-      | [iI][nN][fF] | [nN][aA][nN])
+      | [iI][nN][fF](?![a-zA-Z0-9_:.])
+      | [nN][aA][nN](?![a-zA-Z0-9_:.]))
   | (?P<string>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
   | (?P<op>=~|!~|==|!=|<=|>=|<|>|=|\+|-|\*|/|%|\^|\(|\)|\{|\}|\[|\]|,|:|@)
   | (?P<ident>[a-zA-Z_:][a-zA-Z0-9_:.]*)

@@ -354,3 +354,161 @@ def test_fast_histogram_sum_by_matches_generic(tmp_path):
     finally:
         F.invalidate_cache()
         inst.close()
+
+
+# ----------------------------------------------------------------------
+# round-5 fast paths: arg-taking range fns, topk/bottomk, vector<op>vector
+# ----------------------------------------------------------------------
+
+def run_both_all(inst, promql, start, end, step):
+    """Query once with every fast path live, once with all of them
+    disabled (resolution stubbed out) — results must agree."""
+    eng = PromEngine(inst)
+    fast_val, ev = eng.query_range(promql, start, end, step)
+    real_resolve = F._resolve_fast_selector
+    real_binary = F.try_fast_binary
+    F._resolve_fast_selector = lambda *a, **k: None
+    F.try_fast_binary = lambda *a, **k: None
+    try:
+        slow_val, _ = PromEngine(inst).query_range(promql, start, end,
+                                                   step)
+    finally:
+        F._resolve_fast_selector = real_resolve
+        F.try_fast_binary = real_binary
+    return fast_val, slow_val, ev
+
+
+def assert_equivalent(fast_val, slow_val, promql, *, rtol=1e-5):
+    fm, sm = as_map(fast_val), as_map(slow_val)
+    sm = {k: v for k, v in sm.items() if v[1].any()}
+    fm = {k: v for k, v in fm.items() if v[1].any()}
+    assert set(fm) == set(sm), (promql, set(fm) ^ set(sm))
+    for key in fm:
+        fv, fp = fm[key]
+        sv, sp = sm[key]
+        np.testing.assert_array_equal(fp, sp, err_msg=promql)
+        np.testing.assert_allclose(
+            np.where(fp, fv, 0), np.where(sp, sv, 0),
+            rtol=rtol, atol=1e-5, err_msg=promql,
+        )
+
+
+ARG_FN_QUERIES = [
+    "sum by (host) (quantile_over_time(0.9, req_total[2m]))",
+    "max by (dc) (min_over_time(req_total[1m]))",
+    "sum by (dc) (max_over_time(req_total[2m]))",
+    "avg by (dc) (stddev_over_time(req_total[2m]))",
+    "sum by (host) (deriv(req_total[2m]))",
+    "sum by (host) (predict_linear(req_total[2m], 600))",
+    "sum by (dc) (holt_winters(req_total[2m], 0.5, 0.5))",
+    "sum by (dc) (mad_over_time(req_total[2m]))",
+]
+
+
+@pytest.mark.parametrize("promql", ARG_FN_QUERIES)
+def test_arg_range_fns_fast_matches_generic(inst, promql):
+    setup_metrics(inst)
+    fast_val, slow_val, _ = run_both_all(
+        inst, promql, T0 + 120_000, T0 + 480_000, 30_000
+    )
+    assert isinstance(fast_val, VectorValue)
+    assert_equivalent(fast_val, slow_val, promql)
+
+
+TOPK_QUERIES = [
+    "topk(3, rate(req_total[1m]))",
+    "bottomk(2, rate(req_total[1m]))",
+    "topk(3, req_total)",
+    "topk(100, rate(req_total[1m]))",  # k > num_series
+    'topk(2, rate(req_total{dc="dc0"}[1m]))',
+]
+
+
+@pytest.mark.parametrize("promql", TOPK_QUERIES)
+def test_topk_fast_matches_generic(inst, promql):
+    setup_metrics(inst)
+    fast_val, slow_val, _ = run_both_all(
+        inst, promql, T0 + 120_000, T0 + 480_000, 30_000
+    )
+    assert isinstance(fast_val, VectorValue)
+    assert_equivalent(fast_val, slow_val, promql)
+
+
+def test_topk_uses_fused_kernel(inst):
+    setup_metrics(inst)
+    called = []
+    real = F._fused_topk
+    F._fused_topk = lambda *a, **k: called.append(1) or real(*a, **k)
+    try:
+        PromEngine(inst).query_range(
+            "topk(2, rate(req_total[1m]))",
+            T0 + 120_000, T0 + 240_000, 30_000,
+        )
+    finally:
+        F._fused_topk = real
+    assert called, "topk did not take the fused fast path"
+
+
+BINARY_QUERIES = [
+    "rate(req_total[1m]) / last_over_time(req_total[1m])",
+    "rate(req_total[1m]) + rate(req_total[2m])",
+    "req_total - last_over_time(req_total[1m])",
+    "rate(req_total[1m]) > 0.5",                  # vector-scalar: generic
+    "rate(req_total[1m]) > rate(req_total[2m])",  # filter comparison
+    "rate(req_total[1m]) >= bool rate(req_total[2m])",
+    'rate(req_total{dc="dc0"}[1m]) * rate(req_total[1m])',
+    "sum by (dc) (rate(req_total[1m]) / last_over_time(req_total[1m]))",
+    "avg by (host) (req_total + req_total)",
+    "sum(rate(req_total[1m]) / last_over_time(req_total[1m]))",
+]
+
+
+@pytest.mark.parametrize("promql", BINARY_QUERIES)
+def test_binary_fast_matches_generic(inst, promql):
+    setup_metrics(inst)
+    fast_val, slow_val, _ = run_both_all(
+        inst, promql, T0 + 120_000, T0 + 480_000, 30_000
+    )
+    assert isinstance(fast_val, VectorValue)
+    assert_equivalent(fast_val, slow_val, promql)
+
+
+def test_binary_on_ignoring_falls_back(inst):
+    """Explicit matching modifiers use the generic label matcher."""
+    setup_metrics(inst)
+    called = []
+    real = F._fused_binary
+    F._fused_binary = lambda *a, **k: called.append(1) or real(*a, **k)
+    try:
+        v, _ = PromEngine(inst).query_range(
+            "rate(req_total[1m]) / on(host, dc) "
+            "last_over_time(req_total[1m])",
+            T0 + 120_000, T0 + 240_000, 30_000,
+        )
+    finally:
+        F._fused_binary = real
+    assert not called
+    assert v.num_series > 0
+
+
+def test_topk_keeps_infinite_samples(inst):
+    """A present +Inf sample must win topk (and -Inf bottomk) rather
+    than being confused with the absent-slot fill (code-review r5)."""
+    inst.sql(
+        "CREATE TABLE infm (host STRING PRIMARY KEY, "
+        "greptime_value DOUBLE, ts TIMESTAMP TIME INDEX)"
+    )
+    table = inst.catalog.table("public", "infm")
+    ts = T0 + np.arange(4) * 15_000
+    for h, v in [("a", np.inf), ("b", 5.0), ("c", -np.inf)]:
+        table.write({"host": np.full(4, h, object)}, ts,
+                    {"greptime_value": np.full(4, v)})
+    eng = PromEngine(inst)
+    v, _ = eng.query_range("topk(1, infm)", T0 + 15_000, T0 + 45_000,
+                           15_000)
+    assert [l["host"] for l in v.labels] == ["a"]
+    assert np.isposinf(v.values[v.present]).all()
+    v, _ = eng.query_range("bottomk(1, infm)", T0 + 15_000,
+                           T0 + 45_000, 15_000)
+    assert [l["host"] for l in v.labels] == ["c"]
+    assert np.isneginf(v.values[v.present]).all()
