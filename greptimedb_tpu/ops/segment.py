@@ -235,3 +235,49 @@ def segmented_cumextreme(values: jax.Array, reset: jax.Array,
 
     v, _ = jax.lax.associative_scan(comb, (values, reset))
     return v
+
+
+@jax.jit
+def segmented_cumsum_compensated(v_hi: jax.Array, v_lo: jax.Array,
+                                 reset: jax.Array):
+    """Neumaier-compensated per-segment running sum over two-float f32
+    input (v_hi + v_lo ~= the f64 value): the no-x64 device path for SQL
+    window running sums. Each element enters with its split low part as
+    the initial compensation; the combine two-sums the high parts and
+    accumulates the rounding residue, so sum+comp recovers the f64
+    running sum to ~1 ulp (the pattern proven by flow/device_state.py's
+    Neumaier state slots). Returns (sum, comp) f32 arrays."""
+    def comb(a, b):
+        a_s, a_c, a_f = a
+        b_s, b_c, b_f = b
+        t = a_s + b_s
+        e = jnp.where(jnp.abs(a_s) >= jnp.abs(b_s),
+                      (a_s - t) + b_s, (b_s - t) + a_s)
+        return (jnp.where(b_f, b_s, t),
+                jnp.where(b_f, b_c, a_c + b_c + e),
+                a_f | b_f)
+
+    s, c, _ = jax.lax.associative_scan(comb, (v_hi, v_lo, reset))
+    return s, c
+
+
+@functools.partial(jax.jit, static_argnames=("take_max",))
+def segmented_cumextreme2(v_hi: jax.Array, v_lo: jax.Array,
+                          reset: jax.Array, *, take_max: bool):
+    """Per-segment running extreme over two-float (hi, lo) pairs:
+    lexicographic compare keeps f64 ordering without x64 (values whose
+    f32 roundings tie are ordered by their low parts). Returns the
+    winning (hi, lo) pair arrays."""
+    def comb(a, b):
+        ah, al, af = a
+        bh, bl, bf = b
+        if take_max:
+            pick_a = (ah > bh) | ((ah == bh) & (al >= bl))
+        else:
+            pick_a = (ah < bh) | ((ah == bh) & (al <= bl))
+        mh = jnp.where(pick_a, ah, bh)
+        ml = jnp.where(pick_a, al, bl)
+        return (jnp.where(bf, bh, mh), jnp.where(bf, bl, ml), af | bf)
+
+    h, l, _ = jax.lax.associative_scan(comb, (v_hi, v_lo, reset))
+    return h, l

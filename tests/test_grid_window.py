@@ -256,6 +256,56 @@ def test_window_device_path_matches_host(tmp_path, monkeypatch, rng):
         inst.close()
 
 
+def test_window_device_path_without_x64(tmp_path, monkeypatch, rng):
+    """Real-TPU configuration (no x64): running aggregates still run on
+    device via Neumaier-compensated / two-float f32 segmented scans and
+    match host f64 within tolerance (VERDICT r4 #5)."""
+    import jax
+
+    from greptimedb_tpu.instance import Standalone
+    from greptimedb_tpu.query import stats as qstats
+    from greptimedb_tpu.query import window_fns as W
+
+    inst = Standalone(str(tmp_path / "d"), prefer_device=False,
+                      warm_start=False)
+    try:
+        inst.execute_sql(
+            "create table w (ts timestamp time index, g string "
+            "primary key, v double)"
+        )
+        tab = inst.catalog.table("public", "w")
+        n = 8000
+        ts = np.tile(np.arange(n // 4) * 1000, 4).astype(np.int64)
+        gs = np.repeat([f"g{i}" for i in range(4)], n // 4).astype(object)
+        # large magnitudes + tiny increments: a raw f32 cumsum would
+        # lose the small terms; the compensated scan must not
+        vals = rng.random(n) * 1e6 + rng.random(n) * 1e-3
+        tab.write({"g": gs}, ts, {"v": vals})
+        q = ("select g, ts, sum(v) over (partition by g order by ts) "
+             "as s, max(v) over (partition by g order by ts) as m, "
+             "count(v) over (partition by g order by ts) as c "
+             "from w order by g, ts")
+        host = inst.sql(q).rows()
+        monkeypatch.setattr(W, "DEVICE_THRESHOLD", 100)
+        saved_x64 = bool(jax.config.read("jax_enable_x64"))
+        jax.config.update("jax_enable_x64", False)
+        try:
+            with qstats.collect() as st:
+                dev = inst.sql(q).rows()
+        finally:
+            jax.config.update("jax_enable_x64", saved_x64)
+        assert st.notes.get("exec_path_window") == "device"
+        assert len(host) == len(dev)
+        for h, d in zip(host, dev):
+            assert h[0] == d[0] and h[1] == d[1]
+            np.testing.assert_allclose(h[2], d[2], rtol=1e-9)
+            # two-float pairs carry 48 mantissa bits vs f64's 53
+            np.testing.assert_allclose(h[3], d[3], rtol=1e-12)
+            assert h[4] == d[4]
+    finally:
+        inst.close()
+
+
 def test_interval_column_type(tmp_path):
     """INTERVAL as a first-class column type (VERDICT r3 missing #5):
     DDL, ingest, arithmetic with timestamps, flush + restart."""
