@@ -264,7 +264,8 @@ def _make_instance(opts):
 
     store = None
     storage = opts.section("storage")
-    if str(storage.get("type", "fs")).lower() != "fs":
+    if (str(storage.get("type", "fs")).lower() != "fs"
+            or storage.get("root")):
         store = object_store_from_options(storage, opts.get("data_home"))
     inst = Standalone(
         engine_config=EngineConfig(
@@ -340,29 +341,29 @@ def _start_datanode(opts):
 
 def _heartbeat_loop(meta_addr: str, node_id: int, inst,
                     flight_addr: str | None = None):
-    """Register + heartbeat against the metasrv HTTP service."""
-    import json
+    """Register + heartbeat against the metasrv HTTP service. The
+    MetaClient follows leader redirects across a comma-separated
+    --metasrv-addr list, so a metasrv leader kill re-registers this node
+    with the new leader on the next beat."""
     import threading
-    import urllib.request
+
+    from greptimedb_tpu.dist.client import MetaClient
 
     stop = threading.Event()
-
-    def post(path, doc):
-        req = urllib.request.Request(
-            f"http://{meta_addr}{path}",
-            data=json.dumps(doc).encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=5) as resp:
-            return json.loads(resp.read() or b"{}")
+    client = MetaClient(meta_addr)
 
     def loop():
         registered = False
+        last_leader = client.addr
         while True:   # register immediately, THEN pace by the interval
             try:
+                if client.addr != last_leader:
+                    # leader moved: its memory has no liveness record of
+                    # us — re-register before the next heartbeat
+                    registered = False
+                    last_leader = client.addr
                 if not registered:
-                    post("/register", {"node_id": node_id,
-                                       "addr": flight_addr})
+                    client.register(node_id, flight_addr)
                     registered = True
                 stats = {}
                 try:
@@ -374,10 +375,7 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst,
                             }
                 except Exception:
                     pass
-                resp = post("/heartbeat", {
-                    "node_id": node_id, "region_stats": stats,
-                })
-                for ins in resp.get("instructions") or []:
+                for ins in client.heartbeat(node_id, stats):
                     if ins.get("type") == "grant_lease":
                         rs = getattr(inst, "region_server", None)
                         if rs is not None:

@@ -223,29 +223,102 @@ class DatanodeClient:
             self._raise(e)
 
 
+class _NotLeaderError(GreptimeError):
+    def __init__(self, leader: str | None):
+        super().__init__("metasrv: not leader")
+        self.leader = leader
+
+
 class MetaClient:
-    """Metasrv control plane over HTTP (kv, routes, allocation)."""
+    """Metasrv control plane over HTTP (kv, routes, allocation).
+
+    Accepts a comma-separated address list for metasrv HA (the
+    reference's meta-client multi-endpoint + leader discovery,
+    /root/reference/src/meta-client/src/client.rs): connection failures
+    rotate to the next endpoint, and a follower's not-leader response
+    redirects to the leader it names — so killing the metasrv leader is
+    survivable by every registered role."""
 
     def __init__(self, addr: str, *, timeout: float = 5.0):
-        self.addr = addr
+        self.addrs = [a.strip() for a in str(addr).split(",") if a.strip()]
+        if not self.addrs:
+            raise GreptimeError("metasrv address list is empty")
+        self._cur = 0
         self.timeout = timeout
 
-    def _post(self, path: str, doc: dict) -> dict:
-        req = urllib.request.Request(
-            f"http://{self.addr}{path}", data=json.dumps(doc).encode(),
-            headers={"Content-Type": "application/json"},
+    @property
+    def addr(self) -> str:
+        return self.addrs[self._cur]
+
+    def _rotate(self, leader: str | None = None):
+        if leader and leader in self.addrs:
+            self._cur = self.addrs.index(leader)
+        else:
+            self._cur = (self._cur + 1) % len(self.addrs)
+
+    def _do(self, fn):
+        import time as _time
+
+        # multi-addr: retry against a wall-clock window that outlives a
+        # leader-election transition (~lease_s); single-addr keeps the
+        # old fast-fail so unreachable standalones error promptly
+        window_s = 12.0 if len(self.addrs) > 1 else 1.0
+        deadline = _time.monotonic() + window_s
+        last: Exception | None = None
+        while True:
+            try:
+                return fn(self.addr)
+            except _NotLeaderError as e:
+                last = e
+                self._rotate(e.leader)
+                pause = 0.25
+            except urllib.error.HTTPError as e:
+                # reached a server: app-level failure, don't rotate;
+                # surface the server's error body, not just the code
+                try:
+                    detail = json.loads(e.read() or b"{}").get("error")
+                except Exception:  # noqa: BLE001 - body not JSON
+                    detail = None
+                raise GreptimeError(
+                    f"metasrv: {detail or f'HTTP {e.code}'}"
+                ) from None
+            except (urllib.error.URLError, OSError,
+                    ConnectionError) as e:
+                last = e
+                self._rotate()
+                pause = 0.05
+            if _time.monotonic() >= deadline:
+                break
+            _time.sleep(pause)
+        raise GreptimeError(
+            f"no reachable metasrv leader among {self.addrs}: {last}"
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            out = json.loads(resp.read() or b"{}")
-        if isinstance(out, dict) and out.get("error"):
-            raise GreptimeError(f"metasrv: {out['error']}")
-        return out
+
+    def _post(self, path: str, doc: dict) -> dict:
+        def go(addr):
+            req = urllib.request.Request(
+                f"http://{addr}{path}", data=json.dumps(doc).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                out = json.loads(resp.read() or b"{}")
+            if isinstance(out, dict) and out.get("error"):
+                if out["error"] == "not leader":
+                    raise _NotLeaderError(out.get("leader"))
+                raise GreptimeError(f"metasrv: {out['error']}")
+            return out
+
+        return self._do(go)
 
     def _get(self, path: str) -> dict:
-        with urllib.request.urlopen(
-            f"http://{self.addr}{path}", timeout=self.timeout
-        ) as resp:
-            return json.loads(resp.read() or b"{}")
+        def go(addr):
+            with urllib.request.urlopen(
+                f"http://{addr}{path}", timeout=self.timeout
+            ) as resp:
+                return json.loads(resp.read() or b"{}")
+
+        return self._do(go)
 
     # ---- kv -----------------------------------------------------------
     def kv_get(self, key: str) -> str | None:
@@ -289,3 +362,11 @@ class MetaClient:
 
     def register(self, node_id: int, addr: str | None = None):
         self._post("/register", {"node_id": node_id, "addr": addr})
+
+    def heartbeat(self, node_id: int, region_stats: dict | None = None
+                  ) -> list[dict]:
+        """One heartbeat; returns the leader's mailbox instructions."""
+        resp = self._post("/heartbeat", {
+            "node_id": node_id, "region_stats": region_stats or {},
+        })
+        return resp.get("instructions") or []

@@ -80,6 +80,16 @@ def _make_handler(metasrv: Metasrv, kv: KvBackend):
                 doc = self._body()
             except ValueError as e:
                 return self._json(400, {"error": f"bad json: {e}"})
+            owner = self.server.owner  # type: ignore[attr-defined]
+            if path in ("/register", "/heartbeat", "/allocate",
+                        "/remove_routes") and not owner.election.is_leader:
+                # heartbeat liveness, failure detectors, and placement
+                # live in the LEADER's memory; followers redirect (the
+                # etcd-campaign contract, election/etcd.rs:161-206)
+                leader, _exp = owner.election.leader()
+                return self._json(200, {
+                    "error": "not leader", "leader": leader,
+                })
             try:
                 if path == "/register":
                     metasrv.register_node(int(doc["node_id"]),
@@ -197,6 +207,20 @@ class MetasrvServer:
                         # flips only AFTER recover() succeeds so a
                         # transient kv failure is retried next tick.
                         self.metasrv.procedures.recover(self.metasrv)
+                        # seed liveness from the persisted peer book: a
+                        # datanode that died ALONGSIDE the old leader
+                        # must still be detected (its seeded detector
+                        # gets the acceptable-pause window to re-
+                        # register, then fails over)
+                        import time as _time
+
+                        now_ms = _time.time() * 1000
+                        for nid in self.metasrv.peers():
+                            if nid not in self.metasrv.nodes:
+                                self.metasrv.register_node(nid)
+                                self.metasrv.detectors[nid].heartbeat(
+                                    now_ms
+                                )
                         self._recovered = True
                     self.metasrv.tick()
                 else:
@@ -218,6 +242,9 @@ class MetasrvServer:
             name="metasrv-http",
         )
         self._thread.start()
+        # claim leadership synchronously when uncontested: a single
+        # metasrv must serve registrations the moment start() returns
+        self.election.step()
         self.election.start()
         self._ticker.start()
         return self

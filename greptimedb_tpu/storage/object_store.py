@@ -69,7 +69,11 @@ class FsObjectStore(ObjectStore):
     def write(self, path: str, data: bytes) -> None:
         p = self._abs(path)
         os.makedirs(os.path.dirname(p), exist_ok=True)
-        tmp = p + ".tmp"
+        # unique temp name: concurrent writers on a SHARED store (wire
+        # cluster datanodes) must not race each other's rename source.
+        # The .tmp suffix stays LAST so list()'s filter keeps hiding
+        # in-flight and crash-orphaned temps
+        tmp = f"{p}.{os.getpid()}.{threading.get_native_id()}.tmp"
         with open(tmp, "wb") as f:
             f.write(data)
             f.flush()
@@ -458,7 +462,12 @@ def object_store_from_options(storage: dict, data_root: str) -> ObjectStore:
     local read/write cache."""
     kind = str(storage.get("type", "fs")).lower()
     if kind == "fs":
-        inner: ObjectStore = FsObjectStore(data_root)
+        # storage.root overrides the node-local data_home: datanodes of
+        # a wire cluster share one fs store so failed-over regions can
+        # reopen their SSTs/manifest from the new owner
+        inner: ObjectStore = FsObjectStore(
+            storage.get("root") or data_root
+        )
     elif kind == "memory":
         inner = MemoryObjectStore()
     elif kind == "s3":

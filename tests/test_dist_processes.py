@@ -230,3 +230,179 @@ def test_multiprocess_flow_mirroring(topology):
             pass
         time.sleep(0.5)
     assert rows == [["a", 2, 20.0], ["b", 1, 50.0]]
+
+
+def test_metasrv_ha_leader_kill_and_failover(tmp_path):
+    """3 metasrv PROCESSES share one kv (FsKv flock CAS = the etcd
+    campaign analog, ref meta-srv/src/election/etcd.rs:161-206): exactly
+    one leads; SIGKILLing the leader mid-workload elects a successor,
+    datanodes re-register with it through the multi-address MetaClient,
+    the frontend keeps serving, and a datanode kill AFTER the leader
+    change still fails its regions over to the survivor."""
+    procs = []
+    logs = []
+
+    def spawn(args, name):
+        log = open(tmp_path / f"{name}.log", "w")
+        logs.append(log)
+        p = _spawn(args, log)
+        procs.append(p)
+        return p
+
+    try:
+        meta_home = str(tmp_path / "meta")
+        meta_ports = [_free_port() for _ in range(3)]
+        meta_addrs = [f"127.0.0.1:{p}" for p in meta_ports]
+        metas = {}
+        for i, port in enumerate(meta_ports):
+            metas[meta_addrs[i]] = spawn(
+                ["metasrv", "start", "--data-home", meta_home,
+                 "--metasrv-addr", meta_addrs[i], "--http-addr", ""],
+                f"meta{i}",
+            )
+        for a in meta_addrs:
+            _wait_http(a)
+        addr_list = ",".join(meta_addrs)
+
+        def leaders():
+            out = []
+            for a in meta_addrs:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://{a}/health", timeout=2
+                    ) as resp:
+                        if json.loads(resp.read()).get("is_leader"):
+                            out.append(a)
+                except Exception:
+                    pass
+            return out
+
+        deadline = time.time() + 30
+        while time.time() < deadline and len(leaders()) != 1:
+            time.sleep(0.3)
+        led = leaders()
+        assert len(led) == 1, f"want exactly one leader, got {led}"
+        first_leader = led[0]
+
+        # datanodes share an object store root so failover can reopen
+        # flushed regions from the survivor
+        shared_root = str(tmp_path / "shared_store")
+        cfg = tmp_path / "dn.toml"
+        cfg.write_text(
+            f'[storage]\ntype = "fs"\nroot = "{shared_root}"\n'
+        )
+        dn_ports = []
+        dn_procs = {}
+        for i in range(2):
+            port = _free_port()
+            dn_ports.append(port)
+            dn_procs[i] = spawn(
+                ["datanode", "start", "-c", str(cfg),
+                 "--data-home", str(tmp_path / f"dn{i}"),
+                 "--flight-addr", f"127.0.0.1:{port}",
+                 "--metasrv-addr", addr_list,
+                 "--node-id", str(i), "--http-addr", "",
+                 "--mysql-addr", "", "--postgres-addr", "",
+                 "--no-flows"], f"dn{i}")
+        for port in dn_ports:
+            _wait_port(port)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://{first_leader}/peers", timeout=2
+            ) as resp:
+                if len(json.loads(resp.read())) >= 2:
+                    break
+            time.sleep(0.3)
+
+        fe_port = _free_port()
+        spawn(["frontend", "start",
+               "--data-home", str(tmp_path / "fe"),
+               "--http-addr", f"127.0.0.1:{fe_port}",
+               "--metasrv-addr", addr_list,
+               "--mysql-addr", "", "--postgres-addr", "",
+               "--flight-addr", ""], "frontend")
+        fe = f"127.0.0.1:{fe_port}"
+        _wait_http(fe, path="/health")
+
+        _sql(fe, "create table t (ts timestamp time index, host string "
+                 "primary key, v double) with (num_regions = 2)")
+        _sql(fe, "insert into t (host, ts, v) values "
+                 "('a', 1000, 1.0), ('b', 2000, 2.0), ('c', 3000, 3.0)")
+        _sql(fe, "ADMIN flush_table('t')")
+        assert _rows(_sql(fe, "select count(*) from t")) == [[3]]
+
+        # ---- kill the metasrv leader mid-workload -------------------
+        metas[first_leader].send_signal(signal.SIGKILL)
+        metas[first_leader].wait(timeout=10)
+        survivors = [a for a in meta_addrs if a != first_leader]
+        deadline = time.time() + 45
+        new_leader = None
+        while time.time() < deadline:
+            led = [a for a in leaders() if a in survivors]
+            if len(led) == 1:
+                new_leader = led[0]
+                break
+            time.sleep(0.3)
+        assert new_leader, "no successor elected after leader kill"
+
+        # frontend keeps serving through the surviving metasrvs
+        _sql(fe, "insert into t (host, ts, v) values ('d', 4000, 4.0)")
+        assert _rows(_sql(fe, "select count(*) from t")) == [[4]]
+        _sql(fe, "ADMIN flush_table('t')")
+
+        # datanodes re-register with the new leader (its own memory,
+        # not just the persisted peer book -> wait for heartbeats)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://{new_leader}/peers", timeout=2
+            ) as resp:
+                if len(json.loads(resp.read())) >= 2:
+                    break
+            time.sleep(0.5)
+
+        def routes():
+            with urllib.request.urlopen(
+                f"http://{new_leader}/routes", timeout=2
+            ) as resp:
+                return {int(k): v for k, v in
+                        json.loads(resp.read()).items()}
+
+        # ---- now kill a datanode: failover must still work ----------
+        victim_nid = 0
+        victim = dn_procs[victim_nid]
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=10)
+        deadline = time.time() + 120
+        moved = False
+        while time.time() < deadline:
+            r = routes()
+            if r and all(nid != victim_nid for nid in r.values()):
+                moved = True
+                break
+            time.sleep(1.0)
+        assert moved, f"regions never failed over: {routes()}"
+        # flushed rows are readable from the survivor via the frontend
+        deadline = time.time() + 60
+        ok = False
+        while time.time() < deadline:
+            try:
+                if _rows(_sql(fe, "select count(*) from t")) == [[4]]:
+                    ok = True
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        assert ok, "frontend query did not recover after failover"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()
