@@ -469,7 +469,22 @@ def _start_flownode(opts):
             tick_interval_s=opts.get("flow.tick_interval_s", 1.0)
         )
         closers = [inst.close]
-        _flight_server(inst, opts, closers)
+        flight_srv = _flight_server(inst, opts, closers)
+        # register in the metasrv flownode book so frontends place
+        # flows and route mirrors here (dist/frontend.py). Keyed by the
+        # ADVERTISED ADDRESS: two flownodes without explicit node ids
+        # must not overwrite each other's registration
+        try:
+            from greptimedb_tpu.dist.client import MetaClient
+            from greptimedb_tpu.dist.frontend import DistInstance as _DI
+
+            adv = _advertise_addr(opts, flight_srv) or ""
+            if adv:
+                MetaClient(meta_addr).kv_put(
+                    f"{_DI.FLOWNODE_PREFIX}{adv}", adv
+                )
+        except Exception as e:  # noqa: BLE001 - registration best-effort
+            print(f"# flownode registration failed: {e}", flush=True)
         server = _http_server(inst, opts, closers)
         print(
             f"greptimedb-tpu flownode (dist, metasrv {meta_addr}) "

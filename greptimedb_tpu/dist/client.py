@@ -69,12 +69,18 @@ class DatanodeClient:
         raise GreptimeError(_strip_flight_error(e)) from None
 
     # ---- actions ------------------------------------------------------
-    def action(self, kind: str, body: dict | None = None) -> dict:
+    def action(self, kind: str, body: dict | None = None, *,
+               timeout: float | None = None) -> dict:
+        """One Flight action; `timeout` bounds the call so a blackholed
+        peer cannot hang the caller indefinitely."""
         import pyarrow.flight as flight
 
+        opts = (flight.FlightCallOptions(timeout=timeout)
+                if timeout is not None else None)
         try:
             results = list(self._client().do_action(
-                flight.Action(kind, json.dumps(body or {}).encode())
+                flight.Action(kind, json.dumps(body or {}).encode()),
+                options=opts,
             ))
         except flight.FlightError as e:
             self._raise(e)

@@ -12,6 +12,7 @@ the MergeScan split) so raw rows stay where they were written.
 from __future__ import annotations
 
 import os
+import threading
 
 from greptimedb_tpu.instance import Standalone
 from greptimedb_tpu.dist.catalog import DistCatalogManager
@@ -37,9 +38,27 @@ class DistInstance(Standalone):
         self.catalog = DistCatalogManager(self.engine, self.meta)
         self.distributed = True
         self.flownode_addr = flownode_addr
-        self._flow_client = None
-        self._flow_sources: set[tuple[str, str]] = set()
-        self._flow_sources_at = 0.0
+        self._flow_clients: dict[str, object] = {}
+        # (db, table) -> [flownode addrs] from the kv flow-route book
+        self._mirror_map: dict[tuple[str, str], list[str]] = {}
+        self._mirror_map_at = 0.0
+        # per-flownode mirror backlog: deltas that failed to ship are
+        # replayed IN ORDER before new ones once the node is back
+        import collections
+
+        self._mirror_backlog: dict[str, collections.deque] = {}
+        self._mirror_backlog_bytes: dict[str, int] = {}
+        # per-address locks: one slow/hung flownode must not stall
+        # mirrors to healthy ones (a global registry lock only guards
+        # the per-address entry creation)
+        self._mirror_lock = threading.Lock()
+        self._mirror_addr_locks: dict[str, threading.Lock] = {}
+        # last-seen flownode incarnation + down marker per address: a
+        # restarted flownode re-derived its state from the durable
+        # source, so backlog covering pre-restart rows must be DROPPED
+        self._mirror_epoch: dict[str, str] = {}
+        self._mirror_down: set[str] = set()
+        self._mirror_probe_at: dict[str, float] = {}
 
     def execute_statement(self, stmt, ctx):
         from greptimedb_tpu.errors import DatanodeUnavailableError
@@ -58,53 +77,188 @@ class DistInstance(Standalone):
             self.catalog.refresh()
             return super().execute_statement(stmt, ctx)
 
-    def _flownode(self):
-        if self.flownode_addr is None:
-            return None
-        if self._flow_client is None:
-            from greptimedb_tpu.dist.client import DatanodeClient
+    # ------------------------------------------------------------------
+    # flownode placement: registered flownodes + per-flow routes live in
+    # the metasrv kv (the reference's flow metadata keys,
+    # src/common/meta/src/key/ + src/flow/src/server.rs:64-143)
+    # ------------------------------------------------------------------
+    FLOWNODE_PREFIX = "__meta/flownode/"
+    FLOW_ROUTE_PREFIX = "__flow/route/"
 
-            self._flow_client = DatanodeClient(self.flownode_addr)
-        return self._flow_client
+    def _flownode_addrs(self) -> list[str]:
+        """Registered flownode addresses; --flownode-addr is the
+        single-node fallback when none registered."""
+        try:
+            addrs = [v for _k, v in
+                     self.meta.kv_range(self.FLOWNODE_PREFIX) if v]
+        except Exception:  # noqa: BLE001 - metasrv transient
+            addrs = []
+        if not addrs and self.flownode_addr:
+            addrs = [self.flownode_addr]
+        return sorted(set(addrs))
+
+    def _flow_client_for(self, addr: str):
+        from greptimedb_tpu.dist.client import DatanodeClient
+
+        with self._mirror_lock:
+            cli = self._flow_clients.get(addr)
+            if cli is None:
+                cli = self._flow_clients[addr] = DatanodeClient(addr)
+            return cli
+
+    def _probe_epoch(self, addr: str, *, record: bool = True
+                     ) -> str | None:
+        """Bounded flownode incarnation probe (a blackholed node must
+        not hang the insert path); records it by default. A 2 s
+        cooldown after a failed probe keeps sustained ingest from
+        paying the probe timeout once per insert during an outage."""
+        import json as _json
+        import time as _time
+
+        import pyarrow.flight as flight
+
+        now = _time.monotonic()
+        if now - self._mirror_probe_at.get(addr, -1e9) < 2.0:
+            return None
+        cli = self._flow_client_for(addr)
+        try:
+            results = list(cli._client().do_action(
+                flight.Action("flow_epoch", b"{}"),
+                options=flight.FlightCallOptions(timeout=5.0),
+            ))
+            ep = _json.loads(
+                results[0].body.to_pybytes() or b"{}"
+            ).get("epoch") if results else None
+        except Exception:  # noqa: BLE001 - node down/hung
+            cli.close()
+            self._mirror_probe_at[addr] = now
+            return None
+        self._mirror_probe_at.pop(addr, None)
+        if ep and record:
+            self._mirror_epoch[addr] = ep
+        return ep
+
+    def _flow_routes(self) -> dict[str, dict]:
+        """flow-route book: '<db>/<name>' -> {addr, db, source}."""
+        import json as _json
+
+        out = {}
+        for k, v in self.meta.kv_range(self.FLOW_ROUTE_PREFIX):
+            try:
+                out[k[len(self.FLOW_ROUTE_PREFIX):]] = _json.loads(v)
+            except Exception:  # noqa: BLE001 - tolerate junk keys
+                continue
+        return out
 
     # ------------------------------------------------------------------
-    # flow statements forward to the flownode process (the reference's
-    # frontend -> flownode DDL path, src/operator/src/flow.rs)
+    # flow statements forward to the PLACED flownode process (the
+    # reference's frontend -> flownode DDL path, src/operator/src/flow.rs)
     # ------------------------------------------------------------------
     def _create_flow(self, stmt, ctx):
+        import json as _json
+        import zlib
+
         from greptimedb_tpu.errors import UnsupportedError
-        from greptimedb_tpu.flow.manager import _render_flow_sql
+        from greptimedb_tpu.flow.manager import (
+            _render_flow_sql,
+            _source_of,
+        )
         from greptimedb_tpu.instance import Output
 
         if self.flows is not None:
             # flows enabled on THIS process: we ARE the flownode
             return super()._create_flow(stmt, ctx)
-        cli = self._flownode()
-        if cli is None:
+        addrs = self._flownode_addrs()
+        if not addrs:
             raise UnsupportedError(
-                "this frontend has no flownode configured "
-                "(--flownode-addr)"
+                "no flownode registered and no --flownode-addr fallback"
             )
-        cli.action("create_flow", {
-            "sql": _render_flow_sql(stmt),
-            "db": getattr(ctx, "database", "public"),
-        })
-        self._flow_sources_at = 0.0  # re-fetch the source registry
+        db = getattr(ctx, "database", "public")
+        route_key = f"{self.FLOW_ROUTE_PREFIX}{db}/{stmt.name}"
+        existing = self.meta.kv_get(route_key)
+        if existing is not None:
+            candidates = [_json.loads(existing)["addr"]]
+        else:
+            # stable placement across K flownodes by flow-name hash;
+            # an unreachable (possibly dead, never deregistered) node
+            # must not poison its hash bucket, so fall through the ring
+            start = zlib.crc32(f"{db}/{stmt.name}".encode()) % len(addrs)
+            candidates = addrs[start:] + addrs[:start]
+        last_err = None
+        for addr in candidates:
+            try:
+                self._flow_client_for(addr).action("create_flow", {
+                    "sql": _render_flow_sql(stmt), "db": db,
+                }, timeout=30.0)
+                last_err = None
+                break
+            except Exception as e:  # noqa: BLE001 - try the next node
+                last_err = e
+        if last_err is not None:
+            raise last_err
+        self.meta.kv_put(route_key, _json.dumps({
+            "addr": addr, "db": db, "source": _source_of(stmt),
+        }))
+        # record the node's incarnation now: a later backlog drain must
+        # be able to tell a restart (drop backlog) from continuity
+        self._probe_epoch(addr)
+        self._mirror_map_at = 0.0  # rebuild the mirror route map
         return Output.rows(0)
 
     def _drop_flow(self, stmt, ctx):
+        import json as _json
+
         from greptimedb_tpu.errors import UnsupportedError
         from greptimedb_tpu.instance import Output
 
         if self.flows is not None:
             return super()._drop_flow(stmt, ctx)
-        cli = self._flownode()
-        if cli is None:
-            raise UnsupportedError("no flownode configured")
-        cli.action("drop_flow", {
-            "name": stmt.name, "if_exists": stmt.if_exists,
-        })
-        self._flow_sources_at = 0.0
+        db = getattr(ctx, "database", "public")
+        route_key = f"{self.FLOW_ROUTE_PREFIX}{db}/{stmt.name}"
+        raw = self.meta.kv_get(route_key)
+        if raw is not None:
+            hosts = [_json.loads(raw)["addr"]]
+        else:
+            # no route book entry (flow created out-of-band): locate
+            # the actual host(s) instead of trusting the first node's
+            # silent IF EXISTS success
+            addrs = self._flownode_addrs()
+            if not addrs:
+                raise UnsupportedError("no flownode configured")
+            hosts = []
+            for addr in addrs:
+                try:
+                    infos = self._flow_client_for(addr).action(
+                        "flow_infos", timeout=10.0
+                    ).get("flows", [])
+                except Exception:  # noqa: BLE001 - node down
+                    continue
+                if any(f["name"] == stmt.name for f in infos):
+                    hosts.append(addr)
+            if not hosts:
+                if stmt.if_exists:
+                    return Output.rows(0)
+                from greptimedb_tpu.errors import FlowNotFoundError
+
+                raise FlowNotFoundError(f"flow not found: {stmt.name}")
+        last_err = None
+        for addr in hosts:
+            try:
+                self._flow_client_for(addr).action("drop_flow", {
+                    "name": stmt.name, "if_exists": stmt.if_exists,
+                }, timeout=10.0)
+                last_err = None
+            except Exception as e:  # noqa: BLE001 - keep trying
+                last_err = e
+        if last_err is not None:
+            if not stmt.if_exists:
+                raise last_err
+            # IF EXISTS against a dead routed node: release the route
+            # so mirrors stop targeting it and the name is reusable (a
+            # revived node would still hold its local flow def — the
+            # operator decommissioned it, so that copy is orphaned)
+        self.meta.kv_delete(route_key)
+        self._mirror_map_at = 0.0
         return Output.rows(0)
 
     def _show_flows(self):
@@ -112,48 +266,174 @@ class DistInstance(Standalone):
 
         if self.flows is not None:
             return super()._show_flows()
-        cli = self._flownode()
-        if cli is None:
-            return _result_from_lists(["Flows"], [[]])
-        infos = cli.action("flow_infos").get("flows", [])
-        return _result_from_lists(
-            ["Flows"], [[f["name"] for f in infos]]
-        )
+        names = set()
+        for addr in self._flownode_addrs():
+            try:
+                infos = self._flow_client_for(addr).action(
+                    "flow_infos", timeout=10.0
+                ).get("flows", [])
+                names.update(f["name"] for f in infos)
+            except Exception:  # noqa: BLE001 - node may be down
+                continue
+        return _result_from_lists(["Flows"], [[n] for n in sorted(names)])
 
     # ------------------------------------------------------------------
-    # mirroring: source-table inserts stream to the flownode
-    # (src/operator/src/insert.rs:284-317 mirror path)
+    # mirroring: source-table inserts stream to every flownode hosting a
+    # flow over that source (src/operator/src/insert.rs:284-317); failed
+    # deltas buffer per node and replay in order when it returns
     # ------------------------------------------------------------------
-    def _mirror_sources(self) -> set[tuple[str, str]]:
+    _MIRROR_BACKLOG_BYTES = 64 * 1024 * 1024
+
+    def _mirror_targets(self, db: str, name: str) -> list[str]:
         import time
 
-        cli = self._flownode()
-        if cli is None:
-            return set()
         now = time.monotonic()
-        if now - self._flow_sources_at > 5.0:
+        if now - self._mirror_map_at > 5.0:
+            mapping: dict[tuple[str, str], list[str]] = {}
             try:
-                self._flow_sources = {
-                    (db, t) for db, t in
-                    cli.action("flow_sources").get("sources", [])
-                }
-            except Exception:  # noqa: BLE001 - flownode may be down
-                self._flow_sources = set()
-            self._flow_sources_at = now
-        return self._flow_sources
+                for route in self._flow_routes().values():
+                    key = (route.get("db", "public"), route["source"])
+                    addr = route["addr"]
+                    if addr not in mapping.setdefault(key, []):
+                        mapping[key].append(addr)
+            except Exception:  # noqa: BLE001 - metasrv transient
+                mapping = self._mirror_map
+            # legacy single-flownode mode (no metasrv flow routes):
+            # ask the node for its live source registry
+            if not mapping and self.flownode_addr:
+                try:
+                    srcs = self._flow_client_for(
+                        self.flownode_addr
+                    ).action("flow_sources",
+                             timeout=10.0).get("sources", [])
+                    mapping = {
+                        (d, t): [self.flownode_addr] for d, t in srcs
+                    }
+                except Exception:  # noqa: BLE001 - node down
+                    mapping = self._mirror_map
+            self._mirror_map = mapping
+            self._mirror_map_at = now
+            # opportunistic incarnation probe for nodes we have not
+            # talked to yet (e.g. another frontend created the flow):
+            # without a recorded epoch, a later backlog drain cannot
+            # tell restart from continuity
+            known = {a for addrs_ in mapping.values() for a in addrs_}
+            for a in known - set(self._mirror_epoch):
+                self._probe_epoch(a)
+        return self._mirror_map.get((db, name), [])
+
+    def _ship_mirror(self, addr: str, db: str, name: str, batch):
+        """One DoPut with applied-ack drain; raises on failure."""
+        import pyarrow.flight as flight
+
+        cli = self._flow_client_for(addr)
+        descriptor = flight.FlightDescriptor.for_path(
+            f"flow_mirror:{db}.{name}"
+        )
+        try:
+            # bounded call: a blackholed flownode must not hang the
+            # user's insert for the full gRPC default deadline
+            writer, reader = cli._client().do_put(
+                descriptor, batch.schema,
+                options=flight.FlightCallOptions(timeout=5.0),
+            )
+            writer.write_batch(batch)
+            # drain the ack so the flownode has APPLIED the delta
+            # before this insert returns (a flush must see it)
+            writer.done_writing()
+            try:
+                reader.read()
+            except StopIteration:
+                pass
+            writer.close()
+        except Exception:
+            cli.close()  # force a redial once the node is back
+            raise
+
+    def _mirror_delta(self, addr: str, db: str, name: str, batch):
+        """Ship backlog first (order preserved), then this delta;
+        failures append to the bounded PER-NODE backlog. When the node
+        comes back with a NEW epoch, the backlog is dropped instead of
+        replayed: the restarted flownode re-derived its state from the
+        durable source rows, which already include everything the
+        backlog carried (mirroring happens after the source write)."""
+        import collections
+
+        from greptimedb_tpu.telemetry.metrics import global_registry
+
+        with self._mirror_lock:
+            q = self._mirror_backlog.setdefault(
+                addr, collections.deque()
+            )
+            lock = self._mirror_addr_locks.setdefault(
+                addr, threading.Lock()
+            )
+        with lock:
+            had_backlog = bool(q)
+            q.append((db, name, batch))
+            nbytes = self._mirror_backlog_bytes.get(addr, 0)
+            nbytes += batch.nbytes
+            # bounded per node: drop its OLDEST beyond budget
+            while nbytes > self._MIRROR_BACKLOG_BYTES and len(q) > 1:
+                _db, _nm, dropped = q.popleft()
+                nbytes -= dropped.nbytes
+                global_registry.counter(
+                    "gtpu_flow_mirror_dropped_total",
+                    "mirror deltas dropped beyond the backlog budget",
+                ).inc()
+            self._mirror_backlog_bytes[addr] = nbytes
+            if had_backlog and addr in self._mirror_down:
+                # node was down with queued deltas: check incarnation
+                ep = self._probe_epoch(addr, record=False)
+                if ep is None:
+                    global_registry.counter(
+                        "gtpu_flow_mirror_errors_total",
+                        "failed source-delta mirrors to the flownode",
+                    ).inc()
+                    return
+                if ep and ep != self._mirror_epoch.get(addr):
+                    # restart detected — or no recorded incarnation at
+                    # all, where replay risks double-count against the
+                    # node's startup backfill (backlogged rows are
+                    # durable in the source it scanned): drop all but
+                    # the NEWEST delta (the one just appended, inserted
+                    # after that backfill)
+                    while len(q) > 1:
+                        _d, _n, old = q.popleft()
+                        self._mirror_backlog_bytes[addr] -= old.nbytes
+                if ep:
+                    self._mirror_epoch[addr] = ep
+                self._mirror_down.discard(addr)
+            while q:
+                d, nm, b = q[0]
+                try:
+                    self._ship_mirror(addr, d, nm, b)
+                except Exception:  # noqa: BLE001 - node down: keep
+                    self._mirror_down.add(addr)
+                    global_registry.counter(
+                        "gtpu_flow_mirror_errors_total",
+                        "failed source-delta mirrors to the flownode",
+                    ).inc()
+                    return
+                q.popleft()
+                self._mirror_backlog_bytes[addr] -= b.nbytes
+            if addr not in self._mirror_epoch:
+                # first successful contact: record the incarnation so a
+                # later restart is detectable
+                self._probe_epoch(addr)
 
     def _notify_flows(self, db, name, table, data, valid):
         # local in-process flows still work (flows enabled directly on
         # this instance, e.g. tests)
         super()._notify_flows(db, name, table, data, valid)
-        if (db, name) not in self._mirror_sources():
+        targets = self._mirror_targets(db, name)
+        if not targets:
             return
         # the user's INSERT has already durably landed on the datanodes;
         # NOTHING in the mirror (batch conversion included) may fail it
         try:
             import numpy as np
             import pyarrow as pa
-            import pyarrow.flight as flight
 
             arrays = []
             names = []
@@ -167,22 +447,8 @@ class DistInstance(Standalone):
                     arrays.append(pa.array(vals, mask=mask))
                 names.append(cname)
             batch = pa.RecordBatch.from_arrays(arrays, names=names)
-            cli = self._flownode()
-            descriptor = flight.FlightDescriptor.for_path(
-                f"flow_mirror:{db}.{name}"
-            )
-            writer, reader = cli._client().do_put(
-                descriptor, batch.schema
-            )
-            writer.write_batch(batch)
-            # drain the ack so the flownode has APPLIED the delta before
-            # this insert returns (a following flush must see it)
-            writer.done_writing()
-            try:
-                reader.read()
-            except StopIteration:
-                pass
-            writer.close()
+            for addr in targets:
+                self._mirror_delta(addr, db, name, batch)
         except Exception:  # noqa: BLE001 - mirroring is best-effort
             from greptimedb_tpu.telemetry.metrics import global_registry
 
@@ -193,8 +459,10 @@ class DistInstance(Standalone):
 
     def close(self):
         try:
-            if self._flow_client is not None:
-                self._flow_client.close()
+            with self._mirror_lock:
+                clients = list(self._flow_clients.values())
+            for cli in clients:
+                cli.close()
             self.catalog.close()
         finally:
             super().close()

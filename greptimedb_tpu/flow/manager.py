@@ -72,6 +72,16 @@ class Flow:
         self.plan = None          # lazily planned against the source schema
         self.device_state = None  # DeviceFlowState when the plan allows
         self.last_tick_ms = 0
+        # restart recovery pending: state must re-derive from the source
+        # before deltas may apply (deltas while set are ALSO in the
+        # source, so the eventual backfill covers them)
+        self.needs_backfill = False
+        # a delta was skipped while a backfill scan was running: its row
+        # may postdate the scan snapshot, so the backfill must re-run.
+        # backfill_gate makes the skip-vs-clear handoff atomic without
+        # blocking inserts behind the (long) scan itself.
+        self.missed_during_backfill = False
+        self.backfill_gate = threading.Lock()
 
     def to_json(self) -> dict:
         return {
@@ -96,10 +106,16 @@ class FlowManager:
     """Hosts all flows in-process (standalone's flownode role)."""
 
     def __init__(self, instance, *, tick_interval_s: float | None = None):
+        import uuid
+
         self.instance = instance
         self.tick_interval_s = (
             1.0 if tick_interval_s is None else tick_interval_s
         )
+        # process incarnation: frontends compare this to detect a
+        # restart (state was re-derived from source; stale mirror
+        # backlogs must be dropped, not replayed)
+        self.epoch = uuid.uuid4().hex
         self._flows: dict[str, Flow] = {}
         self._by_source: dict[str, list[Flow]] = {}
         self._lock = threading.RLock()
@@ -199,8 +215,20 @@ class FlowManager:
                 table = self.instance.catalog.maybe_table(
                     flow.db, flow.source_table
                 )
+                # crash recovery: accumulated state died with the
+                # process — re-derive it from the DURABLE source rows
+                # (mirror backlogs covering these rows are dropped by
+                # the frontend on epoch change). Source unreachable or
+                # not yet visible => retry from the tick loop; deltas
+                # are skipped until the backfill lands.
+                flow.needs_backfill = True
                 if table is not None:
                     self._plan_flow(flow, table)
+                    try:
+                        self._backfill(flow, table)
+                        flow.needs_backfill = False
+                    except Exception:  # noqa: BLE001 - retried in tick
+                        pass
                 self._flows[flow.name] = flow
                 self._by_source.setdefault(
                     flow.source_table, []
@@ -209,6 +237,22 @@ class FlowManager:
                 import traceback
 
                 traceback.print_exc()
+
+    def _backfill(self, flow: Flow, table):
+        data = table.scan()
+        rows = data.rows
+        if rows is None or len(rows) == 0:
+            return
+        reg = data.registry
+        cols: dict = {table.ts_name: rows.ts}
+        for t in table.tag_names:
+            cols[t] = reg.tag_values(t)[rows.sid]
+        valid: dict = {}
+        for f, arr in rows.fields.items():
+            cols[f] = arr
+            if rows.field_valid and f in rows.field_valid:
+                valid[f] = rows.field_valid[f]
+        self._apply_delta(flow, table, cols, valid)
 
     # ------------------------------------------------------------------
     # planning
@@ -266,6 +310,16 @@ class FlowManager:
         for flow in flows:
             if flow.db != db:
                 continue
+            with flow.backfill_gate:
+                if flow.needs_backfill:
+                    # state not re-derived yet: this delta's rows are
+                    # durable in the source, so the pending backfill
+                    # covers them — applying now would double-count.
+                    # Mark the skip (under the gate) so a backfill
+                    # racing this delta re-runs: the row may postdate
+                    # its scan snapshot.
+                    flow.missed_during_backfill = True
+                    continue
             try:
                 self._apply_delta(flow, table, data, valid or {})
             except Exception:
@@ -413,6 +467,47 @@ class FlowManager:
         with self._lock:
             flows = list(self._flows.values())
         for flow in flows:
+            if flow.needs_backfill:
+                # restart recovery: keep retrying the source re-derive
+                # until the datanodes are reachable. State resets before
+                # every attempt (a failed attempt may have half-applied
+                # the scan), and the pass re-runs if a mirror delta was
+                # skipped mid-scan — its row may postdate the snapshot.
+                # NOT under flow.lock: _backfill -> _apply_delta takes
+                # it internally (non-reentrant). Concurrent deltas are
+                # excluded by the needs_backfill gate, and this tick
+                # thread is the only backfill runner.
+                try:
+                    table = self.instance.catalog.maybe_table(
+                        flow.db, flow.source_table
+                    )
+                    if table is None:
+                        continue
+                    if flow.plan is None:
+                        with flow.lock:
+                            if flow.plan is None:
+                                self._plan_flow(flow, table)
+                    clean = False
+                    for _attempt in range(3):
+                        flow.state = {}
+                        flow.device_state = None
+                        flow.missed_during_backfill = False
+                        self._backfill(flow, table)
+                        with flow.backfill_gate:
+                            if not flow.missed_during_backfill:
+                                # atomically open the delta gate: any
+                                # delta that marked a miss did so under
+                                # this gate and is visible here
+                                flow.needs_backfill = False
+                                clean = True
+                        if clean:
+                            break
+                    # 3 missed passes (continuous ingest): keep the
+                    # flag set — the freshly scanned state flushes
+                    # below and the next tick rescans until a pass
+                    # completes without a concurrent delta
+                except Exception:
+                    continue
             try:
                 self._flush_flow(flow)
             except Exception:

@@ -244,7 +244,7 @@ class FlightServer(flight.FlightServerBase):
 
     def _do_action(self, kind: str, body: dict) -> dict | None:
         if kind in ("create_flow", "drop_flow", "flow_infos",
-                    "flow_sources"):
+                    "flow_sources", "flow_epoch"):
             return self._flow_action(kind, body)
         rs = self._region_server()
         if kind == "open_region":
@@ -304,6 +304,8 @@ class FlightServer(flight.FlightServerBase):
             return {"flows": flows.flow_infos()}
         if kind == "flow_sources":
             return {"sources": flows.flow_sources()}
+        if kind == "flow_epoch":
+            return {"epoch": flows.epoch}
         raise flight.FlightServerError(f"unknown flow action: {kind}")
 
     def _do_put_flow_mirror(self, name: str, reader):

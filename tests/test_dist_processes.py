@@ -406,3 +406,124 @@ def test_metasrv_ha_leader_kill_and_failover(tmp_path):
                 p.kill()
         for log in logs:
             log.close()
+
+
+def test_flownode_crash_mirror_replay(tmp_path):
+    """Kill the flownode PROCESS mid-stream: deltas inserted while it is
+    down buffer on the frontend (bounded backlog) and replay in order
+    after restart; the flownode reloads its flows from disk and the
+    sink table converges to ALL source rows (VERDICT r4 #7)."""
+    procs = []
+    logs = []
+
+    def spawn(args, name):
+        log = open(tmp_path / f"{name}.log", "a")
+        logs.append(log)
+        p = _spawn(args, log)
+        procs.append(p)
+        return p
+
+    try:
+        meta_port = _free_port()
+        spawn(["metasrv", "start", "--data-home", str(tmp_path / "meta"),
+               "--metasrv-addr", f"127.0.0.1:{meta_port}",
+               "--http-addr", ""], "metasrv")
+        _wait_http(f"127.0.0.1:{meta_port}")
+        dn_port = _free_port()
+        spawn(["datanode", "start",
+               "--data-home", str(tmp_path / "dn0"),
+               "--flight-addr", f"127.0.0.1:{dn_port}",
+               "--metasrv-addr", f"127.0.0.1:{meta_port}",
+               "--node-id", "0", "--http-addr", "", "--mysql-addr", "",
+               "--postgres-addr", "", "--no-flows"], "dn0")
+        _wait_port(dn_port)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{meta_port}/peers", timeout=2
+            ) as resp:
+                if len(json.loads(resp.read())) >= 1:
+                    break
+            time.sleep(0.2)
+
+        flow_port = _free_port()
+
+        def spawn_flownode():
+            return spawn(
+                ["flownode", "start",
+                 "--data-home", str(tmp_path / "flow"),
+                 "--flight-addr", f"127.0.0.1:{flow_port}",
+                 "--metasrv-addr", f"127.0.0.1:{meta_port}",
+                 "--http-addr", "", "--mysql-addr", "",
+                 "--postgres-addr", ""], "flownode")
+
+        fn = spawn_flownode()
+        _wait_port(flow_port)
+
+        fe_port = _free_port()
+        spawn(["frontend", "start",
+               "--data-home", str(tmp_path / "fe"),
+               "--http-addr", f"127.0.0.1:{fe_port}",
+               "--metasrv-addr", f"127.0.0.1:{meta_port}",
+               "--mysql-addr", "", "--postgres-addr", "",
+               "--flight-addr", ""], "frontend")
+        fe = f"127.0.0.1:{fe_port}"
+        _wait_http(fe, path="/health")
+
+        _sql(fe, "create table src (host string primary key, v double, "
+                 "ts timestamp time index)")
+        # flow placed via the metasrv flownode book (no --flownode-addr)
+        _sql(fe, "create flow agg sink to sums as select "
+                 "date_bin('1 minute', ts) as w, host, count(*) as n, "
+                 "sum(v) as s from src group by w, host")
+        _sql(fe, "insert into src values ('a', 1.0, 1700000000000)")
+
+        def sink_rows():
+            try:
+                return _rows(_sql(
+                    fe, "select host, n, s from sums order by host"
+                ))
+            except Exception:
+                return []
+
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if sink_rows() == [["a", 1, 1.0]]:
+                break
+            time.sleep(0.5)
+        assert sink_rows() == [["a", 1, 1.0]], "flow never produced"
+
+        # ---- SIGKILL the flownode mid-stream ------------------------
+        fn.send_signal(signal.SIGKILL)
+        fn.wait(timeout=10)
+        # inserts while it is down must not fail the writes...
+        _sql(fe, "insert into src values ('a', 2.0, 1700000001000)")
+        _sql(fe, "insert into src values ('b', 5.0, 1700000002000)")
+        # ...and the source table has them durably
+        assert _rows(_sql(fe, "select count(*) from src")) == [[3]]
+
+        # ---- restart on the same address ----------------------------
+        spawn_flownode()
+        _wait_port(flow_port)
+        # a post-restart insert triggers the backlog replay
+        _sql(fe, "insert into src values ('b', 7.0, 1700000003000)")
+        deadline = time.time() + 120
+        want = [["a", 2, 3.0], ["b", 2, 12.0]]
+        got = []
+        while time.time() < deadline:
+            got = sink_rows()
+            if got == want:
+                break
+            time.sleep(0.5)
+        assert got == want, f"sink did not converge after restart: {got}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()

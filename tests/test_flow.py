@@ -196,3 +196,51 @@ def test_flow_non_windowed_upserts_not_appends(inst):
     res = inst.sql("SELECT host, s FROM sums")
     # one row per group — each flush overwrites (upsert), never appends
     assert res.rows() == [["h1", 12.0]]
+
+
+def test_backfill_recovery_tick_does_not_deadlock(tmp_path):
+    """flush_all's restart-recovery backfill must not self-deadlock on
+    the non-reentrant flow lock (code-review r5 repro), and must
+    re-derive state from the source."""
+    import threading
+
+    import numpy as np
+
+    from greptimedb_tpu.instance import Standalone
+
+    inst = Standalone(str(tmp_path / "d"), prefer_device=False,
+                      warm_start=False)
+    try:
+        inst.enable_flows(tick_interval_s=3600)
+        inst.execute_sql(
+            "create table s (host string primary key, v double, "
+            "ts timestamp time index)"
+        )
+        inst.execute_sql(
+            "create flow f sink to sums as select "
+            "date_bin('1 minute', ts) as w, host, count(*) as n, "
+            "sum(v) as t from s group by w, host"
+        )
+        inst.execute_sql("insert into s values ('a', 1.0, 1000), "
+                         "('a', 2.0, 2000)")
+        flow = inst.flows.maybe_flow("f")
+        # simulate a restart that could not backfill at load time
+        flow.state = {}
+        flow.device_state = None
+        flow.needs_backfill = True
+        done = threading.Event()
+
+        def run():
+            inst.flows.flush_all()
+            done.set()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert done.wait(30), "flush_all deadlocked in backfill recovery"
+        assert not flow.needs_backfill
+        rows = inst.sql(
+            "select host, n, t from sums order by host"
+        ).rows()
+        assert rows == [["a", 2, 3.0]]
+    finally:
+        inst.close()
