@@ -344,6 +344,95 @@ _PROVIDERS = {
 }
 
 
+# ----------------------------------------------------------------------
+# pg_catalog shims (reference:
+# /root/reference/src/catalog/src/system_schema/pg_catalog/): the
+# queryable tables psql's \d / \dt and ORM introspection hit over the
+# PG wire. OIDs are stable per name (crc32, masked positive) except
+# pg_type's, which match the wire-protocol type OIDs.
+# ----------------------------------------------------------------------
+
+# (typname, wire oid, typlen) — the types the PG wire encoder speaks
+_PG_TYPES = [
+    ("bool", 16, 1), ("int8", 20, 8), ("text", 25, -1),
+    ("float8", 701, 8), ("timestamp", 1114, 8), ("numeric", 1700, -1),
+    ("varchar", 1043, -1), ("int4", 23, 4), ("float4", 700, 4),
+]
+
+
+def _pg_oid(name: str) -> int:
+    import zlib
+
+    return (zlib.crc32(name.encode()) & 0x7FFFFFFF) or 1
+
+
+def _pg_namespace_doc(inst) -> dict[str, list]:
+    rows = {"oid": [], "nspname": []}
+    for db in ["pg_catalog", "information_schema",
+               *inst.catalog.database_names()]:
+        rows["oid"].append(_pg_oid(f"ns:{db}"))
+        rows["nspname"].append(db)
+    return rows
+
+
+def _pg_class_doc(inst) -> dict[str, list]:
+    rows = {"oid": [], "relname": [], "relnamespace": [], "relkind": [],
+            "relowner": []}
+    for db in inst.catalog.database_names():
+        ns = _pg_oid(f"ns:{db}")
+        for name in inst.catalog.table_names(db):
+            rows["oid"].append(_pg_oid(f"rel:{db}.{name}"))
+            rows["relname"].append(name)
+            rows["relnamespace"].append(ns)
+            rows["relkind"].append("r")
+            rows["relowner"].append(10)
+        for vname in inst.catalog.view_names(db):
+            rows["oid"].append(_pg_oid(f"rel:{db}.{vname}"))
+            rows["relname"].append(vname)
+            rows["relnamespace"].append(ns)
+            rows["relkind"].append("v")
+            rows["relowner"].append(10)
+    return rows
+
+
+def _pg_database_doc(inst) -> dict[str, list]:
+    rows = {"oid": [], "datname": []}
+    for db in inst.catalog.database_names():
+        rows["oid"].append(_pg_oid(f"db:{db}"))
+        rows["datname"].append(db)
+    return rows
+
+
+def _pg_type_doc(inst) -> dict[str, list]:
+    return {
+        "oid": [oid for _n, oid, _l in _PG_TYPES],
+        "typname": [n for n, _o, _l in _PG_TYPES],
+        "typlen": [l for _n, _o, l in _PG_TYPES],
+    }
+
+
+PG_CATALOG_TABLES = frozenset(
+    {"pg_namespace", "pg_class", "pg_database", "pg_type"}
+)
+_PG_PROVIDERS = {
+    "pg_namespace": _pg_namespace_doc,
+    "pg_class": _pg_class_doc,
+    "pg_database": _pg_database_doc,
+    "pg_type": _pg_type_doc,
+}
+
+
+def query_pg_catalog(inst, stmt: A.Select, ctx) -> QueryResult:
+    name = stmt.from_table
+    if "." in name:
+        name = name.split(".", 1)[1]
+    name = name.lower()
+    provider = _PG_PROVIDERS.get(name)
+    if provider is None:
+        raise TableNotFoundError(f"pg_catalog.{name}")
+    return _query_system_doc(inst, stmt, provider(inst))
+
+
 def query_information_schema(inst, stmt: A.Select, ctx) -> QueryResult:
     name = stmt.from_table
     if "." in name:
@@ -352,7 +441,10 @@ def query_information_schema(inst, stmt: A.Select, ctx) -> QueryResult:
     provider = _PROVIDERS.get(name)
     if provider is None:
         raise TableNotFoundError(f"information_schema.{name}")
-    doc = provider(inst)
+    return _query_system_doc(inst, stmt, provider(inst))
+
+
+def _query_system_doc(inst, stmt: A.Select, doc) -> QueryResult:
     cols = {}
     n = len(next(iter(doc.values()))) if doc else 0
     for k, vals in doc.items():

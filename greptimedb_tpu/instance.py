@@ -816,6 +816,12 @@ class Standalone:
         if stmt.from_table:
             if self._is_information_schema(stmt.from_table, ctx):
                 return self._query_information_schema(stmt, ctx)
+            if self._is_pg_catalog(stmt.from_table, ctx):
+                from greptimedb_tpu.information_schema import (
+                    query_pg_catalog,
+                )
+
+                return query_pg_catalog(self, stmt, ctx)
             db, name = self._resolve(stmt.from_table, ctx)
             table = self.catalog.table(db, name)
             ts_name = table.ts_name
@@ -993,6 +999,23 @@ class Standalone:
         if "." in name:
             return name.split(".", 1)[0].lower() == "information_schema"
         return ctx.database.lower() == "information_schema"
+
+    def _is_pg_catalog(self, name: str, ctx: QueryContext) -> bool:
+        """pg_catalog shims for psql/ORM introspection (reference:
+        src/catalog/src/system_schema/pg_catalog/). Bare names resolve
+        here only when no user table shadows them."""
+        from greptimedb_tpu.information_schema import PG_CATALOG_TABLES
+
+        if "." in name:
+            return name.split(".", 1)[0].lower() == "pg_catalog"
+        low = name.lower()
+        if low not in PG_CATALOG_TABLES:
+            return False
+        try:
+            db, tname = self._resolve(name, ctx)
+            return self.catalog.maybe_table(db, tname) is None
+        except Exception:  # noqa: BLE001 - unresolvable db: serve shim
+            return True
 
     def _query_information_schema(self, stmt: A.Select, ctx: QueryContext
                                   ) -> QueryResult:

@@ -463,6 +463,16 @@ def _frame_for_table(inst, src: A.TableName, ctx, env, conjuncts):
     qual = src.alias or src.name.rsplit(".", 1)[-1]
     if src.name in env:
         return Frame.from_result(env[src.name], qual), conjuncts
+    if (inst._is_information_schema(src.name, ctx)
+            or inst._is_pg_catalog(src.name, ctx)):
+        # system virtual tables join like any other relation (psql's
+        # \dt runs pg_class JOIN pg_namespace)
+        leaf = A.Select(items=[A.SelectItem(A.Star())],
+                        from_table=src.name)
+        return (
+            Frame.from_result(inst._select_single(leaf, ctx), qual),
+            conjuncts,
+        )
     db, name = inst._resolve(src.name, ctx)
     view_sql = inst.catalog.maybe_view(db, name)
     if view_sql is not None:

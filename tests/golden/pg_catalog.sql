@@ -1,0 +1,48 @@
+-- pg_catalog shims: the queryable tables psql \d / \dt and ORM
+-- introspection hit (reference: src/catalog/src/system_schema/pg_catalog/)
+CREATE TABLE metrics (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+SELECT nspname FROM pg_catalog.pg_namespace ORDER BY nspname;
+----
+nspname
+information_schema
+pg_catalog
+public
+
+SELECT relname, relkind FROM pg_catalog.pg_class ORDER BY relname;
+----
+relname|relkind
+metrics|r
+
+SELECT datname FROM pg_catalog.pg_database;
+----
+datname
+public
+
+SELECT typname, typlen FROM pg_catalog.pg_type WHERE oid = 25;
+----
+typname|typlen
+text|-1
+
+-- the \dt core shape: pg_class JOIN pg_namespace
+SELECT c.relname FROM pg_catalog.pg_class c JOIN pg_catalog.pg_namespace n ON n.oid = c.relnamespace WHERE n.nspname = 'public' AND c.relkind = 'r' ORDER BY c.relname;
+----
+relname
+metrics
+
+-- bare names resolve when no user table shadows them
+SELECT typname FROM pg_type WHERE oid = 16;
+----
+typname
+bool
+
+CREATE VIEW v_hosts AS SELECT host FROM metrics;
+
+SELECT relname, relkind FROM pg_catalog.pg_class WHERE relkind = 'v';
+----
+relname|relkind
+v_hosts|v
+
+DROP VIEW v_hosts;
+
+DROP TABLE metrics;

@@ -578,6 +578,32 @@ def test_postgres_simple_query(inst):
         srv.close()
 
 
+def test_postgres_pg_catalog_introspection(inst):
+    """psql-style catalog queries over the PG wire: \\dt's pg_class
+    JOIN pg_namespace, pg_type lookups (VERDICT r4 #9)."""
+    from greptimedb_tpu.servers.postgres import PostgresServer
+
+    srv = PostgresServer(inst, port=0).start()
+    try:
+        c = MiniPgClient(srv.port)
+        _, rows = c.query(
+            "SELECT c.relname FROM pg_catalog.pg_class c "
+            "JOIN pg_catalog.pg_namespace n ON n.oid = c.relnamespace "
+            "WHERE n.nspname = 'public' AND c.relkind = 'r' "
+            "ORDER BY c.relname"
+        )
+        assert ["wt"] in rows
+        _, rows = c.query(
+            "SELECT typname FROM pg_catalog.pg_type WHERE oid = 701"
+        )
+        assert rows == [["float8"]]
+        _, rows = c.query("SELECT datname FROM pg_catalog.pg_database")
+        assert ["public"] in rows
+        c.close()
+    finally:
+        srv.close()
+
+
 def test_postgres_extended_protocol(inst):
     from greptimedb_tpu.servers.postgres import PostgresServer
 
