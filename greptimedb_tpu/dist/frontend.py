@@ -261,6 +261,34 @@ class DistInstance(Standalone):
         self._mirror_map_at = 0.0
         return Output.rows(0)
 
+    def _flush_flow_admin(self, fname: str) -> bool:
+        if self.flows is not None:
+            return super()._flush_flow_admin(fname)
+        # forward to the node hosting the flow (route book first, then
+        # every registered flownode)
+        from greptimedb_tpu.errors import FlowNotFoundError
+
+        addrs = []
+        for key, route in self._flow_routes().items():
+            if key.rsplit("/", 1)[-1] == fname:
+                addrs.append(route["addr"])
+        if not addrs:
+            addrs = self._flownode_addrs()
+        real_err = None
+        for addr in addrs:
+            try:
+                self._flow_client_for(addr).action(
+                    "flush_flow", {"name": fname}, timeout=30.0,
+                )
+                return True
+            except Exception as e:  # noqa: BLE001 - try next node
+                # the hosting node's genuine failure must win over the
+                # other nodes' expected flow-miss (match the specific
+                # message: a SINK-table not-found is a real failure)
+                if real_err is None and "flow not found" not in str(e):
+                    real_err = e
+        raise real_err or FlowNotFoundError(f"flow not found: {fname}")
+
     def _show_flows(self):
         from greptimedb_tpu.instance import _result_from_lists
 

@@ -69,6 +69,10 @@ class Flow:
         self.processed_rows = 0
         self.state: dict[tuple, _GroupState] = {}
         self.lock = threading.Lock()
+        # serializes whole flushes: ADMIN flush_flow must not return
+        # while a concurrent tick-flush still holds this flow's dirty
+        # snapshot mid-emit (the sink would materialize only later)
+        self.flush_lock = threading.Lock()
         self.plan = None          # lazily planned against the source schema
         self.device_state = None  # DeviceFlowState when the plan allows
         self.last_tick_ms = 0
@@ -158,6 +162,19 @@ class FlowManager:
                 raise FlowNotFoundError(f"flow not found: {name}")
             self._by_source.get(flow.source_table, []).remove(flow)
             self._persist()
+
+    def flush_flow(self, name: str) -> bool:
+        """Flush ONE flow's accumulated state into its sink (the
+        reference's flush_flow admin function,
+        /root/reference/src/common/function/src/flush_flow.rs)."""
+        with self._lock:
+            flow = self._flows.get(name)
+        if flow is None:
+            from greptimedb_tpu.errors import FlowNotFoundError
+
+            raise FlowNotFoundError(f"flow not found: {name}")
+        self._flush_flow(flow)
+        return True
 
     def flow_names(self) -> list[str]:
         with self._lock:
@@ -562,6 +579,10 @@ class FlowManager:
     def _flush_flow(self, flow: Flow):
         if flow.plan is None:
             return
+        with flow.flush_lock:
+            self._flush_flow_locked(flow)
+
+    def _flush_flow_locked(self, flow: Flow):
         ds = flow.device_state
         if ds is not None and self._flush_flow_device(flow, ds):
             return

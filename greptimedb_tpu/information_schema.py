@@ -352,14 +352,6 @@ _PROVIDERS = {
 # pg_type's, which match the wire-protocol type OIDs.
 # ----------------------------------------------------------------------
 
-# (typname, wire oid, typlen) — the types the PG wire encoder speaks
-_PG_TYPES = [
-    ("bool", 16, 1), ("int8", 20, 8), ("text", 25, -1),
-    ("float8", 701, 8), ("timestamp", 1114, 8), ("numeric", 1700, -1),
-    ("varchar", 1043, -1), ("int4", 23, 4), ("float4", 700, 4),
-]
-
-
 def _pg_oid(name: str) -> int:
     import zlib
 
@@ -404,10 +396,13 @@ def _pg_database_doc(inst) -> dict[str, list]:
 
 
 def _pg_type_doc(inst) -> dict[str, list]:
+    # the ONE wire-type table lives next to the PG encoder
+    from greptimedb_tpu.servers.postgres import PG_TYPES
+
     return {
-        "oid": [oid for _n, oid, _l in _PG_TYPES],
-        "typname": [n for n, _o, _l in _PG_TYPES],
-        "typlen": [l for _n, _o, l in _PG_TYPES],
+        "oid": [oid for _n, oid, _l in PG_TYPES],
+        "typname": [n for n, _o, _l in PG_TYPES],
+        "typlen": [l for _n, _o, l in PG_TYPES],
     }
 
 

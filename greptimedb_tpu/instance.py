@@ -459,6 +459,12 @@ class Standalone:
             return Output.records(_result_from_lists(
                 [f"ADMIN {name}({rid})"], [[n]]
             ))
+        if name == "flush_flow":
+            fname = const_str(0)
+            n = 1 if self._flush_flow_admin(fname) else 0
+            return Output.records(_result_from_lists(
+                [f"ADMIN flush_flow('{fname}')"], [[n]]
+            ))
         if name == "migrate_region":
             metasrv = getattr(self, "metasrv", None)
             if metasrv is None:
@@ -1039,6 +1045,13 @@ class Standalone:
             # retarget the running ticker; takes effect at its next wait
             self.flows.tick_interval_s = tick_interval_s
         return self.flows
+
+    def _flush_flow_admin(self, fname: str) -> bool:
+        """ADMIN flush_flow on the local flow manager; DistInstance
+        overrides to forward to the routed flownode."""
+        if self.flows is None:
+            raise UnsupportedError("flows are not enabled")
+        return self.flows.flush_flow(fname)
 
     def _create_flow(self, stmt: A.CreateFlow, ctx: QueryContext) -> Output:
         self.enable_flows()

@@ -238,6 +238,15 @@ def segmented_cumextreme(values: jax.Array, reset: jax.Array,
 
 
 @jax.jit
+def segmented_cumsum_compensated_packed(v_hi: jax.Array, v_lo: jax.Array,
+                                        reset: jax.Array) -> jax.Array:
+    """(2, N) stacked (sum, comp): ONE device buffer = one host
+    readback (the dev-tunnel pays a full RTT per fetched buffer)."""
+    s, c = segmented_cumsum_compensated(v_hi, v_lo, reset)
+    return jnp.stack([s, c])
+
+
+@jax.jit
 def segmented_cumsum_compensated(v_hi: jax.Array, v_lo: jax.Array,
                                  reset: jax.Array):
     """Neumaier-compensated per-segment running sum over two-float f32

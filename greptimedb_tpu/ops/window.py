@@ -358,6 +358,23 @@ def window_stdvar(vals, has, tsg, hi, num_cells: int, *, sample_var: bool = Fals
     return var, jnp.sqrt(var), present
 
 
+def _small_sort_lanes(x, length: int):
+    """Ascending sort along the last axis via an odd-even transposition
+    network: for the short windows quantile_over_time sees (a handful of
+    cells), ~L^2/2 vectorized min/max exchanges on (S, J) planes beat
+    XLA's general variadic sort by a wide margin at 1M series."""
+    cols = [x[:, :, i] for i in range(length)]
+    for p in range(length):
+        for i in range(p % 2, length - 1, 2):
+            a, b = cols[i], cols[i + 1]
+            # NaN-last exchange (jnp.sort parity): a min/max pair would
+            # smear one NaN into BOTH lanes
+            a_first = (a <= b) | jnp.isnan(b)
+            cols[i] = jnp.where(a_first, a, b)
+            cols[i + 1] = jnp.where(a_first, b, a)
+    return jnp.stack(cols, axis=2)
+
+
 @functools.partial(jax.jit, static_argnames=("num_cells",))
 def window_quantile(vals, has, tsg, hi, num_cells: int, q):
     """phi-quantile with linear interpolation (Prometheus
@@ -365,17 +382,32 @@ def window_quantile(vals, has, tsg, hi, num_cells: int, q):
     g_vals, g_has, _ = gather_windows(vals, has, tsg, hi, num_cells)
     dt = vals.dtype
     fill = jnp.asarray(jnp.inf, dt)
-    sorted_vals = jnp.sort(jnp.where(g_has, g_vals, fill), axis=2)
+    masked = jnp.where(g_has, g_vals, fill)
+    if num_cells <= 16:
+        sorted_vals = _small_sort_lanes(masked, num_cells)
+    else:
+        sorted_vals = jnp.sort(masked, axis=2)
     n = jnp.sum(g_has, axis=2)
     present = n > 0
     q = jnp.asarray(q, dt)
     rank = q * jnp.maximum(n - 1, 0).astype(dt)
-    lo_i = jnp.floor(rank).astype(jnp.int32)
-    hi_i = jnp.ceil(rank).astype(jnp.int32)
-    lo_i = jnp.clip(lo_i, 0, num_cells - 1)
-    hi_i = jnp.clip(hi_i, 0, num_cells - 1)
-    v_lo = jnp.take_along_axis(sorted_vals, lo_i[:, :, None], axis=2)[:, :, 0]
-    v_hi = jnp.take_along_axis(sorted_vals, hi_i[:, :, None], axis=2)[:, :, 0]
+    lo_i = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, num_cells - 1)
+    hi_i = jnp.clip(jnp.ceil(rank).astype(jnp.int32), 0, num_cells - 1)
+    if num_cells <= _TAKE_CELLS_MAX_T:
+        # data-dependent take_along_axis lowers to a serializing
+        # scatter on TPU (~250ms at 1M series); a one-hot masked
+        # reduction over the tiny lane axis is fused VPU work
+        lanes = jnp.arange(num_cells, dtype=jnp.int32)[None, None, :]
+        z = jnp.zeros((), dt)
+        v_lo = jnp.sum(jnp.where(lanes == lo_i[:, :, None],
+                                 sorted_vals, z), axis=2)
+        v_hi = jnp.sum(jnp.where(lanes == hi_i[:, :, None],
+                                 sorted_vals, z), axis=2)
+    else:
+        v_lo = jnp.take_along_axis(
+            sorted_vals, lo_i[:, :, None], axis=2)[:, :, 0]
+        v_hi = jnp.take_along_axis(
+            sorted_vals, hi_i[:, :, None], axis=2)[:, :, 0]
     frac = rank - jnp.floor(rank)
     out = v_lo + (v_hi - v_lo) * frac
     return jnp.where(present, out, jnp.zeros((), dt)), present

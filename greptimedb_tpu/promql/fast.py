@@ -939,7 +939,14 @@ def _fused_topk(
         & jnp.isfinite(top_key)
     )
     top_vals = jnp.take_along_axis(out.T, top_idx, axis=1)
-    return top_vals, top_idx.astype(jnp.int32), top_pres
+    # ONE packed (3J, k) f32 buffer = one device->host transfer (three
+    # separate readbacks pay the dev-tunnel RTT three times). Winner
+    # indices are exact in f32: s_pad < 2^24.
+    return jnp.concatenate([
+        top_vals.astype(jnp.float32),
+        top_idx.astype(jnp.float32),
+        top_pres.astype(jnp.float32),
+    ])
 
 
 def try_fast_topk(engine, e, ev):
@@ -970,18 +977,23 @@ def try_fast_topk(engine, e, ev):
     if not any_match:
         _FAST_HITS.labels("hit").inc()
         return _empty_vector(ev)
+    if entry.s_pad >= (1 << 24):
+        # packed winner indices ride as f32 (exact only below 2^24);
+        # beyond that the generic engine serves correctly
+        return None
     lookback_ticks = max(int(ev.lookback_ms // entry.spec.unit), 1)
     kk = min(k, entry.num_series)
-    top_vals, top_idx, top_pres = _fused_topk(
+    packed = np.asarray(_fused_topk(
         entry.vals, entry.has, entry.tsg, smask, lo, hi, t_end,
         fname=fname, k=kk, largest=e.op == "topk",
         range_ticks=range_ticks, range_seconds=range_seconds,
         l_cells=l_cells, tps=entry.spec.tps, fargs=fargs,
         lookback_ticks=lookback_ticks,
-    )
-    top_vals = np.asarray(top_vals, np.float64)   # (J, k)
-    top_idx = np.asarray(top_idx)
-    top_pres = np.asarray(top_pres)
+    ))
+    jj = packed.shape[0] // 3
+    top_vals = packed[:jj].astype(np.float64)      # (J, k)
+    top_idx = packed[jj:2 * jj].astype(np.int64)
+    top_pres = packed[2 * jj:] != 0.0
     j = top_vals.shape[0]
     sids = np.unique(top_idx[top_pres])
     if len(sids) == 0:

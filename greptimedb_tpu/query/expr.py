@@ -173,6 +173,12 @@ def eval_expr(e: A.Expr, src: ColumnSource) -> Col:
     if isinstance(e, A.Case):
         return _eval_case(e, src)
     if isinstance(e, A.FuncCall):
+        if e.filter is not None:
+            # aggregates consume .filter in the planner; a FuncCall
+            # reaching scalar evaluation with one would silently drop it
+            raise UnsupportedError(
+                "FILTER is only supported on aggregate functions"
+            )
         from greptimedb_tpu.query.functions import eval_scalar_function
 
         return eval_scalar_function(e, src)

@@ -302,6 +302,23 @@ class _Rewriter:
         sig = repr(fc)
         if sig in self._agg_index:
             return self._agg_index[sig]
+        if fc.filter is not None:
+            # agg(x) FILTER (WHERE c) == agg(CASE WHEN c THEN x END):
+            # aggregates skip NULLs, so the CASE's NULL else-arm drops
+            # exactly the filtered-out rows (count(*) counts a literal)
+            args = list(fc.args)
+            if not args or isinstance(args[0], A.Star):
+                # count(*): count a filtered literal instead
+                args = [A.Case(None, [(fc.filter, A.Literal(1))], None)]
+            else:
+                # the VALUE argument is the last one (two-arg aggs like
+                # quantile(q, x) carry the fraction first)
+                arg_pos = len(args) - 1
+                args[arg_pos] = A.Case(
+                    None, [(fc.filter, args[arg_pos])], None
+                )
+            fc = A.FuncCall(fc.name, args, distinct=fc.distinct,
+                            order_by=fc.order_by)
         name = _NORMALIZE_AGG.get(fc.name)
         if name is None:
             raise UnsupportedError(f"unknown aggregate: {fc.name}")

@@ -470,11 +470,10 @@ def _running_scans(numeric, cnt, valid, part_start, name, n):
                     ))
             else:
                 v_hi, v_lo = _split_two_float(numeric)
-                s, c = S.segmented_cumsum_compensated(
+                packed = np.asarray(S.segmented_cumsum_compensated_packed(
                     jnp.asarray(v_hi), jnp.asarray(v_lo), d_reset
-                )
-                run_sum = (np.asarray(s, np.float64)
-                           + np.asarray(c, np.float64))
+                ), np.float64)
+                run_sum = packed[0] + packed[1]
                 # row counts fit int32 exactly (n < 2^31)
                 run_cnt = np.asarray(S.segmented_cumsum(
                     jnp.asarray(cnt, jnp.int32), d_reset

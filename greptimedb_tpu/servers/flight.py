@@ -244,7 +244,7 @@ class FlightServer(flight.FlightServerBase):
 
     def _do_action(self, kind: str, body: dict) -> dict | None:
         if kind in ("create_flow", "drop_flow", "flow_infos",
-                    "flow_sources", "flow_epoch"):
+                    "flow_sources", "flow_epoch", "flush_flow"):
             return self._flow_action(kind, body)
         rs = self._region_server()
         if kind == "open_region":
@@ -306,6 +306,8 @@ class FlightServer(flight.FlightServerBase):
             return {"sources": flows.flow_sources()}
         if kind == "flow_epoch":
             return {"epoch": flows.epoch}
+        if kind == "flush_flow":
+            return {"flushed": bool(flows.flush_flow(body["name"]))}
         raise flight.FlightServerError(f"unknown flow action: {kind}")
 
     def _do_put_flow_mirror(self, name: str, reader):

@@ -31,12 +31,20 @@ GSSENC_REQUEST = 80877104
 CANCEL_REQUEST = 80877102
 PROTOCOL_3 = 196608
 
-# type OIDs (pg_catalog.pg_type)
-OID_BOOL = 16
-OID_INT8 = 20
-OID_FLOAT8 = 701
-OID_TEXT = 25
-OID_TIMESTAMP = 1114
+# canonical PG type table: (typname, oid, typlen) — the ONE source for
+# both the wire encoder's OIDs and the queryable pg_catalog.pg_type shim
+# (greptimedb_tpu/information_schema.py derives from this)
+PG_TYPES = [
+    ("bool", 16, 1), ("int8", 20, 8), ("text", 25, -1),
+    ("float8", 701, 8), ("timestamp", 1114, 8), ("numeric", 1700, -1),
+    ("varchar", 1043, -1), ("int4", 23, 4), ("float4", 700, 4),
+]
+_OID = {name: oid for name, oid, _len in PG_TYPES}
+OID_BOOL = _OID["bool"]
+OID_INT8 = _OID["int8"]
+OID_FLOAT8 = _OID["float8"]
+OID_TEXT = _OID["text"]
+OID_TIMESTAMP = _OID["timestamp"]
 
 
 def _msg(tag: bytes, payload: bytes) -> bytes:

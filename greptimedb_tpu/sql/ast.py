@@ -59,6 +59,7 @@ class FuncCall(Expr):
     distinct: bool = False
     order_by: list["OrderItem"] = field(default_factory=list)
     over: "WindowSpec | None" = None   # window function when set
+    filter: "Expr | None" = None       # agg FILTER (WHERE ...) clause
 
 
 @dataclass

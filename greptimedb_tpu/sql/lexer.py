@@ -29,7 +29,7 @@ class Token:
 
 
 _TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||", "::", "->", "=~", "!~"}
-_ONE_CHAR_OPS = set("+-*/%(),.;=<>[]{}@:?$")
+_ONE_CHAR_OPS = set("+-*/%(),.;=<>[]{}@:?$^")  # ^ rides for TQL pow
 
 
 def tokenize(sql: str) -> list[Token]:

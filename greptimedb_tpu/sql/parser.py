@@ -590,16 +590,22 @@ class Parser:
             raise InvalidSyntaxError(f"unsupported TQL {t.text!r}")
         if kind == "evaluate":
             kind = "eval"
-        self.expect_op("(")
-        start = self.expr()
-        self.expect_op(",")
-        end = self.expr()
-        self.expect_op(",")
-        step = self.expr()
         lookback = None
-        if self.eat_op(","):
-            lookback = self.expr()
-        self.expect_op(")")
+        if kind in ("explain", "analyze") and not self.at_op("("):
+            # TQL EXPLAIN/ANALYZE accept a bare expression (the
+            # reference defaults the range to a single instant at 0)
+            start = end = A.Literal(0)
+            step = A.Literal("5m")
+        else:
+            self.expect_op("(")
+            start = self.expr()
+            self.expect_op(",")
+            end = self.expr()
+            self.expect_op(",")
+            step = self.expr()
+            if self.eat_op(","):
+                lookback = self.expr()
+            self.expect_op(")")
         # the rest of the statement text is the raw PromQL query
         t0 = self.peek()
         query = self.sql[t0.pos:].strip().rstrip(";")
@@ -1273,10 +1279,25 @@ class Parser:
                 args[0] = A.BinaryOp("-", A.Literal(1.0), args[0])
             fc = A.FuncCall(fc.name, args + [target.expr],
                             distinct=fc.distinct)
+        if self.at_kw("FILTER"):
+            # SQL:2003 aggregate filter: agg(x) FILTER (WHERE cond)
+            self.next()
+            self.expect_op("(")
+            self.expect_kw("WHERE")
+            fc.filter = self.expr()
+            self.expect_op(")")
         if self.at_kw("OVER"):
             self.next()
             fc.over = self.window_spec()
+            if fc.filter is not None:
+                raise InvalidSyntaxError(
+                    "FILTER on window functions is not supported"
+                )
         if self.at_kw("RANGE") and fc.over is None:
+            if fc.filter is not None:
+                raise InvalidSyntaxError(
+                    "FILTER is not supported on RANGE aggregates"
+                )
             self.next()
             range_ms = parse_interval_ms(self._interval_text())
             fill = None

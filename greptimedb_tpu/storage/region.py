@@ -430,8 +430,8 @@ class Region:
                  else self.meta.field_names)
         # merged-scan cache: answer out of the deduped columnar row set
         # when the region's logical data hasn't changed since it was built
-        if sids is None and fulltext is None and not raw:
-            hit = self._scan_cached(names, ts_min, ts_max)
+        if fulltext is None and not raw:
+            hit = self._scan_cached(names, ts_min, ts_max, sids)
             if hit is not None:
                 return hit
         chunks: list[ColumnarRows] = []
@@ -495,7 +495,8 @@ class Region:
         return ScanResult(rows, self.series, names)
 
     # -- merged-scan cache ---------------------------------------------
-    def _scan_cached(self, names, ts_min, ts_max) -> ScanResult | None:
+    def _scan_cached(self, names, ts_min, ts_max,
+                     sids=None) -> ScanResult | None:
         cached = self._scan_cache
         if cached is None:
             return None
@@ -510,6 +511,27 @@ class Region:
             return None
         _scan_pool.touch(self)
         out = _shallow_rows(rows, names)
+        if sids is not None:
+            # cached rows are (sid, ts)-sorted: each matched series is
+            # one contiguous run; runs expand vectorized (np.repeat of
+            # offset deltas + cumsum), no per-sid Python even at high
+            # matcher cardinality
+            lo_idx = np.searchsorted(out.sid, sids, side="left")
+            hi_idx = np.searchsorted(out.sid, sids, side="right")
+            lens = hi_idx - lo_idx
+            nz = lens > 0
+            starts = lo_idx[nz].astype(np.int64)
+            lens = lens[nz].astype(np.int64)
+            total = int(lens.sum())
+            if total:
+                run_base = np.concatenate(
+                    ([0], np.cumsum(lens)[:-1])
+                )
+                idx = (np.repeat(starts - run_base, lens)
+                       + np.arange(total, dtype=np.int64))
+            else:
+                idx = np.zeros(0, np.int64)
+            out = _slice_rows(out, idx)
         if ts_min is not None or ts_max is not None:
             lo = ts_min if ts_min is not None else -(2**63)
             hi = ts_max if ts_max is not None else 2**63 - 1
