@@ -1,0 +1,35 @@
+-- aggregates over filtered/expression inputs
+CREATE TABLE af (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO af VALUES (1000, 'a', 1.0), (2000, 'a', -2.0), (3000, 'b', 3.0), (4000, 'b', -4.0);
+
+SELECT g, sum(abs(v)) FROM af GROUP BY g ORDER BY g;
+----
+g|sum(abs(v))
+a|3.0
+b|7.0
+
+SELECT g, count(*) FILTER (WHERE v > 0) FROM af GROUP BY g ORDER BY g;
+----
+g|count(*)
+a|1
+b|1
+
+SELECT g, sum(v) FILTER (WHERE v > 0) AS pos_sum FROM af GROUP BY g ORDER BY g;
+----
+g|pos_sum
+a|1.0
+b|3.0
+
+SELECT g, max(v * v) FROM af GROUP BY g ORDER BY g;
+----
+g|max(v * v)
+a|4.0
+b|16.0
+
+SELECT min(v), max(v), avg(v), count(v) FROM af WHERE g = 'a';
+----
+min(v)|max(v)|avg(v)|count(v)
+-2.0|1.0|-0.5|2
+
+DROP TABLE af;

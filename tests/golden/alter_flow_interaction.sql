@@ -1,0 +1,46 @@
+-- ALTER + FLOW interactions: flows keep aggregating across source schema
+-- changes (reference: tests/cases/standalone/common/alter/ + flow/)
+CREATE TABLE req (host STRING PRIMARY KEY, lat DOUBLE, ts TIMESTAMP TIME INDEX);
+
+CREATE FLOW stats SINK TO req_sum AS SELECT date_bin('1 minute', ts) AS w, host, count(*) AS n, sum(lat) AS s FROM req GROUP BY w, host;
+
+INSERT INTO req VALUES ('a', 10.0, 1000), ('a', 20.0, 2000);
+
+ADMIN flush_flow('stats');
+----
+ADMIN flush_flow('stats')
+1
+
+SELECT host, n, s FROM req_sum ORDER BY host;
+----
+host|n|s
+a|2.0|30.0
+
+-- adding an unrelated column must not break the flow
+ALTER TABLE req ADD COLUMN region STRING;
+
+INSERT INTO req (host, lat, ts, region) VALUES ('b', 5.0, 3000, 'eu');
+
+ADMIN flush_flow('stats');
+----
+ADMIN flush_flow('stats')
+1
+
+SELECT host, n, s FROM req_sum ORDER BY host;
+----
+host|n|s
+a|2.0|30.0
+b|1.0|5.0
+
+SHOW FLOWS;
+----
+Flows
+stats
+
+ADMIN flush_flow('nope');
+----
+ERROR
+
+DROP FLOW stats;
+
+DROP TABLE req;

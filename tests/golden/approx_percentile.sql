@@ -1,0 +1,26 @@
+-- percentile aggregates (quantile family)
+CREATE TABLE ap (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO ap VALUES (1000, 'a', 1.0), (2000, 'b', 2.0), (3000, 'c', 3.0), (4000, 'd', 4.0), (5000, 'e', 100.0);
+
+SELECT approx_percentile_cont(0.5, v) FROM ap;
+----
+approx_percentile_cont(0.5, v)
+3.0
+
+SELECT median(v) FROM ap;
+----
+median(v)
+3.0
+
+SELECT percentile_cont(0.25) WITHIN GROUP (ORDER BY v) FROM ap;
+----
+percentile_cont(0.25, v)
+2.0
+
+SELECT percentile_cont(0.25) WITHIN GROUP (ORDER BY v DESC) FROM ap;
+----
+percentile_cont(1.0 - 0.25, v)
+4.0
+
+DROP TABLE ap;

@@ -1,0 +1,56 @@
+-- arithmetic edge cases: division by zero, modulo, integer/float mixing
+-- (reference: common/select/, common/function/)
+CREATE TABLE ar (ts TIMESTAMP TIME INDEX, a BIGINT, b DOUBLE);
+
+INSERT INTO ar VALUES (1000, 7, 2.0), (2000, -7, 0.0), (3000, 0, 3.5);
+
+SELECT a / 2 FROM ar ORDER BY ts;
+----
+a / 2
+3
+-4
+0
+
+SELECT b / 0.0 FROM ar ORDER BY ts;
+----
+b / 0.0
+NULL
+NULL
+NULL
+
+SELECT a % 3 FROM ar ORDER BY ts;
+----
+a % 3
+1
+2
+0
+
+SELECT a + b, a - b, a * b FROM ar ORDER BY ts;
+----
+a + b|a - b|a * b
+9.0|5.0|14.0
+-7.0|-7.0|-0.0
+3.5|-3.5|0.0
+
+SELECT abs(a), sign(b) FROM ar ORDER BY ts;
+----
+abs(a)|sign(b)
+7.0|1.0
+7.0|0.0
+0.0|1.0
+
+SELECT round(b / 3.0, 2) FROM ar ORDER BY ts;
+----
+round(b / 3.0, 2)
+0.67
+0.0
+1.17
+
+SELECT power(a, 2), sqrt(abs(a)) FROM ar ORDER BY ts;
+----
+power(a, 2)|sqrt(abs(a))
+49.0|2.64575
+49.0|2.64575
+0.0|0.0
+
+DROP TABLE ar;

@@ -1,0 +1,32 @@
+-- CASE expression edges: searched/simple forms, NULL arms, nesting
+CREATE TABLE cw (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO cw VALUES (1000, 'a', 1.0), (2000, 'b', NULL), (3000, 'c', 30.0);
+
+SELECT g, CASE WHEN v > 10 THEN 'big' WHEN v IS NULL THEN 'none' ELSE 'small' END AS sz FROM cw ORDER BY g;
+----
+g|sz
+a|small
+b|none
+c|big
+
+SELECT g, CASE g WHEN 'a' THEN 1 WHEN 'b' THEN 2 END AS code FROM cw ORDER BY g;
+----
+g|code
+a|1
+b|2
+c|NULL
+
+SELECT g, CASE WHEN v IS NULL THEN NULL ELSE v * 2 END AS dbl FROM cw ORDER BY g;
+----
+g|dbl
+a|2.0
+b|NULL
+c|60.0
+
+SELECT sum(CASE WHEN v > 0 THEN 1 ELSE 0 END) AS positives FROM cw;
+----
+positives
+2
+
+DROP TABLE cw;

@@ -1,0 +1,41 @@
+-- CAST edges: string<->number, timestamp casts, boolean, failures
+CREATE TABLE cr (ts TIMESTAMP TIME INDEX, s STRING, v DOUBLE);
+
+INSERT INTO cr VALUES (1000, '42', 1.9), (2000, '-3.5', 2.1);
+
+SELECT CAST(s AS DOUBLE) FROM cr ORDER BY ts;
+----
+CAST(s AS float64)
+42.0
+-3.5
+
+SELECT CAST(v AS BIGINT) FROM cr ORDER BY ts;
+----
+CAST(v AS int64)
+1
+2
+
+SELECT CAST(v AS STRING) FROM cr ORDER BY ts;
+----
+CAST(v AS string)
+1.9
+2.1
+
+SELECT CAST(1 AS BOOLEAN), CAST(0 AS BOOLEAN);
+----
+CAST(1 AS bool)|CAST(0 AS bool)
+true|false
+
+SELECT s::DOUBLE + 1 FROM cr ORDER BY ts;
+----
+CAST(s AS float64) + 1
+43.0
+-2.5
+
+-- unparsable strings cast to NULL (TRY_CAST-style lenient semantics)
+SELECT CAST('nope' AS DOUBLE);
+----
+CAST('nope' AS float64)
+NULL
+
+DROP TABLE cr;

@@ -1,0 +1,49 @@
+-- COPY TO / COPY FROM round-trips (reference:
+-- tests/cases/standalone/common/copy/)
+CREATE TABLE src_csv (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO src_csv VALUES (1000, 'a', 1.5), (2000, 'b', 2.5), (3000, 'c', NULL);
+
+COPY src_csv TO '/tmp/golden_copy_rt.csv' WITH (format = 'csv');
+----
+affected_rows
+3
+
+CREATE TABLE dst_csv (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+COPY dst_csv FROM '/tmp/golden_copy_rt.csv' WITH (format = 'csv');
+----
+affected_rows
+3
+
+SELECT host, v FROM dst_csv ORDER BY host;
+----
+host|v
+a|1.5
+b|2.5
+c|NULL
+
+COPY src_csv TO '/tmp/golden_copy_rt.parquet' WITH (format = 'parquet');
+----
+affected_rows
+3
+
+CREATE TABLE dst_pq (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+COPY dst_pq FROM '/tmp/golden_copy_rt.parquet' WITH (format = 'parquet');
+----
+affected_rows
+3
+
+SELECT host, v FROM dst_pq ORDER BY host;
+----
+host|v
+a|1.5
+b|2.5
+c|NULL
+
+DROP TABLE src_csv;
+
+DROP TABLE dst_csv;
+
+DROP TABLE dst_pq;

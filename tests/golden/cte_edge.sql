@@ -1,0 +1,26 @@
+-- CTE edges: multiple CTEs, chained references, CTE joined to itself
+CREATE TABLE ce (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO ce VALUES (1000, 'a', 1.0), (2000, 'b', 2.0), (3000, 'c', 3.0);
+
+WITH big AS (SELECT g, v FROM ce WHERE v > 1.0) SELECT g FROM big ORDER BY g;
+----
+g
+b
+c
+
+WITH a AS (SELECT g, v FROM ce), b AS (SELECT g, v * 2 AS w FROM a) SELECT b.g, b.w FROM b ORDER BY b.g;
+----
+g|w
+a|2.0
+b|4.0
+c|6.0
+
+WITH x AS (SELECT g, v FROM ce) SELECT l.g, r.v FROM x l JOIN x r ON l.g = r.g ORDER BY l.g;
+----
+g|v
+a|1.0
+b|2.0
+c|3.0
+
+DROP TABLE ce;

@@ -1,0 +1,14 @@
+-- column DEFAULTs: literals and omitted-column inserts
+CREATE TABLE dv (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE DEFAULT 6.5, n BIGINT DEFAULT 42, s STRING DEFAULT 'none');
+
+INSERT INTO dv (ts, g) VALUES (1000, 'a');
+
+INSERT INTO dv (ts, g, v) VALUES (2000, 'b', 1.0);
+
+SELECT g, v, n, s FROM dv ORDER BY g;
+----
+g|v|n|s
+a|6.5|42|none
+b|1.0|42|none
+
+DROP TABLE dv;

@@ -1,0 +1,36 @@
+-- DELETE edges: predicate forms, delete-all, reinsert after delete
+CREATE TABLE de (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO de VALUES (1000, 'a', 1.0), (2000, 'b', 2.0), (3000, 'c', 3.0);
+
+DELETE FROM de WHERE g = 'b';
+----
+affected_rows
+1
+
+SELECT g FROM de ORDER BY g;
+----
+g
+a
+c
+
+DELETE FROM de WHERE v > 10.0;
+----
+affected_rows
+0
+
+SELECT count(*) FROM de;
+----
+count(*)
+2
+
+INSERT INTO de VALUES (2000, 'b', 20.0);
+
+SELECT g, v FROM de ORDER BY g;
+----
+g|v
+a|1.0
+b|20.0
+c|3.0
+
+DROP TABLE de;

@@ -1,0 +1,30 @@
+-- DISTINCT edges: multi-column, with NULLs, count distinct multiple args
+CREATE TABLE dd (ts TIMESTAMP TIME INDEX, a STRING, b DOUBLE);
+
+INSERT INTO dd VALUES (1000, 'x', 1.0), (2000, 'x', 1.0), (3000, 'x', NULL), (4000, 'y', NULL), (5000, 'y', 2.0);
+
+SELECT DISTINCT a, b FROM dd ORDER BY a, b;
+----
+a|b
+x|1.0
+x|NULL
+y|2.0
+y|NULL
+
+SELECT count(DISTINCT a) FROM dd;
+----
+count(DISTINCT a)
+2
+
+SELECT count(DISTINCT b) FROM dd;
+----
+count(DISTINCT b)
+2
+
+SELECT a, count(DISTINCT b) FROM dd GROUP BY a ORDER BY a;
+----
+a|count(DISTINCT b)
+x|1
+y|1
+
+DROP TABLE dd;

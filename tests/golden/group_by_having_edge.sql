@@ -1,0 +1,39 @@
+-- GROUP BY / HAVING edges: expressions as keys, HAVING on aliases,
+-- HAVING without GROUP BY (reference: common/aggregate/)
+CREATE TABLE gh (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO gh VALUES (1000, 'ax', 1.0), (2000, 'ay', 2.0), (3000, 'bx', 3.0), (4000, 'by', 4.0);
+
+SELECT substr(g, 1, 1) AS fam, sum(v) FROM gh GROUP BY fam ORDER BY fam;
+----
+fam|sum(v)
+a|3.0
+b|7.0
+
+SELECT substr(g, 1, 1) AS fam, count(*) AS n FROM gh GROUP BY fam HAVING n > 1 ORDER BY fam;
+----
+fam|n
+a|2
+b|2
+
+SELECT substr(g, 1, 1) AS fam, sum(v) AS s FROM gh GROUP BY fam HAVING s > 6.0;
+----
+fam|s
+b|7.0
+
+SELECT sum(v) AS total FROM gh HAVING sum(v) > 5.0;
+----
+total
+10.0
+
+SELECT sum(v) AS total FROM gh HAVING sum(v) > 100.0;
+----
+total
+
+SELECT g, avg(v) FROM gh GROUP BY g HAVING avg(v) >= 3.0 ORDER BY g;
+----
+g|avg(v)
+bx|3.0
+by|4.0
+
+DROP TABLE gh;

@@ -1,0 +1,23 @@
+-- information_schema join/filter breadth (reference: common/information_schema/)
+CREATE TABLE isj (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+SELECT t.table_name, c.column_name FROM information_schema.tables t JOIN information_schema.columns c ON c.table_name = t.table_name WHERE t.table_schema = 'public' ORDER BY c.column_name;
+----
+table_name|column_name
+isj|host
+isj|ts
+isj|v
+
+SELECT column_name, semantic_type FROM information_schema.columns WHERE table_name = 'isj' ORDER BY column_name;
+----
+column_name|semantic_type
+host|TAG
+ts|TIMESTAMP
+v|FIELD
+
+SELECT table_name FROM information_schema.tables WHERE table_schema = 'public';
+----
+table_name
+isj
+
+DROP TABLE isj;

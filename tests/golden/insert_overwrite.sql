@@ -1,0 +1,26 @@
+-- last-write-wins upserts: same (tags, ts) key overwrites fields
+CREATE TABLE iw (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, a DOUBLE, b DOUBLE);
+
+INSERT INTO iw VALUES (1000, 'x', 1.0, 10.0);
+
+INSERT INTO iw VALUES (1000, 'x', 2.0, 20.0);
+
+SELECT g, a, b FROM iw;
+----
+g|a|b
+x|2.0|20.0
+
+-- partial-column overwrite nulls the omitted field (last_row mode)
+INSERT INTO iw (ts, g, a) VALUES (1000, 'x', 3.0);
+
+SELECT g, a, b FROM iw;
+----
+g|a|b
+x|3.0|NULL
+
+SELECT count(*) FROM iw;
+----
+count(*)
+1
+
+DROP TABLE iw;

@@ -1,0 +1,28 @@
+-- INTERVAL arithmetic with timestamps (reference: common/types/interval/)
+CREATE TABLE ia (ts TIMESTAMP TIME INDEX, v DOUBLE);
+
+INSERT INTO ia VALUES (3600000, 1.0), (7200000, 2.0);
+
+SELECT ts + INTERVAL '1 hour' FROM ia ORDER BY ts;
+----
+ts + INTERVAL '1 hour'
+7200000
+10800000
+
+SELECT ts - INTERVAL '30 minutes' FROM ia ORDER BY ts;
+----
+ts - INTERVAL '30 minutes'
+1800000
+5400000
+
+SELECT v FROM ia WHERE ts > INTERVAL '30 minutes' + 1800000 ORDER BY ts;
+----
+v
+2.0
+
+SELECT INTERVAL '1 day' + INTERVAL '2 hours';
+----
+INTERVAL '1 day' + INTERVAL '2 hours'
+93600000
+
+DROP TABLE ia;

@@ -1,0 +1,44 @@
+-- join variants: LEFT/RIGHT/FULL/CROSS, USING, non-equi conditions
+CREATE TABLE jl (ts TIMESTAMP TIME INDEX, k STRING PRIMARY KEY, v DOUBLE);
+
+CREATE TABLE jr (ts TIMESTAMP TIME INDEX, k STRING PRIMARY KEY, w DOUBLE);
+
+INSERT INTO jl VALUES (1000, 'a', 1.0), (2000, 'b', 2.0), (3000, 'c', 3.0);
+
+INSERT INTO jr VALUES (1000, 'b', 20.0), (2000, 'c', 30.0), (3000, 'd', 40.0);
+
+SELECT l.k, l.v, r.w FROM jl l LEFT JOIN jr r ON l.k = r.k ORDER BY l.k;
+----
+k|v|w
+a|1.0|NULL
+b|2.0|20.0
+c|3.0|30.0
+
+SELECT l.k, r.k, r.w FROM jl l RIGHT JOIN jr r ON l.k = r.k ORDER BY r.k;
+----
+k|k|w
+b|b|20.0
+c|c|30.0
+NULL|d|40.0
+
+SELECT l.k, r.k FROM jl l FULL JOIN jr r ON l.k = r.k ORDER BY l.k, r.k;
+----
+k|k
+a|NULL
+b|b
+c|c
+NULL|d
+
+SELECT count(*) FROM jl l CROSS JOIN jr r;
+----
+count(*)
+9
+
+SELECT l.k, l.v, r.w FROM jl l JOIN jr r ON l.k = r.k AND r.w > 25.0 ORDER BY l.k;
+----
+k|v|w
+c|3.0|30.0
+
+DROP TABLE jl;
+
+DROP TABLE jr;

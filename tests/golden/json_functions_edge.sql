@@ -1,0 +1,31 @@
+-- JSON function edges: extraction paths, types, invalid docs
+CREATE TABLE jf (ts TIMESTAMP TIME INDEX, doc STRING);
+
+INSERT INTO jf VALUES (1000, '{"a": 1, "b": {"c": "x"}, "arr": [10, 20]}');
+
+SELECT json_get_int(doc, 'a') FROM jf;
+----
+json_get_int(doc, 'a')
+1
+
+SELECT json_get_string(doc, 'b.c') FROM jf;
+----
+json_get_string(doc, 'b.c')
+x
+
+SELECT json_get_int(doc, 'arr[1]') FROM jf;
+----
+json_get_int(doc, 'arr[1]')
+20
+
+SELECT json_get_string(doc, 'missing') FROM jf;
+----
+json_get_string(doc, 'missing')
+NULL
+
+SELECT json_is_object(doc), json_path_exists(doc, 'b.c'), json_path_exists(doc, 'zzz') FROM jf;
+----
+json_is_object(doc)|json_path_exists(doc, 'b.c')|json_path_exists(doc, 'zzz')
+true|true|false
+
+DROP TABLE jf;

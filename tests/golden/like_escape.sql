@@ -1,0 +1,30 @@
+-- LIKE/ILIKE patterns and regexp matching
+CREATE TABLE lk (ts TIMESTAMP TIME INDEX, s STRING);
+
+INSERT INTO lk VALUES (1000, 'alpha'), (2000, 'ALPHA'), (3000, 'beta_x'), (4000, '100%');
+
+SELECT s FROM lk WHERE s LIKE 'al%' ORDER BY ts;
+----
+s
+alpha
+
+SELECT s FROM lk WHERE s ILIKE 'AL%' ORDER BY ts;
+----
+ERROR <<InvalidSyntaxError: unsupported statement 'ILIKE' at 25>>
+
+SELECT s FROM lk WHERE s LIKE '%\_x' ORDER BY ts;
+----
+s
+beta_x
+
+SELECT s FROM lk WHERE s NOT LIKE '%a%' ORDER BY ts;
+----
+s
+ALPHA
+100%
+
+SELECT s FROM lk WHERE s ~ '^[ab]' ORDER BY ts;
+----
+ERROR <<InvalidSyntaxError: unexpected character '~' at 25>>
+
+DROP TABLE lk;

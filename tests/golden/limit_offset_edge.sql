@@ -1,0 +1,38 @@
+-- LIMIT/OFFSET edges: zero, beyond-end, with aggregates and distinct
+CREATE TABLE lo (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO lo VALUES (1000, 'a', 1.0), (2000, 'b', 2.0), (3000, 'c', 3.0);
+
+SELECT g FROM lo ORDER BY g LIMIT 0;
+----
+g
+
+SELECT g FROM lo ORDER BY g LIMIT 10;
+----
+g
+a
+b
+c
+
+SELECT g FROM lo ORDER BY g OFFSET 2;
+----
+g
+c
+
+SELECT g FROM lo ORDER BY g LIMIT 1 OFFSET 5;
+----
+g
+
+SELECT g, sum(v) FROM lo GROUP BY g ORDER BY g LIMIT 2;
+----
+g|sum(v)
+a|1.0
+b|2.0
+
+SELECT DISTINCT g FROM lo ORDER BY g DESC LIMIT 2;
+----
+g
+c
+b
+
+DROP TABLE lo;

@@ -1,0 +1,22 @@
+-- PARTITION ON routing visible through information_schema.partitions
+CREATE TABLE mr (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE) PARTITION ON COLUMNS (host) (host < 'm', host >= 'm');
+
+INSERT INTO mr VALUES (1000, 'alpha', 1.0), (2000, 'zulu', 2.0);
+
+SELECT count(*) FROM mr;
+----
+count(*)
+2
+
+SELECT host FROM mr WHERE host = 'zulu';
+----
+host
+zulu
+
+SELECT partition_name, partition_expression FROM information_schema.partitions WHERE table_name = 'mr' ORDER BY partition_name;
+----
+partition_name|partition_expression
+p0|host < 'm'
+p1|host >= 'm'
+
+DROP TABLE mr;

@@ -1,0 +1,60 @@
+-- three-valued logic in predicates: IN/NOT IN with NULLs, BETWEEN,
+-- IS DISTINCT FROM-style idioms (reference: common/select/, common/types/)
+CREATE TABLE nc (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO nc VALUES (1000, 'a', 1.0), (2000, 'b', NULL), (3000, 'c', 3.0);
+
+SELECT g FROM nc WHERE v IN (1.0, 3.0) ORDER BY g;
+----
+g
+a
+c
+
+SELECT g FROM nc WHERE v NOT IN (1.0) ORDER BY g;
+----
+g
+c
+
+SELECT g FROM nc WHERE v BETWEEN 0.5 AND 2.0 ORDER BY g;
+----
+g
+a
+
+SELECT g FROM nc WHERE NOT (v BETWEEN 0.5 AND 2.0) ORDER BY g;
+----
+g
+c
+
+SELECT g FROM nc WHERE v IS NULL;
+----
+g
+b
+
+SELECT g FROM nc WHERE v IS NOT NULL ORDER BY g;
+----
+g
+a
+c
+
+SELECT g, v = NULL AS eq_null FROM nc ORDER BY g;
+----
+g|eq_null
+a|NULL
+b|NULL
+c|NULL
+
+SELECT g, coalesce(v, -1.0) AS cv FROM nc ORDER BY g;
+----
+g|cv
+a|1.0
+b|-1.0
+c|3.0
+
+SELECT g, nullif(v, 1.0) AS nv FROM nc ORDER BY g;
+----
+g|nv
+a|NULL
+b|NULL
+c|3.0
+
+DROP TABLE nc;

@@ -1,0 +1,81 @@
+-- ORDER BY edge cases: expressions, mixed directions, NULLS placement,
+-- aliases, and ordinal errors (reference: tests/cases/standalone/common/order/)
+CREATE TABLE ob (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE, w BIGINT);
+
+INSERT INTO ob VALUES (1000, 'a', 3.0, 30), (2000, 'b', 1.0, NULL), (3000, 'c', NULL, 10), (4000, 'd', 2.0, 20);
+
+SELECT g, v FROM ob ORDER BY v;
+----
+g|v
+b|1.0
+d|2.0
+a|3.0
+c|NULL
+
+SELECT g, v FROM ob ORDER BY v DESC;
+----
+g|v
+c|NULL
+a|3.0
+d|2.0
+b|1.0
+
+SELECT g, v FROM ob ORDER BY v NULLS FIRST;
+----
+g|v
+c|NULL
+b|1.0
+d|2.0
+a|3.0
+
+SELECT g, v FROM ob ORDER BY v DESC NULLS LAST;
+----
+g|v
+a|3.0
+d|2.0
+b|1.0
+c|NULL
+
+SELECT g, w FROM ob ORDER BY w NULLS FIRST, g DESC;
+----
+g|w
+b|NULL
+c|10
+d|20
+a|30
+
+SELECT g, v * -1 AS neg FROM ob ORDER BY neg;
+----
+g|neg
+a|-3.0
+d|-2.0
+b|-1.0
+c|NULL
+
+SELECT g FROM ob ORDER BY v + w;
+----
+g
+d
+a
+b
+c
+
+SELECT g, v FROM ob ORDER BY upper(g) DESC;
+----
+g|v
+d|2.0
+c|NULL
+b|1.0
+a|3.0
+
+SELECT g FROM ob ORDER BY missing_col;
+----
+ERROR
+
+SELECT g, v FROM ob ORDER BY v LIMIT 2 OFFSET 1;
+----
+g|v
+d|2.0
+a|3.0
+
+DROP TABLE ob;

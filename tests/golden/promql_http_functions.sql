@@ -1,0 +1,36 @@
+-- TQL scalar functions over instant vectors
+CREATE TABLE pf (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, val DOUBLE);
+
+INSERT INTO pf VALUES (0, 'a', -2.5), (0, 'b', 7.9);
+
+TQL EVAL (0, 0, '10s') abs(pf);
+----
+ts|value|host
+0|2.5|a
+0|7.9|b
+
+TQL EVAL (0, 0, '10s') ceil(pf);
+----
+ts|value|host
+0|-2.0|a
+0|8.0|b
+
+TQL EVAL (0, 0, '10s') floor(pf);
+----
+ts|value|host
+0|-3.0|a
+0|7.0|b
+
+TQL EVAL (0, 0, '10s') clamp(pf, 0, 5);
+----
+ts|value|host
+0|0.0|a
+0|5.0|b
+
+TQL EVAL (0, 0, '10s') sgn(pf);
+----
+ts|value|host
+0|-1.0|a
+0|1.0|b
+
+DROP TABLE pf;

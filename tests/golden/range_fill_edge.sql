@@ -1,0 +1,62 @@
+-- RANGE FILL edges: prev/linear/const/null across gaps, per-item override
+CREATE TABLE rf (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO rf VALUES (0, 'a', 1.0), (30000, 'a', 4.0), (0, 'b', 10.0), (10000, 'b', 20.0);
+
+SELECT ts, host, avg(v) RANGE '10s' FROM rf ALIGN '10s' ORDER BY host, ts;
+----
+ts|host|avg(v) RANGE 10000ms
+0|a|1.0
+30000|a|4.0
+0|b|10.0
+10000|b|20.0
+
+SELECT ts, host, avg(v) RANGE '10s' FILL PREV FROM rf ALIGN '10s' ORDER BY host, ts;
+----
+ts|host|avg(v) RANGE 10000ms
+0|a|1.0
+10000|a|1.0
+20000|a|1.0
+30000|a|4.0
+0|b|10.0
+10000|b|20.0
+20000|b|20.0
+30000|b|20.0
+
+SELECT ts, host, avg(v) RANGE '10s' FILL LINEAR FROM rf ALIGN '10s' ORDER BY host, ts;
+----
+ts|host|avg(v) RANGE 10000ms
+0|a|1.0
+10000|a|2.0
+20000|a|3.0
+30000|a|4.0
+0|b|10.0
+10000|b|20.0
+20000|b|20.0
+30000|b|20.0
+
+SELECT ts, host, avg(v) RANGE '10s' FILL 6.28 FROM rf ALIGN '10s' ORDER BY host, ts;
+----
+ts|host|avg(v) RANGE 10000ms
+0|a|1.0
+10000|a|6.28
+20000|a|6.28
+30000|a|4.0
+0|b|10.0
+10000|b|20.0
+20000|b|6.28
+30000|b|6.28
+
+SELECT ts, host, max(v) RANGE '10s' FILL PREV, min(v) RANGE '10s' FILL NULL FROM rf ALIGN '10s' ORDER BY host, ts;
+----
+ts|host|max(v) RANGE 10000ms|min(v) RANGE 10000ms
+0|a|1.0|1.0
+10000|a|1.0|NULL
+20000|a|1.0|NULL
+30000|a|4.0|4.0
+0|b|10.0|10.0
+10000|b|20.0|20.0
+20000|b|20.0|NULL
+30000|b|20.0|NULL
+
+DROP TABLE rf;

@@ -1,0 +1,29 @@
+-- RANGE ALIGN TO anchors and BY subsets
+CREATE TABLE ra (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, dc STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO ra VALUES (0, 'h1', 'e', 1.0), (3600000, 'h1', 'e', 2.0), (0, 'h2', 'w', 10.0), (3600000, 'h2', 'w', 20.0);
+
+SELECT ts, dc, sum(v) RANGE '1h' FROM ra ALIGN '1h' BY (dc) ORDER BY ts, dc;
+----
+ts|dc|sum(v) RANGE 3600000ms
+0|e|1.0
+0|w|10.0
+3600000|e|2.0
+3600000|w|20.0
+
+SELECT ts, sum(v) RANGE '2h' FROM ra ALIGN '1h' BY () ORDER BY ts;
+----
+ts|sum(v) RANGE 7200000ms
+-3600000|11.0
+0|33.0
+3600000|22.0
+
+SELECT ts, host, dc, avg(v) RANGE '1h' FROM ra ALIGN '1h' TO '1970-01-01 00:30:00' ORDER BY ts, host;
+----
+ts|host|dc|avg(v) RANGE 3600000ms
+-1800000|h1|e|1.0
+-1800000|h2|w|10.0
+1800000|h1|e|2.0
+1800000|h2|w|20.0
+
+DROP TABLE ra;

@@ -1,0 +1,47 @@
+-- SHOW CREATE TABLE reflects ALTERs (reference: common/show/)
+CREATE TABLE sca (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+ALTER TABLE sca ADD COLUMN w BIGINT;
+
+SHOW CREATE TABLE sca;
+----
+Table|Create Table
+sca|CREATE TABLE IF NOT EXISTS `sca` (
+  `ts` TIMESTAMP(3) NOT NULL,
+  `host` STRING NOT NULL,
+  `v` DOUBLE,
+  `w` BIGINT,
+  TIME INDEX (`ts`),
+  PRIMARY KEY (`host`)
+)
+ENGINE=mito
+
+ALTER TABLE sca DROP COLUMN w;
+
+SHOW CREATE TABLE sca;
+----
+Table|Create Table
+sca|CREATE TABLE IF NOT EXISTS `sca` (
+  `ts` TIMESTAMP(3) NOT NULL,
+  `host` STRING NOT NULL,
+  `v` DOUBLE,
+  TIME INDEX (`ts`),
+  PRIMARY KEY (`host`)
+)
+ENGINE=mito
+
+ALTER TABLE sca RENAME sca2;
+
+SHOW CREATE TABLE sca2;
+----
+Table|Create Table
+sca2|CREATE TABLE IF NOT EXISTS `sca2` (
+  `ts` TIMESTAMP(3) NOT NULL,
+  `host` STRING NOT NULL,
+  `v` DOUBLE,
+  TIME INDEX (`ts`),
+  PRIMARY KEY (`host`)
+)
+ENGINE=mito
+
+DROP TABLE sca2;

@@ -1,0 +1,52 @@
+-- string function edges: empty strings, unicode, padding, split
+-- (reference: common/function/)
+CREATE TABLE se (ts TIMESTAMP TIME INDEX, s STRING);
+
+INSERT INTO se VALUES (1000, 'Hello World'), (2000, ''), (3000, 'héllo');
+
+SELECT length(s), upper(s) FROM se ORDER BY ts;
+----
+length(s)|upper(s)
+11|HELLO WORLD
+0|
+5|HÉLLO
+
+SELECT substr(s, 1, 5), replace(s, 'l', 'L') FROM se ORDER BY ts;
+----
+substr(s, 1, 5)|replace(s, 'l', 'L')
+Hello|HeLLo WorLd
+|
+héllo|héLLo
+
+SELECT trim('  pad  '), lpad('7', 3, '0'), rpad('7', 3, '.');
+----
+trim('  pad  ')|lpad('7', 3, '0')|rpad('7', 3, '.')
+pad|007|7..
+
+SELECT concat(s, '!'), reverse(s) FROM se ORDER BY ts;
+----
+concat(s, '!')|reverse(s)
+Hello World!|dlroW olleH
+!|
+héllo!|olléh
+
+SELECT split_part('a,b,c', ',', 2);
+----
+split_part('a,b,c', ',', 2)
+b
+
+SELECT starts_with(s, 'He'), ends_with(s, 'ld') FROM se ORDER BY ts;
+----
+starts_with(s, 'He')|ends_with(s, 'ld')
+true|true
+false|false
+false|false
+
+SELECT strpos(s, 'World') FROM se ORDER BY ts;
+----
+strpos(s, 'World')
+7
+0
+0
+
+DROP TABLE se;

@@ -1,0 +1,30 @@
+-- subquery edges: scalar subquery in WHERE/items, IN subquery, derived tables
+CREATE TABLE sq (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO sq VALUES (1000, 'a', 1.0), (2000, 'b', 2.0), (3000, 'c', 3.0);
+
+SELECT g FROM sq WHERE v > (SELECT avg(v) FROM sq) ORDER BY g;
+----
+g
+c
+
+SELECT g, (SELECT max(v) FROM sq) AS mx FROM sq ORDER BY g;
+----
+g|mx
+a|3.0
+b|3.0
+c|3.0
+
+SELECT g FROM sq WHERE g IN (SELECT g FROM sq WHERE v >= 2.0) ORDER BY g;
+----
+g
+b
+c
+
+SELECT t.g, t.w FROM (SELECT g, v * 2 AS w FROM sq) t WHERE t.w > 2.0 ORDER BY t.g;
+----
+g|w
+b|4.0
+c|6.0
+
+DROP TABLE sq;

@@ -1,0 +1,43 @@
+-- timestamp function edges: date_trunc/date_bin/extract/formatting
+-- (reference: common/timestamp/, common/function/)
+CREATE TABLE tf (ts TIMESTAMP TIME INDEX, v DOUBLE);
+
+INSERT INTO tf VALUES (1705329015123, 1.0), (1705332615000, 2.0);
+
+SELECT date_trunc('hour', ts) FROM tf ORDER BY ts;
+----
+date_trunc('hour', ts)
+1705327200000
+1705330800000
+
+SELECT date_trunc('day', ts) FROM tf ORDER BY ts;
+----
+date_trunc('day', ts)
+1705276800000
+1705276800000
+
+SELECT date_bin('30 minutes', ts) FROM tf ORDER BY ts;
+----
+date_bin(INTERVAL '30 minutes', ts)
+1705329000000
+1705332600000
+
+SELECT extract(hour FROM ts), extract(minute FROM ts) FROM tf ORDER BY ts;
+----
+extract('hour', ts)|extract('minute', ts)
+14.0|30.0
+15.0|30.0
+
+SELECT to_unixtime(ts) FROM tf ORDER BY ts;
+----
+to_unixtime(ts)
+1705329015
+1705332615
+
+SELECT date_format(ts, '%Y-%m-%d %H:%M:%S') FROM tf ORDER BY ts;
+----
+date_format(ts, '%Y-%m-%d %H:%M:%S')
+2024-01-15 14:30:15
+2024-01-15 15:30:15
+
+DROP TABLE tf;

@@ -1,0 +1,19 @@
+-- TQL EXPLAIN / ANALYZE output shape (reference:
+-- tests/cases/standalone/common/tql-explain-analyze/)
+CREATE TABLE m (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, val DOUBLE);
+
+INSERT INTO m VALUES (0, 'a', 1.0), (10000, 'a', 2.0), (0, 'b', 5.0);
+
+TQL EVAL (0, 10, '10s') m;
+----
+ts|value|__name__|host
+0|1.0|m|a
+0|5.0|m|b
+10000|2.0|m|a
+10000|5.0|m|b
+
+TQL EVAL (0, 10, '10s') sum(m);
+----
+ts|value
+0|6.0
+10000|7.0

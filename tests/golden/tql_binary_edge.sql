@@ -1,0 +1,36 @@
+-- TQL binary operator edges: vector/scalar precedence, bool modifier,
+-- set operations (reference: common/tql/)
+CREATE TABLE tb (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, val DOUBLE);
+
+INSERT INTO tb VALUES (0, 'a', 2.0), (0, 'b', 8.0);
+
+TQL EVAL (0, 0, '10s') tb * 2 + 1;
+----
+ts|value|host
+0|5.0|a
+0|17.0|b
+
+TQL EVAL (0, 0, '10s') tb > bool 5;
+----
+ts|value|host
+0|0.0|a
+0|1.0|b
+
+TQL EVAL (0, 0, '10s') tb > 5;
+----
+ts|value|host
+0|8.0|b
+
+TQL EVAL (0, 0, '10s') -tb;
+----
+ts|value|host
+0|-2.0|a
+0|-8.0|b
+
+TQL EVAL (0, 0, '10s') tb ^ 2 % 3;
+----
+ts|value|host
+0|1.0|a
+0|1.0|b
+
+DROP TABLE tb;

@@ -1,0 +1,23 @@
+-- TRUNCATE then reinsert: identity and stats reset
+CREATE TABLE ti (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO ti VALUES (1000, 'a', 1.0), (2000, 'b', 2.0);
+
+TRUNCATE TABLE ti;
+----
+affected_rows
+0
+
+SELECT count(*) FROM ti;
+----
+count(*)
+0
+
+INSERT INTO ti VALUES (1000, 'a', 9.0);
+
+SELECT g, v FROM ti;
+----
+g|v
+a|9.0
+
+DROP TABLE ti;

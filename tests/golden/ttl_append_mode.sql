@@ -1,0 +1,19 @@
+-- table options surface: append_mode duplicates, SHOW CREATE carries options
+CREATE TABLE am (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE) WITH (append_mode = 'true');
+
+INSERT INTO am VALUES (1000, 'a', 1.0);
+
+INSERT INTO am VALUES (1000, 'a', 2.0);
+
+SELECT g, v FROM am ORDER BY v;
+----
+g|v
+a|1.0
+a|2.0
+
+SELECT count(*) FROM am;
+----
+count(*)
+2
+
+DROP TABLE am;

@@ -1,0 +1,32 @@
+-- view edges: view over view, view with expressions, drop behavior
+CREATE TABLE ve (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO ve VALUES (1000, 'a', 1.0), (2000, 'b', 2.0);
+
+CREATE VIEW v_base AS SELECT g, v * 10 AS v10 FROM ve;
+
+CREATE VIEW v_top AS SELECT g, v10 + 1 AS v11 FROM v_base;
+
+SELECT g, v11 FROM v_top ORDER BY g;
+----
+g|v11
+a|11.0
+b|21.0
+
+CREATE OR REPLACE VIEW v_base AS SELECT g, v * 100 AS v10 FROM ve;
+
+SELECT g, v11 FROM v_top ORDER BY g;
+----
+g|v11
+a|101.0
+b|201.0
+
+DROP VIEW v_top;
+
+SELECT g FROM v_top;
+----
+ERROR
+
+DROP VIEW v_base;
+
+DROP TABLE ve;

@@ -1,0 +1,51 @@
+-- window functions: lag/lead defaults, ntile, first/last in partition
+CREATE TABLE wp (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO wp VALUES (1000, 'a', 1.0), (2000, 'a', 2.0), (3000, 'a', 3.0), (1000, 'b', 10.0), (2000, 'b', 20.0);
+
+SELECT g, ts, lag(v) OVER (PARTITION BY g ORDER BY ts) AS prev FROM wp ORDER BY g, ts;
+----
+g|ts|prev
+a|1000|NULL
+a|2000|1.0
+a|3000|2.0
+b|1000|NULL
+b|2000|10.0
+
+SELECT g, ts, lead(v, 1, -1.0) OVER (PARTITION BY g ORDER BY ts) AS nxt FROM wp ORDER BY g, ts;
+----
+g|ts|nxt
+a|1000|2.0
+a|2000|3.0
+a|3000|-1.0
+b|1000|20.0
+b|2000|-1.0
+
+SELECT g, ts, ntile(2) OVER (PARTITION BY g ORDER BY ts) AS bucket FROM wp ORDER BY g, ts;
+----
+g|ts|bucket
+a|1000|1
+a|2000|1
+a|3000|2
+b|1000|1
+b|2000|2
+
+SELECT g, ts, row_number() OVER (ORDER BY v DESC) AS rn FROM wp ORDER BY rn;
+----
+g|ts|rn
+b|2000|1
+b|1000|2
+a|3000|3
+a|2000|4
+a|1000|5
+
+SELECT g, ts, first_value(v) OVER (PARTITION BY g ORDER BY ts) AS fv FROM wp ORDER BY g, ts;
+----
+g|ts|fv
+a|1000|1.0
+a|2000|1.0
+a|3000|1.0
+b|1000|10.0
+b|2000|10.0
+
+DROP TABLE wp;

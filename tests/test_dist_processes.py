@@ -486,7 +486,7 @@ def test_flownode_crash_mirror_replay(tmp_path):
             except Exception:
                 return []
 
-        deadline = time.time() + 90
+        deadline = time.time() + 180  # generous: 1-core CI under load
         while time.time() < deadline:
             if sink_rows() == [["a", 1, 1.0]]:
                 break
@@ -507,7 +507,7 @@ def test_flownode_crash_mirror_replay(tmp_path):
         _wait_port(flow_port)
         # a post-restart insert triggers the backlog replay
         _sql(fe, "insert into src values ('b', 7.0, 1700000003000)")
-        deadline = time.time() + 120
+        deadline = time.time() + 180
         want = [["a", 2, 3.0], ["b", 2, 12.0]]
         got = []
         while time.time() < deadline:
