@@ -144,6 +144,30 @@ def eval_scalar_function(e: A.FuncCall, src: ColumnSource) -> Col:
     if name == "from_unixtime":
         c = eval_expr(args[0], src)
         return Col(c.values.astype(np.int64) * 1000, c.validity)
+    if name in ("to_timestamp", "to_timestamp_seconds"):
+        # seconds (or a parsable string) -> timestamp ms (reference:
+        # DataFusion to_timestamp family)
+        c = eval_expr(args[0], src)
+        if c.values.dtype == object:
+            vals = _ts_ms(c)  # string parse yields ms directly
+        else:
+            vals = (c.values.astype(np.float64) * 1000).astype(np.int64)
+        return Col(vals, c.validity)
+    if name == "to_timestamp_millis":
+        c = eval_expr(args[0], src)
+        return Col(_ts_ms(c), c.validity)
+    if name in ("date_add", "date_sub"):
+        # date_add(ts, interval) / date_sub(ts, interval) — the
+        # reference's scalars/date.rs pair
+        if len(args) != 2:
+            raise PlanError(f"{name}(ts, interval)")
+        from greptimedb_tpu.query.expr import _merge_validity
+
+        c = eval_expr(args[0], src)
+        iv = eval_expr(args[1], src)
+        delta = iv.values.astype(np.int64)
+        sign = 1 if name == "date_add" else -1
+        return Col(_ts_ms(c) + sign * delta, _merge_validity(c, iv))
     if name == "date_format":
         c = eval_expr(args[0], src)
         fmt = str(_const_arg(args[1]))

@@ -1,0 +1,21 @@
+-- aggregates over empty inputs and all-NULL groups
+CREATE TABLE eg (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+SELECT count(*), count(v), sum(v), min(v), avg(v) FROM eg;
+----
+count(*)|count(v)|sum(v)|min(v)|avg(v)
+0|0|NULL|NULL|NULL
+
+INSERT INTO eg (ts, g) VALUES (1000, 'a'), (2000, 'a');
+
+SELECT g, count(*), count(v), sum(v), max(v) FROM eg GROUP BY g;
+----
+g|count(*)|count(v)|sum(v)|max(v)
+a|2|0|NULL|NULL
+
+SELECT count(*) FROM eg WHERE v > 100;
+----
+count(*)
+0
+
+DROP TABLE eg;

@@ -1,0 +1,33 @@
+-- metric engine: many logical tables multiplexed over one physical
+-- region pair (reference: src/metric-engine/)
+CREATE TABLE phys (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, greptime_value DOUBLE) WITH (physical_metric_table = 'true');
+
+CREATE TABLE api_requests (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, greptime_value DOUBLE) WITH (on_physical_table = 'phys');
+
+CREATE TABLE api_errors (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, greptime_value DOUBLE) WITH (on_physical_table = 'phys');
+
+INSERT INTO api_requests VALUES (1000, 'a', 100.0), (2000, 'b', 200.0);
+
+INSERT INTO api_errors VALUES (1000, 'a', 3.0);
+
+SELECT host, greptime_value FROM api_requests ORDER BY host;
+----
+host|greptime_value
+a|100.0
+b|200.0
+
+SELECT host, greptime_value FROM api_errors ORDER BY host;
+----
+host|greptime_value
+a|3.0
+
+SELECT count(*) FROM api_requests;
+----
+count(*)
+2
+
+DROP TABLE api_requests;
+
+DROP TABLE api_errors;
+
+DROP TABLE phys;

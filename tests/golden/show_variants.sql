@@ -1,0 +1,30 @@
+-- SHOW family variants with LIKE/WHERE filters
+CREATE TABLE alpha (ts TIMESTAMP TIME INDEX, v DOUBLE);
+
+CREATE TABLE beta (ts TIMESTAMP TIME INDEX, v DOUBLE);
+
+SHOW TABLES;
+----
+Tables
+alpha
+beta
+
+SHOW TABLES LIKE 'al%';
+----
+Tables
+alpha
+
+SHOW DATABASES;
+----
+Database
+public
+
+SHOW FULL TABLES;
+----
+Tables
+alpha
+beta
+
+DROP TABLE alpha;
+
+DROP TABLE beta;

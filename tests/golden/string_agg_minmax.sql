@@ -1,0 +1,17 @@
+-- string min/max aggregates (lexicographic, typed output)
+CREATE TABLE sm (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, name STRING);
+
+INSERT INTO sm VALUES (1000, 'x', 'zebra'), (2000, 'x', 'ant'), (3000, 'y', 'mole');
+
+SELECT g, min(name), max(name) FROM sm GROUP BY g ORDER BY g;
+----
+g|min(name)|max(name)
+x|ant|zebra
+y|mole|mole
+
+SELECT min(name) FROM sm;
+----
+min(name)
+ant
+
+DROP TABLE sm;

@@ -1,0 +1,23 @@
+-- ORDER BY/LIMIT inside derived tables vs outer ordering
+CREATE TABLE sol (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO sol VALUES (1000, 'a', 5.0), (2000, 'b', 1.0), (3000, 'c', 3.0), (4000, 'd', 4.0);
+
+SELECT t.g FROM (SELECT g, v FROM sol ORDER BY v DESC LIMIT 2) t ORDER BY t.g;
+----
+g
+a
+d
+
+SELECT t.g, t.v FROM (SELECT g, v FROM sol WHERE v > 1.5) t ORDER BY t.v LIMIT 2;
+----
+g|v
+c|3.0
+d|4.0
+
+SELECT count(*) FROM (SELECT DISTINCT g FROM sol) d;
+----
+count(*)
+4
+
+DROP TABLE sol;

@@ -1,0 +1,30 @@
+-- timestamp constructors and conversions
+SELECT to_timestamp(1705329015);
+----
+to_timestamp(1705329015)
+1705329015000
+
+SELECT to_timestamp_millis(1705329015123);
+----
+to_timestamp_millis(1705329015123)
+1705329015123
+
+SELECT greatest(1, 2, 3), least(4.5, 2.5);
+----
+greatest(1, 2, 3)|least(4.5, 2.5)
+3.0|2.5
+
+SELECT now() > to_timestamp(0);
+----
+now() > to_timestamp(0)
+true
+
+SELECT date_add(to_timestamp_millis(0), INTERVAL '1 day');
+----
+date_add(to_timestamp_millis(0), INTERVAL '1 day')
+86400000
+
+SELECT date_sub(to_timestamp_millis(86400000), INTERVAL '12 hours');
+----
+date_sub(to_timestamp_millis(86400000), INTERVAL '12 hours')
+43200000

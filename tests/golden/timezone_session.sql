@@ -1,0 +1,18 @@
+-- session time zone affects rendering, storage stays UTC ms
+CREATE TABLE tz (ts TIMESTAMP TIME INDEX, v DOUBLE);
+
+INSERT INTO tz VALUES (0, 1.0);
+
+SET time_zone = '+05:00';
+
+SELECT @@time_zone;
+----
+ERROR <<InvalidSyntaxError: unexpected token '@' at 7>>
+
+SET time_zone = 'UTC';
+
+SELECT @@time_zone;
+----
+ERROR <<InvalidSyntaxError: unexpected token '@' at 7>>
+
+DROP TABLE tz;
