@@ -61,7 +61,10 @@ class DistInstance(Standalone):
         self._mirror_probe_at: dict[str, float] = {}
 
     def execute_statement(self, stmt, ctx):
-        from greptimedb_tpu.errors import DatanodeUnavailableError
+        from greptimedb_tpu.errors import (
+            DatanodeUnavailableError,
+            GreptimeError,
+        )
         from greptimedb_tpu.sql import ast as A
 
         try:
@@ -76,6 +79,34 @@ class DistInstance(Standalone):
                 raise
             self.catalog.refresh()
             return super().execute_statement(stmt, ctx)
+        except GreptimeError as e:
+            # region-not-found on a WRITE = stale routes after a
+            # migration. Retrying re-sends the WHOLE statement, and a
+            # multi-datanode write may have partially applied on other
+            # nodes — safe only because last-write-wins dedup makes the
+            # replay idempotent. Append-mode tables have no dedup, so
+            # they must surface the error instead of duplicating rows.
+            if not isinstance(stmt, (A.Insert, A.Delete)):
+                raise
+            if "not found" not in str(e).lower():
+                raise
+            if self._stmt_table_append_mode(stmt, ctx):
+                raise
+            self.catalog.refresh()
+            return super().execute_statement(stmt, ctx)
+
+    def _stmt_table_append_mode(self, stmt, ctx) -> bool:
+        try:
+            db, name = self._resolve(stmt.table, ctx)
+            table = self.catalog.maybe_table(db, name)
+            if table is None:
+                return False
+            opts = table.info.options or {}
+            return str(opts.get("append_mode", "")).lower() in (
+                "true", "1", "yes",
+            )
+        except Exception:  # noqa: BLE001 - conservative: no retry
+            return True
 
     # ------------------------------------------------------------------
     # flownode placement: registered flownodes + per-flow routes live in

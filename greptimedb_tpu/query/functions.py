@@ -26,6 +26,45 @@ _TRUNC_UNIT_MS = {
 }
 
 
+def _interval_months(arg) -> int | None:
+    """Total months when `arg` is an INTERVAL literal made ONLY of
+    year/month units (calendar arithmetic applies); None otherwise."""
+    import re as _re
+
+    if not isinstance(arg, A.IntervalLit):
+        return None
+    raw = (arg.raw or "").lower()
+    parts = _re.findall(r"(\d+)\s*([a-z]+)", raw)
+    if not parts:
+        return None
+    months = 0
+    for num, unit in parts:
+        if unit.startswith("year") or unit == "y":
+            months += int(num) * 12
+        elif unit.startswith("mon"):
+            months += int(num)
+        else:
+            return None  # mixed/time units: fixed-span ms path
+    return months
+
+
+def _add_months(ts_ms: np.ndarray, months: int) -> np.ndarray:
+    """Calendar month addition with end-of-month day clamping, fully
+    vectorized over numpy datetime64."""
+    dt = ts_ms.astype("datetime64[ms]")
+    month0 = dt.astype("datetime64[M]")
+    intra = (dt - month0).astype("timedelta64[ms]").astype(np.int64)
+    new_month = month0 + np.timedelta64(months, "M")
+    mlen_ms = ((new_month + np.timedelta64(1, "M")).astype("datetime64[ms]")
+               - new_month.astype("datetime64[ms]")
+               ).astype("timedelta64[ms]").astype(np.int64)
+    day_ms = 86_400_000
+    days = np.minimum(intra // day_ms, mlen_ms // day_ms - 1)
+    tod = intra % day_ms
+    return (new_month.astype("datetime64[ms]").astype(np.int64)
+            + days * day_ms + tod)
+
+
 def _ts_ms(c: Col) -> np.ndarray:
     if c.values.dtype == object:
         return np.asarray([parse_ts_literal(str(v)) for v in c.values], np.int64)
@@ -158,15 +197,21 @@ def eval_scalar_function(e: A.FuncCall, src: ColumnSource) -> Col:
         return Col(_ts_ms(c), c.validity)
     if name in ("date_add", "date_sub"):
         # date_add(ts, interval) / date_sub(ts, interval) — the
-        # reference's scalars/date.rs pair
+        # reference's scalars/date.rs pair. Pure month/year intervals
+        # use CALENDAR arithmetic with end-of-month clamping (Jan 31 +
+        # 1 month = Feb 29), not a fixed 30-day span.
         if len(args) != 2:
             raise PlanError(f"{name}(ts, interval)")
         from greptimedb_tpu.query.expr import _merge_validity
 
         c = eval_expr(args[0], src)
+        sign = 1 if name == "date_add" else -1
+        months = _interval_months(args[1])
+        if months is not None:
+            out = _add_months(_ts_ms(c), sign * months)
+            return Col(out, c.validity)
         iv = eval_expr(args[1], src)
         delta = iv.values.astype(np.int64)
-        sign = 1 if name == "date_add" else -1
         return Col(_ts_ms(c) + sign * delta, _merge_validity(c, iv))
     if name == "date_format":
         c = eval_expr(args[0], src)

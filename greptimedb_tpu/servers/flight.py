@@ -405,12 +405,19 @@ class FlightServer(flight.FlightServerBase):
 
     def _do_put_regions(self, reader):
         """Per-region columnar writes: each batch's app_metadata names
-        the target region (RegionPutRequest analog)."""
+        the target region (RegionPutRequest analog). The whole stream is
+        decoded and its region ids VALIDATED before anything applies,
+        so route staleness (a region migrated away) usually rejects the
+        stream before any write. This is best-effort, not transactional
+        (a concurrent close can still land mid-apply); the frontend's
+        refresh-and-retry therefore relies on last-write-wins dedup for
+        idempotence and refuses to retry append-mode tables."""
         import json
 
         from greptimedb_tpu.dist import codec as dist_codec
 
         rs = self._region_server()
+        batches = []
         for chunk in reader:
             if chunk.data is None:
                 continue
@@ -418,17 +425,20 @@ class FlightServer(flight.FlightServerBase):
                 chunk.app_metadata.to_pybytes()
                 if chunk.app_metadata else b"{}"
             )
-            tag_columns, ts, fields, valids = dist_codec.batch_to_write(
-                chunk.data
+            batches.append(
+                (meta, dist_codec.batch_to_write(chunk.data))
             )
-            try:
+        try:
+            for meta, _decoded in batches:
+                rs._region(int(meta["region_id"]))  # not-found raises
+            for meta, (tag_columns, ts, fields, valids) in batches:
                 rs.write(
                     int(meta["region_id"]), tag_columns, ts, fields,
                     valids, op=int(meta.get("op", 0) or 0),
                     skip_wal=bool(meta.get("skip_wal", False)),
                 )
-            except Exception as e:  # noqa: BLE001 - RPC boundary
-                raise flight.FlightServerError(str(e)) from e
+        except Exception as e:  # noqa: BLE001 - RPC boundary
+            raise flight.FlightServerError(str(e)) from e
 
 
 class FlightFrontend:

@@ -1,18 +1,21 @@
--- session time zone affects rendering, storage stays UTC ms
-CREATE TABLE tz (ts TIMESTAMP TIME INDEX, v DOUBLE);
-
-INSERT INTO tz VALUES (0, 1.0);
-
+-- session time-zone variable round-trips through SET / SHOW VARIABLES
 SET time_zone = '+05:00';
 
-SELECT @@time_zone;
+SHOW VARIABLES LIKE 'time_zone';
 ----
-ERROR <<InvalidSyntaxError: unexpected token '@' at 7>>
+Variable_name|Value
+time_zone|+05:00
 
 SET time_zone = 'UTC';
 
-SELECT @@time_zone;
+SHOW VARIABLES LIKE 'time_zone';
 ----
-ERROR <<InvalidSyntaxError: unexpected token '@' at 7>>
+Variable_name|Value
+time_zone|UTC
 
-DROP TABLE tz;
+SET SESSION read_preference = 'leader';
+
+SHOW VARIABLES LIKE 'read_preference';
+----
+Variable_name|Value
+read_preference|leader

@@ -21,12 +21,13 @@ from greptimedb_tpu.servers.meta_http import MetasrvServer
 from greptimedb_tpu.storage.engine import EngineConfig
 
 
-def _make_datanode(tmp_path, i):
+def _make_datanode(tmp_path, i, *, store=None, wal_backend="fs"):
     home = str(tmp_path / f"dn{i}")
     inst = Standalone(
         engine_config=EngineConfig(data_root=home,
-                                   enable_background=False),
-        prefer_device=False, warm_start=False,
+                                   enable_background=False,
+                                   wal_backend=wal_backend),
+        prefer_device=False, warm_start=False, store=store,
     )
     inst.region_server = RegionServer(inst.engine, home)
     fs = FlightFrontend(inst, port=0).start()
@@ -34,8 +35,15 @@ def _make_datanode(tmp_path, i):
 
 
 class DistHarness:
-    def __init__(self, tmp_path, n_datanodes=3):
+    """In-process wire topology: metasrv HTTP + datanode Flight servers
+    over real sockets. `store`/`wal_backend` build shared-storage
+    clusters (failover/migration tests)."""
+
+    def __init__(self, tmp_path, n_datanodes=3, *, store=None,
+                 wal_backend="fs"):
         self.tmp_path = tmp_path
+        self.store = store
+        self.wal_backend = wal_backend
         self.meta = MetasrvServer(
             addr="127.0.0.1", port=0, data_home=str(tmp_path / "meta")
         ).start()
@@ -48,7 +56,8 @@ class DistHarness:
         )
 
     def start_datanode(self, i):
-        inst, fs = _make_datanode(self.tmp_path, i)
+        inst, fs = _make_datanode(self.tmp_path, i, store=self.store,
+                                  wal_backend=self.wal_backend)
         MetaClient(self.meta_addr).register(
             i, f"127.0.0.1:{fs.server.port}"
         )
@@ -819,32 +828,7 @@ def test_wire_failover_moves_regions_to_live_datanode(tmp_path):
     from greptimedb_tpu.storage.object_store import FsObjectStore
 
     shared = FsObjectStore(str(tmp_path / "shared_store"))
-    h = DistHarness.__new__(DistHarness)
-    h.tmp_path = tmp_path
-    h.meta = MetasrvServer(
-        addr="127.0.0.1", port=0, data_home=str(tmp_path / "meta")
-    ).start()
-    h.meta_addr = f"127.0.0.1:{h.meta.port}"
-    h.datanodes = {}
-
-    def start_dn(i):
-        home = str(tmp_path / f"dn{i}")
-        inst = Standalone(
-            engine_config=EngineConfig(data_root=home,
-                                       enable_background=False),
-            prefer_device=False, warm_start=False, store=shared,
-        )
-        inst.region_server = RegionServer(inst.engine, home)
-        fs = FlightFrontend(inst, port=0).start()
-        MetaClient(h.meta_addr).register(
-            i, f"127.0.0.1:{fs.server.port}"
-        )
-        h.datanodes[i] = (inst, fs)
-
-    for i in range(3):
-        start_dn(i)
-    h.frontend = DistInstance(str(tmp_path / "fe"), h.meta_addr,
-                              prefer_device=False)
+    h = DistHarness(tmp_path, store=shared)
     try:
         fe = h.frontend
         fe.execute_sql(
@@ -893,28 +877,7 @@ def test_wire_graceful_migration_carries_unflushed_rows(tmp_path):
     from greptimedb_tpu.storage.object_store import FsObjectStore
 
     shared = FsObjectStore(str(tmp_path / "shared_store"))
-    h = DistHarness.__new__(DistHarness)
-    h.tmp_path = tmp_path
-    h.meta = MetasrvServer(
-        addr="127.0.0.1", port=0, data_home=str(tmp_path / "meta")
-    ).start()
-    h.meta_addr = f"127.0.0.1:{h.meta.port}"
-    h.datanodes = {}
-    for i in range(2):
-        home = str(tmp_path / f"dn{i}")
-        inst = Standalone(
-            engine_config=EngineConfig(data_root=home,
-                                       enable_background=False),
-            prefer_device=False, warm_start=False, store=shared,
-        )
-        inst.region_server = RegionServer(inst.engine, home)
-        fs = FlightFrontend(inst, port=0).start()
-        MetaClient(h.meta_addr).register(
-            i, f"127.0.0.1:{fs.server.port}"
-        )
-        h.datanodes[i] = (inst, fs)
-    h.frontend = DistInstance(str(tmp_path / "fe"), h.meta_addr,
-                              prefer_device=False)
+    h = DistHarness(tmp_path, n_datanodes=2, store=shared)
     try:
         fe = h.frontend
         fe.execute_sql(
@@ -1008,31 +971,7 @@ def test_wire_failover_replays_unflushed_rows_from_remote_wal(tmp_path):
     from greptimedb_tpu.storage.object_store import FsObjectStore
 
     shared = FsObjectStore(str(tmp_path / "shared_store"))
-    h = DistHarness.__new__(DistHarness)
-    h.tmp_path = tmp_path
-    h.meta = MetasrvServer(
-        addr="127.0.0.1", port=0, data_home=str(tmp_path / "meta")
-    ).start()
-    h.meta_addr = f"127.0.0.1:{h.meta.port}"
-    h.datanodes = {}
-
-    def start_dn(i):
-        home = str(tmp_path / f"dn{i}")
-        inst = Standalone(
-            engine_config=EngineConfig(data_root=home,
-                                       enable_background=False,
-                                       wal_backend="object"),
-            prefer_device=False, warm_start=False, store=shared,
-        )
-        inst.region_server = RegionServer(inst.engine, home)
-        fs = FlightFrontend(inst, port=0).start()
-        MetaClient(h.meta_addr).register(i, f"127.0.0.1:{fs.server.port}")
-        h.datanodes[i] = (inst, fs)
-
-    for i in range(3):
-        start_dn(i)
-    h.frontend = DistInstance(str(tmp_path / "fe"), h.meta_addr,
-                              prefer_device=False)
+    h = DistHarness(tmp_path, store=shared, wal_backend="object")
     try:
         fe = h.frontend
         fe.execute_sql(
@@ -1064,5 +1003,61 @@ def test_wire_failover_replays_unflushed_rows_from_remote_wal(tmp_path):
             "select host, sum(v) from rw group by host order by host"
         ).rows()
         assert after == before, "unflushed rows lost across failover"
+    finally:
+        h.close()
+
+
+def test_wire_migration_fuzz_under_writes(tmp_path):
+    """Live-cluster migration fuzz (the reference's
+    tests-fuzz/targets/migration/fuzz_migrate_mito_regions.rs analog on
+    this wire topology): random region migrations between datanode
+    Flight servers interleave with frontend writes; every row written
+    must be readable afterwards with standalone-equal aggregates."""
+    import random
+
+    from greptimedb_tpu.storage.object_store import FsObjectStore
+
+    rnd = random.Random(17)
+    shared = FsObjectStore(str(tmp_path / "shared_store"))
+    h = DistHarness(tmp_path, store=shared, wal_backend="object")
+    try:
+        fe = h.frontend
+        fe.execute_sql(
+            "create table mf (ts timestamp time index, host string "
+            "primary key, v double) with (num_regions = 3)"
+        )
+        ms = h.meta.metasrv
+        rids = fe.catalog.table("public", "mf").info.region_ids()
+        expected: dict[str, float] = {}
+        counts: dict[str, int] = {}
+        t = 1_700_000_000_000
+        for round_no in range(8):
+            # a write burst...
+            vals = []
+            for _ in range(20):
+                host = f"h{rnd.randrange(6)}"
+                v = float(rnd.randrange(100))
+                vals.append(f"('{host}', {t}, {v})")
+                expected[host] = expected.get(host, 0.0) + v
+                counts[host] = counts.get(host, 0) + 1
+                t += 1000
+            fe.execute_sql(
+                f"insert into mf (host, ts, v) values {', '.join(vals)}"
+            )
+            # ...then a random migration (sometimes mid-flush state)
+            rid = rnd.choice(rids)
+            src = ms.route_of(rid)
+            dst = rnd.choice([n for n in range(3) if n != src])
+            ms.migrate_region(rid, dst)
+            assert ms.route_of(rid) == dst
+        # every write survives every migration
+        fe.catalog.refresh()
+        got = fe.sql(
+            "select host, count(*), sum(v) from mf group by host "
+            "order by host"
+        ).rows()
+        want = [[h_, counts[h_], expected[h_]]
+                for h_ in sorted(expected)]
+        assert got == want
     finally:
         h.close()
