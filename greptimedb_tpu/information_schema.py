@@ -455,9 +455,11 @@ def _query_system_doc(inst, stmt: A.Select, doc) -> QueryResult:
 
     plan = plan_select(stmt, ts_name=None, tag_names=[],
                        all_columns=list(doc.keys()))
-    if plan.kind != "plain":
-        raise TableNotFoundError(
-            "aggregates over information_schema are not supported yet"
+    if plan.kind == "range":
+        from greptimedb_tpu.errors import UnsupportedError
+
+        raise UnsupportedError(
+            "RANGE over system tables is not supported"
         )
     if plan.scan.residual is not None and n:
         cond = eval_expr(plan.scan.residual, src)
@@ -468,25 +470,9 @@ def _query_system_doc(inst, stmt: A.Select, doc) -> QueryResult:
             for k, c in cols.items()
         }
         src = DictSource(cols, int(mask.sum()))
-    names = [nm for _, nm in plan.items]
-    out = [eval_expr(e, src) for e, _ in plan.items]
-    from greptimedb_tpu.query.executor import (
-        _distinct_indices,
-        _slice_result,
-        _sort_indices,
-    )
-
-    # sort before distinct: _distinct_indices keeps first occurrences in
-    # (sorted) row order, so the sort survives dedup
-    if plan.order_by:
-        order_cols = [eval_expr(o.expr, src) for o in plan.order_by]
-        idx = _sort_indices(order_cols, [o.asc for o in plan.order_by],
-                            [o.nulls_first for o in plan.order_by])
-        out = _slice_result(out, idx)
-    if plan.distinct:
-        out = _slice_result(out, _distinct_indices(out))
-    if plan.offset or plan.limit is not None:
-        off = plan.offset or 0
-        end = None if plan.limit is None else off + plan.limit
-        out = _slice_result(out, slice(off, end))
-    return QueryResult(names, out)
+    # system docs run through the normal executor paths (the reference
+    # treats information_schema as ordinary DataFusion tables):
+    # aggregates, window functions, DISTINCT/ORDER/LIMIT all included
+    if plan.kind == "aggregate":
+        return inst.query_engine._execute_aggregate(plan, src, None)
+    return inst.query_engine._execute_plain(plan, src, None)

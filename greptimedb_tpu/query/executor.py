@@ -154,7 +154,8 @@ class RowsSource(ColumnSource):
 
 
 class DictSource(ColumnSource):
-    """Column source over a plain name -> Col mapping (post-agg eval)."""
+    """Column source over a plain name -> Col mapping (post-agg eval,
+    system virtual tables)."""
 
     def __init__(self, cols: dict[str, Col], num_rows: int):
         self.cols = cols

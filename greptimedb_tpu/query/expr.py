@@ -50,9 +50,13 @@ class Col:
 
 class ColumnSource:
     """Resolves column names to Cols; implemented by the executor over scan
-    output (fields direct, tags decoded lazily via the series registry)."""
+    output (fields direct, tags decoded lazily via the series registry).
+    rows/tag_names default to the no-raw-rows shape so any source can
+    feed the plain/aggregate executor paths (RowsSource overrides)."""
 
     num_rows: int = 0
+    rows = None
+    tag_names: list[str] = []
 
     def col(self, name: str) -> Col:  # pragma: no cover - interface
         raise ColumnNotFoundError(name)

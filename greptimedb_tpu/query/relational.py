@@ -109,9 +109,6 @@ class FrameSource(ColumnSource):
     """ColumnSource over a Frame for the shared expression evaluator and
     the executor's plain/aggregate paths."""
 
-    rows = None
-    tag_names: list[str] = []
-
     def __init__(self, frame: Frame):
         self.frame = frame
         self.num_rows = frame.num_rows

@@ -1,0 +1,23 @@
+-- count(*) vs count(col) vs count(1) over NULLs and filters
+CREATE TABLE cn (ts TIMESTAMP TIME INDEX, g STRING PRIMARY KEY, v DOUBLE);
+
+INSERT INTO cn (ts, g, v) VALUES (1000, 'a', 1.0), (2000, 'b', NULL), (3000, 'c', 3.0);
+
+SELECT count(*), count(v), count(1), count(g) FROM cn;
+----
+count(*)|count(v)|count(1)|count(g)
+3|2|3|3
+
+SELECT count(*) FROM cn WHERE v IS NULL;
+----
+count(*)
+1
+
+SELECT g, count(v) FROM cn GROUP BY g ORDER BY g;
+----
+g|count(v)
+a|1
+b|0
+c|1
+
+DROP TABLE cn;

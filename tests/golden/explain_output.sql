@@ -1,0 +1,20 @@
+-- EXPLAIN renders the logical plan shape
+CREATE TABLE ex (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, v DOUBLE);
+
+EXPLAIN SELECT host, sum(v) FROM ex WHERE v > 1 GROUP BY host ORDER BY host LIMIT 3;
+----
+plan
+SelectPlan[aggregate] table=ex
+  Scan: ts=[None, None] matchers=[] residual=v > 1
+  Aggregate: keys=['host'] aggs=['sum(v)']
+  Sort: __key_0 ASC
+  Limit: 3 offset=0
+
+EXPLAIN SELECT ts, host, avg(v) RANGE '1m' FROM ex ALIGN '1m' BY (host);
+----
+plan
+SelectPlan[range] table=ex
+  Scan: ts=[None, None] matchers=[] residual=None
+  Range: align=60000ms to=0 by=['host'] items=['mean RANGE 60000ms']
+
+DROP TABLE ex;

@@ -1,0 +1,22 @@
+-- fulltext matches() over an indexed column (append-mode log shape)
+CREATE TABLE logs (ts TIMESTAMP TIME INDEX, msg STRING FULLTEXT) WITH (append_mode = 'true');
+
+INSERT INTO logs VALUES (1000, 'error: disk full on node-3'), (2000, 'request completed ok'), (3000, 'disk pressure warning');
+
+SELECT ts FROM logs WHERE matches(msg, 'disk') ORDER BY ts;
+----
+ts
+1000
+3000
+
+SELECT ts FROM logs WHERE matches(msg, 'disk full') ORDER BY ts;
+----
+ts
+1000
+
+SELECT count(*) FROM logs WHERE matches(msg, 'nothing_matches');
+----
+count(*)
+0
+
+DROP TABLE logs;

@@ -1,0 +1,25 @@
+-- geo + network scalar functions (reference: scalars/geo/, scalars/ip)
+SELECT h3_latlng_to_cell(37.7749, -122.4194, 8) IS NOT NULL;
+----
+h3_latlng_to_cell(37.7749, -122.4194, 8) IS NOT NULL
+true
+
+SELECT round(st_distance_sphere_m(37.7749, -122.4194, 34.0522, -118.2437) / 1000.0, 0);
+----
+round(st_distance_sphere_m(37.7749, -122.4194, 34.0522, -118.2437) / 1000.0, 0)
+559.0
+
+SELECT ipv4_string_to_num('10.0.0.1');
+----
+ipv4_string_to_num('10.0.0.1')
+167772161
+
+SELECT ipv4_num_to_string(167772161);
+----
+ipv4_num_to_string(167772161)
+10.0.0.1
+
+SELECT ipv4_in_range('10.0.0.7', '10.0.0.0/24'), ipv4_in_range('10.0.1.7', '10.0.0.0/24');
+----
+ipv4_in_range('10.0.0.7', '10.0.0.0/24')|ipv4_in_range('10.0.1.7', '10.0.0.0/24')
+true|false

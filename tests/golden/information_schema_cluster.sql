@@ -1,0 +1,19 @@
+-- cluster/runtime information_schema tables exist and have sane shapes
+SELECT count(*) >= 1 FROM information_schema.build_info;
+----
+count(*) >= 1
+true
+
+SELECT count(*) >= 1 FROM information_schema.engines;
+----
+count(*) >= 1
+true
+
+SELECT count(*) >= 1 FROM information_schema.character_sets;
+----
+count(*) >= 1
+true
+
+SELECT table_name FROM information_schema.tables WHERE table_schema = 'information_schema' ORDER BY table_name LIMIT 5;
+----
+table_name

@@ -1,0 +1,21 @@
+-- TQL subqueries and offset modifiers
+CREATE TABLE sq (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY, val DOUBLE);
+
+INSERT INTO sq VALUES (0, 'a', 1.0), (30000, 'a', 4.0), (60000, 'a', 9.0);
+
+TQL EVAL (60, 60, '30s') max_over_time(sq[1m:30s]);
+----
+ts|value|host
+60000|9.0|a
+
+TQL EVAL (60, 60, '30s') sq offset 30s;
+----
+ts|value|__name__|host
+60000|4.0|sq|a
+
+TQL EVAL (60, 60, '30s') avg_over_time(sq[1m]);
+----
+ts|value|host
+60000|6.5|a
+
+DROP TABLE sq;
