@@ -105,25 +105,56 @@ class TableInfo:
         )
 
 
+def append_mode_enabled(options: dict | None) -> bool:
+    """THE append-mode predicate: every layer (region options, the
+    ingest retry guard, the frontend statement-retry guard) must agree,
+    or a table could get dedup regions while the write path refuses the
+    dedup-safe retry (or worse, the inverse)."""
+    return str((options or {}).get("append_mode", "")).lower() in (
+        "true", "1",
+    )
+
+
+def validate_table_options(options: dict | None):
+    """CREATE-boundary validation: parse_interval_ms carries signs
+    now, and a negative TTL would compute a cutoff in the future and
+    expire EVERYTHING. Runs only when a table is CREATED — the
+    converter below stays lenient so a previously persisted catalog
+    (whatever it holds) still opens."""
+    from greptimedb_tpu.errors import InvalidArgumentError
+    from greptimedb_tpu.sql.parser import parse_interval_ms
+
+    for key in ("ttl", "compaction.twcs.time_window"):
+        if key in (options or {}):
+            if parse_interval_ms(str(options[key])) <= 0:
+                raise InvalidArgumentError(
+                    f"{key} must be positive: {options[key]!r}"
+                )
+
+
 def region_options_from_table(options: dict) -> RegionOptions:
     """SQL WITH(...) options -> region options (TTL, append_mode, merge_mode,
     compaction windows — the table-option surface of
-    /root/reference/src/mito2/src/region/options.rs)."""
+    /root/reference/src/mito2/src/region/options.rs). Lenient: also the
+    catalog REOPEN path, so non-positive persisted intervals disable
+    the feature instead of failing the load."""
+    from greptimedb_tpu.sql.parser import parse_interval_ms
+
     opts = RegionOptions()
     if "ttl" in options:
-        from greptimedb_tpu.sql.parser import parse_interval_ms
-
-        opts.ttl_ms = parse_interval_ms(str(options["ttl"]))
-    if str(options.get("append_mode", "")).lower() in ("true", "1"):
+        ms = parse_interval_ms(str(options["ttl"]))
+        if ms > 0:
+            opts.ttl_ms = ms
+    if append_mode_enabled(options):
         opts.append_mode = True
     if "merge_mode" in options:
         opts.merge_mode = str(options["merge_mode"])
     if "compaction.twcs.time_window" in options:
-        from greptimedb_tpu.sql.parser import parse_interval_ms
-
-        opts.compaction_window_ms = parse_interval_ms(
+        ms = parse_interval_ms(
             str(options["compaction.twcs.time_window"])
         )
+        if ms > 0:
+            opts.compaction_window_ms = ms
     return opts
 
 
@@ -316,6 +347,7 @@ class CatalogManager:
         if_not_exists: bool = False,
         partition: dict | None = None,
     ) -> Table:
+        validate_table_options(options)
         with self._lock:
             db = self._db(database)
             if name in self._views.get(database, {}):

@@ -420,6 +420,7 @@ def _start_frontend(opts):
         inst = DistInstance(
             opts.get("data_home"), meta_addr,
             flownode_addr=opts.get("frontend.flownode_addr") or None,
+            ingest_options=opts.section("ingest"),
         )
         target = f"metasrv {meta_addr}"
     else:
@@ -464,7 +465,8 @@ def _start_flownode(opts):
         # deltas arrive over Flight (dist/frontend.py flow mirroring)
         from greptimedb_tpu.dist.frontend import DistInstance
 
-        inst = DistInstance(opts.get("data_home"), meta_addr)
+        inst = DistInstance(opts.get("data_home"), meta_addr,
+                            ingest_options=opts.section("ingest"))
         inst.enable_flows(
             tick_interval_s=opts.get("flow.tick_interval_s", 1.0)
         )

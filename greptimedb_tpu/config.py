@@ -46,6 +46,20 @@ DEFAULTS: dict = {
         "cache_capacity_bytes": 0,
     },
     "flow": {"enable": True, "tick_interval_s": 1.0},
+    # pipelined wire-ingest dataplane (greptimedb_tpu/ingest/): the
+    # frontend write path batches, coalesces, and streams region writes
+    # to every datanode concurrently over long-lived Flight streams
+    "ingest": {
+        "pipeline": True,            # false = serial blocking DoPut
+        "batch_max_rows": 262144,    # per coalesced wire batch group
+        "coalesce_min_rows": 4096,   # group-commit target batch size
+        "max_delay_ms": 4.0,         # max adaptive coalesce hold
+        "queue_max_rows": 1048576,   # per-datanode backpressure bound
+        "block_timeout_s": 2.0,      # blocked past this => 429 shed
+        "max_inflight_groups": 2,    # double-buffered send/apply
+        "ack_timeout_s": 60.0,       # unacked past this => overloaded
+        "idle_stream_s": 60.0,       # close parked streams after this
+    },
     "engine": {
         "enable_background": True,
         "background_interval_s": 5.0,
@@ -161,7 +175,10 @@ def load_options(
     # reach back into the shared module-level DEFAULTS
     values = copy.deepcopy(DEFAULTS)
     if config_file:
-        import tomllib
+        try:
+            import tomllib  # 3.11+
+        except ModuleNotFoundError:  # 3.10: same API, external name
+            import tomli as tomllib
 
         with open(config_file, "rb") as f:
             values = _deep_merge(values, tomllib.load(f))

@@ -49,12 +49,41 @@ _MISS_REFRESH_INTERVAL_S = 2.0
 class DistCatalogManager(CatalogManager):
     """Catalog whose tables live across datanode processes."""
 
-    def __init__(self, engine, meta: MetaClient):
+    def __init__(self, engine, meta: MetaClient, *,
+                 ingest_options: dict | None = None):
         self.meta = meta
         self._clients: dict[int, DatanodeClient] = {}
         self._last_miss_refresh = 0.0
+        # pipelined ingest dataplane shared by every RemoteTable this
+        # catalog builds (ingest/): [ingest] pipeline=false falls back
+        # to the serial blocking DoPut path
+        self.ingest = None
+        if (ingest_options or {}).get("pipeline", True):
+            from greptimedb_tpu.ingest import IngestConfig, IngestPipeline
+
+            self.ingest = IngestPipeline(
+                IngestConfig.from_options(ingest_options),
+                reroute=self._ingest_reroute,
+            )
         # base __init__ runs _load(), which needs self.meta/_clients
         super().__init__(engine)
+
+    def _ingest_reroute(self, region_ids: list[int]) -> dict:
+        """Route-refresh for the dataplane's region-not-found retry:
+        re-read routes from the metasrv (refreshing the catalog so
+        reads heal too) and resolve each region's CURRENT owner."""
+        self.refresh()
+        routes = self.meta.routes()
+        out = {}
+        for rid in region_ids:
+            nid = routes.get(rid)
+            if nid is None:
+                continue
+            try:
+                out[rid] = self._client_for(nid)
+            except Exception:  # noqa: BLE001 - node gone again
+                continue
+        return out
 
     # ------------------------------------------------------------------
     def _client_for(self, node_id: int) -> DatanodeClient:
@@ -183,6 +212,9 @@ class DistCatalogManager(CatalogManager):
                      engine: str = "mito", options: dict | None = None,
                      num_regions: int = 1, if_not_exists: bool = False,
                      partition: dict | None = None):
+        from greptimedb_tpu.catalog.manager import validate_table_options
+
+        validate_table_options(options)
         with self._lock:
             db = self._db(database)
             if name in self._views.get(database, {}):
@@ -272,7 +304,10 @@ class DistCatalogManager(CatalogManager):
             nid: self._client_for(nid)
             for nid in {routes[r] for r in rids if r in routes}
         }
-        return RemoteTable(info, remote_regions_for(info, routes, clients))
+        return RemoteTable(
+            info, remote_regions_for(info, routes, clients),
+            ingest=self.ingest,
+        )
 
     # ------------------------------------------------------------------
     def drop_table(self, database: str, name: str, *,
@@ -412,5 +447,7 @@ class DistCatalogManager(CatalogManager):
             return super().table(database, name)
 
     def close(self):
+        if self.ingest is not None:
+            self.ingest.close()  # drains queued + in-flight batches
         for cli in self._clients.values():
             cli.close()

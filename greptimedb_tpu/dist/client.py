@@ -9,10 +9,15 @@ remote-table layer and the dist catalog talk through.
 from __future__ import annotations
 
 import json
+import re
 import threading
 import urllib.request
 
-from greptimedb_tpu.errors import DatanodeUnavailableError, GreptimeError
+from greptimedb_tpu.errors import (
+    DatanodeUnavailableError,
+    GreptimeError,
+    error_from_code,
+)
 
 
 def _strip_flight_error(e) -> str:
@@ -28,6 +33,31 @@ def _is_unavailable(e) -> bool:
         return True
     return "unavailable" in str(e).lower() or \
         "failed to connect" in str(e).lower()
+
+
+# typed-error marker a server stamped on the message (servers/flight.py
+# wrap_flight_error): the status code re-raises as its dedicated class
+# on this side instead of substring-matching the text
+_CODE_RE = re.compile(r"\[gtdb:(\d+)\]\s*")
+
+
+def map_flight_error(e: Exception, addr: str) -> GreptimeError:
+    """Flight/socket error -> typed GreptimeError. A `[gtdb:<code>]`
+    marker re-raises the remote error as its dedicated class — checked
+    FIRST, because the unavailable substring heuristic would otherwise
+    misclassify a typed server error that merely mentions
+    'unavailable' (e.g. a StorageError) as the retryable
+    datanode-unreachable case. Transport-level failures never carry
+    the marker, so they fall through to the heuristic."""
+    msg = _strip_flight_error(e)
+    m = _CODE_RE.search(msg)
+    if m:
+        return error_from_code(int(m.group(1)), msg[m.end():].strip())
+    if _is_unavailable(e):
+        return DatanodeUnavailableError(
+            f"datanode {addr} unreachable: {msg}"
+        )
+    return GreptimeError(msg)
 
 
 class DatanodeClient:
@@ -59,14 +89,12 @@ class DatanodeClient:
         """Map a Flight error: unreachable datanodes raise the
         RETRYABLE DatanodeUnavailableError (and drop the cached
         connection so the next call redials — failover may have moved
-        the regions)."""
-        if _is_unavailable(e):
+        the regions); `[gtdb:<code>]`-stamped messages re-raise as
+        their typed class (e.g. RegionNotFoundError)."""
+        err = map_flight_error(e, self.addr)
+        if isinstance(err, DatanodeUnavailableError):
             self.close()
-            raise DatanodeUnavailableError(
-                f"datanode {self.addr} unreachable: "
-                f"{_strip_flight_error(e)}"
-            ) from None
-        raise GreptimeError(_strip_flight_error(e)) from None
+        raise err from None
 
     # ---- actions ------------------------------------------------------
     def action(self, kind: str, body: dict | None = None, *,

@@ -23,7 +23,8 @@ from greptimedb_tpu.storage.engine import EngineConfig
 class DistInstance(Standalone):
     def __init__(self, data_home: str, metasrv_addr: str, *,
                  prefer_device: bool | None = None,
-                 flownode_addr: str | None = None):
+                 flownode_addr: str | None = None,
+                 ingest_options: dict | None = None):
         # the local engine only backs frontend-local scratch (scripts,
         # slow-query log); table data never lands here
         super().__init__(
@@ -35,7 +36,9 @@ class DistInstance(Standalone):
             warm_start=False,
         )
         self.meta = MetaClient(metasrv_addr)
-        self.catalog = DistCatalogManager(self.engine, self.meta)
+        self.catalog = DistCatalogManager(
+            self.engine, self.meta, ingest_options=ingest_options
+        )
         self.distributed = True
         self.flownode_addr = flownode_addr
         self._flow_clients: dict[str, object] = {}
@@ -63,7 +66,7 @@ class DistInstance(Standalone):
     def execute_statement(self, stmt, ctx):
         from greptimedb_tpu.errors import (
             DatanodeUnavailableError,
-            GreptimeError,
+            RegionNotFoundError,
         )
         from greptimedb_tpu.sql import ast as A
 
@@ -79,16 +82,17 @@ class DistInstance(Standalone):
                 raise
             self.catalog.refresh()
             return super().execute_statement(stmt, ctx)
-        except GreptimeError as e:
-            # region-not-found on a WRITE = stale routes after a
-            # migration. Retrying re-sends the WHOLE statement, and a
-            # multi-datanode write may have partially applied on other
-            # nodes — safe only because last-write-wins dedup makes the
-            # replay idempotent. Append-mode tables have no dedup, so
-            # they must surface the error instead of duplicating rows.
+        except RegionNotFoundError:
+            # the TYPED region-not-found carried across the Flight
+            # boundary (servers/flight.py wrap_flight_error) on a WRITE
+            # = stale routes after a migration; the ingest dataplane's
+            # batch-level re-route already retried dedup-safe batches,
+            # so reaching here means a full-statement replay is needed.
+            # That replay may re-apply batches that landed on other
+            # datanodes — safe only because last-write-wins dedup makes
+            # it idempotent. Append-mode tables have no dedup, so they
+            # surface the error instead of duplicating rows.
             if not isinstance(stmt, (A.Insert, A.Delete)):
-                raise
-            if "not found" not in str(e).lower():
                 raise
             if self._stmt_table_append_mode(stmt, ctx):
                 raise
@@ -96,15 +100,14 @@ class DistInstance(Standalone):
             return super().execute_statement(stmt, ctx)
 
     def _stmt_table_append_mode(self, stmt, ctx) -> bool:
+        from greptimedb_tpu.catalog.manager import append_mode_enabled
+
         try:
             db, name = self._resolve(stmt.table, ctx)
             table = self.catalog.maybe_table(db, name)
             if table is None:
                 return False
-            opts = table.info.options or {}
-            return str(opts.get("append_mode", "")).lower() in (
-                "true", "1", "yes",
-            )
+            return append_mode_enabled(table.info.options)
         except Exception:  # noqa: BLE001 - conservative: no retry
             return True
 
@@ -314,9 +317,13 @@ class DistInstance(Standalone):
                 return True
             except Exception as e:  # noqa: BLE001 - try next node
                 # the hosting node's genuine failure must win over the
-                # other nodes' expected flow-miss (match the specific
-                # message: a SINK-table not-found is a real failure)
-                if real_err is None and "flow not found" not in str(e):
+                # other nodes' expected flow-miss: the miss arrives as
+                # the TYPED FlowNotFoundError (status code over the
+                # wire), so e.g. a SINK-table not-found — a real
+                # failure — is never mistaken for it
+                if real_err is None and not isinstance(
+                    e, FlowNotFoundError
+                ):
                     real_err = e
         raise real_err or FlowNotFoundError(f"flow not found: {fname}")
 

@@ -32,6 +32,7 @@ class RegionServer:
         self.engine = engine
         self._path = os.path.join(data_home, REGIONS_FILE)
         self._lock = threading.Lock()
+        self._closed = False
         self._metas: dict[int, dict] = {}
         # region alive-keeping (the reference's RegionAliveKeeper,
         # src/datanode/src/alive_keeper.rs:44-113): metasrv lease grants
@@ -87,8 +88,18 @@ class RegionServer:
         with self._lock:
             return sorted(self._metas)
 
+    def close(self):
+        """Fence the server for shutdown: requests still arriving over
+        parked ingest streams (servers/flight.py region_write_stream)
+        must error instead of applying into a closing engine."""
+        self._closed = True
+
     # ---- per-region ops ----------------------------------------------
     def _region(self, region_id: int):
+        if self._closed:
+            from greptimedb_tpu.errors import IllegalStateError
+
+            raise IllegalStateError("datanode is shutting down")
         try:
             return self.engine.region(region_id)
         except RegionNotFoundError:

@@ -117,12 +117,21 @@ class RemoteRegion:
 
 
 class RemoteTable(Table):
-    """Table over remote regions; scans group regions per datanode."""
+    """Table over remote regions; scans group regions per datanode.
+    When an ingest pipeline is attached (dist/catalog.py), writes route
+    through the pipelined dataplane instead of serial blocking RPCs."""
 
     remote = True
 
-    def __init__(self, info, regions: list[RemoteRegion]):
+    def __init__(self, info, regions: list[RemoteRegion],
+                 ingest=None):
         super().__init__(info, regions)
+        self.ingest = ingest
+        # append-mode tables have no last-write-wins dedup, so a
+        # re-routed batch re-send could duplicate rows: not retryable
+        from greptimedb_tpu.catalog.manager import append_mode_enabled
+
+        self._append_mode = append_mode_enabled(info.options)
 
     # ------------------------------------------------------------------
     def _by_datanode(self, regions) -> list[tuple[object, list[int]]]:
@@ -177,8 +186,28 @@ class RemoteTable(Table):
 
     # ------------------------------------------------------------------
     def _dispatch_writes(self, puts, *, op: int, skip_wal: bool):
-        """One DoPut stream per datanode, carrying all of its regions'
-        batches (instead of one RPC per region)."""
+        """Route region batches through the pipelined ingest dataplane
+        when one is attached: all datanodes written CONCURRENTLY over
+        long-lived streams, encode overlapped with send, coalescing
+        with concurrent writers (ingest/). Fallback: one blocking DoPut
+        per datanode (the pre-dataplane path, kept for direct
+        RemoteRegion users and pipeline-disabled configs)."""
+        if self.ingest is not None:
+            from greptimedb_tpu.ingest.coalescer import IngestEntry
+
+            entries = []
+            for r_idx, tag_columns, ts, fields, field_valid in puts:
+                region = self.regions[r_idx]
+                region._stats_cache = None
+                entries.append(IngestEntry(
+                    region_id=region.meta.region_id,
+                    client=region.client, tag_columns=tag_columns,
+                    ts=ts, fields=fields, field_valid=field_valid,
+                    op=int(op), skip_wal=skip_wal,
+                    retryable=not self._append_mode,
+                ))
+            self.ingest.submit(entries)  # blocks until APPLIED remotely
+            return
         groups: dict[int, tuple[object, list[dict]]] = {}
         for r_idx, tag_columns, ts, fields, field_valid in puts:
             region = self.regions[r_idx]

@@ -137,3 +137,56 @@ class FlowAlreadyExistsError(GreptimeError):
 
 class IllegalStateError(GreptimeError):
     status_code = StatusCode.ILLEGAL_STATE
+
+
+class IngestOverloadedError(GreptimeError):
+    """The ingest dataplane's bounded queues stayed full past the
+    block timeout: a datanode is slow or stalled and the accepting
+    edge sheds instead of growing memory without bound. Clients
+    should back off and retry (HTTP surfaces map this to 429)."""
+
+    status_code = StatusCode.RATE_LIMITED
+
+
+class ArithmeticOverflowError(ExecutionError):
+    """An exact integer aggregate (e.g. SUM over BIGINT/UINT64)
+    exceeds the int64 result range; raised instead of silently
+    wrapping two's-complement."""
+
+
+# wire mapping: one REPRESENTATIVE class per status code so a typed
+# error re-raises as the same class on the far side of an RPC
+# boundary (codes shared by several classes map to the most specific
+# retry-relevant one)
+_CODE_CLASSES: dict[StatusCode, type] = {
+    StatusCode.UNSUPPORTED: UnsupportedError,
+    StatusCode.INVALID_ARGUMENTS: InvalidArgumentError,
+    StatusCode.INVALID_SYNTAX: InvalidSyntaxError,
+    StatusCode.PLAN_QUERY: PlanError,
+    StatusCode.ENGINE_EXECUTE_QUERY: ExecutionError,
+    StatusCode.TABLE_NOT_FOUND: TableNotFoundError,
+    StatusCode.TABLE_ALREADY_EXISTS: TableAlreadyExistsError,
+    StatusCode.TABLE_COLUMN_NOT_FOUND: ColumnNotFoundError,
+    StatusCode.DATABASE_NOT_FOUND: DatabaseNotFoundError,
+    StatusCode.DATABASE_ALREADY_EXISTS: DatabaseAlreadyExistsError,
+    StatusCode.REGION_NOT_FOUND: RegionNotFoundError,
+    StatusCode.REGION_READONLY: RegionReadonlyError,
+    StatusCode.STORAGE_UNAVAILABLE: StorageError,
+    StatusCode.RATE_LIMITED: IngestOverloadedError,
+    StatusCode.FLOW_NOT_FOUND: FlowNotFoundError,
+    StatusCode.FLOW_ALREADY_EXISTS: FlowAlreadyExistsError,
+    StatusCode.ILLEGAL_STATE: IllegalStateError,
+}
+
+
+def error_from_code(code: int, msg: str) -> GreptimeError:
+    """Rebuild the typed error a remote process serialized as its
+    status code (see servers/flight.py wrap_flight_error /
+    dist/client.py _raise)."""
+    try:
+        cls = _CODE_CLASSES.get(StatusCode(int(code)))
+    except ValueError:
+        cls = None
+    if cls is None:
+        return GreptimeError(msg)
+    return cls(msg)

@@ -1,0 +1,33 @@
+"""Pipelined wire-ingest dataplane.
+
+The frontend write path and the wire-protocol servers route region
+writes through this package instead of issuing one blocking Flight call
+per datanode:
+
+- coalescer.py — accumulates per-region row batches with adaptive
+  size/age thresholds so small wire writes amortize encode + RPC cost
+  (group commit).
+- sender.py    — one pipelined sender per datanode: a long-lived DoPut
+  stream (`region_write_stream`, servers/flight.py), encode overlapped
+  with send, all datanodes written concurrently; bounded queues give
+  backpressure and shed with IngestOverloadedError.
+- pipeline.py  — the facade: submit/wait tickets, the region-not-found
+  route-refresh retry policy, flush/drain for shutdown and tests.
+
+Per-stage telemetry (queued rows, in-flight batches, coalesce ratio,
+backpressure events) registers on telemetry/metrics.py's
+global_registry and therefore reaches /metrics, the self-import
+exporter, and information_schema.runtime_metrics automatically.
+"""
+
+from greptimedb_tpu.ingest.coalescer import (  # noqa: F401
+    AdaptiveDelay,
+    IngestEntry,
+    coalesce_entries,
+)
+from greptimedb_tpu.ingest.pipeline import (  # noqa: F401
+    IngestConfig,
+    IngestPipeline,
+    WriteTicket,
+)
+from greptimedb_tpu.ingest.sender import DatanodeSender  # noqa: F401

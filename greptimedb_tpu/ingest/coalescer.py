@@ -1,0 +1,160 @@
+"""Batch coalescing for the pipelined ingest dataplane.
+
+The amortization half of the tf.data recipe (arxiv 2101.12127: batch
+small per-element work before the expensive stage): many small wire
+writes bound for the same region merge into one Arrow batch, so the
+encode + DoPut + WAL-append cost is paid per COALESCED batch, not per
+protocol request. Coalescing is keyed by (region, op, skip_wal, field
+set) — only writes that would have produced wire-identical batches
+merge, so apply semantics are unchanged.
+
+`AdaptiveDelay` is the group-commit governor: when flushes keep going
+out below the target batch size while the downstream stream is busy,
+the hold window widens (more arrivals fold into the next batch); a
+flush at/above target narrows it back so an idle pipeline stays at
+near-zero added latency.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+
+from greptimedb_tpu.storage.memtable import OP_PUT
+
+
+@dataclass
+class IngestEntry:
+    """One region-bound write split, as produced by the frontend's
+    tag-hash routing (catalog/table.py Table.write)."""
+
+    region_id: int
+    client: object                      # DatanodeClient (addr + channel)
+    tag_columns: dict[str, np.ndarray]
+    ts: np.ndarray
+    fields: dict[str, np.ndarray]
+    field_valid: dict[str, np.ndarray] | None
+    op: int = OP_PUT
+    skip_wal: bool = False
+    # dedup-safe: a re-send after a route refresh cannot duplicate rows
+    # (last-write-wins tables only; append-mode must NOT retry)
+    retryable: bool = True
+    # route-refresh retries already burned on this entry's rows
+    attempts: int = 0
+    ticket: object | None = field(default=None, repr=False)
+    # post-coalesce: every ticket the merged entry must complete
+    tickets: list = field(default_factory=list, repr=False)
+
+    @property
+    def rows(self) -> int:
+        return len(self.ts)
+
+    def coalesce_key(self) -> tuple:
+        return (
+            self.region_id, self.op, self.skip_wal,
+            tuple(self.tag_columns), tuple(self.fields),
+        )
+
+    def with_client(self, client) -> "IngestEntry":
+        return replace(self, client=client)
+
+
+def _merge_valid(entries: list[IngestEntry], name: str) -> np.ndarray | None:
+    """Concatenated validity for one field; None when every entry is
+    fully valid (the wire encoding treats absent masks as all-valid)."""
+    if not any(
+        e.field_valid and name in e.field_valid for e in entries
+    ):
+        return None
+    parts = []
+    for e in entries:
+        v = (e.field_valid or {}).get(name)
+        parts.append(np.ones(e.rows, bool) if v is None else np.asarray(v, bool))
+    return np.concatenate(parts)
+
+
+def coalesce_entries(entries: list[IngestEntry]) -> list[IngestEntry]:
+    """Merge compatible same-region entries into one entry each (order
+    within a region is preserved — later rows stay later, so
+    last-write-wins dedup sees the same sequence the caller sent).
+    Tickets of merged entries are carried on the merged entry as a
+    list; single entries pass through untouched."""
+    def src_tickets(e: IngestEntry) -> list:
+        # an already-merged entry re-entering the queue (route-refresh
+        # retry) carries its sources' tickets; fresh entries carry one
+        return e.tickets or (
+            [e.ticket] if e.ticket is not None else []
+        )
+
+    by_key: dict[tuple, list[IngestEntry]] = {}
+    order: list[tuple] = []
+    for e in entries:
+        k = e.coalesce_key()
+        if k not in by_key:
+            by_key[k] = []
+            order.append(k)
+        by_key[k].append(e)
+    out = []
+    for k in order:
+        group = by_key[k]
+        if len(group) == 1:
+            e = group[0]
+            e.tickets = src_tickets(e)
+            out.append(e)
+            continue
+        first = group[0]
+        merged = IngestEntry(
+            region_id=first.region_id, client=first.client,
+            tag_columns={
+                t: np.concatenate(
+                    [np.asarray(e.tag_columns[t], object) for e in group]
+                )
+                for t in first.tag_columns
+            },
+            ts=np.concatenate([e.ts for e in group]),
+            fields={
+                f: np.concatenate([e.fields[f] for e in group])
+                for f in first.fields
+            },
+            field_valid=None,
+            op=first.op, skip_wal=first.skip_wal,
+            retryable=all(e.retryable for e in group),
+            attempts=max(e.attempts for e in group),
+        )
+        valid = {}
+        for f in first.fields:
+            v = _merge_valid(group, f)
+            if v is not None:
+                valid[f] = v
+        merged.field_valid = valid or None
+        merged.tickets = [
+            t for e in group for t in src_tickets(e)
+        ]
+        out.append(merged)
+    return out
+
+
+class AdaptiveDelay:
+    """Hold-window controller for group commit: flushes below the
+    target batch size double the hold (up to max); at/above target the
+    hold halves (down to zero). Not thread-safe — owned by one sender
+    worker."""
+
+    _FLOOR_S = 0.0005
+
+    def __init__(self, max_delay_s: float):
+        self.max_delay_s = max(0.0, float(max_delay_s))
+        self.current_s = 0.0
+
+    def note_flush(self, rows: int, target_rows: int):
+        if rows >= target_rows:
+            self.current_s = (
+                0.0 if self.current_s <= self._FLOOR_S
+                else self.current_s / 2.0
+            )
+        else:
+            self.current_s = min(
+                self.max_delay_s,
+                max(self.current_s * 2.0, self._FLOOR_S),
+            )

@@ -1,0 +1,258 @@
+"""IngestPipeline: the frontend-facing face of the ingest dataplane.
+
+Owns one `DatanodeSender` per datanode address, fans a statement's
+region batches out to ALL of them concurrently, and gives the caller a
+`WriteTicket` to wait on (writes stay synchronous at the SQL/wire
+surface — when `submit` returns, every datanode has APPLIED the rows —
+while the transport underneath is pipelined and shared).
+
+This layer also owns the retry/flush policy:
+
+- **Route-refresh retry.** A group acked with the typed
+  `RegionNotFoundError` (the region migrated/failed over since this
+  frontend loaded its routes) re-resolves the region's owner through
+  the catalog and re-submits ONCE — but only when every affected row
+  is dedup-safe (`retryable`, i.e. last-write-wins tables; append-mode
+  surfaces the error instead, matching the statement-level contract).
+  Because the failed group was validated-then-applied atomically per
+  datanode, the re-send is not a replay: nothing landed the first time.
+- **Flush/drain.** `flush()` blocks until every queue and in-flight
+  group empties (clean shutdown, tests, admin flush).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from greptimedb_tpu.errors import (
+    GreptimeError,
+    IngestOverloadedError,
+    RegionNotFoundError,
+)
+from greptimedb_tpu.ingest.sender import DatanodeSender
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+_RETRIES = global_registry.counter(
+    "gtpu_ingest_route_retry_total",
+    "region batches re-routed after a RegionNotFound ack",
+)
+
+
+class IngestConfig:
+    """Knobs for the dataplane (TOML section [ingest], config.py)."""
+
+    def __init__(self, *, batch_max_rows: int = 262_144,
+                 coalesce_min_rows: int = 4096,
+                 max_delay_ms: float = 4.0,
+                 queue_max_rows: int = 1_048_576,
+                 block_timeout_s: float = 2.0,
+                 max_inflight_groups: int = 2,
+                 ack_timeout_s: float = 60.0,
+                 idle_stream_s: float = 60.0):
+        self.batch_max_rows = int(batch_max_rows)
+        self.coalesce_min_rows = int(coalesce_min_rows)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.queue_max_rows = int(queue_max_rows)
+        self.block_timeout_s = float(block_timeout_s)
+        self.max_inflight_groups = max(1, int(max_inflight_groups))
+        self.ack_timeout_s = float(ack_timeout_s)
+        self.idle_stream_s = float(idle_stream_s)
+
+    @classmethod
+    def from_options(cls, section: dict | None) -> "IngestConfig":
+        section = section or {}
+        kwargs = {}
+        for key in ("batch_max_rows", "coalesce_min_rows",
+                    "max_delay_ms", "queue_max_rows", "block_timeout_s",
+                    "max_inflight_groups", "ack_timeout_s",
+                    "idle_stream_s"):
+            if key in section:
+                kwargs[key] = section[key]
+        return cls(**kwargs)
+
+
+class WriteTicket:
+    """Completion handle for one submit: counts down one part per
+    region batch; collects the typed errors of failed parts."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._pending = 0
+        self.errors: list[GreptimeError] = []
+
+    def add_parts(self, n: int):
+        with self._cv:
+            self._pending += n
+
+    def part_done(self, error: GreptimeError | None = None):
+        with self._cv:
+            self._pending -= 1
+            if error is not None:
+                self.errors.append(error)
+            if self._pending <= 0:
+                self._cv.notify_all()
+
+    def wait(self, timeout: float) -> list[GreptimeError]:
+        from greptimedb_tpu.errors import DatanodeUnavailableError
+
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._pending > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    # NOT IngestOverloadedError: an unacked group may
+                    # still apply when the datanode recovers, so a
+                    # 429-invited blind client retry could duplicate
+                    # rows on append-mode tables. Unknown outcome maps
+                    # to the unavailable (503) contract instead.
+                    raise DatanodeUnavailableError(
+                        f"ingest not acknowledged within {timeout:.0f}s "
+                        f"({self._pending} batches outstanding; "
+                        f"outcome unknown)"
+                    )
+            return list(self.errors)
+
+
+class IngestPipeline:
+    def __init__(self, config: IngestConfig | None = None, *,
+                 reroute=None):
+        """`reroute(region_ids) -> {region_id: client}` refreshes the
+        catalog's routes and resolves each region's CURRENT owner (the
+        dist catalog provides it); None disables route-refresh retry."""
+        self.cfg = config or IngestConfig()
+        self._reroute = reroute
+        self._lock = threading.Lock()
+        self._senders: dict[str, DatanodeSender] = {}
+        self._closed = False
+
+    # ---- sender registry ----------------------------------------------
+    def sender_for(self, client) -> DatanodeSender:
+        with self._lock:
+            if self._closed:
+                # a requeue racing close() must not resurrect a sender
+                # into the cleared registry (it would never be drained)
+                raise IngestOverloadedError(
+                    "ingest pipeline is closed"
+                )
+            sender = self._senders.get(client.addr)
+            if sender is None or sender._closed:
+                sender = DatanodeSender(
+                    client, self.cfg,
+                    on_group_error=self._handle_group_error,
+                )
+                self._senders[client.addr] = sender
+            return sender
+
+    # ---- submit -------------------------------------------------------
+    def submit(self, entries: list, *, wait: bool = True,
+               timeout: float | None = None) -> WriteTicket:
+        """Fan entries out to their datanodes' senders. With wait=True
+        (the default) blocks until every batch is APPLIED remotely and
+        raises the first typed error (RegionNotFound preferred, so the
+        statement layer's refresh-and-replay backstop can fire)."""
+        if self._closed:
+            raise IngestOverloadedError("ingest pipeline is closed")
+        ticket = WriteTicket()
+        ticket.add_parts(len(entries))
+        submitted = 0
+        try:
+            for e in entries:
+                e.ticket = ticket
+                self.sender_for(e.client).submit(e)
+                submitted += 1
+        except IngestOverloadedError as shed:
+            # mark the never-queued parts done so the ticket cannot
+            # hang a concurrent waiter; already-queued rows still land
+            for _ in range(len(entries) - submitted):
+                ticket.part_done()
+            if submitted == 0:
+                raise  # nothing landed: 429 is safe to blind-retry
+            # PARTIAL shed: some of the statement's rows will still
+            # apply, so a 429-invited blind retry could duplicate rows
+            # on append-mode tables — surface the unknown/partial
+            # outcome as the unavailable (503) contract instead
+            from greptimedb_tpu.errors import DatanodeUnavailableError
+
+            raise DatanodeUnavailableError(
+                f"ingest partially queued ({submitted}/{len(entries)} "
+                f"batches) before overload: {shed}"
+            ) from shed
+        if wait:
+            self.wait(ticket, timeout=timeout)
+        return ticket
+
+    def wait(self, ticket: WriteTicket, *, timeout: float | None = None):
+        failures = ticket.wait(timeout or self.cfg.ack_timeout_s)
+        if not failures:
+            return
+        for err in failures:
+            if isinstance(err, RegionNotFoundError):
+                raise err
+        raise failures[0]
+
+    # ---- policy: route-refresh retry ----------------------------------
+    def _handle_group_error(self, entries: list, error) -> bool:
+        """Sender callback on a failed group. Returns True when the
+        entries were re-routed and re-queued (their tickets remain
+        pending); False hands the error back to the tickets."""
+        if self._reroute is None or self._closed:
+            return False
+        if not isinstance(error, RegionNotFoundError):
+            return False
+        if not all(e.retryable and e.attempts < 1 for e in entries):
+            return False
+        try:
+            mapping = self._reroute([e.region_id for e in entries])
+        except Exception:  # noqa: BLE001 - metasrv transient
+            return False
+        clients = [mapping.get(e.region_id) for e in entries]
+        if any(c is None for c in clients):
+            return False
+        requeued = []
+        try:
+            for e, cli in zip(entries, clients):
+                e2 = e.with_client(cli)
+                e2.attempts = e.attempts + 1
+                self.sender_for(cli).submit(e2)
+                requeued.append(e2)
+        except IngestOverloadedError:
+            # the re-routed target is overloaded: fail the rest
+            for e in entries[len(requeued):]:
+                for t in e.tickets or ([e.ticket] if e.ticket else []):
+                    t.part_done(error)
+            return True
+        _RETRIES.inc(len(requeued))
+        return True
+
+    # ---- flush / drain / close ----------------------------------------
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Drain every sender (queued + in-flight empty)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            senders = list(self._senders.values())
+        ok = True
+        for s in senders:
+            ok = s.drain(max(0.01, deadline - time.monotonic())) and ok
+        return ok
+
+    def stats(self) -> dict:
+        with self._lock:
+            senders = list(self._senders.items())
+        return {
+            addr: {
+                "queued_rows": s._queued_rows,
+                "inflight_groups": len(s._inflight),
+            }
+            for addr, s in senders
+        }
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            senders = list(self._senders.values())
+            self._senders.clear()
+        for s in senders:
+            s.close()

@@ -1,0 +1,433 @@
+"""Per-datanode pipelined sender: one long-lived Flight DoPut stream,
+encode overlapped with send, bounded queue with backpressure.
+
+The software-pipelining half of the dataplane (tf.data's
+prefetch/overlap discipline, arxiv 2101.12127, applied to ingest): a
+single worker thread per datanode pops queued region batches, coalesces
+them (coalescer.py), encodes to Arrow, and writes them to a LONG-LIVED
+`region_write_stream` DoPut stream — while a separate ack thread drains
+per-group application acks. Up to `max_inflight_groups` groups ride the
+stream unacknowledged (double buffering: group N+1 encodes and sends
+while the datanode applies group N), and every datanode's sender runs
+concurrently, so a multi-region statement pays the SLOWEST datanode's
+latency instead of the sum.
+
+Backpressure: the queue is bounded by rows; when a datanode stalls, the
+bound fills, `submit` blocks up to `block_timeout_s`, then sheds with
+the typed `IngestOverloadedError` — frontend memory stays bounded by
+`queue_max_rows` x row size per datanode, never by outage length.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+
+from greptimedb_tpu.errors import (
+    DatanodeUnavailableError,
+    GreptimeError,
+    IngestOverloadedError,
+    error_from_code,
+)
+from greptimedb_tpu.ingest.coalescer import AdaptiveDelay, coalesce_entries
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+STREAM_DESCRIPTOR = "region_write_stream"
+
+_QUEUED = global_registry.gauge(
+    "gtpu_ingest_queued_rows",
+    "rows waiting in the ingest dataplane queue", ("datanode",),
+)
+_INFLIGHT = global_registry.gauge(
+    "gtpu_ingest_inflight_batches",
+    "coalesced batch groups sent but not yet acked", ("datanode",),
+)
+_ROWS = global_registry.counter(
+    "gtpu_ingest_rows_total",
+    "rows accepted into the ingest dataplane", ("datanode",),
+)
+_BATCHES = global_registry.counter(
+    "gtpu_ingest_batches_total",
+    "coalesced batch groups shipped over the wire", ("datanode",),
+)
+_SUBMITTED = global_registry.counter(
+    "gtpu_ingest_submitted_batches_total",
+    "pre-coalesce region batches submitted (the coalesce ratio is "
+    "submitted/batches)", ("datanode",),
+)
+_BACKPRESSURE = global_registry.counter(
+    "gtpu_ingest_backpressure_total",
+    "submits that blocked on a full ingest queue", ("datanode",),
+)
+_SHED = global_registry.counter(
+    "gtpu_ingest_overloaded_total",
+    "submits shed with IngestOverloadedError after the block timeout",
+    ("datanode",),
+)
+_RECONNECTS = global_registry.counter(
+    "gtpu_ingest_stream_errors_total",
+    "ingest stream failures (a fresh stream is opened on demand)",
+    ("datanode",),
+)
+
+
+def _ack_error(ack: dict) -> GreptimeError | None:
+    if not ack.get("error"):
+        return None
+    return error_from_code(int(ack.get("code") or 0), ack["error"])
+
+
+class _Stream:
+    __slots__ = ("key", "writer", "reader", "alive")
+
+    def __init__(self, key, writer, reader):
+        self.key = key
+        self.writer = writer
+        self.reader = reader
+        self.alive = True
+
+
+class DatanodeSender:
+    """Owns the queue, worker, and stream(s) toward ONE datanode.
+    Streams are keyed by Arrow schema (one per table shape), so mixed
+    workloads keep every stream long-lived instead of renegotiating."""
+
+    def __init__(self, client, config, *, on_group_error=None):
+        self.client = client
+        self.addr = client.addr
+        self.cfg = config
+        # pipeline-level policy hook: (entries, error) -> True when the
+        # entries were requeued (tickets stay pending)
+        self._on_group_error = on_group_error
+        self._cv = threading.Condition()
+        self._queue: list = []
+        self._queued_rows = 0
+        self._inflight_rows = 0
+        self._gid = itertools.count(1)
+        # rows the worker popped but has not yet registered in-flight
+        # (coalesce/encode window): drain() must see them too
+        self._worker_rows = 0
+        self._inflight: dict[int, dict] = {}
+        self._streams: dict[tuple, _Stream] = {}
+        self._closed = False
+        self._last_send = time.monotonic()
+        self._delay = AdaptiveDelay(config.max_delay_s)
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name=f"ingest-{self.addr}"
+        )
+        self._worker.start()
+
+    # ---- accepting edge ----------------------------------------------
+    def _pending_rows(self) -> int:
+        return self._queued_rows + self._inflight_rows
+
+    def submit(self, entry, *, timeout: float | None = None):
+        """Enqueue one region batch; blocks under backpressure and
+        sheds with IngestOverloadedError after `timeout` (default: the
+        configured block timeout)."""
+        timeout = self.cfg.block_timeout_s if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            blocked = False
+            while (not self._closed and self._pending_rows() > 0
+                   and self._pending_rows() + entry.rows
+                   > self.cfg.queue_max_rows):
+                if not blocked:
+                    _BACKPRESSURE.labels(self.addr).inc()
+                    blocked = True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    _SHED.labels(self.addr).inc()
+                    raise IngestOverloadedError(
+                        f"ingest queue for datanode {self.addr} is "
+                        f"full ({self.cfg.queue_max_rows} rows) and did "
+                        f"not drain within {timeout:.1f}s"
+                    )
+            if self._closed:
+                raise IngestOverloadedError(
+                    f"ingest pipeline to {self.addr} is shut down"
+                )
+            self._queue.append(entry)
+            self._queued_rows += entry.rows
+            _QUEUED.labels(self.addr).set(self._queued_rows)
+            _SUBMITTED.labels(self.addr).inc()
+            _ROWS.labels(self.addr).inc(entry.rows)
+            self._cv.notify_all()
+
+    # ---- worker: pop -> coalesce -> encode -> send --------------------
+    def _take(self) -> list:
+        """Pop up to batch_max_rows of queued entries (caller holds
+        no lock). While idle, parks on 1s ticks so long-unused streams
+        can be closed — a datanode must be able to shut down gracefully
+        without waiting on parked ingest streams forever. Stream
+        teardown is a network round-trip, so it happens OUTSIDE the
+        condition lock (submit must never block on it)."""
+        while True:
+            idle_streams = []
+            taken = self._take_locked(idle_streams)
+            if not idle_streams:
+                return taken
+            self._close_streams(idle_streams)
+
+    def _take_locked(self, idle_streams: list) -> list:
+        with self._cv:
+            while not self._queue and not self._closed:
+                self._cv.wait(1.0)
+                if (not self._queue and not self._inflight
+                        and self._streams
+                        and time.monotonic() - self._last_send
+                        > self.cfg.idle_stream_s):
+                    # detach under the lock; caller closes outside it
+                    idle_streams.extend(self._detach_streams())
+                    return []
+            if not self._queue:
+                return []
+            # adaptive hold: a small backlog while the stream is busy
+            # waits briefly for more arrivals to fold in (group commit)
+            if (self._queued_rows < self.cfg.coalesce_min_rows
+                    and self._inflight and self._delay.current_s > 0):
+                self._cv.wait(self._delay.current_s)
+            # slice off the front in one move: per-element pop(0) is
+            # quadratic under backlog, all of it inside the lock
+            rows, k = 0, 0
+            while k < len(self._queue) and rows < self.cfg.batch_max_rows:
+                rows += self._queue[k].rows
+                k += 1
+            taken = self._queue[:k]
+            del self._queue[:k]
+            self._queued_rows -= rows
+            self._worker_rows = rows
+            _QUEUED.labels(self.addr).set(self._queued_rows)
+            self._cv.notify_all()
+            return taken
+
+    def _run(self):
+        while True:
+            taken = self._take()
+            if not taken:
+                self._finish_streams()
+                return
+            try:
+                self._ship(taken)
+            except Exception as e:  # noqa: BLE001 - worker must survive
+                self._complete_entries(
+                    taken, DatanodeUnavailableError(
+                        f"ingest worker for {self.addr}: {e}"
+                    )
+                )
+            finally:
+                # groups are registered in _inflight by now (or their
+                # tickets completed): hand accounting over
+                with self._cv:
+                    self._worker_rows = 0
+                    self._cv.notify_all()
+
+    def _ship(self, taken: list):
+        from greptimedb_tpu.dist.codec import write_to_batch
+
+        entries = coalesce_entries(taken)
+        self._delay.note_flush(
+            sum(e.rows for e in taken), self.cfg.coalesce_min_rows
+        )
+        # encode (overlaps the datanode applying earlier groups)
+        encoded = []
+        for e in entries:
+            batch = write_to_batch(
+                e.tag_columns, e.ts, e.fields, e.field_valid
+            )
+            meta = {
+                "region_id": e.region_id, "op": int(e.op),
+                "skip_wal": bool(e.skip_wal),
+            }
+            encoded.append((e, batch, meta))
+        # one wire group per schema (a region's table has one shape)
+        by_schema: dict[tuple, list] = {}
+        for item in encoded:
+            key = tuple(
+                (f.name, str(f.type)) for f in item[1].schema
+            )
+            by_schema.setdefault(key, []).append(item)
+        for key, items in by_schema.items():
+            self._send_group(key, items)
+
+    def _send_group(self, key: tuple, items: list):
+        group_entries = [e for e, _, _ in items]
+        rows = sum(e.rows for e in group_entries)
+        with self._cv:
+            while (len(self._inflight) >= self.cfg.max_inflight_groups
+                   and not self._closed):
+                self._cv.wait()
+            if self._closed:
+                pass  # still ship: close() drains via done_writing
+            gid = next(self._gid)
+            group = {"entries": group_entries, "rows": rows,
+                     "stream": None}
+            self._inflight[gid] = group
+            self._inflight_rows += rows
+            _INFLIGHT.labels(self.addr).set(len(self._inflight))
+        try:
+            stream = self._stream_for(key, items[0][1].schema)
+            with self._cv:
+                group["stream"] = stream
+            last = len(items) - 1
+            for i, (_e, batch, meta) in enumerate(items):
+                m = dict(meta, group=gid)
+                if i == last:
+                    m["end"] = True
+                stream.writer.write_with_metadata(
+                    batch, json.dumps(m).encode()
+                )
+            self._last_send = time.monotonic()
+            _BATCHES.labels(self.addr).inc()
+        except Exception as e:  # noqa: BLE001 - stream died mid-write
+            err = self._map_error(e)
+            self._fail_stream(self._streams.get(key), err)
+            # stream open may have failed before the group was bound to
+            # one; completing here is idempotent with _fail_stream
+            self._complete_group(gid, err)
+
+    # ---- stream lifecycle --------------------------------------------
+    def _stream_for(self, key: tuple, schema) -> _Stream:
+        import pyarrow.flight as flight
+
+        st = self._streams.get(key)
+        if st is not None and st.alive:
+            return st
+        writer, reader = self.client._client().do_put(
+            flight.FlightDescriptor.for_path(STREAM_DESCRIPTOR), schema
+        )
+        st = _Stream(key, writer, reader)
+        self._streams[key] = st
+        threading.Thread(
+            target=self._ack_loop, args=(st,), daemon=True,
+            name=f"ingest-ack-{self.addr}",
+        ).start()
+        return st
+
+    def _ack_loop(self, stream: _Stream):
+        while True:
+            try:
+                buf = stream.reader.read()
+            except StopIteration:
+                break
+            except Exception as e:  # noqa: BLE001 - stream died
+                self._fail_stream(stream, self._map_error(e))
+                return
+            if buf is None:
+                break
+            try:
+                ack = json.loads(bytes(buf))
+            except Exception:  # noqa: BLE001 - malformed ack
+                continue
+            self._complete_group(int(ack.get("group", 0)),
+                                 _ack_error(ack))
+        # clean end-of-stream: any group still unacked is unknown-state
+        self._fail_stream(stream, DatanodeUnavailableError(
+            f"ingest stream to {self.addr} closed before ack"
+        ))
+
+    def _map_error(self, e: Exception) -> GreptimeError:
+        from greptimedb_tpu.dist.client import map_flight_error
+
+        if isinstance(e, GreptimeError):
+            return e
+        return map_flight_error(e, self.addr)
+
+    def _fail_stream(self, stream: _Stream | None, error: GreptimeError):
+        """Fail every group in flight on `stream` and drop it; the next
+        group opens a fresh stream (the channel itself redials)."""
+        if stream is None or not stream.alive:
+            return
+        with self._cv:
+            if not stream.alive:
+                return
+            stream.alive = False
+            if self._streams.get(stream.key) is stream:
+                del self._streams[stream.key]
+            gids = [g for g, grp in self._inflight.items()
+                    if grp["stream"] is stream]
+        _RECONNECTS.labels(self.addr).inc()
+        try:
+            stream.writer.close()
+        except Exception:  # noqa: BLE001 - already broken
+            pass
+        if isinstance(error, DatanodeUnavailableError):
+            # failover may have moved this node's regions: force the
+            # shared channel to redial on next use
+            try:
+                self.client.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for gid in gids:
+            self._complete_group(gid, error)
+
+    def _detach_streams(self) -> list:
+        """Caller holds self._cv: mark every stream dead and unhook it,
+        so each ack thread's end-of-stream reads as a CLEAN close (no
+        error counter, no shared-channel teardown)."""
+        out = list(self._streams.values())
+        for st in out:
+            st.alive = False
+        self._streams.clear()
+        return out
+
+    @staticmethod
+    def _close_streams(streams: list):
+        for st in streams:
+            try:
+                st.writer.done_writing()
+                st.writer.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+
+    def _finish_streams(self):
+        with self._cv:
+            streams = self._detach_streams()
+        self._close_streams(streams)
+
+    # ---- completion ---------------------------------------------------
+    def _complete_group(self, gid: int, error: GreptimeError | None):
+        with self._cv:
+            group = self._inflight.pop(gid, None)
+            if group is None:
+                return
+            self._inflight_rows -= group["rows"]
+            _INFLIGHT.labels(self.addr).set(len(self._inflight))
+            self._cv.notify_all()
+        self._complete_entries(group["entries"], error)
+
+    def _complete_entries(self, entries: list, error):
+        if error is not None and self._on_group_error is not None:
+            try:
+                if self._on_group_error(entries, error):
+                    return  # requeued: tickets stay pending
+            except Exception:  # noqa: BLE001 - policy must not wedge acks
+                pass
+        for e in entries:
+            tickets = e.tickets or (
+                [e.ticket] if e.ticket is not None else []
+            )
+            for t in tickets:
+                t.part_done(error)
+
+    # ---- drain / close -----------------------------------------------
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait for the queue and in-flight groups to empty."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while (self._queued_rows or self._inflight
+                   or self._worker_rows):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    return False
+            return True
+
+    def close(self, *, drain_timeout: float = 10.0):
+        self.drain(drain_timeout)
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._worker.join(timeout=5.0)
+        self._finish_streams()

@@ -199,6 +199,11 @@ class Standalone:
     def close(self):
         if self.flows is not None:
             self.flows.stop()
+        # fence the region server FIRST: a parked ingest stream must
+        # get typed errors, not apply writes into a closing engine
+        rs = getattr(self, "region_server", None)
+        if rs is not None and hasattr(rs, "close"):
+            rs.close()
         self.engine.close()
 
     # ------------------------------------------------------------------

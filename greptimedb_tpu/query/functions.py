@@ -27,18 +27,20 @@ _TRUNC_UNIT_MS = {
 
 
 def _interval_months(arg) -> int | None:
-    """Total months when `arg` is an INTERVAL literal made ONLY of
-    year/month units (calendar arithmetic applies); None otherwise."""
+    """Total SIGNED months when `arg` is an INTERVAL literal made ONLY
+    of year/month units (calendar arithmetic applies); None otherwise.
+    The sign must survive: date_add(ts, INTERVAL '-1 month') subtracts."""
     import re as _re
 
     if not isinstance(arg, A.IntervalLit):
         return None
     raw = (arg.raw or "").lower()
-    parts = _re.findall(r"(\d+)\s*([a-z]+)", raw)
+    parts = _re.findall(r"(-?\s*\d+)\s*([a-z]+)", raw)
     if not parts:
         return None
     months = 0
     for num, unit in parts:
+        num = num.replace(" ", "")
         if unit.startswith("year") or unit == "y":
             months += int(num) * 12
         elif unit.startswith("mon"):

@@ -110,8 +110,42 @@ def _host_reduce(op: str, values, valid, gid, g: int, q: float | None,
     if op == "sum" and values.dtype.kind in "iu":
         present = np.zeros(g, bool)
         present[gid[valid]] = True
+        vals = values[valid]
+        gg = gid[valid]
         out = np.zeros(g, np.int64)
-        np.add.at(out, gid[valid], values[valid].astype(np.int64))
+        if vals.size:
+            info64 = np.iinfo(np.int64)
+            infov = np.iinfo(vals.dtype)
+            mag_dtype = max(abs(int(infov.max)), abs(int(infov.min)))
+            # cheapest-first safety ladder, so the common case costs
+            # nothing extra: (1) dtype bound — no data pass at all
+            # (int8/16/32 with any realistic row count clear here);
+            # (2) observed-extremes bound with size as the group-count
+            # cap — one max+min pass; (3) only then the exact path
+            safe = vals.size * mag_dtype <= info64.max
+            if not safe:
+                vmax, vmin = int(vals.max()), int(vals.min())
+                safe = (vmax <= info64.max
+                        and vals.size * max(abs(vmax), abs(vmin))
+                        <= info64.max)
+            if safe:
+                np.add.at(out, gg, vals.astype(np.int64))
+            else:
+                # exact big-int accumulation: uint64 above 2^63 stays
+                # exact (no mis-cast to negative) and true overflow is
+                # DETECTED instead of silently wrapping
+                from greptimedb_tpu.errors import ArithmeticOverflowError
+
+                exact = np.zeros(g, object)
+                np.add.at(exact, gg, np.asarray(vals.tolist(), object))
+                hi = max(exact[present], default=0)
+                lo = min(exact[present], default=0)
+                if hi > info64.max or lo < info64.min:
+                    raise ArithmeticOverflowError(
+                        f"SUM overflows BIGINT: group total {hi if hi > info64.max else lo} "
+                        f"is outside [{info64.min}, {info64.max}]"
+                    )
+                out[present] = exact[present].astype(np.int64)
         return out, present
 
     v = values.astype(np.float64, copy=False)

@@ -21,7 +21,21 @@ import pyarrow as pa
 import pyarrow.flight as flight
 
 from greptimedb_tpu.datatypes.batch import HostColumn
+from greptimedb_tpu.errors import GreptimeError
 from greptimedb_tpu.session import QueryContext
+
+
+def wrap_flight_error(e: Exception) -> flight.FlightServerError:
+    """Stamp a typed engine error's status code onto the Flight message
+    (`[gtdb:<code>]`) so the far side re-raises the dedicated class
+    instead of substring-matching text (dist/client.py
+    map_flight_error)."""
+    msg = str(e) or type(e).__name__
+    if isinstance(e, GreptimeError):
+        return flight.FlightServerError(
+            f"[gtdb:{int(e.status_code)}] {msg}"
+        )
+    return flight.FlightServerError(msg)
 
 
 def result_to_arrow(res) -> pa.Table:
@@ -180,11 +194,11 @@ class FlightServer(flight.FlightServerBase):
                 except flight.FlightServerError:
                     raise
                 except Exception as e:  # noqa: BLE001 - RPC boundary
-                    raise flight.FlightServerError(str(e)) from e
+                    raise wrap_flight_error(e) from e
             try:
                 table = self._run_sql(sql)
             except Exception as e:  # noqa: BLE001 - RPC boundary
-                raise flight.FlightServerError(str(e)) from e
+                raise wrap_flight_error(e) from e
         return flight.RecordBatchStream(table)
 
     # ---- region server (distributed data plane) -----------------------
@@ -239,7 +253,7 @@ class FlightServer(flight.FlightServerBase):
         except flight.FlightServerError:
             raise
         except Exception as e:  # noqa: BLE001 - RPC boundary
-            raise flight.FlightServerError(str(e)) from e
+            raise wrap_flight_error(e) from e
         return [flight.Result(json.dumps(out or {}).encode())]
 
     def _do_action(self, kind: str, body: dict) -> dict | None:
@@ -338,7 +352,7 @@ class FlightServer(flight.FlightServerBase):
             try:
                 inst.flows.on_insert(db, tname, table, data, valid)
             except Exception as e:  # noqa: BLE001 - RPC boundary
-                raise flight.FlightServerError(str(e)) from e
+                raise wrap_flight_error(e) from e
 
     def list_actions(self, context):
         return [
@@ -358,7 +372,7 @@ class FlightServer(flight.FlightServerBase):
         try:
             table = self._run_sql(sql)
         except Exception as e:  # noqa: BLE001
-            raise flight.FlightServerError(str(e)) from e
+            raise wrap_flight_error(e) from e
         with self._pending_lock:
             if len(self._pending) >= 32:
                 self._pending.pop(next(iter(self._pending)))
@@ -376,6 +390,8 @@ class FlightServer(flight.FlightServerBase):
         name = path[0].decode("utf-8")
         if name == "region_write":
             return self._do_put_regions(reader)
+        if name == "region_write_stream":
+            return self._do_put_region_stream(reader, writer)
         if name.startswith("flow_mirror:"):
             return self._do_put_flow_mirror(name[12:], reader)
         inst = self.instance
@@ -400,7 +416,7 @@ class FlightServer(flight.FlightServerBase):
             try:
                 inst._write_columns(table, data, valid)
             except Exception as e:  # noqa: BLE001 - RPC boundary
-                raise flight.FlightServerError(str(e)) from e
+                raise wrap_flight_error(e) from e
             inst._notify_flows(db, name, table, data, valid)
 
     def _do_put_regions(self, reader):
@@ -429,16 +445,67 @@ class FlightServer(flight.FlightServerBase):
                 (meta, dist_codec.batch_to_write(chunk.data))
             )
         try:
-            for meta, _decoded in batches:
-                rs._region(int(meta["region_id"]))  # not-found raises
-            for meta, (tag_columns, ts, fields, valids) in batches:
-                rs.write(
-                    int(meta["region_id"]), tag_columns, ts, fields,
-                    valids, op=int(meta.get("op", 0) or 0),
-                    skip_wal=bool(meta.get("skip_wal", False)),
-                )
+            self._apply_region_batches(rs, batches)
         except Exception as e:  # noqa: BLE001 - RPC boundary
-            raise flight.FlightServerError(str(e)) from e
+            raise wrap_flight_error(e) from e
+
+    @staticmethod
+    def _apply_region_batches(rs, batches):
+        """Validate every region id BEFORE applying anything, so route
+        staleness (a region migrated away) rejects the group before any
+        write — the property the frontend's dedup-safe retry relies on."""
+        for meta, _decoded in batches:
+            rs._region(int(meta["region_id"]))  # not-found raises
+        rows = 0
+        for meta, (tag_columns, ts, fields, valids) in batches:
+            rows += rs.write(
+                int(meta["region_id"]), tag_columns, ts, fields,
+                valids, op=int(meta.get("op", 0) or 0),
+                skip_wal=bool(meta.get("skip_wal", False)),
+            )
+        return rows
+
+    def _do_put_region_stream(self, reader, writer):
+        """Long-lived pipelined ingest stream (ingest/sender.py): the
+        client writes batch GROUPS (the last batch of a group carries
+        `end: true`); each group is validated + applied as a unit and
+        acknowledged through the metadata side channel. Apply errors
+        ride the ack — typed via their status code — so one stale
+        route does not kill the stream for the other regions riding
+        it."""
+        import json
+
+        from greptimedb_tpu.dist import codec as dist_codec
+        from greptimedb_tpu.errors import GreptimeError
+
+        rs = self._region_server()
+        pending = []
+        for chunk in reader:
+            if chunk.data is None:
+                continue
+            meta = json.loads(
+                chunk.app_metadata.to_pybytes()
+                if chunk.app_metadata else b"{}"
+            )
+            pending.append(
+                (meta, dist_codec.batch_to_write(chunk.data))
+            )
+            if not meta.get("end"):
+                continue
+            gid = meta.get("group", 0)
+            batches, pending = pending, []
+            try:
+                rows = self._apply_region_batches(rs, batches)
+                ack = {"group": gid, "rows": rows}
+            except Exception as e:  # noqa: BLE001 - ack carries it
+                code = 0
+                if isinstance(e, GreptimeError):
+                    code = int(e.status_code)
+                ack = {
+                    "group": gid, "error": str(e) or type(e).__name__,
+                    "code": code,
+                }
+            writer.write(pa.py_buffer(json.dumps(ack).encode()))
 
 
 class FlightFrontend:
@@ -460,5 +527,23 @@ class FlightFrontend:
         self._thread.start()
         return self
 
-    def close(self):
-        self.server.shutdown()
+    def close(self, *, grace_s: float = 5.0):
+        """Shut the server down with a BOUNDED wait: pyarrow's
+        shutdown() blocks until every in-flight handler returns, and a
+        parked long-lived ingest stream (ingest/sender.py) only ends
+        when its client side closes — which a hard-stopped test
+        topology never does. After the grace period the daemon serve
+        thread is abandoned; the engine teardown behind it makes any
+        zombie handler fail its acks, which clients surface as the
+        retryable unavailable error."""
+        done = threading.Event()
+
+        def _shutdown():
+            try:
+                self.server.shutdown()
+            finally:
+                done.set()
+
+        threading.Thread(target=_shutdown, daemon=True,
+                         name="flight-shutdown").start()
+        done.wait(grace_s)

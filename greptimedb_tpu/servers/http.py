@@ -297,7 +297,17 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                         return
                 self._route_request(method, path)
             except GreptimeError as e:
-                self._error(400, str(e))
+                from greptimedb_tpu.errors import StatusCode
+
+                # backpressure from the ingest dataplane sheds with 429
+                # (clients back off + retry); an unreachable storage
+                # layer is the server's fault: 503
+                http_code = {
+                    StatusCode.RATE_LIMITED: 429,
+                    StatusCode.RUNTIME_RESOURCES_EXHAUSTED: 429,
+                    StatusCode.STORAGE_UNAVAILABLE: 503,
+                }.get(e.status_code, 400)
+                self._error(http_code, str(e))
             except BrokenPipeError:
                 pass
             except Exception as e:

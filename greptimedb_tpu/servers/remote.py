@@ -110,10 +110,11 @@ class RemoteInstance:
             reader = self._client(self.addrs[0]).do_get(ticket)
             table = reader.read_all()
         except flight.FlightError as e:
-            # surface the datanode's message, not the gRPC wrapper
-            msg = str(e).split("gRPC client debug context")[0]
-            msg = msg.split(". Detail: Failed")[0].strip().rstrip(". ")
-            raise GreptimeError(msg) from None
+            # surface the datanode's message (typed when it carries a
+            # status-code marker), not the gRPC wrapper
+            from greptimedb_tpu.dist.client import map_flight_error
+
+            raise map_flight_error(e, self.addrs[0]) from None
         meta = table.schema.metadata or {}
         if meta.get(b"gtdb:affected") == b"1":
             return [Output(

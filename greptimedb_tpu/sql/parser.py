@@ -16,7 +16,7 @@ from greptimedb_tpu.sql import ast as A
 from greptimedb_tpu.sql.lexer import Tok, Token, tokenize
 
 _INTERVAL_RE = re.compile(
-    r"^\s*(\d+(?:\.\d+)?)\s*(nanosecond|microsecond|millisecond|second|minute|"
+    r"^\s*(-?\s*\d+(?:\.\d+)?)\s*(nanosecond|microsecond|millisecond|second|minute|"
     r"hour|day|week|month|year|ns|us|ms|s|m|h|d|w|y)s?\s*$",
     re.IGNORECASE,
 )
@@ -35,13 +35,16 @@ _UNIT_MS = {
 
 
 def parse_interval_ms(text: str) -> int:
-    """'5 minutes', '1h', '30s', also compound '1 hour 30 minutes'."""
+    """'5 minutes', '1h', '30s', also compound '1 hour 30 minutes';
+    per-part signs carry through ('-1 day' < 0, '1 day -1 hour'),
+    including a space-separated sign ('- 1 day')."""
     total = 0.0
     parts = re.findall(
-        r"(\d+(?:\.\d+)?)\s*([a-zA-Z]+)", text
+        r"(-?\s*\d+(?:\.\d+)?)\s*([a-zA-Z]+)", text
     )
     if not parts:
         raise InvalidSyntaxError(f"bad interval: {text!r}")
+    parts = [(num.replace(" ", ""), unit) for num, unit in parts]
     for num, unit in parts:
         unit = unit.lower().rstrip("s") if unit.lower() not in ("s", "ns", "us", "ms") else unit.lower()
         if unit not in _UNIT_MS:
@@ -350,7 +353,13 @@ class Parser:
             expire = None
             if self.eat_kw("EXPIRE"):
                 self.expect_kw("AFTER")
-                expire = parse_interval_ms(self._interval_text()) // 1000
+                expire_ms = parse_interval_ms(self._interval_text())
+                if expire_ms <= 0:
+                    raise InvalidSyntaxError(
+                        "EXPIRE AFTER interval must be positive"
+                    )
+                # ceil so a positive sub-second interval stays positive
+                expire = (expire_ms + 999) // 1000
             comment = None
             if self.eat_kw("COMMENT"):
                 comment = self.next().text
@@ -956,6 +965,10 @@ class Parser:
     def align_clause(self) -> A.RangeClause:
         self.expect_kw("ALIGN")
         align_ms = parse_interval_ms(self._interval_text())
+        if align_ms <= 0:
+            raise InvalidSyntaxError(
+                "ALIGN interval must be positive"
+            )
         to = None
         if self.eat_kw("TO"):
             to = self.next().text
@@ -1300,6 +1313,10 @@ class Parser:
                 )
             self.next()
             range_ms = parse_interval_ms(self._interval_text())
+            if range_ms <= 0:
+                raise InvalidSyntaxError(
+                    "RANGE interval must be positive"
+                )
             fill = None
             if self.at_kw("FILL"):
                 self.next()

@@ -1,0 +1,41 @@
+-- signed month/year intervals must carry their sign through calendar
+-- arithmetic (date_add with INTERVAL '-1 month' SUBTRACTS), with
+-- end-of-month clamping intact (ADVICE r5)
+SELECT date_add(to_timestamp_millis(0), INTERVAL '-1 month');
+----
+date_add(to_timestamp_millis(0), INTERVAL '-1 month')
+-2678400000
+
+SELECT date_sub(to_timestamp_millis(0), INTERVAL '-1 month');
+----
+date_sub(to_timestamp_millis(0), INTERVAL '-1 month')
+2678400000
+
+-- 2024-03-31 minus one month clamps to 2024-02-29 (leap year)
+SELECT date_add(TIMESTAMP '2024-03-31 00:00:00', INTERVAL '-1 month');
+----
+date_add(CAST('2024-03-31 00:00:00' AS timestamp_ms), INTERVAL '-1 month')
+1709164800000
+
+-- 2024-02-29 minus one year clamps to 2023-02-28
+SELECT date_add(TIMESTAMP '2024-02-29 00:00:00', INTERVAL '-1 year');
+----
+date_add(CAST('2024-02-29 00:00:00' AS timestamp_ms), INTERVAL '-1 year')
+1677542400000
+
+-- mixed signs total 11 months (1970-12-01)
+SELECT date_add(to_timestamp_millis(0), INTERVAL '1 year -1 month');
+----
+date_add(to_timestamp_millis(0), INTERVAL '1 year -1 month')
+28857600000
+
+-- fixed-span units keep their sign too
+SELECT date_add(to_timestamp_millis(0), INTERVAL '-1 day');
+----
+date_add(to_timestamp_millis(0), INTERVAL '-1 day')
+-86400000
+
+SELECT to_timestamp_millis(3600000) + INTERVAL '-1 hour';
+----
+to_timestamp_millis(3600000) + INTERVAL '-1 hour'
+0

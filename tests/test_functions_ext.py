@@ -92,3 +92,120 @@ def test_net_functions(inst):
     r = inst.sql("SELECT ts FROM nt WHERE ipv4_in_range(ip, "
                  "'192.168.0.0/16')")
     assert [row[0] for row in r.rows()] == [2]
+
+
+# ----------------------------------------------------------------------
+# signed intervals (ADVICE r5: date_add(ts, INTERVAL '-1 month') must
+# subtract, not add)
+# ----------------------------------------------------------------------
+
+def test_parse_interval_ms_signed():
+    from greptimedb_tpu.sql.parser import parse_interval_ms
+
+    assert parse_interval_ms("-90 minutes") == -5_400_000
+    assert parse_interval_ms("-1h") == -3_600_000
+    assert parse_interval_ms("1 day -1 hour") == 82_800_000
+    # space-separated sign must not silently drop
+    assert parse_interval_ms("- 1 day") == -86_400_000
+
+
+def test_interval_months_signed():
+    from greptimedb_tpu.query.functions import _interval_months
+    from greptimedb_tpu.sql import ast as A
+
+    def months(raw):
+        return _interval_months(A.IntervalLit(0, raw))
+
+    assert months("-1 month") == -1
+    assert months("-2 years") == -24
+    assert months("1 year -1 month") == 11
+    assert months("- 1 month") == -1  # space-separated sign
+    assert months("-1 day") is None  # fixed-span path, not calendar
+
+
+def test_date_add_negative_month_over_table(inst):
+    inst.sql("CREATE TABLE sd (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+    # 2024-03-31: minus 1 month clamps to 2024-02-29 (leap year)
+    inst.sql("INSERT INTO sd VALUES (1711843200000, 1.0)")
+    r = inst.sql("SELECT date_add(ts, INTERVAL '-1 month') FROM sd")
+    assert r.rows()[0][0] == 1709164800000
+    # date_sub of a negative interval ADDS
+    r = inst.sql("SELECT date_sub(ts, INTERVAL '-1 month') FROM sd")
+    assert r.rows()[0][0] == 1714435200000  # 2024-04-30 (clamped)
+
+
+def test_negative_range_interval_rejected(inst):
+    from greptimedb_tpu.errors import InvalidSyntaxError
+
+    inst.sql("CREATE TABLE nr (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+    with pytest.raises(InvalidSyntaxError):
+        inst.sql("SELECT ts, avg(v) RANGE '-1h' FROM nr ALIGN '1h'")
+    with pytest.raises(InvalidSyntaxError):
+        inst.sql("SELECT ts, avg(v) RANGE '1h' FROM nr ALIGN '-1h'")
+
+
+# ----------------------------------------------------------------------
+# integer SUM overflow detection (ADVICE r5: raise, don't wrap)
+# ----------------------------------------------------------------------
+
+def test_sum_bigint_overflow_raises(inst):
+    from greptimedb_tpu.errors import ArithmeticOverflowError
+
+    inst.sql("CREATE TABLE so (ts TIMESTAMP TIME INDEX, n BIGINT)")
+    big = 2**63 - 1
+    inst.sql(f"INSERT INTO so VALUES (1, {big}), (2, {big})")
+    with pytest.raises(ArithmeticOverflowError, match="overflows"):
+        inst.sql("SELECT sum(n) FROM so")
+
+
+def test_sum_uint64_above_int63_raises_not_wraps(inst):
+    from greptimedb_tpu.errors import ArithmeticOverflowError
+
+    inst.sql("CREATE TABLE su (ts TIMESTAMP TIME INDEX, "
+             "n BIGINT UNSIGNED)")
+    inst.sql(f"INSERT INTO su VALUES (1, {2**63 - 1}), (2, 100)")
+    # the old path wrapped the int64 accumulator silently
+    with pytest.raises(ArithmeticOverflowError):
+        inst.sql("SELECT sum(n) FROM su")
+
+
+def test_reduce_uint64_value_above_int63_raises():
+    """A single uint64 value above 2^63 used to mis-cast negative via
+    .astype(int64); the exact path must raise instead."""
+    from greptimedb_tpu.errors import ArithmeticOverflowError
+    from greptimedb_tpu.query.reduce import _host_reduce
+
+    vals = np.asarray([2**63 + 10, 5], np.uint64)
+    valid = np.ones(2, bool)
+    gid = np.zeros(2, np.int64)
+    with pytest.raises(ArithmeticOverflowError):
+        _host_reduce("sum", vals, valid, gid, 1, None)
+    # big-but-representable uint64 sums stay exact
+    vals = np.asarray([2**62, 2**61], np.uint64)
+    out, present = _host_reduce("sum", vals, valid, gid, 1, None)
+    assert int(out[0]) == 2**62 + 2**61 and bool(present[0])
+
+
+def test_sum_bigint_exact_above_2_53(inst):
+    """Sums past float53 but inside int64 must stay exact (the safety
+    bound falls back to exact big-int accumulation, not a raise)."""
+    inst.sql("CREATE TABLE se (ts TIMESTAMP TIME INDEX, n BIGINT, "
+             "g STRING PRIMARY KEY)")
+    a = 2**62
+    inst.sql(f"INSERT INTO se (ts, g, n) VALUES (1, 'x', {a}), "
+             f"(2, 'x', 1), (3, 'y', -5)")
+    r = inst.sql("SELECT g, sum(n) FROM se GROUP BY g ORDER BY g")
+    assert r.rows() == [["x", a + 1], ["y", -5]]
+
+
+def test_negative_ttl_and_window_rejected(inst):
+    """Signed interval parsing must not let a negative TTL through —
+    it would compute a cutoff in the future and expire everything."""
+    from greptimedb_tpu.errors import GreptimeError
+
+    with pytest.raises(GreptimeError, match="positive"):
+        inst.sql("CREATE TABLE nt1 (ts TIMESTAMP TIME INDEX, v DOUBLE) "
+                 "WITH (ttl = '-1 day')")
+    with pytest.raises(GreptimeError, match="positive"):
+        inst.sql("CREATE TABLE nt2 (ts TIMESTAMP TIME INDEX, v DOUBLE) "
+                 "WITH ('compaction.twcs.time_window' = '-1h')")

@@ -1,0 +1,459 @@
+"""Pipelined wire-ingest dataplane (greptimedb_tpu/ingest/).
+
+Covers the PR-1 contract: coalescer thresholds, bounded-queue
+backpressure surfacing IngestOverloadedError with bounded frontend
+memory, typed errors across the Flight boundary, the region-not-found
+route-refresh retry, and crash-mid-stream dedup-idempotent replay.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytest.importorskip("pyarrow.flight")
+
+from greptimedb_tpu.dist.client import DatanodeClient, MetaClient
+from greptimedb_tpu.dist.frontend import DistInstance
+from greptimedb_tpu.dist.region_server import RegionServer
+from greptimedb_tpu.errors import (
+    FlowNotFoundError,
+    IngestOverloadedError,
+    RegionNotFoundError,
+)
+from greptimedb_tpu.ingest import (
+    AdaptiveDelay,
+    IngestConfig,
+    IngestEntry,
+    IngestPipeline,
+    WriteTicket,
+    coalesce_entries,
+)
+from greptimedb_tpu.ingest.sender import DatanodeSender
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.servers.flight import FlightFrontend
+from greptimedb_tpu.servers.meta_http import MetasrvServer
+from greptimedb_tpu.storage.engine import EngineConfig
+
+
+# ----------------------------------------------------------------------
+# unit: coalescer
+# ----------------------------------------------------------------------
+
+def _entry(rid=1, n=3, base_ts=0, client=None, op=0, skip_wal=False,
+           valid=None, ticket=None):
+    return IngestEntry(
+        region_id=rid, client=client,
+        tag_columns={"host": np.asarray([f"h{i}" for i in range(n)],
+                                        object)},
+        ts=np.arange(base_ts, base_ts + n, dtype=np.int64),
+        fields={"v": np.arange(n, dtype=np.float64)},
+        field_valid=valid, op=op, skip_wal=skip_wal, ticket=ticket,
+    )
+
+
+def test_coalesce_merges_same_region_in_order():
+    t1, t2 = WriteTicket(), WriteTicket()
+    out = coalesce_entries([
+        _entry(rid=1, n=2, base_ts=0, ticket=t1),
+        _entry(rid=1, n=3, base_ts=100, ticket=t2),
+    ])
+    assert len(out) == 1
+    m = out[0]
+    assert m.rows == 5
+    # order preserved: the second submit's rows stay LATER
+    assert list(m.ts) == [0, 1, 100, 101, 102]
+    assert m.tickets == [t1, t2]
+
+
+def test_coalesce_keeps_incompatible_entries_apart():
+    out = coalesce_entries([
+        _entry(rid=1), _entry(rid=2),             # different region
+        _entry(rid=1, op=1),                      # different op
+        _entry(rid=1, skip_wal=True),             # different durability
+    ])
+    assert len(out) == 4
+
+
+def test_coalesce_merges_partial_validity():
+    v = {"v": np.asarray([True, False, True])}
+    out = coalesce_entries([
+        _entry(rid=1, n=3),            # fully valid (no mask)
+        _entry(rid=1, n=3, valid=v),
+    ])
+    assert len(out) == 1
+    mask = out[0].field_valid["v"]
+    assert list(mask) == [True, True, True, True, False, True]
+
+
+def test_adaptive_delay_widens_and_narrows():
+    d = AdaptiveDelay(max_delay_s=0.008)
+    assert d.current_s == 0.0
+    d.note_flush(10, target_rows=1000)   # undersized flush: widen
+    first = d.current_s
+    assert first > 0
+    for _ in range(20):
+        d.note_flush(10, target_rows=1000)
+    assert d.current_s == 0.008          # capped at max
+    d.note_flush(5000, target_rows=1000)  # at-target: narrow
+    assert d.current_s < 0.008
+    for _ in range(20):
+        d.note_flush(5000, target_rows=1000)
+    assert d.current_s == 0.0            # back to zero added latency
+
+
+def test_write_ticket_timeout_raises_unknown_outcome():
+    """An unacked ticket times out as the unavailable (unknown-outcome)
+    error, NOT the retry-inviting IngestOverloadedError — the group may
+    still apply when the datanode recovers."""
+    from greptimedb_tpu.errors import DatanodeUnavailableError
+
+    t = WriteTicket()
+    t.add_parts(1)
+    with pytest.raises(DatanodeUnavailableError):
+        t.wait(0.05)
+    t.part_done()
+    assert t.wait(0.05) == []
+
+
+# ----------------------------------------------------------------------
+# unit: sender backpressure (transport stubbed out)
+# ----------------------------------------------------------------------
+
+class _FakeClient:
+    addr = "stub:0"
+
+    def close(self):
+        pass
+
+
+def test_sender_backpressure_bounds_queue_and_sheds(monkeypatch):
+    release = threading.Event()
+    shipped = []
+
+    def stalled_ship(self, taken):
+        shipped.append(sum(e.rows for e in taken))
+        release.wait(10.0)
+
+    monkeypatch.setattr(DatanodeSender, "_ship", stalled_ship)
+    cfg = IngestConfig(queue_max_rows=10, block_timeout_s=0.1)
+    sender = DatanodeSender(_FakeClient(), cfg)
+    try:
+        sender.submit(_entry(n=8))   # worker takes it, stalls in _ship
+        deadline = time.monotonic() + 5
+        while not shipped and time.monotonic() < deadline:
+            time.sleep(0.01)
+        sender.submit(_entry(n=8))   # queued (queue empty, oversized ok)
+        t0 = time.monotonic()
+        with pytest.raises(IngestOverloadedError):
+            sender.submit(_entry(n=8))   # over budget: block then shed
+        assert time.monotonic() - t0 >= 0.09
+        # frontend memory stays bounded by the queue budget
+        assert sender._queued_rows <= cfg.queue_max_rows
+    finally:
+        release.set()
+        sender.close(drain_timeout=0.1)
+
+
+# ----------------------------------------------------------------------
+# wire harness
+# ----------------------------------------------------------------------
+
+class MiniCluster:
+    def __init__(self, tmp_path, n=2, *, store=None, wal_backend="fs",
+                 ingest_options=None):
+        self.tmp_path = tmp_path
+        self.store = store
+        self.wal_backend = wal_backend
+        self.meta = MetasrvServer(
+            addr="127.0.0.1", port=0, data_home=str(tmp_path / "meta")
+        ).start()
+        self.meta_addr = f"127.0.0.1:{self.meta.port}"
+        self.datanodes = {}
+        for i in range(n):
+            self.start_datanode(i)
+        self.frontend = DistInstance(
+            str(tmp_path / "fe"), self.meta_addr, prefer_device=False,
+            ingest_options=ingest_options,
+        )
+
+    def start_datanode(self, i):
+        home = str(self.tmp_path / f"dn{i}")
+        inst = Standalone(
+            engine_config=EngineConfig(data_root=home,
+                                       enable_background=False,
+                                       wal_backend=self.wal_backend),
+            prefer_device=False, warm_start=False, store=self.store,
+        )
+        inst.region_server = RegionServer(inst.engine, home)
+        fs = FlightFrontend(inst, port=0).start()
+        MetaClient(self.meta_addr).register(
+            i, f"127.0.0.1:{fs.server.port}"
+        )
+        self.datanodes[i] = (inst, fs)
+        return inst, fs
+
+    def stop_datanode(self, i):
+        inst, fs = self.datanodes.pop(i)
+        fs.close()
+        inst.close()
+
+    def close(self):
+        self.frontend.close()
+        for i in list(self.datanodes):
+            self.stop_datanode(i)
+        self.meta.close()
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = MiniCluster(tmp_path)
+    yield c
+    c.close()
+
+
+def _seed_table(fe, name="t", regions=2):
+    fe.execute_sql(
+        f"create table {name} (ts timestamp time index, host string "
+        f"primary key, v double) with (num_regions = {regions})"
+    )
+
+
+# ----------------------------------------------------------------------
+# typed errors across the Flight boundary
+# ----------------------------------------------------------------------
+
+def test_region_not_found_is_typed_across_the_wire(cluster):
+    _, fs = cluster.datanodes[0]
+    cli = DatanodeClient(f"127.0.0.1:{fs.server.port}")
+    try:
+        with pytest.raises(RegionNotFoundError):
+            cli.flush_region(99_999_999)
+    finally:
+        cli.close()
+
+
+def test_flow_not_found_is_typed_across_the_wire(cluster):
+    fe = cluster.frontend
+    fe.flownode_addr = None
+    with pytest.raises(FlowNotFoundError):
+        fe.execute_sql("admin flush_flow('no_such_flow')")
+
+
+def test_writes_ride_the_pipeline_and_read_back(cluster):
+    fe = cluster.frontend
+    _seed_table(fe)
+    table = fe.catalog.table("public", "t")
+    assert table.ingest is not None
+    n = 4000
+    hosts = np.asarray([f"h{i % 37}" for i in range(n)], object)
+    ts = np.arange(n, dtype=np.int64) * 1000
+    table.write({"host": hosts}, ts, {"v": np.ones(n)})
+    assert fe.sql("select count(v), sum(v) from t").rows() == [[n, float(n)]]
+    # concurrent small writes coalesce and all land
+    errs = []
+
+    def worker(k):
+        try:
+            for j in range(10):
+                t0 = 10_000_000 + (k * 10 + j) * 1000
+                fe.execute_sql(
+                    f"insert into t (host, ts, v) values "
+                    f"('w{k}', {t0}, 1.0)"
+                )
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    assert fe.sql("select count(v) from t").rows() == [[n + 80]]
+    assert table.ingest.flush(timeout=10.0)
+
+
+def test_delete_routes_through_pipeline(cluster):
+    fe = cluster.frontend
+    _seed_table(fe)
+    fe.execute_sql(
+        "insert into t (host, ts, v) values ('a', 1000, 1.0), "
+        "('b', 2000, 2.0)"
+    )
+    fe.execute_sql("delete from t where host = 'a'")
+    assert fe.sql("select host from t").rows() == [["b"]]
+
+
+# ----------------------------------------------------------------------
+# backpressure: a stalled datanode bounds memory + sheds typed
+# ----------------------------------------------------------------------
+
+class _StalledFlightServer:
+    """Accepts the ingest stream but never acks a group."""
+
+    def __init__(self):
+        import pyarrow.flight as flight
+
+        stop = threading.Event()
+
+        class Srv(flight.FlightServerBase):
+            def do_put(self, context, descriptor, reader, writer):
+                stop.wait(30.0)  # never ack; release on close
+
+        self._stop = stop
+        self.server = Srv("grpc://127.0.0.1:0")
+        self.addr = f"127.0.0.1:{self.server.port}"
+
+    def close(self):
+        self._stop.set()
+        self.server.shutdown()
+
+
+def test_stalled_datanode_bounds_memory_and_sheds():
+    from greptimedb_tpu.errors import DatanodeUnavailableError
+
+    srv = _StalledFlightServer()
+    cli = DatanodeClient(srv.addr)
+    cfg = IngestConfig(queue_max_rows=64, block_timeout_s=0.2,
+                       ack_timeout_s=0.5, max_delay_ms=0.0)
+    pipe = IngestPipeline(cfg)
+    try:
+        # a waited submit times out typed instead of hanging — as the
+        # UNKNOWN-OUTCOME unavailable error, not the retry-inviting 429
+        # (the unacked group may still apply later)
+        with pytest.raises(DatanodeUnavailableError):
+            pipe.submit([_entry(rid=1, n=8, client=cli)])
+        # fire-and-forget floods hit the bounded queue and shed
+        with pytest.raises(IngestOverloadedError):
+            for _ in range(64):
+                pipe.submit([_entry(rid=1, n=8, client=cli)],
+                            wait=False)
+        sender = pipe.sender_for(cli)
+        assert sender._pending_rows() <= cfg.queue_max_rows + 8
+    finally:
+        pipe.close()
+        cli.close()
+        srv.close()
+
+
+# ----------------------------------------------------------------------
+# migration + crash: retry and replay semantics through the dataplane
+# ----------------------------------------------------------------------
+
+def test_migration_reroutes_batches_without_statement_retry(tmp_path):
+    from greptimedb_tpu.storage.object_store import FsObjectStore
+    from greptimedb_tpu.telemetry.metrics import global_registry
+
+    shared = FsObjectStore(str(tmp_path / "shared"))
+    c = MiniCluster(tmp_path, n=2, store=shared, wal_backend="object")
+    try:
+        fe = c.frontend
+        _seed_table(fe, regions=2)
+        fe.execute_sql(
+            "insert into t (host, ts, v) values ('a', 1000, 1.0), "
+            "('b', 2000, 2.0), ('c', 3000, 3.0)"
+        )
+        ms = c.meta.metasrv
+        retry_counter = global_registry.counter(
+            "gtpu_ingest_route_retry_total",
+            "region batches re-routed after a RegionNotFound ack",
+        ).labels()
+        before = retry_counter.value
+        moved = 0
+        for rid in fe.catalog.table("public", "t").info.region_ids():
+            src = ms.route_of(rid)
+            ms.migrate_region(rid, 1 - src)
+            moved += 1
+        assert moved == 2
+        # the frontend's routes are now stale for EVERY region; the
+        # dataplane's typed region-not-found retry re-routes batches
+        vals = ", ".join(
+            f"('h{i}', {100_000 + i * 1000}, 1.0)" for i in range(12)
+        )
+        fe.execute_sql(f"insert into t (host, ts, v) values {vals}")
+        got = fe.sql("select count(v), sum(v) from t").rows()
+        assert got == [[15, 18.0]]
+        assert retry_counter.value > before
+    finally:
+        c.close()
+
+
+def test_crash_mid_stream_dedup_replay_is_idempotent(tmp_path):
+    """A datanode dies with the ingest stream live; the failed
+    statement replays after restart and last-write-wins dedup keeps the
+    counts exact even though OTHER datanodes may have applied their
+    batches the first time."""
+    from greptimedb_tpu.errors import (
+        DatanodeUnavailableError,
+        GreptimeError,
+    )
+
+    c = MiniCluster(tmp_path, n=2)
+    try:
+        fe = c.frontend
+        _seed_table(fe, regions=2)
+        vals = ", ".join(
+            f"('h{i}', {i * 1000}, {float(i)})" for i in range(40)
+        )
+        insert = f"insert into t (host, ts, v) values {vals}"
+        fe.execute_sql(insert)  # stream established to both datanodes
+        assert fe.sql("select count(v) from t").rows() == [[40]]
+        c.stop_datanode(0)      # hard stop: stream dies mid-life
+        with pytest.raises((DatanodeUnavailableError, GreptimeError)):
+            fe.execute_sql(insert)  # partial apply on the survivor
+        c.start_datanode(0)     # same node id, fresh port
+        fe.catalog.refresh()
+        fe.execute_sql(insert)  # the REPLAY
+        # idempotent: every row exactly once
+        got = fe.sql("select count(v), sum(v) from t").rows()
+        assert got == [[40, float(sum(range(40)))]]
+    finally:
+        c.close()
+
+
+def test_append_mode_batches_are_not_retried(cluster):
+    fe = cluster.frontend
+    fe.execute_sql(
+        "create table ap (ts timestamp time index, host string "
+        "primary key, v double) with (num_regions = 2, "
+        "append_mode = 'true')"
+    )
+    table = fe.catalog.table("public", "ap")
+    assert table._append_mode
+    # the dataplane must mark append-mode batches non-retryable
+    fe.execute_sql(
+        "insert into ap (host, ts, v) values ('a', 1000, 1.0)"
+    )
+    assert fe.sql("select count(v) from ap").rows() == [[1]]
+
+
+def test_pipeline_disabled_falls_back_to_legacy_path(tmp_path):
+    c = MiniCluster(tmp_path, ingest_options={"pipeline": False})
+    try:
+        fe = c.frontend
+        _seed_table(fe)
+        assert fe.catalog.table("public", "t").ingest is None
+        fe.execute_sql(
+            "insert into t (host, ts, v) values ('a', 1000, 1.0)"
+        )
+        assert fe.sql("select count(v) from t").rows() == [[1]]
+    finally:
+        c.close()
+
+
+def test_pipeline_metrics_surface_in_information_schema(cluster):
+    fe = cluster.frontend
+    _seed_table(fe)
+    fe.execute_sql(
+        "insert into t (host, ts, v) values ('a', 1000, 1.0)"
+    )
+    rows = fe.sql(
+        "select metric_name from information_schema.runtime_metrics "
+        "where metric_name like 'gtpu_ingest%'"
+    ).rows()
+    names = {r[0] for r in rows}
+    assert "gtpu_ingest_rows_total" in names
+    assert "gtpu_ingest_queued_rows" in names
