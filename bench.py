@@ -181,6 +181,12 @@ def _emit_ordered(lines: list[str], cold_line: str | None):
         m: {"v": d.get("value"), "x": d.get("vs_baseline")}
         for m, d in by_metric.items() if m
     }
+    for m, d in by_metric.items():
+        # the dist metric's stage breakdown + scan-cache counters must
+        # survive even a tail capture that only keeps the final line
+        if m and "stages" in d:
+            summary[m]["stages"] = d["stages"]
+            summary[m]["scan_cache"] = d.get("scan_cache")
     head = by_metric.get(_HEADLINE)
     # the driver parses the LAST line: headline fields stay at the top
     # level, the full metric set rides in `summary`
@@ -623,6 +629,26 @@ def _bench_promql_1m(inst):
         }))
 
 
+def _dist_query_snapshot():
+    """(stage_ms by stage, query count, scan-cache hits, misses) from
+    the in-process metrics registry (the wire bench runs frontend and
+    datanodes in one process, so the counters are all visible here)."""
+    from greptimedb_tpu.telemetry.metrics import global_registry
+
+    stage_c = global_registry.counter(
+        "gtpu_dist_query_stage_ms_total", "", ("stage",)
+    )
+    stages = {key[0]: child.value for key, child in stage_c._snapshot()}
+    n = global_registry.counter("gtpu_dist_query_total").labels().value
+    hits = global_registry.counter(
+        "gtpu_dist_scan_cache_hits_total"
+    ).labels().value
+    misses = global_registry.counter(
+        "gtpu_dist_scan_cache_misses_total"
+    ).labels().value
+    return stages, n, hits, misses
+
+
 def _bench_wire(tmp: str):
     """Wire-topology benches over real sockets (in-process metasrv HTTP
     + datanode Flight servers + a DistInstance frontend): ingest
@@ -719,9 +745,19 @@ def _bench_wire(tmp: str):
                 assert r.num_rows == w_hosts * 12, r.num_rows
             return sorted(lat)[len(lat) // 2]
 
+        fe.sql(q)  # warm: plan-doc caches + datanode scan caches
+        s0, n0, h0, m0 = _dist_query_snapshot()
         dist_ms = p50(fe)
+        s1, n1, h1, m1 = _dist_query_snapshot()
         ref_ms = p50(ref)
         ratio = dist_ms / max(ref_ms, 1e-9)
+        queries = max(n1 - n0, 1)
+        stages = {
+            stage: round((s1.get(stage, 0.0) - s0.get(stage, 0.0))
+                         / queries, 2)
+            for stage in sorted(set(s0) | set(s1))
+        }
+        hits, misses = h1 - h0, m1 - m0
         print(json.dumps({
             "metric": "dist_double_groupby_all_vs_standalone_ratio",
             "value": round(ratio, 3),
@@ -731,6 +767,14 @@ def _bench_wire(tmp: str):
             "vs_baseline": round(2.0 / max(ratio, 1e-9), 2),
             "dist_ms": round(dist_ms, 3),
             "standalone_ms": round(ref_ms, 3),
+            # per-query stage means over the measured window
+            # (gtpu_dist_query_stage_ms_total): encode / fan_out /
+            # datanode_exec / wire / merge / finalize
+            "stages": stages,
+            "scan_cache": {
+                "hits": hits, "misses": misses,
+                "hit_rate": round(hits / max(hits + misses, 1), 3),
+            },
         }))
     finally:
         fe.close()

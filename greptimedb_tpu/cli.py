@@ -320,7 +320,13 @@ def _start_datanode(opts):
     # the distributed topology (dist/region_server.py)
     from greptimedb_tpu.dist.region_server import RegionServer
 
-    inst.region_server = RegionServer(inst.engine, opts.get("data_home"))
+    inst.region_server = RegionServer(
+        inst.engine, opts.get("data_home"),
+        scan_cache_bytes=opts.get("dist_query.scan_cache_bytes"),
+        region_scan_parallelism=opts.get(
+            "dist_query.region_scan_parallelism"
+        ),
+    )
     flight_srv = _flight_server(inst, opts, closers)
     _http_server(inst, opts, closers)
     _export_metrics(inst, opts, closers)
@@ -421,6 +427,7 @@ def _start_frontend(opts):
             opts.get("data_home"), meta_addr,
             flownode_addr=opts.get("frontend.flownode_addr") or None,
             ingest_options=opts.section("ingest"),
+            dist_query_options=opts.section("dist_query"),
         )
         target = f"metasrv {meta_addr}"
     else:

@@ -60,6 +60,13 @@ DEFAULTS: dict = {
         "ack_timeout_s": 60.0,       # unacked past this => overloaded
         "idle_stream_s": 60.0,       # close parked streams after this
     },
+    # distributed query dataplane (dist/): datanode merged-scan cache,
+    # intra-datanode region-scan parallelism, frontend fan-out pool
+    "dist_query": {
+        "scan_cache_bytes": 268435456,   # datanode LRU byte budget
+        "region_scan_parallelism": 4,    # bounded pool per datanode
+        "fanout_pool_size": 8,           # shared frontend fan-out pool
+    },
     "engine": {
         "enable_background": True,
         "background_interval_s": 5.0,

@@ -195,12 +195,19 @@ class DatanodeClient:
     def partial_sql(self, doc: dict):
         """Ship a partial plan (SQL fragment over named regions); returns
         the raw Arrow table + metrics metadata."""
+        return self.partial_sql_ticket(
+            json.dumps({"rpc": "partial_sql", **doc}).encode()
+        )
+
+    def partial_sql_ticket(self, ticket: bytes):
+        """partial_sql with a pre-serialized ticket: the frontend caches
+        the encoded plan/TableInfo docs (dist/dist_query.py) and splices
+        region ids in, so hot queries skip re-encoding — and ship
+        byte-identical tickets, which keys the datanode's decode memo."""
         import pyarrow.flight as flight
 
         try:
-            reader = self._client().do_get(flight.Ticket(
-                json.dumps({"rpc": "partial_sql", **doc}).encode()
-            ))
+            reader = self._client().do_get(flight.Ticket(ticket))
             return reader.read_all()
         except flight.FlightError as e:
             self._raise(e)

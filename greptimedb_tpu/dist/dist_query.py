@@ -41,6 +41,9 @@ correct for the whole SQL surface.
 from __future__ import annotations
 
 import json
+import threading
+import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -56,6 +59,7 @@ from greptimedb_tpu.query.executor import (
 )
 from greptimedb_tpu.query.planner import AggSpec, KeySpec, SelectPlan
 from greptimedb_tpu.sql import ast as A
+from greptimedb_tpu.telemetry.metrics import global_registry
 
 _DECOMPOSABLE = {
     "count", "sum", "min", "max", "mean",
@@ -63,67 +67,235 @@ _DECOMPOSABLE = {
 }
 _VARIANCE_OPS = {"var_samp", "var_pop", "stddev_samp", "stddev_pop"}
 
+# per-stage wall-clock of the distributed query dataplane, exported to
+# /metrics + information_schema.runtime_metrics (and, per query, into
+# the EXPLAIN ANALYZE collector as dist_stage_<stage>_ms)
+_STAGE_MS = global_registry.counter(
+    "gtpu_dist_query_stage_ms_total",
+    "distributed-query wall clock per stage (ms)",
+    labels=("stage",),
+)
+_QUERIES = global_registry.counter(
+    "gtpu_dist_query_total",
+    "distributed queries answered through the partial-plan pushdown",
+)
+
+
+class _StageClock:
+    """Accumulates per-stage wall ms for ONE distributed query.
+
+    Stages: encode (plan/TableInfo doc build, cache hits ~free),
+    fan_out (dispatch until the last partial is consumed — overlaps
+    exec+wire), datanode_exec (max datanode-reported execution wall),
+    wire (max per-datanode RPC wall minus its exec: serialization +
+    transport + decode), merge (partial folding), finalize (final
+    ORDER BY / LIMIT / post-projection)."""
+
+    __slots__ = ("ms",)
+
+    def __init__(self):
+        self.ms: dict[str, float] = {}
+
+    def add(self, stage: str, ms: float):
+        self.ms[stage] = self.ms.get(stage, 0.0) + max(ms, 0.0)
+
+    def timed(self, stage: str):
+        clock = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+
+            def __exit__(self, *exc):
+                clock.add(
+                    stage, (time.perf_counter() - self.t0) * 1000.0
+                )
+
+        return _Ctx()
+
+    def done(self):
+        for stage, ms in self.ms.items():
+            stats.add(f"dist_stage_{stage}_ms", ms)
+            _STAGE_MS.labels(stage).inc(ms)
+        _QUERIES.inc()
+
 
 def try_dist_query(instance, plan: SelectPlan, table):
     """Push a decomposable plan down per datanode; None = fall back."""
     if not getattr(table, "remote", False):
         return None
+    clock = _StageClock()
     try:
         if plan.kind == "plain":
-            return _dist_plain(instance, plan, table)
-        if plan.kind == "aggregate":
-            return _dist_aggregate(instance, plan, table)
-        if plan.kind == "range":
-            return _dist_range(instance, plan, table)
+            res = _dist_plain(instance, plan, table, clock)
+        elif plan.kind == "aggregate":
+            res = _dist_aggregate(instance, plan, table, clock)
+        elif plan.kind == "range":
+            res = _dist_range(instance, plan, table, clock)
+        else:
+            return None
     except Exception:  # noqa: BLE001 - fall back to data shipping
         stats.add("dist_pushdown_errors", 1)
         return None
-    return None
+    if res is not None:
+        clock.done()
+    return res
 
 
 # ---------------------------------------------------------------------------
 # shared plumbing
 # ---------------------------------------------------------------------------
 
+# long-lived fan-out pool shared by every distributed query in this
+# process (the per-query ThreadPoolExecutor spin-up was measurable on
+# hot queries); sized by [dist_query] fanout_pool_size
+_DEFAULT_POOL_SIZE = 8
+_pool_size = _DEFAULT_POOL_SIZE
+_pool = None
+_pool_lock = threading.Lock()
 
-def _fan_out(instance, table, partial: SelectPlan):
-    """Ship `partial` concurrently to every datanode holding un-pruned
-    regions of `table`; returns [(addr, QueryResult)]."""
+
+def configure(options: dict | None):
+    """Apply the [dist_query] TOML section to this frontend process."""
+    global _pool_size, _pool
+    size = int((options or {}).get("fanout_pool_size",
+                                   _DEFAULT_POOL_SIZE))
+    with _pool_lock:
+        if size != _pool_size:
+            _pool_size = max(1, size)
+            old, _pool = _pool, None
+            if old is not None:
+                old.shutdown(wait=False)
+
+
+def _fanout_pool():
     from concurrent.futures import ThreadPoolExecutor
 
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(
+                max_workers=_pool_size, thread_name_prefix="gtpu-fanout"
+            )
+        return _pool
+
+
+# encoded-doc caches: hot queries re-ship byte-identical plan/TableInfo
+# docs, so the codec + json.dumps work is paid once per distinct shape
+_PLAN_DOC_MAX = 128
+_plan_doc_lock = threading.Lock()
+_plan_doc_cache: OrderedDict[str, bytes] = OrderedDict()
+
+
+def _plan_fingerprint(partial: SelectPlan) -> str:
+    # dataclass repr is deterministic; full matcher patterns appended
+    # because re.Pattern repr truncates long patterns
+    extra = "".join(
+        str(getattr(m[2], "pattern", ""))
+        for m in partial.scan.matchers or []
+    )
+    return repr(partial) + "\x00" + extra
+
+
+def _plan_doc(partial: SelectPlan) -> bytes:
+    key = _plan_fingerprint(partial)
+    with _plan_doc_lock:
+        hit = _plan_doc_cache.get(key)
+        if hit is not None:
+            _plan_doc_cache.move_to_end(key)
+            return hit
+    enc = json.dumps(plan_codec.encode(partial)).encode()
+    with _plan_doc_lock:
+        _plan_doc_cache[key] = enc
+        while len(_plan_doc_cache) > _PLAN_DOC_MAX:
+            _plan_doc_cache.popitem(last=False)
+    return enc
+
+
+def _info_doc(table) -> bytes:
+    """Encoded TableInfo, cached on the table object (invalidated by
+    schema shape: ALTER rebuilds the info columns)."""
+    key = (table.info.table_id, tuple(table.schema.column_names),
+           tuple(table.tag_names))
+    cached = getattr(table, "_info_doc_cache", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    enc = json.dumps(table.info.to_json()).encode()
+    table._info_doc_cache = (key, enc)
+    return enc
+
+
+def _fan_out_stream(instance, table, partial: SelectPlan, clock):
+    """Ship `partial` concurrently to every datanode holding un-pruned
+    regions of `table` over the shared long-lived pool; yields
+    (addr, QueryResult) in ARRIVAL order, so the caller can merge each
+    datanode's partial while slower ones are still executing. Arrow
+    decode happens in the pool workers (overlapped with other
+    datanodes' wire time)."""
     from greptimedb_tpu.servers.remote import arrow_to_result
 
-    doc_plan = plan_codec.encode(partial)
-    info_json = table.info.to_json()
+    t0 = time.perf_counter()
+    plan_json = _plan_doc(partial)
+    info_json = _info_doc(table)
     scan_regions = table.pruned_regions(partial.scan.matchers)
     groups = table._by_datanode(scan_regions)
+    tickets = [
+        (client, b'{"rpc":"partial_sql","mode":"plan","plan":'
+         + plan_json + b',"table":' + info_json + b',"region_ids":'
+         + json.dumps(list(rids)).encode() + b"}")
+        for client, rids in groups
+    ]
+    clock.add("encode", (time.perf_counter() - t0) * 1000.0)
 
-    def one(client, rids):
-        return client.partial_sql({
-            "mode": "plan", "plan": doc_plan, "table": info_json,
-            "region_ids": rids,
-        })
-
-    if len(groups) <= 1:
-        arrows = [one(c, r) for c, r in groups]
-    else:
-        with ThreadPoolExecutor(max_workers=len(groups)) as pool:
-            arrows = list(pool.map(lambda g: one(*g), groups))
-    outs = []
-    for (client, _rids), arrow in zip(groups, arrows):
+    def one(client, ticket):
+        t = time.perf_counter()
+        arrow = client.partial_sql_ticket(ticket)
+        res = arrow_to_result(arrow)
+        rpc_ms = (time.perf_counter() - t) * 1000.0
         meta = arrow.schema.metadata or {}
         stage = json.loads(meta.get(b"gtdb:stage_stats", b"{}"))
         path = meta.get(b"gtdb:exec_path", b"?").decode()
-        counters = stage.get("counters", {})
-        stats.note(f"datanode_{client.addr}", json.dumps({
-            "exec_path": path,
-            "rows_scanned": counters.get("rows_scanned", 0),
-            "regions_scanned": counters.get("regions_scanned", 0),
-            "partial_rows": arrow.num_rows,
-        }))
-        outs.append((client.addr, arrow_to_result(arrow)))
-    stats.add("dist_partial_datanodes", len(outs))
-    return outs
+        return client.addr, res, stage, path, rpc_ms, arrow.num_rows
+
+    t_fan = time.perf_counter()
+    if len(tickets) <= 1:
+        raw_iter = (one(c, t) for c, t in tickets)
+    else:
+        from concurrent.futures import as_completed
+
+        pool = _fanout_pool()
+        futs = [pool.submit(one, c, t) for c, t in tickets]
+        raw_iter = (f.result() for f in as_completed(futs))
+    n = 0
+    exec_max = 0.0
+    wire_max = 0.0
+    try:
+        for addr, res, stage, path, rpc_ms, nrows in raw_iter:
+            counters = stage.get("counters", {})
+            stats.note(f"datanode_{addr}", json.dumps({
+                "exec_path": path,
+                "rows_scanned": counters.get("rows_scanned", 0),
+                "regions_scanned": counters.get("regions_scanned", 0),
+                "scan_cache_hits": counters.get("dist_scan_cache_hits",
+                                                0),
+                "partial_rows": nrows,
+            }))
+            exec_ms = float(stage.get("exec_ms", 0.0))
+            exec_max = max(exec_max, exec_ms)
+            wire_max = max(wire_max, rpc_ms - exec_ms)
+            n += 1
+            yield addr, res
+    finally:
+        clock.add("fan_out", (time.perf_counter() - t_fan) * 1000.0)
+        clock.add("datanode_exec", exec_max)
+        clock.add("wire", wire_max)
+        stats.add("dist_partial_datanodes", n)
+
+
+def _fan_out(instance, table, partial: SelectPlan, clock=None):
+    """Barrier form of the stream: [(addr, QueryResult)]."""
+    clock = clock if clock is not None else _StageClock()
+    return list(_fan_out_stream(instance, table, partial, clock))
 
 
 def _cat_col(parts: list[QueryResult], i: int) -> Col:
@@ -215,7 +387,7 @@ def _merge_minmax(op: str, col: Col, gid: np.ndarray, g: int):
 # ---------------------------------------------------------------------------
 
 
-def _dist_plain(instance, plan: SelectPlan, table):
+def _dist_plain(instance, plan: SelectPlan, table, clock):
     from greptimedb_tpu.query import window_fns as W
 
     win: list = []
@@ -254,32 +426,40 @@ def _dist_plain(instance, plan: SelectPlan, table):
         order_by=partial_order, limit=push_limit,
         distinct=plan.distinct,
     )
-    results = _fan_out(instance, table, partial)
     types: dict = {}
-    for _addr, res in results:
-        types.update(res.types)
-    parts = [res for _addr, res in results if res.num_rows]
+    parts = []
+    for _addr, res in _fan_out_stream(instance, table, partial, clock):
+        if res.num_rows:
+            types.update(res.types)  # rowful partials win the type merge
+            parts.append(res)
+        else:
+            for k, v in res.types.items():
+                types.setdefault(k, v)
     if not parts:
         return QueryResult(names, [Col(np.zeros(0)) for _ in names], types)
-    total = len(plan.items) + len(extra_items)
-    cols = [_cat_col(parts, i) for i in range(total)]
-    vis = cols[:len(names)]
-    if plan.distinct:
-        didx = _distinct_indices(vis)
-        cols = _slice_result(cols, didx)
+    with clock.timed("merge"):
+        total = len(plan.items) + len(extra_items)
+        cols = [_cat_col(parts, i) for i in range(total)]
         vis = cols[:len(names)]
-    if ob_specs:
-        by_name = dict(zip(names + [nm for _, nm in extra_items], cols))
-        idx = _sort_indices(
-            [by_name[nm] for nm, _, _ in ob_specs],
-            [asc for _, asc, _ in ob_specs],
-            [nf for _, _, nf in ob_specs],
-        )
-        vis = _slice_result(vis, idx)
-    off = plan.offset or 0
-    if off or plan.limit is not None:
-        end = None if plan.limit is None else off + plan.limit
-        vis = _slice_result(vis, slice(off, end))
+        if plan.distinct:
+            didx = _distinct_indices(vis)
+            cols = _slice_result(cols, didx)
+            vis = cols[:len(names)]
+    with clock.timed("finalize"):
+        if ob_specs:
+            by_name = dict(
+                zip(names + [nm for _, nm in extra_items], cols)
+            )
+            idx = _sort_indices(
+                [by_name[nm] for nm, _, _ in ob_specs],
+                [asc for _, asc, _ in ob_specs],
+                [nf for _, _, nf in ob_specs],
+            )
+            vis = _slice_result(vis, idx)
+        off = plan.offset or 0
+        if off or plan.limit is not None:
+            end = None if plan.limit is None else off + plan.limit
+            vis = _slice_result(vis, slice(off, end))
     instance.query_engine._record_path("plain", "dist:partial")
     return QueryResult(names, vis, types)
 
@@ -313,9 +493,46 @@ def _empty_agg_cols(plan: SelectPlan) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def _dist_aggregate(instance, plan: SelectPlan, table):
+class _ColsView:
+    """Minimal QueryResult-shaped view over a list of Cols (what
+    _cat_col consumes when folding accumulated state with a newly
+    arrived partial)."""
+
+    __slots__ = ("cols", "num_rows")
+
+    def __init__(self, cols: list[Col]):
+        self.cols = cols
+        self.num_rows = len(cols[0]) if cols else 0
+
+
+def _fold_states(plan_keys, partial_aggs, parts: list[_ColsView]
+                 ) -> list[Col]:
+    """Merge partial-aggregate states (associative: a previously folded
+    accumulator is itself a valid partial). Returns nk key cols +
+    one state col per partial agg; unseen groups carry False validity."""
+    nk = len(plan_keys)
+    key_cat = [_cat_col(parts, i) for i in range(nk)]
+    n_rows = (len(key_cat[0]) if key_cat
+              else sum(p.num_rows for p in parts))
+    gid, g, rep = _group_rows(key_cat, n_rows)
+    out = [
+        Col(c.values[rep],
+            None if c.validity is None else c.validity[rep])
+        for c in key_cat
+    ]
+    for j, p in enumerate(partial_aggs):
+        c = _cat_col(parts, nk + j)
+        if p.op in ("sum", "count"):
+            acc, seen = _merge_sum(c, gid, g)
+        else:
+            acc, seen = _merge_minmax(p.op, c, gid, g)
+        out.append(Col(acc, None if seen.all() else seen))
+    return out
+
+
+def _dist_aggregate(instance, plan: SelectPlan, table, clock):
     if any(a.op == "count_distinct" for a in plan.aggs):
-        return _dist_count_distinct(instance, plan, table)
+        return _dist_count_distinct(instance, plan, table, clock)
     if any(a.op not in _DECOMPOSABLE or a.distinct for a in plan.aggs):
         return None
     # partial aggs: stable derived keys; avg -> sum+count, var/stddev ->
@@ -344,25 +561,38 @@ def _dist_aggregate(instance, plan: SelectPlan, table):
             + [(A.Column(p.key), p.key) for p in partial_aggs]
         ),
     )
-    results = _fan_out(instance, table, partial)
-    parts = [res for _addr, res in results if res.num_rows]
+    # STREAMING group-state fold: each datanode's partial merges into
+    # the accumulated state as it arrives (the merge is associative —
+    # sum/count fold by grouped addition, min/max by grouped extremes —
+    # so the accumulator is itself a valid partial), overlapping merge
+    # work with the slower datanodes' execution + wire time.
     nk = len(plan.keys)
-    if not parts:
+    state: list[Col] | None = None
+    width = nk + len(partial_aggs)
+    for _addr, res in _fan_out_stream(instance, table, partial, clock):
+        if not res.num_rows:
+            continue
+        part = _ColsView(res.cols[:width])
+        with clock.timed("merge"):
+            state = (part.cols if state is None
+                     else _fold_states(plan.keys, partial_aggs,
+                                       [_ColsView(state), part]))
+    if state is None:
         return instance.query_engine._post_project(
             plan, _empty_agg_cols(plan), 0 if plan.keys else 1
         )
-
-    key_cat = [_cat_col(parts, i) for i in range(nk)]
-    n_rows = len(key_cat[0]) if key_cat else sum(p.num_rows for p in parts)
-    gid, g, rep = _group_rows(key_cat, n_rows)
-    agg_cols = _rep_key_cols(plan.keys, key_cat, rep)
+    g = len(state[0]) if state else 0
+    agg_cols = {
+        k.key: state[i] for i, k in enumerate(plan.keys)
+    }
     merged: dict[str, tuple] = {}
     for j, p in enumerate(partial_aggs):
-        c = _cat_col(parts, nk + j)
-        if p.op in ("sum", "count"):
-            merged[p.key] = _merge_sum(c, gid, g)
-        else:
-            merged[p.key] = _merge_minmax(p.op, c, gid, g)
+        c = state[nk + j]
+        merged[p.key] = (
+            np.asarray(c.values),
+            c.validity if c.validity is not None
+            else np.ones(len(c), bool),
+        )
     for a in plan.aggs:
         if a.op == "mean":
             s, sv = merged[f"{a.key}__s"]
@@ -395,10 +625,11 @@ def _dist_aggregate(instance, plan: SelectPlan, table):
             agg_cols[a.key] = Col(vals, None if seen.all() else seen)
     engine = instance.query_engine
     engine._record_path("aggregate", "dist:partial")
-    return engine._post_project(plan, agg_cols, g)
+    with clock.timed("finalize"):
+        return engine._post_project(plan, agg_cols, g)
 
 
-def _dist_count_distinct(instance, plan: SelectPlan, table):
+def _dist_count_distinct(instance, plan: SelectPlan, table, clock):
     """COUNT(DISTINCT x): ship GROUP BY (keys, x), count distinct codes
     on the frontend. Only the single-distinct-agg shape pushes down."""
     if len(plan.aggs) != 1 or plan.aggs[0].op != "count_distinct":
@@ -415,28 +646,30 @@ def _dist_count_distinct(instance, plan: SelectPlan, table):
             + [(A.Column("__dv"), "__dv")]
         ),
     )
-    results = _fan_out(instance, table, partial)
+    results = _fan_out(instance, table, partial, clock)
     parts = [res for _addr, res in results if res.num_rows]
     nk = len(plan.keys)
     if not parts:
         return instance.query_engine._post_project(
             plan, _empty_agg_cols(plan), 0 if plan.keys else 1
         )
-    key_cat = [_cat_col(parts, i) for i in range(nk)]
-    n_rows = sum(p.num_rows for p in parts)
-    gid, g, rep = _group_rows(key_cat, n_rows)
-    agg_cols = _rep_key_cols(plan.keys, key_cat, rep)
-    dv_col = _cat_col(parts, nk)
-    codes = _factorize(dv_col)
-    keep = codes >= 0  # COUNT(DISTINCT) ignores NULLs
-    card = int(codes.max()) + 1 if keep.any() else 1
-    uniq_pairs = np.unique(gid[keep] * card + codes[keep])
-    counts = np.bincount((uniq_pairs // card).astype(np.int64),
-                         minlength=g).astype(np.int64)
-    agg_cols[a.key] = Col(counts)
+    with clock.timed("merge"):
+        key_cat = [_cat_col(parts, i) for i in range(nk)]
+        n_rows = sum(p.num_rows for p in parts)
+        gid, g, rep = _group_rows(key_cat, n_rows)
+        agg_cols = _rep_key_cols(plan.keys, key_cat, rep)
+        dv_col = _cat_col(parts, nk)
+        codes = _factorize(dv_col)
+        keep = codes >= 0  # COUNT(DISTINCT) ignores NULLs
+        card = int(codes.max()) + 1 if keep.any() else 1
+        uniq_pairs = np.unique(gid[keep] * card + codes[keep])
+        counts = np.bincount((uniq_pairs // card).astype(np.int64),
+                             minlength=g).astype(np.int64)
+        agg_cols[a.key] = Col(counts)
     engine = instance.query_engine
     engine._record_path("aggregate", "dist:partial")
-    return engine._post_project(plan, agg_cols, g)
+    with clock.timed("finalize"):
+        return engine._post_project(plan, agg_cols, g)
 
 
 # ---------------------------------------------------------------------------
@@ -444,7 +677,7 @@ def _dist_count_distinct(instance, plan: SelectPlan, table):
 # ---------------------------------------------------------------------------
 
 
-def _global_ts_extent(instance, plan: SelectPlan, table):
+def _global_ts_extent(instance, plan: SelectPlan, table, clock):
     """Negotiate the global scanned-ts extent (min, max) across datanodes
     via a tiny partial-aggregate round, so every datanode builds the SAME
     fill grid (the reference reads this off the merged stream; with fill
@@ -459,7 +692,7 @@ def _global_ts_extent(instance, plan: SelectPlan, table):
         post_items=[(A.Column("__tmin"), "__tmin"),
                     (A.Column("__tmax"), "__tmax")],
     )
-    results = _fan_out(instance, table, partial)
+    results = _fan_out(instance, table, partial, clock)
     mins: list[int] = []
     maxs: list[int] = []
     for _addr, res in results:
@@ -475,7 +708,7 @@ def _global_ts_extent(instance, plan: SelectPlan, table):
     return min(mins), max(maxs)
 
 
-def _dist_range(instance, plan: SelectPlan, table):
+def _dist_range(instance, plan: SelectPlan, table, clock):
     tags = set(table.tag_names)
     if not tags:
         return None
@@ -493,7 +726,7 @@ def _dist_range(instance, plan: SelectPlan, table):
     if has_fill:
         # fill grids span the GLOBAL time range; agree on it first and
         # ship it as an explicit override so per-datanode grids match
-        grid = _global_ts_extent(instance, plan, table)
+        grid = _global_ts_extent(instance, plan, table, clock)
         if grid is None:
             # zero rows anywhere: fall back so the empty result carries
             # the standalone-typed schema
@@ -527,43 +760,51 @@ def _dist_range(instance, plan: SelectPlan, table):
         grid_ts_min=None if grid is None else grid[0],
         grid_ts_max=None if grid is None else grid[1],
     )
-    results = _fan_out(instance, table, partial)
-    parts = [res for _addr, res in results if res.num_rows]
     types: dict = {}
-    for _addr, res in results:
-        types.update(res.types)
+    parts = []
+    for _addr, res in _fan_out_stream(instance, table, partial, clock):
+        if res.num_rows:
+            types.update(res.types)  # rowful partials win the type merge
+            parts.append(res)
+        else:
+            for k, v in res.types.items():
+                types.setdefault(k, v)
     if not parts:
         return QueryResult(names, [Col(np.zeros(0)) for _ in names], types)
-    total = len(partial_items)
-    cols = [_cat_col(parts, i) for i in range(total)]
-    vis = cols[:len(names)]
-    by_name = dict(zip(names + internal, cols))
-    n_rows = len(cols[0]) if cols else 0
-    if plan.distinct:
-        didx = _distinct_indices(vis)
-        cols = _slice_result(cols, didx)
+    with clock.timed("merge"):
+        total = len(partial_items)
+        cols = [_cat_col(parts, i) for i in range(total)]
         vis = cols[:len(names)]
         by_name = dict(zip(names + internal, cols))
-        n_rows = len(didx)
+        n_rows = len(cols[0]) if cols else 0
+        if plan.distinct:
+            didx = _distinct_indices(vis)
+            cols = _slice_result(cols, didx)
+            vis = cols[:len(names)]
+            by_name = dict(zip(names + internal, cols))
+            n_rows = len(didx)
     engine = instance.query_engine
-    if plan.order_by:
-        extra = DictSource(
-            {key: by_name[key] for key in internal}, n_rows
-        )
-        vis = engine._order_limit(plan, vis, names, extra_src=extra)
-    else:
-        # standalone default order: ts-major, then groups ranked by key
-        # values (ADVICE r4: concat order interleaved datanode blocks)
-        sort_cols = [by_name["__ts"]] + [
-            by_name[k.key] for k in plan.keys
-        ]
-        idx = _sort_indices(
-            sort_cols, [True] * len(sort_cols), [None] * len(sort_cols)
-        )
-        vis = _slice_result(vis, idx)
-        off = plan.offset or 0
-        if off or plan.limit is not None:
-            end = None if plan.limit is None else off + plan.limit
-            vis = _slice_result(vis, slice(off, end))
+    with clock.timed("finalize"):
+        if plan.order_by:
+            extra = DictSource(
+                {key: by_name[key] for key in internal}, n_rows
+            )
+            vis = engine._order_limit(plan, vis, names, extra_src=extra)
+        else:
+            # standalone default order: ts-major, then groups ranked by
+            # key values (ADVICE r4: concat order interleaved datanode
+            # blocks)
+            sort_cols = [by_name["__ts"]] + [
+                by_name[k.key] for k in plan.keys
+            ]
+            idx = _sort_indices(
+                sort_cols, [True] * len(sort_cols),
+                [None] * len(sort_cols)
+            )
+            vis = _slice_result(vis, idx)
+            off = plan.offset or 0
+            if off or plan.limit is not None:
+                end = None if plan.limit is None else off + plan.limit
+                vis = _slice_result(vis, slice(off, end))
     engine._record_path("range", "dist:partial")
     return QueryResult(names, vis, types)

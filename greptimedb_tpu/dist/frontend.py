@@ -24,7 +24,13 @@ class DistInstance(Standalone):
     def __init__(self, data_home: str, metasrv_addr: str, *,
                  prefer_device: bool | None = None,
                  flownode_addr: str | None = None,
-                 ingest_options: dict | None = None):
+                 ingest_options: dict | None = None,
+                 dist_query_options: dict | None = None):
+        from greptimedb_tpu.dist import dist_query
+
+        # [dist_query] knobs for the fan-out side (shared pool size);
+        # the datanode-side knobs apply where the RegionServer lives
+        dist_query.configure(dist_query_options)
         # the local engine only backs frontend-local scratch (scripts,
         # slow-query log); table data never lands here
         super().__init__(

@@ -27,13 +27,60 @@ from greptimedb_tpu.storage.series import SeriesRegistry
 REGIONS_FILE = "dist_regions.json"
 
 
+def _copy_rows_container(rows):
+    """Shallow ColumnarRows copy: shared arrays, caller-owned container
+    (callers reassign .sid during table-level remaps)."""
+    from greptimedb_tpu.storage.memtable import ColumnarRows
+
+    return ColumnarRows(
+        sid=rows.sid, ts=rows.ts, seq=rows.seq, op=rows.op,
+        fields=dict(rows.fields),
+        field_valid=(dict(rows.field_valid)
+                     if rows.field_valid is not None else None),
+    )
+
+
+def _entry_nbytes(rows, tag_values) -> int:
+    n = 0
+    if rows is not None:
+        for arr in (rows.sid, rows.ts, rows.seq, rows.op):
+            n += arr.nbytes
+        for v in rows.fields.values():
+            n += v.nbytes
+        if rows.field_valid:
+            for v in rows.field_valid.values():
+                n += v.nbytes
+    for vals in tag_values.values():
+        n += sum(len(s) + 49 for s in vals)
+    return n
+
+
+_DEFAULT_SCAN_CACHE_BYTES = 256 * 1024 * 1024
+_DEFAULT_SCAN_PARALLELISM = 4
+
+
 class RegionServer:
-    def __init__(self, engine, data_home: str):
+    def __init__(self, engine, data_home: str, *,
+                 scan_cache_bytes: int | None = None,
+                 region_scan_parallelism: int | None = None):
+        from greptimedb_tpu.dist.scan_cache import ScanCache
+
         self.engine = engine
         self._path = os.path.join(data_home, REGIONS_FILE)
         self._lock = threading.Lock()
         self._closed = False
         self._metas: dict[int, dict] = {}
+        # merged-scan cache + bounded region-scan pool ([dist_query])
+        self.scan_cache = ScanCache(
+            _DEFAULT_SCAN_CACHE_BYTES if scan_cache_bytes is None
+            else int(scan_cache_bytes)
+        )
+        self._scan_parallelism = max(1, int(
+            _DEFAULT_SCAN_PARALLELISM if region_scan_parallelism is None
+            else region_scan_parallelism
+        ))
+        self._scan_pool = None
+        self._scan_pool_lock = threading.Lock()
         # region alive-keeping (the reference's RegionAliveKeeper,
         # src/datanode/src/alive_keeper.rs:44-113): metasrv lease grants
         # set per-region deadlines; expiry FENCES the region (writes
@@ -59,6 +106,9 @@ class RegionServer:
     def open_region(self, meta_doc: dict) -> None:
         meta = region_meta_from_json(meta_doc)
         self.engine.open_region(meta)
+        # migration/reopen: any cached merge spanning this region id was
+        # built from a PREVIOUS hosting of it
+        self.scan_cache.purge_region(meta.region_id)
         with self._lock:
             self._metas[meta.region_id] = meta_doc
             # fresh hosting = fresh lease state: a stale lapsed deadline
@@ -76,11 +126,13 @@ class RegionServer:
 
     def close_region(self, region_id: int) -> None:
         self.engine.close_region(region_id)
+        self.scan_cache.purge_region(region_id)
         with self._lock:
             self._forget_region(region_id)
 
     def drop_region(self, region_id: int) -> None:
         self.engine.drop_region(region_id)
+        self.scan_cache.purge_region(region_id)
         with self._lock:
             self._forget_region(region_id)
 
@@ -93,6 +145,10 @@ class RegionServer:
         parked ingest streams (servers/flight.py region_write_stream)
         must error instead of applying into a closing engine."""
         self._closed = True
+        with self._scan_pool_lock:
+            pool, self._scan_pool = self._scan_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     # ---- per-region ops ----------------------------------------------
     def _region(self, region_id: int):
@@ -206,6 +262,8 @@ class RegionServer:
             else:
                 raise ValueError(f"unknown alter op: {op}")
         region.invalidate_scan_cache()
+        # schema changes can leave data_version untouched
+        self.scan_cache.purge_region(region_id)
         with self._lock:
             doc = self._metas.get(region_id)
             if doc is not None:
@@ -237,44 +295,135 @@ class RegionServer:
         """Scan the named local regions and merge them into ONE compact
         sid space (the datanode-local half of Table.scan's merge; the
         frontend then merges datanodes). Returns (rows, tag_values,
-        field_names, stats)."""
-        regions = [self._region(int(rid)) for rid in region_ids]
+        field_names, stats). Served out of the merged-scan cache when
+        every region's data_version is unchanged since the entry was
+        built; cold builds scan regions concurrently."""
+        entry = self.scan_entry(region_ids, ts_min=ts_min, ts_max=ts_max,
+                                field_names=field_names,
+                                matchers=matchers, fulltext=fulltext)
+        rows = entry.rows
+        if rows is not None:
+            # hits share the entry's arrays; the container must be the
+            # caller's own (frontends remap .sid on the result)
+            rows = _copy_rows_container(rows)
+        return rows, entry.tag_values, entry.names, dict(entry.stats)
+
+    def scan_entry(self, region_ids: list[int], *, ts_min=None,
+                   ts_max=None, field_names=None, matchers=None,
+                   fulltext=None):
+        """Cache-backed merged scan returning the shared ScanEntry
+        (rows + tag_values + lazily-built registry). Both the
+        `region_scan` RPC and the local partial-plan execution
+        (dist/merge.py) come through here."""
+        from greptimedb_tpu.dist.scan_cache import (
+            ScanEntry,
+            predicate_fingerprint,
+        )
+        from greptimedb_tpu.query import stats as qstats
+
+        rids = [int(r) for r in region_ids]
+        regions = [self._region(rid) for rid in rids]
         if not regions:
-            return None, {}, field_names or [], {}
+            return ScanEntry((), None, {}, field_names or [], {}, 0)
         tag_names = list(regions[0].meta.tag_names)
         names = (field_names if field_names is not None
                  else list(regions[0].meta.field_names))
-        merged = SeriesRegistry(tag_names)
-        chunks = []
+        # TTL regions clamp ts_min to (now - ttl) INSIDE Region.scan, so
+        # a cached merge would keep serving rows past their expiry even
+        # though no version changed — never cache those
+        cacheable = all(r.meta.options.ttl_ms is None for r in regions)
+        if not cacheable:
+            qstats.add("dist_scan_cache_bypass", 1)
+            rows, tag_values, stats = self._scan_merged(
+                regions, tag_names, names, ts_min=ts_min, ts_max=ts_max,
+                matchers=matchers, fulltext=fulltext,
+            )
+            return ScanEntry((), rows, tag_values, names, stats,
+                             _entry_nbytes(rows, tag_values))
+        versions = tuple(r.physical_version for r in regions)
+        key = (tuple(rids), tuple(names),
+               predicate_fingerprint(ts_min, ts_max, matchers, fulltext))
+        entry = self.scan_cache.get(key, versions)
+        if entry is not None:
+            qstats.add("dist_scan_cache_hits", 1)
+            return entry
+        qstats.add("dist_scan_cache_misses", 1)
+        rows, tag_values, stats = self._scan_merged(
+            regions, tag_names, names, ts_min=ts_min, ts_max=ts_max,
+            matchers=matchers, fulltext=fulltext,
+        )
+        entry = ScanEntry(versions, rows, tag_values, names, stats,
+                          _entry_nbytes(rows, tag_values))
+        self.scan_cache.put(key, entry)
+        return entry
+
+    def _pool(self):
+        """Bounded shared pool for intra-datanode region parallelism."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._scan_pool_lock:
+            if self._scan_pool is None:
+                self._scan_pool = ThreadPoolExecutor(
+                    max_workers=self._scan_parallelism,
+                    thread_name_prefix="gtpu-region-scan",
+                )
+            return self._scan_pool
+
+    def _scan_merged(self, regions, tag_names, names, *, ts_min, ts_max,
+                     matchers, fulltext):
+        """Cold merged scan: regions scanned concurrently (bounded
+        pool), then one VECTORIZED registry remap over the concatenated
+        per-region registries instead of a per-region intern loop."""
         stats = {"regions_scanned": 0, "rows_scanned": 0}
-        for region in regions:
+
+        def one(region):
             sids = None
             if matchers:
                 sids = region.series.match_sids(
                     [tuple(m) for m in matchers]
                 )
                 if len(sids) == 0:
-                    continue
-            stats["regions_scanned"] += 1
-            res = region.scan(ts_min=ts_min, ts_max=ts_max,
-                              field_names=names, sids=sids,
-                              fulltext=fulltext)
-            if res.rows is None or len(res.rows) == 0:
-                continue
-            stats["rows_scanned"] += len(res.rows)
-            reg = res.registry
-            if reg.num_series:
-                if tag_names:
-                    remap = merged.intern_rows(
-                        [reg.tag_values(t) for t in tag_names]
-                    )
-                    res.rows.sid = remap[res.rows.sid]
-                else:
-                    merged.intern_rows([], n=1)
-            chunks.append(res.rows)
-        if not chunks:
-            return None, {t: [] for t in tag_names}, names, stats
-        rows = chunks[0] if len(chunks) == 1 else _concat_rows(chunks, names)
+                    return None
+            return region.scan(ts_min=ts_min, ts_max=ts_max,
+                               field_names=names, sids=sids,
+                               fulltext=fulltext)
+
+        if len(regions) > 1 and self._scan_parallelism > 1:
+            results = list(self._pool().map(one, regions))
+        else:
+            results = [one(r) for r in regions]
+        stats["regions_scanned"] = sum(1 for r in results if r is not None)
+        scans = [
+            r for r in results
+            if r is not None and r.rows is not None and len(r.rows)
+        ]
+        stats["rows_scanned"] = sum(len(r.rows) for r in scans)
+        if not scans:
+            return None, {t: [] for t in tag_names}, stats
+        merged = SeriesRegistry(tag_names)
+        if tag_names:
+            # one intern over all regions' registries; per-region remap
+            # slices fall out of the concatenation offsets. Sizes are
+            # pinned FIRST: a concurrent write interning new series
+            # must not skew the per-tag arrays against each other (the
+            # scanned rows only reference sids below the pinned count).
+            counts = [r.registry.num_series for r in scans]
+            remap_all = merged.intern_rows([
+                np.concatenate([
+                    r.registry.tag_values(t)[:c]
+                    for r, c in zip(scans, counts)
+                ])
+                for t in tag_names
+            ])
+            off = 0
+            for r, n in zip(scans, counts):
+                r.rows.sid = remap_all[off:off + n][r.rows.sid]
+                off += n
+        else:
+            merged.intern_rows([], n=1)
+        chunks = [r.rows for r in scans]
+        rows = chunks[0] if len(chunks) == 1 else _concat_rows(chunks,
+                                                               names)
         # compact: only series that actually appear in the result leave
         # the process (a matcher-restricted scan must not leak the other
         # series' tag values, and full registries would dominate the
@@ -296,7 +445,7 @@ class RegionServer:
                 }
         else:
             tag_values = {t: [] for t in tag_names}
-        return rows, tag_values, names, stats
+        return rows, tag_values, stats
 
     def data_versions(self, region_ids: list[int]) -> dict:
         out = {}

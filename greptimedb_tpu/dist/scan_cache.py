@@ -1,0 +1,190 @@
+"""Datanode merged-scan cache: the distributed half of the page cache.
+
+`RegionServer.scan` merges N local regions into one compact sid space —
+scan + dedup + registry intern — and both the `region_scan` RPC and the
+`partial_sql` plan execution pay it per query. Repeated aggregates over
+unchanged regions (the TSBS double-groupby steady state) re-do that work
+even though every input region's logical data is identical. This cache
+holds the merged `(rows, tag_values)` output keyed by (region-id tuple,
+field set, predicate fingerprint) with the regions' `data_version`s
+pinned at build time; a lookup re-reads each region's CURRENT
+data_version and serves the entry only when every one still matches, so
+invalidation is driven by the same version bumps the per-region scan
+cache uses (write bumps the sequence; flush/compact/truncate commit the
+manifest — storage/region.py `data_version`). Schema changes and region
+close/drop/migration purge entries explicitly (an ALTER can leave
+data_version untouched).
+
+Bounded by an LRU byte budget ([dist_query] scan_cache_bytes).
+Hit/miss/eviction counters export as `gtpu_dist_scan_cache_*` through
+the global metrics registry (/metrics, runtime_metrics).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+_HITS = global_registry.counter(
+    "gtpu_dist_scan_cache_hits_total",
+    "datanode merged-scan cache hits",
+)
+_MISSES = global_registry.counter(
+    "gtpu_dist_scan_cache_misses_total",
+    "datanode merged-scan cache misses",
+)
+_EVICTIONS = global_registry.counter(
+    "gtpu_dist_scan_cache_evictions_total",
+    "datanode merged-scan cache entries evicted (budget or staleness)",
+)
+_BYTES = global_registry.gauge(
+    "gtpu_dist_scan_cache_bytes",
+    "bytes held by the datanode merged-scan cache",
+)
+_ENTRIES = global_registry.gauge(
+    "gtpu_dist_scan_cache_entries",
+    "entries held by the datanode merged-scan cache",
+)
+
+
+def predicate_fingerprint(ts_min, ts_max, matchers, fulltext) -> tuple:
+    """Hashable identity of a scan predicate. Regex matchers carry
+    compiled patterns; their (pattern, flags) pair is the identity."""
+    def _val(v):
+        pat = getattr(v, "pattern", None)
+        if pat is not None:
+            return ("re", pat, getattr(v, "flags", 0))
+        if isinstance(v, (list, tuple, set, frozenset)):
+            return ("seq",) + tuple(_val(x) for x in v)
+        return v
+
+    m_fp = (
+        tuple((m[0], m[1], _val(m[2])) for m in matchers)
+        if matchers else None
+    )
+    f_fp = tuple(tuple(f) for f in fulltext) if fulltext else None
+    return (ts_min, ts_max, m_fp, f_fp)
+
+
+class ScanEntry:
+    """One cached merged scan. `rows` / `tag_values` are shared with
+    every hit — callers receive a shallow container copy of rows and
+    must never mutate the arrays or the tag_values lists in place."""
+
+    __slots__ = ("data_versions", "rows", "tag_values", "names", "stats",
+                 "nbytes", "_registry")
+
+    def __init__(self, data_versions, rows, tag_values, names, stats,
+                 nbytes):
+        self.data_versions = data_versions
+        self.rows = rows
+        self.tag_values = tag_values
+        self.names = names
+        self.stats = stats
+        self.nbytes = nbytes
+        self._registry = None
+
+    def registry(self, tag_names):
+        """Lazily-built SeriesRegistry over the compacted sid space
+        (what the local partial-plan execution consumes as
+        TableScanData.registry)."""
+        if self._registry is None:
+            import numpy as np
+
+            from greptimedb_tpu.storage.series import SeriesRegistry
+
+            reg = SeriesRegistry(list(tag_names))
+            if tag_names:
+                n = len(next(iter(self.tag_values.values()), []))
+                if n:
+                    reg.intern_rows([
+                        np.asarray(self.tag_values[t], object)
+                        for t in tag_names
+                    ])
+            elif self.rows is not None and len(self.rows):
+                reg.intern_rows([], n=1)
+            self._registry = reg
+        return self._registry
+
+
+class ScanCache:
+    """LRU byte-budget cache of ScanEntry, region-version validated."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, ScanEntry] = OrderedDict()
+        self._bytes = 0
+
+    # ------------------------------------------------------------------
+    def get(self, key: tuple, current_versions: tuple) -> ScanEntry | None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                _MISSES.inc()
+                return None
+            if e.data_versions != current_versions:
+                # a region's data changed since this entry was built:
+                # it can never be served again — release it now
+                self._drop_locked(key, e)
+                _MISSES.inc()
+                return None
+            self._entries.move_to_end(key)
+            _HITS.inc()
+            return e
+
+    def put(self, key: tuple, entry: ScanEntry) -> None:
+        if self.max_bytes <= 0 or entry.nbytes > self.max_bytes:
+            return
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                k, ev = next(iter(self._entries.items()))
+                self._drop_locked(k, ev)
+            self._publish_locked()
+
+    # ------------------------------------------------------------------
+    def purge_region(self, region_id: int) -> None:
+        """Drop every entry whose region set contains `region_id`
+        (close/drop/migrate/alter: version comparison may not cover
+        these)."""
+        with self._lock:
+            stale = [k for k in self._entries if int(region_id) in k[0]]
+            for k in stale:
+                self._drop_locked(k, self._entries[k])
+            if stale:
+                self._publish_locked()
+
+    def clear(self) -> None:
+        with self._lock:
+            for k in list(self._entries):
+                self._drop_locked(k, self._entries[k])
+            self._publish_locked()
+
+    # ------------------------------------------------------------------
+    def _drop_locked(self, key, entry) -> None:
+        self._entries.pop(key, None)
+        self._bytes -= entry.nbytes
+        _EVICTIONS.inc()
+        self._publish_locked()
+
+    def _publish_locked(self) -> None:
+        _BYTES.set(float(self._bytes))
+        _ENTRIES.set(float(len(self._entries)))
+
+    # introspection (tests, stats)
+    @property
+    def entry_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def byte_count(self) -> int:
+        with self._lock:
+            return self._bytes

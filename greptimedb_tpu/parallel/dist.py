@@ -63,7 +63,9 @@ def halo_exchange_prev(x: jax.Array, halo: int, axis_name: str = AXIS_TIME):
     """Prepend the last `halo` cells of the previous time shard (zeros for
     the first shard). x is the local (S, T_local) block inside shard_map;
     returns (S, halo + T_local)."""
-    n = jax.lax.axis_size(axis_name)
+    # jax.lax.axis_size was removed from current JAX; psum of a python
+    # literal folds to the static axis size inside shard_map
+    n = jax.lax.psum(1, axis_name)
     tail = x[:, -halo:]
     # ring shift: device i receives from i-1
     perm = [(i, (i + 1) % n) for i in range(n)]

@@ -241,7 +241,10 @@ class FlightServer(flight.FlightServerBase):
         if rpc == "partial_sql":
             from greptimedb_tpu.dist.merge import exec_partial
 
-            return exec_partial(self.instance, doc)
+            # raw ticket rides along as the decode-memo key: hot
+            # queries ship byte-identical tickets (dist_query.py caches
+            # the encode side)
+            return exec_partial(self.instance, doc, raw=raw)
         raise flight.FlightServerError(f"unknown rpc: {rpc}")
 
     def do_action(self, context, action: flight.Action):

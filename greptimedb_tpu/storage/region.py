@@ -205,9 +205,22 @@ class Region:
         reference's memtable/SST version in
         /root/reference/src/mito2/src/region/version.rs). The manifest's
         truncated_entry_id rides along so the version stays comparable
-        across restarts (the in-memory epoch resets to 0 at reopen)."""
+        across restarts (the in-memory epoch resets to 0 at reopen).
+        Deliberately flush-stable: a flush moves rows without changing
+        them, so grid snapshots restored after a clean shutdown (which
+        flushes) still match."""
         return (self._seq, self._truncate_epoch,
                 self.manifest.state.truncated_entry_id)
+
+    @property
+    def physical_version(self) -> tuple[int, int, int, int]:
+        """data_version extended with the manifest version: additionally
+        bumps on every manifest commit — flush, compaction, truncate,
+        schema change. The datanode merged-scan cache
+        (dist/scan_cache.py) keys on THIS, so a cached partial is never
+        served across any physical mutation of the region, even ones
+        that provably preserve the logical row set."""
+        return self.data_version + (self.manifest.version,)
 
     # ------------------------------------------------------------------
     # write path

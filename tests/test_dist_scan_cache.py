@@ -1,0 +1,292 @@
+"""Datanode merged-scan cache (dist/scan_cache.py): invalidation proof.
+
+A cached partial must NEVER be served after a data-mutating op — write,
+flush, truncate, compact, region migration — through the full
+frontend -> datanode path. The cache keys on each region's
+physical_version (storage/region.py), which every one of those ops
+bumps; close/open/alter purge explicitly.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("pyarrow.flight")
+
+from greptimedb_tpu.dist.client import MetaClient
+from greptimedb_tpu.dist.frontend import DistInstance
+from greptimedb_tpu.dist.region_server import RegionServer
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.servers.flight import FlightFrontend
+from greptimedb_tpu.servers.meta_http import MetasrvServer
+from greptimedb_tpu.storage.engine import EngineConfig
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+
+def _counter(name: str) -> float:
+    return global_registry.counter(name).labels().value
+
+
+class _Harness:
+    def __init__(self, tmp_path, n_datanodes=2, *, store=None):
+        self.meta = MetasrvServer(
+            addr="127.0.0.1", port=0, data_home=str(tmp_path / "meta")
+        ).start()
+        self.meta_addr = f"127.0.0.1:{self.meta.port}"
+        self.datanodes = {}
+        for i in range(n_datanodes):
+            home = str(tmp_path / f"dn{i}")
+            inst = Standalone(
+                engine_config=EngineConfig(data_root=home,
+                                           enable_background=False),
+                prefer_device=False, warm_start=False, store=store,
+            )
+            inst.region_server = RegionServer(inst.engine, home)
+            fs = FlightFrontend(inst, port=0).start()
+            MetaClient(self.meta_addr).register(
+                i, f"127.0.0.1:{fs.server.port}"
+            )
+            self.datanodes[i] = (inst, fs)
+        self.frontend = DistInstance(
+            str(tmp_path / "fe"), self.meta_addr, prefer_device=False
+        )
+
+    def region_servers(self):
+        return [inst.region_server for inst, _ in self.datanodes.values()]
+
+    def close(self):
+        self.frontend.close()
+        for inst, fs in self.datanodes.values():
+            fs.close()
+            inst.close()
+        self.meta.close()
+
+
+@pytest.fixture()
+def harness(tmp_path):
+    h = _Harness(tmp_path)
+    yield h
+    h.close()
+
+
+Q = "select host, sum(v), count(*) from t1 group by host order by host"
+
+
+def _seed(fe, rows=40):
+    fe.execute_sql(
+        "create table t1 (ts timestamp time index, host string "
+        "primary key, v double) with (num_regions = 2)"
+    )
+    values = ", ".join(
+        f"('h{i % 4}', {1_000_000 + i * 1000}, {float(i)})"
+        for i in range(rows)
+    )
+    fe.execute_sql(f"insert into t1 (host, ts, v) values {values}")
+
+
+def test_warm_query_hits_cache(harness):
+    fe = harness.frontend
+    _seed(fe)
+    cold = fe.sql(Q).rows()
+    h0 = _counter("gtpu_dist_scan_cache_hits_total")
+    warm = fe.sql(Q).rows()
+    assert warm == cold
+    assert _counter("gtpu_dist_scan_cache_hits_total") > h0
+    assert sum(rs.scan_cache.entry_count
+               for rs in harness.region_servers()) > 0
+
+
+def test_write_invalidates_through_frontend(harness):
+    fe = harness.frontend
+    _seed(fe)
+    before = fe.sql(Q).rows()
+    fe.sql(Q)  # cached on every datanode
+    fe.execute_sql(
+        "insert into t1 (host, ts, v) values ('h0', 99000000, 1000.0)"
+    )
+    after = fe.sql(Q).rows()
+    assert after != before
+    h0 = next(r for r in after if r[0] == "h0")
+    b0 = next(r for r in before if r[0] == "h0")
+    assert h0[1] == b0[1] + 1000.0 and h0[2] == b0[2] + 1
+
+
+def test_delete_and_truncate_invalidate(harness):
+    fe = harness.frontend
+    _seed(fe)
+    fe.sql(Q)
+    fe.sql(Q)
+    fe.execute_sql("delete from t1 where host = 'h1'")
+    rows = fe.sql(Q).rows()
+    assert all(r[0] != "h1" for r in rows)
+    fe.catalog.table("public", "t1").truncate()
+    assert fe.sql("select count(*) from t1").rows() == [[0]]
+
+
+def test_flush_bumps_physical_version_and_invalidates(harness):
+    fe = harness.frontend
+    _seed(fe)
+    cold = fe.sql(Q).rows()
+    fe.sql(Q)
+    versions = {
+        r.meta.region_id: r.physical_version
+        for inst, _ in harness.datanodes.values()
+        for r in inst.engine.regions()
+        if r.memtable.rows  # an empty region's flush is a no-op
+    }
+    assert versions
+    fe.catalog.table("public", "t1").flush()  # frontend -> datanode RPC
+    m0 = _counter("gtpu_dist_scan_cache_misses_total")
+    assert fe.sql(Q).rows() == cold
+    # flush bumped every flushed region's version: the old entries were
+    # NOT served (a fresh build = at least one miss)
+    for inst, _ in harness.datanodes.values():
+        for region in inst.engine.regions():
+            if region.meta.region_id in versions:
+                assert region.physical_version != \
+                    versions[region.meta.region_id]
+    assert _counter("gtpu_dist_scan_cache_misses_total") > m0
+
+
+def test_compact_bumps_physical_version_and_invalidates(harness):
+    fe = harness.frontend
+    _seed(fe, rows=20)
+    table = fe.catalog.table("public", "t1")
+    table.flush()
+    for round_ in range(4):  # enough level-0 SSTs in one window to
+        fe.execute_sql(      # trip the TWCS picker
+            "insert into t1 (host, ts, v) values "
+            + ", ".join(
+                f"('h{i % 4}', {2_000_000 + round_ * 40_000 + i * 1000},"
+                f" {float(i)})"
+                for i in range(20)
+            )
+        )
+        table.flush()
+    cold = fe.sql(Q).rows()
+    fe.sql(Q)
+    m0 = _counter("gtpu_dist_scan_cache_misses_total")
+    compacted = 0
+    for region_proxy in table.regions:
+        before = region_proxy.data_version
+        if region_proxy.compact():
+            compacted += 1
+            # logical version is flush/compact-stable...
+            assert region_proxy.data_version == before
+    assert compacted > 0
+    # ...but the scan-cache's physical version is not: no stale serve
+    assert fe.sql(Q).rows() == cold
+    assert _counter("gtpu_dist_scan_cache_misses_total") > m0
+
+
+def test_migration_purges_source_cache(tmp_path):
+    from greptimedb_tpu.storage.object_store import FsObjectStore
+
+    shared = FsObjectStore(str(tmp_path / "shared_store"))
+    h = _Harness(tmp_path, n_datanodes=2, store=shared)
+    try:
+        fe = h.frontend
+        fe.execute_sql(
+            "create table gm (ts timestamp time index, host string "
+            "primary key, v double)"
+        )
+        fe.execute_sql(
+            "insert into gm (host, ts, v) values ('a', 1000, 1.0), "
+            "('b', 2000, 2.0)"
+        )
+        q = "select host, sum(v) from gm group by host order by host"
+        want = fe.sql(q).rows()
+        fe.sql(q)  # cached on the source datanode
+        ms = h.meta.metasrv
+        rid = fe.catalog.table("public", "gm").info.region_ids()[0]
+        src = ms.route_of(rid)
+        src_rs = h.datanodes[src][0].region_server
+        assert src_rs.scan_cache.entry_count > 0
+        ms.migrate_region(rid, 1 - src)
+        # the close step of the migration purged the source's entries
+        assert src_rs.scan_cache.entry_count == 0
+        fe.catalog.refresh()
+        assert fe.sql(q).rows() == want
+        # and a write on the TARGET hosting is visible immediately
+        fe.execute_sql(
+            "insert into gm (host, ts, v) values ('a', 3000, 10.0)"
+        )
+        rows = fe.sql(q).rows()
+        assert rows == [["a", 11.0], ["b", 2.0]]
+    finally:
+        h.close()
+
+
+def test_ttl_regions_bypass_cache(harness):
+    """TTL tables derive their effective scan window from the wall
+    clock inside Region.scan: a cached merge would keep serving expired
+    rows forever (no version bump happens at expiry), so TTL'd regions
+    must never enter the cache."""
+    import time as _time
+
+    fe = harness.frontend
+    fe.execute_sql(
+        "create table tt (ts timestamp time index, host string "
+        "primary key, v double) with (ttl = '1h', num_regions = 2)"
+    )
+    now = int(_time.time() * 1000)
+    fe.execute_sql(
+        "insert into tt (host, ts, v) values "
+        f"('a', {now - 2 * 3600_000}, 1.0), "   # already expired
+        f"('b', {now - 60_000}, 2.0)"           # live
+    )
+    q = "select host, sum(v) from tt group by host order by host"
+    assert fe.sql(q).rows() == [["b", 2.0]]
+    n0 = sum(rs.scan_cache.entry_count for rs in harness.region_servers())
+    fe.sql(q)
+    assert sum(rs.scan_cache.entry_count
+               for rs in harness.region_servers()) == n0
+
+
+def test_reopen_purges_previous_hosting_entries(tmp_path):
+    """RegionServer-level: close + reopen of a region must not serve a
+    merge built from the previous hosting."""
+    from greptimedb_tpu.catalog.manager import TableInfo
+    from greptimedb_tpu.datatypes.schema import (
+        ColumnSchema,
+        Schema,
+        SemanticType,
+    )
+    from greptimedb_tpu.datatypes.types import ConcreteDataType as T
+    from greptimedb_tpu.dist.remote import region_meta_doc
+
+    inst = Standalone(
+        engine_config=EngineConfig(data_root=str(tmp_path / "dn"),
+                                   enable_background=False),
+        prefer_device=False, warm_start=False,
+    )
+    rs = RegionServer(inst.engine, str(tmp_path / "dn"))
+    try:
+        info = TableInfo(
+            table_id=7, name="t", database="public",
+            schema=Schema([
+                ColumnSchema("ts", T.timestamp_millisecond(),
+                             SemanticType.TIMESTAMP, nullable=False),
+                ColumnSchema("host", T.string(), SemanticType.TAG),
+                ColumnSchema("v", T.float64(), SemanticType.FIELD),
+            ]),
+        )
+        rid = info.region_ids()[0]
+        doc = region_meta_doc(info, rid)
+        rs.open_region(doc)
+        rs.write(rid, {"host": np.asarray(["a"], object)},
+                 np.asarray([1000], np.int64),
+                 {"v": np.asarray([1.0])}, None, op=0)
+        rows, tags, _names, _st = rs.scan([rid])
+        assert len(rows) == 1 and rs.scan_cache.entry_count == 1
+        rs.close_region(rid)
+        assert rs.scan_cache.entry_count == 0
+        rs.open_region(doc)
+        rs.write(rid, {"host": np.asarray(["b"], object)},
+                 np.asarray([2000], np.int64),
+                 {"v": np.asarray([2.0])}, None, op=0)
+        rows2, tags2, _n2, _s2 = rs.scan([rid])
+        assert sorted(tags2["host"]) == ["a", "b"]
+        assert len(rows2) == 2
+    finally:
+        rs.close()
+        inst.close()
