@@ -60,6 +60,19 @@ def build_parser() -> argparse.ArgumentParser:
         start.add_argument("--node-id", type=int, default=None)
         start.add_argument("--no-flows", action="store_true")
 
+    lint = sub.add_parser(
+        "lint", help="run gtlint (AST correctness linter) over the "
+                     "given paths; exits non-zero on findings",
+    )
+    lint.add_argument("paths", nargs="*", default=None)
+    lint.add_argument("--format", choices=("text", "json"),
+                      default="text")
+    lint.add_argument("--baseline", default=None)
+    lint.add_argument("--no-baseline", action="store_true")
+    lint.add_argument("--write-baseline", action="store_true")
+    lint.add_argument("--select", default=None)
+    lint.add_argument("--list-rules", action="store_true")
+
     cli = sub.add_parser("cli")
     # the real default lives on the parent; subcommand flags use SUPPRESS
     # so `cli --data-home X <cmd>` isn't clobbered by subparser defaults
@@ -82,6 +95,19 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.role == "lint":
+        from greptimedb_tpu.tools.lint.runner import main as lint_main
+
+        fwd = list(args.paths or [])
+        fwd += ["--format", args.format]
+        if args.baseline:
+            fwd += ["--baseline", args.baseline]
+        for flag in ("no_baseline", "write_baseline", "list_rules"):
+            if getattr(args, flag):
+                fwd.append("--" + flag.replace("_", "-"))
+        if args.select:
+            fwd += ["--select", args.select]
+        return lint_main(fwd)
     if args.role == "cli":
         cmd = getattr(args, "cli_cmd", None)
         if cmd == "export":
@@ -149,8 +175,9 @@ def _serve_until_signal(closers):
         for c in reversed(closers):
             try:
                 c()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                # keep tearing the rest down, but say what broke
+                print(f"# shutdown: {c} failed: {e}", flush=True)
     return 0
 
 
@@ -284,8 +311,9 @@ def _make_instance(opts):
             inst.enable_flows(
                 tick_interval_s=opts.get("flow.tick_interval_s", 1.0)
             )
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            # the node still serves reads/writes without flows
+            print(f"# flows disabled: {e}", flush=True)
     from greptimedb_tpu.telemetry.slow_query import SlowQueryLog
 
     inst.slow_query_log = SlowQueryLog(
@@ -351,9 +379,12 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst,
     MetaClient follows leader redirects across a comma-separated
     --metasrv-addr list, so a metasrv leader kill re-registers this node
     with the new leader on the next beat."""
+    import logging
     import threading
 
     from greptimedb_tpu.dist.client import MetaClient
+
+    _hb_log = logging.getLogger("greptimedb_tpu.heartbeat")
 
     stop = threading.Event()
     client = MetaClient(meta_addr)
@@ -379,8 +410,9 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst,
                                 "rows": int(getattr(r.memtable, "rows",
                                                     0)),
                             }
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    # stats are advisory; heartbeat with what we have
+                    _hb_log.debug("region stat collection: %s", e)
                 for ins in client.heartbeat(node_id, stats):
                     if ins.get("type") == "grant_lease":
                         rs = getattr(inst, "region_server", None)
@@ -406,8 +438,8 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst,
                     for rid in rs.enforce_leases():
                         print(f"# region {rid} lease expired: fenced",
                               flush=True)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                print(f"# lease enforcement failed: {e}", flush=True)
             if stop.wait(2.0):
                 return
 

@@ -15,6 +15,7 @@ datanode over Flight.
 from __future__ import annotations
 
 import json
+import logging
 import time
 
 from greptimedb_tpu.catalog.manager import (
@@ -37,6 +38,8 @@ from greptimedb_tpu.errors import (
     TableNotFoundError,
     UnsupportedError,
 )
+
+_log = logging.getLogger("greptimedb_tpu.dist.catalog")
 
 DB_PREFIX = "__cat/db/"
 TABLE_PREFIX = "__cat/table/"
@@ -328,12 +331,16 @@ class DistCatalogManager(CatalogManager):
             for r in getattr(table, "regions", []):
                 try:
                     r.client.drop_region(r.meta.region_id)
-                except Exception:  # noqa: BLE001 - best effort teardown
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    # best-effort teardown: an unreachable datanode
+                    # must not block the DROP; orphaned region dirs
+                    # are reclaimed when the node reopens
+                    _log.warning("drop_region %s on %s failed: %s",
+                                 r.meta.region_id, r.client.addr, e)
             try:
                 self.meta.remove_routes(rids)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                _log.warning("remove_routes %s failed: %s", rids, e)
             self._del_table(database, name)
 
     # ------------------------------------------------------------------
@@ -422,8 +429,9 @@ class DistCatalogManager(CatalogManager):
                     if peers.get(nid) != cli.addr:
                         try:
                             cli.close()
-                        except Exception:  # noqa: BLE001
-                            pass
+                        except Exception as e:  # noqa: BLE001
+                            _log.debug("closing stale client for "
+                                       "node %s: %s", nid, e)
                         del self._clients[nid]
             self._databases = {}
             self._views = {}

@@ -9,6 +9,7 @@ remote-table layer and the dist catalog talk through.
 from __future__ import annotations
 
 import json
+import logging
 import re
 import threading
 import urllib.request
@@ -20,19 +21,25 @@ from greptimedb_tpu.errors import (
 )
 
 
+_log = logging.getLogger("greptimedb_tpu.dist.client")
+
+
 def _strip_flight_error(e) -> str:
     msg = str(e).split("gRPC client debug context")[0]
     return msg.split(". Detail: Failed")[0].strip().rstrip(". ")
 
 
 def _is_unavailable(e) -> bool:
+    """Transport-level unreachability, decided purely by TYPE: gRPC
+    maps a dead/refusing peer to FlightUnavailableError and a deadline
+    miss to FlightTimedOutError; raw socket failures are OSError
+    (ConnectionError included). Server-side application errors never
+    take these types — they arrive marker-stamped and are re-raised
+    typed by map_flight_error before this check runs."""
     import pyarrow.flight as flight
 
-    if isinstance(e, (flight.FlightUnavailableError,
-                      flight.FlightTimedOutError, ConnectionError)):
-        return True
-    return "unavailable" in str(e).lower() or \
-        "failed to connect" in str(e).lower()
+    return isinstance(e, (flight.FlightUnavailableError,
+                          flight.FlightTimedOutError, OSError))
 
 
 # typed-error marker a server stamped on the message (servers/flight.py
@@ -44,11 +51,10 @@ _CODE_RE = re.compile(r"\[gtdb:(\d+)\]\s*")
 def map_flight_error(e: Exception, addr: str) -> GreptimeError:
     """Flight/socket error -> typed GreptimeError. A `[gtdb:<code>]`
     marker re-raises the remote error as its dedicated class — checked
-    FIRST, because the unavailable substring heuristic would otherwise
-    misclassify a typed server error that merely mentions
-    'unavailable' (e.g. a StorageError) as the retryable
-    datanode-unreachable case. Transport-level failures never carry
-    the marker, so they fall through to the heuristic."""
+    FIRST so a typed server error is never misclassified as the
+    retryable datanode-unreachable case. Transport-level failures
+    never carry the marker and are recognised by exception TYPE
+    (_is_unavailable), not message text."""
     msg = _strip_flight_error(e)
     m = _CODE_RE.search(msg)
     if m:
@@ -81,8 +87,11 @@ class DatanodeClient:
             if self._conn is not None:
                 try:
                     self._conn.close()
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    # closing an already-broken channel raising is
+                    # expected; the connection is dropped either way
+                    _log.debug("closing flight conn to %s: %s",
+                               self.addr, e)
                 self._conn = None
 
     def _raise(self, e):

@@ -68,6 +68,19 @@ class DistInstance(Standalone):
         self._mirror_epoch: dict[str, str] = {}
         self._mirror_down: set[str] = set()
         self._mirror_probe_at: dict[str, float] = {}
+        # bounded background retriers (one per down flownode) drain the
+        # backlog WITHOUT waiting for the next insert — replay must not
+        # depend on new traffic arriving after a flownode restart
+        self._mirror_retriers: set[str] = set()
+        self._mirror_stop = False
+        # monotonic time the node was LAST confirmed down (failed
+        # probe / failed ship; cleared when the outage ends): on an
+        # epoch change, backlog entries appended before this instant
+        # were durable in the source before the restarted node's
+        # startup backfill scanned it, so that backfill covers them —
+        # replaying would double-count. Later entries (inserts that
+        # landed after the node came back) must ship.
+        self._mirror_down_at: dict[str, float] = {}
 
     def execute_statement(self, stmt, ctx):
         from greptimedb_tpu.errors import (
@@ -172,6 +185,21 @@ class DistInstance(Standalone):
         except Exception:  # noqa: BLE001 - node down/hung
             cli.close()
             self._mirror_probe_at[addr] = now
+            # REAL down evidence (an attempted probe failed) — unlike
+            # the cooldown early-return above, which proves nothing
+            # and must NOT advance the stale-backlog cutoff: the
+            # retrier early-returns on cooldown every 0.5s, which
+            # would sweep the cutoff past genuinely post-restart
+            # deltas. LAST real evidence is the cutoff by design:
+            # everything queued before the node was last seen down is
+            # durable in the source the restarted node backfilled
+            # from, so replaying it double-counts (verified by
+            # test_flownode_crash_mirror_replay). The residual risk —
+            # a spuriously failed probe against an already-recovered
+            # node marking a just-queued delta stale — needs the blip
+            # to land exactly between that delta's append and the
+            # epoch observation, and loses at most that window.
+            self._mirror_down_at[addr] = now
             return None
         self._mirror_probe_at.pop(addr, None)
         if ep and record:
@@ -424,11 +452,13 @@ class DistInstance(Standalone):
 
     def _mirror_delta(self, addr: str, db: str, name: str, batch):
         """Ship backlog first (order preserved), then this delta;
-        failures append to the bounded PER-NODE backlog. When the node
-        comes back with a NEW epoch, the backlog is dropped instead of
-        replayed: the restarted flownode re-derived its state from the
-        durable source rows, which already include everything the
-        backlog carried (mirroring happens after the source write)."""
+        failures append to the bounded PER-NODE backlog and arm a
+        background retrier so replay does not wait for the NEXT
+        insert. When the node comes back with a NEW epoch, stale
+        backlog is dropped instead of replayed: the restarted flownode
+        re-derived its state from the durable source rows, which
+        already include everything the backlog carried (mirroring
+        happens after the source write)."""
         import collections
 
         from greptimedb_tpu.telemetry.metrics import global_registry
@@ -440,59 +470,151 @@ class DistInstance(Standalone):
             lock = self._mirror_addr_locks.setdefault(
                 addr, threading.Lock()
             )
+        import time as _time
+
         with lock:
-            had_backlog = bool(q)
-            q.append((db, name, batch))
+            q.append((db, name, batch, _time.monotonic()))
             nbytes = self._mirror_backlog_bytes.get(addr, 0)
             nbytes += batch.nbytes
             # bounded per node: drop its OLDEST beyond budget
             while nbytes > self._MIRROR_BACKLOG_BYTES and len(q) > 1:
-                _db, _nm, dropped = q.popleft()
+                _db, _nm, dropped, _t = q.popleft()
                 nbytes -= dropped.nbytes
                 global_registry.counter(
                     "gtpu_flow_mirror_dropped_total",
                     "mirror deltas dropped beyond the backlog budget",
                 ).inc()
             self._mirror_backlog_bytes[addr] = nbytes
-            if had_backlog and addr in self._mirror_down:
-                # node was down with queued deltas: check incarnation
-                ep = self._probe_epoch(addr, record=False)
-                if ep is None:
+            drained = self._drain_backlog_locked(addr, q, count=True)
+        if not drained:
+            self._arm_mirror_retry(addr)
+
+    def _drain_backlog_locked(self, addr: str, q, *, count: bool
+                              ) -> bool:
+        """Ship the backlog in order; caller holds the per-address
+        lock. Returns True when the backlog is empty on exit. `count`
+        records probe failures in the mirror-error counter (the insert
+        path); the retrier's periodic probes are not mirror attempts.
+
+        On an epoch change — the node restarted and re-derived its
+        state from the durable source rows — entries appended before
+        the node was last confirmed down are covered by that startup
+        backfill and replaying them would double-count, so they are
+        dropped; entries appended later (inserts that landed after
+        the restart, e.g. parked behind the probe cooldown) still
+        ship."""
+        import time as _time
+
+        from greptimedb_tpu.telemetry.metrics import global_registry
+
+        if not q:
+            return True
+        if addr in self._mirror_down:
+            # node was down with queued deltas: check incarnation
+            ep = self._probe_epoch(addr, record=False)
+            if ep is None:
+                if count:
                     global_registry.counter(
                         "gtpu_flow_mirror_errors_total",
                         "failed source-delta mirrors to the flownode",
                     ).inc()
+                return False
+            if ep and ep != self._mirror_epoch.get(addr):
+                # restart detected — or no recorded incarnation at
+                # all, where replay risks double-count against the
+                # node's startup backfill
+                # entries append in time order: the stale prefix is
+                # contiguous. Entries newer than the cutoff but older
+                # than the restart are AMBIGUOUS (e.g. appended during
+                # the probe cooldown): they ship, accepting a narrow
+                # double-count race iff the node's backfill completed
+                # AND scanned their rows before they arrive — the
+                # flownode's needs_backfill gate skips-and-rescans
+                # otherwise. Dropping them instead would risk silently
+                # LOSING a post-restart delta forever, which is worse.
+                cutoff = self._mirror_down_at.get(addr, 0.0)
+                while q and q[0][3] <= cutoff:
+                    _d, _n, old, _t = q.popleft()
+                    self._mirror_backlog_bytes[addr] -= old.nbytes
+            if ep:
+                self._mirror_epoch[addr] = ep
+            self._mirror_down.discard(addr)
+            # outage over: the next outage records its own first
+            # failure instant
+            self._mirror_down_at.pop(addr, None)
+        while q:
+            d, nm, b, _t = q[0]
+            try:
+                self._ship_mirror(addr, d, nm, b)
+            except Exception:  # noqa: BLE001 - node down: keep
+                self._mirror_down.add(addr)
+                self._mirror_down_at[addr] = _time.monotonic()
+                global_registry.counter(
+                    "gtpu_flow_mirror_errors_total",
+                    "failed source-delta mirrors to the flownode",
+                ).inc()
+                return False
+            q.popleft()
+            self._mirror_backlog_bytes[addr] -= b.nbytes
+        if addr not in self._mirror_epoch:
+            # first successful contact: record the incarnation so a
+            # later restart is detectable
+            self._probe_epoch(addr)
+        return True
+
+    # bounded retry/poll: how often a down node's backlog is retried
+    # and for how long before giving up until the next insert re-arms
+    _MIRROR_RETRY_INTERVAL_S = 0.5
+    _MIRROR_RETRY_WINDOW_S = 300.0
+
+    def _arm_mirror_retry(self, addr: str):
+        """Start (at most one per address) a bounded background drain:
+        mirror replay after a flownode restart must not depend on new
+        inserts arriving — the pre-retrier behaviour left the backlog
+        parked until the next write, which is exactly the
+        test_flownode_crash_mirror_replay flake."""
+        with self._mirror_lock:
+            if self._mirror_stop or addr in self._mirror_retriers:
+                return
+            self._mirror_retriers.add(addr)
+        threading.Thread(
+            target=self._mirror_retry_loop, args=(addr,),
+            daemon=True, name=f"mirror-retry-{addr}",
+        ).start()
+
+    def _mirror_retry_loop(self, addr: str):
+        import time as _time
+
+        deadline = _time.monotonic() + self._MIRROR_RETRY_WINDOW_S
+        expired = False
+        try:
+            while not self._mirror_stop:
+                if _time.monotonic() >= deadline:
+                    expired = True
                     return
-                if ep and ep != self._mirror_epoch.get(addr):
-                    # restart detected — or no recorded incarnation at
-                    # all, where replay risks double-count against the
-                    # node's startup backfill (backlogged rows are
-                    # durable in the source it scanned): drop all but
-                    # the NEWEST delta (the one just appended, inserted
-                    # after that backfill)
-                    while len(q) > 1:
-                        _d, _n, old = q.popleft()
-                        self._mirror_backlog_bytes[addr] -= old.nbytes
-                if ep:
-                    self._mirror_epoch[addr] = ep
-                self._mirror_down.discard(addr)
-            while q:
-                d, nm, b = q[0]
-                try:
-                    self._ship_mirror(addr, d, nm, b)
-                except Exception:  # noqa: BLE001 - node down: keep
-                    self._mirror_down.add(addr)
-                    global_registry.counter(
-                        "gtpu_flow_mirror_errors_total",
-                        "failed source-delta mirrors to the flownode",
-                    ).inc()
+                _time.sleep(self._MIRROR_RETRY_INTERVAL_S)
+                with self._mirror_lock:
+                    q = self._mirror_backlog.get(addr)
+                    lock = self._mirror_addr_locks.get(addr)
+                if not q or lock is None:
                     return
-                q.popleft()
-                self._mirror_backlog_bytes[addr] -= b.nbytes
-            if addr not in self._mirror_epoch:
-                # first successful contact: record the incarnation so a
-                # later restart is detectable
-                self._probe_epoch(addr)
+                with lock:
+                    if self._drain_backlog_locked(addr, q, count=False):
+                        return
+        finally:
+            with self._mirror_lock:
+                self._mirror_retriers.discard(addr)
+                # an insert whose drain failed between our exit
+                # decision and this deregistration saw the retrier
+                # still armed and skipped re-arming — re-check the
+                # backlog so that delta is not parked until the next
+                # insert. Window expiry is exempt: that bound exists
+                # so a permanently-dead flownode doesn't retry
+                # forever, and the next insert re-arms.
+                rearm = (not expired and not self._mirror_stop
+                         and bool(self._mirror_backlog.get(addr)))
+            if rearm:
+                self._arm_mirror_retry(addr)
 
     def _notify_flows(self, db, name, table, data, valid):
         # local in-process flows still work (flows enabled directly on
@@ -531,6 +653,7 @@ class DistInstance(Standalone):
 
     def close(self):
         try:
+            self._mirror_stop = True   # retrier threads exit promptly
             with self._mirror_lock:
                 clients = list(self._flow_clients.values())
             for cli in clients:

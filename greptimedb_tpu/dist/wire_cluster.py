@@ -15,6 +15,7 @@ region's data is reachable from its new owner.
 
 from __future__ import annotations
 
+import logging
 import time
 
 from greptimedb_tpu.catalog.manager import _REGION_SHIFT
@@ -22,6 +23,8 @@ from greptimedb_tpu.dist.catalog import TABLE_PREFIX
 from greptimedb_tpu.errors import IllegalStateError, RegionNotFoundError
 
 _META_TTL_S = 5.0
+
+_log = logging.getLogger("greptimedb_tpu.dist.wire_cluster")
 
 
 class WireCluster:
@@ -59,8 +62,9 @@ class WireCluster:
         if stale is not None:
             try:
                 stale.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                _log.debug("closing stale client %s: %s",
+                           stale.addr, e)
         return cli
 
     def _table_info(self, table_id: int):
@@ -127,8 +131,11 @@ class WireCluster:
         cli = self._client(node_id)
         try:
             cli.action("close_region", {"region_id": region_id})
-        except Exception:  # noqa: BLE001 - candidate open may be gone
-            pass
+        except Exception as e:  # noqa: BLE001
+            # the candidate's provisional open may already be gone;
+            # the authoritative reopen below decides success
+            _log.debug("pre-upgrade close of region %s on node %s: %s",
+                       region_id, node_id, e)
         cli.open_region(self._region_meta_doc(region_id))
 
     def close_region_on(self, node_id: int, region_id: int) -> None:
@@ -136,12 +143,15 @@ class WireCluster:
             self._client(node_id).action(
                 "close_region", {"region_id": region_id}
             )
-        except Exception:  # noqa: BLE001 - dead/unreachable source
-            pass
+        except Exception as e:  # noqa: BLE001
+            # failover source is typically dead/unreachable — that is
+            # why the migration is running; its lease fences it
+            _log.info("close_region %s on node %s failed: %s",
+                      region_id, node_id, e)
 
     def close(self):
         for cli in self._clients.values():
             try:
                 cli.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                _log.debug("closing client %s: %s", cli.addr, e)

@@ -20,6 +20,7 @@ restructured TPU-first:
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 
@@ -38,6 +39,8 @@ from greptimedb_tpu.query.expr import eval_expr
 from greptimedb_tpu.query.planner import plan_select
 from greptimedb_tpu.sql import ast as A
 from greptimedb_tpu.sql.parser import parse_sql
+
+_log = logging.getLogger("greptimedb_tpu.flow.manager")
 
 FLOWS_PATH = "meta/flows.json"
 
@@ -244,8 +247,11 @@ class FlowManager:
                     try:
                         self._backfill(flow, table)
                         flow.needs_backfill = False
-                    except Exception:  # noqa: BLE001 - retried in tick
-                        pass
+                    except Exception as e:  # noqa: BLE001
+                        # needs_backfill stays set; the tick loop
+                        # retries once the source is reachable
+                        _log.info("backfill of flow %s deferred: %s",
+                                  flow.name, e)
                 self._flows[flow.name] = flow
                 self._by_source.setdefault(
                     flow.source_table, []

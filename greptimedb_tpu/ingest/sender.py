@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import logging
 import threading
 import time
 
@@ -33,6 +34,8 @@ from greptimedb_tpu.errors import (
 )
 from greptimedb_tpu.ingest.coalescer import AdaptiveDelay, coalesce_entries
 from greptimedb_tpu.telemetry.metrics import global_registry
+
+_log = logging.getLogger("greptimedb_tpu.ingest.sender")
 
 STREAM_DESCRIPTOR = "region_write_stream"
 
@@ -351,15 +354,17 @@ class DatanodeSender:
         _RECONNECTS.labels(self.addr).inc()
         try:
             stream.writer.close()
-        except Exception:  # noqa: BLE001 - already broken
-            pass
+        except Exception as e:  # noqa: BLE001
+            # the stream is already torn down; the close is cosmetic
+            _log.debug("closing broken stream %s: %s", stream.key, e)
         if isinstance(error, DatanodeUnavailableError):
             # failover may have moved this node's regions: force the
             # shared channel to redial on next use
             try:
                 self.client.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                _log.debug("closing shared channel to %s: %s",
+                           self.addr, e)
         for gid in gids:
             self._complete_group(gid, error)
 
@@ -379,8 +384,9 @@ class DatanodeSender:
             try:
                 st.writer.done_writing()
                 st.writer.close()
-            except Exception:  # noqa: BLE001 - best-effort teardown
-                pass
+            except Exception as e:  # noqa: BLE001
+                # best-effort teardown of an unhooked stream
+                _log.debug("finishing stream %s: %s", st.key, e)
 
     def _finish_streams(self):
         with self._cv:
@@ -403,8 +409,10 @@ class DatanodeSender:
             try:
                 if self._on_group_error(entries, error):
                     return  # requeued: tickets stay pending
-            except Exception:  # noqa: BLE001 - policy must not wedge acks
-                pass
+            except Exception as e:  # noqa: BLE001
+                # the retry policy must never wedge ack delivery; the
+                # original error still reaches every waiting ticket
+                _log.warning("group-error policy failed: %s", e)
         for e in entries:
             tickets = e.tickets or (
                 [e.ticket] if e.ticket is not None else []

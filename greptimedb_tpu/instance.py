@@ -9,6 +9,8 @@ through the planner/executor. Protocol servers (HTTP/gRPC) call into this.
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 from greptimedb_tpu.catalog import CatalogManager
@@ -151,8 +153,10 @@ def _enable_xla_persistent_cache(data_root: str):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         _xla_cache_enabled = True
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001
+        # purely a warm-start optimisation; run uncached without it
+        logging.getLogger("greptimedb_tpu.instance").debug(
+            "xla persistent cache unavailable: %s", e)
 
 
 class Standalone:
@@ -189,8 +193,10 @@ class Standalone:
                     )
 
                     warm_from_snapshots(self.query_engine, self.catalog)
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    # cold caches are only slower, never wrong
+                    logging.getLogger("greptimedb_tpu.instance").debug(
+                        "device cache warm-start skipped: %s", e)
 
             threading.Thread(
                 target=_warm, daemon=True, name="device-cache-warm"

@@ -15,10 +15,13 @@ cleanly), and stepping down deletes the key for an immediate handover.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 
 from greptimedb_tpu.meta.kv import KvBackend
+
+_log = logging.getLogger("greptimedb_tpu.meta.election")
 
 LEADER_KEY = "__meta/election/leader"
 
@@ -100,8 +103,9 @@ class Election:
         if won != was and self.on_change is not None:
             try:
                 self.on_change(won)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                # a throwing observer must not demote/kill the loop
+                _log.warning("leadership observer failed: %s", e)
         return won
 
     def resign(self):
@@ -121,8 +125,9 @@ class Election:
         if was and self.on_change is not None:
             try:
                 self.on_change(False)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                _log.warning("leadership observer failed on resign: %s",
+                             e)
 
     # ---- lifecycle ----------------------------------------------------
     def start(self) -> "Election":
@@ -138,8 +143,10 @@ class Election:
         while not self._stop.wait(self.tick_s):
             try:
                 self.step()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                # kv momentarily unreachable: lease expiry handles
+                # demotion; keep ticking so we can re-campaign
+                _log.debug("election step failed: %s", e)
 
     def stop(self, *, resign: bool = True):
         self._stop.set()

@@ -14,6 +14,7 @@ update_metadata).
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -22,6 +23,8 @@ from greptimedb_tpu.errors import IllegalStateError
 from greptimedb_tpu.meta.failure_detector import PhiAccrualFailureDetector
 from greptimedb_tpu.meta.kv import KvBackend
 from greptimedb_tpu.meta.procedure import Procedure, ProcedureManager, Status
+
+_log = logging.getLogger("greptimedb_tpu.meta.metasrv")
 
 ROUTE_PREFIX = "__route/"
 PEER_PREFIX = "__peer/"
@@ -297,5 +300,8 @@ class RegionMigrationProcedure(Procedure):
         # abort: drop the half-opened candidate, keep the original route
         try:
             cluster.close_region_on(self.to_node, self.region_id)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            # the candidate may never have opened; the kept route is
+            # what guarantees correctness, not this cleanup
+            _log.info("rollback close of region %s on node %s: %s",
+                      self.region_id, self.to_node, e)

@@ -37,6 +37,7 @@ bounds, expression-valued aggregate args, quantiles).
 from __future__ import annotations
 
 import functools
+import logging
 import math
 import threading
 from dataclasses import dataclass, field as dc_field
@@ -45,6 +46,8 @@ import numpy as np
 
 from greptimedb_tpu.errors import UnsupportedError
 from greptimedb_tpu.sql import ast as A
+
+_log = logging.getLogger("greptimedb_tpu.query.device_range")
 
 DEVICE_THRESHOLD = 262_144       # min table rows before the cache pays off
 _CELL_CAP = 256 * 1024 * 1024    # max S*NB cells per cached array (1GB f32)
@@ -773,8 +776,9 @@ def _persist_program_specs(entry: _Entry, table) -> None:
             _program_specs_path(entry, region),
             _json.dumps(doc).encode(),
         )
-    except Exception:  # noqa: BLE001 - advisory metadata only
-        pass
+    except Exception as e:  # noqa: BLE001
+        # advisory warm-start metadata only; queries recompile lazily
+        _log.debug("program-spec snapshot write skipped: %s", e)
 
 
 def precompile_programs(entry: _Entry, table) -> int:
@@ -798,8 +802,9 @@ def precompile_programs(entry: _Entry, table) -> int:
     # matcher-less variant the flagship shape uses)
     try:
         run_prelude(entry, None, -(2**31) + 1, 2**31 - 1)
-    except Exception:  # noqa: BLE001
-        pass
+    except Exception as e:  # noqa: BLE001
+        # warmup miss: the first real query compiles it instead
+        _log.debug("prelude precompile skipped: %s", e)
     program = get_program()
     _, put1 = _make_put(getattr(entry, "mesh", None))
     done = 0

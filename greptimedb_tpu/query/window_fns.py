@@ -460,7 +460,9 @@ def _running_scans(numeric, cnt, valid, part_start, name, n):
                     jnp.asarray(numeric, jnp.float64), d_reset
                 ))
                 run_cnt = np.asarray(S.segmented_cumsum(
-                    jnp.asarray(cnt, jnp.int64), d_reset
+                    # this branch only runs with x64 enabled (the
+                    # `if x64` guard above), so int64 is exact here
+                    jnp.asarray(cnt, jnp.int64), d_reset  # gtlint: disable=GT009
                 ))
                 run_mm = None
                 if want_mm:

@@ -18,6 +18,7 @@ Endpoints (JSON):
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -227,8 +228,11 @@ class MetasrvServer:
                     # leadership lost: a later re-acquisition must
                     # re-check the procedure store
                     self._recovered = False
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                # the tick loop must survive transient kv/detector
+                # failures; the next tick retries
+                logging.getLogger("greptimedb_tpu.meta_http").warning(
+                    "metasrv tick failed: %s", e)
 
     def start(self) -> "MetasrvServer":
         self._srv = ThreadingHTTPServer(

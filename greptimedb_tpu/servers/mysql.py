@@ -21,6 +21,7 @@ import secrets
 import socket
 import socketserver
 import struct
+import logging
 import threading
 
 from greptimedb_tpu.session import QueryContext
@@ -349,8 +350,11 @@ class _Handler(socketserver.BaseRequestHandler):
             # values back; unparseable connector dialects get a blind OK
             try:
                 inst.execute_sql(stripped, ctx)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                # connector-dialect SET we don't parse: blind OK keeps
+                # drivers connecting, but leave a trace
+                logging.getLogger("greptimedb_tpu.mysql").debug(
+                    "SET ignored: %s (%s)", stripped, e)
             conn.send_packet(self._ok())
             return
         if low in ("begin", "commit", "rollback"):

@@ -20,6 +20,7 @@ import secrets
 import socket
 import socketserver
 import struct
+import logging
 import threading
 
 from greptimedb_tpu.session import QueryContext
@@ -373,8 +374,11 @@ class _Handler(socketserver.BaseRequestHandler):
             # unparseable client dialects still get a clean SET reply
             try:
                 inst.execute_sql(stripped, ctx)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                # client-dialect SET we don't parse: clean reply keeps
+                # drivers connecting, but leave a trace
+                logging.getLogger("greptimedb_tpu.postgres").debug(
+                    "SET ignored: %s (%s)", stripped, e)
             conn.send(_msg(b"C", _cstr("SET")))
             conn.send(_ready())
             return

@@ -16,6 +16,7 @@ cluster.py; this is the process-topology wire path).
 from __future__ import annotations
 
 import json
+import logging
 
 from greptimedb_tpu.datatypes.batch import HostColumn
 from greptimedb_tpu.datatypes.types import ConcreteDataType
@@ -138,5 +139,6 @@ class RemoteInstance:
         for cli in self._clients.values():
             try:
                 cli.close()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                logging.getLogger("greptimedb_tpu.remote").debug(
+                    "closing client %s: %s", cli.addr, e)

@@ -1,4 +1,10 @@
-"""Offline data tools: export / import a data home.
+"""Offline tools package.
+
+Submodules: this module (export/import of a data home) and
+`greptimedb_tpu.tools.lint` (gtlint, the AST-based correctness
+linter — see README "Static analysis").
+
+Offline data tools: export / import a data home.
 
 Capability counterpart of the reference's CLI subtools
 (/root/reference/src/cmd/src/cli/export.rs, import.rs): dump every

@@ -1,0 +1,24 @@
+"""gtlint: AST-based correctness linter for greptimedb-tpu.
+
+Rules target the hazard classes this codebase has been bitten by —
+silent exception swallows, error-text substring matching, host/device
+sync and Python branching inside jitted code, recompile churn, locks
+held across blocking Flight I/O, leaked threads/pools, int64-on-TPU
+dtypes, and mutable default arguments.  See README "Static analysis".
+
+    python -m greptimedb_tpu.tools.lint greptimedb_tpu/ --format=json
+"""
+
+from greptimedb_tpu.tools.lint.baseline import Baseline
+from greptimedb_tpu.tools.lint.core import Finding, Rule, all_rules
+from greptimedb_tpu.tools.lint.runner import (
+    lint_paths,
+    lint_source,
+    main,
+    run,
+)
+
+__all__ = [
+    "Baseline", "Finding", "Rule", "all_rules", "lint_paths",
+    "lint_source", "main", "run",
+]

@@ -1,0 +1,76 @@
+"""gtlint baseline: grandfathered findings checked into the repo.
+
+A baseline entry matches a finding by (rule, path, stripped source
+text of the flagged line) — deliberately NOT by line number, so
+unrelated edits above a grandfathered site don't break the gate.
+Matching consumes entries with multiplicity: two identical findings
+need two entries.  Entries that no longer match anything are reported
+as stale so the file shrinks as debt is paid down.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+
+from greptimedb_tpu.tools.lint.core import Finding
+
+
+def _key(rule: str, path: str, text: str) -> tuple:
+    return rule, path.replace("\\", "/"), text.strip()
+
+
+class Baseline:
+    def __init__(self, entries: list[dict] | None = None):
+        self.entries = entries or []
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls([])
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        return cls(list(doc.get("entries", [])))
+
+    def save(self, path: str):
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "entries": self.entries}, f,
+                      indent=1, sort_keys=True)
+            f.write("\n")
+
+    @classmethod
+    def from_findings(cls, findings: list[Finding],
+                      line_text) -> "Baseline":
+        """line_text(path, lineno) -> the flagged line's source."""
+        entries = [
+            {"rule": f.rule, "path": f.path.replace("\\", "/"),
+             "line": f.line, "text": line_text(f.path, f.line).strip()}
+            for f in sorted(findings,
+                            key=lambda f: (f.path, f.line, f.rule))
+        ]
+        return cls(entries)
+
+    def split(self, findings: list[Finding], line_text
+              ) -> tuple[list[Finding], list[Finding], list[dict]]:
+        """(new, grandfathered, stale_entries)."""
+        budget: collections.Counter = collections.Counter(
+            _key(e.get("rule", ""), e.get("path", ""),
+                 e.get("text", "")) for e in self.entries
+        )
+        new, old = [], []
+        for f in findings:
+            k = _key(f.rule, f.path, line_text(f.path, f.line))
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        stale = []
+        for e in self.entries:
+            k = _key(e.get("rule", ""), e.get("path", ""),
+                     e.get("text", ""))
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                stale.append(e)
+        return new, old, stale

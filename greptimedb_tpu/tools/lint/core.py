@@ -1,0 +1,352 @@
+"""gtlint core: findings, rule registry, and the AST walk context.
+
+The linter is one recursive AST walk per file (`ModuleLinter`).  The
+walker maintains the semantic state rules need — enclosing function
+stack with jit/Pallas device info, `with <lock>:` nesting, loop depth,
+live exception-handler variable names — and dispatches each node to
+every rule that registered a `visit_<NodeType>` method.  Rules are
+stateless singletons; all per-file state lives on the context so a
+single registry instance lints any number of files.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def to_doc(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "message": self.message,
+        }
+
+
+class Rule:
+    """One lint rule. Subclasses set `id`/`name`/`description` and
+    implement `visit_<NodeType>(node, ctx)` for the AST node types
+    they care about."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    inst = cls()
+    if inst.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    # rules live in rules.py; importing it populates the registry
+    from greptimedb_tpu.tools.lint import rules as _rules  # noqa: F401
+
+    return dict(sorted(_REGISTRY.items()))
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'jax.jit' for Attribute(Name jax, jit); None if not a plain
+    dotted path."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def _static_names(call: ast.Call, params: list[str]) -> set[str]:
+    """Param names declared static via static_argnames/static_argnums."""
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for el in vals:
+                if isinstance(el, ast.Constant) and isinstance(el.value,
+                                                               str):
+                    out.add(el.value)
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for el in vals:
+                if isinstance(el, ast.Constant) and isinstance(el.value,
+                                                               int):
+                    if 0 <= el.value < len(params):
+                        out.add(params[el.value])
+    return out
+
+
+def jit_decorator_info(dec: ast.AST, params: list[str]
+                       ) -> tuple[bool, set[str]]:
+    """(is_jit, static param names) for one decorator expression.
+    Recognises @jax.jit, @jit, @functools.partial(jax.jit, ...) and
+    @jax.jit(...) call forms."""
+    d = dotted_name(dec)
+    if d in _JIT_NAMES:
+        return True, set()
+    if isinstance(dec, ast.Call):
+        f = dotted_name(dec.func)
+        if f in _JIT_NAMES:
+            return True, _static_names(dec, params)
+        if f in _PARTIAL_NAMES and dec.args:
+            g = dotted_name(dec.args[0])
+            if g in _JIT_NAMES:
+                return True, _static_names(dec, params)
+    return False, set()
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    node: ast.AST
+    name: str
+    params: set[str]
+    jitted: bool
+    static: set[str]
+    device: bool        # jitted, a Pallas kernel, or nested in one
+
+
+class FileContext:
+    """Per-file lint state, visible to rules during the walk."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.findings: list[Finding] = []
+        self.func_stack: list[FuncInfo] = []
+        self.class_stack: list[ast.ClassDef] = []
+        self.node_stack: list[ast.AST] = []
+        self.lock_depth = 0
+        self.loop_depth = 0
+        self.exc_names: list[str] = []
+        # names of functions passed to pl.pallas_call(...) anywhere in
+        # the module: their bodies run traced on device
+        self.pallas_kernels: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                f = dotted_name(node.func)
+                if f and f.endswith("pallas_call") and node.args:
+                    k = dotted_name(node.args[0])
+                    if k:
+                        self.pallas_kernels.add(k.split(".")[-1])
+
+    # -- helpers rules use ---------------------------------------------
+    @property
+    def current_func(self) -> FuncInfo | None:
+        return self.func_stack[-1] if self.func_stack else None
+
+    @property
+    def device_func(self) -> FuncInfo | None:
+        """Innermost enclosing traced/device function, if any."""
+        fi = self.current_func
+        return fi if fi is not None and fi.device else None
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def scope_text(self, *, cls: bool = False) -> str:
+        """Source text of the enclosing function (or, with cls=True,
+        the enclosing class — teardown methods like close() commonly
+        live beside the constructor that owns the resource).  Falls
+        back to the whole module."""
+        node: ast.AST | None = None
+        if cls and self.class_stack:
+            node = self.class_stack[-1]
+        elif self.func_stack:
+            node = self.func_stack[-1].node
+        if node is None or not hasattr(node, "end_lineno"):
+            return self.source
+        return "\n".join(self.lines[node.lineno - 1:node.end_lineno])
+
+    def parent(self, up: int = 1) -> ast.AST | None:
+        """Enclosing AST node `up` levels above the node currently
+        being dispatched (node_stack[-1] is that node itself)."""
+        i = len(self.node_stack) - 1 - up
+        return self.node_stack[i] if i >= 0 else None
+
+    def report(self, rule: Rule, node: ast.AST, message: str):
+        self.findings.append(Finding(
+            rule=rule.id, path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        ))
+
+
+def traced_value_use(expr: ast.AST, fi: FuncInfo) -> bool:
+    """Does `expr` consume the *value* of a traced parameter?  Uses
+    that stay static at trace time — `.shape`/`.ndim`/`.dtype`/`.size`
+    attributes, `len(x)`, `isinstance(x, ...)`, `x is None` — do not
+    count: branching on those is fine inside jit."""
+    traced = fi.params - fi.static
+
+    def scan(node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute) and node.attr in (
+                "shape", "ndim", "dtype", "size"):
+            return False            # static metadata access
+        if isinstance(node, ast.Call):
+            f = dotted_name(node.func)
+            if f in ("len", "isinstance", "type"):
+                return False        # static at trace time
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False            # identity tests (x is None)
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            return node.id in traced
+        return any(scan(c) for c in ast.iter_child_nodes(node))
+
+    return scan(expr)
+
+
+class ModuleLinter(ast.NodeVisitor):
+    """The walk: dispatches nodes to rules while tracking scope state."""
+
+    def __init__(self, ctx: FileContext, rules: dict[str, Rule]):
+        self.ctx = ctx
+        # node-type name -> [(rule, bound visit method)]
+        self.dispatch: dict[str, list] = {}
+        for rule in rules.values():
+            for attr in dir(rule):
+                if attr.startswith("visit_"):
+                    self.dispatch.setdefault(attr[6:], []).append(
+                        getattr(rule, attr)
+                    )
+
+    def run(self):
+        self.visit(self.ctx.tree)
+        return self.ctx.findings
+
+    def visit(self, node: ast.AST):
+        self.ctx.node_stack.append(node)
+        try:
+            for meth in self.dispatch.get(type(node).__name__, ()):
+                meth(node, self.ctx)
+            handler = getattr(self, f"scope_{type(node).__name__}", None)
+            if handler is not None:
+                handler(node)
+            else:
+                super().generic_visit(node)
+        finally:
+            self.ctx.node_stack.pop()
+
+    # -- scope-tracking handlers ---------------------------------------
+    def _scope_func(self, node):
+        ctx = self.ctx
+        params = [a.arg for a in (
+            node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+        )]
+        jitted, static = False, set()
+        for dec in node.decorator_list:
+            self.visit(dec)
+            is_jit, st = jit_decorator_info(dec, params)
+            if is_jit:
+                jitted, static = True, st
+        pallas = node.name in ctx.pallas_kernels
+        enclosing_device = bool(ctx.func_stack and ctx.func_stack[-1].device)
+        fi = FuncInfo(
+            node=node, name=node.name,
+            params={p for p in params if p not in ("self", "cls")},
+            jitted=jitted, static=static,
+            device=jitted or pallas or enclosing_device,
+        )
+        ctx.func_stack.append(fi)
+        # loops/locks of the enclosing scope don't wrap this body
+        saved_loop, saved_lock = ctx.loop_depth, ctx.lock_depth
+        ctx.loop_depth = ctx.lock_depth = 0
+        try:
+            for child in ast.iter_child_nodes(node):
+                if child in node.decorator_list:
+                    continue
+                self.visit(child)
+        finally:
+            ctx.loop_depth, ctx.lock_depth = saved_loop, saved_lock
+            ctx.func_stack.pop()
+
+    scope_FunctionDef = _scope_func
+    scope_AsyncFunctionDef = _scope_func
+
+    def _scope_loop(self, node):
+        self.ctx.loop_depth += 1
+        try:
+            super().generic_visit(node)
+        finally:
+            self.ctx.loop_depth -= 1
+
+    scope_For = _scope_loop
+    scope_While = _scope_loop
+
+    def scope_With(self, node):
+        ctx = self.ctx
+        holds_lock = False
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            if _looks_like_lock(item.context_expr):
+                holds_lock = True
+        if holds_lock:
+            ctx.lock_depth += 1
+        try:
+            for stmt in node.body:
+                self.visit(stmt)
+        finally:
+            if holds_lock:
+                ctx.lock_depth -= 1
+
+    def scope_ClassDef(self, node):
+        self.ctx.class_stack.append(node)
+        try:
+            super().generic_visit(node)
+        finally:
+            self.ctx.class_stack.pop()
+
+    def scope_ExceptHandler(self, node):
+        ctx = self.ctx
+        pushed = False
+        if node.name:
+            ctx.exc_names.append(node.name)
+            pushed = True
+        try:
+            super().generic_visit(node)
+        finally:
+            if pushed:
+                ctx.exc_names.pop()
+
+
+def _looks_like_lock(expr: ast.AST) -> bool:
+    """`with self._lock:` / `with lock:` / `with threading.Lock():`.
+    Condition variables are excluded — their wait() *releases* the
+    lock, so blocking under them is the intended pattern."""
+    d = dotted_name(expr)
+    if d is None and isinstance(expr, ast.Call):
+        d = dotted_name(expr.func)
+    if d is None:
+        return False
+    last = d.split(".")[-1].lower()
+    if "cond" in last:
+        return False
+    return "lock" in last or last in ("mutex",)

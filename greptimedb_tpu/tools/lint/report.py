@@ -1,0 +1,29 @@
+"""gtlint reporters: human text and machine-readable JSON."""
+
+from __future__ import annotations
+
+import json
+
+
+def render_text(result: dict) -> str:
+    out = []
+    for f in result["findings"]:
+        out.append(f"{f['path']}:{f['line']}:{f['col'] + 1}: "
+                   f"{f['rule']} {f['message']}")
+    for e in result["stale_baseline"]:
+        out.append(f"{e.get('path')}: stale baseline entry "
+                   f"{e.get('rule')} (line {e.get('line')}) no longer "
+                   "matches; remove it")
+    for p, msg in result["errors"]:
+        out.append(f"{p}: error: {msg}")
+    c = result["counts"]
+    out.append(
+        f"gtlint: {c['files']} files, {c['new']} findings "
+        f"({c['baselined']} baselined, {c['suppressed']} suppressed, "
+        f"{c['stale_baseline']} stale baseline entries)"
+    )
+    return "\n".join(out)
+
+
+def render_json(result: dict) -> str:
+    return json.dumps(result, indent=1, sort_keys=True)

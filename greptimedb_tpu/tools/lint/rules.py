@@ -1,0 +1,434 @@
+"""gtlint rules GT001-GT010.
+
+Each rule encodes a hazard class this codebase has actually been
+bitten by (see the PR log in CHANGES.md): silent exception swallows
+that hid datanode failures, substring matching on error text that the
+typed-error migration obsoleted, host/device sync inside jitted hot
+paths that shows up only as tail latency, and locks held across
+blocking Flight I/O that serialize the ingest dataplane.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from greptimedb_tpu.tools.lint.core import (
+    FileContext,
+    Rule,
+    dotted_name,
+    register,
+    traced_value_use,
+)
+
+
+def _is_swallow_body(body: list[ast.stmt]) -> bool:
+    """True when a handler body does nothing: only pass/`...`."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)):
+            continue
+        return False
+    return True
+
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _handler_catches_broad(node: ast.ExceptHandler) -> bool:
+    if node.type is None:
+        return True
+    types = (node.type.elts if isinstance(node.type, ast.Tuple)
+             else [node.type])
+    for t in types:
+        d = dotted_name(t)
+        if d is not None and d.split(".")[-1] in _BROAD:
+            return True
+    return False
+
+
+@register
+class SilentSwallow(Rule):
+    id = "GT001"
+    name = "silent-exception-swallow"
+    description = (
+        "`except Exception: pass` (or a bare except) discards the "
+        "error with no trace. Narrow the exception type, re-raise, or "
+        "log with context."
+    )
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler,
+                            ctx: FileContext):
+        if node.type is None:
+            ctx.report(self, node,
+                       "bare `except:` also catches KeyboardInterrupt/"
+                       "SystemExit; catch a concrete exception type")
+            return
+        if _handler_catches_broad(node) and _is_swallow_body(node.body):
+            ctx.report(self, node,
+                       "broad except with an empty body silently "
+                       "swallows the error; narrow the type, re-raise, "
+                       "or log with context")
+
+
+_EXC_HINT_NAMES = {"e", "ex", "exc", "err", "error", "exception"}
+
+
+def _unwrap_str_call(node: ast.AST) -> ast.AST | None:
+    """For `str(x)`, `str(x).lower()`, ... return x; else None."""
+    while isinstance(node, ast.Call) and isinstance(node.func,
+                                                    ast.Attribute):
+        node = node.func.value
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "str" and node.args):
+        return node.args[0]
+    return None
+
+
+@register
+class ErrorSubstringMatch(Rule):
+    id = "GT002"
+    name = "error-substring-match"
+    description = (
+        "Classifying an exception by substring-matching its message "
+        "(`'...' in str(e)`) breaks the moment the wording changes. "
+        "Use isinstance on a typed error, or the `[gtdb:<code>]` "
+        "marker via errors.error_from_code."
+    )
+
+    def visit_Compare(self, node: ast.Compare, ctx: FileContext):
+        if not all(isinstance(op, (ast.In, ast.NotIn))
+                   for op in node.ops):
+            return
+        for comp in node.comparators:
+            inner = _unwrap_str_call(comp)
+            if inner is None or not isinstance(inner, ast.Name):
+                continue
+            if (inner.id in ctx.exc_names
+                    or inner.id in _EXC_HINT_NAMES):
+                ctx.report(self, node,
+                           f"substring match on str({inner.id}) — "
+                           "classify via typed errors "
+                           "(errors.error_from_code / isinstance), "
+                           "not message text")
+
+
+@register
+class UntypedRaise(Rule):
+    id = "GT003"
+    name = "untyped-raise"
+    description = (
+        "Raising a plain `Exception` defeats the errors.py taxonomy: "
+        "callers cannot catch it without a broad except, and it "
+        "crosses the Flight boundary as UNKNOWN. Raise a GreptimeError "
+        "subclass."
+    )
+
+    def visit_Raise(self, node: ast.Raise, ctx: FileContext):
+        if ctx.path.replace("\\", "/").endswith("errors.py"):
+            return
+        exc = node.exc
+        if isinstance(exc, ast.Call):
+            exc = exc.func
+        d = dotted_name(exc) if exc is not None else None
+        if d in ("Exception", "BaseException"):
+            ctx.report(self, node,
+                       f"raise {d} is untyped; raise a GreptimeError "
+                       "subclass from greptimedb_tpu.errors")
+
+
+_HOST_SYNC_ATTRS = {"item", "tolist"}
+_HOST_SYNC_CALLS = {
+    "np.asarray", "np.array", "np.fromiter", "numpy.asarray",
+    "numpy.array", "onp.asarray", "onp.array", "jax.device_get",
+}
+
+
+@register
+class HostSyncInJit(Rule):
+    id = "GT004"
+    name = "host-sync-in-jit"
+    description = (
+        "Inside a @jax.jit function or Pallas kernel, `.item()`, "
+        "np.asarray(...), float(x)/int(x) on traced values force a "
+        "device->host transfer (or fail to trace), stalling the "
+        "pipeline. Keep host conversions outside the jitted region."
+    )
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        fi = ctx.device_func
+        if fi is None:
+            return
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_ATTRS):
+            ctx.report(self, node,
+                       f".{node.func.attr}() inside a jitted/device "
+                       "function forces host sync")
+            return
+        d = dotted_name(node.func)
+        if d in _HOST_SYNC_CALLS:
+            ctx.report(self, node,
+                       f"{d}(...) inside a jitted/device function "
+                       "materializes on host; use jnp instead")
+            return
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and node.args
+                and any(traced_value_use(a, fi) for a in node.args)):
+            ctx.report(self, node,
+                       f"{node.func.id}() on a traced value forces "
+                       "host sync inside jit")
+
+
+@register
+class TracedPythonBranch(Rule):
+    id = "GT005"
+    name = "traced-python-branch"
+    description = (
+        "A Python `if`/`while` on a traced value inside jit forces "
+        "concretization (TracerBoolConversionError at best, silent "
+        "host sync at worst). Use jnp.where / lax.cond / lax.select, "
+        "or mark the argument static."
+    )
+
+    def _check(self, test: ast.AST, node: ast.AST, ctx: FileContext,
+               kind: str):
+        fi = ctx.device_func
+        if fi is None:
+            return
+        while isinstance(test, ast.UnaryOp) and isinstance(test.op,
+                                                           ast.Not):
+            test = test.operand
+        if traced_value_use(test, fi):
+            ctx.report(self, node,
+                       f"Python {kind} on a traced value inside a "
+                       "jitted/device function; use jnp.where / "
+                       "lax.cond or a static arg")
+
+    def visit_If(self, node: ast.If, ctx: FileContext):
+        self._check(node.test, node, ctx, "if")
+
+    def visit_IfExp(self, node: ast.IfExp, ctx: FileContext):
+        self._check(node.test, node, ctx, "conditional expression")
+
+    def visit_While(self, node: ast.While, ctx: FileContext):
+        self._check(node.test, node, ctx, "while")
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    f = dotted_name(node.func)
+    if f in ("jax.jit", "jit", "jax.pjit", "pjit"):
+        return True
+    if f in ("functools.partial", "partial") and node.args:
+        return dotted_name(node.args[0]) in ("jax.jit", "jit")
+    return False
+
+
+@register
+class RecompileHazard(Rule):
+    id = "GT006"
+    name = "recompile-hazard"
+    description = (
+        "jax.jit(...) constructed inside a loop (or over a lambda "
+        "inside a function body) builds a fresh cache entry per "
+        "iteration/call — every invocation recompiles. Hoist the "
+        "jitted callable to module scope or cache it."
+    )
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        if not _is_jit_call(node):
+            return
+        if ctx.loop_depth > 0:
+            ctx.report(self, node,
+                       "jax.jit constructed inside a loop recompiles "
+                       "every iteration; hoist it out")
+        elif (ctx.func_stack
+              and node.args
+              and isinstance(node.args[-1], ast.Lambda)):
+            ctx.report(self, node,
+                       "jax.jit(lambda ...) inside a function creates "
+                       "a new callable (and compile cache entry) per "
+                       "call; define and jit it at module scope")
+
+
+_BLOCKING_ATTRS = {
+    "urlopen", "do_get", "do_put", "do_action", "read_all",
+    "recv", "recvfrom", "sendall", "accept", "getresponse",
+    "create_connection", "getaddrinfo", "read_chunk",
+}
+_BLOCKING_DOTTED = {"time.sleep", "urllib.request.urlopen",
+                    "socket.create_connection"}
+
+
+@register
+class LockAcrossBlockingIO(Rule):
+    id = "GT007"
+    name = "lock-across-blocking-io"
+    description = (
+        "A threading.Lock held across blocking I/O (sockets, HTTP, "
+        "Arrow Flight do_get/do_put/do_action, sleep) serializes every "
+        "other thread on that lock for the full I/O latency. Copy the "
+        "state out under the lock, do the I/O outside it."
+    )
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        if ctx.lock_depth == 0:
+            return
+        d = dotted_name(node.func)
+        label = None
+        if d in _BLOCKING_DOTTED:
+            label = d
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_ATTRS):
+            label = node.func.attr
+        if label is not None:
+            ctx.report(self, node,
+                       f"{label}(...) called while holding a lock "
+                       "blocks every other waiter for the full I/O "
+                       "latency; move the call outside the lock")
+
+
+def _assign_target_segment(ctx: FileContext) -> str | None:
+    """Last name segment of the Assign target the dispatched call
+    feeds, e.g. '_worker' for `self._worker = threading.Thread(...)`,
+    't' for `t = Thread(...)`. None when not directly assigned."""
+    parent = ctx.parent(1)
+    if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+        tgt = (parent.targets[0] if isinstance(parent, ast.Assign)
+               else parent.target)
+        d = dotted_name(tgt)
+        if d:
+            return d.split(".")[-1]
+    return None
+
+
+@register
+class UnjoinedThread(Rule):
+    id = "GT008"
+    name = "unjoined-thread"
+    description = (
+        "A non-daemon Thread that is never join()ed (or a "
+        "ThreadPoolExecutor never shutdown and not used as a context "
+        "manager) leaks and can hang interpreter exit. Pass "
+        "daemon=True, join it, or shut the pool down in close()."
+    )
+
+    def _has_kw(self, node: ast.Call, name: str, value=True) -> bool:
+        for kw in node.keywords:
+            if (kw.arg == name and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is value):
+                return True
+        return False
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        d = dotted_name(node.func)
+        if d is None:
+            return
+        last = d.split(".")[-1]
+        if last == "Thread":
+            if self._has_kw(node, "daemon"):
+                return
+            seg = _assign_target_segment(ctx)
+            scope = ctx.scope_text(cls=bool(ctx.class_stack))
+            if seg is not None and f"{seg}.join(" in scope:
+                return
+            ctx.report(self, node,
+                       "Thread without daemon=True and no matching "
+                       ".join() in scope leaks on shutdown")
+        elif last == "ThreadPoolExecutor":
+            parent = ctx.parent(1)
+            if isinstance(parent, (ast.withitem, ast.With)):
+                return          # `with ThreadPoolExecutor(...) as ..`
+            seg = _assign_target_segment(ctx)
+            scope = (ctx.scope_text(cls=True) if ctx.class_stack
+                     else ctx.source)
+            # evidence the pool is torn down: either a direct
+            # `<name>.shutdown(...)`, or the swap-to-local teardown
+            # idiom (`pool, self._x = self._x, None` then
+            # `pool.shutdown()` outside the lock) — approximated as
+            # the name and a .shutdown( call both present in scope
+            if seg is not None and (f"{seg}.shutdown(" in scope
+                                    or f"{seg}.join(" in scope
+                                    or (seg in scope
+                                        and ".shutdown(" in scope)):
+                return
+            ctx.report(self, node,
+                       "ThreadPoolExecutor with no shutdown() in "
+                       "scope and not used as a context manager "
+                       "leaks worker threads")
+
+
+_INT64_DOTTED = {"jnp.int64", "jax.numpy.int64", "jnp.uint64",
+                 "jax.numpy.uint64"}
+
+
+@register
+class Int64OnDevice(Rule):
+    id = "GT009"
+    name = "int64-on-device"
+    description = (
+        "jnp int64/uint64 silently downcasts to 32-bit unless x64 is "
+        "enabled, and is slow on TPU where it is emulated. Use int32 "
+        "(guard row counts < 2^31 on host), or gate explicitly on the "
+        "x64 flag."
+    )
+
+    def visit_Attribute(self, node: ast.Attribute, ctx: FileContext):
+        d = dotted_name(node)
+        if d in _INT64_DOTTED:
+            ctx.report(self, node,
+                       f"{d} downcasts silently without x64 and is "
+                       "emulated on TPU; prefer int32 or gate on x64")
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        d = dotted_name(node.func)
+        if not d or not (d.startswith("jnp.")
+                         or d.startswith("jax.numpy.")):
+            return
+        for kw in node.keywords:
+            if kw.arg != "dtype":
+                continue
+            kd = dotted_name(kw.value)
+            if (kd in ("np.int64", "numpy.int64", "np.uint64")
+                    or (isinstance(kw.value, ast.Constant)
+                        and kw.value.value in ("int64", "uint64"))):
+                ctx.report(self, node,
+                           f"{d}(dtype=int64) on device; prefer int32 "
+                           "or gate on x64")
+
+
+_MUTABLE_CTORS = {"list", "dict", "set"}
+
+
+@register
+class MutableDefaultArg(Rule):
+    id = "GT010"
+    name = "mutable-default-arg"
+    description = (
+        "A mutable default ([], {}, set()) is shared across every "
+        "call of a public function — state leaks between callers. "
+        "Default to None and create inside."
+    )
+
+    def _check(self, node, ctx: FileContext):
+        if node.name.startswith("_"):
+            return
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]
+        for d in defaults:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                ctx.report(self, d,
+                           f"mutable default argument in public "
+                           f"function {node.name}(); use None")
+            elif (isinstance(d, ast.Call)
+                  and isinstance(d.func, ast.Name)
+                  and d.func.id in _MUTABLE_CTORS and not d.args
+                  and not d.keywords):
+                ctx.report(self, d,
+                           f"mutable default argument in public "
+                           f"function {node.name}(); use None")
+
+    visit_FunctionDef = _check
+    visit_AsyncFunctionDef = _check

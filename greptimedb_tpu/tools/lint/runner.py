@@ -1,0 +1,218 @@
+"""gtlint runner + CLI.
+
+    python -m greptimedb_tpu.tools.lint [paths...] [--format=json]
+    greptimedb-tpu lint [paths...]
+
+Exit status: 0 clean, 1 unsuppressed/non-baselined findings (or stale
+baseline entries), 2 usage/parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+from greptimedb_tpu.tools.lint.baseline import Baseline
+from greptimedb_tpu.tools.lint.core import (
+    FileContext,
+    Finding,
+    ModuleLinter,
+    all_rules,
+)
+from greptimedb_tpu.tools.lint.report import render_json, render_text
+from greptimedb_tpu.tools.lint.suppress import Suppressions
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+# repo root (parent of the greptimedb_tpu package): finding paths are
+# anchored here, NOT to os.getcwd(), so the checked-in baseline and
+# the lint gate behave identically from any working directory
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def _norm_path(path: str) -> str:
+    ap = os.path.abspath(path)
+    try:
+        rel = os.path.relpath(ap, _REPO_ROOT)
+    except ValueError:      # Windows: different drive
+        rel = None
+    if rel is not None and not rel.startswith(".."):
+        return rel.replace("\\", "/")
+    return ap.replace("\\", "/")
+
+
+def lint_source(path: str, source: str, *, select: set[str] | None = None
+                ) -> tuple[list[Finding], list[Finding]]:
+    """Lint one file's text. Returns (active, suppressed) findings."""
+    rules = all_rules()
+    if select:
+        rules = {k: v for k, v in rules.items() if k in select}
+    tree = ast.parse(source, filename=path)
+    ctx = FileContext(path, source, tree)
+    ModuleLinter(ctx, rules).run()
+    sup = Suppressions(source)
+    active = [f for f in ctx.findings if not sup.covers(f.rule, f.line)]
+    suppressed = [f for f in ctx.findings if sup.covers(f.rule, f.line)]
+    return active, suppressed
+
+
+def iter_py_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        yield os.path.join(root, fn)
+
+
+def lint_paths(paths: list[str], *, baseline: Baseline | None = None,
+               select: set[str] | None = None) -> dict:
+    """Lint every .py under `paths`; returns the report document."""
+    findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    errors: list[tuple[str, str]] = []
+    sources: dict[str, list[str]] = {}
+    nfiles = 0
+    for p in paths:
+        if not os.path.exists(p):
+            # a typo'd/renamed path must not lint 0 files and pass
+            errors.append((p, "path does not exist"))
+    for path in iter_py_files(paths):
+        nfiles += 1
+        norm = _norm_path(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            act, sup = lint_source(norm, text, select=select)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append((norm, str(e)))
+            continue
+        sources[norm] = text.splitlines()
+        findings.extend(act)
+        suppressed.extend(sup)
+
+    def line_text(path: str, lineno: int) -> str:
+        lines = sources.get(path, [])
+        return lines[lineno - 1].strip() if 1 <= lineno <= len(lines) \
+            else ""
+
+    if baseline is not None:
+        new, old, stale = baseline.split(findings, line_text)
+    else:
+        new, old, stale = findings, [], []
+    new.sort(key=lambda f: (f.path, f.line, f.rule))
+    return {
+        "findings": [f.to_doc() for f in new],
+        "baselined": [f.to_doc() for f in old],
+        "suppressed": [f.to_doc() for f in suppressed],
+        "stale_baseline": stale,
+        "errors": errors,
+        "counts": {
+            "files": nfiles, "new": len(new), "baselined": len(old),
+            "suppressed": len(suppressed), "stale_baseline": len(stale),
+        },
+        "clean": not new and not stale and not errors,
+        # internal (stripped before reporting): for --write-baseline
+        "_line_text": line_text,
+        "_scanned_paths": list(sources),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gtlint",
+        description="AST-based correctness linter for greptimedb-tpu "
+                    "(JAX/TPU + concurrency hazards).",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint "
+                         "(default: the greptimedb_tpu package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON path (default: the checked-in "
+                         "package baseline)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline file "
+                         "and exit 0")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (e.g. "
+                         "GT001,GT007)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in all_rules().items():
+            print(f"{rid} {rule.name}: {rule.description}")
+        return 0
+
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))]
+    select = ({s.strip().upper() for s in args.select.split(",")
+               if s.strip()} if args.select else None)
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        baseline = Baseline.load(args.baseline)
+
+    result = lint_paths(paths, baseline=baseline, select=select)
+    line_text = result.pop("_line_text")
+    scanned = set(result.pop("_scanned_paths", []))
+
+    if args.write_baseline:
+        if select:
+            # a rule-filtered run would clobber other rules' entries
+            # for the scanned files
+            print("gtlint: --write-baseline cannot be combined with "
+                  "--select", file=sys.stderr)
+            return 2
+        if result["errors"]:
+            for p, msg in result["errors"]:
+                print(f"{p}: error: {msg}", file=sys.stderr)
+            print("gtlint: refusing to write a baseline from an "
+                  "errored run", file=sys.stderr)
+            return 2
+        findings = [Finding(**d) for d in result["findings"]]
+        new_base = Baseline.from_findings(findings, line_text)
+        # merge: keep existing entries for files OUTSIDE this run's
+        # scope so a subdirectory run doesn't discard the rest of the
+        # grandfathered debt
+        kept = [e for e in Baseline.load(args.baseline).entries
+                if e.get("path") not in scanned]
+        new_base.entries = kept + new_base.entries
+        new_base.save(args.baseline)
+        print(f"gtlint: wrote {len(new_base.entries)} entries to "
+              f"{args.baseline}"
+              + (f" ({len(kept)} kept from outside this run's scope)"
+                 if kept else ""))
+        return 0
+
+    out = (render_json(result) if args.format == "json"
+           else render_text(result))
+    print(out)
+    if result["errors"]:
+        return 2
+    return 0 if result["clean"] else 1
+
+
+def run(paths: list[str], *, baseline_path: str | None = None,
+        no_baseline: bool = False) -> dict:
+    """Library entry: lint `paths`, returning the report document
+    (used by tests/test_lint_clean.py and cli.py)."""
+    baseline = None
+    if not no_baseline:
+        baseline = Baseline.load(baseline_path or DEFAULT_BASELINE)
+    result = lint_paths(paths, baseline=baseline)
+    result.pop("_line_text", None)
+    result.pop("_scanned_paths", None)
+    return result

@@ -1,0 +1,53 @@
+"""gtlint suppression comments.
+
+Three forms, all carrying explicit rule ids (or `all`):
+
+    risky_call()            # gtlint: disable=GT007
+    # gtlint: disable-next-line=GT001,GT004
+    risky_call()
+    # gtlint: disable-file=GT010        (anywhere in the first 10 lines)
+
+Suppressed findings are dropped from the failure count but reported
+in the JSON output so tooling can audit them.
+"""
+
+from __future__ import annotations
+
+import re
+
+_LINE_RE = re.compile(r"#\s*gtlint:\s*disable=([A-Za-z0-9, ]+)")
+_NEXT_RE = re.compile(r"#\s*gtlint:\s*disable-next-line=([A-Za-z0-9, ]+)")
+_FILE_RE = re.compile(r"#\s*gtlint:\s*disable-file=([A-Za-z0-9, ]+)")
+
+_FILE_SCAN_LINES = 10
+
+
+def _ids(match: re.Match) -> set[str]:
+    return {p.strip().upper() for p in match.group(1).split(",")
+            if p.strip()}
+
+
+class Suppressions:
+    """Parsed suppression comments for one file's source."""
+
+    def __init__(self, source: str):
+        self.per_line: dict[int, set[str]] = {}
+        self.file_wide: set[str] = set()
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _LINE_RE.search(line)
+            if m:
+                self.per_line.setdefault(i, set()).update(_ids(m))
+            m = _NEXT_RE.search(line)
+            if m:
+                self.per_line.setdefault(i + 1, set()).update(_ids(m))
+            if i <= _FILE_SCAN_LINES:
+                m = _FILE_RE.search(line)
+                if m:
+                    self.file_wide.update(_ids(m))
+
+    def covers(self, rule: str, line: int) -> bool:
+        rule = rule.upper()
+        if rule in self.file_wide or "ALL" in self.file_wide:
+            return True
+        ids = self.per_line.get(line)
+        return bool(ids) and (rule in ids or "ALL" in ids)

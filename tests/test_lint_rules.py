@@ -1,0 +1,697 @@
+"""gtlint rule fixtures: every rule has at least one positive snippet
+(caught, with the right rule id and line) and one negative snippet
+(not flagged), plus suppression-comment and baseline round-trips and
+the CLI/JSON surface."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from greptimedb_tpu.tools.lint import (
+    Baseline,
+    all_rules,
+    lint_paths,
+    lint_source,
+)
+
+
+def run_lint(src: str, select: str | None = None):
+    act, sup = lint_source(
+        "fixture.py", textwrap.dedent(src),
+        select={select} if select else None,
+    )
+    return act, sup
+
+
+def rules_hit(src: str, select: str | None = None):
+    act, _ = run_lint(src, select)
+    return [(f.rule, f.line) for f in act]
+
+
+def test_registry_has_all_ten_rules():
+    ids = sorted(all_rules())
+    assert ids == [f"GT{n:03d}" for n in range(1, 11)]
+    for rule in all_rules().values():
+        assert rule.name and rule.description
+
+
+# ---------------------------------------------------------------------------
+# GT001 silent exception swallow
+# ---------------------------------------------------------------------------
+
+def test_gt001_positive_swallow_and_bare():
+    hits = rules_hit("""
+        try:
+            x = 1
+        except Exception:
+            pass
+    """)
+    assert ("GT001", 4) in hits
+
+    hits = rules_hit("""
+        try:
+            x = 1
+        except:
+            x = 2
+    """)
+    assert ("GT001", 4) in hits
+
+
+def test_gt001_negative_narrow_or_logged():
+    assert rules_hit("""
+        try:
+            x = 1
+        except ValueError:
+            pass
+    """) == []
+    assert rules_hit("""
+        import logging
+        try:
+            x = 1
+        except Exception as e:
+            logging.getLogger("x").warning("boom: %s", e)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# GT002 error-substring matching
+# ---------------------------------------------------------------------------
+
+def test_gt002_positive_str_e_matching():
+    hits = rules_hit("""
+        def classify(e):
+            return "unavailable" in str(e).lower()
+    """)
+    assert ("GT002", 3) in hits
+    hits = rules_hit("""
+        try:
+            x = 1
+        except Exception as boom:
+            if "not found" in str(boom):
+                raise
+    """)
+    assert ("GT002", 5) in hits
+
+
+def test_gt002_negative_plain_string_ops():
+    # substring tests on non-exception values are fine
+    assert rules_hit("""
+        def f(value):
+            return "," in str(value)
+    """) == []
+    assert rules_hit("""
+        def f(e):
+            return isinstance(e, ConnectionError)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# GT003 untyped raise
+# ---------------------------------------------------------------------------
+
+def test_gt003_positive_untyped():
+    assert ("GT003", 2) in rules_hit("""
+        raise Exception("boom")
+    """)
+    assert ("GT003", 2) in rules_hit("""
+        raise BaseException("boom")
+    """)
+
+
+def test_gt003_negative_typed():
+    assert rules_hit("""
+        from greptimedb_tpu.errors import StorageError
+        def f():
+            raise StorageError("disk gone")
+        def g():
+            raise ValueError("bad arg")
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# GT004 host sync inside jit / Pallas
+# ---------------------------------------------------------------------------
+
+def test_gt004_positive_item_float_asarray():
+    hits = rules_hit("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            a = x.item()
+            b = float(x)
+            c = np.asarray(x)
+            return a + b + c.sum()
+    """)
+    assert [h[0] for h in hits] == ["GT004", "GT004", "GT004"]
+    assert [h[1] for h in hits] == [7, 8, 9]
+
+
+def test_gt004_positive_inside_pallas_kernel():
+    hits = rules_hit("""
+        from jax.experimental import pallas as pl
+
+        def my_kernel(x_ref, o_ref):
+            o_ref[0] = float(x_ref)
+
+        def launch(x):
+            return pl.pallas_call(my_kernel, out_shape=None)(x)
+    """)
+    assert ("GT004", 5) in hits
+
+
+def test_gt004_negative_host_code_and_static():
+    # outside jit, all of these are normal host code
+    assert rules_hit("""
+        import numpy as np
+        def f(x):
+            return float(x) + np.asarray(x).sum() + x.item()
+    """) == []
+    # float() of a static (non-traced) value inside jit is fine
+    assert rules_hit("""
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def f(x, k):
+            return x * float(k)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# GT005 Python branch on traced value
+# ---------------------------------------------------------------------------
+
+def test_gt005_positive_if_while_ifexp():
+    hits = rules_hit("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                x = x - 1
+            while x < 3:
+                x = x + 1
+            return x if x > 0 else -x
+    """)
+    assert [h[0] for h in hits] == ["GT005", "GT005", "GT005"]
+    assert [h[1] for h in hits] == [6, 8, 10]
+
+
+def test_gt005_negative_static_shape_none_isinstance():
+    assert rules_hit("""
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def f(x, k, opt=None):
+            if k > 1:
+                x = x * 2
+            if x.ndim == 2:
+                x = x.sum(axis=1)
+            if opt is None:
+                x = x + 1
+            if len(x.shape) == 1:
+                x = x * 3
+            return x
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# GT006 recompile hazards
+# ---------------------------------------------------------------------------
+
+def test_gt006_positive_jit_in_loop_and_lambda():
+    hits = rules_hit("""
+        import jax
+
+        def g(h, xs):
+            for x in xs:
+                f = jax.jit(h)
+            f2 = jax.jit(lambda a: a + 1)
+            return f, f2
+    """)
+    assert [h[0] for h in hits] == ["GT006", "GT006"]
+    assert [h[1] for h in hits] == [6, 7]
+
+
+def test_gt006_negative_module_scope_jit():
+    assert rules_hit("""
+        import functools
+        import jax
+
+        def _impl(x):
+            return x + 1
+
+        fast = jax.jit(_impl)
+        faster = functools.partial(jax.jit, static_argnames=("k",))
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# GT007 lock across blocking I/O
+# ---------------------------------------------------------------------------
+
+def test_gt007_positive_urlopen_flight_sleep_under_lock():
+    hits = rules_hit("""
+        import threading
+        import time
+        import urllib.request
+
+        lock = threading.Lock()
+
+        def f(client):
+            with lock:
+                urllib.request.urlopen("http://x")
+            with client._lock:
+                client.conn.do_get(b"t")
+            with lock:
+                time.sleep(1.0)
+    """)
+    assert [h[0] for h in hits] == ["GT007", "GT007", "GT007"]
+    assert [h[1] for h in hits] == [10, 12, 14]
+
+
+def test_gt007_negative_io_outside_lock_and_condvar():
+    assert rules_hit("""
+        import threading
+        import urllib.request
+
+        lock = threading.Lock()
+        cond = threading.Condition()
+
+        def f():
+            with lock:
+                snapshot = 1
+            urllib.request.urlopen("http://x")
+            with cond:
+                cond.wait()   # releases the lock: allowed
+            return snapshot
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# GT008 thread/pool without join/shutdown
+# ---------------------------------------------------------------------------
+
+def test_gt008_positive_leaked_thread_and_pool():
+    hits = rules_hit("""
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        def bad(target):
+            threading.Thread(target=target).start()
+            pool = ThreadPoolExecutor(4)
+            return pool
+    """)
+    assert [h[0] for h in hits] == ["GT008", "GT008"]
+    assert [h[1] for h in hits] == [6, 7]
+
+
+def test_gt008_negative_daemon_join_with_shutdown():
+    assert rules_hit("""
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        def ok(target):
+            threading.Thread(target=target, daemon=True).start()
+            t = threading.Thread(target=target)
+            t.start()
+            t.join()
+            with ThreadPoolExecutor(4) as p:
+                p.submit(target)
+            q = ThreadPoolExecutor(2)
+            q.shutdown(wait=False)
+    """) == []
+
+
+def test_gt008_negative_swap_teardown_idiom():
+    # the codebase's shutdown-outside-the-lock idiom must not flag
+    assert rules_hit("""
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Server:
+            def _pool(self):
+                with self._lock:
+                    if self._scan_pool is None:
+                        self._scan_pool = ThreadPoolExecutor(4)
+                    return self._scan_pool
+
+            def close(self):
+                with self._lock:
+                    pool, self._scan_pool = self._scan_pool, None
+                if pool is not None:
+                    pool.shutdown(wait=False)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# GT009 int64 on device
+# ---------------------------------------------------------------------------
+
+def test_gt009_positive_jnp_int64():
+    hits = rules_hit("""
+        import jax.numpy as jnp
+        import numpy as np
+
+        def f(x):
+            a = jnp.asarray(x, jnp.int64)
+            b = jnp.zeros(3, dtype=np.int64)
+            c = jnp.zeros(3, dtype="int64")
+            return a, b, c
+    """)
+    assert [h[0] for h in hits] == ["GT009", "GT009", "GT009"]
+    assert [h[1] for h in hits] == [6, 7, 8]
+
+
+def test_gt009_negative_host_numpy_and_int32():
+    assert rules_hit("""
+        import jax.numpy as jnp
+        import numpy as np
+
+        def f(x):
+            host = np.asarray(x, np.int64)      # host numpy: fine
+            dev = jnp.asarray(x, jnp.int32)
+            return host, dev
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# GT010 mutable default args
+# ---------------------------------------------------------------------------
+
+def test_gt010_positive_public_mutable_defaults():
+    hits = rules_hit("""
+        def public(a, xs=[], m={}, s=set()):
+            return a
+    """)
+    assert [h[0] for h in hits] == ["GT010", "GT010", "GT010"]
+
+
+def test_gt010_negative_private_none_tuple():
+    assert rules_hit("""
+        def _private(xs=[]):
+            return xs
+
+        def public(a, xs=None, t=(), name="x"):
+            return a
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_same_line():
+    src = """
+        try:
+            x = 1
+        except Exception:  # gtlint: disable=GT001
+            pass
+    """
+    act, sup = run_lint(src)
+    assert act == []
+    assert [(f.rule, f.line) for f in sup] == [("GT001", 4)]
+
+
+def test_suppression_next_line_and_multi_id():
+    act, sup = run_lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            # gtlint: disable-next-line=GT004,GT005
+            if x > 0:
+                return x
+            return float(x)   # gtlint: disable=GT004
+    """)
+    assert act == []
+    assert sorted(f.rule for f in sup) == ["GT004", "GT005"]
+
+
+def test_suppression_wrong_id_does_not_cover():
+    act, _ = run_lint("""
+        try:
+            x = 1
+        except Exception:
+            pass  # gtlint: disable=GT999
+    """)
+    assert [(f.rule, f.line) for f in act] == [("GT001", 4)]
+
+
+def test_suppression_file_wide():
+    act, sup = run_lint("""
+        # gtlint: disable-file=GT010
+        def public(xs=[]):
+            return xs
+
+        def other(m={}):
+            return m
+    """)
+    assert act == []
+    assert len(sup) == 2
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+BASELINE_SRC = '''
+try:
+    x = 1
+except Exception:
+    pass
+
+def classify(e):
+    return "boom" in str(e)
+'''
+
+
+def test_baseline_round_trip(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(BASELINE_SRC)
+
+    # 1) no baseline: both findings are new
+    res = lint_paths([str(pkg)], baseline=None)
+    res.pop("_line_text", None)
+    assert res["counts"]["new"] == 2
+    assert not res["clean"]
+
+    # 2) write those findings as the baseline; re-run: clean
+    proc = subprocess.run(
+        [sys.executable, "-m", "greptimedb_tpu.tools.lint", str(pkg),
+         "--baseline", str(tmp_path / "base.json"), "--write-baseline"],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stderr
+    base = Baseline.load(str(tmp_path / "base.json"))
+    assert len(base.entries) == 2
+
+    res = lint_paths([str(pkg)], baseline=base)
+    res.pop("_line_text", None)
+    assert res["counts"]["new"] == 0
+    assert res["counts"]["baselined"] == 2
+    assert res["clean"]
+
+    # 3) fix one violation: its baseline entry goes stale (reported,
+    # and the gate fails until the entry is removed)
+    (pkg / "mod.py").write_text(BASELINE_SRC.replace(
+        'return "boom" in str(e)', "return isinstance(e, OSError)"
+    ))
+    res = lint_paths([str(pkg)], baseline=base)
+    res.pop("_line_text", None)
+    assert res["counts"]["new"] == 0
+    assert res["counts"]["baselined"] == 1
+    assert res["counts"]["stale_baseline"] == 1
+    assert not res["clean"]
+
+    # 4) a NEW violation is never hidden by the baseline
+    (pkg / "mod.py").write_text(
+        BASELINE_SRC + "\n\ndef pub(xs=[]):\n    return xs\n"
+    )
+    res = lint_paths([str(pkg)], baseline=base)
+    res.pop("_line_text", None)
+    assert res["counts"]["new"] == 1
+    assert res["findings"][0]["rule"] == "GT010"
+
+
+def test_baseline_line_drift_tolerated(tmp_path):
+    """Edits above a grandfathered site must not invalidate its
+    baseline entry: matching is by text, not line number."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(BASELINE_SRC)
+    res = lint_paths([str(pkg)], baseline=None)
+    line_text = res.pop("_line_text")
+    from greptimedb_tpu.tools.lint import Finding
+
+    base = Baseline.from_findings(
+        [Finding(**d) for d in res["findings"]], line_text
+    )
+    (pkg / "mod.py").write_text("import os\nimport sys\n" + BASELINE_SRC)
+    res = lint_paths([str(pkg)], baseline=base)
+    res.pop("_line_text", None)
+    assert res["counts"]["new"] == 0
+    assert res["counts"]["stale_baseline"] == 0
+    assert res["clean"]
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def _run_cli(args, cwd="/root/repo"):
+    return subprocess.run(
+        [sys.executable, "-m", "greptimedb_tpu.tools.lint", *args],
+        capture_output=True, text=True, cwd=cwd,
+    )
+
+
+def test_cli_json_format_and_exit_code(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def pub(xs=[]):\n    return xs\n")
+    proc = _run_cli([str(bad), "--format=json", "--no-baseline"])
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["counts"]["new"] == 1
+    assert doc["findings"][0]["rule"] == "GT010"
+    assert doc["findings"][0]["line"] == 1
+    assert not doc["clean"]
+
+    good = tmp_path / "good.py"
+    good.write_text("def pub(xs=None):\n    return xs\n")
+    proc = _run_cli([str(good), "--format=json", "--no-baseline"])
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["clean"]
+
+
+def test_cli_select_and_list_rules(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def pub(xs=[]):\n"
+        "    try:\n"
+        "        return xs\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    proc = _run_cli([str(bad), "--select=GT001", "--format=json",
+                     "--no-baseline"])
+    doc = json.loads(proc.stdout)
+    assert [f["rule"] for f in doc["findings"]] == ["GT001"]
+
+    proc = _run_cli(["--list-rules"])
+    assert proc.returncode == 0
+    for rid in ("GT001", "GT005", "GT010"):
+        assert rid in proc.stdout
+
+
+def test_cli_syntax_error_exit_2(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def (\n")
+    proc = _run_cli([str(bad), "--no-baseline"])
+    assert proc.returncode == 2
+    assert "error" in proc.stdout
+
+
+def test_cli_nonexistent_path_exit_2(tmp_path):
+    """A typo'd path must not lint 0 files and report clean."""
+    proc = _run_cli([str(tmp_path / "no_such_dir"), "--no-baseline"])
+    assert proc.returncode == 2
+    assert "does not exist" in proc.stdout
+
+
+def test_write_baseline_merges_out_of_scope_and_refuses_select(tmp_path):
+    """A subdirectory --write-baseline keeps grandfathered entries for
+    files outside the run's scope; --select is refused outright."""
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    a.mkdir()
+    b.mkdir()
+    (a / "mod.py").write_text("def pub(xs=[]):\n    return xs\n")
+    (b / "mod.py").write_text(
+        "try:\n    x = 1\nexcept Exception:\n    pass\n"
+    )
+    base = tmp_path / "base.json"
+    proc = _run_cli([str(a), str(b), "--baseline", str(base),
+                     "--write-baseline"])
+    assert proc.returncode == 0, proc.stderr
+    assert len(Baseline.load(str(base)).entries) == 2
+
+    # re-write scoped to only a/: b/'s entry must survive the merge
+    proc = _run_cli([str(a), "--baseline", str(base),
+                     "--write-baseline"])
+    assert proc.returncode == 0, proc.stderr
+    entries = Baseline.load(str(base)).entries
+    assert sorted(e["rule"] for e in entries) == ["GT001", "GT010"]
+
+    proc = _run_cli([str(a), "--baseline", str(base),
+                     "--write-baseline", "--select=GT010"])
+    assert proc.returncode == 2
+    assert "--select" in proc.stderr
+
+
+def test_greptimedb_tpu_cli_lint_subcommand(tmp_path):
+    """`greptimedb-tpu lint` (cli.py) mirrors the module CLI."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("def pub(xs=[]):\n    return xs\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "greptimedb_tpu.cli", "lint", str(bad),
+         "--format=json", "--no-baseline"],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["findings"][0]["rule"] == "GT010"
+
+
+# ---------------------------------------------------------------------------
+# planted multi-violation fixture: ids, files, and lines all correct
+# ---------------------------------------------------------------------------
+
+def test_planted_violations_report_correct_rule_file_line(tmp_path):
+    pkg = tmp_path / "planted"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "try:\n"
+        "    x = 1\n"
+        "except Exception:\n"
+        "    pass\n"
+    )
+    (pkg / "b.py").write_text(
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return float(x)\n"
+        "    return x\n"
+    )
+    res = lint_paths([str(pkg)], baseline=None)
+    res.pop("_line_text", None)
+    got = {(f["rule"], f["path"].rsplit("/", 1)[-1], f["line"])
+           for f in res["findings"]}
+    assert got == {
+        ("GT001", "a.py", 3),
+        ("GT005", "b.py", 5),
+        ("GT004", "b.py", 6),
+    }
+
+
+def test_lint_source_on_every_rule_doc():
+    """Rule descriptions render in --list-rules; ids are stable."""
+    rules = all_rules()
+    assert rules["GT001"].name == "silent-exception-swallow"
+    assert rules["GT007"].name == "lock-across-blocking-io"
+    assert rules["GT009"].name == "int64-on-device"
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))
