@@ -54,6 +54,92 @@ FIELD_NAMES = [
 RUNS = 20  # headline samples; the tunnel floor drifts, more pairs help
 
 
+def _assert_sanitizer_off():
+    """Benchmarks must never run instrumented: gtsan wrappers add
+    per-lock-op cost that would pollute every number."""
+    import os
+
+    if (os.environ.get("GTPU_SAN") or "").strip().lower() in (
+            "1", "true", "on", "yes"):
+        sys.exit("bench.py: refusing to run with GTPU_SAN set — "
+                 "unset it (sanitizer overhead corrupts the metrics; "
+                 "see san_overhead_pct for the measured cost)")
+    from greptimedb_tpu import concurrency
+
+    assert not concurrency.sanitizer_enabled(), (
+        "bench.py: the gtsan sanitizer is enabled in-process; "
+        "benchmarks must run with raw stdlib primitives"
+    )
+
+
+# micro-suite exercising exactly the surface gtsan instruments (lock/
+# rlock/condvar ops, thread and pool lifecycles). Run in a CHILD with
+# and without GTPU_SAN=1, the ratio is `san_overhead_pct` — a
+# regression here means every sanitized tier-1 run got slower.
+_SAN_PROBE = r"""
+import time
+from greptimedb_tpu import concurrency as C
+
+t0 = time.perf_counter()
+lock = C.Lock(name="bench")
+rlock = C.RLock(name="bench-r")
+cv = C.Condition(name="bench-cv")
+for _ in range(60000):
+    with lock:
+        pass
+    with rlock:
+        with rlock:
+            pass
+for _ in range(2000):
+    with cv:
+        cv.wait(0)
+for _ in range(50):
+    t = C.Thread(target=lambda: None)
+    t.start(); t.join()
+    with C.ThreadPoolExecutor(max_workers=2) as pool:
+        pool.submit(lambda: None).result()
+print(time.perf_counter() - t0)
+"""
+
+
+def _san_overhead_line() -> str | None:
+    """Wall-time of the concurrency micro-suite with vs without
+    GTPU_SAN=1 (best of 3 each, child processes so the env gate is the
+    real one users hit)."""
+    import os
+    import subprocess
+
+    def best(env_extra: dict) -> float:
+        runs = []
+        env = {k: v for k, v in os.environ.items() if k != "GTPU_SAN"}
+        env.update(env_extra)
+        for _ in range(3):
+            p = subprocess.run(
+                [sys.executable, "-c", _SAN_PROBE],
+                stdout=subprocess.PIPE, text=True, timeout=300,
+                env=env,
+            )
+            if p.returncode != 0:
+                raise RuntimeError(f"probe exited {p.returncode}")
+            runs.append(float(p.stdout.strip().splitlines()[-1]))
+        return min(runs)
+
+    try:
+        off_s = best({})
+        on_s = best({"GTPU_SAN": "1"})
+    except Exception as e:  # noqa: BLE001 - additive metric only
+        print(f"# san overhead probe failed: {e}", file=sys.stderr)
+        return None
+    pct = (on_s / max(off_s, 1e-9) - 1.0) * 100.0
+    return json.dumps({
+        "metric": "san_overhead_pct",
+        "value": round(pct, 1),
+        "unit": "%",
+        "off_s": round(off_s, 4),
+        "on_s": round(on_s, 4),
+    })
+
+
 def main():
     """Orchestrator: phase 1 (ingest + all query metrics) runs in a child
     process, then the cold-start probe runs in a SECOND child against the
@@ -61,6 +147,8 @@ def main():
     grid snapshot, persistent XLA compilation cache). Output lines are
     re-emitted with the headline metric last (the driver parses it)."""
     import subprocess
+
+    _assert_sanitizer_off()
 
     tmp = tempfile.mkdtemp(prefix="gtpu_bench_")
     try:
@@ -125,6 +213,9 @@ def main():
             })
         except Exception as e:  # cold start is additive: never mask phase 1
             print(f"# cold-start probe failed: {e}", file=sys.stderr)
+        san_line = _san_overhead_line()
+        if san_line:
+            lines.append(san_line)
         _emit_ordered(lines, cold_line)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -248,6 +339,7 @@ def cold_start_probe(data_dir: str):
 def phase1(tmp: str):
     from greptimedb_tpu.instance import Standalone
 
+    _assert_sanitizer_off()
     try:
         inst = Standalone(tmp, prefer_device=True)
         cols = ", ".join(f"{f} double" for f in FIELD_NAMES)

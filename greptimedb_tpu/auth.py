@@ -11,10 +11,10 @@ import base64
 import hashlib
 import hmac
 import os
-import threading
 
 from greptimedb_tpu.errors import GreptimeError
 
+from greptimedb_tpu import concurrency
 
 class AccessDeniedError(GreptimeError):
     pass
@@ -79,7 +79,7 @@ class WatchFileUserProvider(UserProvider):
         self.path = path
         self._mtime = 0.0
         self._inner = StaticUserProvider({})
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._maybe_reload()
 
     def _maybe_reload(self):

@@ -27,6 +27,8 @@ import time
 
 from greptimedb_tpu.config import load_options
 
+from greptimedb_tpu import concurrency
+
 ROLES = ("standalone", "frontend", "datanode", "metasrv", "flownode")
 
 
@@ -71,7 +73,25 @@ def build_parser() -> argparse.ArgumentParser:
     lint.add_argument("--no-baseline", action="store_true")
     lint.add_argument("--write-baseline", action="store_true")
     lint.add_argument("--select", default=None)
+    lint.add_argument("--changed", default=None, metavar="REF",
+                      help="lint only files differing from this git "
+                           "ref (fast pre-commit runs)")
     lint.add_argument("--list-rules", action="store_true")
+
+    san = sub.add_parser(
+        "san", help="run a command under the gtsan concurrency "
+                    "sanitizer (GTPU_SAN=1) and report lock-order "
+                    "cycles, blocking-under-lock, and thread/pool "
+                    "leaks; exits non-zero on findings",
+    )
+    san.add_argument("cmd", nargs=argparse.REMAINDER,
+                     help="command to run (prefix with --)")
+    san.add_argument("--format", choices=("text", "json"),
+                     default="text")
+    san.add_argument("--baseline", default=None)
+    san.add_argument("--no-baseline", action="store_true")
+    san.add_argument("--hold-time-ms", type=float, default=None)
+    san.add_argument("--report", default=None)
 
     cli = sub.add_parser("cli")
     # the real default lives on the parent; subcommand flags use SUPPRESS
@@ -107,7 +127,27 @@ def main(argv=None):
                 fwd.append("--" + flag.replace("_", "-"))
         if args.select:
             fwd += ["--select", args.select]
+        if args.changed:
+            fwd += ["--changed", args.changed]
         return lint_main(fwd)
+    if args.role == "san":
+        from greptimedb_tpu.tools.san.runner import main as san_main
+
+        fwd = []
+        if args.format != "text":
+            fwd += ["--format", args.format]
+        if args.baseline:
+            fwd += ["--baseline", args.baseline]
+        if args.no_baseline:
+            fwd.append("--no-baseline")
+        if args.hold_time_ms is not None:
+            fwd += ["--hold-time-ms", str(args.hold_time_ms)]
+        if args.report:
+            fwd += ["--report", args.report]
+        cmd = list(args.cmd)
+        if cmd and cmd[0] == "--":
+            cmd = cmd[1:]
+        return san_main(fwd + ["--"] + cmd if cmd else fwd)
     if args.role == "cli":
         cmd = getattr(args, "cli_cmd", None)
         if cmd == "export":
@@ -150,6 +190,17 @@ def main(argv=None):
             "flow.enable": False if args.no_flows else None,
         },
     )
+    san_sec = opts.section("sanitizer")
+    if san_sec.get("enable"):
+        # [sanitizer] TOML: enable BEFORE any server builds its locks
+        # so every primitive in this process is instrumented, and
+        # render the findings to stderr at exit — an instrumented run
+        # must never be a silent no-op
+        from greptimedb_tpu.tools import san as _san
+        from greptimedb_tpu.tools.san.report import attach_exit_report
+
+        attach_exit_report(
+            _san.enable(_san.SanConfig.from_options(san_sec)))
     return {
         "standalone": _start_standalone,
         "frontend": _start_frontend,
@@ -386,7 +437,7 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst,
 
     _hb_log = logging.getLogger("greptimedb_tpu.heartbeat")
 
-    stop = threading.Event()
+    stop = concurrency.Event()
     client = MetaClient(meta_addr)
 
     def loop():
@@ -443,7 +494,7 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst,
             if stop.wait(2.0):
                 return
 
-    t = threading.Thread(target=loop, daemon=True, name="dn-heartbeat")
+    t = concurrency.Thread(target=loop, daemon=True, name="dn-heartbeat")
     t.start()
     return stop.set
 

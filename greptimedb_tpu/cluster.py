@@ -19,7 +19,7 @@ real clients). Here:
 from __future__ import annotations
 
 import os
-import threading
+
 import time
 
 from greptimedb_tpu.catalog.manager import (
@@ -39,6 +39,8 @@ from greptimedb_tpu.meta.metasrv import Metasrv, RegionMigrationProcedure
 from greptimedb_tpu.storage.engine import EngineConfig, TsdbEngine
 from greptimedb_tpu.storage.object_store import FsObjectStore
 from greptimedb_tpu.storage.region import Region, RegionMetadata
+
+from greptimedb_tpu import concurrency
 
 TABLE_PREFIX = "__table/"
 
@@ -117,7 +119,7 @@ class Cluster:
         self.datanodes: dict[int, Datanode] = {}
         self._tables: dict[tuple[str, str], Table] = {}
         self._next_table_id = 2048
-        self._lock = threading.RLock()
+        self._lock = concurrency.RLock()
         for i in range(n_datanodes):
             self.add_datanode(i)
         self._restore_tables()

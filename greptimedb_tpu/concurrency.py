@@ -1,0 +1,116 @@
+"""Concurrency facade: every lock, condvar, event, thread, and pool
+the system creates comes from here.
+
+Off path (the default): each factory returns the *raw stdlib object* —
+`Lock()` is `threading.Lock()`, `ThreadPoolExecutor(...)` is
+`concurrent.futures.ThreadPoolExecutor(...)`.  No wrapper classes, no
+extra frames, no per-operation cost; the only overhead is one flag
+check at construction time.
+
+On path: with the gtsan sanitizer enabled (`GTPU_SAN=1`, the
+`[sanitizer]` TOML section, `greptimedb-tpu san -- <cmd>`, or
+`tools.san.enable()` in tests), the factories return instrumented
+wrappers that feed the lock-order graph, blocking-under-lock and
+hold-time checks, and the thread/pool lifecycle registry.  See
+`greptimedb_tpu/tools/san/`.
+
+Extra (sanitizer-only) keyword arguments accepted by every factory and
+silently dropped on the off path:
+
+- `name=` on Lock/RLock/Condition: a human label for reports (default:
+  the construction site `path:line`).
+- `shared=True` on ThreadPoolExecutor: marks an intentionally
+  process-wide pool (module-level singleton) exempt from the
+  un-shutdown-pool leak check.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor as _RawExecutor
+
+__all__ = ["Condition", "Event", "Lock", "RLock", "Thread",
+           "ThreadPoolExecutor", "sanitizer_enabled"]
+
+_enabled = False
+_env_checked = False
+# serializes the one-time lazy env check: without it, two threads
+# making their first factory call under GTPU_SAN=1 could race one into
+# handing out a raw, never-instrumented primitive
+_init_mu = threading.Lock()
+
+
+def _set_enabled(value: bool):
+    """Called by tools.san.enable/disable; not public API."""
+    global _enabled, _env_checked
+    _enabled = value
+    _env_checked = True
+
+
+def sanitizer_enabled() -> bool:
+    """True when factories currently hand out instrumented objects."""
+    global _env_checked
+    if not _env_checked:
+        with _init_mu:
+            if not _env_checked:
+                # one-time lazy GTPU_SAN=1 auto-enable (sets _enabled
+                # via _set_enabled); keeps plain imports free of san
+                # machinery
+                if (os.environ.get("GTPU_SAN") or "").strip().lower() \
+                        in ("1", "true", "on", "yes"):
+                    from greptimedb_tpu.tools import san
+
+                    san.ensure_enabled_from_env()
+                _env_checked = True
+    return _enabled
+
+
+def Lock(*, name: str | None = None):
+    if not sanitizer_enabled():
+        return threading.Lock()
+    from greptimedb_tpu.tools.san.wrappers import SanLock
+
+    return SanLock(name)
+
+
+def RLock(*, name: str | None = None):
+    if not sanitizer_enabled():
+        return threading.RLock()
+    from greptimedb_tpu.tools.san.wrappers import SanRLock
+
+    return SanRLock(name)
+
+
+def Condition(lock=None, *, name: str | None = None):
+    if not sanitizer_enabled():
+        return threading.Condition(lock)
+    from greptimedb_tpu.tools.san.wrappers import SanCondition
+
+    return SanCondition(lock, name=name)
+
+
+def Event():
+    if not sanitizer_enabled():
+        return threading.Event()
+    from greptimedb_tpu.tools.san.wrappers import SanEvent
+
+    return SanEvent()
+
+
+def Thread(*args, **kwargs):
+    if not sanitizer_enabled():
+        # factory passthrough: lifecycle hygiene is checked at the CALL
+        # site (GT008) and at runtime by gtsan (GTS104), not here
+        return threading.Thread(*args, **kwargs)  # gtlint: disable=GT008
+    from greptimedb_tpu.tools.san.wrappers import SanThread
+
+    return SanThread(*args, **kwargs)
+
+
+def ThreadPoolExecutor(*args, shared: bool = False, **kwargs):
+    if not sanitizer_enabled():
+        return _RawExecutor(*args, **kwargs)
+    from greptimedb_tpu.tools.san.wrappers import SanThreadPoolExecutor
+
+    return SanThreadPoolExecutor(*args, shared=shared, **kwargs)

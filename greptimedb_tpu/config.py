@@ -77,6 +77,15 @@ DEFAULTS: dict = {
     },
     "metasrv": {"addr": "127.0.0.1:4010", "selector": "round_robin"},
     "datanode": {"node_id": 0, "metasrv_addr": ""},
+    # gtsan cooperative concurrency sanitizer (tools/san): off by
+    # default — the concurrency facade hands out raw stdlib objects
+    # and adds no per-operation cost. enable=true (or GTPU_SAN=1)
+    # switches to instrumented locks/threads/pools
+    "sanitizer": {
+        "enable": False,
+        "hold_time_ms": 1000.0,   # GTS103 lock hold-time threshold
+        "fail_on_cycle": True,    # findings fail the run (vs report)
+    },
     "logging": {
         "level": "info",
         # statements slower than threshold land in the slow-query log +

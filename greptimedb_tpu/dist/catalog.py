@@ -54,8 +54,19 @@ class DistCatalogManager(CatalogManager):
 
     def __init__(self, engine, meta: MetaClient, *,
                  ingest_options: dict | None = None):
+        from greptimedb_tpu import concurrency
+
         self.meta = meta
         self._clients: dict[int, DatanodeClient] = {}
+        self._clients_lock = concurrency.Lock()
+        # serializes DDL against DDL only (alter fan-out, view and
+        # database mutate-then-persist ordering); the read path takes
+        # self._lock and never waits on this one
+        self._ddl_lock = concurrency.Lock()
+        # bumped (under self._lock) by every local catalog mutation
+        # (create/rename/drop, tables and views) so refresh() can tell
+        # its kv snapshot went stale mid-build and abandon the swap
+        self._local_gen = 0
         self._last_miss_refresh = 0.0
         # pipelined ingest dataplane shared by every RemoteTable this
         # catalog builds (ingest/): [ingest] pipeline=false falls back
@@ -90,26 +101,36 @@ class DistCatalogManager(CatalogManager):
 
     # ------------------------------------------------------------------
     def _client_for(self, node_id: int) -> DatanodeClient:
-        cli = self._clients.get(node_id)
+        with self._clients_lock:
+            cli = self._clients.get(node_id)
         if cli is None:
+            # peers() is a metasrv HTTP round-trip: resolve it before
+            # taking the registry lock (DatanodeClient dials lazily)
             addr = self.meta.peers().get(node_id)
             if addr is None:
                 raise InvalidArgumentError(
                     f"datanode {node_id} has no registered address"
                 )
-            cli = DatanodeClient(addr)
-            self._clients[node_id] = cli
+            with self._clients_lock:
+                cli = self._clients.setdefault(node_id,
+                                               DatanodeClient(addr))
         return cli
 
     # ------------------------------------------------------------------
     # persistence: one kv key per database / table / view
     # ------------------------------------------------------------------
     def _load(self):
+        self._load_into(self._databases, self._views)
+
+    def _load_into(self, databases: dict, views: dict):
+        """Read the shared kv catalog into the GIVEN dicts (kv HTTP +
+        region-open Flight, so callers keep self._lock released and
+        swap the result in afterwards)."""
         for key, _ in self.meta.kv_range(DB_PREFIX):
-            self._databases.setdefault(key[len(DB_PREFIX):], {})
+            databases.setdefault(key[len(DB_PREFIX):], {})
         for key, raw in self.meta.kv_range(VIEW_PREFIX):
             db, _, name = key[len(VIEW_PREFIX):].partition("/")
-            self._views.setdefault(db, {})[name] = raw
+            views.setdefault(db, {})[name] = raw
         infos = []
         for _key, raw in self.meta.kv_range(TABLE_PREFIX):
             info = TableInfo.from_json(json.loads(raw))
@@ -122,7 +143,7 @@ class DistCatalogManager(CatalogManager):
         # physical (mito) first so logical metric tables resolve their
         # shared physical table without creating a duplicate
         for info in sorted(infos, key=lambda i: i.engine == "metric"):
-            db = self._databases.setdefault(info.database, {})
+            db = databases.setdefault(info.database, {})
             try:
                 db[info.name] = self._open_table(info)
             except Exception as e:  # noqa: BLE001 - startup isolation
@@ -156,56 +177,82 @@ class DistCatalogManager(CatalogManager):
     # databases + views (per-key persistence)
     # ------------------------------------------------------------------
     def create_database(self, name: str, *, if_not_exists: bool = False):
-        with self._lock:
-            if name in self._databases:
-                if if_not_exists:
-                    return
-                raise InvalidArgumentError(
-                    f"database already exists: {name}"
-                )
-            self._databases[name] = {}
+        # _ddl_lock keeps the dict mutation and the kv write ORDERED
+        # against other view/database DDL (no CAS backs these keys);
+        # the read path uses self._lock and never waits here
+        with self._ddl_lock:  # gtlint: disable=GTS102
+            with self._lock:
+                if name in self._databases:
+                    if if_not_exists:
+                        return
+                    raise InvalidArgumentError(
+                        f"database already exists: {name}"
+                    )
+                self._databases[name] = {}
+                self._local_gen += 1
+            # kv round-trip outside self._lock: table lookups on the
+            # query path must not stall behind metasrv HTTP
             self.meta.kv_put(DB_PREFIX + name, "1")
 
     def drop_database(self, name: str, *, if_exists: bool = False):
-        with self._lock:
-            if name not in self._databases:
-                if if_exists:
-                    return
-                raise DatabaseNotFoundError(f"database not found: {name}")
-            if name == DEFAULT_SCHEMA:
-                raise InvalidArgumentError(
-                    "cannot drop the public database"
-                )
-            for tname in list(self._databases[name]):
-                self.drop_table(name, tname)
-            del self._databases[name]
-            for vname in list(self._views.pop(name, {})):
+        # _ddl_lock: see create_database — kv writes for databases and
+        # views carry no CAS, so DDL-vs-DDL ordering comes from here
+        with self._ddl_lock:  # gtlint: disable=GTS102
+            with self._lock:
+                if name not in self._databases:
+                    if if_exists:
+                        return
+                    raise DatabaseNotFoundError(
+                        f"database not found: {name}")
+                if name == DEFAULT_SCHEMA:
+                    raise InvalidArgumentError(
+                        "cannot drop the public database"
+                    )
+                # pop FIRST, teardown after: once the dict entry is
+                # gone a concurrent CREATE TABLE in this database
+                # fails its DatabaseNotFound check (and rolls back its
+                # kv claim) instead of racing a table into a
+                # half-dropped database
+                dropped = self._databases.pop(name)
+                vnames = list(self._views.pop(name, {}))
+                self._local_gen += 1
+            for tname, table in dropped.items():
+                self._teardown_table(name, tname, table)
+            for vname in vnames:
                 self.meta.kv_delete(f"{VIEW_PREFIX}{name}/{vname}")
             self.meta.kv_delete(DB_PREFIX + name)
 
     def create_view(self, database: str, name: str, sql_text: str,
                     *, or_replace: bool = False):
-        with self._lock:
-            self._db(database)
-            if name in self._databases.get(database, {}):
-                raise InvalidArgumentError(
-                    f"a table named {name!r} already exists"
-                )
-            views = self._views.setdefault(database, {})
-            if name in views and not or_replace:
-                raise InvalidArgumentError(f"view already exists: {name}")
-            views[name] = sql_text
-            self.meta.kv_put(f"{VIEW_PREFIX}{database}/{name}", sql_text)
+        # _ddl_lock: mutate-then-persist ordering (see create_database)
+        with self._ddl_lock:  # gtlint: disable=GTS102
+            with self._lock:
+                self._db(database)
+                if name in self._databases.get(database, {}):
+                    raise InvalidArgumentError(
+                        f"a table named {name!r} already exists"
+                    )
+                views = self._views.setdefault(database, {})
+                if name in views and not or_replace:
+                    raise InvalidArgumentError(
+                        f"view already exists: {name}")
+                views[name] = sql_text
+                self._local_gen += 1
+            self.meta.kv_put(f"{VIEW_PREFIX}{database}/{name}",
+                             sql_text)
 
     def drop_view(self, database: str, name: str, *,
                   if_exists: bool = False):
-        with self._lock:
-            views = self._views.get(database, {})
-            if name not in views:
-                if if_exists:
-                    return
-                raise TableNotFoundError(f"view not found: {name}")
-            del views[name]
+        # _ddl_lock: mutate-then-persist ordering (see create_database)
+        with self._ddl_lock:  # gtlint: disable=GTS102
+            with self._lock:
+                views = self._views.get(database, {})
+                if name not in views:
+                    if if_exists:
+                        return
+                    raise TableNotFoundError(f"view not found: {name}")
+                del views[name]
+                self._local_gen += 1
             self.meta.kv_delete(f"{VIEW_PREFIX}{database}/{name}")
 
     # ------------------------------------------------------------------
@@ -231,49 +278,77 @@ class DistCatalogManager(CatalogManager):
                     f"table already exists: {name}"
                 )
             schema.time_index  # raises unless a TIME INDEX exists
-            info = TableInfo(
-                table_id=self._alloc_table_id(),
-                name=name, database=database, schema=schema,
-                engine=engine, options=options or {},
-                num_regions=max(1, num_regions), partition=partition,
-                created_ms=int(time.time() * 1000),
-            )
-            # guard the kv key with CAS(expect-absent): two frontends
-            # racing on the same name must not both win (the local dict
-            # check only sees THIS process's view) — ADVICE r4
-            key = f"{TABLE_PREFIX}{database}/{name}"
-            if not self.meta.kv_cas(key, None, json.dumps(info.to_json())):
-                if if_not_exists:
-                    # the racing winner's table: open from its kv doc
-                    raw = self.meta.kv_get(key)
-                    won = TableInfo.from_json(json.loads(raw))
-                    db[name] = self._open_table(won)
-                    return db[name]
+        # all wire I/O below runs OUTSIDE self._lock: table lookups on
+        # the query path must not stall behind DDL kv/Flight latency
+        # (found by gtsan GTS102). In-process same-name races are
+        # arbitrated by the kv CAS, exactly like cross-process ones.
+        info = TableInfo(
+            table_id=self._alloc_table_id(),
+            name=name, database=database, schema=schema,
+            engine=engine, options=options or {},
+            num_regions=max(1, num_regions), partition=partition,
+            created_ms=int(time.time() * 1000),
+        )
+        # guard the kv key with CAS(expect-absent): two frontends
+        # racing on the same name must not both win (the local dict
+        # check only sees THIS process's view) — ADVICE r4
+        key = f"{TABLE_PREFIX}{database}/{name}"
+        while not self.meta.kv_cas(key, None,
+                                   json.dumps(info.to_json())):
+            if not if_not_exists:
                 raise TableAlreadyExistsError(
                     f"table already exists: {name}"
                 )
-            try:
-                table = self._open_table(info)
-            except Exception:
-                # roll the claim back: a failed region placement must
-                # not leave a phantom kv entry blocking the name forever
-                self.meta.kv_delete(key)
-                raise
-            db[name] = table
-            return table
+            # the racing winner's table: open from its kv doc
+            raw = self.meta.kv_get(key)
+            if raw is None:
+                # the winner rolled its claim back (failed placement)
+                # or the table was dropped in the same instant: the
+                # name is free again, re-attempt our own CAS
+                continue
+            won = TableInfo.from_json(json.loads(raw))
+            table = self._open_table(won)
+            with self._lock:
+                self._local_gen += 1
+                return self._db(database).setdefault(name, table)
+        try:
+            table = self._open_table(info)
+        except Exception:
+            # roll the claim back: a failed region placement must
+            # not leave a phantom kv entry blocking the name forever
+            self.meta.kv_delete(key)
+            raise
+        try:
+            with self._lock:
+                # a concurrent refresh() may have opened the kv entry
+                # we just CAS'd; keep whichever proxy landed first
+                self._local_gen += 1
+                return self._db(database).setdefault(name, table)
+        except DatabaseNotFoundError:
+            # the database was dropped while we were opening regions:
+            # roll the kv claim back so a later refresh cannot
+            # resurrect the dropped database around an orphan entry
+            self._teardown_table(database, name, table)
+            raise
 
     def rename_table(self, database: str, old: str, new: str):
-        with self._lock:
-            db = self._db(database)
-            if new in db:
-                raise TableAlreadyExistsError(
-                    f"table already exists: {new}"
-                )
-            table = db.pop(old, None)
-            if table is None:
-                raise TableNotFoundError(f"table not found: {old}")
-            table.info.name = new
-            db[new] = table
+        # _ddl_lock: the delete-old/put-new kv pair must not interleave
+        # with another rename's (no CAS backs these writes)
+        with self._ddl_lock:  # gtlint: disable=GTS102
+            with self._lock:
+                db = self._db(database)
+                if new in db:
+                    raise TableAlreadyExistsError(
+                        f"table already exists: {new}"
+                    )
+                table = db.pop(old, None)
+                if table is None:
+                    raise TableNotFoundError(f"table not found: {old}")
+                table.info.name = new
+                db[new] = table
+                self._local_gen += 1
+            # kv writes outside self._lock (lookups must not wait on
+            # HTTP)
             self._del_table(database, old)
             self._put_table(table.info)
 
@@ -318,37 +393,52 @@ class DistCatalogManager(CatalogManager):
         with self._lock:
             db = self._db(database)
             table = db.pop(name, None)
-            if table is None:
-                if if_exists:
-                    return
-                raise TableNotFoundError(f"table not found: {name}")
-            if table.info.engine == "metric":
-                # logical drop only: the physical regions are SHARED
-                # with every other metric table on this database
-                self._del_table(database, name)
+            if table is not None:
+                self._local_gen += 1
+        if table is None:
+            if if_exists:
                 return
-            rids = table.info.region_ids()
-            for r in getattr(table, "regions", []):
-                try:
-                    r.client.drop_region(r.meta.region_id)
-                except Exception as e:  # noqa: BLE001
-                    # best-effort teardown: an unreachable datanode
-                    # must not block the DROP; orphaned region dirs
-                    # are reclaimed when the node reopens
-                    _log.warning("drop_region %s on %s failed: %s",
-                                 r.meta.region_id, r.client.addr, e)
-            try:
-                self.meta.remove_routes(rids)
-            except Exception as e:  # noqa: BLE001
-                _log.warning("remove_routes %s failed: %s", rids, e)
+            raise TableNotFoundError(f"table not found: {name}")
+        self._teardown_table(database, name, table)
+
+    def _teardown_table(self, database: str, name: str, table):
+        """Region teardown + kv deletes, run OUTSIDE self._lock:
+        lookups of unrelated tables must not stall behind per-region
+        Flight round-trips (gtsan GTS102). The caller has already
+        removed the name from the local dict, so no new writes can
+        route to the table."""
+        if table.info.engine == "metric":
+            # logical drop only: the physical regions are SHARED
+            # with every other metric table on this database
             self._del_table(database, name)
+            return
+        rids = table.info.region_ids()
+        for r in getattr(table, "regions", []):
+            try:
+                r.client.drop_region(r.meta.region_id)
+            except Exception as e:  # noqa: BLE001
+                # best-effort teardown: an unreachable datanode
+                # must not block the DROP; orphaned region dirs
+                # are reclaimed when the node reopens
+                _log.warning("drop_region %s on %s failed: %s",
+                             r.meta.region_id, r.client.addr, e)
+        try:
+            self.meta.remove_routes(rids)
+        except Exception as e:  # noqa: BLE001
+            _log.warning("remove_routes %s failed: %s", rids, e)
+        self._del_table(database, name)
 
     # ------------------------------------------------------------------
     # alter: fan the region-level change to owning datanodes
     # ------------------------------------------------------------------
     def alter_add_column(self, database: str, name: str, col, *,
                          if_not_exists: bool = False):
-        with self._lock:
+        # _ddl_lock serializes DDL against DDL only (lost-update guard
+        # on schema + kv): readers/writers use self._lock and never
+        # wait here, so region-fan-out Flight latency under THIS lock
+        # stalls nobody but a concurrent ALTER — which must wait
+        # anyway for a consistent schema
+        with self._ddl_lock:  # gtlint: disable=GTS102
             table = self.table(database, name)
             if col.semantic_type == SemanticType.TIMESTAMP:
                 raise InvalidArgumentError("cannot add a TIME INDEX column")
@@ -389,7 +479,8 @@ class DistCatalogManager(CatalogManager):
             self._put_table(table.info)
 
     def alter_drop_column(self, database: str, name: str, col_name: str):
-        with self._lock:
+        # see alter_add_column: DDL-vs-DDL serialization only
+        with self._ddl_lock:  # gtlint: disable=GTS102
             table = self.table(database, name)
             col = table.info.schema.column(col_name)
             if not col.is_field:
@@ -416,28 +507,47 @@ class DistCatalogManager(CatalogManager):
         by OTHER frontends since this process loaded (flownodes see
         source/sink tables appear; region proxies are cheap to
         rebuild)."""
-        with self._lock:
-            # drop clients whose node re-registered at a new address
-            # (a restarted datanode binds a fresh port) — otherwise the
-            # post-failover retry redials the dead socket forever
-            try:
-                peers = self.meta.peers()
-            except Exception:  # noqa: BLE001 - metasrv momentarily away
-                peers = None
-            if peers is not None:
+        # drop clients whose node re-registered at a new address
+        # (a restarted datanode binds a fresh port) — otherwise the
+        # post-failover retry redials the dead socket forever
+        try:
+            peers = self.meta.peers()
+        except Exception:  # noqa: BLE001 - metasrv momentarily away
+            peers = None
+        if peers is not None:
+            stale = []
+            with self._clients_lock:
                 for nid, cli in list(self._clients.items()):
                     if peers.get(nid) != cli.addr:
-                        try:
-                            cli.close()
-                        except Exception as e:  # noqa: BLE001
-                            _log.debug("closing stale client for "
-                                       "node %s: %s", nid, e)
+                        stale.append((nid, cli))
                         del self._clients[nid]
-            self._databases = {}
-            self._views = {}
-            self._load()
-            if DEFAULT_SCHEMA not in self._databases:
-                self._databases[DEFAULT_SCHEMA] = {}
+            for nid, cli in stale:
+                try:
+                    cli.close()
+                except Exception as e:  # noqa: BLE001
+                    _log.debug("closing stale client for node %s: %s",
+                               nid, e)
+        # rebuild into fresh dicts OUTSIDE self._lock (kv HTTP +
+        # region-open Flight), then swap: concurrent lookups keep
+        # resolving against the old snapshot instead of stalling
+        with self._lock:
+            gen0 = self._local_gen
+        databases: dict = {}
+        views: dict = {}
+        self._load_into(databases, views)
+        if DEFAULT_SCHEMA not in databases:
+            databases[DEFAULT_SCHEMA] = {}
+        with self._lock:
+            if self._local_gen != gen0:
+                # a local create/rename/DROP landed AFTER our kv
+                # snapshot: swapping it in could vanish a just-created
+                # table or RESURRECT a just-dropped one (proxies to
+                # dead regions). The current dicts are newer than the
+                # snapshot, so abandon this swap — the next miss
+                # triggers a fresh rebuild.
+                return
+            self._databases = databases
+            self._views = views
 
     def table(self, database: str, name: str):
         """Base lookup, refreshing from the shared kv on a miss (rate-
@@ -457,5 +567,8 @@ class DistCatalogManager(CatalogManager):
     def close(self):
         if self.ingest is not None:
             self.ingest.close()  # drains queued + in-flight batches
-        for cli in self._clients.values():
+        with self._clients_lock:
+            # snapshot: an in-flight _client_for may still be inserting
+            clients = list(self._clients.values())
+        for cli in clients:
             cli.close()

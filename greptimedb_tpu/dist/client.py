@@ -11,7 +11,7 @@ from __future__ import annotations
 import json
 import logging
 import re
-import threading
+
 import urllib.request
 
 from greptimedb_tpu.errors import (
@@ -20,6 +20,7 @@ from greptimedb_tpu.errors import (
     error_from_code,
 )
 
+from greptimedb_tpu import concurrency
 
 _log = logging.getLogger("greptimedb_tpu.dist.client")
 
@@ -71,7 +72,7 @@ class DatanodeClient:
 
     def __init__(self, addr: str):
         self.addr = addr
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._conn = None
 
     def _client(self):

@@ -41,7 +41,7 @@ correct for the whole SQL surface.
 from __future__ import annotations
 
 import json
-import threading
+
 import time
 from collections import OrderedDict
 
@@ -60,6 +60,8 @@ from greptimedb_tpu.query.executor import (
 from greptimedb_tpu.query.planner import AggSpec, KeySpec, SelectPlan
 from greptimedb_tpu.sql import ast as A
 from greptimedb_tpu.telemetry.metrics import global_registry
+
+from greptimedb_tpu import concurrency
 
 _DECOMPOSABLE = {
     "count", "sum", "min", "max", "mean",
@@ -152,8 +154,7 @@ def try_dist_query(instance, plan: SelectPlan, table):
 _DEFAULT_POOL_SIZE = 8
 _pool_size = _DEFAULT_POOL_SIZE
 _pool = None
-_pool_lock = threading.Lock()
-
+_pool_lock = concurrency.Lock()
 
 def configure(options: dict | None):
     """Apply the [dist_query] TOML section to this frontend process."""
@@ -169,13 +170,14 @@ def configure(options: dict | None):
 
 
 def _fanout_pool():
-    from concurrent.futures import ThreadPoolExecutor
-
     global _pool
     with _pool_lock:
         if _pool is None:
-            _pool = ThreadPoolExecutor(
-                max_workers=_pool_size, thread_name_prefix="gtpu-fanout"
+            # shared=True: intentionally process-wide, lives for the
+            # process (gtsan leak check exempt)
+            _pool = concurrency.ThreadPoolExecutor(
+                max_workers=_pool_size, thread_name_prefix="gtpu-fanout",
+                shared=True,
             )
         return _pool
 
@@ -183,7 +185,7 @@ def _fanout_pool():
 # encoded-doc caches: hot queries re-ship byte-identical plan/TableInfo
 # docs, so the codec + json.dumps work is paid once per distinct shape
 _PLAN_DOC_MAX = 128
-_plan_doc_lock = threading.Lock()
+_plan_doc_lock = concurrency.Lock()
 _plan_doc_cache: OrderedDict[str, bytes] = OrderedDict()
 
 

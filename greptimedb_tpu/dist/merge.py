@@ -21,14 +21,16 @@ Datanode-side fast paths for the repeated-query steady state:
 from __future__ import annotations
 
 import json
-import threading
+
 import time
 from collections import OrderedDict
 
 from greptimedb_tpu.catalog.table import Table, TableScanData
 
+from greptimedb_tpu import concurrency
+
 _DECODE_LRU_MAX = 64
-_decode_lock = threading.Lock()
+_decode_lock = concurrency.Lock()
 _decode_cache: OrderedDict[str, tuple] = OrderedDict()
 
 

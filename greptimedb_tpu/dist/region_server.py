@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 
 import numpy as np
 
@@ -23,6 +22,8 @@ from greptimedb_tpu.dist.codec import (
 from greptimedb_tpu.errors import RegionNotFoundError
 from greptimedb_tpu.storage.memtable import _concat_rows
 from greptimedb_tpu.storage.series import SeriesRegistry
+
+from greptimedb_tpu import concurrency
 
 REGIONS_FILE = "dist_regions.json"
 
@@ -67,7 +68,7 @@ class RegionServer:
 
         self.engine = engine
         self._path = os.path.join(data_home, REGIONS_FILE)
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._closed = False
         self._metas: dict[int, dict] = {}
         # merged-scan cache + bounded region-scan pool ([dist_query])
@@ -80,7 +81,7 @@ class RegionServer:
             else region_scan_parallelism
         ))
         self._scan_pool = None
-        self._scan_pool_lock = threading.Lock()
+        self._scan_pool_lock = concurrency.Lock()
         # region alive-keeping (the reference's RegionAliveKeeper,
         # src/datanode/src/alive_keeper.rs:44-113): metasrv lease grants
         # set per-region deadlines; expiry FENCES the region (writes
@@ -359,11 +360,9 @@ class RegionServer:
 
     def _pool(self):
         """Bounded shared pool for intra-datanode region parallelism."""
-        from concurrent.futures import ThreadPoolExecutor
-
         with self._scan_pool_lock:
             if self._scan_pool is None:
-                self._scan_pool = ThreadPoolExecutor(
+                self._scan_pool = concurrency.ThreadPoolExecutor(
                     max_workers=self._scan_parallelism,
                     thread_name_prefix="gtpu-region-scan",
                 )

@@ -22,10 +22,11 @@ the global metrics registry (/metrics, runtime_metrics).
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 
 from greptimedb_tpu.telemetry.metrics import global_registry
+
+from greptimedb_tpu import concurrency
 
 _HITS = global_registry.counter(
     "gtpu_dist_scan_cache_hits_total",
@@ -114,7 +115,7 @@ class ScanCache:
 
     def __init__(self, max_bytes: int):
         self.max_bytes = int(max_bytes)
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._entries: OrderedDict[tuple, ScanEntry] = OrderedDict()
         self._bytes = 0
 

@@ -22,6 +22,8 @@ from greptimedb_tpu.catalog.manager import _REGION_SHIFT
 from greptimedb_tpu.dist.catalog import TABLE_PREFIX
 from greptimedb_tpu.errors import IllegalStateError, RegionNotFoundError
 
+from greptimedb_tpu import concurrency
+
 _META_TTL_S = 5.0
 
 _log = logging.getLogger("greptimedb_tpu.dist.wire_cluster")
@@ -32,7 +34,7 @@ class WireCluster:
         import threading
 
         self.metasrv = metasrv
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._clients: dict[int, object] = {}
         # table_id -> (meta_doc builder input, fetched_at): failing over
         # R regions must not rescan the whole catalog R times

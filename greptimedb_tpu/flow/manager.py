@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import json
 import logging
-import threading
+
 import time
 
 import numpy as np
@@ -39,6 +39,8 @@ from greptimedb_tpu.query.expr import eval_expr
 from greptimedb_tpu.query.planner import plan_select
 from greptimedb_tpu.sql import ast as A
 from greptimedb_tpu.sql.parser import parse_sql
+
+from greptimedb_tpu import concurrency
 
 _log = logging.getLogger("greptimedb_tpu.flow.manager")
 
@@ -71,11 +73,11 @@ class Flow:
         self.comment = stmt.comment
         self.processed_rows = 0
         self.state: dict[tuple, _GroupState] = {}
-        self.lock = threading.Lock()
+        self.lock = concurrency.Lock()
         # serializes whole flushes: ADMIN flush_flow must not return
         # while a concurrent tick-flush still holds this flow's dirty
         # snapshot mid-emit (the sink would materialize only later)
-        self.flush_lock = threading.Lock()
+        self.flush_lock = concurrency.Lock()
         self.plan = None          # lazily planned against the source schema
         self.device_state = None  # DeviceFlowState when the plan allows
         self.last_tick_ms = 0
@@ -88,7 +90,7 @@ class Flow:
         # backfill_gate makes the skip-vs-clear handoff atomic without
         # blocking inserts behind the (long) scan itself.
         self.missed_during_backfill = False
-        self.backfill_gate = threading.Lock()
+        self.backfill_gate = concurrency.Lock()
 
     def to_json(self) -> dict:
         return {
@@ -125,10 +127,10 @@ class FlowManager:
         self.epoch = uuid.uuid4().hex
         self._flows: dict[str, Flow] = {}
         self._by_source: dict[str, list[Flow]] = {}
-        self._lock = threading.RLock()
-        self._stop = threading.Event()
+        self._lock = concurrency.RLock()
+        self._stop = concurrency.Event()
         self._load()
-        self._ticker = threading.Thread(
+        self._ticker = concurrency.Thread(
             target=self._tick_loop, daemon=True, name="flow-ticker"
         )
         self._ticker.start()
@@ -585,7 +587,15 @@ class FlowManager:
     def _flush_flow(self, flow: Flow):
         if flow.plan is None:
             return
-        with flow.flush_lock:
+        # flush_lock exists to cover the whole flush INCLUDING the sink
+        # write: ADMIN flush_flow must not return while a tick-flush
+        # still holds this flow's dirty snapshot mid-emit. Only other
+        # flushers of the SAME flow ever wait here; inserts take
+        # flow.lock, which is released before the sink write
+        # GTS103: the FIRST flush of a device flow jit-compiles its
+        # kernel under this lock (single-flight); steady-state flushes
+        # are milliseconds
+        with flow.flush_lock:  # gtlint: disable=GTS102,GTS103
             self._flush_flow_locked(flow)
 
     def _flush_flow_locked(self, flow: Flow):

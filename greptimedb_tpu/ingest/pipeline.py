@@ -22,7 +22,6 @@ This layer also owns the retry/flush policy:
 
 from __future__ import annotations
 
-import threading
 import time
 
 from greptimedb_tpu.errors import (
@@ -32,6 +31,8 @@ from greptimedb_tpu.errors import (
 )
 from greptimedb_tpu.ingest.sender import DatanodeSender
 from greptimedb_tpu.telemetry.metrics import global_registry
+
+from greptimedb_tpu import concurrency
 
 _RETRIES = global_registry.counter(
     "gtpu_ingest_route_retry_total",
@@ -77,7 +78,7 @@ class WriteTicket:
     region batch; collects the typed errors of failed parts."""
 
     def __init__(self):
-        self._cv = threading.Condition()
+        self._cv = concurrency.Condition()
         self._pending = 0
         self.errors: list[GreptimeError] = []
 
@@ -122,7 +123,7 @@ class IngestPipeline:
         dist catalog provides it); None disables route-refresh retry."""
         self.cfg = config or IngestConfig()
         self._reroute = reroute
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._senders: dict[str, DatanodeSender] = {}
         self._closed = False
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 import itertools
 import json
 import logging
-import threading
+
 import time
 
 from greptimedb_tpu.errors import (
@@ -34,6 +34,8 @@ from greptimedb_tpu.errors import (
 )
 from greptimedb_tpu.ingest.coalescer import AdaptiveDelay, coalesce_entries
 from greptimedb_tpu.telemetry.metrics import global_registry
+
+from greptimedb_tpu import concurrency
 
 _log = logging.getLogger("greptimedb_tpu.ingest.sender")
 
@@ -104,7 +106,7 @@ class DatanodeSender:
         # pipeline-level policy hook: (entries, error) -> True when the
         # entries were requeued (tickets stay pending)
         self._on_group_error = on_group_error
-        self._cv = threading.Condition()
+        self._cv = concurrency.Condition()
         self._queue: list = []
         self._queued_rows = 0
         self._inflight_rows = 0
@@ -117,7 +119,7 @@ class DatanodeSender:
         self._closed = False
         self._last_send = time.monotonic()
         self._delay = AdaptiveDelay(config.max_delay_s)
-        self._worker = threading.Thread(
+        self._worker = concurrency.Thread(
             target=self._run, daemon=True, name=f"ingest-{self.addr}"
         )
         self._worker.start()
@@ -303,7 +305,7 @@ class DatanodeSender:
         )
         st = _Stream(key, writer, reader)
         self._streams[key] = st
-        threading.Thread(
+        concurrency.Thread(
             target=self._ack_loop, args=(st,), daemon=True,
             name=f"ingest-ack-{self.addr}",
         ).start()

@@ -33,6 +33,7 @@ from greptimedb_tpu.sql import ast as A
 from greptimedb_tpu.sql.parser import parse_sql
 from greptimedb_tpu.storage.engine import EngineConfig, TsdbEngine
 
+from greptimedb_tpu import concurrency
 
 class Output:
     """Statement execution result: either affected rows or a result set."""
@@ -76,7 +77,7 @@ class _ProcessList:
     def __init__(self):
         import threading
 
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._next_id = 1
         self._running: dict[int, dict] = {}
 
@@ -89,6 +90,9 @@ class _ProcessList:
             self._running[pid] = {
                 "id": pid, "query": query, "db": ctx.database,
                 "user": ctx.username or "greptime", "start": time.time(),
+                # elapsed_s math uses the monotonic clock (GT011): an
+                # NTP slew must not show negative or absurd elapsed
+                "_start_mono": time.monotonic(),
                 "killed": False,
             }
             return pid
@@ -122,9 +126,11 @@ class _ProcessList:
         import time
 
         with self._lock:
-            now = time.time()
+            now = time.monotonic()
             return [
-                {**e, "elapsed_s": now - e["start"]}
+                {**{k: v for k, v in e.items()
+                    if not k.startswith("_")},
+                 "elapsed_s": now - e["_start_mono"]}
                 for e in self._running.values()
             ]
 
@@ -198,7 +204,7 @@ class Standalone:
                     logging.getLogger("greptimedb_tpu.instance").debug(
                         "device cache warm-start skipped: %s", e)
 
-            threading.Thread(
+            concurrency.Thread(
                 target=_warm, daemon=True, name="device-cache-warm"
             ).start()
 

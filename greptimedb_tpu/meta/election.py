@@ -17,9 +17,12 @@ from __future__ import annotations
 import json
 import logging
 import threading
+
 import time
 
 from greptimedb_tpu.meta.kv import KvBackend
+
+from greptimedb_tpu import concurrency
 
 _log = logging.getLogger("greptimedb_tpu.meta.election")
 
@@ -42,9 +45,9 @@ class Election:
         self.on_change = on_change
         self._is_leader = False
         self._last_written: bytes | None = None
-        self._stop = threading.Event()
+        self._stop = concurrency.Event()
         self._thread: threading.Thread | None = None
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
 
     # ---- observation --------------------------------------------------
     @property
@@ -79,7 +82,12 @@ class Election:
                 except ValueError:
                     doc = None
             new = json.dumps({
-                "leader": self.me, "expires_at": now + self.lease_s,
+                # wall clock by design: expires_at lives in the SHARED
+                # kv and is compared against every candidate's own
+                # clock — monotonic clocks are process-local and
+                # meaningless across them
+                "leader": self.me,
+                "expires_at": now + self.lease_s,  # gtlint: disable=GT011
             }).encode()
             won = False
             if raw is None:
@@ -131,7 +139,7 @@ class Election:
 
     # ---- lifecycle ----------------------------------------------------
     def start(self) -> "Election":
-        self._thread = threading.Thread(
+        self._thread = concurrency.Thread(
             target=self._loop, daemon=True,
             name=f"election-{self.me}",
         )

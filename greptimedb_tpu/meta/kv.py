@@ -11,8 +11,8 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 
+from greptimedb_tpu import concurrency
 
 class KvBackend:
     def get(self, key: str) -> bytes | None:
@@ -44,7 +44,7 @@ class KvBackend:
 class MemoryKv(KvBackend):
     def __init__(self):
         self._data: dict[str, bytes] = {}
-        self._lock = threading.RLock()
+        self._lock = concurrency.RLock()
 
     def get(self, key):
         with self._lock:
@@ -88,7 +88,7 @@ class FsKv(KvBackend):
     def __init__(self, path: str):
         self.path = path
         self._mem = MemoryKv()
-        self._lock = threading.RLock()
+        self._lock = concurrency.RLock()
         self._stamp: tuple | None = None
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._reload_if_changed()

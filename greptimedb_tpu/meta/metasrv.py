@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import json
 import logging
-import threading
+
 import time
 from dataclasses import dataclass, field
 
@@ -23,6 +23,8 @@ from greptimedb_tpu.errors import IllegalStateError
 from greptimedb_tpu.meta.failure_detector import PhiAccrualFailureDetector
 from greptimedb_tpu.meta.kv import KvBackend
 from greptimedb_tpu.meta.procedure import Procedure, ProcedureManager, Status
+
+from greptimedb_tpu import concurrency
 
 _log = logging.getLogger("greptimedb_tpu.meta.metasrv")
 
@@ -79,7 +81,7 @@ class Metasrv:
         self.maintenance_mode = False
         self.phi_threshold = phi_threshold
         self._mailbox: dict[int, list[dict]] = {}
-        self._lock = threading.RLock()
+        self._lock = concurrency.RLock()
         self._failover_cb = None  # set by the cluster: (region, from, to)
         self._load_routes()
 

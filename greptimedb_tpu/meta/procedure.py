@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import threading
+
 import time
 import traceback
 import uuid
@@ -18,6 +19,8 @@ from dataclasses import dataclass
 
 from greptimedb_tpu.errors import IllegalStateError
 from greptimedb_tpu.meta.kv import KvBackend
+
+from greptimedb_tpu import concurrency
 
 PROC_PREFIX = "__procedure/"
 
@@ -84,7 +87,7 @@ class ProcedureManager:
         self._loaders: dict[str, type[Procedure]] = {}
         self._metas: dict[str, ProcedureMeta] = {}
         self._events: dict[str, threading.Event] = {}
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
 
     def register_loader(self, type_name: str, cls: type[Procedure]):
         self._loaders[type_name] = cls
@@ -93,12 +96,12 @@ class ProcedureManager:
     def submit(self, procedure: Procedure, ctx=None) -> str:
         proc_id = uuid.uuid4().hex
         meta = ProcedureMeta(proc_id, procedure.type_name, "running")
-        ev = threading.Event()
+        ev = concurrency.Event()
         with self._lock:
             self._metas[proc_id] = meta
             self._events[proc_id] = ev
         self._persist_state(proc_id, procedure, "running")
-        t = threading.Thread(
+        t = concurrency.Thread(
             target=self._run, args=(proc_id, procedure, ctx, ev),
             daemon=True, name=f"procedure-{procedure.type_name}",
         )
@@ -175,11 +178,11 @@ class ProcedureManager:
             proc = cls.restore(doc["data"])
             proc_id = key[len(PROC_PREFIX):]
             meta = ProcedureMeta(proc_id, proc.type_name, "running")
-            ev = threading.Event()
+            ev = concurrency.Event()
             with self._lock:
                 self._metas[proc_id] = meta
                 self._events[proc_id] = ev
-            threading.Thread(
+            concurrency.Thread(
                 target=self._run, args=(proc_id, proc, ctx, ev),
                 daemon=True,
             ).start()

@@ -10,7 +10,7 @@ names the TIME INDEX).
 from __future__ import annotations
 
 import json
-import threading
+
 import time
 
 import numpy as np
@@ -20,11 +20,11 @@ from greptimedb_tpu.datatypes.types import ConcreteDataType
 from greptimedb_tpu.errors import InvalidArgumentError
 from greptimedb_tpu.pipeline.etl import IdentityPipeline, Pipeline
 
+from greptimedb_tpu import concurrency
+
 PIPELINES_PATH = "meta/pipelines.json"
 
-
-_get_lock = threading.Lock()
-
+_get_lock = concurrency.Lock()
 
 class PipelineManager:
     @classmethod
@@ -43,7 +43,7 @@ class PipelineManager:
     def __init__(self, instance):
         self.instance = instance
         self._pipelines: dict[str, Pipeline] = {}
-        self._lock = threading.RLock()
+        self._lock = concurrency.RLock()
         self._load()
 
     # ------------------------------------------------------------------

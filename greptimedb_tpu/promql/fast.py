@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import functools
 import os
-import threading
+
 import time
 from dataclasses import dataclass, field
 
@@ -45,6 +45,8 @@ from greptimedb_tpu.promql.parser import (
     VectorSelector,
 )
 from greptimedb_tpu.telemetry.metrics import global_registry
+
+from greptimedb_tpu import concurrency
 
 # range functions computable from per-series prefix sums: O(S*T) memory,
 # no (S, J, L) window materialisation, safe at 1M series.
@@ -112,7 +114,7 @@ class SelectorGridCache:
 
     def __init__(self):
         self._entries: dict[tuple, _Entry] = {}
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
 
     def get_entry(self, table, fieldname: str, mesh=None) -> _Entry | None:
         key = (id(table), fieldname)

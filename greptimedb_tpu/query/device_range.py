@@ -40,12 +40,15 @@ import functools
 import logging
 import math
 import threading
+
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
 from greptimedb_tpu.errors import UnsupportedError
 from greptimedb_tpu.sql import ast as A
+
+from greptimedb_tpu import concurrency
 
 _log = logging.getLogger("greptimedb_tpu.query.device_range")
 
@@ -114,7 +117,7 @@ class _Entry:
     # never iterate `fields` while a grow mutates it
     nbytes: int = 0
     # serializes in-place growth (ensure_states) across query threads
-    grow_lock: object = dc_field(default_factory=threading.Lock)
+    grow_lock: object = dc_field(default_factory=concurrency.Lock)
     # host-side grid arrays retained by build_entry(keep_host=True) until
     # persist_entry writes the restart snapshot
     host_snap: dict | None = None
@@ -154,7 +157,7 @@ class DeviceRangeCache:
 
     def __init__(self, byte_budget: int = _BYTE_BUDGET):
         self._entries: dict[tuple, _Entry] = {}
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self.byte_budget = byte_budget
 
     def lookup_compatible(self, tkey, version, r0: int, align_to: int
@@ -532,7 +535,7 @@ def _ensure_rows_pseudo(entry, items, jnp):
 # ----------------------------------------------------------------------
 
 _SNAP_DIRNAME = "device_cache"
-_snapshot_io_lock = threading.Lock()
+_snapshot_io_lock = concurrency.Lock()
 # per-table restore serialization: the warm thread and a racing query
 # must not both decode + device-transfer the same GB-scale snapshot
 _restore_locks: dict = {}
@@ -540,8 +543,7 @@ _restore_locks: dict = {}
 
 def _restore_lock(tkey) -> threading.Lock:
     with _snapshot_io_lock:
-        return _restore_locks.setdefault(tkey, threading.Lock())
-
+        return _restore_locks.setdefault(tkey, concurrency.Lock())
 
 def _ver_json(version) -> str:
     import json as _json
@@ -848,7 +850,7 @@ def precompile_programs(entry: _Entry, table) -> int:
 def persist_entry_async(entry: _Entry, table) -> None:
     if entry.host_snap is None:
         return
-    threading.Thread(
+    concurrency.Thread(
         target=persist_entry, args=(entry, table),
         daemon=True, name="device-cache-persist",
     ).start()
@@ -1606,7 +1608,7 @@ def execute_range_device(engine, plan, table):
         out = np.asarray(out)
     if prog_spec not in entry.program_specs:
         entry.program_specs[prog_spec] = True
-        threading.Thread(
+        concurrency.Thread(
             target=_persist_program_specs, args=(entry, table),
             daemon=True, name="program-specs-persist",
         ).start()

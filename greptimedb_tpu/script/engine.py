@@ -19,12 +19,13 @@ Scripts are stored through the object store (the reference keeps them in a
 from __future__ import annotations
 
 import json
-import threading
 
 import numpy as np
 
 from greptimedb_tpu.errors import InvalidArgumentError, UnsupportedError
 from greptimedb_tpu.query.executor import Col, QueryResult
+
+from greptimedb_tpu import concurrency
 
 SCRIPTS_PATH = "meta/scripts.json"
 
@@ -77,7 +78,7 @@ class PyEngine:
     def __init__(self, instance):
         self.instance = instance
         self._scripts: dict[str, CompiledScript] = {}
-        self._lock = threading.RLock()
+        self._lock = concurrency.RLock()
         self._load()
 
     # ------------------------------------------------------------------

@@ -24,6 +24,7 @@ from greptimedb_tpu.datatypes.batch import HostColumn
 from greptimedb_tpu.errors import GreptimeError
 from greptimedb_tpu.session import QueryContext
 
+from greptimedb_tpu import concurrency
 
 def wrap_flight_error(e: Exception) -> flight.FlightServerError:
     """Stamp a typed engine error's status code onto the Flight message
@@ -87,7 +88,7 @@ class _BasicAuthMiddlewareFactory(flight.ServerMiddlewareFactory):
     def __init__(self, provider):
         self.provider = provider
         self._tokens: dict[str, str] = {}
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
 
     def start_call(self, info, headers):
         import base64
@@ -153,7 +154,7 @@ class FlightServer(flight.FlightServerBase):
         # get_flight_info -> do_get runs the query once: the info call
         # materializes and parks the table for the matching ticket
         self._pending: dict[bytes, pa.Table] = {}
-        self._pending_lock = threading.Lock()
+        self._pending_lock = concurrency.Lock()
 
     # ---- queries ------------------------------------------------------
     def _run_sql(self, sql: str) -> pa.Table:
@@ -524,7 +525,7 @@ class FlightFrontend:
         self._thread: threading.Thread | None = None
 
     def start(self) -> "FlightFrontend":
-        self._thread = threading.Thread(
+        self._thread = concurrency.Thread(
             target=self.server.serve, daemon=True, name="flight-server"
         )
         self._thread.start()
@@ -539,7 +540,7 @@ class FlightFrontend:
         thread is abandoned; the engine teardown behind it makes any
         zombie handler fail its acks, which clients surface as the
         retryable unavailable error."""
-        done = threading.Event()
+        done = concurrency.Event()
 
         def _shutdown():
             try:
@@ -547,6 +548,6 @@ class FlightFrontend:
             finally:
                 done.set()
 
-        threading.Thread(target=_shutdown, daemon=True,
+        concurrency.Thread(target=_shutdown, daemon=True,
                          name="flight-shutdown").start()
         done.wait(grace_s)

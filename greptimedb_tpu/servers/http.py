@@ -18,6 +18,7 @@ from __future__ import annotations
 import gzip
 import json
 import threading
+
 import time
 import traceback
 import urllib.parse
@@ -35,6 +36,8 @@ from greptimedb_tpu.servers import influx, prom_store
 from greptimedb_tpu.session import QueryContext
 from greptimedb_tpu.telemetry import global_registry
 from greptimedb_tpu.version import __version__
+
+from greptimedb_tpu import concurrency
 
 _REQS = global_registry.counter(
     "greptime_servers_http_requests_total", "HTTP requests", ("path", "code")
@@ -141,7 +144,7 @@ class HttpServer:
         else:
             self._httpd = ThreadingHTTPServer((self.addr, self.port), handler)
         self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(
+        self._thread = concurrency.Thread(
             target=self._httpd.serve_forever, daemon=True, name="http-server"
         )
         self._thread.start()
@@ -420,7 +423,7 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 return self._handle_run_script()
             self._error(404, f"no route: {path}")
 
-        _engine_lock = threading.Lock()
+        _engine_lock = concurrency.Lock()
 
         def _script_engine(self):
             eng = getattr(instance, "_py_engine", None)
@@ -582,8 +585,13 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 end = _parse_prom_time(params.get("end"), time.time())
                 for match in _match_params(params):
                     try:
+                        # start/end are Prometheus API DATA timestamps
+                        # (epoch seconds from request params); their
+                        # difference is a query window in the data time
+                        # domain, not a process-relative duration
                         val, ev = engine.query_instant(
-                            match, end, lookback_ms=max(end - start, 1),
+                            match, end,
+                            lookback_ms=max(end - start, 1),  # gtlint: disable=GT011
                         )
                     except GreptimeError:
                         continue
@@ -603,7 +611,11 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             engine = PromEngine(instance, QueryContext(database=db))
             q = params.get("query", "")
             now = time.time()
-            start = _parse_prom_time(params.get("start"), now - 300)
+            # default range window in the Prometheus DATA time domain
+            # (epoch seconds): rows are stamped with wall clock, so the
+            # window bounds must be too
+            start = _parse_prom_time(
+                params.get("start"), now - 300)  # gtlint: disable=GT011
             end = _parse_prom_time(params.get("end"), now)
             step_s = params.get("step", "60")
             try:

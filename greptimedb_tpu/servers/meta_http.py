@@ -20,11 +20,13 @@ from __future__ import annotations
 import json
 import logging
 import threading
+
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from greptimedb_tpu.meta.kv import FsKv, KvBackend, MemoryKv
 from greptimedb_tpu.meta.metasrv import Metasrv
 
+from greptimedb_tpu import concurrency
 
 def _make_handler(metasrv: Metasrv, kv: KvBackend):
     class Handler(BaseHTTPRequestHandler):
@@ -193,10 +195,10 @@ class MetasrvServer:
         )
         self._srv: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
-        self._ticker = threading.Thread(
+        self._ticker = concurrency.Thread(
             target=self._tick_loop, daemon=True, name="metasrv-tick"
         )
-        self._stop = threading.Event()
+        self._stop = concurrency.Event()
 
     def _tick_loop(self):
         while not self._stop.wait(1.0):
@@ -241,7 +243,7 @@ class MetasrvServer:
         self._srv.owner = self  # type: ignore[attr-defined]
         self.port = self._srv.server_address[1]
         self.election.me = f"{self.addr}:{self.port}"
-        self._thread = threading.Thread(
+        self._thread = concurrency.Thread(
             target=self._srv.serve_forever, daemon=True,
             name="metasrv-http",
         )

@@ -26,6 +26,8 @@ import threading
 
 from greptimedb_tpu.session import QueryContext
 
+from greptimedb_tpu import concurrency
+
 # capability flags
 CLIENT_LONG_PASSWORD = 0x00000001
 CLIENT_CONNECT_WITH_DB = 0x00000008
@@ -633,7 +635,7 @@ class MySqlServer:
         self._srv = _TcpServer((self.addr, self.port), _Handler)
         self._srv.owner = self  # type: ignore[attr-defined]
         self.port = self._srv.server_address[1]
-        self._thread = threading.Thread(
+        self._thread = concurrency.Thread(
             target=self._srv.serve_forever, daemon=True,
             name="mysql-server",
         )

@@ -25,6 +25,8 @@ import threading
 
 from greptimedb_tpu.session import QueryContext
 
+from greptimedb_tpu import concurrency
+
 _SERVER_VERSION = "16.3 (greptimedb-tpu)"
 
 SSL_REQUEST = 80877103
@@ -485,7 +487,7 @@ class PostgresServer:
         self._srv = _TcpServer((self.addr, self.port), _Handler)
         self._srv.owner = self  # type: ignore[attr-defined]
         self.port = self._srv.server_address[1]
-        self._thread = threading.Thread(
+        self._thread = concurrency.Thread(
             target=self._srv.serve_forever, daemon=True,
             name="postgres-server",
         )

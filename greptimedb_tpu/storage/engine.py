@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import threading
+
 from dataclasses import dataclass, field
 
 from greptimedb_tpu.errors import RegionNotFoundError
@@ -19,6 +20,7 @@ from greptimedb_tpu.storage.compaction import compact_once
 from greptimedb_tpu.storage.object_store import FsObjectStore, ObjectStore
 from greptimedb_tpu.storage.region import Region, RegionMetadata
 
+from greptimedb_tpu import concurrency
 
 @dataclass
 class EngineConfig:
@@ -49,19 +51,23 @@ class TsdbEngine:
         self.store = store or FsObjectStore(self.config.data_root)
         self._regions: dict[int, Region] = {}
         self._topics: dict[int, object] = {}
-        self._lock = threading.RLock()
-        self._stop = threading.Event()
+        self._lock = concurrency.RLock()
+        self._stop = concurrency.Event()
         self._bg: threading.Thread | None = None
         if self.config.enable_background:
-            self._bg = threading.Thread(
+            self._bg = concurrency.Thread(
                 target=self._background_loop, daemon=True,
                 name="engine-maintenance",
             )
             self._bg.start()
 
     # ---- lifecycle ----------------------------------------------------
+    # GTS102 (both methods): _open replays the WAL and reads the
+    # manifest — over the wire on object-store/shared-WAL backends —
+    # under the registry lock BY DESIGN: a half-open region must never
+    # be visible, and open/create are startup- and migration-rare.
     def create_region(self, meta: RegionMetadata) -> Region:
-        with self._lock:
+        with self._lock:  # gtlint: disable=GTS102
             assert meta.region_id not in self._regions, meta.region_id
             region = self._open(meta)
             self._regions[meta.region_id] = region
@@ -69,7 +75,7 @@ class TsdbEngine:
 
     def open_region(self, meta: RegionMetadata) -> Region:
         """Open (possibly existing) region, replaying its WAL."""
-        with self._lock:
+        with self._lock:  # gtlint: disable=GTS102
             if meta.region_id in self._regions:
                 return self._regions[meta.region_id]
             region = self._open(meta)

@@ -13,10 +13,11 @@ lock-light.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
 
 import numpy as np
+
+from greptimedb_tpu import concurrency
 
 OP_PUT = 0
 OP_DELETE = 1
@@ -50,7 +51,7 @@ class Memtable:
         self.field_names = list(field_names)
         self.window_ms = window_ms
         self._parts: dict[int, _Partition] = {}
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self.rows = 0
         self.bytes = 0
 

@@ -7,8 +7,10 @@ from __future__ import annotations
 
 import os
 import threading
+
 from dataclasses import dataclass
 
+from greptimedb_tpu import concurrency
 
 @dataclass
 class ObjectMeta:
@@ -112,7 +114,7 @@ class FsObjectStore(ObjectStore):
 class MemoryObjectStore(ObjectStore):
     def __init__(self):
         self._data: dict[str, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
 
     def read(self, path: str) -> bytes:
         with self._lock:
@@ -333,7 +335,7 @@ class CachedObjectStore(ObjectStore):
         self.inner = inner
         self.cache_dir = cache_dir
         self.max_bytes = max_bytes
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._lru: "collections.OrderedDict[str, int]" = (
             collections.OrderedDict()
         )

@@ -10,10 +10,11 @@ least-recently-used columns.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 
 import numpy as np
+
+from greptimedb_tpu import concurrency
 
 _DEFAULT_CAPACITY = 256 * 1024 * 1024
 
@@ -21,7 +22,7 @@ _DEFAULT_CAPACITY = 256 * 1024 * 1024
 class PageCache:
     def __init__(self, capacity_bytes: int = _DEFAULT_CAPACITY):
         self.capacity = capacity_bytes
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._entries: OrderedDict[tuple, tuple] = OrderedDict()
         self._bytes = 0
         self.hits = 0

@@ -10,12 +10,11 @@ tag strings live only here. The registry is persisted through the manifest
 
 from __future__ import annotations
 
-import threading
-
 import numpy as np
 
 from greptimedb_tpu.datatypes.batch import Dictionary
 
+from greptimedb_tpu import concurrency
 
 class SeriesRegistry:
     def __init__(self, tag_names: list[str]):
@@ -23,7 +22,7 @@ class SeriesRegistry:
         self.dicts = [Dictionary() for _ in tag_names]
         self._series: dict[tuple, int] = {}
         self._rows: list[tuple] = []
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._codes_cache: np.ndarray | None = None
 
     def __len__(self) -> int:

@@ -12,9 +12,12 @@ from __future__ import annotations
 
 import re
 import threading
+
 import time
 
 from greptimedb_tpu.telemetry.metrics import global_registry
+
+from greptimedb_tpu import concurrency
 
 _LINE = re.compile(
     r"^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)"
@@ -58,7 +61,7 @@ class ExportMetricsTask:
         self.instance = instance
         self.db = db
         self.interval_s = max(1.0, float(interval_s))
-        self._stop = threading.Event()
+        self._stop = concurrency.Event()
         self._thread: threading.Thread | None = None
         self.runs = 0
         self.samples_written = 0
@@ -67,7 +70,7 @@ class ExportMetricsTask:
 
     def start(self):
         self.instance.catalog.create_database(self.db, if_not_exists=True)
-        self._thread = threading.Thread(
+        self._thread = concurrency.Thread(
             target=self._loop, daemon=True, name="export-metrics"
         )
         self._thread.start()

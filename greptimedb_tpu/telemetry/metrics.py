@@ -8,9 +8,9 @@ labels, rendered in the text exposition format.
 
 from __future__ import annotations
 
-import threading
 import time
 
+from greptimedb_tpu import concurrency
 
 class _Metric:
     def __init__(self, name: str, help_: str, label_names: tuple[str, ...]):
@@ -18,7 +18,7 @@ class _Metric:
         self.help = help_
         self.label_names = label_names
         self._children: dict[tuple, object] = {}
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
 
     def labels(self, *values: str):
         key = tuple(str(v) for v in values)
@@ -55,7 +55,7 @@ class _CounterChild:
 
     def __init__(self):
         self.value = 0.0
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
 
     def inc(self, amount: float = 1.0):
         with self._lock:
@@ -84,7 +84,7 @@ class _GaugeChild:
 
     def __init__(self):
         self.value = 0.0
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
 
     def set(self, v: float):
         with self._lock:
@@ -135,7 +135,7 @@ class _HistogramChild:
         self.counts = [0] * len(buckets)
         self.total = 0.0
         self.count = 0
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
 
     def observe(self, v: float):
         with self._lock:
@@ -202,7 +202,7 @@ class Histogram(_Metric):
 class MetricsRegistry:
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
 
     def counter(self, name, help_="", labels=()) -> Counter:
         return self._get(name, lambda: Counter(name, help_, tuple(labels)))

@@ -15,9 +15,11 @@ from __future__ import annotations
 
 import sys
 import threading
+
 import time
 from collections import Counter
 
+from greptimedb_tpu import concurrency
 
 def sample_cpu(seconds: float = 1.0, hz: int = 99,
                *, skip_threads: tuple[str, ...] = ("pprof-sampler",)
@@ -60,7 +62,7 @@ def sample_cpu(seconds: float = 1.0, hz: int = 99,
                 stacks[name + ";" + ";".join(parts)] += 1
             time.sleep(interval)
 
-    t = threading.Thread(target=loop, name="pprof-sampler", daemon=True)
+    t = concurrency.Thread(target=loop, name="pprof-sampler", daemon=True)
     t.start()
     t.join(seconds + 5.0)
     return stacks
@@ -99,8 +101,7 @@ def render_report(stacks: Counter, top: int = 40) -> str:
 # heap profiling (tracemalloc)
 # ----------------------------------------------------------------------
 
-_tracemalloc_lock = threading.Lock()
-
+_tracemalloc_lock = concurrency.Lock()
 
 def mem_profile(top: int = 30) -> str:
     """Top heap allocation sites. Starts tracemalloc on first use (the

@@ -13,9 +13,12 @@ import json
 import os
 import platform
 import threading
+
 import uuid
 
 from greptimedb_tpu.version import __version__
+
+from greptimedb_tpu import concurrency
 
 UUID_FILE_NAME = ".greptimedb-telemetry-uuid"
 
@@ -65,11 +68,11 @@ class TelemetryTask:
         self.mode = mode
         self.nodes = nodes
         self.reports_sent = 0
-        self._stop = threading.Event()
+        self._stop = concurrency.Event()
         self._thread: threading.Thread | None = None
 
     def start(self):
-        self._thread = threading.Thread(
+        self._thread = concurrency.Thread(
             target=self._loop, daemon=True, name="telemetry-report"
         )
         self._thread.start()

@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import logging
 import random
-import threading
+
 import time
 from collections import deque
+
+from greptimedb_tpu import concurrency
 
 logger = logging.getLogger("greptimedb_tpu.slow_query")
 
@@ -25,7 +27,7 @@ class SlowQueryLog:
         self.threshold_s = float(threshold_s)
         self.sample_ratio = min(1.0, max(0.0, float(sample_ratio)))
         self._ring: deque = deque(maxlen=max(1, capacity))
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self.total_recorded = 0
 
     def maybe_record(self, sql: str, elapsed_s: float, *, db: str = "",

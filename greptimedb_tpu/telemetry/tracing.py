@@ -14,9 +14,11 @@ from __future__ import annotations
 
 import contextvars
 import secrets
-import threading
+
 import time
 from dataclasses import dataclass, field
+
+from greptimedb_tpu import concurrency
 
 _current_span: contextvars.ContextVar["Span | None"] = (
     contextvars.ContextVar("gtpu_span", default=None)
@@ -55,7 +57,7 @@ class _TraceStore:
     """Bounded ring of finished traces (newest kept)."""
 
     def __init__(self, cap: int = _MAX_TRACES):
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._spans: dict[str, list[Span]] = {}
         self._order: list[str] = []
         self.cap = cap

@@ -1,8 +1,10 @@
 """Offline tools package.
 
-Submodules: this module (export/import of a data home) and
+Submodules: this module (export/import of a data home),
 `greptimedb_tpu.tools.lint` (gtlint, the AST-based correctness
-linter — see README "Static analysis").
+linter — see README "Static analysis"), and
+`greptimedb_tpu.tools.san` (gtsan, the cooperative concurrency
+sanitizer — see README "Concurrency sanitizer").
 
 Offline data tools: export / import a data home.
 

@@ -1,0 +1,182 @@
+"""gtlint interprocedural layer: module-level call graph + taint.
+
+PR 3's GT007 (lock-across-blocking-I/O) and GT004 (host-sync-in-jit)
+only saw *direct* hazards: `with lock: client.do_put(...)` fired, but
+`with lock: self._send(...)` where `_send` does the do_put two helpers
+down did not.  This module gives each file a call graph over its
+project-local functions and a per-function "blocking" / "host-sync"
+taint summary computed to a fixpoint, so the rules follow calls any
+number of levels deep through helpers defined in the same module.
+
+Resolution is deliberately conservative (no false edges across
+modules or duck-typed receivers):
+
+- `foo(...)`            -> module-level `def foo`
+- `self.foo(...)` /
+  `cls.foo(...)`        -> method `foo` of the enclosing class
+- `SomeClass.foo(...)`  -> method `foo` of a class defined in this
+                           module
+
+Nested `def`s are *not* edges: a closure handed to a Thread/pool runs
+asynchronously, so charging its blocking work to the definer would be
+a false positive.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from greptimedb_tpu.tools.lint.core import dotted_name
+
+# shared blocking tables (rules.py re-exports these for GT007)
+BLOCKING_ATTRS = {
+    "urlopen", "do_get", "do_put", "do_action", "read_all",
+    "recv", "recvfrom", "sendall", "accept", "getresponse",
+    "create_connection", "getaddrinfo", "read_chunk",
+}
+BLOCKING_DOTTED = {"time.sleep", "urllib.request.urlopen",
+                   "socket.create_connection"}
+
+# definite device->host sync ops for the GT004 taint (np.asarray et al
+# are excluded here: helpers legitimately materialize *static* data at
+# trace time; the call-site check requires a traced argument anyway)
+_SYNC_ATTRS = {"item", "tolist"}
+_SYNC_DOTTED = {"jax.device_get"}
+
+
+@dataclasses.dataclass
+class FuncSummary:
+    qualname: str
+    node: ast.AST
+    # direct ops: (label, lineno)
+    blocking: bool = False
+    host_sync: bool = False
+    # taint witness: ["helper (line 12)", ..., "do_put (line 88)"] —
+    # the chain of calls from this function down to the leaf op
+    block_chain: list = dataclasses.field(default_factory=list)
+    sync_chain: list = dataclasses.field(default_factory=list)
+    # unresolved edges: (callee qualname, call lineno)
+    calls: list = dataclasses.field(default_factory=list)
+
+
+def blocking_label(call: ast.Call) -> str | None:
+    """The blocking-op label for a direct call, or None."""
+    d = dotted_name(call.func)
+    if d in BLOCKING_DOTTED:
+        return d
+    if (isinstance(call.func, ast.Attribute)
+            and call.func.attr in BLOCKING_ATTRS):
+        return call.func.attr
+    return None
+
+
+def _sync_label(call: ast.Call) -> str | None:
+    d = dotted_name(call.func)
+    if d in _SYNC_DOTTED:
+        return d
+    if (isinstance(call.func, ast.Attribute)
+            and call.func.attr in _SYNC_ATTRS):
+        return "." + call.func.attr + "()"
+    return None
+
+
+def _callee_qualname(call: ast.Call, cls: str | None,
+                     classes: set[str], funcs: set[str]) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id if f.id in funcs else None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        recv = f.value.id
+        if recv in ("self", "cls") and cls is not None:
+            q = f"{cls}.{f.attr}"
+            return q if q in funcs else None
+        if recv in classes:
+            q = f"{recv}.{f.attr}"
+            return q if q in funcs else None
+    return None
+
+
+class ModuleSummary:
+    """Call graph + taint for one module's top-level functions and
+    first-level methods."""
+
+    def __init__(self, tree: ast.Module):
+        self.funcs: dict[str, FuncSummary] = {}
+        self.classes: set[str] = set()
+        self._collect(tree)
+        self._propagate()
+
+    # -- collection ----------------------------------------------------
+    def _collect(self, tree: ast.Module):
+        pairs: list[tuple[str | None, ast.AST]] = []
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                pairs.append((None, node))
+            elif isinstance(node, ast.ClassDef):
+                self.classes.add(node.name)
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        pairs.append((node.name, sub))
+        names = {f"{c}.{n.name}" if c else n.name for c, n in pairs}
+        for cls, node in pairs:
+            q = f"{cls}.{node.name}" if cls else node.name
+            s = FuncSummary(q, node)
+            for call in self._own_calls(node):
+                label = blocking_label(call)
+                if label is not None and not s.blocking:
+                    s.blocking = True
+                    s.block_chain = [f"{label} (line {call.lineno})"]
+                sl = _sync_label(call)
+                if sl is not None and not s.host_sync:
+                    s.host_sync = True
+                    s.sync_chain = [f"{sl} (line {call.lineno})"]
+                callee = _callee_qualname(call, cls, self.classes,
+                                          names)
+                if callee is not None and callee != q:
+                    s.calls.append((callee, call.lineno))
+            self.funcs[q] = s
+
+    @staticmethod
+    def _own_calls(func: ast.AST):
+        """Call nodes in `func`'s own body, not descending into nested
+        function definitions (they run on their own schedule)."""
+        stack = list(ast.iter_child_nodes(func))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- fixpoint ------------------------------------------------------
+    def _propagate(self):
+        changed = True
+        while changed:
+            changed = False
+            for s in self.funcs.values():
+                for callee, lineno in s.calls:
+                    c = self.funcs[callee]
+                    if c.blocking and not s.blocking:
+                        s.blocking = True
+                        s.block_chain = [
+                            f"{callee} (line {lineno})"
+                        ] + c.block_chain
+                        changed = True
+                    if c.host_sync and not s.host_sync:
+                        s.host_sync = True
+                        s.sync_chain = [
+                            f"{callee} (line {lineno})"
+                        ] + c.sync_chain
+                        changed = True
+
+    # -- rule-facing API -----------------------------------------------
+    def resolve_call(self, call: ast.Call, cls: str | None
+                     ) -> FuncSummary | None:
+        q = _callee_qualname(call, cls, self.classes,
+                             set(self.funcs))
+        return self.funcs.get(q) if q is not None else None

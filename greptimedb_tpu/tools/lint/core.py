@@ -151,6 +151,16 @@ class FileContext:
                     k = dotted_name(node.args[0])
                     if k:
                         self.pallas_kernels.add(k.split(".")[-1])
+        # interprocedural layer: per-function blocking/host-sync taint
+        # over the module-local call graph (import here — callgraph
+        # imports this module)
+        from greptimedb_tpu.tools.lint.callgraph import ModuleSummary
+
+        self.call_summary = ModuleSummary(tree)
+
+    @property
+    def current_class(self) -> str | None:
+        return self.class_stack[-1].name if self.class_stack else None
 
     # -- helpers rules use ---------------------------------------------
     @property

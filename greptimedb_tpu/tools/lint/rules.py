@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ast
 
+from greptimedb_tpu.tools.lint import callgraph
 from greptimedb_tpu.tools.lint.core import (
     FileContext,
     Rule,
@@ -179,6 +180,18 @@ class HostSyncInJit(Rule):
             ctx.report(self, node,
                        f"{node.func.id}() on a traced value forces "
                        "host sync inside jit")
+            return
+        # interprocedural: a module-local helper that (transitively)
+        # does .item()/.tolist()/device_get, called on a traced value
+        # from inside the jitted region, syncs just the same
+        s = ctx.call_summary.resolve_call(node, ctx.current_class)
+        if (s is not None and s.host_sync
+                and any(traced_value_use(a, fi) for a in node.args)):
+            chain = " -> ".join(s.sync_chain)
+            ctx.report(self, node,
+                       f"{s.qualname}(...) on a traced value inside a "
+                       f"jitted/device function reaches a host sync "
+                       f"({chain}); hoist it out of the jitted region")
 
 
 @register
@@ -252,15 +265,6 @@ class RecompileHazard(Rule):
                        "call; define and jit it at module scope")
 
 
-_BLOCKING_ATTRS = {
-    "urlopen", "do_get", "do_put", "do_action", "read_all",
-    "recv", "recvfrom", "sendall", "accept", "getresponse",
-    "create_connection", "getaddrinfo", "read_chunk",
-}
-_BLOCKING_DOTTED = {"time.sleep", "urllib.request.urlopen",
-                    "socket.create_connection"}
-
-
 @register
 class LockAcrossBlockingIO(Rule):
     id = "GT007"
@@ -268,25 +272,30 @@ class LockAcrossBlockingIO(Rule):
     description = (
         "A threading.Lock held across blocking I/O (sockets, HTTP, "
         "Arrow Flight do_get/do_put/do_action, sleep) serializes every "
-        "other thread on that lock for the full I/O latency. Copy the "
+        "other thread on that lock for the full I/O latency — directly "
+        "or through any chain of module-local helper calls. Copy the "
         "state out under the lock, do the I/O outside it."
     )
 
     def visit_Call(self, node: ast.Call, ctx: FileContext):
         if ctx.lock_depth == 0:
             return
-        d = dotted_name(node.func)
-        label = None
-        if d in _BLOCKING_DOTTED:
-            label = d
-        elif (isinstance(node.func, ast.Attribute)
-                and node.func.attr in _BLOCKING_ATTRS):
-            label = node.func.attr
+        label = callgraph.blocking_label(node)
         if label is not None:
             ctx.report(self, node,
                        f"{label}(...) called while holding a lock "
                        "blocks every other waiter for the full I/O "
                        "latency; move the call outside the lock")
+            return
+        # interprocedural: a module-local helper that (transitively)
+        # blocks is just as bad as the direct call
+        s = ctx.call_summary.resolve_call(node, ctx.current_class)
+        if s is not None and s.blocking:
+            chain = " -> ".join(s.block_chain)
+            ctx.report(self, node,
+                       f"{s.qualname}(...) called while holding a "
+                       f"lock reaches blocking I/O ({chain}); move "
+                       "the call outside the lock")
 
 
 def _assign_target_segment(ctx: FileContext) -> str | None:
@@ -396,6 +405,107 @@ class Int64OnDevice(Rule):
                 ctx.report(self, node,
                            f"{d}(dtype=int64) on device; prefer int32 "
                            "or gate on x64")
+
+
+def _is_walltime_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and dotted_name(node.func) in (
+        "time.time", "_time.time")
+
+
+def _contains_walltime_call(expr: ast.AST) -> bool:
+    """Does `expr` contain a time.time() call in the *interval* domain?
+
+    The exact idiom `time.time() * 1000` (either operand order) is the
+    codebase's epoch-ms DATA-timestamp constructor — arithmetic on the
+    result compares against row timestamps, where wall clock is the
+    point — so it is exempt.  `(time.time() - t0) * 1000` is NOT: the
+    subtraction happens in the time domain and stays flagged."""
+
+    def scan(node: ast.AST) -> bool:
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            def ms(n):
+                return (isinstance(n, ast.Constant)
+                        and n.value in (1000, 1000.0))
+
+            if ((_is_walltime_call(node.left) and ms(node.right))
+                    or (_is_walltime_call(node.right) and ms(node.left))):
+                return False        # epoch-ms data timestamp
+        if _is_walltime_call(node):
+            return True
+        return any(scan(c) for c in ast.iter_child_nodes(node))
+
+    return scan(expr)
+
+
+@register
+class WallClockDuration(Rule):
+    id = "GT011"
+    name = "wallclock-duration"
+    description = (
+        "Duration/deadline arithmetic on time.time() jumps with NTP "
+        "slews and DST — a retry window or cooldown can silently "
+        "double or go negative. Use time.monotonic() for elapsed/"
+        "deadline math; time.time() is for *data* timestamps only "
+        "(the epoch-ms constructor `time.time() * 1000` is exempt)."
+    )
+
+    @staticmethod
+    def _scan_assigns(scope: ast.AST, *, skip_nested: bool) -> set[str]:
+        """Names assigned from a wall-time expression within `scope`'s
+        own statements (optionally not descending into nested function
+        bodies — their bindings are a different scope)."""
+        names: set[str] = set()
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if skip_nested and isinstance(node, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef,
+                                                 ast.Lambda)):
+                continue
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and _contains_walltime_call(node.value)):
+                names.add(node.targets[0].id)
+            stack.extend(ast.iter_child_nodes(node))
+        return names
+
+    def _wall_names(self, ctx: FileContext) -> set[str]:
+        """Names bound to time.time() in the CURRENT scope: the
+        enclosing function's own assignments plus module-level ones.
+        Scoped per function — `now = time.time()` in one function must
+        not poison a monotonic `now` in another."""
+        cache = getattr(ctx, "_gt011_scopes", None)
+        if cache is None:
+            cache = ctx._gt011_scopes = {}
+        if "module" not in cache:
+            cache["module"] = self._scan_assigns(ctx.tree,
+                                                 skip_nested=True)
+        fi = ctx.current_func
+        if fi is None:
+            return cache["module"]
+        key = id(fi.node)
+        if key not in cache:
+            cache[key] = self._scan_assigns(fi.node, skip_nested=True)
+        return cache[key] | cache["module"]
+
+    def visit_BinOp(self, node: ast.BinOp, ctx: FileContext):
+        if not isinstance(node.op, (ast.Add, ast.Sub)):
+            return
+        for side in (node.left, node.right):
+            if _contains_walltime_call(side):
+                ctx.report(self, node,
+                           "duration/deadline arithmetic on "
+                           "time.time(); use time.monotonic() (wall "
+                           "clock is for data timestamps, not "
+                           "intervals)")
+                return
+            if (isinstance(side, ast.Name)
+                    and side.id in self._wall_names(ctx)):
+                ctx.report(self, node,
+                           f"{side.id} holds time.time() and feeds "
+                           "duration/deadline arithmetic; use "
+                           "time.monotonic() for interval math")
+                return
 
 
 _MUTABLE_CTORS = {"list", "dict", "set"}

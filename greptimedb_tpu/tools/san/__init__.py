@@ -1,0 +1,49 @@
+"""gtsan: cooperative concurrency sanitizer.
+
+A zero-cost-when-off runtime companion to gtlint's static rules: the
+codebase creates its locks, condition variables, threads, and pools
+through `greptimedb_tpu.concurrency`, and when the sanitizer is off
+those factories return *raw stdlib objects* (no wrapper frames, no
+overhead).  When enabled (`GTPU_SAN=1`, the `[sanitizer]` TOML
+section, or `greptimedb-tpu san -- <cmd>`), the factories return
+instrumented wrappers and gtsan maintains:
+
+- per-thread lock acquisition stacks and a global lock-order graph
+  with cycle detection — a potential ABBA deadlock is reported with
+  BOTH acquisition stacks without the process ever deadlocking
+  (GTS101);
+- blocking-call detection: `time.sleep`, Arrow Flight
+  do_get/do_put/do_action, socket connects, and condvar/event waits
+  executed while an instrumented lock is held (GTS102);
+- a configurable hold-time threshold — any lock held longer than
+  `hold_time_ms` is reported with its acquisition stack (GTS103);
+- thread / executor lifecycle tracking, so the pytest plugin
+  (`greptimedb_tpu.tools.san.pytest_plugin`) can fail any test that
+  leaks a non-daemon thread (GTS104) or an un-shutdown pool (GTS105).
+
+Findings flow through the same reporter / suppression / baseline
+machinery as gtlint (`# gtlint: disable=GTS1xx` comments and
+`tools/san/baseline.json`).
+"""
+
+from greptimedb_tpu.tools.san.core import (
+    SanConfig,
+    Sanitizer,
+    current,
+    disable,
+    enable,
+    enabled,
+    ensure_enabled_from_env,
+)
+from greptimedb_tpu.tools.san.report import result_doc
+
+__all__ = [
+    "SanConfig",
+    "Sanitizer",
+    "current",
+    "disable",
+    "enable",
+    "enabled",
+    "ensure_enabled_from_env",
+    "result_doc",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from greptimedb_tpu.tools.san.runner import main
+
+sys.exit(main())

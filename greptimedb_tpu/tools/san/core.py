@@ -1,0 +1,489 @@
+"""gtsan engine: lock-order graph, blocking detection, lifecycles.
+
+Design constraints:
+
+- The sanitizer's own synchronization uses RAW `threading` primitives
+  so instrumentation never recurses into itself.
+- Wrappers consult `current()` on every operation instead of binding a
+  sanitizer at construction: objects created while one sanitizer was
+  active keep working (untracked) after it is popped, which is what
+  nested pytest runs (pytester) need.
+- Per-acquire cost when ON is one `sys._getframe` walk over a handful
+  of frames (no linecache, no traceback objects); edges and cycle
+  checks only run on *nested* acquisitions, which are rare.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import threading
+import time
+import weakref
+
+_WRAP_ID = itertools.count(1)
+
+# frames inside these path fragments are instrumentation, not user code
+_SELF_FRAGMENTS = ("/tools/san/", "/concurrency.py", "/threading.py",
+                   "/concurrent/futures/")
+
+_STACK_DEPTH = 12
+
+
+def _capture_stack(skip: int = 2) -> list[tuple[str, int, str]]:
+    """(filename, lineno, funcname) frames, innermost first, skipping
+    instrumentation frames. Cheap: raw frame walk, no source lookup."""
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        return []
+    out: list[tuple[str, int, str]] = []
+    while f is not None and len(out) < _STACK_DEPTH:
+        fn = f.f_code.co_filename.replace("\\", "/")
+        if not any(s in fn for s in _SELF_FRAGMENTS):
+            out.append((fn, f.f_lineno, f.f_code.co_name))
+        f = f.f_back
+    return out
+
+
+def _fmt_stack(stack: list[tuple[str, int, str]], indent: str = "      "
+               ) -> str:
+    from greptimedb_tpu.tools.san.report import norm_path
+
+    return "\n".join(
+        f"{indent}{norm_path(fn)}:{ln} in {name}"
+        for fn, ln, name in stack
+    )
+
+
+def _site_of(stack: list[tuple[str, int, str]]) -> tuple[str, int]:
+    """First project frame of a captured stack -> (path, line)."""
+    from greptimedb_tpu.tools.san.report import norm_path
+
+    for fn, ln, _name in stack:
+        return norm_path(fn), ln
+    return "<unknown>", 0
+
+
+class SanConfig:
+    """Knobs, resolved from the `[sanitizer]` TOML section or
+    `GTPU_SAN_*` env vars (env wins inside `greptimedb-tpu san`)."""
+
+    def __init__(self, *, hold_time_ms: float = 1000.0,
+                 fail_on_cycle: bool = True,
+                 sleep_min_s: float = 0.001):
+        self.hold_time_ms = float(hold_time_ms)
+        self.fail_on_cycle = bool(fail_on_cycle)
+        # sleeps shorter than this are yield-style and not reported
+        self.sleep_min_s = float(sleep_min_s)
+
+    @classmethod
+    def from_env(cls, env=None) -> "SanConfig":
+        env = os.environ if env is None else env
+        kw = {}
+        if env.get("GTPU_SAN_HOLD_MS"):
+            kw["hold_time_ms"] = float(env["GTPU_SAN_HOLD_MS"])
+        if env.get("GTPU_SAN_FAIL_ON_CYCLE"):
+            kw["fail_on_cycle"] = env["GTPU_SAN_FAIL_ON_CYCLE"].lower() \
+                not in ("0", "false", "off")
+        return cls(**kw)
+
+    @classmethod
+    def from_options(cls, section: dict) -> "SanConfig":
+        kw = {}
+        if "hold_time_ms" in section:
+            kw["hold_time_ms"] = float(section["hold_time_ms"])
+        if "fail_on_cycle" in section:
+            kw["fail_on_cycle"] = bool(section["fail_on_cycle"])
+        return cls(**kw)
+
+
+class _Held:
+    """One entry on a thread's held-lock stack."""
+
+    __slots__ = ("node", "label", "t0", "stack", "count", "waiting")
+
+    def __init__(self, node: int, label: str, t0: float,
+                 stack: list[tuple[str, int, str]]):
+        self.node = node
+        self.label = label
+        self.t0 = t0
+        self.stack = stack
+        self.count = 1          # reentrancy (RLock / Condition)
+        self.waiting = False    # True while cv.wait() has it released
+
+
+class Sanitizer:
+    """Global state for one enabled sanitizer scope."""
+
+    def __init__(self, config: SanConfig | None = None):
+        self.cfg = config or SanConfig()
+        self._mu = threading.Lock()          # raw: guards graph+findings
+        self._tls = threading.local()
+        self.findings: list[dict] = []
+        self._finding_keys: set[tuple] = set()
+        # lock-order graph over wrapper ids: edge a->b = "b acquired
+        # while a held"; each edge remembers the stacks that created it
+        self._adj: dict[int, set[int]] = {}
+        self._edges: dict[tuple[int, int], dict] = {}
+        self._labels: dict[int, str] = {}
+        self._cycles_seen: set[frozenset] = set()
+        # lifecycle registries (weakrefs: a collected object cannot leak)
+        self._threads: dict[int, dict] = {}
+        self._executors: dict[int, dict] = {}
+
+    # ---- held-lock stack ---------------------------------------------
+    def _held(self) -> list[_Held]:
+        st = getattr(self._tls, "held", None)
+        if st is None:
+            st = self._tls.held = []
+        return st
+
+    def held_labels(self) -> list[str]:
+        return [h.label for h in self._held() if not h.waiting]
+
+    def _add_finding(self, rule: str, path: str, line: int, message: str,
+                     key: tuple | None = None):
+        with self._mu:
+            if key is not None:
+                if key in self._finding_keys:
+                    return
+                self._finding_keys.add(key)
+            self.findings.append({
+                "rule": rule, "path": path, "line": line, "col": 0,
+                "message": message,
+            })
+
+    # ---- lock-order graph --------------------------------------------
+    def before_acquire(self, node: int, label: str,
+                       stack: list[tuple[str, int, str]]):
+        """Record ordering edges held->node; runs BEFORE the real
+        acquire so a would-be deadlock is still reported."""
+        held = [h for h in self._held() if not h.waiting
+                and h.node != node]
+        if not held:
+            return
+        with self._mu:
+            self._labels[node] = label
+            for h in held:
+                self._labels.setdefault(h.node, h.label)
+                key = (h.node, node)
+                if key in self._edges:
+                    continue
+                self._edges[key] = {
+                    "held_stack": h.stack, "acq_stack": stack,
+                }
+                self._adj.setdefault(h.node, set()).add(node)
+                self._check_cycle_locked(h.node, node)
+
+    def _check_cycle_locked(self, a: int, b: int):
+        """After adding a->b, a path b ~> a closes a cycle."""
+        path = self._find_path_locked(b, a)
+        if path is None:
+            return
+        cycle = [a] + path          # [a, b, ..., a]
+        key = frozenset(cycle)
+        if key in self._cycles_seen:
+            return
+        self._cycles_seen.add(key)
+        fwd = self._edges[(a, b)]
+
+        def lbl(n: int) -> str:
+            return self._labels.get(n, f"lock#{n}")
+
+        lines = [
+            "potential deadlock: lock-order cycle "
+            + " -> ".join(lbl(n) for n in cycle),
+            f"    this thread acquired {lbl(b)} while holding {lbl(a)}:",
+            _fmt_stack(fwd["acq_stack"]),
+            f"    with {lbl(a)} held at:",
+            _fmt_stack(fwd["held_stack"]),
+        ]
+        # the return path b -> ... -> a: every edge carries the stacks
+        # recorded when that (reverse-order) acquisition happened
+        for x, y in zip(cycle[1:], cycle[2:]):
+            e = self._edges.get((x, y))
+            if e is None:
+                continue
+            lines.append(f"    elsewhere {lbl(y)} was acquired while "
+                         f"holding {lbl(x)}:")
+            lines.append(_fmt_stack(e["acq_stack"]))
+            lines.append(f"    with {lbl(x)} held at:")
+            lines.append(_fmt_stack(e["held_stack"]))
+        path_site, line_no = _site_of(fwd["acq_stack"])
+        self.findings.append({
+            "rule": "GTS101", "path": path_site, "line": line_no,
+            "col": 0, "message": "\n".join(lines),
+        })
+
+    def _find_path_locked(self, src: int, dst: int) -> list[int] | None:
+        """DFS src ~> dst over the order graph; returns the node path
+        [src, ..., dst] or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._adj.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def after_acquired(self, node: int, label: str,
+                       stack: list[tuple[str, int, str]]):
+        held = self._held()
+        for h in reversed(held):
+            if h.node == node and not h.waiting:
+                h.count += 1        # reentrant re-acquire
+                return
+        held.append(_Held(node, label, time.monotonic(), stack))
+
+    def on_release(self, node: int):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            h = held[i]
+            if h.node == node and not h.waiting:
+                h.count -= 1
+                if h.count > 0:
+                    return
+                del held[i]
+                held_ms = (time.monotonic() - h.t0) * 1000.0
+                if held_ms > self.cfg.hold_time_ms:
+                    path, line = _site_of(h.stack)
+                    self._add_finding(
+                        "GTS103", path, line,
+                        f"{h.label} held for {held_ms:.0f}ms "
+                        f"(threshold {self.cfg.hold_time_ms:.0f}ms); "
+                        "long critical sections serialize every other "
+                        "waiter — move the slow work outside the lock"
+                        "\n    acquired at:\n" + _fmt_stack(h.stack),
+                        key=("GTS103", path, line),
+                    )
+                return
+
+    # ---- condvar wait bracketing -------------------------------------
+    def wait_begin(self, node: int) -> _Held | None:
+        """cv.wait() releases the underlying lock: mark the entry
+        waiting so it neither counts as held nor accrues hold time."""
+        for h in reversed(self._held()):
+            if h.node == node and not h.waiting:
+                h.waiting = True
+                return h
+        return None
+
+    def wait_end(self, entry: _Held | None):
+        if entry is not None:
+            entry.waiting = False
+            entry.t0 = time.monotonic()     # re-acquired: fresh clock
+
+    # ---- blocking calls ----------------------------------------------
+    def on_blocking(self, label: str, *, skip: int = 2):
+        """Called from patched blockers (sleep/Flight/socket) and from
+        cv/event wait wrappers. Reports GTS102 when any instrumented
+        lock is held by this thread."""
+        held = [h for h in self._held() if not h.waiting]
+        if not held:
+            return
+        stack = _capture_stack(skip)
+        # anchor at the innermost lock ACQUISITION site: that is where
+        # "this lock intentionally covers blocking work" is decided, so
+        # that is where a fix (or a justified suppression) belongs
+        path, line = _site_of(held[-1].stack)
+        locks = ", ".join(h.label for h in held)
+        # dedup on the CALL KIND, not the full label: a variable
+        # backoff ("time.sleep(0.48)", "time.sleep(0.96)", ...) is ONE
+        # defect, and per-value keys would grow findings without bound
+        # in a long-lived instrumented server
+        kind = label.split("(")[0]
+        self._add_finding(
+            "GTS102", path, line,
+            f"blocking call {label} while holding {locks} stalls every "
+            "other waiter for the full blocking latency; move it "
+            "outside the lock\n    blocking call at:\n"
+            + _fmt_stack(stack)
+            + "\n    lock acquired at:\n" + _fmt_stack(held[-1].stack),
+            key=("GTS102", path, line, kind),
+        )
+
+    # ---- thread / executor lifecycle ---------------------------------
+    def register_thread(self, thread, stack: list[tuple[str, int, str]]):
+        tid = next(_WRAP_ID)
+        with self._mu:
+            self._threads[tid] = {
+                "ref": weakref.ref(thread), "stack": stack,
+                "joined": False, "name": thread.name,
+            }
+        return tid
+
+    def thread_joined(self, tid: int):
+        with self._mu:
+            info = self._threads.get(tid)
+            if info is not None:
+                info["joined"] = True
+
+    def register_executor(self, pool, stack: list[tuple[str, int, str]],
+                          *, shared: bool = False):
+        pid = next(_WRAP_ID)
+        info = {
+            "ref": None, "stack": stack,
+            "shutdown": False, "shared": shared,
+            # an executor COLLECTED without shutdown still leaks: its
+            # worker threads sit in the stdlib's detached queues until
+            # interpreter exit. The weakref callback records that.
+            "leaked_at_gc": False,
+        }
+
+        def _collected(_ref, info=info):
+            if not info["shutdown"]:
+                info["leaked_at_gc"] = True
+
+        info["ref"] = weakref.ref(pool, _collected)
+        with self._mu:
+            self._executors[pid] = info
+        return pid
+
+    def executor_shutdown(self, pid: int):
+        with self._mu:
+            info = self._executors.get(pid)
+            if info is not None:
+                info["shutdown"] = True
+
+    def lifecycle_token(self) -> int:
+        """Watermark: objects registered after this are 'new'."""
+        with self._mu:
+            keys = list(self._threads) + list(self._executors)
+        return max(keys, default=0)
+
+    def leak_findings(self, since: int = 0, *, record: bool = True
+                      ) -> list[dict]:
+        """GTS104/GTS105 findings for threads/pools registered after
+        `since` that are still live and unreleased. Called by the
+        pytest plugin at test teardown and session finish."""
+        out: list[dict] = []
+        with self._mu:
+            threads = [(k, dict(v)) for k, v in self._threads.items()
+                       if k > since]
+            pools = [(k, dict(v)) for k, v in self._executors.items()
+                     if k > since]
+        for _tid, info in threads:
+            t = info["ref"]()
+            if t is None or info["joined"] or t.daemon:
+                continue
+            if not t.is_alive():
+                continue
+            path, line = _site_of(info["stack"])
+            out.append({
+                "rule": "GTS104", "path": path, "line": line, "col": 0,
+                "message": f"non-daemon thread {info['name']!r} still "
+                           "alive and never joined — it can hang "
+                           "interpreter exit\n    created at:\n"
+                           + _fmt_stack(info["stack"]),
+            })
+        for _pid, info in pools:
+            if info["shutdown"] or info["shared"]:
+                continue
+            if info["ref"]() is None and not info["leaked_at_gc"]:
+                continue
+            path, line = _site_of(info["stack"])
+            out.append({
+                "rule": "GTS105", "path": path, "line": line, "col": 0,
+                "message": "ThreadPoolExecutor never shut down (and "
+                           "not marked shared=True) leaks its worker "
+                           "threads\n    created at:\n"
+                           + _fmt_stack(info["stack"]),
+            })
+        if record and out:
+            with self._mu:
+                for f in out:
+                    k = (f["rule"], f["path"], f["line"])
+                    if k not in self._finding_keys:
+                        self._finding_keys.add(k)
+                        self.findings.append(f)
+        return out
+
+    def snapshot_findings(self) -> list[dict]:
+        with self._mu:
+            return [dict(f) for f in self.findings]
+
+
+# ---- enable / disable scopes -----------------------------------------
+
+_active: list[Sanitizer] = []
+_env_enabled = False
+
+
+def current() -> Sanitizer | None:
+    return _active[-1] if _active else None
+
+
+def all_active() -> list[Sanitizer]:
+    """Every live scope, innermost last. Patched global blockers
+    (sleep/Flight/socket) notify each: held-lock stacks are per-scope
+    thread-locals, so only the scope whose locks this thread holds
+    produces a finding — nested pytester runs stay attributed."""
+    return list(_active)
+
+
+def is_active(san: Sanitizer | None) -> bool:
+    return san is not None and san in _active
+
+
+def enabled() -> bool:
+    return bool(_active)
+
+
+def enable(config: SanConfig | None = None) -> Sanitizer:
+    """Push a sanitizer scope and switch the concurrency facade to
+    instrumented factories. Returns the new scope (pass to
+    `disable`). Nested enables stack (pytester runs inside a
+    sanitized suite)."""
+    from greptimedb_tpu import concurrency
+    from greptimedb_tpu.tools.san import patch
+
+    san = Sanitizer(config)
+    _active.append(san)
+    concurrency._set_enabled(True)
+    patch.install()
+    return san
+
+
+def disable(san: Sanitizer | None = None):
+    """Pop a sanitizer scope (the given one, or the innermost)."""
+    from greptimedb_tpu import concurrency
+    from greptimedb_tpu.tools.san import patch
+
+    if san is None and _active:
+        _active.pop()
+    elif san in _active:
+        _active.remove(san)
+    if not _active:
+        patch.uninstall()
+        concurrency._set_enabled(False)
+
+
+def _env_truthy(val: str | None) -> bool:
+    return (val or "").strip().lower() in ("1", "true", "on", "yes")
+
+
+def ensure_enabled_from_env(env=None) -> Sanitizer | None:
+    """`GTPU_SAN=1` auto-enable: called once from the concurrency
+    facade on first use. Registers an atexit report writer when
+    `GTPU_SAN_REPORT` names a path (the `greptimedb-tpu san` driver
+    sets both)."""
+    global _env_enabled
+    env = os.environ if env is None else env
+    if _env_enabled or not _env_truthy(env.get("GTPU_SAN")):
+        return current()
+    _env_enabled = True
+    san = enable(SanConfig.from_env(env))
+    report_path = env.get("GTPU_SAN_REPORT")
+    if report_path:
+        import atexit
+
+        from greptimedb_tpu.tools.san.report import write_report
+
+        atexit.register(write_report, san, report_path)
+    return san

@@ -1,0 +1,95 @@
+"""gtsan blocking-call patches.
+
+Installed while at least one sanitizer scope is active; uninstalled
+when the last scope pops.  Each patch forwards to the real callable —
+only the held-lock check is added — so behavior is unchanged.
+
+Patched blockers:
+- `time.sleep` (yield-style sleeps under `sleep_min_s` are ignored)
+- Arrow Flight client calls: `do_get` / `do_put` / `do_action`
+- `socket.create_connection` (TCP connect latency)
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+from greptimedb_tpu.tools.san import core
+
+_real: dict = {}
+
+
+def _sleep(secs):
+    for san in core.all_active():
+        if secs >= san.cfg.sleep_min_s:
+            san.on_blocking(f"time.sleep({secs:g})", skip=2)
+    return _real["sleep"](secs)
+
+
+def _create_connection(*args, **kwargs):
+    for san in core.all_active():
+        san.on_blocking("socket.create_connection", skip=2)
+    return _real["create_connection"](*args, **kwargs)
+
+
+class _SanFlightClient:
+    """Proxy over a pyarrow FlightClient (the C type is immutable, so
+    methods cannot be patched in place): do_get/do_put/do_action gain
+    the held-lock check, everything else delegates."""
+
+    __slots__ = ("_inner",)
+
+    def __init__(self, inner):
+        object.__setattr__(self, "_inner", inner)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _blocking(self, label, *args, **kwargs):
+        for san in core.all_active():
+            san.on_blocking(label, skip=3)
+        return getattr(self._inner, label.split(".")[-1])(*args,
+                                                          **kwargs)
+
+    def do_get(self, *args, **kwargs):
+        return self._blocking("FlightClient.do_get", *args, **kwargs)
+
+    def do_put(self, *args, **kwargs):
+        return self._blocking("FlightClient.do_put", *args, **kwargs)
+
+    def do_action(self, *args, **kwargs):
+        return self._blocking("FlightClient.do_action", *args,
+                              **kwargs)
+
+
+def _connect(*args, **kwargs):
+    return _SanFlightClient(_real["flight.connect"](*args, **kwargs))
+
+
+def install():
+    if _real:
+        return          # nested scope: already installed
+    _real["sleep"] = time.sleep
+    time.sleep = _sleep
+    _real["create_connection"] = socket.create_connection
+    socket.create_connection = _create_connection
+    try:
+        import pyarrow.flight as flight
+    except ImportError:
+        return
+    _real["flight.connect"] = flight.connect
+    flight.connect = _connect
+
+
+def uninstall():
+    if not _real:
+        return
+    time.sleep = _real.pop("sleep")
+    socket.create_connection = _real.pop("create_connection")
+    real_connect = _real.pop("flight.connect", None)
+    if real_connect is not None:
+        import pyarrow.flight as flight
+
+        flight.connect = real_connect
+    _real.clear()

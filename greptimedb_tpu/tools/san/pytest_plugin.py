@@ -1,0 +1,98 @@
+"""gtsan pytest plugin: every tier-1 run is also a race/deadlock audit.
+
+Loaded by tests/conftest.py when `GTPU_SAN=1` (or explicitly with
+`-p greptimedb_tpu.tools.san.pytest_plugin`).  It
+
+- enables the sanitizer at configure time, before test modules import
+  the package (so module-level locks are instrumented too),
+- fails any test that leaks a non-daemon thread (GTS104) or an
+  un-shutdown ThreadPoolExecutor (GTS105) — checked after the test's
+  own fixture finalizers have run,
+- at session end renders every finding (cycles, blocking-under-lock,
+  hold-time, leaks) through the baseline/suppression machinery and
+  fails the session when unsuppressed findings remain.
+
+All state lives on the `config` object, NOT at module level: a nested
+pytest run (pytester, used by tests/test_san.py) shares this module
+object but gets its own config, so the inner session's sanitizer scope
+never clobbers the outer one.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from greptimedb_tpu.tools import san
+
+
+class SanLeakError(AssertionError):
+    """A test leaked a thread or pool (report in the message)."""
+
+
+def pytest_configure(config):
+    config._gtsan_scope = san.enable(san.SanConfig.from_env())
+    config._gtsan_token = 0
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_setup(item):
+    scope = getattr(item.config, "_gtsan_scope", None)
+    if scope is not None:
+        item.config._gtsan_token = scope.lifecycle_token()
+    yield
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item, nextitem):
+    # after yield, this test's function-scoped finalizers have run;
+    # anything still alive that the test created is a leak
+    yield
+    scope = getattr(item.config, "_gtsan_scope", None)
+    if scope is None:
+        return
+    leaks = scope.leak_findings(item.config._gtsan_token)
+    if leaks:
+        msg = "\n".join(
+            f"{f['rule']} {f['path']}:{f['line']}: {f['message']}"
+            for f in leaks
+        )
+        raise SanLeakError(
+            f"gtsan: {item.nodeid} leaked concurrency resources:\n"
+            + msg
+            + "\n(join the thread / shut the pool down before the "
+            "test ends; a resource owned by a longer-lived fixture "
+            "should be created eagerly in that fixture's setup, or "
+            "marked shared=True if intentionally process-wide)"
+        )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    scope = getattr(session.config, "_gtsan_scope", None)
+    if scope is None:
+        return
+    # session-scoped fixtures are already finalized here; a final
+    # whole-run sweep catches leaks attributed to no single test
+    scope.leak_findings(0)
+    doc = san.result_doc(scope.snapshot_findings())
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    write = tr.write_line if tr is not None else print
+    c = doc["counts"]
+    if doc["clean"]:
+        write(
+            f"gtsan: clean ({c['baselined']} baselined, "
+            f"{c['suppressed']} suppressed)"
+        )
+    else:
+        from greptimedb_tpu.tools.lint.report import render_text
+
+        for line in render_text(doc).splitlines():
+            write(line)
+        if scope.cfg.fail_on_cycle and session.exitstatus == 0:
+            session.exitstatus = 1
+
+
+def pytest_unconfigure(config):
+    scope = getattr(config, "_gtsan_scope", None)
+    if scope is not None:
+        san.disable(scope)
+        config._gtsan_scope = None

@@ -1,0 +1,126 @@
+"""gtsan reporting: reuses gtlint's reporters, baseline, suppressions.
+
+A sanitizer finding is shaped exactly like a lint finding (rule, path,
+line, col, message) with runtime stacks folded into the message, so:
+
+- `# gtlint: disable=GTS10x` comments at the *anchor line* (the
+  acquisition / creation site) suppress it,
+- `tools/san/baseline.json` grandfathers it with the same
+  rule+path+line-text matching as the lint baseline (line drift safe),
+- text/JSON rendering is `tools.lint.report.render_text/render_json`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from greptimedb_tpu.tools.lint.baseline import Baseline
+from greptimedb_tpu.tools.lint.core import Finding
+from greptimedb_tpu.tools.lint.suppress import Suppressions
+
+from greptimedb_tpu.tools.lint.runner import (
+    _REPO_ROOT,
+    _norm_path as norm_path,
+)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+
+def _source_lines(path: str, cache: dict) -> list[str]:
+    if path not in cache:
+        full = path if os.path.isabs(path) \
+            else os.path.join(_REPO_ROOT, path)
+        try:
+            with open(full, encoding="utf-8") as f:
+                cache[path] = f.read().splitlines()
+        except OSError:
+            cache[path] = []
+    return cache[path]
+
+
+def result_doc(findings: list[dict], *,
+               baseline_path: str | None = DEFAULT_BASELINE,
+               files_checked: int = 0) -> dict:
+    """Raw sanitizer findings -> the lint-shaped report document
+    (suppressions applied, baseline split, counts, `clean`)."""
+    cache: dict[str, list[str]] = {}
+    sup_cache: dict[str, Suppressions] = {}
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    for d in findings:
+        f = Finding(rule=d["rule"], path=d["path"], line=d["line"],
+                    col=d.get("col", 0), message=d["message"])
+        if f.path not in sup_cache:
+            sup_cache[f.path] = Suppressions(
+                "\n".join(_source_lines(f.path, cache)))
+        if sup_cache[f.path].covers(f.rule, f.line):
+            suppressed.append(f)
+        else:
+            active.append(f)
+
+    def line_text(path: str, lineno: int) -> str:
+        lines = _source_lines(path, cache)
+        return lines[lineno - 1].strip() if 1 <= lineno <= len(lines) \
+            else ""
+
+    baseline = Baseline.load(baseline_path) if baseline_path else \
+        Baseline([])
+    new, old, stale = baseline.split(active, line_text)
+    new.sort(key=lambda f: (f.path, f.line, f.rule))
+    return {
+        "findings": [f.to_doc() for f in new],
+        "baselined": [f.to_doc() for f in old],
+        "suppressed": [f.to_doc() for f in suppressed],
+        "stale_baseline": stale,
+        "errors": [],
+        "counts": {
+            "files": files_checked, "new": len(new),
+            "baselined": len(old), "suppressed": len(suppressed),
+            "stale_baseline": len(stale),
+        },
+        "clean": not new and not stale,
+    }
+
+
+def attach_exit_report(san):
+    """atexit hook for long-running processes enabled via the
+    `[sanitizer]` TOML section: render the findings to stderr at exit
+    so an instrumented server run is never a silent no-op."""
+    import atexit
+    import sys
+
+    def _render():
+        try:
+            san.leak_findings(0)
+            doc = result_doc(san.snapshot_findings())
+            from greptimedb_tpu.tools.lint.report import render_text
+
+            print("gtsan: " + ("clean"
+                               if doc["clean"] else "findings below"),
+                  file=sys.stderr)
+            if not doc["clean"]:
+                print(render_text(doc), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - exit path, keep going
+            print(f"gtsan: exit report failed: {e}", file=sys.stderr)
+
+    atexit.register(_render)
+
+
+def write_report(san, path: str):
+    """atexit hook for `greptimedb-tpu san -- <cmd>` child processes:
+    dump raw findings (leaks included) for the parent to render."""
+    try:
+        san.leak_findings(0)
+        doc = {"version": 1, "findings": san.snapshot_findings()}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+    except OSError:
+        pass
+
+
+def load_raw_report(path: str) -> list[dict]:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return list(doc.get("findings", []))

@@ -1,0 +1,99 @@
+"""gtsan CLI.
+
+    greptimedb-tpu san [options] -- <command ...>
+    python -m greptimedb_tpu.tools.san [options] -- <command ...>
+
+Runs `<command>` with the sanitizer enabled (GTPU_SAN=1 plus a
+GTPU_SAN_REPORT drop file), then renders the child's findings through
+the shared baseline/suppression machinery.  Exit status: the child's
+non-zero status wins; otherwise 1 when unsuppressed findings (or
+stale baseline entries) remain, 0 clean.
+
+    greptimedb-tpu san --report findings.json
+
+re-renders a previously captured raw report without running anything.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+from greptimedb_tpu.tools.lint.report import render_json, render_text
+from greptimedb_tpu.tools.san.report import (
+    DEFAULT_BASELINE,
+    load_raw_report,
+    result_doc,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gtsan",
+        description="cooperative concurrency sanitizer driver: run a "
+                    "command with GTPU_SAN=1 and report lock-order "
+                    "cycles, blocking-under-lock, hold-time, and "
+                    "thread/pool leaks.",
+    )
+    ap.add_argument("cmd", nargs="*",
+                    help="command to run (prefix with `--`)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--hold-time-ms", type=float, default=None,
+                    help="lock hold-time threshold (GTS103)")
+    ap.add_argument("--report", default=None,
+                    help="render an existing raw report file instead "
+                         "of running a command")
+    args = ap.parse_args(argv)
+
+    child_rc = 0
+    if args.report:
+        try:
+            findings = load_raw_report(args.report)
+        except (OSError, ValueError) as e:
+            print(f"gtsan: cannot read report {args.report}: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        if not args.cmd:
+            ap.error("no command given (greptimedb-tpu san -- <cmd>)")
+        fd, drop = tempfile.mkstemp(prefix="gtsan_", suffix=".json")
+        os.close(fd)
+        env = dict(os.environ)
+        env["GTPU_SAN"] = "1"
+        env["GTPU_SAN_REPORT"] = drop
+        if args.hold_time_ms is not None:
+            env["GTPU_SAN_HOLD_MS"] = str(args.hold_time_ms)
+        try:
+            child_rc = subprocess.call(args.cmd, env=env)
+            try:
+                findings = load_raw_report(drop)
+            except (OSError, ValueError):
+                # the report is written lazily from the child's first
+                # facade use: a child that never imported the package
+                # legitimately writes none
+                print("gtsan: child wrote no report (it never used "
+                      "greptimedb_tpu.concurrency, or crashed before "
+                      "exit handlers ran)", file=sys.stderr)
+                findings = []
+        finally:
+            try:
+                os.unlink(drop)
+            except OSError:
+                pass
+
+    doc = result_doc(
+        findings,
+        baseline_path=None if args.no_baseline else args.baseline,
+    )
+    print(render_json(doc) if args.format == "json"
+          else render_text(doc))
+    if child_rc != 0:
+        return child_rc
+    return 0 if doc["clean"] else 1

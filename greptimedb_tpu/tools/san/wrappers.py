@@ -1,0 +1,252 @@
+"""gtsan instrumented primitives.
+
+Each wrapper delegates to a raw stdlib object and reports acquire /
+release / wait / lifecycle events to the sanitizer scope that was
+active when the object was CREATED (nested scopes — a pytester run
+inside a sanitized suite — stay correctly attributed).  Once that
+scope is popped the wrapper keeps functioning, untracked.  The
+concurrency facade returns these only when the sanitizer is enabled;
+with it off the facade hands out raw stdlib objects and none of this
+code is on any path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from greptimedb_tpu.tools.san import core
+from greptimedb_tpu.tools.san.core import _capture_stack, _site_of
+
+_IDS = itertools.count(1)
+
+
+def _make_label(kind: str, name: str | None,
+                stack: list[tuple[str, int, str]]) -> str:
+    if name:
+        return f"{kind}({name})"
+    path, line = _site_of(stack)
+    return f"{kind}@{path}:{line}"
+
+
+class _LockBase:
+    """Shared acquire/release instrumentation for Lock and RLock.
+
+    The owning sanitizer is bound at CONSTRUCTION: during a nested
+    sanitizer scope (a pytester run inside a sanitized suite), outer
+    objects keep reporting to the outer scope and vice versa. Once the
+    owning scope is popped the wrapper keeps working, untracked."""
+
+    _kind = "Lock"
+
+    def __init__(self, name: str | None = None):
+        self._raw = self._make_raw()
+        self.gtsan_id = next(_IDS)
+        self.gtsan_label = _make_label(self._kind, name,
+                                       _capture_stack(2))
+        self._owner = core.current()
+
+    def _san(self):
+        owner = self._owner
+        return owner if core.is_active(owner) else None
+
+    def _make_raw(self):
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        san = self._san()
+        stack = None
+        if san is not None:
+            stack = _capture_stack(2)
+            if blocking:
+                san.before_acquire(self.gtsan_id, self.gtsan_label,
+                                   stack)
+        ok = self._raw.acquire(blocking, timeout)
+        if ok and san is not None:
+            san.after_acquired(self.gtsan_id, self.gtsan_label, stack)
+        return ok
+
+    def release(self):
+        san = self._san()
+        if san is not None:
+            san.on_release(self.gtsan_id)
+        self._raw.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._raw.locked()
+
+    def __repr__(self):
+        return f"<gtsan {self.gtsan_label} wrapping {self._raw!r}>"
+
+
+class SanLock(_LockBase):
+    _kind = "Lock"
+
+
+class SanRLock(_LockBase):
+    _kind = "RLock"
+
+    def _make_raw(self):
+        return threading.RLock()
+
+
+class SanCondition:
+    """Condition over an (instrumented) lock.  `with cv:` acquisitions
+    participate in the lock-order graph; `wait()` marks the lock
+    released for its duration — blocking while *another* instrumented
+    lock is held is reported, waiting on your own condvar is not."""
+
+    def __init__(self, lock: _LockBase | None = None,
+                 name: str | None = None):
+        if lock is None:
+            lock = SanRLock(name)
+        elif not isinstance(lock, _LockBase):
+            # a raw stdlib lock (created before the sanitizer was
+            # enabled): wrap it so tracking still works
+            wrapped = SanRLock.__new__(SanRLock)
+            wrapped._raw = lock
+            wrapped.gtsan_id = next(_IDS)
+            wrapped.gtsan_label = _make_label("RLock", name,
+                                              _capture_stack(2))
+            wrapped._owner = core.current()
+            lock = wrapped
+        self._slock = lock
+        # the stdlib Condition drives the RAW lock; our wrapper methods
+        # below do the tracking around it
+        self._raw = threading.Condition(lock._raw)
+
+    @property
+    def gtsan_id(self):
+        return self._slock.gtsan_id
+
+    @property
+    def gtsan_label(self):
+        return self._slock.gtsan_label
+
+    def acquire(self, *a, **kw):
+        return self._slock.acquire(*a, **kw)
+
+    def release(self):
+        self._slock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def wait(self, timeout: float | None = None):
+        san = self._slock._san()
+        entry = None
+        if san is not None:
+            entry = san.wait_begin(self.gtsan_id)
+            # waiting on this cv while holding OTHER locks blocks them
+            san.on_blocking(f"{self.gtsan_label}.wait()", skip=2)
+        try:
+            return self._raw.wait(timeout)
+        finally:
+            if san is not None:
+                san.wait_end(entry)
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        # stdlib logic, re-expressed over self.wait so waits are tracked
+        import time as _time
+
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = _time.monotonic() + timeout
+                waittime = endtime - _time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1):
+        self._raw.notify(n)
+
+    def notify_all(self):
+        self._raw.notify_all()
+
+
+class SanEvent:
+    """Event whose blocking wait() is visible to the sanitizer (an
+    event wait while holding an instrumented lock is a stall)."""
+
+    def __init__(self):
+        self._raw = threading.Event()
+        self._owner = core.current()
+
+    def is_set(self):
+        return self._raw.is_set()
+
+    def set(self):
+        self._raw.set()
+
+    def clear(self):
+        self._raw.clear()
+
+    def wait(self, timeout: float | None = None):
+        owner = self._owner
+        san = owner if core.is_active(owner) else None
+        if san is not None and (timeout is None
+                                or timeout >= san.cfg.sleep_min_s):
+            san.on_blocking(
+                f"Event.wait({'' if timeout is None else timeout})",
+                skip=2)
+        return self._raw.wait(timeout)
+
+
+class SanThread(threading.Thread):
+    """Thread registered with the sanitizer's lifecycle registry; the
+    pytest plugin fails tests that leave one alive, non-daemon, and
+    unjoined."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._gtsan_tid = None
+        self._gtsan_owner = core.current()
+        if self._gtsan_owner is not None:
+            self._gtsan_tid = self._gtsan_owner.register_thread(
+                self, _capture_stack(2))
+
+    def join(self, timeout: float | None = None):
+        super().join(timeout)
+        if not self.is_alive() and self._gtsan_tid is not None:
+            self._gtsan_owner.thread_joined(self._gtsan_tid)
+
+
+class SanThreadPoolExecutor(ThreadPoolExecutor):
+    """Executor registered with the lifecycle registry. Pass
+    `shared=True` through the facade for intentionally process-wide
+    pools (module-level singletons) that are exempt from the
+    un-shutdown-pool check."""
+
+    def __init__(self, *args, shared: bool = False, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._gtsan_pid = None
+        self._gtsan_owner = core.current()
+        if self._gtsan_owner is not None:
+            self._gtsan_pid = self._gtsan_owner.register_executor(
+                self, _capture_stack(2), shared=shared)
+
+    def shutdown(self, *args, **kwargs):
+        if self._gtsan_pid is not None:
+            self._gtsan_owner.executor_shutdown(self._gtsan_pid)
+        return super().shutdown(*args, **kwargs)

@@ -8,6 +8,14 @@ required to get CPU here.
 
 import os
 
+# GTPU_SAN=1 turns every run into a race/deadlock audit: the gtsan
+# plugin enables the concurrency sanitizer before test modules import
+# the package, fails tests that leak threads/pools, and reports
+# lock-order cycles + blocking-under-lock at session end
+if (os.environ.get("GTPU_SAN") or "").strip().lower() in (
+        "1", "true", "on", "yes"):
+    pytest_plugins = ["greptimedb_tpu.tools.san.pytest_plugin"]
+
 os.environ.setdefault("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
     os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"

@@ -33,9 +33,9 @@ def rules_hit(src: str, select: str | None = None):
     return [(f.rule, f.line) for f in act]
 
 
-def test_registry_has_all_ten_rules():
+def test_registry_has_all_rules():
     ids = sorted(all_rules())
-    assert ids == [f"GT{n:03d}" for n in range(1, 11)]
+    assert ids == [f"GT{n:03d}" for n in range(1, 12)]
     for rule in all_rules().values():
         assert rule.name and rule.description
 
@@ -691,6 +691,290 @@ def test_lint_source_on_every_rule_doc():
     assert rules["GT001"].name == "silent-exception-swallow"
     assert rules["GT007"].name == "lock-across-blocking-io"
     assert rules["GT009"].name == "int64-on-device"
+    assert rules["GT011"].name == "wallclock-duration"
+
+
+# ---------------------------------------------------------------------------
+# GT007 interprocedural: blocking taint through module-local helpers
+# ---------------------------------------------------------------------------
+
+def test_gt007_interproc_two_calls_deep():
+    """lock -> helper -> helper -> do_put fires, with the chain."""
+    act, _ = run_lint("""
+        import threading
+
+        lock = threading.Lock()
+
+        class Sender:
+            def _wire(self, batch):
+                writer, reader = self.client.do_put(batch)
+
+            def _send(self, batch):
+                return self._wire(batch)
+
+            def submit(self, batch):
+                with lock:
+                    self._send(batch)
+    """)
+    hits = [(f.rule, f.line) for f in act]
+    assert ("GT007", 15) in hits, hits
+    msg = [f.message for f in act if f.line == 15][0]
+    assert "Sender._send" in msg and "do_put" in msg
+
+
+def test_gt007_interproc_module_function_one_deep():
+    act, _ = run_lint("""
+        import threading
+        import time
+
+        lock = threading.Lock()
+
+        def backoff():
+            time.sleep(0.5)
+
+        def retry():
+            with lock:
+                backoff()
+    """)
+    hits = [(f.rule, f.line) for f in act]
+    assert ("GT007", 12) in hits, hits
+
+
+def test_gt007_interproc_negative_clean_helper_and_async_def():
+    # a helper with no blocking op, and a nested def handed to a
+    # thread (runs asynchronously), must not taint the caller
+    assert rules_hit("""
+        import threading
+        import time
+
+        lock = threading.Lock()
+
+        def compute():
+            return 2 + 2
+
+        def submit():
+            def worker():
+                time.sleep(5)
+            t = threading.Thread(target=worker, daemon=True)
+            with lock:
+                compute()
+            t.start()
+    """) == []
+
+
+def test_gt007_interproc_negative_helper_called_outside_lock():
+    assert rules_hit("""
+        import threading
+        import time
+
+        lock = threading.Lock()
+
+        def backoff():
+            time.sleep(0.5)
+
+        def retry():
+            with lock:
+                x = 1
+            backoff()
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# GT004 interprocedural: host-sync taint through helpers in jit
+# ---------------------------------------------------------------------------
+
+def test_gt004_interproc_helper_item_on_traced_arg():
+    act, _ = run_lint("""
+        import jax
+
+        def total(v):
+            return v.sum().item()
+
+        @jax.jit
+        def kernel(x):
+            return total(x)
+    """)
+    hits = [(f.rule, f.line) for f in act]
+    assert ("GT004", 9) in hits, hits
+    msg = [f.message for f in act if f.line == 9][0]
+    assert "total" in msg and ".item()" in msg
+
+
+def test_gt004_interproc_negative_static_arg_and_host_caller():
+    # helper called on a NON-traced value, and the same helper called
+    # from plain host code, both stay clean
+    assert rules_hit("""
+        import functools
+
+        import jax
+
+        def total(v):
+            return v.sum().item()
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def kernel(x, n):
+            return x * total(n)
+
+        def host(y):
+            return total(y)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# GT011 wall-clock duration arithmetic
+# ---------------------------------------------------------------------------
+
+def test_gt011_positive_inline_and_named():
+    hits = rules_hit("""
+        import time
+
+        def f(start):
+            return time.time() - start
+    """)
+    assert ("GT011", 5) in hits
+
+    hits = rules_hit("""
+        import time
+
+        def g(lease_s):
+            now = time.time()
+            deadline = now + lease_s
+            return deadline
+    """)
+    assert ("GT011", 6) in hits
+
+
+def test_gt011_positive_duration_then_ms_conversion():
+    # (time.time() - t0) * 1000 is interval math, NOT the exempt
+    # epoch-ms constructor
+    hits = rules_hit("""
+        import time
+
+        def f(t0):
+            return (time.time() - t0) * 1000
+    """)
+    assert ("GT011", 5) in hits
+
+
+def test_gt011_negative_epoch_ms_and_monotonic():
+    # the epoch-ms DATA-timestamp constructor is exempt, either order
+    assert rules_hit("""
+        import time
+
+        def stamp(ttl_ms):
+            return int(time.time() * 1000) - ttl_ms
+
+        def stamp2():
+            now_ms = int(1000 * time.time())
+            return now_ms + 3
+    """) == []
+    # monotonic interval math is the fix, not a finding
+    assert rules_hit("""
+        import time
+
+        def f(start):
+            return time.monotonic() - start
+    """) == []
+    # bare timestamps without arithmetic are fine
+    assert rules_hit("""
+        import time
+
+        def g():
+            return {"created": time.time()}
+    """) == []
+    # name tracking is scoped per function: a wall-clock `now` in one
+    # function must not poison a monotonic `now` elsewhere
+    assert rules_hit("""
+        import time
+
+        def stamp():
+            now = time.time()
+            return {"created": now}
+
+        def elapsed(t0):
+            now = time.monotonic()
+            return now - t0
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# --changed mode
+# ---------------------------------------------------------------------------
+
+def test_changed_mode_lints_only_differing_files(tmp_path):
+    """In a fresh git repo: clean committed file + dirty violating
+    file; --changed HEAD flags only the dirty one."""
+    import os
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(
+            ["git", *args], cwd=repo, check=True, capture_output=True,
+            env={**os.environ, "GIT_AUTHOR_NAME": "t",
+                 "GIT_AUTHOR_EMAIL": "t@t", "GIT_COMMITTER_NAME": "t",
+                 "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    git("init", "-q")
+    clean = repo / "clean.py"
+    clean.write_text("try:\n    x = 1\nexcept Exception:\n    pass\n")
+    dirty = repo / "dirty.py"
+    dirty.write_text("x = 1\n")
+    git("add", "-A")
+    git("commit", "-q", "-m", "seed")
+    # clean.py keeps its committed violation (must NOT be relinted);
+    # dirty.py gains one (must be flagged); untracked.py is new
+    dirty.write_text("try:\n    x = 1\nexcept Exception:\n    pass\n")
+    untracked = repo / "untracked.py"
+    untracked.write_text("def f(xs=[]):\n    return xs\n")
+
+    from greptimedb_tpu.tools.lint import runner
+
+    old_root = runner._REPO_ROOT
+    runner._REPO_ROOT = str(repo)
+    try:
+        only = runner.changed_files("HEAD")
+        assert only == {str(dirty), str(untracked)}
+        res = runner.lint_paths([str(repo)], only=only)
+    finally:
+        runner._REPO_ROOT = old_root
+    flagged = {d["path"].rsplit("/", 1)[-1] for d in res["findings"]}
+    assert "dirty.py" in flagged and "untracked.py" in flagged
+    assert "clean.py" not in flagged
+    assert res["counts"]["files"] == 2
+
+
+def test_changed_mode_cli_unknown_ref_exits_2(tmp_path):
+    from greptimedb_tpu.tools.lint.runner import main as lint_main
+
+    rc = lint_main(["--changed", "no-such-ref-xyz", str(tmp_path)])
+    assert rc == 2
+
+
+def test_changed_run_does_not_report_foreign_stale(tmp_path):
+    """A --changed run must not mark baseline entries for UNSCANNED
+    files as stale; a normal (full) run still must — that is how
+    entries for DELETED files get flushed out."""
+    import os
+
+    target = tmp_path / "a.py"
+    target.write_text("x = 1\n")
+    base = Baseline([{
+        "rule": "GT001", "path": "elsewhere/b.py", "line": 3,
+        "text": "except Exception:",
+    }])
+    # --changed semantics: `only` restricts the walk, foreign entries
+    # are out of scope
+    res = lint_paths([str(target)], baseline=base,
+                     only={os.path.normpath(str(target))})
+    assert res["stale_baseline"] == []
+    assert res["clean"]
+    # full-run semantics: the unmatched entry is stale (deleted file)
+    res = lint_paths([str(target)], baseline=base)
+    assert len(res["stale_baseline"]) == 1
+    assert not res["clean"]
 
 
 if __name__ == "__main__":

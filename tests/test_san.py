@@ -1,0 +1,465 @@
+"""gtsan (cooperative concurrency sanitizer) fixtures.
+
+Every detector has a deterministic positive fixture that never
+actually deadlocks or hangs the test process, and a negative fixture
+(correctly ordered locks, joined threads, shut-down pools) that stays
+clean.  The off path is pinned: with the sanitizer disabled the
+concurrency facade returns raw stdlib objects — no wrapper frames.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from greptimedb_tpu import concurrency as C
+from greptimedb_tpu.tools import san
+
+pytest_plugins = ["pytester"]
+
+
+@pytest.fixture()
+def scope():
+    s = san.enable(san.SanConfig(hold_time_ms=60.0))
+    yield s
+    san.disable(s)
+
+
+def _run_threads(*fns):
+    """Run each fn on its own (sequential) thread so lock orders are
+    observed per-thread without any real contention."""
+    for fn in fns:
+        t = C.Thread(target=fn, daemon=True)
+        t.start()
+        t.join(10)
+        assert not t.is_alive()
+
+
+def rules_of(scope):
+    return [f["rule"] for f in scope.snapshot_findings()]
+
+
+# ---------------------------------------------------------------------------
+# off path: raw stdlib objects, zero wrapper frames
+# ---------------------------------------------------------------------------
+
+def test_facade_off_returns_raw_stdlib_objects():
+    if san.enabled():
+        pytest.skip("sanitizer is enabled suite-wide (GTPU_SAN=1); "
+                    "the off path is covered by the plain tier-1 run")
+    assert type(C.Lock()) is type(threading.Lock())
+    assert type(C.RLock()) is type(threading.RLock())
+    assert type(C.Condition()) is threading.Condition
+    assert type(C.Event()) is threading.Event
+    assert type(C.Thread(target=lambda: None)) is threading.Thread
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = C.ThreadPoolExecutor(max_workers=1)
+    try:
+        assert type(pool) is ThreadPoolExecutor
+    finally:
+        pool.shutdown()
+
+
+def test_facade_on_returns_wrappers_and_restores():
+    was_on = san.enabled()
+    s = san.enable()
+    try:
+        from greptimedb_tpu.tools.san.wrappers import SanLock
+
+        assert isinstance(C.Lock(), SanLock)
+    finally:
+        san.disable(s)
+    if was_on:
+        # an outer suite-wide scope (GTPU_SAN=1) remains active
+        assert san.enabled()
+    else:
+        assert type(C.Lock()) is type(threading.Lock())
+
+
+# ---------------------------------------------------------------------------
+# GTS101 lock-order cycles
+# ---------------------------------------------------------------------------
+
+def test_abba_cycle_detected_with_both_stacks(scope):
+    A = C.Lock(name="A")
+    B = C.Lock(name="B")
+
+    def ab():
+        with A:
+            with B:
+                pass
+
+    def ba():
+        with B:
+            with A:
+                pass
+
+    _run_threads(ab, ba)
+    cycles = [f for f in scope.snapshot_findings()
+              if f["rule"] == "GTS101"]
+    assert len(cycles) == 1
+    msg = cycles[0]["message"]
+    assert "Lock(A)" in msg and "Lock(B)" in msg
+    # BOTH acquisition stacks, ABBA style: this thread's and the
+    # reverse direction recorded earlier
+    assert "in ba" in msg and "in ab" in msg
+    assert msg.count("acquired") >= 2
+    # the report anchors at a real source location in THIS file
+    assert cycles[0]["path"].endswith("test_san.py")
+    assert cycles[0]["line"] > 0
+
+
+def test_three_lock_cycle_detected(scope):
+    A, B, X = (C.Lock(name="A3"), C.Lock(name="B3"), C.Lock(name="C3"))
+
+    def ab():
+        with A:
+            with B:
+                pass
+
+    def bc():
+        with B:
+            with X:
+                pass
+
+    def ca():
+        with X:
+            with A:
+                pass
+
+    _run_threads(ab, bc, ca)
+    cycles = [f for f in scope.snapshot_findings()
+              if f["rule"] == "GTS101"]
+    assert len(cycles) == 1
+    assert all(k in cycles[0]["message"]
+               for k in ("Lock(A3)", "Lock(B3)", "Lock(C3)"))
+
+
+def test_consistent_order_and_reentrant_rlock_stay_clean(scope):
+    A = C.Lock(name="An")
+    B = C.Lock(name="Bn")
+    R = C.RLock(name="Rn")
+
+    def ordered():
+        for _ in range(3):
+            with A:
+                with B:
+                    pass
+
+    def reentrant():
+        with R:
+            with R:     # same lock re-entered: not a cycle edge
+                pass
+
+    _run_threads(ordered, reentrant, ordered)
+    assert rules_of(scope) == []
+
+
+# ---------------------------------------------------------------------------
+# GTS102 blocking under lock
+# ---------------------------------------------------------------------------
+
+def test_sleep_under_lock_flagged_and_anchored_at_acquisition(scope):
+    L = C.Lock(name="SleepLock")
+    with L:
+        time.sleep(0.005)
+    hits = [f for f in scope.snapshot_findings()
+            if f["rule"] == "GTS102"]
+    assert len(hits) == 1
+    assert "time.sleep" in hits[0]["message"]
+    assert "SleepLock" in hits[0]["message"]
+    assert hits[0]["path"].endswith("test_san.py")
+
+
+def test_cv_wait_holding_other_lock_flagged_own_lock_exempt(scope):
+    other = C.Lock(name="Other")
+    cv = C.Condition(name="CV")
+
+    # waiting on your own condvar releases it: clean
+    with cv:
+        cv.wait(0.01)
+    assert rules_of(scope) == []
+
+    # waiting while ANOTHER lock is held blocks its waiters
+    with other:
+        with cv:
+            cv.wait(0.01)
+    hits = [f for f in scope.snapshot_findings()
+            if f["rule"] == "GTS102"]
+    assert len(hits) == 1
+    assert "Other" in hits[0]["message"]
+
+
+def test_event_wait_and_short_sleep_negatives(scope):
+    ev = C.Event()
+    ev.set()
+    L = C.Lock(name="NegL")
+    with L:
+        ev.wait(0.0005)      # under sleep_min_s: yield-style, clean
+        time.sleep(0.0001)
+    time.sleep(0.005)        # no lock held: clean
+    assert rules_of(scope) == []
+
+
+# ---------------------------------------------------------------------------
+# GTS103 hold time
+# ---------------------------------------------------------------------------
+
+def test_hold_time_threshold(scope):
+    L = C.Lock(name="Slow")
+    with L:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 0.1:   # spin: no blocking call
+            pass
+    hits = [f for f in scope.snapshot_findings()
+            if f["rule"] == "GTS103"]
+    assert len(hits) == 1
+    assert "Slow" in hits[0]["message"]
+
+    # a fast critical section stays clean
+    with C.Lock(name="Fast"):
+        pass
+    assert len([f for f in scope.snapshot_findings()
+                if f["rule"] == "GTS103"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# GTS104/GTS105 lifecycle leaks
+# ---------------------------------------------------------------------------
+
+def test_leaked_thread_and_pool_detected_then_cleared(scope):
+    token = scope.lifecycle_token()
+    release = threading.Event()
+    t = C.Thread(target=release.wait)        # non-daemon, unjoined
+    t.start()
+    pool = C.ThreadPoolExecutor(max_workers=1)
+    leaks = scope.leak_findings(token, record=False)
+    assert sorted(f["rule"] for f in leaks) == ["GTS104", "GTS105"]
+    assert all(f["path"].endswith("test_san.py") for f in leaks)
+
+    release.set()
+    t.join()
+    pool.shutdown()
+    assert scope.leak_findings(token, record=False) == []
+
+
+def test_daemon_joined_and_shared_are_not_leaks(scope):
+    token = scope.lifecycle_token()
+    d = C.Thread(target=lambda: time.sleep(0.01), daemon=True)
+    d.start()                                # daemon: exempt
+    j = C.Thread(target=lambda: None)
+    j.start()
+    j.join()                                 # joined: exempt
+    with C.ThreadPoolExecutor(max_workers=1) as pool:
+        pool.submit(lambda: None).result()   # ctx manager: shutdown
+    shared = C.ThreadPoolExecutor(max_workers=1, shared=True)
+    try:
+        assert scope.leak_findings(token, record=False) == []
+    finally:
+        shared.shutdown()
+        d.join(5)
+
+
+# ---------------------------------------------------------------------------
+# pytest plugin: leaking tests FAIL
+# ---------------------------------------------------------------------------
+
+_PLUGIN = "greptimedb_tpu.tools.san.pytest_plugin"
+
+
+def test_plugin_fails_leaked_thread_test(pytester):
+    pytester.makepyfile("""
+        import threading
+
+        from greptimedb_tpu import concurrency as C
+
+        release = threading.Event()
+
+        def test_leaks_a_thread():
+            t = C.Thread(target=release.wait,
+                         name="leaky-fixture-thread")
+            t.start()
+
+        def test_cleanup():
+            release.set()
+    """)
+    result = pytester.runpytest_inprocess("-p", _PLUGIN, "-q")
+    outcomes = result.parseoutcomes()
+    assert outcomes.get("errors", 0) >= 1
+    result.stdout.fnmatch_lines(["*GTS104*leaky-fixture-thread*"])
+
+
+def test_plugin_fails_unshutdown_pool_test(pytester):
+    pytester.makepyfile("""
+        from greptimedb_tpu import concurrency as C
+
+        def test_leaks_a_pool():
+            pool = C.ThreadPoolExecutor(max_workers=1)
+            pool.submit(lambda: None).result()
+    """)
+    result = pytester.runpytest_inprocess("-p", _PLUGIN, "-q")
+    assert result.parseoutcomes().get("errors", 0) >= 1
+    result.stdout.fnmatch_lines(["*GTS105*"])
+
+
+def test_plugin_clean_suite_passes_and_reports_clean(pytester):
+    pytester.makepyfile("""
+        from greptimedb_tpu import concurrency as C
+
+        def test_tidy():
+            t = C.Thread(target=lambda: None)
+            t.start()
+            t.join()
+            with C.ThreadPoolExecutor(max_workers=1) as pool:
+                pool.submit(lambda: None).result()
+            with C.Lock(name="x"):
+                pass
+    """)
+    result = pytester.runpytest_inprocess("-p", _PLUGIN, "-q")
+    result.assert_outcomes(passed=1)
+    assert result.ret == 0
+    result.stdout.fnmatch_lines(["*gtsan: clean*"])
+
+
+def test_plugin_session_fails_on_cycle_findings(pytester):
+    pytester.makepyfile("""
+        from greptimedb_tpu import concurrency as C
+
+        def test_abba():
+            A = C.Lock(name="pA")
+            B = C.Lock(name="pB")
+
+            def ab():
+                with A:
+                    with B: pass
+
+            def ba():
+                with B:
+                    with A: pass
+
+            for fn in (ab, ba):
+                t = C.Thread(target=fn, daemon=True)
+                t.start(); t.join()
+    """)
+    result = pytester.runpytest_inprocess("-p", _PLUGIN, "-q")
+    result.assert_outcomes(passed=1)     # the test itself passes...
+    assert result.ret == 1               # ...the session does not
+    result.stdout.fnmatch_lines(["*GTS101*"])
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline round-trip (shared gtlint machinery)
+# ---------------------------------------------------------------------------
+
+def _fake_finding(path, line):
+    return {"rule": "GTS102", "path": str(path), "line": line, "col": 0,
+            "message": "blocking call time.sleep(1) while holding X"}
+
+
+def test_suppression_comment_covers_san_finding(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "def f(lock):\n"
+        "    with lock:  # gtlint: disable=GTS102\n"
+        "        pass\n"
+    )
+    doc = san.result_doc([_fake_finding(src, 2)], baseline_path=None)
+    assert doc["clean"]
+    assert doc["counts"]["suppressed"] == 1
+    # the wrong id does NOT cover
+    src.write_text(
+        "def f(lock):\n"
+        "    with lock:  # gtlint: disable=GTS101\n"
+        "        pass\n"
+    )
+    doc = san.result_doc([_fake_finding(src, 2)], baseline_path=None)
+    assert not doc["clean"]
+    assert doc["counts"]["new"] == 1
+
+
+def test_baseline_round_trip_and_stale(tmp_path):
+    from greptimedb_tpu.tools.lint import Baseline
+
+    src = tmp_path / "mod.py"
+    src.write_text("def f(lock):\n    with lock:\n        pass\n")
+    finding = _fake_finding(src, 2)
+
+    base_path = tmp_path / "san_baseline.json"
+    Baseline([{"rule": "GTS102", "path": str(src), "line": 2,
+               "text": "with lock:"}]).save(str(base_path))
+    doc = san.result_doc([finding], baseline_path=str(base_path))
+    assert doc["clean"]
+    assert doc["counts"]["baselined"] == 1
+
+    # violation gone -> the entry is stale and fails the run
+    doc = san.result_doc([], baseline_path=str(base_path))
+    assert not doc["clean"]
+    assert doc["counts"]["stale_baseline"] == 1
+
+
+def test_checked_in_san_baseline_is_empty():
+    from greptimedb_tpu.tools.lint import Baseline
+    from greptimedb_tpu.tools.san.report import DEFAULT_BASELINE
+
+    assert Baseline.load(DEFAULT_BASELINE).entries == []
+
+
+# ---------------------------------------------------------------------------
+# CLI driver
+# ---------------------------------------------------------------------------
+
+def test_san_cli_reports_child_findings_and_exit_code(tmp_path):
+    import subprocess
+    import sys
+
+    demo = tmp_path / "abba.py"
+    demo.write_text(
+        "from greptimedb_tpu import concurrency as C\n"
+        "A = C.Lock(name='cliA')\n"
+        "B = C.Lock(name='cliB')\n"
+        "def ab():\n"
+        "    with A:\n"
+        "        with B: pass\n"
+        "def ba():\n"
+        "    with B:\n"
+        "        with A: pass\n"
+        "for fn in (ab, ba):\n"
+        "    t = C.Thread(target=fn, daemon=True)\n"
+        "    t.start(); t.join()\n"
+    )
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
+    env.pop("GTPU_SAN", None)
+    p = subprocess.run(
+        [sys.executable, "-m", "greptimedb_tpu.tools.san",
+         "--no-baseline", "--", sys.executable, str(demo)],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=120,
+    )
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "GTS101" in p.stdout
+    assert "cliA" in p.stdout and "cliB" in p.stdout
+
+    # a clean child exits 0
+    clean = tmp_path / "clean.py"
+    clean.write_text(
+        "from greptimedb_tpu import concurrency as C\n"
+        "with C.Lock(name='only'):\n"
+        "    pass\n"
+    )
+    p = subprocess.run(
+        [sys.executable, "-m", "greptimedb_tpu.tools.san",
+         "--no-baseline", "--", sys.executable, str(clean)],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=120,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))
