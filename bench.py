@@ -210,6 +210,10 @@ def main():
                     round(p["first_query_s"] * 1000.0, 1)
                     for p in attempts
                 ],
+                # per-stage recovery breakdown (manifest/wal/sst ms,
+                # prefetch depth + parallelism used) so the opaque
+                # restore cost is attributable
+                "recovery": probe.get("recovery"),
             })
         except Exception as e:  # cold start is additive: never mask phase 1
             print(f"# cold-start probe failed: {e}", file=sys.stderr)
@@ -273,17 +277,255 @@ def _emit_ordered(lines: list[str], cold_line: str | None):
         for m, d in by_metric.items() if m
     }
     for m, d in by_metric.items():
-        # the dist metric's stage breakdown + scan-cache counters must
-        # survive even a tail capture that only keeps the final line
+        # the dist metric's stage breakdown + scan-cache counters and
+        # the cold-start recovery breakdown must survive even a tail
+        # capture that only keeps the final line
         if m and "stages" in d:
             summary[m]["stages"] = d["stages"]
             summary[m]["scan_cache"] = d.get("scan_cache")
+        if m and d.get("recovery") is not None:
+            summary[m]["recovery"] = d["recovery"]
     head = by_metric.get(_HEADLINE)
     # the driver parses the LAST line: headline fields stay at the top
     # level, the full metric set rides in `summary`
     final = dict(head) if head is not None else {"metric": "bench_summary"}
     final["summary"] = summary
     print(json.dumps(final, separators=(",", ":")))
+
+
+# ----------------------------------------------------------------------
+# recovery dataplane probe (`python bench.py cold_start <dir>`): times a
+# multi-region storage recovery (manifest load + WAL replay + pipelined
+# SST restore) through the parallel dataplane vs the fully serial path
+# on the SAME data, over a store with simulated object-store latency
+# (the deployment shape the dataplane exists for), then proves WAL
+# truncation: the cold start after a recovery flush replays nothing.
+# ----------------------------------------------------------------------
+
+_REC_REGIONS = 8
+_REC_SSTS_PER_REGION = 6
+_REC_ROWS_PER_SST = 20_000
+_REC_TAIL_BATCHES = 3          # unflushed writes left in the WAL
+_REC_GET_LATENCY_S = 0.025     # simulated per-GET first-byte latency
+_REC_BANDWIDTH_MBPS = 200.0    # simulated GET throughput
+
+
+class _SimRemoteStore:
+    """ObjectStore wrapper adding S3-shaped read latency (per-op
+    first-byte delay + bandwidth-bound transfer). Writes/deletes pass
+    through untouched — only the recovery READ path is being modeled."""
+
+    def __init__(self, inner, get_latency_s=_REC_GET_LATENCY_S,
+                 bandwidth_mbps=_REC_BANDWIDTH_MBPS):
+        self.inner = inner
+        self.get_latency_s = get_latency_s
+        self.bandwidth = bandwidth_mbps * 1e6
+
+    def _delay(self, nbytes: int = 0):
+        time.sleep(self.get_latency_s + nbytes / self.bandwidth)
+
+    def read(self, path):
+        data = self.inner.read(path)
+        self._delay(len(data))
+        return data
+
+    def read_range(self, path, offset, length):
+        data = self.inner.read_range(path, offset, length)
+        self._delay(len(data))
+        return data
+
+    def exists(self, path):
+        self._delay()
+        return self.inner.exists(path)
+
+    def list(self, prefix):
+        self._delay()
+        return self.inner.list(prefix)
+
+    def write(self, path, data):
+        return self.inner.write(path, data)
+
+    def delete(self, path):
+        return self.inner.delete(path)
+
+    def local_path(self, path):
+        raise NotImplementedError("simulated remote store")
+
+    def local_read_path(self, path):
+        raise NotImplementedError("simulated remote store")
+
+
+def _recovery_metas():
+    from greptimedb_tpu.storage.region import RegionMetadata
+
+    return [
+        RegionMetadata(region_id=100 + i, table="rec", tag_names=["host"],
+                       field_names=["a", "b"], ts_name="ts")
+        for i in range(_REC_REGIONS)
+    ]
+
+
+def _recovery_generate(root: str):
+    """Deterministic multi-region dataset: K flushed SSTs per region
+    plus an unflushed WAL tail, ending in a simulated crash (WAL file
+    handles closed, no flush)."""
+    from greptimedb_tpu.storage.engine import EngineConfig, TsdbEngine
+    from greptimedb_tpu.storage.recovery import RecoveryOptions
+
+    eng = TsdbEngine(EngineConfig(
+        data_root=root, enable_background=False,
+        recovery=RecoveryOptions(flush_after_replay=False),
+    ))
+    rng = np.random.default_rng(31)
+    total_bytes = 0
+    for meta in _recovery_metas():
+        region = eng.create_region(meta)
+        for _s in range(_REC_SSTS_PER_REGION):
+            n = _REC_ROWS_PER_SST
+            region.write(
+                {"host": np.asarray(
+                    [f"h{i % 64}" for i in range(n)], object)},
+                np.arange(n, dtype=np.int64) * 1000,
+                {"a": rng.random(n), "b": rng.random(n)},
+            )
+            region.flush()
+        for _t in range(_REC_TAIL_BATCHES):
+            n = 2000
+            region.write(
+                {"host": np.asarray(
+                    [f"h{i % 64}" for i in range(n)], object)},
+                np.arange(n, dtype=np.int64) * 1000,
+                {"a": rng.random(n), "b": rng.random(n)},
+            )
+        total_bytes += sum(
+            m.size_bytes for m in region.manifest.state.ssts
+        )
+        region.wal.close()  # crash: handles closed, tail unflushed
+    return total_bytes
+
+
+def _recovery_open(root: str, *, parallelism, prefetch_depth,
+                   simulate_remote: bool):
+    """One measured recovery: open every region (restore on, recovery
+    flush off so runs stay comparable). Returns (wall_ms, stage_deltas,
+    replayed_entries)."""
+    from greptimedb_tpu.storage import recovery as R
+    from greptimedb_tpu.storage.engine import EngineConfig, TsdbEngine
+    from greptimedb_tpu.storage.object_store import FsObjectStore
+    from greptimedb_tpu.storage.page_cache import global_page_cache
+
+    global_page_cache.clear()
+    store = FsObjectStore(root)
+    if simulate_remote:
+        store = _SimRemoteStore(store)
+    eng = TsdbEngine(
+        EngineConfig(
+            data_root=root, enable_background=False,
+            recovery=R.RecoveryOptions(
+                open_parallelism=parallelism,
+                sst_prefetch_depth=prefetch_depth,
+                flush_after_replay=False,
+            ),
+        ),
+        store=store,
+    )
+    before = R.stage_totals()
+    t0 = time.perf_counter()
+    regions = eng.open_regions(_recovery_metas(), restore=True)
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+    after = R.stage_totals()
+    stages = {
+        k: round(after.get(k, 0.0) - before.get(k, 0.0), 1)
+        for k in sorted(after)
+        if after.get(k, 0.0) - before.get(k, 0.0) > 0.0
+    }
+    replayed = sum(r.recovery_stats["replayed_entries"] for r in regions)
+    for r in regions:
+        r.wal.close()
+    return wall_ms, stages, replayed
+
+
+def recovery_probe(base_dir: str):
+    """`python bench.py cold_start <dir>`: the storage recovery
+    dataplane, parallel vs serial on the same data (both numbers are
+    recorded), then the WAL-truncation contract across two further cold
+    starts."""
+    import os
+
+    from greptimedb_tpu.storage.engine import EngineConfig, TsdbEngine
+    from greptimedb_tpu.storage.page_cache import global_page_cache
+
+    _assert_sanitizer_off()
+    root = os.path.join(base_dir, "recovery_probe")
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root, exist_ok=True)
+    sst_bytes = _recovery_generate(root)
+    print(f"# generated {_REC_REGIONS} regions, "
+          f"{_REC_REGIONS * _REC_SSTS_PER_REGION} SSTs, "
+          f"{sst_bytes / 1e6:.1f} MB", file=sys.stderr)
+
+    # parallel FIRST so any OS file-cache warming biases AGAINST it
+    par_ms, par_stages, replayed_par = _recovery_open(
+        root, parallelism=0, prefetch_depth=4, simulate_remote=True,
+    )
+    ser_ms, ser_stages, _ = _recovery_open(
+        root, parallelism=1, prefetch_depth=0, simulate_remote=True,
+    )
+    par_fs_ms, _, _ = _recovery_open(
+        root, parallelism=0, prefetch_depth=4, simulate_remote=False,
+    )
+    ser_fs_ms, _, _ = _recovery_open(
+        root, parallelism=1, prefetch_depth=0, simulate_remote=False,
+    )
+
+    # WAL truncation after the recovery flush: the first default-config
+    # open replays the tail and flushes; the NEXT cold start replays 0
+    global_page_cache.clear()
+    eng = TsdbEngine(EngineConfig(data_root=root,
+                                  enable_background=False))
+    first_regions = eng.open_regions(_recovery_metas())
+    first_replayed = sum(
+        r.recovery_stats["replayed_entries"] for r in first_regions
+    )
+    eng.close()
+    eng2 = TsdbEngine(EngineConfig(data_root=root,
+                                   enable_background=False))
+    second_regions = eng2.open_regions(_recovery_metas())
+    second_replayed = sum(
+        r.recovery_stats["replayed_entries"] for r in second_regions
+    )
+    eng2.close()
+    assert first_replayed > 0, "probe data lost its WAL tail"
+    assert second_replayed == 0, (
+        f"second cold start replayed {second_replayed} WAL entries "
+        "(recovery flush did not truncate)"
+    )
+
+    speedup = ser_ms / max(par_ms, 1e-9)
+    print(json.dumps({
+        "metric": "recovery_restore_ms",
+        "value": round(par_ms, 1),
+        "unit": "ms",
+        # target: parallel recovery >= 4x the serial path on the same
+        # data (vs_baseline >= 1.0 == target met)
+        "vs_baseline": round(speedup / 4.0, 2),
+        "serial_ms": round(ser_ms, 1),
+        "speedup_x": round(speedup, 2),
+        "local_fs_ms": round(par_fs_ms, 1),
+        "local_fs_serial_ms": round(ser_fs_ms, 1),
+        "stages_parallel": par_stages,
+        "stages_serial": ser_stages,
+        "parallelism": min(8, _REC_REGIONS),
+        "prefetch_depth": 4,
+        "regions": _REC_REGIONS,
+        "sst_files": _REC_REGIONS * _REC_SSTS_PER_REGION,
+        "sst_bytes": sst_bytes,
+        "wal_entries_replayed": replayed_par,
+        "first_cold_start_wal_entries": first_replayed,
+        "second_cold_start_wal_entries": second_replayed,
+        "simulated_get_ms": _REC_GET_LATENCY_S * 1000.0,
+        "simulated_mbps": _REC_BANDWIDTH_MBPS,
+    }))
 
 
 def cold_start_probe(data_dir: str):
@@ -301,9 +543,22 @@ def cold_start_probe(data_dir: str):
     )
     from greptimedb_tpu.query import device_range as DR
 
+    from greptimedb_tpu.storage import recovery as REC
+
+    rec_before = REC.stage_totals()
     t0 = time.perf_counter()
     inst = Standalone(data_dir, prefer_device=True, warm_start=False)
     open_s = time.perf_counter() - t0
+    rec_after = REC.stage_totals()
+    rec_stages = {
+        k: round(rec_after.get(k, 0.0) - rec_before.get(k, 0.0), 1)
+        for k in ("manifest_load", "wal_replay", "recovery_flush",
+                  "sst_restore", "total")
+    }
+    wal_replayed = sum(
+        r.recovery_stats["replayed_entries"]
+        for r in inst.engine.regions()
+    )
     # restore phase, run synchronously for measurement (a server does
     # this in the warm_start background thread): snapshot decode + grid
     # puts + forced residency. The transfer portion is the dev-tunnel's
@@ -328,12 +583,32 @@ def cold_start_probe(data_dir: str):
     t3 = time.perf_counter()
     inst.sql(query)
     second_q = time.perf_counter() - t3
+    inst.close()
+    # second cold start: after the recovery flush the WAL must be
+    # truncated — a restarted datanode replays ZERO entries (repeated
+    # cold starts must not pay the same replay forever)
+    inst2 = Standalone(data_dir, prefer_device=True, warm_start=False)
+    second_replayed = sum(
+        r.recovery_stats["replayed_entries"]
+        for r in inst2.engine.regions()
+    )
+    inst2.close()
+    assert second_replayed == 0, (
+        f"second cold start replayed {second_replayed} WAL entries"
+    )
+    rec = inst.engine.config.recovery
     print(json.dumps({
         "open_s": open_s, "restore_s": restore_s,
         "first_query_s": first_q, "second_query_s": second_q,
         "entry_bytes": nbytes,
+        "recovery": {
+            **rec_stages,
+            "wal_entries_replayed": wal_replayed,
+            "second_cold_start_wal_entries": second_replayed,
+            "prefetch_depth": rec.sst_prefetch_depth,
+            "open_parallelism": rec.open_parallelism,
+        },
     }))
-    inst.close()
 
 
 def phase1(tmp: str):
@@ -1032,5 +1307,7 @@ if __name__ == "__main__":
         phase1(sys.argv[2])
     elif len(sys.argv) >= 3 and sys.argv[1] == "--cold-start":
         cold_start_probe(sys.argv[2])
+    elif len(sys.argv) >= 3 and sys.argv[1] == "cold_start":
+        recovery_probe(sys.argv[2])
     else:
         main()

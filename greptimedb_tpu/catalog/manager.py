@@ -208,9 +208,32 @@ class CatalogManager:
         self._views = {
             db: dict(views) for db, views in doc.get("views", {}).items()
         }
-        for db_name, tables in doc.get("databases", {}).items():
+        all_infos = {
+            db_name: [TableInfo.from_json(t) for t in tables]
+            for db_name, tables in doc.get("databases", {}).items()
+        }
+        # region-parallel startup: submit EVERY mito region across every
+        # table to the engine's bounded recovery pool in one batch and
+        # join, so N single-region tables recover concurrently too. The
+        # per-table opens below then hit the registry; a failed open
+        # re-raises there and lands in that table's _BrokenTable.
+        batch = []
+        for infos in all_infos.values():
+            for info in infos:
+                if info.engine == "mito":
+                    batch.extend(self._region_metas(info))
+        if batch:
+            try:
+                self.engine.open_regions(batch)
+            except Exception as e:  # noqa: BLE001 - per-table isolation
+                import logging
+
+                logging.getLogger("greptimedb_tpu.catalog").warning(
+                    "batch region open failed (isolating per table): %s",
+                    e,
+                )
+        for db_name, infos in all_infos.items():
             db = self._databases.setdefault(db_name, {})
-            infos = [TableInfo.from_json(t) for t in tables]
             # physical (mito) tables first: logical metric tables resolve
             # their shared physical table during open
             for info in sorted(infos, key=lambda i: i.engine == "metric"):
@@ -239,17 +262,10 @@ class CatalogManager:
         }
         self.store.write(CATALOG_PATH, json.dumps(doc).encode())
 
-    def _open_table(self, info: TableInfo) -> Table:
-        if info.engine == "metric":
-            return self._open_metric_table(info)
-        if info.engine == "file":
-            from greptimedb_tpu.storage.file_engine import open_file_table
-
-            return open_file_table(self, info)
-        regions = []
+    def _region_metas(self, info: TableInfo) -> list[RegionMetadata]:
         opts = region_options_from_table(info.options)
-        for rid in info.region_ids():
-            meta = RegionMetadata(
+        return [
+            RegionMetadata(
                 region_id=rid,
                 table=info.name,
                 tag_names=[c.name for c in info.schema.tag_columns],
@@ -261,7 +277,19 @@ class CatalogManager:
                     if getattr(c, "fulltext", False)
                 ],
             )
-            regions.append(self.engine.open_region(meta))
+            for rid in info.region_ids()
+        ]
+
+    def _open_table(self, info: TableInfo) -> Table:
+        if info.engine == "metric":
+            return self._open_metric_table(info)
+        if info.engine == "file":
+            from greptimedb_tpu.storage.file_engine import open_file_table
+
+            return open_file_table(self, info)
+        # multi-region tables open region-parallel on the engine's
+        # bounded pool (already-open regions hit the registry)
+        regions = self.engine.open_regions(self._region_metas(info))
         return Table(info, regions)
 
     # ------------------------------------------------------------------

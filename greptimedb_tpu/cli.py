@@ -339,6 +339,7 @@ def _make_instance(opts):
     from greptimedb_tpu.storage.object_store import (
         object_store_from_options,
     )
+    from greptimedb_tpu.storage.recovery import recovery_options_from
 
     store = None
     storage = opts.section("storage")
@@ -354,6 +355,7 @@ def _make_instance(opts):
             ),
             wal_backend=opts.get("wal.backend", "fs"),
             wal_topics=int(opts.get("wal.topics", 4)),
+            recovery=recovery_options_from(opts.section("recovery")),
         ),
         store=store,
     )

@@ -71,6 +71,17 @@ DEFAULTS: dict = {
         "enable_background": True,
         "background_interval_s": 5.0,
     },
+    # recovery & startup dataplane (storage/recovery.py): bounded
+    # region-parallel open, pipelined SST restore with a readahead
+    # window, manifest checkpoint cadence, and the post-replay flush
+    # that truncates the WAL so the next restart replays nothing
+    "recovery": {
+        "open_parallelism": 0,          # 0 = min(8, regions in batch)
+        "sst_prefetch_depth": 4,        # ranged gets in flight / region
+        "checkpoint_interval_edits": 64,
+        "flush_after_replay": True,
+        "restore_ssts": False,          # eager fetch+verify+warm at open
+    },
     "frontend": {
         # flight addresses of the datanodes this frontend fans out to
         "datanode_addrs": [],

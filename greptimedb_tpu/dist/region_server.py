@@ -92,9 +92,15 @@ class RegionServer:
         if os.path.exists(self._path):
             with open(self._path) as f:
                 self._metas = {int(k): v for k, v in json.load(f).items()}
-            for doc in self._metas.values():
-                # reopen = WAL replay; unflushed rows survive the restart
-                self.engine.open_region(region_meta_from_json(doc))
+            # datanode rejoin: submit every hosted region to the
+            # engine's bounded recovery pool and join (reopen = WAL
+            # replay; unflushed rows survive the restart). Parallelism,
+            # SST restore and the post-replay flush all come from the
+            # [recovery] knobs.
+            self.engine.open_regions(
+                [region_meta_from_json(doc)
+                 for doc in self._metas.values()]
+            )
 
     def _persist(self):
         tmp = self._path + ".tmp"

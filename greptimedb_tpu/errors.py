@@ -119,6 +119,14 @@ class StorageError(GreptimeError):
     status_code = StatusCode.STORAGE_UNAVAILABLE
 
 
+class SstRestoreError(StorageError):
+    """An SST object failed verification during recovery restore: the
+    ranged get returned fewer bytes than the manifest entry records
+    (torn/partial object), the object is missing, or the Parquet
+    payload is corrupt. Carries the offending file path so operators
+    see WHICH object to repair instead of a decode traceback."""
+
+
 class DatanodeUnavailableError(GreptimeError):
     """A datanode process is unreachable (connection refused/timeout) —
     retryable after a route refresh (failover may have moved its

@@ -73,7 +73,12 @@ class Election:
     def step(self, now: float | None = None) -> bool:
         """One election round; returns leadership after the round."""
         now = time.time() if now is None else now
-        with self._lock:
+        # GTS103: the round intentionally holds the in-process lock
+        # across the kv CAS, which waits on the CROSS-PROCESS flock —
+        # bounded by a peer's lease tick, not by this process. Splitting
+        # it would let two in-process campaigners interleave reads and
+        # CAS attempts of one round.
+        with self._lock:  # gtlint: disable=GTS103
             raw = self.kv.get(self.key)
             doc = None
             if raw is not None:

@@ -146,14 +146,19 @@ class FsKv(KvBackend):
             self._reload_if_changed()
             return self._mem.get(key)
 
+    # GTS103 (put/delete/compare_and_put): the in-process lock
+    # deliberately covers the CROSS-PROCESS flock + fsync'd persist —
+    # its hold time is bounded by the peer process's critical section
+    # (seconds under load), and releasing it earlier would let sibling
+    # threads interleave _reload/_mem mutation/persist around the flock.
     def put(self, key, value):
-        with self._lock, self._flock():
+        with self._lock, self._flock():  # gtlint: disable=GTS103
             self._reload_if_changed()
             self._mem.put(key, value)
             self._persist()
 
     def delete(self, key):
-        with self._lock, self._flock():
+        with self._lock, self._flock():  # gtlint: disable=GTS103
             self._reload_if_changed()
             out = self._mem.delete(key)
             if out:
@@ -166,7 +171,7 @@ class FsKv(KvBackend):
             return self._mem.range(prefix)
 
     def compare_and_put(self, key, expect, value):
-        with self._lock, self._flock():
+        with self._lock, self._flock():  # gtlint: disable=GTS103
             self._reload_if_changed()
             ok = self._mem.compare_and_put(key, expect, value)
             if ok:

@@ -9,10 +9,13 @@ truncation marker, schema version.
 from __future__ import annotations
 
 import json
+import logging
 from dataclasses import dataclass, field
 
 from greptimedb_tpu.storage.object_store import ObjectStore
 from greptimedb_tpu.storage.sst import SstMeta
+
+_log = logging.getLogger("greptimedb_tpu.storage.manifest")
 
 
 @dataclass
@@ -77,13 +80,29 @@ def apply_action(state: ManifestState, action: dict) -> None:
 
 class RegionManifest:
     """Action files <prefix>/<version>.json; checkpoint at
-    <prefix>/_checkpoint.json covering versions <= its `version`."""
+    <prefix>/_checkpoint.json covering versions <= its `version`.
+
+    Recovery loads the latest checkpoint and replays only the edit
+    suffix above it; a torn/corrupt checkpoint object degrades to a
+    full replay of the retained edit files with a warning instead of a
+    crash. Concurrency contract: every commit (flush/compact/truncate/
+    alter) and explicit checkpoint() runs under the owning region's
+    lock — the manifest commit lock — which linearizes checkpoint
+    writes against edit appends; the manifest itself adds no second
+    lock."""
 
     def __init__(self, store: ObjectStore, prefix: str,
-                 *, checkpoint_distance: int = 16):
+                 *, checkpoint_distance: int | None = None):
+        from greptimedb_tpu.storage.recovery import (
+            DEFAULT_CHECKPOINT_INTERVAL,
+        )
+
         self.store = store
         self.prefix = prefix.rstrip("/")
-        self.checkpoint_distance = checkpoint_distance
+        self.checkpoint_distance = (
+            DEFAULT_CHECKPOINT_INTERVAL if checkpoint_distance is None
+            else int(checkpoint_distance)
+        )
         self.state = ManifestState()
         self.version = -1
         self._ckpt_version = -1
@@ -98,17 +117,35 @@ class RegionManifest:
 
     def _load(self):
         if self.store.exists(self._ckpt_path):
-            obj = json.loads(self.store.read(self._ckpt_path))
-            self.state = ManifestState.from_json(obj["state"])
-            self.version = self._ckpt_version = obj["version"]
+            try:
+                obj = json.loads(self.store.read(self._ckpt_path))
+                state = ManifestState.from_json(obj["state"])
+                version = int(obj["version"])
+            except Exception as e:  # noqa: BLE001 - torn checkpoint
+                # fall back to replaying every retained edit file from
+                # scratch; edits the checkpoint had already absorbed
+                # (and trimmed) are unrecoverable, but a readable
+                # suffix beats refusing to open the region
+                _log.warning(
+                    "torn manifest checkpoint %s (%s); falling back to "
+                    "full edit replay", self._ckpt_path, e,
+                )
+            else:
+                self.state = state
+                self.version = self._ckpt_version = version
+        edits = []
         for meta in self.store.list(self.prefix + "/"):
             name = meta.path.rsplit("/", 1)[-1]
             if not name.endswith(".json") or name.startswith("_"):
                 continue
-            v = int(name[:-5])
+            edits.append((int(name[:-5]), meta.path))
+        # replay in VERSION order explicitly — every ObjectStore.list
+        # sorts by path today, but a later out-of-order listing would
+        # silently skip lower versions through the guard below
+        for v, path in sorted(edits):
             if v <= self.version:
                 continue
-            action = json.loads(self.store.read(meta.path))
+            action = json.loads(self.store.read(path))
             apply_action(self.state, action)
             self.version = v
 

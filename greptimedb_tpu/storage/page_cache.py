@@ -52,6 +52,20 @@ class PageCache:
                 _, (_, b) = self._entries.popitem(last=False)
                 self._bytes -= b
 
+    def put_free(self, key: tuple, value, nbytes: int) -> bool:
+        """Install only while FREE budget remains — never evicts.
+        The recovery restore path warms the cache with this so a large
+        restore cannot push out hot scan data. Returns False once the
+        entry would not fit."""
+        with self._lock:
+            if key in self._entries:
+                return True
+            if self._bytes + nbytes > self.capacity:
+                return False
+            self._entries[key] = (value, nbytes)
+            self._bytes += nbytes
+            return True
+
     def clear(self):
         with self._lock:
             self._entries.clear()
@@ -74,6 +88,28 @@ def _col_nbytes(values: np.ndarray, validity) -> int:
     return n
 
 
+def decode_arrow_column(arr) -> tuple[np.ndarray, np.ndarray | None]:
+    """Arrow column -> (values, validity|None) in the cache's exact
+    representation. The ONE decode both the scan path and the recovery
+    restore warm share, so restore-installed entries hit verbatim."""
+    import pyarrow as pa
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    is_str = (pa.types.is_string(arr.type)
+              or pa.types.is_large_string(arr.type))
+    validity = None
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+        arr = arr.fill_null("" if is_str else 0)
+    if is_str:
+        values = np.asarray(arr.to_pylist(), dtype=object)
+    else:
+        values = np.asarray(arr)
+    values.setflags(write=False)
+    return values, validity
+
+
 def read_columns(pf, path: str, groups: list[int], cols: list[str]):
     """Read `cols` over `groups` of the ParquetFile `pf`, column-by-group
     through the global cache. Returns {col: (values, validity|None)} with
@@ -94,25 +130,9 @@ def read_columns(pf, path: str, groups: list[int], cols: list[str]):
     for g, want in missing.items():
         tbl = pf.read_row_groups([g], columns=want)
         for c in want:
-            import pyarrow as pa
-
-            arr = tbl.column(c)
-            if isinstance(arr, pa.ChunkedArray):
-                arr = arr.combine_chunks()
-            is_str = (pa.types.is_string(arr.type)
-                      or pa.types.is_large_string(arr.type))
-            validity = None
-            if arr.null_count:
-                validity = np.asarray(arr.is_valid())
-                arr = arr.fill_null("" if is_str else 0)
-            if is_str:
-                values = np.asarray(arr.to_pylist(), dtype=object)
-            else:
-                values = np.asarray(arr)
-            values.setflags(write=False)
-            entry = (values, validity)
+            entry = decode_arrow_column(tbl.column(c))
             global_page_cache.put(
-                (path, g, c), entry, _col_nbytes(values, validity)
+                (path, g, c), entry, _col_nbytes(entry[0], entry[1])
             )
             per_col[c][groups.index(g)] = entry
     out = {}

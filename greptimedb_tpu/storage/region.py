@@ -165,7 +165,12 @@ class Region:
         *,
         prefix: str | None = None,
         log_store=None,
+        checkpoint_interval_edits: int | None = None,
     ):
+        import time as _time
+
+        from greptimedb_tpu.storage import recovery as _recovery
+
         self.meta = meta
         self.store = store
         self.prefix = prefix or f"data/region_{meta.region_id}"
@@ -174,7 +179,20 @@ class Region:
         # topology) supplied by the engine
         self.wal = (log_store if log_store is not None
                     else RegionWal(wal_dir, sync=meta.options.wal_sync))
-        self.manifest = RegionManifest(store, f"{self.prefix}/manifest")
+        # per-stage recovery wall times + replayed-entry count for this
+        # open; the engine aggregates them into gtpu_recovery_* metrics
+        self.recovery_stats: dict = {
+            "manifest_load_ms": 0.0, "wal_replay_ms": 0.0,
+            "sst_restore_ms": 0.0, "replayed_entries": 0,
+        }
+        t0 = _time.perf_counter()
+        self.manifest = RegionManifest(
+            store, f"{self.prefix}/manifest",
+            checkpoint_distance=checkpoint_interval_edits,
+        )
+        ms = (_time.perf_counter() - t0) * 1000.0
+        self.recovery_stats["manifest_load_ms"] = ms
+        _recovery.record_stage("manifest_load", ms)
         self.series = (
             SeriesRegistry.restore(self.manifest.state.series_snapshot)
             if self.manifest.state.series_snapshot
@@ -195,7 +213,11 @@ class Region:
         self._scan_cache: tuple | None = None  # (data_version, ColumnarRows)
         self._lock = concurrency.RLock()
         self.writable = True
-        self._replay()
+        t1 = _time.perf_counter()
+        self.recovery_stats["replayed_entries"] = self._replay()
+        ms = (_time.perf_counter() - t1) * 1000.0
+        self.recovery_stats["wal_replay_ms"] = ms
+        _recovery.record_stage("wal_replay", ms)
 
     @property
     def data_version(self) -> tuple[int, int, int]:
@@ -325,16 +347,19 @@ class Region:
     def delete(self, tag_columns: dict[str, np.ndarray], ts: np.ndarray) -> int:
         return self.write(tag_columns, ts, {}, op=OP_DELETE)
 
-    def _replay(self):
+    def _replay(self) -> int:
         """Re-apply WAL entries after the flushed id (open/catchup,
-        /root/reference/src/mito2/src/worker/handle_catchup.rs analog)."""
+        /root/reference/src/mito2/src/worker/handle_catchup.rs analog).
+        Returns the number of entries replayed."""
         from_id = self.manifest.state.flushed_entry_id + 1
         seed = getattr(self.wal, "seed_floor", None)
         if seed is not None:
             # shared-topic logs: never hand out ids below the flushed
             # watermark even if truncation erased every physical entry
             seed(self.manifest.state.flushed_entry_id)
+        replayed = 0
         for entry in self.wal.replay(from_id):
+            replayed += 1
             cols, meta = codec.decode_columns(entry.payload)
             ts = cols.pop("__ts")
             base_seq = meta["base_seq"]
@@ -377,6 +402,7 @@ class Region:
                 self._apply_rows(tags, ts, fields, valids or None,
                                  meta["op"], base_seq)
             self._seq = max(self._seq, base_seq + len(ts))
+        return replayed
 
     # ------------------------------------------------------------------
     # flush

@@ -32,6 +32,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running stress tests (tier-1 runs -m 'not slow')",
+    )
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()
