@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import contextvars
 
+from greptimedb_tpu.sched import deadline as _deadline
+
 _check: contextvars.ContextVar = contextvars.ContextVar(
     "gtpu_cancel_check", default=None
 )
@@ -28,7 +30,9 @@ def reset(token):
 
 def checkpoint():
     """Raise (via the installed callable) if the current statement has
-    been killed. No-op outside statement execution."""
+    been killed, or (typed QueryDeadlineExceededError) if its deadline
+    lapsed. No-op outside statement execution."""
     fn = _check.get()
     if fn is not None:
         fn()
+    _deadline.check()

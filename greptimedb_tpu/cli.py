@@ -367,6 +367,11 @@ def _make_instance(opts):
         except Exception as e:  # noqa: BLE001
             # the node still serves reads/writes without flows
             print(f"# flows disabled: {e}", flush=True)
+    from greptimedb_tpu.sched import AdmissionController, SchedulerConfig
+
+    inst.scheduler = AdmissionController(
+        SchedulerConfig.from_options(opts.section("scheduler"))
+    )
     from greptimedb_tpu.telemetry.slow_query import SlowQueryLog
 
     inst.slow_query_log = SlowQueryLog(
@@ -513,6 +518,7 @@ def _start_frontend(opts):
             flownode_addr=opts.get("frontend.flownode_addr") or None,
             ingest_options=opts.section("ingest"),
             dist_query_options=opts.section("dist_query"),
+            scheduler_options=opts.section("scheduler"),
         )
         target = f"metasrv {meta_addr}"
     else:

@@ -82,6 +82,25 @@ DEFAULTS: dict = {
         "flush_after_replay": True,
         "restore_ssts": False,          # eager fetch+verify+warm at open
     },
+    # query admission control + scheduling (sched/): per-tenant token
+    # buckets and concurrency limits over a bounded priority queue,
+    # queue-time SLOs, end-to-end deadlines, graceful degradation.
+    # 0 = unlimited for every limit knob; the permissive defaults keep
+    # the controller on the hot path without ever queueing or shedding
+    "scheduler": {
+        "enable": True,
+        "max_concurrency": 0,        # global execution slots
+        "queue_depth": 256,          # bounded wait queue (0 = unbounded)
+        "queue_timeout_s": 10.0,     # queue-time SLO => 503 shed (0 = none)
+        "default_deadline_s": 0.0,   # absolute per-query deadline
+        "tenant_qps": 0.0,           # per-tenant token bucket rate
+        "tenant_burst": 0.0,         # 0 => max(1, 2*qps)
+        "tenant_concurrency": 0,     # per-tenant execution slots
+        "allow_partial_results": False,  # degrade instead of fail
+        # per-tenant overrides: [scheduler.tenants.<name>]
+        # qps/burst/concurrency/priority (lower priority runs first)
+        "tenants": {},
+    },
     "frontend": {
         # flight addresses of the datanodes this frontend fans out to
         "datanode_addrs": [],

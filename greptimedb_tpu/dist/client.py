@@ -17,12 +17,29 @@ import urllib.request
 from greptimedb_tpu.errors import (
     DatanodeUnavailableError,
     GreptimeError,
+    QueryDeadlineExceededError,
     error_from_code,
 )
 
 from greptimedb_tpu import concurrency
 
 _log = logging.getLogger("greptimedb_tpu.dist.client")
+
+# backstop bound on the serial write path (the pipelined dataplane has
+# its own ack timeout); a blackholed datanode must not park the writer
+# on the gRPC default (no) deadline
+_WRITE_TIMEOUT_S = 300.0
+
+
+def _op_timeout(base_s: float) -> float:
+    """Bounded wait for a DDL/maintenance Flight action. Every region
+    lifecycle call carries an explicit deadline so a stalled peer
+    bounds, not blocks, the DDL (the load-dependent golden
+    wire-topology DROP flake under GTPU_SAN was an UNBOUNDED drop_region
+    wait against a starved server). The cooperative sanitizer makes
+    every lock operation ~an order of magnitude slower, so instrumented
+    runs get a wider — but still bounded — window."""
+    return base_s * (4.0 if concurrency.sanitizer_enabled() else 1.0)
 
 
 def _strip_flight_error(e) -> str:
@@ -49,17 +66,28 @@ def _is_unavailable(e) -> bool:
 _CODE_RE = re.compile(r"\[gtdb:(\d+)\]\s*")
 
 
-def map_flight_error(e: Exception, addr: str) -> GreptimeError:
+def map_flight_error(e: Exception, addr: str, *,
+                     deadline: bool = False) -> GreptimeError:
     """Flight/socket error -> typed GreptimeError. A `[gtdb:<code>]`
     marker re-raises the remote error as its dedicated class — checked
     FIRST so a typed server error is never misclassified as the
     retryable datanode-unreachable case. Transport-level failures
     never carry the marker and are recognised by exception TYPE
-    (_is_unavailable), not message text."""
+    (_is_unavailable), not message text. With `deadline=True` (the
+    call carried a query-deadline-derived timeout) a gRPC deadline
+    miss maps to the typed QueryDeadlineExceededError instead of the
+    retryable unavailable case — retrying cannot help a query whose
+    budget is spent."""
+    import pyarrow.flight as flight
+
     msg = _strip_flight_error(e)
     m = _CODE_RE.search(msg)
     if m:
         return error_from_code(int(m.group(1)), msg[m.end():].strip())
+    if deadline and isinstance(e, flight.FlightTimedOutError):
+        return QueryDeadlineExceededError(
+            f"datanode {addr} missed the query deadline"
+        )
     if _is_unavailable(e):
         return DatanodeUnavailableError(
             f"datanode {addr} unreachable: {msg}"
@@ -95,13 +123,14 @@ class DatanodeClient:
                                self.addr, e)
                 self._conn = None
 
-    def _raise(self, e):
+    def _raise(self, e, *, deadline: bool = False):
         """Map a Flight error: unreachable datanodes raise the
         RETRYABLE DatanodeUnavailableError (and drop the cached
         connection so the next call redials — failover may have moved
         the regions); `[gtdb:<code>]`-stamped messages re-raise as
-        their typed class (e.g. RegionNotFoundError)."""
-        err = map_flight_error(e, self.addr)
+        their typed class (e.g. RegionNotFoundError); deadline-bounded
+        calls map a gRPC timeout to QueryDeadlineExceededError."""
+        err = map_flight_error(e, self.addr, deadline=deadline)
         if isinstance(err, DatanodeUnavailableError):
             self.close()
         raise err from None
@@ -126,53 +155,70 @@ class DatanodeClient:
             return {}
         return json.loads(results[0].body.to_pybytes() or b"{}")
 
+    # every region lifecycle action carries an explicit bounded
+    # timeout (_op_timeout): DDL against a slow/blackholed datanode
+    # must error typed, never hang
     def open_region(self, meta_doc: dict):
-        self.action("open_region", {"meta": meta_doc})
+        # opening may replay a WAL + restore SSTs: the widest bound
+        self.action("open_region", {"meta": meta_doc},
+                    timeout=_op_timeout(120.0))
 
     def drop_region(self, region_id: int):
-        self.action("drop_region", {"region_id": region_id})
+        self.action("drop_region", {"region_id": region_id},
+                    timeout=_op_timeout(30.0))
 
     def flush_region(self, region_id: int) -> bool:
         return bool(
-            self.action("flush_region", {"region_id": region_id})
+            self.action("flush_region", {"region_id": region_id},
+                        timeout=_op_timeout(120.0))
             .get("flushed")
         )
 
     def compact_region(self, region_id: int) -> bool:
         return bool(
-            self.action("compact_region", {"region_id": region_id})
+            self.action("compact_region", {"region_id": region_id},
+                        timeout=_op_timeout(300.0))
             .get("compacted")
         )
 
     def truncate_region(self, region_id: int):
-        self.action("truncate_region", {"region_id": region_id})
+        self.action("truncate_region", {"region_id": region_id},
+                    timeout=_op_timeout(30.0))
 
     def alter_region(self, region_id: int, op: str, name: str):
         self.action("alter_region",
-                    {"region_id": region_id, "op": op, "name": name})
+                    {"region_id": region_id, "op": op, "name": name},
+                    timeout=_op_timeout(30.0))
 
     def region_stats(self, region_ids: list[int]) -> dict:
-        return self.action("region_stats", {"region_ids": region_ids}).get(
+        return self.action("region_stats", {"region_ids": region_ids},
+                           timeout=_op_timeout(15.0)).get(
             "stats", {}
         )
 
     def data_versions(self, region_ids: list[int]) -> dict:
         return self.action(
-            "data_versions", {"region_ids": region_ids}
+            "data_versions", {"region_ids": region_ids},
+            timeout=_op_timeout(15.0),
         ).get("versions", {})
 
     # ---- data plane ---------------------------------------------------
     def region_scan(self, region_ids: list[int], *, ts_min=None,
                     ts_max=None, fields=None, matchers=None,
                     fulltext=None):
-        """One RPC: merged scan of this datanode's listed regions.
-        Returns (ColumnarRows|None, tag_values, stats)."""
+        """One RPC: merged scan of this datanode's listed regions,
+        bounded by the caller's active query deadline (sched/deadline):
+        the remaining budget rides both the gRPC call options AND the
+        ticket (datanode-side cooperative checks). Returns
+        (ColumnarRows|None, tag_values, stats)."""
         import pyarrow.flight as flight
 
         from greptimedb_tpu.dist.codec import arrow_to_scan
+        from greptimedb_tpu.sched import deadline as _dl
 
         from greptimedb_tpu.dist import plan_codec
 
+        timeout = _dl.call_timeout()
         ticket = {
             "rpc": "region_scan", "region_ids": list(region_ids),
             "ts_min": ts_min, "ts_max": ts_max, "fields": fields,
@@ -186,13 +232,16 @@ class DatanodeClient:
                 [list(f) for f in fulltext] if fulltext else None
             ),
         }
+        if timeout is not None:
+            ticket["deadline_s"] = round(timeout, 3)
         try:
             reader = self._client().do_get(
-                flight.Ticket(json.dumps(ticket).encode())
+                flight.Ticket(json.dumps(ticket).encode()),
+                options=flight.FlightCallOptions(timeout=timeout),
             )
             table = reader.read_all()
         except flight.FlightError as e:
-            self._raise(e)
+            self._raise(e, deadline=timeout is not None)
         meta = table.schema.metadata or {}
         stats = json.loads(meta.get(b"gtdb:stats", b"{}"))
         names = (fields if fields is not None else [
@@ -209,18 +258,24 @@ class DatanodeClient:
             json.dumps({"rpc": "partial_sql", **doc}).encode()
         )
 
-    def partial_sql_ticket(self, ticket: bytes):
+    def partial_sql_ticket(self, ticket: bytes,
+                           timeout: float | None = None):
         """partial_sql with a pre-serialized ticket: the frontend caches
         the encoded plan/TableInfo docs (dist/dist_query.py) and splices
         region ids in, so hot queries skip re-encoding — and ship
-        byte-identical tickets, which keys the datanode's decode memo."""
+        byte-identical tickets, which keys the datanode's decode memo.
+        `timeout` (the query deadline's remaining budget) bounds the
+        whole call; its expiry raises the typed deadline error."""
         import pyarrow.flight as flight
 
         try:
-            reader = self._client().do_get(flight.Ticket(ticket))
+            reader = self._client().do_get(
+                flight.Ticket(ticket),
+                options=flight.FlightCallOptions(timeout=timeout),
+            )
             return reader.read_all()
         except flight.FlightError as e:
-            self._raise(e)
+            self._raise(e, deadline=timeout is not None)
 
     def write_regions(self, puts: list[dict]):
         """puts: [{region_id, op, skip_wal, tag_columns, ts, fields,
@@ -255,9 +310,13 @@ class DatanodeClient:
                 pass
             writer.close()
 
+        # backstop deadline: the serial write path must never park on
+        # the gRPC default (infinite) deadline against a blackholed
+        # datanode (the pipelined dataplane bounds acks itself)
+        opts = flight.FlightCallOptions(timeout=_WRITE_TIMEOUT_S)
         try:
             writer, reader = self._client().do_put(
-                descriptor, batches[0][0].schema
+                descriptor, batches[0][0].schema, options=opts
             )
             schema = batches[0][0].schema
             for batch, meta in batches:
@@ -265,7 +324,7 @@ class DatanodeClient:
                     # schema changes mid-stream need a fresh stream
                     finish(writer, reader)
                     writer, reader = self._client().do_put(
-                        descriptor, batch.schema
+                        descriptor, batch.schema, options=opts
                     )
                     schema = batch.schema
                 writer.write_with_metadata(batch, meta)

@@ -48,7 +48,14 @@ from collections import OrderedDict
 import numpy as np
 
 from greptimedb_tpu.dist import plan_codec
+from greptimedb_tpu.errors import (
+    DatanodeUnavailableError,
+    QueryDeadlineExceededError,
+    QueryOverloadedError,
+    QueryQueueTimeoutError,
+)
 from greptimedb_tpu.query import stats
+from greptimedb_tpu.sched import deadline as _dl
 from greptimedb_tpu.query.executor import (
     Col,
     DictSource,
@@ -136,6 +143,14 @@ def try_dist_query(instance, plan: SelectPlan, table):
             res = _dist_range(instance, plan, table, clock)
         else:
             return None
+    except (QueryDeadlineExceededError, QueryOverloadedError,
+            QueryQueueTimeoutError, DatanodeUnavailableError):
+        # overload/deadline/unreachable are TYPED outcomes, not plan
+        # shapes the pushdown cannot express: falling back to data
+        # shipping would re-run the query against the same dead or
+        # saturated peer and double the latency of an already-bounded
+        # failure
+        raise
     except Exception:  # noqa: BLE001 - fall back to data shipping
         stats.add("dist_pushdown_errors", 1)
         return None
@@ -227,13 +242,22 @@ def _info_doc(table) -> bytes:
     return enc
 
 
-def _fan_out_stream(instance, table, partial: SelectPlan, clock):
+def _fan_out_stream(instance, table, partial: SelectPlan, clock,
+                    failures: list | None = None):
     """Ship `partial` concurrently to every datanode holding un-pruned
     regions of `table` over the shared long-lived pool; yields
     (addr, QueryResult) in ARRIVAL order, so the caller can merge each
     datanode's partial while slower ones are still executing. Arrow
     decode happens in the pool workers (overlapped with other
-    datanodes' wire time)."""
+    datanodes' wire time).
+
+    The active query deadline (sched/deadline) bounds every per-
+    datanode call — as the gRPC call-option timeout AND as a
+    `deadline_s` ticket field for datanode-side cooperative checks.
+    With `failures` given (graceful degradation), a datanode that is
+    unreachable or misses the deadline is recorded as
+    (addr, missing_region_count, error) instead of failing the whole
+    query; otherwise the typed error propagates."""
     from greptimedb_tpu.servers.remote import arrow_to_result
 
     t0 = time.perf_counter()
@@ -241,17 +265,31 @@ def _fan_out_stream(instance, table, partial: SelectPlan, clock):
     info_json = _info_doc(table)
     scan_regions = table.pruned_regions(partial.scan.matchers)
     groups = table._by_datanode(scan_regions)
+    # remaining budget resolved ONCE here (pool workers do not inherit
+    # the caller's contextvars); the datanode re-anchors it on arrival
+    _dl.check("fan-out")
+    timeout = _dl.call_timeout()
+    dl_field = (b'' if timeout is None
+                else b'"deadline_s":%.3f,' % timeout)
     tickets = [
-        (client, b'{"rpc":"partial_sql","mode":"plan","plan":'
+        (client, b'{"rpc":"partial_sql",' + dl_field
+         + b'"mode":"plan","plan":'
          + plan_json + b',"table":' + info_json + b',"region_ids":'
-         + json.dumps(list(rids)).encode() + b"}")
+         + json.dumps(list(rids)).encode() + b"}", len(rids))
         for client, rids in groups
     ]
     clock.add("encode", (time.perf_counter() - t0) * 1000.0)
 
-    def one(client, ticket):
+    def one(client, ticket, nrids):
         t = time.perf_counter()
-        arrow = client.partial_sql_ticket(ticket)
+        try:
+            arrow = client.partial_sql_ticket(ticket, timeout=timeout)
+        except (DatanodeUnavailableError,
+                QueryDeadlineExceededError) as e:
+            if failures is None:
+                raise
+            failures.append((client.addr, nrids, e))
+            return None
         res = arrow_to_result(arrow)
         rpc_ms = (time.perf_counter() - t) * 1000.0
         meta = arrow.schema.metadata or {}
@@ -261,18 +299,21 @@ def _fan_out_stream(instance, table, partial: SelectPlan, clock):
 
     t_fan = time.perf_counter()
     if len(tickets) <= 1:
-        raw_iter = (one(c, t) for c, t in tickets)
+        raw_iter = (one(c, t, nr) for c, t, nr in tickets)
     else:
         from concurrent.futures import as_completed
 
         pool = _fanout_pool()
-        futs = [pool.submit(one, c, t) for c, t in tickets]
+        futs = [pool.submit(one, c, t, nr) for c, t, nr in tickets]
         raw_iter = (f.result() for f in as_completed(futs))
     n = 0
     exec_max = 0.0
     wire_max = 0.0
     try:
-        for addr, res, stage, path, rpc_ms, nrows in raw_iter:
+        for item in raw_iter:
+            if item is None:
+                continue  # recorded in `failures` (degraded answer)
+            addr, res, stage, path, rpc_ms, nrows = item
             counters = stage.get("counters", {})
             stats.note(f"datanode_{addr}", json.dumps({
                 "exec_path": path,
@@ -298,6 +339,26 @@ def _fan_out(instance, table, partial: SelectPlan, clock=None):
     """Barrier form of the stream: [(addr, QueryResult)]."""
     clock = clock if clock is not None else _StageClock()
     return list(_fan_out_stream(instance, table, partial, clock))
+
+
+def _allow_partial(instance) -> bool:
+    """`[scheduler] allow_partial_results`: decomposable aggregates may
+    answer with a typed partial result when a datanode is unreachable
+    or misses the deadline."""
+    sched = getattr(instance, "scheduler", None)
+    return sched is not None and sched.config.allow_partial_results
+
+
+def _mark_partial(res: QueryResult, failures: list) -> QueryResult:
+    """Stamp the degraded-answer metadata the protocol layers surface
+    (`partial=true` + missing-region count)."""
+    from greptimedb_tpu.sched.admission import note_partial_result
+
+    res.partial = True
+    res.missing_regions = sum(n for _addr, n, _e in failures)
+    note_partial_result()
+    stats.add("dist_partial_results", 1)
+    return res
 
 
 def _cat_col(parts: list[QueryResult], i: int) -> Col:
@@ -568,10 +629,18 @@ def _dist_aggregate(instance, plan: SelectPlan, table, clock):
     # sum/count fold by grouped addition, min/max by grouped extremes —
     # so the accumulator is itself a valid partial), overlapping merge
     # work with the slower datanodes' execution + wire time.
+    # Graceful degradation: with [scheduler] allow_partial_results a
+    # dead or deadline-missing datanode drops out of the fold and the
+    # answer is stamped partial (decomposable aggregates stay
+    # well-defined over the surviving regions).
+    failures: list | None = [] if _allow_partial(instance) else None
     nk = len(plan.keys)
     state: list[Col] | None = None
     width = nk + len(partial_aggs)
-    for _addr, res in _fan_out_stream(instance, table, partial, clock):
+    answered = 0
+    for _addr, res in _fan_out_stream(instance, table, partial, clock,
+                                      failures=failures):
+        answered += 1
         if not res.num_rows:
             continue
         part = _ColsView(res.cols[:width])
@@ -579,10 +648,15 @@ def _dist_aggregate(instance, plan: SelectPlan, table, clock):
             state = (part.cols if state is None
                      else _fold_states(plan.keys, partial_aggs,
                                        [_ColsView(state), part]))
+    if failures and not answered:
+        # every datanode failed: nothing to degrade to — surface the
+        # typed error instead of inventing an empty "partial" answer
+        raise failures[0][2]
     if state is None:
-        return instance.query_engine._post_project(
+        res = instance.query_engine._post_project(
             plan, _empty_agg_cols(plan), 0 if plan.keys else 1
         )
+        return _mark_partial(res, failures) if failures else res
     g = len(state[0]) if state else 0
     agg_cols = {
         k.key: state[i] for i, k in enumerate(plan.keys)
@@ -628,7 +702,8 @@ def _dist_aggregate(instance, plan: SelectPlan, table, clock):
     engine = instance.query_engine
     engine._record_path("aggregate", "dist:partial")
     with clock.timed("finalize"):
-        return engine._post_project(plan, agg_cols, g)
+        res = engine._post_project(plan, agg_cols, g)
+    return _mark_partial(res, failures) if failures else res
 
 
 def _dist_count_distinct(instance, plan: SelectPlan, table, clock):

@@ -26,7 +26,8 @@ class DistInstance(Standalone):
                  prefer_device: bool | None = None,
                  flownode_addr: str | None = None,
                  ingest_options: dict | None = None,
-                 dist_query_options: dict | None = None):
+                 dist_query_options: dict | None = None,
+                 scheduler_options: dict | None = None):
         from greptimedb_tpu.dist import dist_query
 
         # [dist_query] knobs for the fan-out side (shared pool size);
@@ -42,6 +43,15 @@ class DistInstance(Standalone):
             prefer_device=prefer_device,
             warm_start=False,
         )
+        if scheduler_options is not None:
+            from greptimedb_tpu.sched import (
+                AdmissionController,
+                SchedulerConfig,
+            )
+
+            self.scheduler = AdmissionController(
+                SchedulerConfig.from_options(scheduler_options)
+            )
         self.meta = MetaClient(metasrv_addr)
         self.catalog = DistCatalogManager(
             self.engine, self.meta, ingest_options=ingest_options

@@ -21,6 +21,7 @@ Datanode-side fast paths for the repeated-query steady state:
 from __future__ import annotations
 
 import json
+import re
 
 import time
 from collections import OrderedDict
@@ -30,6 +31,12 @@ from greptimedb_tpu.catalog.table import Table, TableScanData
 from greptimedb_tpu import concurrency
 
 _DECODE_LRU_MAX = 64
+
+# the frontend splices the remaining deadline budget into the ticket
+# (dist_query.py _fan_out_stream); it varies per query, so the decode
+# memo keys on the ticket WITHOUT it — otherwise every deadline-bound
+# repeat of a hot query would miss the plan-decode cache
+_DEADLINE_FIELD_RE = re.compile(r'"deadline_s":[0-9.eE+-]+,')
 _decode_lock = concurrency.Lock()
 _decode_cache: OrderedDict[str, tuple] = OrderedDict()
 
@@ -103,13 +110,29 @@ def exec_partial(instance, doc: dict, raw: str | None = None):
     if doc.get("mode") != "plan":
         raise ValueError("partial_sql requires mode='plan'")
     t0 = time.perf_counter()
+    if raw is not None:
+        raw = _DEADLINE_FIELD_RE.sub("", raw, count=1)
     plan, info = _decode_ticket(raw, doc)
     rs = instance.region_server
     rids = [int(r) for r in doc["region_ids"]]
     regions = [rs._region(r) for r in rids]
     table = _DatanodeTable(info, regions, rs, rids)
-    with qstats.collect() as collected:
-        res = instance.query_engine.execute(plan, table)
+    # re-anchor the shipped deadline budget: cooperative checkpoints in
+    # the scan path (catalog/table.py) raise the typed deadline error
+    # datanode-side, so even a query the gRPC deadline cannot abort
+    # (already executing) stays bounded
+    from greptimedb_tpu.sched.deadline import Deadline, bind, reset
+
+    dl = Deadline.from_timeout(doc.get("deadline_s"))
+    token = bind(dl) if dl is not None else None
+    try:
+        if dl is not None:
+            dl.check("partial query")
+        with qstats.collect() as collected:
+            res = instance.query_engine.execute(plan, table)
+    finally:
+        if token is not None:
+            reset(token)
     exec_ms = (time.perf_counter() - t0) * 1000.0
     out = result_to_arrow(res)
     meta = dict(out.schema.metadata or {})

@@ -39,6 +39,9 @@ class StatusCode(enum.IntEnum):
 
     RUNTIME_RESOURCES_EXHAUSTED = 6000
     RATE_LIMITED = 6001
+    QUERY_OVERLOADED = 6002
+    QUERY_QUEUE_TIMEOUT = 6003
+    DEADLINE_EXCEEDED = 6004
 
     USER_NOT_FOUND = 7000
     UNSUPPORTED_PASSWORD_TYPE = 7001
@@ -147,13 +150,48 @@ class IllegalStateError(GreptimeError):
     status_code = StatusCode.ILLEGAL_STATE
 
 
-class IngestOverloadedError(GreptimeError):
+class OverloadedError(GreptimeError):
+    """Base of the typed overload surface: the node is shedding load
+    instead of queueing without bound. Every protocol maps these to a
+    back-off signal (HTTP 429/503, `[gtdb:<code>]` over Flight/MySQL/
+    Postgres) — never a hang."""
+
+    status_code = StatusCode.RATE_LIMITED
+
+
+class IngestOverloadedError(OverloadedError):
     """The ingest dataplane's bounded queues stayed full past the
     block timeout: a datanode is slow or stalled and the accepting
     edge sheds instead of growing memory without bound. Clients
     should back off and retry (HTTP surfaces map this to 429)."""
 
     status_code = StatusCode.RATE_LIMITED
+
+
+class QueryOverloadedError(OverloadedError):
+    """The frontend admission controller shed this query at the door:
+    the tenant is over its qps quota, or the bounded wait queue is
+    full. Retryable after client back-off (HTTP 429)."""
+
+    status_code = StatusCode.QUERY_OVERLOADED
+
+
+class QueryQueueTimeoutError(OverloadedError):
+    """The query was admitted to the wait queue but no execution slot
+    freed within the queue-time SLO: the instance is saturated. Shed
+    instead of growing the queue's sojourn time without bound
+    (HTTP 503)."""
+
+    status_code = StatusCode.QUERY_QUEUE_TIMEOUT
+
+
+class QueryDeadlineExceededError(GreptimeError):
+    """The query's absolute deadline expired — at a cooperative
+    checkpoint, or because a datanode failed to answer its bounded
+    per-call deadline (slow or blackholed). The deadline BOUNDS the
+    query; it never hangs (HTTP 503)."""
+
+    status_code = StatusCode.DEADLINE_EXCEEDED
 
 
 class ArithmeticOverflowError(ExecutionError):
@@ -181,10 +219,25 @@ _CODE_CLASSES: dict[StatusCode, type] = {
     StatusCode.REGION_READONLY: RegionReadonlyError,
     StatusCode.STORAGE_UNAVAILABLE: StorageError,
     StatusCode.RATE_LIMITED: IngestOverloadedError,
+    StatusCode.QUERY_OVERLOADED: QueryOverloadedError,
+    StatusCode.QUERY_QUEUE_TIMEOUT: QueryQueueTimeoutError,
+    StatusCode.DEADLINE_EXCEEDED: QueryDeadlineExceededError,
     StatusCode.FLOW_NOT_FOUND: FlowNotFoundError,
     StatusCode.FLOW_ALREADY_EXISTS: FlowAlreadyExistsError,
     StatusCode.ILLEGAL_STATE: IllegalStateError,
 }
+
+
+def wire_message(e: Exception) -> str:
+    """Error text with the `[gtdb:<code>]` marker prepended for typed
+    errors — the SAME marker the Flight boundary stamps
+    (servers/flight.py wrap_flight_error), reused on the MySQL and
+    Postgres wires so every protocol client can classify overload/
+    deadline/shed errors by code instead of prose."""
+    msg = str(e) or type(e).__name__
+    if isinstance(e, GreptimeError):
+        return f"[gtdb:{int(e.status_code)}] {msg}"
+    return msg
 
 
 def error_from_code(code: int, msg: str) -> GreptimeError:

@@ -300,6 +300,12 @@ class DatanodeSender:
         st = self._streams.get(key)
         if st is not None and st.alive:
             return st
+        # INTENTIONALLY unbounded call options: this is the long-lived
+        # pipelined ingest stream — it stays open across batches by
+        # design, and stalls are bounded elsewhere (per-group ack
+        # timeout ack_timeout_s + queue block_timeout_s shed), so a
+        # gRPC deadline here would just kill healthy parked streams
+        # gtlint: disable-next-line=GT012
         writer, reader = self.client._client().do_put(
             flight.FlightDescriptor.for_path(STREAM_DESCRIPTOR), schema
         )

@@ -94,8 +94,18 @@ class _ProcessList:
                 # NTP slew must not show negative or absurd elapsed
                 "_start_mono": time.monotonic(),
                 "killed": False,
+                # Queued until the admission controller grants a slot
+                # (sched/admission.py) — SHOW PROCESSLIST separates
+                # waiting work from running work under overload
+                "state": "Queued",
             }
             return pid
+
+    def set_state(self, pid: int, state: str):
+        with self._lock:
+            entry = self._running.get(pid)
+            if entry is not None:
+                entry["state"] = state
 
     def unregister(self, pid: int):
         with self._lock:
@@ -134,6 +144,13 @@ class _ProcessList:
                 for e in self._running.values()
             ]
 
+
+# statement kinds that consume engine/storage resources and therefore
+# pass through the admission controller; everything else (SHOW, SET,
+# ADMIN kill, DESCRIBE, ...) is control-plane and bypasses it
+_ADMITTED_STATEMENTS = (
+    A.Select, A.SetOp, A.Tql, A.Insert, A.Delete, A.Copy, A.Explain,
+)
 
 _xla_cache_enabled = False
 
@@ -184,6 +201,12 @@ class Standalone:
         self.flows = None  # wired by flow.FlowManager when enabled
         self._procedures = []
         self._process_list = _ProcessList()
+        # admission control + deadline scheduling (sched/): default
+        # config is permissive (no quotas/limits => never queues or
+        # sheds); cli.py swaps in the [scheduler]-configured one
+        from greptimedb_tpu.sched import AdmissionController
+
+        self.scheduler = AdmissionController()
         from greptimedb_tpu.telemetry.slow_query import SlowQueryLog
 
         self.slow_query_log = SlowQueryLog()
@@ -262,6 +285,16 @@ class Standalone:
         )
         try:
             with tracing.span(f"sql.{kind}"):
+                if isinstance(stmt, _ADMITTED_STATEMENTS):
+                    # data-plane statements go through admission
+                    # control (quota/slot/deadline); control-plane
+                    # statements (SHOW/SET/USE/ADMIN kill...) bypass so
+                    # an operator can still inspect and kill work on an
+                    # overloaded instance
+                    with self.scheduler.admit(ctx):
+                        self._process_list.set_state(pid, "Running")
+                        return self._execute_statement(stmt, ctx)
+                self._process_list.set_state(pid, "Running")
                 return self._execute_statement(stmt, ctx)
         finally:
             cancellation.reset(token)
@@ -579,11 +612,12 @@ class Standalone:
     def _show_processlist(self, stmt: A.ShowProcesslist):
         entries = self._process_list.snapshot()
         return _result_from_lists(
-            ["Id", "User", "db", "Command", "Time", "Info"],
+            ["Id", "User", "db", "Command", "State", "Time", "Info"],
             [[e["id"] for e in entries],
              [e["user"] for e in entries],
              [e["db"] for e in entries],
              ["Query"] * len(entries),
+             [e.get("state", "Running") for e in entries],
              [round(e["elapsed_s"], 3) for e in entries],
              [e["query"] for e in entries]],
         )

@@ -96,19 +96,23 @@ class Election:
             }).encode()
             won = False
             if raw is None:
-                won = self.kv.compare_and_put(self.key, None, new)
+                won = self.kv.compare_and_put(self.key, None, new,
+                                              durable=False)
             elif doc is None:
                 # corrupt leader key: CAS against its raw bytes so SOME
                 # candidate can always repair it
-                won = self.kv.compare_and_put(self.key, raw, new)
+                won = self.kv.compare_and_put(self.key, raw, new,
+                                              durable=False)
             elif doc.get("leader") == self.me:
                 # renew against the exact bytes we hold; a steal we
                 # haven't observed fails the CAS and demotes us
                 expect = (self._last_written
                           if self._last_written is not None else raw)
-                won = self.kv.compare_and_put(self.key, expect, new)
+                won = self.kv.compare_and_put(self.key, expect, new,
+                                              durable=False)
             elif float(doc.get("expires_at", 0.0)) < now:
-                won = self.kv.compare_and_put(self.key, raw, new)
+                won = self.kv.compare_and_put(self.key, raw, new,
+                                              durable=False)
             if won:
                 self._last_written = new
             was = self._is_leader
@@ -132,7 +136,7 @@ class Election:
                 # next candidate's step() takes over immediately
                 self.kv.compare_and_put(self.key, raw, json.dumps({
                     "leader": self.me, "expires_at": 0.0,
-                }).encode())
+                }).encode(), durable=False)
             was = self._is_leader
             self._is_leader = False
         if was and self.on_change is not None:

@@ -28,9 +28,23 @@ class KvBackend:
         raise NotImplementedError
 
     def compare_and_put(self, key: str, expect: bytes | None,
-                        value: bytes) -> bool:
-        """Atomic: put iff current value == expect (None == absent)."""
+                        value: bytes, *, durable: bool = True) -> bool:
+        """Atomic: put iff current value == expect (None == absent).
+        `durable=False` marks EPHEMERAL state (election leases): the
+        write must be atomic and visible, but need not survive power
+        loss — it expires on its own. Backends may skip fsync."""
         raise NotImplementedError
+
+    def put_many(self, items: list[tuple[str, bytes]]) -> None:
+        """Batch put: ONE commit (one flock + persist for durable
+        backends) instead of one per key — DDL fanning N region routes
+        must not pay N fsyncs."""
+        for k, v in items:
+            self.put(k, v)
+
+    def delete_many(self, keys: list[str]) -> int:
+        """Batch delete under one commit; returns how many existed."""
+        return sum(1 for k in keys if self.delete(k))
 
     # convenience
     def get_json(self, key: str):
@@ -65,13 +79,24 @@ class MemoryKv(KvBackend):
                 if k.startswith(prefix)
             )
 
-    def compare_and_put(self, key, expect, value):
+    def compare_and_put(self, key, expect, value, *, durable=True):
         with self._lock:
             cur = self._data.get(key)
             if cur != expect:
                 return False
             self._data[key] = bytes(value)
             return True
+
+    def put_many(self, items):
+        with self._lock:
+            for k, v in items:
+                self._data[k] = bytes(v)
+
+    def delete_many(self, keys):
+        with self._lock:
+            return sum(
+                1 for k in keys if self._data.pop(k, None) is not None
+            )
 
 
 class FsKv(KvBackend):
@@ -83,38 +108,61 @@ class FsKv(KvBackend):
     every operation revalidates the in-memory cache against the file's
     (mtime_ns, size) stamp, and mutations hold an OS-level flock on a
     sidecar lock file — so compare_and_put is a true cross-process CAS
-    and leader election over a shared data_home can't split-brain."""
+    and leader election over a shared data_home can't split-brain.
+
+    Keys written with `durable=False` (election leases) live in a
+    SIDECAR file (`<path>.eph`, atomic rename, never fsync'd): the
+    durable file is only ever replaced by an fsync'd copy, so a power
+    loss can lose at most the leases — which expire on their own —
+    never the routes/metadata the fsync exists to protect."""
 
     def __init__(self, path: str):
         self.path = path
-        self._mem = MemoryKv()
+        self._mem = MemoryKv()     # durable keys (fsync'd commits)
+        self._emem = MemoryKv()    # ephemeral keys (<path>.eph)
         self._lock = concurrency.RLock()
         self._stamp: tuple | None = None
+        self._estamp: tuple | None = None
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._reload_if_changed()
 
     # ---- cross-instance coherence -------------------------------------
-    def _file_stamp(self):
+    @property
+    def _eph_path(self) -> str:
+        return self.path + ".eph"
+
+    def _file_stamp(self, path: str | None = None):
         try:
-            st = os.stat(self.path)
+            st = os.stat(path or self.path)
             return (st.st_mtime_ns, st.st_size)
         except FileNotFoundError:
             return None
 
-    def _reload_if_changed(self):
-        stamp = self._file_stamp()
-        if stamp == self._stamp:
-            return
+    @staticmethod
+    def _load_file(path: str, stamp) -> MemoryKv | None:
         mem = MemoryKv()
         if stamp is not None:
             try:
-                with open(self.path) as f:
+                with open(path) as f:
                     for k, v in json.load(f).items():
                         mem.put(k, bytes.fromhex(v))
             except (ValueError, OSError):
-                return   # mid-replace read; next op retries
-        self._mem = mem
-        self._stamp = stamp
+                return None   # mid-replace read; next op retries
+        return mem
+
+    def _reload_if_changed(self):
+        stamp = self._file_stamp()
+        if stamp != self._stamp:
+            mem = self._load_file(self.path, stamp)
+            if mem is not None:
+                self._mem = mem
+                self._stamp = stamp
+        estamp = self._file_stamp(self._eph_path)
+        if estamp != self._estamp:
+            emem = self._load_file(self._eph_path, estamp)
+            if emem is not None:
+                self._emem = emem
+                self._estamp = estamp
 
     def _flock(self):
         import fcntl
@@ -141,10 +189,27 @@ class FsKv(KvBackend):
         os.replace(tmp, self.path)
         self._stamp = self._file_stamp()
 
+    def _persist_eph(self):
+        # NO fsync, by design: ephemeral writes (election lease
+        # renewals, every lease_s/3 forever) were stalling every
+        # concurrent kv mutation behind a loaded disk's fsync — the
+        # observed load-dependent golden wire-topology DROP timeout.
+        # The atomic rename keeps the write all-or-nothing and visible
+        # to peers; losing a lease to power loss is harmless (it
+        # expires anyway), and the durable file is untouched here.
+        doc = {k: v.hex() for k, v in self._emem.range("")}
+        tmp = self._eph_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+        os.replace(tmp, self._eph_path)
+        self._estamp = self._file_stamp(self._eph_path)
+
     def get(self, key):
         with self._lock:
             self._reload_if_changed()
-            return self._mem.get(key)
+            v = self._emem.get(key)
+            return v if v is not None else self._mem.get(key)
 
     # GTS103 (put/delete/compare_and_put): the in-process lock
     # deliberately covers the CROSS-PROCESS flock + fsync'd persist —
@@ -156,6 +221,9 @@ class FsKv(KvBackend):
             self._reload_if_changed()
             self._mem.put(key, value)
             self._persist()
+            if self._emem.delete(key):
+                # a durable write supersedes any ephemeral shadow
+                self._persist_eph()
 
     def delete(self, key):
         with self._lock, self._flock():  # gtlint: disable=GTS103
@@ -163,17 +231,72 @@ class FsKv(KvBackend):
             out = self._mem.delete(key)
             if out:
                 self._persist()
-            return out
+            eout = self._emem.delete(key)
+            if eout:
+                self._persist_eph()
+            return out or eout
 
     def range(self, prefix):
         with self._lock:
             self._reload_if_changed()
-            return self._mem.range(prefix)
+            merged = dict(self._mem.range(prefix))
+            merged.update(self._emem.range(prefix))
+            return tuple(sorted(merged.items()))
 
-    def compare_and_put(self, key, expect, value):
+    def compare_and_put(self, key, expect, value, *, durable=True):
         with self._lock, self._flock():  # gtlint: disable=GTS103
             self._reload_if_changed()
-            ok = self._mem.compare_and_put(key, expect, value)
-            if ok:
+            cur = self._emem.get(key)
+            in_eph = cur is not None
+            if not in_eph:
+                cur = self._mem.get(key)
+            if cur != (bytes(expect) if expect is not None else None):
+                return False
+            if durable:
+                self._mem.put(key, value)
                 self._persist()
-            return ok
+                if in_eph:
+                    self._emem.delete(key)
+                    self._persist_eph()
+            else:
+                # the ephemeral copy shadows any durable one on reads;
+                # in practice a key is one or the other for life
+                # (election leases are always durable=False)
+                self._emem.put(key, value)
+                self._persist_eph()
+            return True
+
+    def put_many(self, items):
+        if not items:
+            return
+        with self._lock, self._flock():  # gtlint: disable=GTS103
+            self._reload_if_changed()
+            emut = False
+            for k, v in items:
+                self._mem.put(k, v)
+                # like put(): a durable write supersedes any
+                # ephemeral shadow, or get() would serve stale bytes
+                emut |= self._emem.delete(k)
+            self._persist()
+            if emut:
+                self._persist_eph()
+
+    def delete_many(self, keys):
+        if not keys:
+            return 0
+        with self._lock, self._flock():  # gtlint: disable=GTS103
+            self._reload_if_changed()
+            n = 0
+            dmut = emut = False
+            for k in keys:
+                d = self._mem.delete(k)
+                e = self._emem.delete(k)
+                dmut |= d
+                emut |= e
+                if d or e:
+                    n += 1
+            if dmut:
+                self._persist()
+            if emut:
+                self._persist_eph()
+            return n

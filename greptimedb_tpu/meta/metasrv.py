@@ -142,15 +142,18 @@ class Metasrv:
     # routes
     # ------------------------------------------------------------------
     def allocate_regions(self, region_ids: list[int]) -> dict[int, int]:
-        """Place new regions on nodes via the selector; persist routes."""
+        """Place new regions on nodes via the selector; persist routes
+        as ONE kv commit (one flock + fsync, not one per region — a
+        multi-region CREATE must not pay N durable writes)."""
         with self._lock:
             chosen = self.selector.select(
                 list(self.nodes.values()), len(region_ids)
             )
-            routes = {}
-            for rid, nid in zip(region_ids, chosen):
-                self.kv.put_json(ROUTE_PREFIX + str(rid), nid)
-                routes[rid] = nid
+            routes = dict(zip(region_ids, chosen))
+            self.kv.put_many([
+                (ROUTE_PREFIX + str(rid), json.dumps(nid).encode())
+                for rid, nid in routes.items()
+            ])
             return routes
 
     def route_of(self, region_id: int) -> int | None:
@@ -161,8 +164,13 @@ class Metasrv:
         self.kv.put_json(ROUTE_PREFIX + str(region_id), node_id)
 
     def remove_routes(self, region_ids: list[int]):
-        for rid in region_ids:
-            self.kv.delete(ROUTE_PREFIX + str(rid))
+        # one kv commit for the whole table's routes: the DDL wait on
+        # the metasrv is bounded by ONE durable write, not N (the
+        # per-region loop was the load-dependent golden wire-topology
+        # DROP timeout — each delete fsync'd the whole kv file)
+        self.kv.delete_many(
+            [ROUTE_PREFIX + str(rid) for rid in region_ids]
+        )
 
     def _all_routes(self) -> dict[int, int]:
         return {

@@ -1,0 +1,25 @@
+"""Query admission control and scheduling dataplane.
+
+The frontend's overload surface (ROADMAP open item 4): per-tenant
+token buckets + concurrency limits with a bounded priority queue
+(`admission.py`), and end-to-end deadline propagation (`deadline.py`)
+so a slow or blackholed datanode BOUNDS a query instead of blocking
+it. Modeled on tf.data's pipelining-and-backpressure design
+(PAPERS.md): the accepting edge sheds typed errors under overload —
+`QueryOverloadedError` (429), `QueryQueueTimeoutError` (503),
+`QueryDeadlineExceededError` (503) — never a hang.
+"""
+
+from greptimedb_tpu.sched.admission import (
+    AdmissionController,
+    SchedulerConfig,
+    tenant_of,
+)
+from greptimedb_tpu.sched.deadline import Deadline
+
+__all__ = [
+    "AdmissionController",
+    "Deadline",
+    "SchedulerConfig",
+    "tenant_of",
+]

@@ -1,0 +1,103 @@
+"""End-to-end query deadlines.
+
+Every admitted query carries an absolute deadline (monotonic clock;
+wall clock would jump with NTP slews, GT011). The deadline flows:
+
+- frontend: bound into a contextvar for the statement's lifetime, so
+  every `cancellation.checkpoint()` along the execution path (per-
+  region scans, fan-out boundaries) raises the typed
+  `QueryDeadlineExceededError` the moment it lapses;
+- fan-out: the REMAINING budget becomes each datanode Flight call's
+  timeout (`FlightCallOptions`) and rides the partial-plan ticket as
+  `deadline_s`, so the datanode runs its own cooperative checks — a
+  blackholed datanode bounds, not blocks, the query;
+- datanode: `exec_partial` (dist/merge.py) re-binds the shipped
+  budget before executing.
+
+Monotonic deadlines do not transfer between processes, so only the
+remaining BUDGET crosses the wire and is re-anchored on arrival.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import math
+import time
+
+from greptimedb_tpu.errors import QueryDeadlineExceededError
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "gtpu_query_deadline", default=None
+)
+
+
+class Deadline:
+    """Absolute monotonic deadline for one query."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, timeout_s: float):
+        self.at = time.monotonic() + float(timeout_s)
+
+    @classmethod
+    def from_timeout(cls, timeout_s) -> "Deadline | None":
+        """None / <=0 / non-finite means unbounded (no deadline) —
+        nan or inf would make `at` arithmetic nonsense (never-firing
+        expired() but 0-second remaining()); the protocol edges
+        reject them as client errors before they get here."""
+        if timeout_s is None:
+            return None
+        t = float(timeout_s)
+        if not math.isfinite(t) or t <= 0:
+            return None
+        return cls(t)
+
+    def remaining(self) -> float:
+        return max(0.0, self.at - time.monotonic())
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.at
+
+    def check(self, what: str = "query"):
+        if self.expired():
+            raise QueryDeadlineExceededError(
+                f"{what} deadline exceeded"
+            )
+
+
+def bind(deadline: Deadline | None):
+    """Install `deadline` for the current context; returns a token for
+    `reset`. Binding None clears an inherited deadline."""
+    return _current.set(deadline)
+
+
+def reset(token):
+    _current.reset(token)
+
+
+def current() -> Deadline | None:
+    return _current.get()
+
+
+def remaining() -> float | None:
+    """Seconds left on the active deadline; None when unbounded."""
+    d = _current.get()
+    return None if d is None else d.remaining()
+
+
+def call_timeout(cap_s: float | None = None) -> float | None:
+    """Per-RPC timeout derived from the active deadline, optionally
+    capped: min(remaining, cap). None = no bound requested anywhere."""
+    r = remaining()
+    if r is None:
+        return cap_s
+    return r if cap_s is None else min(r, cap_s)
+
+
+def check(what: str = "query"):
+    """Raise QueryDeadlineExceededError when the active deadline has
+    lapsed; no-op when unbounded. Called from every
+    cancellation.checkpoint()."""
+    d = _current.get()
+    if d is not None:
+        d.check(what)

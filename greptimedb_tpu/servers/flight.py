@@ -21,22 +21,17 @@ import pyarrow as pa
 import pyarrow.flight as flight
 
 from greptimedb_tpu.datatypes.batch import HostColumn
-from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.errors import wire_message
 from greptimedb_tpu.session import QueryContext
 
 from greptimedb_tpu import concurrency
 
 def wrap_flight_error(e: Exception) -> flight.FlightServerError:
     """Stamp a typed engine error's status code onto the Flight message
-    (`[gtdb:<code>]`) so the far side re-raises the dedicated class
-    instead of substring-matching text (dist/client.py
-    map_flight_error)."""
-    msg = str(e) or type(e).__name__
-    if isinstance(e, GreptimeError):
-        return flight.FlightServerError(
-            f"[gtdb:{int(e.status_code)}] {msg}"
-        )
-    return flight.FlightServerError(msg)
+    (`[gtdb:<code>]`, the shared errors.wire_message marker) so the far
+    side re-raises the dedicated class instead of substring-matching
+    text (dist/client.py map_flight_error)."""
+    return flight.FlightServerError(wire_message(e))
 
 
 def result_to_arrow(res) -> pa.Table:
@@ -66,9 +61,17 @@ def result_to_arrow(res) -> pa.Table:
         fields.append(pa.field(name, arr.type))
     tbl = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
     declared = {n: dt.name for n, dt in res.types.items() if dt is not None}
+    meta = dict(tbl.schema.metadata or {})
     if declared:
-        meta = dict(tbl.schema.metadata or {})
         meta[b"gtdb:types"] = _json.dumps(declared).encode()
+    if getattr(res, "partial", False):
+        # degraded answer (sched/: per-datanode deadline expiry or
+        # unavailability under allow_partial_results): the marker must
+        # survive the Flight hop so remote frontends re-stamp it
+        meta[b"gtdb:partial"] = _json.dumps({
+            "missing_regions": int(getattr(res, "missing_regions", 0)),
+        }).encode()
+    if meta:
         tbl = tbl.replace_schema_metadata(meta)
     return tbl
 
@@ -220,22 +223,34 @@ class FlightServer(flight.FlightServerBase):
         rpc = doc.get("rpc")
         if rpc == "region_scan":
             from greptimedb_tpu.dist import plan_codec
+            from greptimedb_tpu.sched import deadline as _dl
 
             rs = self._region_server()
-            rows, tag_values, names, stats = rs.scan(
-                doc["region_ids"],
-                ts_min=doc.get("ts_min"), ts_max=doc.get("ts_max"),
-                field_names=doc.get("fields"),
-                matchers=(
-                    [(m[0], m[1], plan_codec.decode(m[2]))
-                     for m in doc["matchers"]]
-                    if doc.get("matchers") else None
-                ),
-                fulltext=(
-                    [tuple(f) for f in doc["fulltext"]]
-                    if doc.get("fulltext") else None
-                ),
-            )
+            # re-anchor the shipped deadline budget for cooperative
+            # checks along the scan path (a blackholed disk/object
+            # store must bound, not block, the scan)
+            dl = _dl.Deadline.from_timeout(doc.get("deadline_s"))
+            token = _dl.bind(dl) if dl is not None else None
+            try:
+                if dl is not None:
+                    dl.check("region scan")
+                rows, tag_values, names, stats = rs.scan(
+                    doc["region_ids"],
+                    ts_min=doc.get("ts_min"), ts_max=doc.get("ts_max"),
+                    field_names=doc.get("fields"),
+                    matchers=(
+                        [(m[0], m[1], plan_codec.decode(m[2]))
+                         for m in doc["matchers"]]
+                        if doc.get("matchers") else None
+                    ),
+                    fulltext=(
+                        [tuple(f) for f in doc["fulltext"]]
+                        if doc.get("fulltext") else None
+                    ),
+                )
+            finally:
+                if token is not None:
+                    _dl.reset(token)
             return dist_codec.scan_to_arrow(
                 rows, tag_values, names, extra_meta={"gtdb:stats": stats}
             )

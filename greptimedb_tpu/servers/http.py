@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import gzip
 import json
+import math
 import threading
 
 import time
@@ -302,12 +303,16 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             except GreptimeError as e:
                 from greptimedb_tpu.errors import StatusCode
 
-                # backpressure from the ingest dataplane sheds with 429
-                # (clients back off + retry); an unreachable storage
-                # layer is the server's fault: 503
+                # backpressure sheds with 429 (over-quota tenant /
+                # ingest queues full: client backs off + retries); a
+                # saturated queue-time SLO, an expired deadline, or an
+                # unreachable storage layer is the server's state: 503
                 http_code = {
                     StatusCode.RATE_LIMITED: 429,
+                    StatusCode.QUERY_OVERLOADED: 429,
                     StatusCode.RUNTIME_RESOURCES_EXHAUSTED: 429,
+                    StatusCode.QUERY_QUEUE_TIMEOUT: 503,
+                    StatusCode.DEADLINE_EXCEEDED: 503,
                     StatusCode.STORAGE_UNAVAILABLE: 503,
                 }.get(e.status_code, 400)
                 self._error(http_code, str(e))
@@ -465,6 +470,22 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             if fmt not in ("csv", "table", "greptimedb_v1"):
                 return self._error(400, f"unknown format {fmt!r}")
             ctx = QueryContext(database=db)
+            # per-request deadline: ?timeout=<seconds> or the
+            # X-Greptime-Timeout header override the [scheduler]
+            # default; the admission controller binds it end to end
+            timeout = (params.get("timeout")
+                       or self.headers.get("X-Greptime-Timeout"))
+            if timeout is not None:
+                try:
+                    t = float(timeout)
+                except ValueError:
+                    return self._error(400, f"bad timeout {timeout!r}")
+                # nan/inf would make Deadline arithmetic nonsense
+                # (never-expiring checks but 0-second RPC budgets);
+                # <=0 is an already-spent budget — all client errors
+                if not math.isfinite(t) or t <= 0:
+                    return self._error(400, f"bad timeout {timeout!r}")
+                ctx.extensions["deadline_s"] = t
             t0 = time.perf_counter()
             outputs = instance.execute_sql(sql, ctx)
             elapsed = (time.perf_counter() - t0) * 1000
@@ -484,15 +505,28 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                     "text/csv" if fmt == "csv" else "text/plain",
                 )
             out_json = []
+            partial = None
             for o in outputs:
                 if o.result is not None:
                     out_json.append(result_to_json(o.result))
+                    if getattr(o.result, "partial", False):
+                        partial = {
+                            "partial": True,
+                            "missing_regions": int(getattr(
+                                o.result, "missing_regions", 0)),
+                        }
                 else:
                     out_json.append({"affectedrows": o.affected_rows or 0})
-            self._json(200, {
+            doc = {
                 "output": out_json,
                 "execution_time_ms": round(elapsed, 3),
-            })
+            }
+            if partial is not None:
+                # graceful degradation is EXPLICIT: a client must be
+                # able to tell a complete answer from a shed-datanode
+                # one ([scheduler] allow_partial_results)
+                doc.update(partial)
+            self._json(200, doc)
 
         # ------------------------------------------------------------------
         def _handle_prom_api(self, endpoint: str):

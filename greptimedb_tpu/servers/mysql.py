@@ -24,6 +24,7 @@ import struct
 import logging
 import threading
 
+from greptimedb_tpu.errors import wire_message
 from greptimedb_tpu.session import QueryContext
 
 from greptimedb_tpu import concurrency
@@ -368,7 +369,7 @@ class _Handler(socketserver.BaseRequestHandler):
         try:
             outs = inst.execute_sql(stripped, ctx)
         except Exception as e:  # noqa: BLE001 - protocol boundary
-            conn.send_packet(self._err(1064, "42000", str(e)))
+            conn.send_packet(self._err(1064, "42000", wire_message(e)))
             return
         out = outs[-1]
         if out.result is None:
@@ -439,7 +440,7 @@ class _Handler(socketserver.BaseRequestHandler):
             bound = substitute_placeholders(sql, args)
             outs = inst.execute_sql(bound, ctx)
         except Exception as e:  # noqa: BLE001 - protocol boundary
-            conn.send_packet(self._err(1064, "42000", str(e)))
+            conn.send_packet(self._err(1064, "42000", wire_message(e)))
             return
         out = outs[-1]
         if out.result is None:

@@ -23,6 +23,7 @@ import struct
 import logging
 import threading
 
+from greptimedb_tpu.errors import wire_message
 from greptimedb_tpu.session import QueryContext
 
 from greptimedb_tpu import concurrency
@@ -411,7 +412,7 @@ class _Handler(socketserver.BaseRequestHandler):
             try:
                 outs = inst.execute_sql(sql, ctx)
             except Exception as e:  # noqa: BLE001 - protocol boundary
-                conn.send(_error("42601", str(e)))
+                conn.send(_error("42601", wire_message(e)))
                 return
             if len(outs) != len(stmts):
                 stmts = stmts[-len(outs):] if outs else []
@@ -423,7 +424,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 try:
                     out = exec_stmt(st, ctx)
                 except Exception as e:  # noqa: BLE001
-                    conn.send(_error("42601", str(e)))
+                    conn.send(_error("42601", wire_message(e)))
                     return
             else:
                 out = pre

@@ -61,7 +61,13 @@ def arrow_to_result(table) -> QueryResult:
             )
         else:
             types[field.name] = ConcreteDataType.from_arrow(field.type)
-    return QueryResult(names, cols, types)
+    res = QueryResult(names, cols, types)
+    if b"gtdb:partial" in meta:
+        # degraded (partial) answer marker survives the Flight hop
+        part = json.loads(meta[b"gtdb:partial"])
+        res.partial = True
+        res.missing_regions = int(part.get("missing_regions", 0))
+    return res
 
 
 class _RemoteCatalog:
@@ -103,12 +109,21 @@ class RemoteInstance:
     def execute_sql(self, sql: str, ctx: QueryContext | None = None):
         import pyarrow.flight as flight
 
+        from greptimedb_tpu.sched import deadline as _dl
+
         db = getattr(ctx, "database", None) or "public"
         ticket = flight.Ticket(
             json.dumps({"sql": sql, "db": db}).encode()
         )
         try:
-            reader = self._client(self.addrs[0]).do_get(ticket)
+            # bounded by the active query deadline when one is set;
+            # None = explicitly unbounded (legacy proxy path)
+            reader = self._client(self.addrs[0]).do_get(
+                ticket,
+                options=flight.FlightCallOptions(
+                    timeout=_dl.call_timeout()
+                ),
+            )
             table = reader.read_all()
         except flight.FlightError as e:
             # surface the datanode's message (typed when it carries a

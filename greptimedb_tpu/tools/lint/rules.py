@@ -508,6 +508,73 @@ class WallClockDuration(Rule):
                 return
 
 
+_FLIGHT_CLIENT_CALLS = {"do_get", "do_put", "do_action"}
+_TIMEOUT_KW_CALLS = {"urlopen", "create_connection"}
+
+
+@register
+class UnboundedBlockingCall(Rule):
+    id = "GT012"
+    name = "unbounded-blocking-call"
+    description = (
+        "An Arrow Flight client call (do_get/do_put/do_action) without "
+        "explicit call `options`, or urlopen/socket.create_connection "
+        "without a `timeout`, waits on the gRPC/socket default — "
+        "i.e. forever against a blackholed peer. Every blocking call "
+        "carries an explicit deadline decision at the call site "
+        "(sched/deadline.call_timeout for query-path calls); "
+        "intentionally unbounded long-lived streams suppress with a "
+        "justification."
+    )
+
+    @staticmethod
+    def _has_kw(node: ast.Call, name: str) -> bool:
+        return any(kw.arg == name for kw in node.keywords)
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        if not isinstance(node.func, ast.Attribute):
+            # bare urlopen(...) from `from urllib.request import
+            # urlopen` still needs the timeout (keyword OR positional:
+            # urlopen(url, data, timeout) / create_connection(addr,
+            # timeout) — same shapes the attribute branch accepts)
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _TIMEOUT_KW_CALLS):
+                pos_ok = (len(node.args) >= 3
+                          if node.func.id == "urlopen"
+                          else len(node.args) >= 2)
+                if not pos_ok and not self._has_kw(node, "timeout"):
+                    ctx.report(self, node,
+                               f"{node.func.id}(...) without timeout= "
+                               "blocks forever against a blackholed "
+                               "peer; pass an explicit timeout")
+            return
+        attr = node.func.attr
+        if attr in _FLIGHT_CLIENT_CALLS:
+            # server-side handler plumbing (self._do_action and co.)
+            # is not a Flight client call; the client calls go through
+            # a connection object, never self/cls
+            base = dotted_name(node.func.value)
+            if base in ("self", "cls"):
+                return
+            if not self._has_kw(node, "options"):
+                ctx.report(self, node,
+                           f".{attr}(...) without explicit call "
+                           "options carries no deadline — a "
+                           "blackholed peer hangs the caller; pass "
+                           "options=FlightCallOptions(timeout=...) "
+                           "(None only as an explicit decision)")
+        elif attr in _TIMEOUT_KW_CALLS:
+            # positional timeout: urlopen(url, data, timeout) /
+            # socket.create_connection(addr, timeout)
+            pos_ok = (len(node.args) >= 3 if attr == "urlopen"
+                      else len(node.args) >= 2)
+            if not pos_ok and not self._has_kw(node, "timeout"):
+                ctx.report(self, node,
+                           f"{attr}(...) without timeout= blocks "
+                           "forever against a blackholed peer; pass "
+                           "an explicit timeout")
+
+
 _MUTABLE_CTORS = {"list", "dict", "set"}
 
 

@@ -103,7 +103,8 @@ def test_show_status_charset_collation_processlist(inst):
     # the processlist contains the SHOW PROCESSLIST statement itself
     r = inst.sql("SHOW PROCESSLIST")
     assert r.num_rows >= 1
-    assert "ShowProcesslist" in list(r.cols[5].values)
+    assert "State" in r.names
+    assert "ShowProcesslist" in list(r.column("Info").values)
 
 
 def test_admin_kill_nonexistent(inst):

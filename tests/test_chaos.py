@@ -178,6 +178,84 @@ def test_crash_failover_write_fuzz(tmp_path):
     c.shutdown()
 
 
+def test_blackholed_datanode_bounds_query_under_admission(tmp_path):
+    """Blackhole (hang, not kill) a datanode mid-query under admission
+    control: the client gets a typed partial result (allow_partial) or
+    the typed deadline error within the deadline — NEVER a hang, and
+    no leaked threads (the gtsan plugin enforces leak-freedom when
+    this runs under GTPU_SAN=1)."""
+    import pytest
+
+    pytest.importorskip("pyarrow.flight")
+    import threading
+
+    from test_dist_cluster import DistHarness
+
+    from greptimedb_tpu.errors import QueryDeadlineExceededError
+    from greptimedb_tpu.sched import AdmissionController, SchedulerConfig
+    from greptimedb_tpu.session import QueryContext
+
+    h = DistHarness(tmp_path, n_datanodes=2)
+    release = threading.Event()
+    try:
+        h.frontend.execute_sql(
+            "create table t (ts timestamp time index, host string "
+            "primary key, v double) with (num_regions = 3)"
+        )
+        vals = ", ".join(
+            f"('h{i % 6}', {1_700_000_000_000 + i * 1000}, {float(i)})"
+            for i in range(60)
+        )
+        h.frontend.execute_sql(f"insert into t (host, ts, v) values {vals}")
+        full = float(h.frontend.sql("select sum(v) from t")
+                     .cols[0].values[0])
+        assert full == float(sum(range(60)))
+
+        # blackhole datanode 0: its scans park on an event instead of
+        # answering — the socket stays open, so only the DEADLINE can
+        # bound the query (the unavailable/refused case is covered by
+        # tests/test_sched.py::test_partial_result_when_datanode_dies)
+        rs0 = h.datanodes[0][0].region_server
+        real_scan_entry = rs0.scan_entry
+
+        def blackholed_scan_entry(*args, **kwargs):
+            release.wait(30)   # far beyond the query deadline
+            return real_scan_entry(*args, **kwargs)
+
+        rs0.scan_entry = blackholed_scan_entry
+
+        # 1) graceful degradation on: typed partial within the deadline
+        h.frontend.scheduler = AdmissionController(SchedulerConfig(
+            default_deadline_s=2.0, allow_partial_results=True,
+        ))
+        t0 = time.time()
+        res = h.frontend.sql("select sum(v) from t")
+        elapsed = time.time() - t0
+        assert elapsed < 10.0, f"query not bounded: {elapsed:.1f}s"
+        assert getattr(res, "partial", False) is True
+        assert res.missing_regions >= 1
+        assert float(res.cols[0].values[0]) < full
+
+        # 2) degradation off: the TYPED deadline error, still bounded
+        h.frontend.scheduler = AdmissionController(SchedulerConfig(
+            default_deadline_s=2.0, allow_partial_results=False,
+        ))
+        t0 = time.time()
+        with pytest.raises(QueryDeadlineExceededError):
+            h.frontend.sql("select sum(v) from t")
+        assert time.time() - t0 < 10.0
+
+        # 3) un-blackhole: the same instance fully recovers
+        release.set()
+        rs0.scan_entry = real_scan_entry
+        res = h.frontend.sql("select sum(v) from t")
+        assert float(res.cols[0].values[0]) == full
+        assert not getattr(res, "partial", False)
+    finally:
+        release.set()   # unpark any handler still waiting
+        h.close()
+
+
 def test_process_kill_mid_write_wal_replay(tmp_path):
     """SIGKILL a datanode OS process during ingest; restart it with the
     same data-home. Every ACKNOWLEDGED insert must be queryable after

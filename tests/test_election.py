@@ -1,5 +1,6 @@
 """Metasrv HA: lease election over the CAS kv (VERDICT missing #9)."""
 
+import json
 import time
 
 from greptimedb_tpu.meta.election import Election
@@ -77,6 +78,44 @@ def test_election_durable_across_kv_reload(tmp_path):
     b = Election(kv2, "b", lease_s=30.0)
     assert not b.step(now=100.1)
     assert b.leader()[0] == "a"
+
+
+def test_fskv_ephemeral_lease_never_rewrites_durable_file(tmp_path):
+    """durable=False commits (election leases) go to the `.eph`
+    sidecar: the fsync'd durable file is never replaced by an
+    un-fsynced copy, so a power loss mid-lease-renewal can lose at
+    most the lease — never routes/metadata. (The un-fsynced whole-file
+    rewrite was the load-dependent DROP-timeout root cause's fix, and
+    this pins that the fix can't cost durable state.)"""
+    import os
+
+    path = str(tmp_path / "kv.json")
+    kv = FsKv(path)
+    kv.put("route/1", b"node-a")          # durable state
+    durable_stamp = os.stat(path).st_mtime_ns
+    assert kv.compare_and_put("lease", None, b"me", durable=False)
+    # the durable file is untouched; the lease lives in the sidecar
+    assert os.stat(path).st_mtime_ns == durable_stamp
+    assert os.path.exists(path + ".eph")
+    # both stores are visible, merged, to a fresh process view
+    kv2 = FsKv(path)
+    assert kv2.get("route/1") == b"node-a"
+    assert kv2.get("lease") == b"me"
+    assert dict(kv2.range("")) == {"route/1": b"node-a",
+                                   "lease": b"me"}
+    # CAS semantics hold across the two stores
+    assert not kv2.compare_and_put("lease", b"stale", b"you",
+                                   durable=False)
+    assert kv2.compare_and_put("lease", b"me", b"you", durable=False)
+    assert kv.get("lease") == b"you"      # first view reloads
+    # a durable batch write supersedes an ephemeral shadow like put()
+    kv2.put_many([("lease", b"durable-now"), ("route/2", b"node-b")])
+    assert kv.get("lease") == b"durable-now"
+    assert not json.load(open(path + ".eph"))
+    # losing the sidecar (the power-loss case) loses ONLY the lease
+    kv2.delete("lease")
+    assert kv.get("route/1") == b"node-a"
+    assert kv.get("lease") is None
 
 
 def test_metasrv_server_election_and_failover():

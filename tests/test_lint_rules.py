@@ -35,7 +35,7 @@ def rules_hit(src: str, select: str | None = None):
 
 def test_registry_has_all_rules():
     ids = sorted(all_rules())
-    assert ids == [f"GT{n:03d}" for n in range(1, 12)]
+    assert ids == [f"GT{n:03d}" for n in range(1, 13)]
     for rule in all_rules().values():
         assert rule.name and rule.description
 
@@ -274,8 +274,11 @@ def test_gt007_positive_urlopen_flight_sleep_under_lock():
             with lock:
                 time.sleep(1.0)
     """)
-    assert [h[0] for h in hits] == ["GT007", "GT007", "GT007"]
-    assert [h[1] for h in hits] == [10, 12, 14]
+    # the same unbounded urlopen/do_get also trip GT012: filter to the
+    # lock-discipline findings this test is about
+    gt007 = [h for h in hits if h[0] == "GT007"]
+    assert [h[1] for h in gt007] == [10, 12, 14]
+    assert {h[0] for h in hits} == {"GT007", "GT012"}
 
 
 def test_gt007_negative_io_outside_lock_and_condvar():
@@ -289,7 +292,7 @@ def test_gt007_negative_io_outside_lock_and_condvar():
         def f():
             with lock:
                 snapshot = 1
-            urllib.request.urlopen("http://x")
+            urllib.request.urlopen("http://x", timeout=5.0)
             with cond:
                 cond.wait()   # releases the lock: allowed
             return snapshot
@@ -895,6 +898,111 @@ def test_gt011_negative_epoch_ms_and_monotonic():
             now = time.monotonic()
             return now - t0
     """) == []
+
+
+# ---------------------------------------------------------------------------
+# GT012 unbounded blocking calls
+# ---------------------------------------------------------------------------
+
+def test_gt012_positive_flight_calls_without_options():
+    hits = rules_hit("""
+        def scan(client, ticket):
+            reader = client.do_get(ticket)
+            return reader.read_all()
+    """)
+    assert ("GT012", 3) in hits
+    hits = rules_hit("""
+        def put(conn, desc, schema):
+            return conn.do_put(desc, schema)
+    """)
+    assert ("GT012", 3) in hits
+    hits = rules_hit("""
+        def act(conn, action):
+            return list(conn.do_action(action))
+    """)
+    assert ("GT012", 3) in hits
+
+
+def test_gt012_positive_urlopen_and_socket_without_timeout():
+    hits = rules_hit("""
+        import urllib.request
+
+        def fetch(url):
+            with urllib.request.urlopen(url) as r:
+                return r.read()
+    """)
+    assert ("GT012", 5) in hits
+    hits = rules_hit("""
+        from urllib.request import urlopen
+
+        def fetch(url):
+            return urlopen(url).read()
+    """)
+    assert ("GT012", 5) in hits
+    hits = rules_hit("""
+        import socket
+
+        def dial(addr):
+            return socket.create_connection(addr)
+    """)
+    assert ("GT012", 5) in hits
+
+
+def test_gt012_negative_bounded_calls():
+    assert rules_hit("""
+        import urllib.request
+
+        def fetch(url):
+            with urllib.request.urlopen(url, timeout=5.0) as r:
+                return r.read()
+    """, "GT012") == []
+    # positional timeout forms count as explicit
+    assert rules_hit("""
+        import socket
+
+        def dial(addr):
+            return socket.create_connection(addr, 3.0)
+    """, "GT012") == []
+    # ... including on bare-name imports
+    assert rules_hit("""
+        from urllib.request import urlopen
+
+        def fetch(url):
+            return urlopen(url, None, 5.0).read()
+    """, "GT012") == []
+    assert rules_hit("""
+        from socket import create_connection
+
+        def dial(addr):
+            return create_connection(addr, 3.0)
+    """, "GT012") == []
+    assert rules_hit("""
+        import pyarrow.flight as flight
+
+        def scan(client, ticket, timeout):
+            return client.do_get(
+                ticket, options=flight.FlightCallOptions(timeout=timeout)
+            ).read_all()
+    """, "GT012") == []
+    # server-side dispatch plumbing is not a Flight client call
+    assert rules_hit("""
+        class Server:
+            def do_action(self, context, action):
+                return self._do_action(action.type)
+
+            def handle(self, context, action):
+                return self.do_action(context, action)
+    """, "GT012") == []
+
+
+def test_gt012_suppressible():
+    act, sup = run_lint("""
+        def stream(conn, desc, schema):
+            # long-lived by design
+            # gtlint: disable-next-line=GT012
+            return conn.do_put(desc, schema)
+    """, "GT012")
+    assert act == [] and [f.rule for f in sup] == ["GT012"]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,482 @@
+"""Admission control + deadline scheduling (greptimedb_tpu/sched/).
+
+Tier-1 gate for the overload surface: typed shedding (429/503 class
+errors, never a hang), per-tenant isolation (an over-quota tenant is
+shed while an in-quota tenant on the same instance completes), deadline
+propagation through cooperative checkpoints and the distributed
+fan-out, `gtpu_sched_*` observability in /metrics and
+information_schema, and the queued/running split in SHOW PROCESSLIST.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from greptimedb_tpu.errors import (
+    QueryDeadlineExceededError,
+    QueryOverloadedError,
+    QueryQueueTimeoutError,
+)
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.sched import (
+    AdmissionController,
+    Deadline,
+    SchedulerConfig,
+    tenant_of,
+)
+from greptimedb_tpu.session import QueryContext
+
+
+@pytest.fixture()
+def inst(tmp_path):
+    inst = Standalone(str(tmp_path / "data"), prefer_device=False,
+                      warm_start=False)
+    yield inst
+    inst.close()
+
+
+# ---------------------------------------------------------------------
+# controller unit behavior
+# ---------------------------------------------------------------------
+
+def test_tenant_identity():
+    assert tenant_of(QueryContext()) == "public"
+    assert tenant_of(QueryContext(database="metrics")) == "metrics"
+    assert tenant_of(QueryContext(username="alice",
+                                  database="metrics")) == "alice"
+
+
+def test_qps_quota_sheds_typed():
+    c = AdmissionController(SchedulerConfig(tenant_qps=1.0,
+                                            tenant_burst=1.0))
+    ctx = QueryContext(username="noisy")
+    with c.admit(ctx):
+        pass
+    with pytest.raises(QueryOverloadedError):
+        with c.admit(ctx):
+            pass
+    # tokens refill at qps: after a second one passes again
+    time.sleep(1.05)
+    with c.admit(ctx):
+        pass
+
+
+def test_per_tenant_quota_isolation():
+    """The over-quota tenant sheds; another tenant on the SAME
+    controller is untouched."""
+    c = AdmissionController(SchedulerConfig(
+        tenants={"noisy": {"qps": 1.0, "burst": 1.0}},
+    ))
+    with c.admit(QueryContext(username="noisy")):
+        pass
+    with pytest.raises(QueryOverloadedError):
+        with c.admit(QueryContext(username="noisy")):
+            pass
+    for _ in range(5):   # unlimited tenant: never shed
+        with c.admit(QueryContext(username="quiet")):
+            pass
+
+
+def test_queue_timeout_and_queue_full_shed_typed():
+    c = AdmissionController(SchedulerConfig(
+        max_concurrency=1, queue_depth=1, queue_timeout_s=0.2,
+    ))
+    hold = c.admit(QueryContext())
+    hold.__enter__()
+    try:
+        results = {}
+
+        def attempt(name, delay):
+            time.sleep(delay)
+            try:
+                with c.admit(QueryContext(username=name)):
+                    results[name] = "admitted"
+            except Exception as e:  # noqa: BLE001 - recorded
+                results[name] = type(e).__name__
+
+        ts = [threading.Thread(target=attempt, args=("waiter", 0.0)),
+              threading.Thread(target=attempt, args=("spill", 0.05))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(5)
+        # first queues then times out (503 class); second finds the
+        # bounded queue full and sheds immediately (429 class)
+        assert results["waiter"] == "QueryQueueTimeoutError"
+        assert results["spill"] == "QueryOverloadedError"
+    finally:
+        hold.__exit__(None, None, None)
+
+
+def test_queue_knobs_zero_mean_unlimited():
+    """queue_depth=0 / queue_timeout_s=0 follow the same 0=unlimited
+    convention as every other limit knob: an unbounded queue never
+    sheds queue_full, and no SLO means the waiter holds on until a
+    slot frees (or its deadline lapses)."""
+    c = AdmissionController(SchedulerConfig(
+        max_concurrency=1, queue_depth=0, queue_timeout_s=0.0,
+    ))
+    hold = c.admit(QueryContext())
+    hold.__enter__()
+    outcomes = []
+    lock = threading.Lock()
+
+    def attempt():
+        try:
+            with c.admit(QueryContext()):
+                with lock:
+                    outcomes.append("admitted")
+        except Exception as e:  # noqa: BLE001 - recorded
+            with lock:
+                outcomes.append(type(e).__name__)
+
+    ts = [threading.Thread(target=attempt) for _ in range(3)]
+    for t in ts:
+        t.start()
+    time.sleep(0.3)   # well past a 0-valued SLO misread as 0 seconds
+    assert outcomes == [] and c.snapshot()["queued"] == 3
+    hold.__exit__(None, None, None)
+    for t in ts:
+        t.join(10)
+    assert outcomes == ["admitted"] * 3
+
+
+def test_tenant_state_stays_bounded_under_name_rotation():
+    """The tenant string is client-controlled (HTTP db param): a storm
+    rotating names must not grow per-tenant state without bound."""
+    from greptimedb_tpu.sched import admission
+
+    c = AdmissionController(SchedulerConfig(tenant_qps=100.0,
+                                            tenant_burst=100.0))
+    n = admission._TENANT_STATE_MAX + 64
+    for i in range(n):
+        with c.admit(tenant=f"t{i}"):
+            pass
+    assert len(c._buckets) <= admission._TENANT_STATE_MAX
+    # unconfigured tenants share ONE limits object (nothing cached)
+    assert c.config._limits_cache == {}
+    assert c.config.limits("t0") is c.config.limits("t999999")
+
+
+def test_slot_handover_wakes_waiter():
+    c = AdmissionController(SchedulerConfig(max_concurrency=1,
+                                            queue_timeout_s=5.0))
+    hold = c.admit(QueryContext())
+    hold.__enter__()
+    admitted = threading.Event()
+
+    def waiter():
+        with c.admit(QueryContext()):
+            admitted.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    assert not admitted.is_set()
+    hold.__exit__(None, None, None)
+    t.join(5)
+    assert admitted.is_set()
+    snap = c.snapshot()
+    assert snap["running"] == 0 and snap["queued"] == 0
+
+
+def test_priority_orders_the_queue():
+    """Two tenants queued behind a held slot: the lower-priority
+    number is admitted first regardless of arrival order."""
+    c = AdmissionController(SchedulerConfig(
+        max_concurrency=1, queue_timeout_s=5.0,
+        tenants={"fast": {"priority": 1}, "slow": {"priority": 200}},
+    ))
+    hold = c.admit(QueryContext())
+    hold.__enter__()
+    order = []
+    lock = threading.Lock()
+
+    def run(name):
+        with c.admit(QueryContext(username=name)):
+            with lock:
+                order.append(name)
+            time.sleep(0.05)
+
+    t_slow = threading.Thread(target=run, args=("slow",))
+    t_slow.start()
+    time.sleep(0.05)   # slow is queued first
+    t_fast = threading.Thread(target=run, args=("fast",))
+    t_fast.start()
+    time.sleep(0.05)
+    hold.__exit__(None, None, None)
+    t_slow.join(5)
+    t_fast.join(5)
+    assert order == ["fast", "slow"]
+
+
+def test_nested_admission_rides_parent_slot():
+    """A statement executed INSIDE an admitted statement (prepared
+    EXECUTE, COPY's inner SELECT) must not deadlock on its own
+    tenant's concurrency limit."""
+    c = AdmissionController(SchedulerConfig(max_concurrency=1,
+                                            queue_timeout_s=0.1))
+    with c.admit(QueryContext()):
+        with c.admit(QueryContext()):   # would deadlock if counted
+            pass
+    assert c.snapshot()["running"] == 0
+
+
+def test_deadline_expires_in_queue():
+    c = AdmissionController(SchedulerConfig(
+        max_concurrency=1, queue_timeout_s=10.0,
+    ))
+    hold = c.admit(QueryContext())
+    hold.__enter__()
+    try:
+        result = {}
+
+        def attempt():
+            # separate thread: the same-thread re-entrancy guard would
+            # otherwise treat this as a nested statement
+            t0 = time.monotonic()
+            try:
+                with c.admit(QueryContext(), timeout_s=0.2):
+                    result["outcome"] = "admitted"
+            except Exception as e:  # noqa: BLE001 - recorded
+                result["outcome"] = type(e).__name__
+            result["elapsed"] = time.monotonic() - t0
+
+        t = threading.Thread(target=attempt)
+        t.start()
+        t.join(10)
+        assert result["outcome"] == "QueryDeadlineExceededError"
+        assert result["elapsed"] < 5.0   # bounded by the deadline SLO
+    finally:
+        hold.__exit__(None, None, None)
+
+
+def test_deadline_checkpoint_raises_typed():
+    from greptimedb_tpu import cancellation
+    from greptimedb_tpu.sched import deadline as dl
+
+    token = dl.bind(Deadline(0.01))
+    try:
+        time.sleep(0.02)
+        with pytest.raises(QueryDeadlineExceededError):
+            cancellation.checkpoint()
+    finally:
+        dl.reset(token)
+    cancellation.checkpoint()   # unbound again: no-op
+
+
+def test_call_timeout_caps_remaining():
+    from greptimedb_tpu.sched import deadline as dl
+
+    assert dl.call_timeout() is None
+    assert dl.call_timeout(5.0) == 5.0
+    token = dl.bind(Deadline(100.0))
+    try:
+        assert dl.call_timeout(5.0) == 5.0
+        assert 99.0 < dl.call_timeout() <= 100.0
+    finally:
+        dl.reset(token)
+
+
+# ---------------------------------------------------------------------
+# instance integration
+# ---------------------------------------------------------------------
+
+def _seed(inst, rows=64):
+    inst.sql("create table cpu (ts timestamp time index, host string "
+             "primary key, v double)")
+    vals = ", ".join(
+        f"('h{i % 8}', {1_700_000_000_000 + i * 1000}, {float(i)})"
+        for i in range(rows)
+    )
+    inst.execute_sql(f"insert into cpu (host, ts, v) values {vals}")
+
+
+def test_over_quota_tenant_shed_while_in_quota_completes(inst):
+    """THE tier-1 isolation gate: same instance, one tenant over its
+    qps quota gets the typed 429-class error, the other completes."""
+    _seed(inst)
+    inst.scheduler = AdmissionController(SchedulerConfig(
+        tenants={"noisy": {"qps": 1.0, "burst": 1.0}},
+    ))
+    noisy = QueryContext(username="noisy")
+    quiet = QueryContext(username="quiet")
+    assert inst.sql("select count(*) from cpu",
+                    noisy).cols[0].values[0] == 64
+    with pytest.raises(QueryOverloadedError):
+        inst.sql("select count(*) from cpu", noisy)
+    # the in-quota tenant is untouched, repeatedly
+    for _ in range(3):
+        assert inst.sql("select count(*) from cpu",
+                        quiet).cols[0].values[0] == 64
+
+
+def test_statement_deadline_bounds_query(inst):
+    _seed(inst)
+    ctx = QueryContext()
+    ctx.extensions["deadline_s"] = 1e-9   # expires before any scan
+    with pytest.raises(QueryDeadlineExceededError):
+        inst.sql("select count(*) from cpu", ctx)
+    # control-plane statements bypass admission even with the hint
+    assert inst.sql("show tables", ctx).num_rows == 1
+
+
+def test_max_execution_time_session_variable(inst):
+    """SET max_execution_time=<ms> (the MySQL-compatible knob) feeds
+    the per-statement deadline resolution."""
+    ctx = QueryContext()
+    inst.execute_sql("set max_execution_time = 250", ctx)
+    adm = inst.scheduler.admit(ctx)
+    assert adm._resolve_timeout() == pytest.approx(0.25)
+    # an explicit per-request hint (HTTP ?timeout=) wins over it
+    ctx.extensions["deadline_s"] = 2.0
+    assert inst.scheduler.admit(ctx)._resolve_timeout() == 2.0
+
+
+def test_show_processlist_has_state_column(inst):
+    res = inst.sql("SHOW PROCESSLIST")
+    assert "State" in res.names
+    assert "Running" in list(res.column("State").values)
+
+
+def test_sched_metrics_render_in_metrics_and_information_schema(inst):
+    """gtpu_sched_* must surface through BOTH observability paths."""
+    from greptimedb_tpu.servers.http import HttpServer
+
+    _seed(inst, rows=8)
+    inst.scheduler = AdmissionController(SchedulerConfig(
+        tenants={"noisy": {"qps": 1.0, "burst": 1.0}},
+    ))
+    noisy = QueryContext(username="noisy")
+    inst.sql("select count(*) from cpu", noisy)
+    with pytest.raises(QueryOverloadedError):
+        inst.sql("select count(*) from cpu", noisy)
+    srv = HttpServer(inst, port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=30
+        ) as r:
+            body = r.read().decode()
+        assert 'gtpu_sched_admitted_total{tenant="noisy"}' in body
+        assert ('gtpu_sched_shed_total{tenant="noisy",reason="qps"}'
+                in body)
+        assert "gtpu_sched_queue_depth" in body
+        assert "gtpu_sched_running" in body
+        assert "gtpu_sched_queue_time_seconds_bucket" in body
+    finally:
+        srv.stop()
+    res = inst.sql("select metric_name, value, labels from "
+                   "information_schema.runtime_metrics")
+    names = list(res.column("metric_name").values)
+    assert "gtpu_sched_admitted_total" in names
+    assert "gtpu_sched_shed_total" in names
+
+
+def test_http_surface_maps_shed_to_429_and_deadline_to_503(inst):
+    from greptimedb_tpu.servers.http import HttpServer
+
+    _seed(inst, rows=8)
+    inst.scheduler = AdmissionController(SchedulerConfig(
+        tenants={"public": {"qps": 1.0, "burst": 1.0}},
+    ))
+    srv = HttpServer(inst, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def sql(q, extra=""):
+        return urllib.request.urlopen(
+            f"{base}/v1/sql?sql={urllib.parse.quote(q)}{extra}",
+            data=b"", timeout=30,
+        )
+
+    try:
+        with sql("select count(*) from cpu") as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            sql("select count(*) from cpu")
+        assert ei.value.code == 429
+        body = json.loads(ei.value.read())
+        assert "quota" in body["error"]
+        # deadline via ?timeout= maps to 503 after the bucket refills
+        time.sleep(1.1)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            sql("select count(*) from cpu", "&timeout=0.000000001")
+        assert ei.value.code == 503
+        # non-finite / non-positive timeouts are client errors, not
+        # never-expiring (nan) or instantly-failing (inf RPC budget)
+        # deadlines
+        for bad in ("nan", "inf", "-1", "0", "bogus"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                sql("select 1", f"&timeout={bad}")
+            assert ei.value.code == 400, bad
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------
+# distributed propagation (in-process wire topology)
+# ---------------------------------------------------------------------
+
+def _dist_harness(tmp_path, n=2):
+    pytest.importorskip("pyarrow.flight")
+    from tests.test_dist_cluster import DistHarness
+
+    return DistHarness(tmp_path, n_datanodes=n)
+
+
+def _dist_seed(frontend, rows=60):
+    frontend.execute_sql(
+        "create table cpu (ts timestamp time index, host string "
+        "primary key, v double) with (num_regions = 3)"
+    )
+    vals = ", ".join(
+        f"('h{i % 6}', {1_700_000_000_000 + i * 1000}, {float(i)})"
+        for i in range(rows)
+    )
+    frontend.execute_sql(f"insert into cpu (host, ts, v) values {vals}")
+
+
+def test_deadline_bounds_distributed_query_typed(tmp_path):
+    """An expired per-statement deadline against the wire topology
+    fails with the TYPED error, bounded — never a hang (the mid-flight
+    blackhole propagation case lives in tests/test_chaos.py)."""
+    h = _dist_harness(tmp_path)
+    try:
+        _dist_seed(h.frontend)
+        ctx = QueryContext()
+        res = h.frontend.sql("select count(*) from cpu", ctx)
+        assert res.cols[0].values[0] == 60
+        ctx.extensions["deadline_s"] = 1e-9
+        t0 = time.monotonic()
+        with pytest.raises(QueryDeadlineExceededError):
+            h.frontend.sql("select count(*) from cpu", ctx)
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        h.close()
+
+
+def test_partial_result_when_datanode_dies(tmp_path):
+    """[scheduler] allow_partial_results: killing one datanode mid-
+    stream degrades a decomposable aggregate to a typed partial result
+    (partial=true + missing-region count) instead of failing."""
+    h = _dist_harness(tmp_path, n=2)
+    try:
+        _dist_seed(h.frontend)
+        h.frontend.scheduler = AdmissionController(SchedulerConfig(
+            allow_partial_results=True, default_deadline_s=30.0,
+        ))
+        full = h.frontend.sql("select sum(v) from cpu")
+        assert float(full.cols[0].values[0]) == float(sum(range(60)))
+        assert not getattr(full, "partial", False)
+        h.stop_datanode(0)
+        res = h.frontend.sql("select sum(v) from cpu")
+        assert getattr(res, "partial", False) is True
+        assert res.missing_regions >= 1
+        # the surviving regions' sum is a strict subset
+        assert float(res.cols[0].values[0]) < float(sum(range(60)))
+    finally:
+        h.close()
