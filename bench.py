@@ -815,6 +815,225 @@ def storm_probe(base_dir: str | None = None):
             _shutil.rmtree(tmp, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# multichip probe: the sharded query engine at mesh sizes 1/2/4/8
+# ---------------------------------------------------------------------------
+
+MC_HOSTS = 8192      # crosses the default shard_min_series=4096 threshold
+MC_CELLS = 120       # 10s interval -> 20 ALIGN '1m' buckets
+MC_RUNS = 5          # steady-state samples per mesh size (min is reported)
+
+MC_SQL = (
+    "SELECT ts, host, avg(u) RANGE '1m', max(v) RANGE '1m', "
+    "last_value(u) RANGE '1m' FROM cpu ALIGN '1m' BY (host) "
+    "ORDER BY ts, host"
+)
+
+
+def multichip_probe(base_dir: str | None = None):
+    """Partial-build + steady query latency of the flagship double-groupby
+    RANGE query at mesh sizes 1/2/4/8 over the SAME dataset, on a forced
+    8-virtual-device CPU mesh.
+
+    The dataset (8192 series) crosses the PRODUCTION shard_min_series
+    threshold, so the replicate-vs-shard planner itself decides to shard
+    — nothing is forced. Two scaling views are reported: `work_scaling`
+    (per-chip series count vs mesh=1 — the quantity that becomes wall
+    time on a real v5e-8, exact on the simulated mesh) and the measured
+    `wall ms` (informational: this host's cores timeshare the virtual
+    devices, so wall time here measures overhead, not chip parallelism).
+    Asserts: work scaling strictly monotone 1->8, results BIT-IDENTICAL
+    across every mesh size, shard chosen for the big grid and replicate
+    for a small one."""
+    import os
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    _assert_sanitizer_off()
+    # 8 virtual CPU devices, pinned before the jax backend initializes
+    flag = "--xla_force_host_platform_device_count=8"
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            f"{os.environ.get('XLA_FLAGS', '')} {flag}".strip()
+        )
+    import jax
+
+    if len(jax.devices()) < 8:
+        # site hooks may pin a real 1-chip platform; fall back to the
+        # virtual CPU devices like dryrun_multichip does
+        from jax.extend.backend import clear_backends
+
+        jax.config.update("jax_platforms", "cpu")
+        clear_backends()
+    devices = jax.devices()[:8]
+    assert len(devices) == 8, f"need 8 devices, have {len(devices)}"
+
+    from greptimedb_tpu.instance import Standalone
+    from greptimedb_tpu.parallel import mesh as M
+    from greptimedb_tpu.query.executor import QueryEngine
+    from greptimedb_tpu.session import QueryContext
+    from greptimedb_tpu.sql.parser import parse_sql
+
+    tmp = base_dir or _tempfile.mkdtemp(prefix="gtpu_multichip_")
+    own_tmp = base_dir is None
+    inst = Standalone(os.path.join(tmp, "data"), prefer_device=True,
+                      warm_start=False)
+    try:
+        inst.execute_sql(
+            "create table cpu (ts timestamp time index, host string "
+            "primary key, u double, v double)"
+        )
+        table = inst.catalog.table("public", "cpu")
+        rng = np.random.default_rng(7)
+        ts_block = (np.arange(MC_CELLS) * 10_000 + 1_700_000_000_000)
+        # chunked ingest: MC_HOSTS series x MC_CELLS cells (~1M rows)
+        chunk = 512
+        for h0 in range(0, MC_HOSTS, chunk):
+            n = min(chunk, MC_HOSTS - h0)
+            hosts = np.repeat(
+                [f"h{h0 + i:05d}" for i in range(n)], MC_CELLS
+            ).astype(object)
+            ts = np.tile(ts_block, n).astype(np.int64)
+            table.write({"host": hosts}, ts, {
+                "u": rng.random(n * MC_CELLS) * 100,
+                "v": rng.random(n * MC_CELLS),
+            })
+        stmt = parse_sql(MC_SQL)[0]
+        plan, ptable = inst.plan(stmt, QueryContext())
+
+        per_mesh: dict[str, dict] = {}
+        ref_result = None
+        base_per_chip = None
+        for n_dev in (1, 2, 4, 8):
+            mesh = None if n_dev == 1 else M.make_mesh(devices[:n_dev])
+            engine = QueryEngine(prefer_device=True, mesh=mesh)
+            engine.persist_device_cache = False  # same dataset, fresh build
+            t0 = time.perf_counter()
+            res = engine.execute(plan, ptable)
+            build_ms = (time.perf_counter() - t0) * 1000
+            assert engine.last_exec_path == "device", (
+                f"mesh={n_dev}: fell off the device path "
+                f"({engine.last_exec_path})"
+            )
+            samples = []
+            for _ in range(MC_RUNS):
+                t0 = time.perf_counter()
+                res = engine.execute(plan, ptable)
+                samples.append((time.perf_counter() - t0) * 1000)
+            query_ms = min(samples)
+            entry = next(iter(engine.range_cache._entries.values()))
+            s_pad = int(entry.nrow.shape[0])
+            if n_dev > 1:
+                dec = entry.mesh_decision
+                assert dec is not None and dec.shard, (
+                    f"mesh={n_dev}: planner chose "
+                    f"{dec.label() if dec else None} for a "
+                    f"{MC_HOSTS}-series grid (expected shard)"
+                )
+                assert len(entry.nrow.devices()) == n_dev, (
+                    f"mesh={n_dev}: grid lives on "
+                    f"{len(entry.nrow.devices())} device(s)"
+                )
+            per_chip = s_pad // n_dev
+            if ref_result is None:
+                ref_result = res
+                base_per_chip = per_chip
+            else:
+                # bit-identical parity is the sharding contract
+                assert res.num_rows == ref_result.num_rows
+                for i, name in enumerate(res.names):
+                    a = np.asarray(ref_result.cols[i].values)
+                    b = np.asarray(res.cols[i].values)
+                    assert (
+                        (a == b) | (a != a) & (b != b)
+                    ).all(), (
+                        f"mesh={n_dev}: column {name} differs from "
+                        "the single-device result"
+                    )
+            per_mesh[str(n_dev)] = {
+                "build_ms": round(build_ms, 1),
+                "query_ms": round(query_ms, 1),
+                "series_per_chip": per_chip,
+                "work_scaling": round(base_per_chip / per_chip, 2),
+            }
+            engine.range_cache.clear()
+
+        scalings = [per_mesh[str(n)]["work_scaling"] for n in (1, 2, 4, 8)]
+        assert all(b > a for a, b in zip(scalings, scalings[1:])), (
+            f"per-chip work scaling not monotone 1->8: {scalings}"
+        )
+
+        # small grid on the same 8-way mesh must REPLICATE (planner
+        # threshold, production defaults)
+        inst.execute_sql(
+            "create table cpu_small (ts timestamp time index, host string "
+            "primary key, u double, v double)"
+        )
+        small = inst.catalog.table("public", "cpu_small")
+        hosts = np.repeat(
+            [f"s{i:02d}" for i in range(64)], MC_CELLS
+        ).astype(object)
+        small.write({"host": hosts},
+                    np.tile(ts_block, 64).astype(np.int64), {
+                        "u": rng.random(64 * MC_CELLS),
+                        "v": rng.random(64 * MC_CELLS),
+                    })
+        em8 = QueryEngine(prefer_device=True,
+                          mesh=M.make_mesh(devices))
+        em8.persist_device_cache = False
+        stmt_s = parse_sql(MC_SQL.replace("FROM cpu", "FROM cpu_small"))[0]
+        plan_s, table_s = inst.plan(stmt_s, QueryContext())
+        em8.execute(plan_s, table_s)
+        dec_s = next(
+            iter(em8.range_cache._entries.values())
+        ).mesh_decision
+        assert dec_s is not None and not dec_s.shard and (
+            dec_s.reason == "small_grid"
+        ), f"small grid decided {dec_s.label() if dec_s else None}"
+
+        lines = [
+            json.dumps({"metric": "multichip_build_ms",
+                        "unit": "ms", "per_mesh": {
+                            k: v["build_ms"] for k, v in per_mesh.items()
+                        }}, separators=(",", ":")),
+            json.dumps({"metric": "multichip_query_ms",
+                        "unit": "ms", "per_mesh": {
+                            k: v["query_ms"] for k, v in per_mesh.items()
+                        }}, separators=(",", ":")),
+        ]
+        doc = {
+            "metric": "multichip_work_scaling_x8",
+            "value": per_mesh["8"]["work_scaling"],
+            "unit": "x",
+            "series": MC_HOSTS,
+            "per_mesh": per_mesh,
+            "small_grid_decision": dec_s.label(),
+            "parity": "bit_identical",
+            "note": ("wall ms on this host timeshares the virtual "
+                     "devices over its CPU cores; work_scaling is the "
+                     "per-chip series reduction that becomes wall time "
+                     "on a real v5e-8"),
+        }
+        lines.append(json.dumps(doc, separators=(",", ":")))
+        for ln in lines:
+            print(ln)
+        # final summary line mirrors the orchestrated bench contract
+        print(json.dumps({**doc, "summary": {
+            "multichip_work_scaling_x8": {"v": doc["value"]},
+            "multichip_build_ms_m1": {"v": per_mesh["1"]["build_ms"]},
+            "multichip_build_ms_m8": {"v": per_mesh["8"]["build_ms"]},
+            "multichip_query_ms_m1": {"v": per_mesh["1"]["query_ms"]},
+            "multichip_query_ms_m8": {"v": per_mesh["8"]["query_ms"]},
+            "multichip_series_per_chip_m8": {
+                "v": per_mesh["8"]["series_per_chip"]},
+        }}, separators=(",", ":")))
+    finally:
+        inst.close()
+        if own_tmp:
+            _shutil.rmtree(tmp, ignore_errors=True)
+
+
 def phase1(tmp: str):
     from greptimedb_tpu.instance import Standalone
 
@@ -1515,5 +1734,7 @@ if __name__ == "__main__":
         recovery_probe(sys.argv[2])
     elif len(sys.argv) >= 2 and sys.argv[1] == "storm":
         storm_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "multichip":
+        multichip_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
     else:
         main()

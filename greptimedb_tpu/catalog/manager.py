@@ -378,12 +378,13 @@ class CatalogManager:
         partition: dict | None = None,
     ) -> Table:
         validate_table_options(options)
-        # GTS102: the standalone catalog persists the WHOLE catalog doc
-        # (_persist) under its lock — mutate-then-write atomicity is the
-        # consistency contract, and only DDL pays the (object-store)
-        # write latency. The dist catalog (per-key kv) does its wire
+        # GTS102/103: the standalone catalog persists the WHOLE catalog
+        # doc (_persist) under its lock — mutate-then-write atomicity is
+        # the consistency contract, and only DDL pays the (object-store)
+        # write latency (wall-clock can cross the 1s hold threshold on a
+        # saturated host). The dist catalog (per-key kv) does its wire
         # I/O outside the lock instead.
-        with self._lock:  # gtlint: disable=GTS102
+        with self._lock:  # gtlint: disable=GTS102,GTS103
             db = self._db(database)
             if name in self._views.get(database, {}):
                 raise InvalidArgumentError(

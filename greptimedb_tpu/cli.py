@@ -346,7 +346,15 @@ def _make_instance(opts):
     if (str(storage.get("type", "fs")).lower() != "fs"
             or storage.get("root")):
         store = object_store_from_options(storage, opts.get("data_home"))
+    # process-wide query mesh ([mesh] knobs): built once from the
+    # visible devices and threaded into every QueryEngine this process
+    # creates (the replicate-vs-shard planner gates per-query use)
+    from greptimedb_tpu.parallel import mesh as mesh_mod
+
+    mesh_opts = mesh_mod.mesh_options_from(opts.section("mesh"))
+    mesh = mesh_mod.configure(mesh_opts)
     inst = Standalone(
+        mesh=mesh, mesh_opts=mesh_opts,
         engine_config=EngineConfig(
             data_root=opts.get("data_home"),
             enable_background=opts.get("engine.enable_background", True),

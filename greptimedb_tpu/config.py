@@ -101,6 +101,19 @@ DEFAULTS: dict = {
         # qps/burst/concurrency/priority (lower priority runs first)
         "tenants": {},
     },
+    # multi-chip sharded query execution (parallel/mesh.py): one
+    # process-wide mesh over the visible devices; large grids shard the
+    # series axis across it and the shard_map reduction programs
+    # recombine with explicit collectives. The replicate-vs-shard
+    # thresholds feed query/planner.decide_mesh_execution.
+    "mesh": {
+        "enabled": False,
+        "axis_size": 0,                 # shard-axis devices; 0 = all
+        "time_parallel": 1,             # devices on the time axis
+        "force_host_device_count": 0,   # CPU simulation (virtual devices)
+        "shard_min_series": 4096,       # grids below this replicate
+        "shard_min_rows": 262144,       # row reductions below this replicate
+    },
     "frontend": {
         # flight addresses of the datanodes this frontend fans out to
         "datanode_addrs": [],

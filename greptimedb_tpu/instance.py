@@ -190,14 +190,14 @@ class Standalone:
     def __init__(self, data_root: str = "./greptimedb_tpu_data", *,
                  engine_config: EngineConfig | None = None,
                  prefer_device: bool | None = None, mesh=None,
-                 warm_start: bool = True, store=None):
+                 mesh_opts=None, warm_start: bool = True, store=None):
         cfg = engine_config or EngineConfig(data_root=data_root,
                                             enable_background=False)
         _enable_xla_persistent_cache(cfg.data_root)
         self.engine = TsdbEngine(cfg, store=store)
         self.catalog = CatalogManager(self.engine)
         self.query_engine = QueryEngine(prefer_device=prefer_device,
-                                        mesh=mesh)
+                                        mesh=mesh, mesh_opts=mesh_opts)
         self.flows = None  # wired by flow.FlowManager when enabled
         self._procedures = []
         self._process_list = _ProcessList()

@@ -189,6 +189,96 @@ def _group_matmul(x, onehot_t):
     return jax.lax.dot(onehot_t, x, precision=jax.lax.Precision.HIGHEST)
 
 
+def aggregate_across_series_blocked(
+    vals, present, group_ids, num_groups: int, op: str, *,
+    total_series: int, blocks: int | None = None, ctx=None,
+):
+    """Series aggregation with a fixed blocked-combine structure: the
+    series axis splits into `blocks` aligned blocks whose partials are
+    combined in one unrolled left fold. Run single-device (ctx =
+    LocalFoldCtx) or per-shard inside shard_map (ctx = ShardFoldCtx) it
+    performs the SAME additions in the SAME order, so the mesh fast path
+    (promql/fast.py) matches the unsharded fast path bit-for-bit.
+    `total_series` is the GLOBAL padded series count (local shape *
+    shards inside shard_map) — it keeps the matmul-vs-scatter choice
+    identical across shardings."""
+    from greptimedb_tpu.parallel.dist import LocalFoldCtx, left_fold_sum
+    from greptimedb_tpu.parallel.mesh import FOLD_BLOCKS
+
+    if blocks is None:
+        blocks = FOLD_BLOCKS  # the ONE cross-path fold-block contract
+    if ctx is None:
+        ctx = LocalFoldCtx()
+    dt = vals.dtype
+    gid = group_ids.astype(jnp.int32)
+    s_loc = vals.shape[0]
+    bl = max(blocks // ctx.shards, 1)
+    aligned = (blocks % ctx.shards == 0 and s_loc % bl == 0
+               and s_loc >= bl)
+    linear = op in ("sum", "avg", "count", "group", "stddev", "stdvar")
+    use_matmul = (
+        linear
+        and total_series >= _MATMUL_MIN_SERIES
+        and num_groups * total_series <= _MATMUL_MAX_ONEHOT_ELEMS
+    )
+
+    def bsum(x):
+        """Blocked exact-structured group sum of an (S_loc, J) matrix."""
+        if not aligned:
+            return ctx.psum(jax.ops.segment_sum(
+                x, gid, num_segments=num_groups
+            ))
+        per = s_loc // bl
+        if use_matmul:
+            parts = []
+            grange = jnp.arange(num_groups, dtype=jnp.int32)[:, None]
+            for b in range(bl):
+                sl = slice(b * per, (b + 1) * per)
+                onehot_t = (gid[sl][None, :] == grange).astype(dt)
+                parts.append(_group_matmul(x[sl], onehot_t))
+            partial = jnp.stack(parts)              # (bl, G, J)
+        else:
+            bid = (jnp.arange(s_loc, dtype=jnp.int32)
+                   // jnp.int32(per))
+            seg = bid * jnp.int32(num_groups) + gid
+            p = jax.ops.segment_sum(
+                x, seg, num_segments=bl * num_groups
+            )
+            partial = p.reshape(bl, num_groups, -1)
+        return left_fold_sum(ctx.gather(partial))
+
+    cnt = bsum(present.astype(dt))
+    any_present = cnt > 0
+    if op in ("sum", "avg"):
+        s = bsum(jnp.where(present, vals, 0))
+        if op == "avg":
+            s = s / jnp.maximum(cnt, 1)
+        return jnp.where(any_present, s, 0), any_present
+    if op == "count":
+        return cnt, any_present
+    if op == "group":
+        return any_present.astype(dt), any_present
+    if op == "min":
+        v = jnp.where(present, vals, jnp.inf)
+        m = ctx.pext(jax.ops.segment_min(v, gid, num_segments=num_groups),
+                     take_max=False)
+        return jnp.where(any_present, m, 0), any_present
+    if op == "max":
+        v = jnp.where(present, vals, -jnp.inf)
+        m = ctx.pext(jax.ops.segment_max(v, gid, num_segments=num_groups),
+                     take_max=True)
+        return jnp.where(any_present, m, 0), any_present
+    if op in ("stddev", "stdvar"):
+        s = bsum(jnp.where(present, vals, 0))
+        n = jnp.maximum(cnt, 1)
+        mean = s / n
+        dev = jnp.where(present, vals - mean[gid], 0)
+        var = bsum(dev * dev) / n
+        out = var if op == "stdvar" else jnp.sqrt(var)
+        return jnp.where(any_present, out, 0), any_present
+    raise ValueError(f"unsupported aggregation: {op}")
+
+
 @functools.partial(jax.jit, static_argnames=("op", "num_groups"))
 def aggregate_across_series(vals, present, group_ids, num_groups: int, op: str):
     """PromQL aggregation operators over the series axis of an (S, J) matrix.

@@ -59,20 +59,28 @@ def dist_segment_agg(mesh: Mesh, op: str, num_segments: int):
     )
 
 
-def halo_exchange_prev(x: jax.Array, halo: int, axis_name: str = AXIS_TIME):
-    """Prepend the last `halo` cells of the previous time shard (zeros for
-    the first shard). x is the local (S, T_local) block inside shard_map;
-    returns (S, halo + T_local)."""
+def _halo_prev(x: jax.Array, halo: int, axis_name: str, axis: int, fill):
+    """Ring halo: prepend the last `halo` cells (along `axis`) of the
+    PREVIOUS shard on `axis_name`; the first shard gets `fill`."""
     # jax.lax.axis_size was removed from current JAX; psum of a python
     # literal folds to the static axis size inside shard_map
     n = jax.lax.psum(1, axis_name)
-    tail = x[:, -halo:]
+    tail = jax.lax.slice_in_dim(x, x.shape[axis] - halo, x.shape[axis],
+                                axis=axis)
     # ring shift: device i receives from i-1
     perm = [(i, (i + 1) % n) for i in range(n)]
     prev_tail = jax.lax.ppermute(tail, axis_name, perm)
     idx = jax.lax.axis_index(axis_name)
-    prev_tail = jnp.where(idx == 0, jnp.zeros_like(prev_tail), prev_tail)
-    return jnp.concatenate([prev_tail, x], axis=1)
+    prev_tail = jnp.where(idx == 0,
+                          jnp.full_like(prev_tail, fill), prev_tail)
+    return jnp.concatenate([prev_tail, x], axis=axis)
+
+
+def halo_exchange_prev(x: jax.Array, halo: int, axis_name: str = AXIS_TIME):
+    """Prepend the last `halo` cells of the previous time shard (zeros for
+    the first shard). x is the local (S, T_local) block inside shard_map;
+    returns (S, halo + T_local)."""
+    return _halo_prev(x, halo, axis_name, axis=1, fill=0.0)
 
 
 def dist_topk(mesh: Mesh, k: int, *, largest: bool = True):
@@ -101,6 +109,88 @@ def dist_topk(mesh: Mesh, k: int, *, largest: bool = True):
         out_specs=(P(), P()),
         check_rep=False,
     )
+
+
+# ----------------------------------------------------------------------
+# shard_map building blocks for the LIVE query path (query/reduce.py,
+# query/device_range.py, promql/fast.py, query/window_fns.py). All cross
+# -shard combines that touch f32 sums go through gather_blocks +
+# left_fold so the addition order is identical to the single-device
+# blocked fold (parallel/mesh.FOLD_BLOCKS) — sharded results match the
+# unsharded path bit-for-bit for decomposable aggregates.
+# ----------------------------------------------------------------------
+
+def halo_prev_1d(x: jax.Array, halo: int, axis_name: str = AXIS_SHARD,
+                 fill=0.0):
+    """Prepend the last `halo` cells of the PREVIOUS shard of a 1-D
+    row-sharded array (the first shard gets `fill`). The sliding-window
+    primitive for frames crossing shard boundaries
+    (query/window_fns.py ROWS k PRECEDING)."""
+    return _halo_prev(x, halo, axis_name, axis=0, fill=fill)
+
+
+def gather_blocks(partial: jax.Array, axis_name: str = AXIS_SHARD):
+    """Concatenate per-shard partial blocks along axis 0 in shard order:
+    (B_local, ...) -> (B_local * n_shards, ...). Pure data movement —
+    exact."""
+    return jax.lax.all_gather(partial, axis_name, axis=0, tiled=True)
+
+
+def left_fold_sum(parts: jax.Array):
+    """Sum over axis 0 as an explicit unrolled left fold. The static add
+    chain is the contract: both the sharded (post-gather) and unsharded
+    blocked folds run this exact sequence, so f32 results agree
+    bit-for-bit across mesh sizes."""
+    total = parts[0]
+    for i in range(1, parts.shape[0]):
+        total = total + parts[i]
+    return total
+
+
+def pext(x: jax.Array, axis_name: str = AXIS_SHARD, *,
+         take_max: bool = True):
+    """Cross-shard elementwise extreme (exact for any association)."""
+    return (jax.lax.pmax if take_max else jax.lax.pmin)(x, axis_name)
+
+
+class LocalFoldCtx:
+    """Cross-shard hooks for blocked exact folds. This single-device
+    instance is the identity; ShardFoldCtx recombines with collectives.
+    Both fold the SAME per-block partials in the SAME left-fold order,
+    so sharded and unsharded results agree bit-for-bit."""
+
+    shards = 1
+
+    def sid_base(self, s_local: int):
+        return jnp.int32(0)
+
+    def gather(self, partial):
+        return partial
+
+    def pext(self, x, take_max: bool):
+        return x
+
+    def psum(self, x):
+        return x
+
+
+class ShardFoldCtx(LocalFoldCtx):
+    """Collective fold hooks for code running INSIDE shard_map."""
+
+    def __init__(self, shards: int):
+        self.shards = shards
+
+    def sid_base(self, s_local: int):
+        return jax.lax.axis_index(AXIS_SHARD) * jnp.int32(s_local)
+
+    def gather(self, partial):
+        return gather_blocks(partial)
+
+    def pext(self, x, take_max: bool):
+        return pext(x, take_max=take_max)
+
+    def psum(self, x):
+        return jax.lax.psum(x, AXIS_SHARD)
 
 
 def shard_rows_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,33 @@
+"""Bounded memo for compiled mesh programs.
+
+Deliberately free of jax imports so the query modules can construct
+their caches at import time (importing anything under parallel/ pulls in jax ~0.5s via the package __init__; the
+program BUILDERS stay lazy behind ProgramCache.get).
+"""
+
+from __future__ import annotations
+
+from greptimedb_tpu import concurrency
+
+
+class ProgramCache:
+    """FIFO-bounded get-or-build memo for compiled mesh programs. A
+    process only ever holds a handful of live meshes, so eviction just
+    drops the oldest compile; `build` receives the key verbatim. The
+    lock covers the build so concurrent first queries share ONE program
+    object (builders only wrap jax.jit — no I/O, no device work)."""
+
+    def __init__(self, build, cap: int = 4):
+        self._build = build
+        self._cap = cap
+        self._lock = concurrency.Lock()
+        self._entries: dict = {}
+
+    def get(self, key):
+        with self._lock:
+            prog = self._entries.get(key)
+            if prog is None:
+                prog = self._entries[key] = self._build(key)
+                while len(self._entries) > self._cap:
+                    self._entries.pop(next(iter(self._entries)))
+            return prog

@@ -44,6 +44,7 @@ from greptimedb_tpu.promql.parser import (
     NumberLit,
     VectorSelector,
 )
+from greptimedb_tpu.program_cache import ProgramCache
 from greptimedb_tpu.telemetry.metrics import global_registry
 
 from greptimedb_tpu import concurrency
@@ -103,6 +104,7 @@ class _Entry:
     nbytes: int
     last_used: float = 0.0
     mesh: object = None         # series-axis sharding mesh (None = 1 dev)
+    mesh_decision: object = None  # planner MeshDecision (replicate/shard)
     # per-entry derived caches (device-resident, so queries move no masks)
     match_cache: dict = field(default_factory=dict)
     group_cache: dict = field(default_factory=dict)
@@ -116,7 +118,8 @@ class SelectorGridCache:
         self._entries: dict[tuple, _Entry] = {}
         self._lock = concurrency.Lock()
 
-    def get_entry(self, table, fieldname: str, mesh=None) -> _Entry | None:
+    def get_entry(self, table, fieldname: str, mesh=None,
+                  mesh_opts=None) -> _Entry | None:
         key = (id(table), fieldname)
         version = table.data_version()
         with self._lock:
@@ -124,7 +127,8 @@ class SelectorGridCache:
             if e is not None and e.table is table and e.version == version:
                 e.last_used = time.monotonic()
                 return e
-        e = _build_entry(table, fieldname, version, mesh=mesh)
+        e = _build_entry(table, fieldname, version, mesh=mesh,
+                         mesh_opts=mesh_opts)
         if e is None:
             return None
         with self._lock:
@@ -176,7 +180,8 @@ def _series_sharding(mesh, ndim: int):
     return NamedSharding(mesh, P(*spec))
 
 
-def _build_entry(table, fieldname: str, version, mesh=None) -> _Entry | None:
+def _build_entry(table, fieldname: str, version, mesh=None,
+                 mesh_opts=None) -> _Entry | None:
     """Scan the whole table once and gridify every series onto one
     HBM-resident grid. Resolution is the gcd of observed sample intervals
     (coarsened if the grid would blow the cell cap, same approximation as
@@ -215,6 +220,17 @@ def _build_entry(table, fieldname: str, version, mesh=None) -> _Entry | None:
     t_min = int(uniq_ts[0])
     t_max = int(uniq_ts[-1])
     s = registry.num_series
+    mesh_decision = None
+    if mesh is not None:
+        # replicate-vs-shard: small grids stay single-device (collective
+        # + launch latency dominates), large ones shard the series axis
+        from greptimedb_tpu.query.planner import decide_mesh_execution
+
+        mesh_decision = decide_mesh_execution(
+            mesh, kind="promql", series=s, opts=mesh_opts,
+        )
+        if not mesh_decision.shard:
+            mesh = None
     s_pad = _pow2_bucket(s)
     if mesh is not None:
         from greptimedb_tpu.parallel.mesh import AXIS_SHARD
@@ -269,6 +285,7 @@ def _build_entry(table, fieldname: str, version, mesh=None) -> _Entry | None:
         s, s_pad, nbytes,
     )
     entry.mesh = mesh
+    entry.mesh_decision = mesh_decision
     return entry
 
 
@@ -465,11 +482,79 @@ def _fused_query(
         l_cells=l_cells, tps=tps, fargs=fargs,
         lookback_ticks=lookback_ticks,
     )
-    vals_g, pres_g = K.aggregate_across_series(out, pres, gid, g + 1, op)
+    # blocked fold: the same fixed combine structure the sharded twin
+    # runs per shard, so mesh and single-device results agree bit-for-bit
+    vals_g, pres_g = K.aggregate_across_series_blocked(
+        out, pres, gid, g + 1, op, total_series=vals.shape[0],
+    )
     # single packed (2G, J) buffer: one device->host transfer per query
     return jnp.concatenate([
         vals_g[:g], pres_g[:g].astype(vals_g.dtype),
     ])
+
+
+def _make_sharded_fused_query(mesh):
+    """shard_map twin of _fused_query: grids series-sharded over
+    AXIS_SHARD, each shard evaluates its series slice (range functions
+    are per-series) and the cross-series aggregation recombines with the
+    SAME blocked left fold the single-device program runs — sharded ==
+    unsharded bit-for-bit (the 1M-series parity contract)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from greptimedb_tpu.parallel.dist import ShardFoldCtx
+    from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+
+    ns = mesh.shape[AXIS_SHARD]
+
+    @functools.partial(
+        jax.jit,
+        static_argnames=(
+            "fname", "op", "g", "range_ticks", "range_seconds",
+            "l_cells", "tps", "fargs", "lookback_ticks",
+        ),
+    )
+    def program(
+        vals, has, tsg, smask, gid, lo, hi, t_end, *,
+        fname: str, op: str, g: int, range_ticks: int,
+        range_seconds: float, l_cells: int, tps: float, fargs: tuple,
+        lookback_ticks: int,
+    ):
+        from greptimedb_tpu.ops import promql as K
+
+        import jax.numpy as jnp
+
+        def local(vals, has, tsg, smask, gid, lo, hi, t_end):
+            out, pres = _eval_side(
+                vals, has, tsg, smask, lo, hi, t_end, fname=fname,
+                range_ticks=range_ticks, range_seconds=range_seconds,
+                l_cells=l_cells, tps=tps, fargs=fargs,
+                lookback_ticks=lookback_ticks,
+            )
+            vals_g, pres_g = K.aggregate_across_series_blocked(
+                out, pres, gid, g + 1, op,
+                total_series=vals.shape[0] * ns, ctx=ShardFoldCtx(ns),
+            )
+            return jnp.concatenate([
+                vals_g[:g], pres_g[:g].astype(vals_g.dtype),
+            ])
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(AXIS_SHARD, None), P(AXIS_SHARD, None),
+                      P(AXIS_SHARD, None), P(AXIS_SHARD), P(AXIS_SHARD),
+                      P(), P(), P()),
+            out_specs=P(), check_rep=False,
+        )(vals, has, tsg, smask, gid, lo, hi, t_end)
+
+    return program
+
+
+_SHARDED_QUERY = ProgramCache(_make_sharded_fused_query)
+
+
+def _get_sharded_query(mesh):
+    return _SHARDED_QUERY.get(mesh)
 
 
 # ----------------------------------------------------------------------
@@ -669,10 +754,10 @@ def _resolve_fast_selector(engine, inner, ev):
         fieldname = engine._value_field(table, field_sel)
     except Exception:  # noqa: BLE001 - resolution failure: generic path
         return None
-    mesh = getattr(
-        getattr(engine.instance, "query_engine", None), "mesh", None
-    )
-    entry = _CACHE.get_entry(table, fieldname, mesh=mesh)
+    qe = getattr(engine.instance, "query_engine", None)
+    mesh = getattr(qe, "mesh", None)
+    entry = _CACHE.get_entry(table, fieldname, mesh=mesh,
+                             mesh_opts=getattr(qe, "mesh_opts", None))
     if entry is None:
         return None
     if entry.num_series == 0:
@@ -684,6 +769,29 @@ def _resolve_fast_selector(engine, inner, ev):
     if win is None:
         return None
     return entry, table, raw_matchers, fname, fargs, win
+
+
+def _note_mesh_decision(entry, *, auto_spmd_site: str | None = None):
+    """Surface the entry's replicate-vs-shard decision for ONE fast-path
+    query that actually EXECUTED (EXPLAIN + gtpu_mesh_*) — resolution
+    alone records nothing, so queries that fall back to the generic
+    engine (or resolve two operands) don't inflate the counters. Sites
+    whose program runs single-device code over sharded grids (histogram
+    and binary: XLA auto-SPMD picks its own combine order) tag the
+    reason so the documented bit-identity exception stays visible."""
+    dec = entry.mesh_decision
+    if dec is None:
+        return
+    from greptimedb_tpu.query.planner import (
+        MeshDecision, record_mesh_decision,
+    )
+
+    if auto_spmd_site is not None and dec.shard:
+        dec = MeshDecision(
+            dec.mode, f"{dec.reason}:auto_spmd_{auto_spmd_site}",
+            dec.devices,
+        )
+    record_mesh_decision(dec, "promql")
 
 
 def _hist_slots_from_labels(labels):
@@ -778,6 +886,7 @@ def try_fast_histogram(engine, phi: float, inner, ev):
         _FAST_HITS.labels("hit").inc()
         return _empty_vector(ev)
     lookback_ticks = max(int(ev.lookback_ms // entry.spec.unit), 1)
+    _note_mesh_decision(entry, auto_spmd_site="histogram")
     packed = _fused_hist_query(
         entry.vals, entry.has, entry.tsg, smask, d_gid, d_slot,
         jnp.asarray(uniq_le, jnp.float32), lo, hi, t_end,
@@ -824,7 +933,10 @@ def try_fast(engine, e, ev):
         return _empty_vector(ev)
     labels, gid, g = _grouping_dev(entry, table, e.grouping, e.without)
     lookback_ticks = max(int(ev.lookback_ms // entry.spec.unit), 1)
-    packed = _fused_query(
+    program = (_fused_query if entry.mesh is None
+               else _get_sharded_query(entry.mesh))
+    _note_mesh_decision(entry)
+    packed = program(
         entry.vals, entry.has, entry.tsg, smask, gid,
         lo, hi, t_end,
         fname=fname, op=e.op, g=g, range_ticks=range_ticks,
@@ -921,18 +1033,7 @@ def _fused_topk(
         l_cells=l_cells, tps=tps, fargs=fargs,
         lookback_ticks=lookback_ticks,
     )
-    # sort key (always descending): present samples clamped to a finite
-    # range so genuine +-Inf values still rank above/below every absent
-    # slot (-inf fill); present NaN ranks below every real value but
-    # above absence (generic np.argsort puts NaN last), staying finite
-    # so the presence check keeps it when k exceeds the real winners
-    big = jnp.asarray(3.0e38, out.dtype)
-    nan_key = jnp.asarray(-3.2e38, out.dtype)
-    base = jnp.clip(out, -big, big)
-    k_dir = base if largest else -base
-    key = jnp.where(
-        pres, jnp.where(jnp.isnan(out), nan_key, k_dir), -jnp.inf
-    )
+    key = _topk_key(out, pres, largest)
     top_key, top_idx = jax.lax.top_k(key.T, k)       # (J, k)
     # presence gathered from the real mask; finite-key check drops the
     # absent fill slots when fewer than k series are present
@@ -949,6 +1050,100 @@ def _fused_topk(
         top_idx.astype(jnp.float32),
         top_pres.astype(jnp.float32),
     ])
+
+
+def _topk_key(out, pres, largest: bool):
+    """Descending sort key: present samples clamped to a finite range so
+    genuine +-Inf values still rank above/below every absent slot (-inf
+    fill); present NaN ranks below every real value but above absence
+    (generic np.argsort puts NaN last), staying finite so the presence
+    check keeps it when k exceeds the real winners."""
+    import jax.numpy as jnp
+
+    big = jnp.asarray(3.0e38, out.dtype)
+    nan_key = jnp.asarray(-3.2e38, out.dtype)
+    base = jnp.clip(out, -big, big)
+    k_dir = base if largest else -base
+    return jnp.where(
+        pres, jnp.where(jnp.isnan(out), nan_key, k_dir), -jnp.inf
+    )
+
+
+def _make_sharded_fused_topk(mesh):
+    """shard_map twin of _fused_topk using the dist_topk pattern
+    (parallel/dist.py): each shard evaluates its series slice and takes
+    a LOCAL per-step top-k, the (J, k)-sized winner sets all_gather in
+    shard order, and one reselect over the ns*k candidates yields the
+    global winners — only k rows per shard cross the ICI instead of the
+    whole (S, J) matrix. Every global winner is inside its shard's local
+    top-k, and candidate order (shard, then local rank) equals ascending
+    global series index among equal keys, so selection — values, winner
+    indices, tie-breaks — matches the single-device program exactly."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+
+    @functools.partial(
+        jax.jit,
+        static_argnames=("fname", "k", "largest", "range_ticks",
+                         "range_seconds", "l_cells", "tps", "fargs",
+                         "lookback_ticks"),
+    )
+    def program(
+        vals, has, tsg, smask, lo, hi, t_end, *,
+        fname: str, k: int, largest: bool, range_ticks: int,
+        range_seconds: float, l_cells: int, tps: float, fargs: tuple,
+        lookback_ticks: int,
+    ):
+        import jax.numpy as jnp
+
+        def local(vals, has, tsg, smask, lo, hi, t_end):
+            out, pres = _eval_side(
+                vals, has, tsg, smask, lo, hi, t_end, fname=fname,
+                range_ticks=range_ticks, range_seconds=range_seconds,
+                l_cells=l_cells, tps=tps, fargs=fargs,
+                lookback_ticks=lookback_ticks,
+            )
+            s_loc = out.shape[0]
+            key = _topk_key(out, pres, largest)
+            kl = min(k, s_loc)
+            l_key, l_idx = jax.lax.top_k(key.T, kl)    # (J, kl)
+            base = jax.lax.axis_index(AXIS_SHARD) * jnp.int32(s_loc)
+            l_gidx = base + l_idx.astype(jnp.int32)
+            l_pres = jnp.take_along_axis(pres.T, l_idx, axis=1)
+            l_vals = jnp.take_along_axis(out.T, l_idx, axis=1)
+            cat = lambda x: jax.lax.all_gather(  # noqa: E731
+                x, AXIS_SHARD, axis=1, tiled=True
+            )
+            c_key = cat(l_key)                         # (J, ns*kl)
+            f_key, f_pos = jax.lax.top_k(c_key, k)
+            f_vals = jnp.take_along_axis(cat(l_vals), f_pos, axis=1)
+            f_idx = jnp.take_along_axis(cat(l_gidx), f_pos, axis=1)
+            f_pres = (jnp.take_along_axis(cat(l_pres), f_pos, axis=1)
+                      & jnp.isfinite(f_key))
+            return jnp.concatenate([
+                f_vals.astype(jnp.float32),
+                f_idx.astype(jnp.float32),
+                f_pres.astype(jnp.float32),
+            ])
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(AXIS_SHARD, None), P(AXIS_SHARD, None),
+                      P(AXIS_SHARD, None), P(AXIS_SHARD),
+                      P(), P(), P()),
+            out_specs=P(), check_rep=False,
+        )(vals, has, tsg, smask, lo, hi, t_end)
+
+    return program
+
+
+_SHARDED_TOPK = ProgramCache(_make_sharded_fused_topk)
+
+
+def _get_sharded_topk(mesh):
+    return _SHARDED_TOPK.get(mesh)
 
 
 def try_fast_topk(engine, e, ev):
@@ -985,7 +1180,10 @@ def try_fast_topk(engine, e, ev):
         return None
     lookback_ticks = max(int(ev.lookback_ms // entry.spec.unit), 1)
     kk = min(k, entry.num_series)
-    packed = np.asarray(_fused_topk(
+    topk_prog = (_fused_topk if entry.mesh is None
+                 else _get_sharded_topk(entry.mesh))
+    _note_mesh_decision(entry)
+    packed = np.asarray(topk_prog(
         entry.vals, entry.has, entry.tsg, smask, lo, hi, t_end,
         fname=fname, k=kk, largest=e.op == "topk",
         range_ticks=range_ticks, range_seconds=range_seconds,
@@ -1177,6 +1375,7 @@ def try_fast_binary(engine, e, ev, *, agg=None):
     if gid is None:
         gid = jnp.zeros(entry_l.s_pad, jnp.int32)
     lookback_ticks = max(int(ev.lookback_ms // entry_l.spec.unit), 1)
+    _note_mesh_decision(entry_l, auto_spmd_site="binary")
     packed = _fused_binary(
         entry_l.vals, entry_l.has, entry_l.tsg, smask_l,
         lo_l, hi_l, t_end_l,

@@ -46,6 +46,7 @@ from dataclasses import dataclass, field as dc_field
 import numpy as np
 
 from greptimedb_tpu.errors import UnsupportedError
+from greptimedb_tpu.program_cache import ProgramCache
 from greptimedb_tpu.sql import ast as A
 
 from greptimedb_tpu import concurrency
@@ -325,22 +326,31 @@ def _make_put(mesh):
 
 
 def _series_pad(s: int, mesh) -> int:
-    if mesh is None:
-        return s
-    from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+    """Pad the series axis to the fold-block multiple (and the shard
+    count): block boundaries are part of the numeric contract — the
+    blocked group fold combines per-block f32 partials in one fixed
+    order, so sharded and single-device entries of the same table get
+    IDENTICAL block contents and bit-identical results."""
+    from greptimedb_tpu.parallel.mesh import AXIS_SHARD, FOLD_BLOCKS
 
-    n = mesh.shape[AXIS_SHARD]
-    return -(-s // n) * n
+    mult = FOLD_BLOCKS
+    if mesh is not None:
+        n = mesh.shape[AXIS_SHARD]
+        mult = mult * n // math.gcd(mult, n)
+    return -(-s // mult) * mult
 
 
-def build_entry(plan, table, items, mesh=None,
+def build_entry(plan, table, items, mesh=None, mesh_opts=None,
                 byte_budget: int = _BYTE_BUDGET,
                 keep_host: bool = False) -> _Entry | None:
     """Scan the table once and build the device cell-state grids.
 
-    keep_host=True additionally retains the host-side grid arrays on
-    entry.host_snap so persist_entry can write a restart snapshot
-    without a device readback."""
+    With a mesh, the replicate-vs-shard planner decides placement from
+    the series count: large grids get a series-axis NamedSharding (the
+    shard_map range program recombines group folds with collectives),
+    small ones stay single-device. keep_host=True additionally retains
+    the host-side grid arrays on entry.host_snap so persist_entry can
+    write a restart snapshot without a device readback."""
     import jax.numpy as jnp
 
     needed: dict[str, set] = {}
@@ -364,6 +374,16 @@ def build_entry(plan, table, items, mesh=None,
     else:
         reorder = None
     S = max(data.registry.num_series, int(sid.max()) + 1 if len(sid) else 1)
+    decision = None
+    if mesh is not None:
+        from greptimedb_tpu.query.planner import decide_mesh_execution
+
+        decision = decide_mesh_execution(
+            mesh, kind="range", series=S, ops=[op for _, op in items],
+            opts=mesh_opts,
+        )
+        if not decision.shard:
+            mesh = None
     S = _series_pad(S, mesh)
     res = _pick_res(plan, ts, S)
     if res is None or res >= _I32_MAX:
@@ -394,6 +414,7 @@ def build_entry(plan, table, items, mesh=None,
         rows_scanned=len(rows),
     )
     entry.mesh = mesh
+    entry.mesh_decision = decision
     snap = {} if keep_host else None
     put2, _ = _make_put(mesh)
     shape = (S, nb)
@@ -681,6 +702,7 @@ def _snap_open(region, path):
 
 
 def load_entry_snapshot(table, r0: int, align_to: int, mesh=None,
+                        mesh_opts=None,
                         byte_budget: int = _BYTE_BUDGET) -> _Entry | None:
     """Restore a compatible snapshot for the table's CURRENT data
     version, deleting stale snapshot files as they are found."""
@@ -723,6 +745,27 @@ def load_entry_snapshot(table, r0: int, align_to: int, mesh=None,
         n_arr = len(meta["arrays"])
         if meta["num_series"] * meta["nb"] * 4 * n_arr > byte_budget:
             continue
+        decision = None
+        if mesh is not None:
+            from greptimedb_tpu.query.planner import (
+                decide_mesh_execution,
+            )
+            from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+
+            decision = decide_mesh_execution(
+                mesh, kind="range", series=meta["num_series"],
+                ops=(), opts=mesh_opts,
+            )
+            if decision.shard and meta["num_series"] % mesh.shape[
+                    AXIS_SHARD]:
+                # snapshots from an unpadded/unsharded build stay
+                # single-device (the series axis must split evenly)
+                from greptimedb_tpu.query.planner import MeshDecision
+
+                decision = MeshDecision("replicate", "snapshot_unaligned",
+                                        devices=decision.devices)
+            if not decision.shard:
+                mesh = None
         put2, _ = _make_put(mesh)
         entry = _Entry(
             version=version, res=res, phase=phase,
@@ -731,6 +774,7 @@ def load_entry_snapshot(table, r0: int, align_to: int, mesh=None,
             rows_scanned=meta["rows_scanned"],
         )
         entry.mesh = mesh
+        entry.mesh_decision = decision
         by_key = {ent["key"]: ent for ent in meta["arrays"]}
         entry.nrow = put2(fetch(by_key["nrow"]))
         entry.imin = put2(fetch(by_key["imin"]))
@@ -807,8 +851,8 @@ def precompile_programs(entry: _Entry, table) -> int:
     except Exception as e:  # noqa: BLE001
         # warmup miss: the first real query compiles it instead
         _log.debug("prelude precompile skipped: %s", e)
-    program = get_program()
-    _, put1 = _make_put(getattr(entry, "mesh", None))
+    entry_mesh = getattr(entry, "mesh", None)
+    _, put1 = _make_put(entry_mesh)
     done = 0
     for s in doc:
         try:
@@ -831,6 +875,17 @@ def precompile_programs(entry: _Entry, table) -> int:
                 continue
             spec = (int(s["stride"]), int(s["n_steps"]), int(s["g"]),
                     bool(s["fold"]), bool(s["nanenc"]), items)
+            # select the program the way execute_range_device will, so
+            # the warm compile is the one that actually serves queries
+            # (sharded entries use the shard_map twin except for
+            # affordable blocked folds)
+            program = get_program()
+            if entry_mesh is not None and (
+                not spec[3]
+                or _fold_blocks(spec[2], entry.nb,
+                                entry.num_series) != 1
+            ):
+                program = get_sharded_program(entry_mesh)
             out = program(
                 arrs,
                 put1(np.zeros(entry.num_series, np.int32)),
@@ -929,6 +984,7 @@ def _load_any_snapshot(table, engine) -> _Entry | None:
             continue
         entry = load_entry_snapshot(
             table, r0=res, align_to=phase, mesh=getattr(engine, "mesh", None),
+            mesh_opts=getattr(engine, "mesh_opts", None),
             byte_budget=engine.range_cache.byte_budget,
         )
         if entry is not None:
@@ -1202,162 +1258,267 @@ def _finalize_j(op, state: dict, jnp):
     raise UnsupportedError(op)
 
 
-def _make_range_program():
-    # spec = (stride, n_steps, g, fold, items) with items a tuple of
-    # (op, w, field_key) — everything shape-determining is static.
-    # dynamic scalars: delta (cache cell of first window's first bucket),
-    # lo/hi absolute cell bounds from WHERE ts.
+def _fold_blocks(g: int, nb: int, s: int) -> int:
+    """Series-block count for the group fold. FOLD_BLOCKS when the
+    (blocks, g, nb) partial tensor is affordable and the series axis is
+    block-aligned; 1 degenerates to the direct fold (sharded execution
+    then stays on the auto-SPMD program — see execute_range_device)."""
+    from greptimedb_tpu.parallel.mesh import FOLD_BLOCKS
+
+    if s % FOLD_BLOCKS == 0 and FOLD_BLOCKS * g * nb <= 256_000_000:
+        return FOLD_BLOCKS
+    return 1
+
+
+def _fold_groups(op, state, gid, g, jnp, ctx):
+    """Fold per-series cell states into per-group states.
+
+    n/s/s2 fold through FOLD_BLOCKS aligned series blocks combined in
+    one fixed left-fold order (bit-identical across mesh sizes); min/max
+    are exactly associative and recombine with pmin/pmax; first/last
+    winners resolve by exact (ts, sid) staged selection and a masked
+    sum extraction (adding zeros never perturbs the winner value)."""
+    import jax
+
+    out = {}
+    s_total = state["n"].shape[0] * ctx.shards
+    nb = state["n"].shape[1]
+    fb = _fold_blocks(g, nb, s_total)
+    fb_local = fb // ctx.shards if fb >= ctx.shards else 1
+    s_local = state["n"].shape[0]
+
+    def blocked_sum(arr):
+        if fb == 1:
+            return ctx.psum(
+                jax.ops.segment_sum(arr, gid, num_segments=g)
+            )
+        per = s_local // fb_local
+        bid = jnp.arange(s_local, dtype=jnp.int32) // jnp.int32(per)
+        seg = jnp.where(gid < g, bid * jnp.int32(g) + gid,
+                        jnp.int32(fb_local * g))
+        p = jax.ops.segment_sum(arr, seg, num_segments=fb_local * g + 1)
+        parts = ctx.gather(p[:-1].reshape(fb_local, g, nb))
+        from greptimedb_tpu.parallel import dist as D
+
+        return D.left_fold_sum(parts)
+
+    out["n"] = blocked_sum(state["n"])
+    if "s" in state:
+        out["s"] = blocked_sum(state["s"])
+    if "s2" in state:
+        out["s2"] = blocked_sum(state["s2"])
+    if "m" in state:
+        f = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        out["m"] = ctx.pext(
+            f(state["m"], gid, num_segments=g), take_max=op != "min"
+        )
+
+    # first/last across sids within one cell: winner = (ts, sid)
+    # lexicographic, matching the host path's deterministic rule
+    # (max ts then max sid for last; min ts then min sid for first).
+    # The winner is unique, so its value is extracted by a masked
+    # segment_sum — exact for any float value incl. ±inf/NaN.
+    def fold_extreme(v_arr, t_arr, pick_max):
+        has = state["n"] > 0
+        sid = (ctx.sid_base(s_local)
+               + jnp.arange(s_local, dtype=jnp.int32))[:, None]
+        seg_ext = jax.ops.segment_max if pick_max else jax.ops.segment_min
+        t_id = -1 if pick_max else _I32_MAX
+        t = jnp.where(has, t_arr, t_id)
+        win_t = ctx.pext(seg_ext(t, gid, num_segments=g),
+                         take_max=pick_max)
+        tie = has & (t == win_t[gid])
+        sid_w = ctx.pext(
+            seg_ext(jnp.where(tie, sid, t_id), gid, num_segments=g),
+            take_max=pick_max,
+        )
+        win = tie & (sid == sid_w[gid])
+        v = ctx.psum(jax.ops.segment_sum(
+            jnp.where(win, v_arr, 0.0), gid, num_segments=g
+        ))
+        return v, jnp.clip(win_t, 0, _I32_MAX - 1)
+
+    if "il" in state:
+        out["vl"], out["il"] = fold_extreme(
+            state["vl"], state["il"], pick_max=True
+        )
+    if "if" in state:
+        out["vf"], out["if"] = fold_extreme(
+            state["vf"], state["if"], pick_max=False
+        )
+    return out
+
+
+def _disjoint_reduce(op, state, n_steps, w, jnp):
+    out = {}
+    if op in ("first_value", "last_value"):
+        G = state["n"].shape[0]
+        n_r = state["n"].reshape(G, n_steps, w)
+        has = n_r > 0
+        pos = jnp.arange(w, dtype=jnp.int32)[None, None, :]
+        # cells within a window carry distinct time ranges, so the
+        # last/first present cell is the exact winner (no value ties)
+        am_l = jnp.argmax(jnp.where(has, pos, -1), axis=2, keepdims=True)
+        am_f = jnp.argmin(
+            jnp.where(has, pos, _I32_MAX), axis=2, keepdims=True
+        )
+        for k, v in state.items():
+            r = v.reshape(G, n_steps, w)
+            if k == "n":
+                out[k] = r.sum(axis=2)
+            elif k in ("vl", "il", "cl"):
+                out[k] = jnp.take_along_axis(r, am_l, axis=2)[..., 0]
+            elif k in ("vf", "if", "cf"):
+                out[k] = jnp.take_along_axis(r, am_f, axis=2)[..., 0]
+        return out
+    for k, v in state.items():
+        r = v.reshape(v.shape[0], n_steps, w)
+        if k in ("n", "s", "s2"):
+            out[k] = r.sum(axis=2)
+        elif k == "m":
+            out[k] = (r.min(axis=2) if op == "min" else r.max(axis=2))
+    return out
+
+
+def _range_body(arrs, gid, sid_mask, delta, lo, hi, spec, ctx):
+    """One RANGE query over (local) cell-state grids. spec =
+    (stride, n_steps, g, fold, nanenc, items), items (op, w, field_key)
+    — everything shape-determining static. Shared verbatim by the
+    single-device program and each shard_map shard (the fold ctx is the
+    only difference), so sharded == unsharded bit-for-bit."""
     import jax
     import jax.numpy as jnp
 
+    stride, n_steps, g, fold, nanenc, items = spec
+    vals_out = []
+    pres_out = []
+    nb = next(iter(next(iter(arrs.values())).values())).shape[1]
+    cell_ids = jnp.arange(nb, dtype=jnp.int32)
+    cmask = (cell_ids >= lo) & (cell_ids < hi)
+    for op, w, fkey in items:
+        raw = arrs[fkey]
+        # map build-state keys to combine-state keys
+        state = {}
+        state["n"] = jnp.where(
+            cmask[None, :] & sid_mask[:, None], raw["n"], 0
+        )
+        for bk, ck in (("s", "s"), ("s2", "s2"), ("mn", "m"), ("mx", "m"),
+                       ("vl", "vl"), ("il", "il"), ("vf", "vf"),
+                       ("if", "if")):
+            if bk in raw and ck in _STATE_COMBINE.get(op, ()):
+                ident = _identity(bk, op, jnp)
+                v = raw[bk]
+                if ck not in ("il", "if"):
+                    v = v.astype(jnp.float32)
+                state[ck] = jnp.where(
+                    cmask[None, :] & sid_mask[:, None], v,
+                    jnp.asarray(ident, v.dtype),
+                )
+        if fold:
+            state = _fold_groups(op, state, gid, g, jnp, ctx)
+        # gather the query's cell window: nb_q cells starting at delta
+        nb_q = (n_steps - 1) * stride + w
+        idx = delta + jnp.arange(nb_q, dtype=jnp.int32)
+        okc = (idx >= 0) & (idx < nb)
+        safe = jnp.clip(idx, 0, nb - 1)
+        state = {
+            k: jnp.where(
+                okc[None, :], v[:, safe],
+                jnp.asarray(_identity(_ck_to_bk(k, op), op, jnp), v.dtype),
+            )
+            for k, v in state.items()
+        }
+        if op in ("first_value", "last_value"):
+            # cell keys for the lexicographic (cell, intra) ts compare;
+            # window position is monotone in absolute cell index
+            pres = state["n"] > 0
+            pos = jnp.arange(nb_q, dtype=jnp.int32)[None, :]
+            state["cl"] = jnp.where(pres, pos, -1)
+            state["cf"] = jnp.where(pres, pos, _I32_MAX)
+        if w == stride and nb_q == n_steps * w:
+            # disjoint windows: reshape-reduce (the TSBS double-groupby
+            # shape — rides dense reductions, no stride doubling)
+            combined = _disjoint_reduce(op, state, n_steps, w, jnp)
+        else:
+            combined = _window_combine_j(op, state, w, jnp)
+            combined = {
+                k: jax.lax.slice_in_dim(v, 0, (n_steps - 1) * stride + 1,
+                                        stride, axis=1)
+                for k, v in combined.items()
+            }
+        v, p = _finalize_j(op, combined, jnp)
+        if nanenc:
+            # presence rides inside the value plane as NaN (data is
+            # known all-finite): halves the result payload
+            v = jnp.where(p, v, jnp.nan)
+        vals_out.append(v.astype(jnp.float32))
+        pres_out.append(p)
+    # ONE output array -> one device->host transfer per query (each
+    # readback is a full round trip on a remote-attached chip)
+    if nanenc:
+        return jnp.stack(vals_out)
+    return jnp.concatenate(
+        [jnp.stack(vals_out), jnp.stack(pres_out).astype(jnp.float32)],
+        axis=0,
+    )
+
+
+def _make_range_program():
+    import jax
+
+    from greptimedb_tpu.parallel.dist import LocalFoldCtx
+
     @functools.partial(jax.jit, static_argnames=("spec",))
     def program(arrs, gid, sid_mask, delta, lo, hi, *, spec):
-        stride, n_steps, g, fold, nanenc, items = spec
-        vals_out = []
-        pres_out = []
-        nb = next(iter(next(iter(arrs.values())).values())).shape[1]
-        cell_ids = jnp.arange(nb, dtype=jnp.int32)
-        cmask = (cell_ids >= lo) & (cell_ids < hi)
-        for op, w, fkey in items:
-            raw = arrs[fkey]
-            # map build-state keys to combine-state keys
-            state = {}
-            state["n"] = jnp.where(
-                cmask[None, :] & sid_mask[:, None], raw["n"], 0
-            )
-            for bk, ck in (("s", "s"), ("s2", "s2"), ("mn", "m"), ("mx", "m"),
-                           ("vl", "vl"), ("il", "il"), ("vf", "vf"),
-                           ("if", "if")):
-                if bk in raw and ck in _STATE_COMBINE.get(op, ()):
-                    ident = _identity(bk, op, jnp)
-                    v = raw[bk]
-                    if ck not in ("il", "if"):
-                        v = v.astype(jnp.float32)
-                    state[ck] = jnp.where(
-                        cmask[None, :] & sid_mask[:, None], v,
-                        jnp.asarray(ident, v.dtype),
-                    )
-            if fold:
-                state = _fold_groups(op, state, gid, g, jnp)
-            # gather the query's cell window: nb_q cells starting at delta
-            nb_q = (n_steps - 1) * stride + w
-            idx = delta + jnp.arange(nb_q, dtype=jnp.int32)
-            okc = (idx >= 0) & (idx < nb)
-            safe = jnp.clip(idx, 0, nb - 1)
-            state = {
-                k: jnp.where(
-                    okc[None, :], v[:, safe],
-                    jnp.asarray(_identity(_ck_to_bk(k, op), op, jnp), v.dtype),
-                )
-                for k, v in state.items()
-            }
-            if op in ("first_value", "last_value"):
-                # cell keys for the lexicographic (cell, intra) ts compare;
-                # window position is monotone in absolute cell index
-                pres = state["n"] > 0
-                pos = jnp.arange(nb_q, dtype=jnp.int32)[None, :]
-                state["cl"] = jnp.where(pres, pos, -1)
-                state["cf"] = jnp.where(pres, pos, _I32_MAX)
-            if w == stride and nb_q == n_steps * w:
-                # disjoint windows: reshape-reduce (the TSBS double-groupby
-                # shape — rides dense reductions, no stride doubling)
-                combined = _disjoint_reduce(op, state, n_steps, w, jnp)
-            else:
-                combined = _window_combine_j(op, state, w, jnp)
-                combined = {
-                    k: jax.lax.slice_in_dim(v, 0, (n_steps - 1) * stride + 1,
-                                            stride, axis=1)
-                    for k, v in combined.items()
-                }
-            v, p = _finalize_j(op, combined, jnp)
-            if nanenc:
-                # presence rides inside the value plane as NaN (data is
-                # known all-finite): halves the result payload
-                v = jnp.where(p, v, jnp.nan)
-            vals_out.append(v.astype(jnp.float32))
-            pres_out.append(p)
-        # ONE output array -> one device->host transfer per query (each
-        # readback is a full round trip on a remote-attached chip)
-        if nanenc:
-            return jnp.stack(vals_out)
-        return jnp.concatenate(
-            [jnp.stack(vals_out), jnp.stack(pres_out).astype(jnp.float32)],
-            axis=0,
-        )
-
-    def _fold_groups(op, state, gid, g, jnp):
-        out = {}
-        out["n"] = jax.ops.segment_sum(state["n"], gid, num_segments=g)
-        if "s" in state:
-            out["s"] = jax.ops.segment_sum(state["s"], gid, num_segments=g)
-        if "s2" in state:
-            out["s2"] = jax.ops.segment_sum(state["s2"], gid, num_segments=g)
-        if "m" in state:
-            f = jax.ops.segment_min if op == "min" else jax.ops.segment_max
-            out["m"] = f(state["m"], gid, num_segments=g)
-        # first/last across sids within one cell: winner = (ts, sid)
-        # lexicographic, matching the host path's deterministic rule
-        # (max ts then max sid for last; min ts then min sid for first).
-        # The winner is unique, so its value is extracted by a masked
-        # segment_sum — exact for any float value incl. ±inf/NaN.
-        def fold_extreme(v_arr, t_arr, pick_max):
-            has = state["n"] > 0
-            sid = jnp.arange(
-                state["n"].shape[0], dtype=jnp.int32
-            )[:, None]
-            seg_ext = jax.ops.segment_max if pick_max else jax.ops.segment_min
-            t_id = -1 if pick_max else _I32_MAX
-            t = jnp.where(has, t_arr, t_id)
-            win_t = seg_ext(t, gid, num_segments=g)
-            tie = has & (t == win_t[gid])
-            sid_w = seg_ext(jnp.where(tie, sid, t_id), gid, num_segments=g)
-            win = tie & (sid == sid_w[gid])
-            v = jax.ops.segment_sum(
-                jnp.where(win, v_arr, 0.0), gid, num_segments=g
-            )
-            return v, jnp.clip(win_t, 0, _I32_MAX - 1)
-
-        if "il" in state:
-            out["vl"], out["il"] = fold_extreme(
-                state["vl"], state["il"], pick_max=True
-            )
-        if "if" in state:
-            out["vf"], out["if"] = fold_extreme(
-                state["vf"], state["if"], pick_max=False
-            )
-        return out
-
-    def _disjoint_reduce(op, state, n_steps, w, jnp):
-        out = {}
-        if op in ("first_value", "last_value"):
-            G = state["n"].shape[0]
-            n_r = state["n"].reshape(G, n_steps, w)
-            has = n_r > 0
-            pos = jnp.arange(w, dtype=jnp.int32)[None, None, :]
-            # cells within a window carry distinct time ranges, so the
-            # last/first present cell is the exact winner (no value ties)
-            am_l = jnp.argmax(jnp.where(has, pos, -1), axis=2, keepdims=True)
-            am_f = jnp.argmin(
-                jnp.where(has, pos, _I32_MAX), axis=2, keepdims=True
-            )
-            for k, v in state.items():
-                r = v.reshape(G, n_steps, w)
-                if k == "n":
-                    out[k] = r.sum(axis=2)
-                elif k in ("vl", "il", "cl"):
-                    out[k] = jnp.take_along_axis(r, am_l, axis=2)[..., 0]
-                elif k in ("vf", "if", "cf"):
-                    out[k] = jnp.take_along_axis(r, am_f, axis=2)[..., 0]
-            return out
-        for k, v in state.items():
-            r = v.reshape(v.shape[0], n_steps, w)
-            if k in ("n", "s", "s2"):
-                out[k] = r.sum(axis=2)
-            elif k == "m":
-                out[k] = (r.min(axis=2) if op == "min" else r.max(axis=2))
-        return out
+        return _range_body(arrs, gid, sid_mask, delta, lo, hi, spec,
+                           LocalFoldCtx())
 
     return program
+
+
+def _make_sharded_range_program(mesh):
+    """shard_map twin of the range program: grids series-sharded over
+    AXIS_SHARD, each shard runs _range_body on its slice with the
+    collective fold ctx. fold=True outputs replicate (the post-fold
+    window combine is tiny and runs redundantly per shard); fold=False
+    outputs stay series-sharded."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from greptimedb_tpu.parallel.dist import ShardFoldCtx
+    from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+
+    ns = mesh.shape[AXIS_SHARD]
+
+    @functools.partial(jax.jit, static_argnames=("spec",))
+    def program(arrs, gid, sid_mask, delta, lo, hi, *, spec):
+        fold = spec[3]
+        arr_specs = jax.tree_util.tree_map(
+            lambda _: P(AXIS_SHARD, None), arrs
+        )
+        ctx = ShardFoldCtx(ns)
+
+        def local(arrs, gid, sid_mask, delta, lo, hi):
+            return _range_body(arrs, gid, sid_mask, delta, lo, hi, spec,
+                               ctx)
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(arr_specs, P(AXIS_SHARD), P(AXIS_SHARD),
+                      P(), P(), P()),
+            out_specs=P() if fold else P(None, AXIS_SHARD, None),
+            check_rep=False,
+        )(arrs, gid, sid_mask, delta, lo, hi)
+
+    return program
+
+
+_SHARDED_RANGE = ProgramCache(_make_sharded_range_program)
+
+
+def get_sharded_program(mesh):
+    return _SHARDED_RANGE.get(mesh)
 
 
 _STATE_COMBINE = {
@@ -1476,6 +1637,7 @@ def execute_range_device(engine, plan, table):
                 entry = load_entry_snapshot(
                     table, r0, plan.align_to,
                     mesh=getattr(engine, "mesh", None),
+                    mesh_opts=getattr(engine, "mesh_opts", None),
                     byte_budget=cache.byte_budget,
                 )
                 if entry is not None:
@@ -1486,6 +1648,7 @@ def execute_range_device(engine, plan, table):
             entry = build_entry(
                 plan, table, items,
                 mesh=getattr(engine, "mesh", None),
+                mesh_opts=getattr(engine, "mesh_opts", None),
                 byte_budget=cache.byte_budget,
                 keep_host=getattr(engine, "persist_device_cache", True),
             )
@@ -1501,6 +1664,20 @@ def execute_range_device(engine, plan, table):
         if not ok:
             return None
     stats.add("grid_cache_bytes", entry.bytes())
+    if getattr(engine, "mesh", None) is not None:
+        from greptimedb_tpu.query.planner import (
+            MeshDecision, record_mesh_decision,
+        )
+        from greptimedb_tpu.parallel.mesh import shard_count
+
+        dec = getattr(entry, "mesh_decision", None)
+        if dec is None:
+            dec = MeshDecision(
+                "shard" if getattr(entry, "mesh", None) is not None
+                else "replicate", "cached",
+                devices=shard_count(engine.mesh),
+            )
+        record_mesh_decision(dec, "range")
 
     res = entry.res
     # WHERE ts bounds must land on cell edges or partials can't honor them
@@ -1559,9 +1736,13 @@ def execute_range_device(engine, plan, table):
         gid_full, g, key_cols = _group_ids_from_sids(
             plan, entry.registry, active
         )
-        fold = not (g == entry.num_series
-                    and np.array_equal(gid_full,
-                                       np.arange(entry.num_series)))
+        # identity grouping (each real series is its own group, padded
+        # tail routed past g) needs no fold: the per-series state IS the
+        # group state. num_series is FOLD_BLOCKS-padded, so compare the
+        # real prefix, not the whole axis.
+        fold = not (g <= entry.num_series
+                    and np.array_equal(gid_full[:g], np.arange(g))
+                    and (gid_full[g:] == g).all())
         _, put1 = _make_put(getattr(entry, "mesh", None))
         dmask = (put1(sid_mask & active) if sid_mask is not None
                  else put1(active))
@@ -1598,6 +1779,19 @@ def execute_range_device(engine, plan, table):
         entry.nan_ok.get(fname, fname == "__rows__") for fname, _ in items
     )
     program = get_program()
+    entry_mesh = getattr(entry, "mesh", None)
+    if entry_mesh is not None:
+        if (not memo["fold"]
+                or _fold_blocks(g, entry.nb, entry.num_series) != 1):
+            # explicit-collective shard_map program with the blocked
+            # exact fold (bit-identical across mesh sizes)
+            program = get_sharded_program(entry_mesh)
+        else:
+            # oversized blocked fold (FOLD_BLOCKS*g*nb past the partial
+            # budget): stays on the auto-SPMD jit program — still
+            # sharded, but XLA picks the combine order, so this is a
+            # DOCUMENTED bit-identity exception; surface it
+            stats.note("mesh_fold_range", "auto_spmd(oversized_fold)")
     prog_spec = (stride, n_steps, g, memo["fold"], nanenc, prog_items)
     with stats.timed("device_exec_ms"):
         out = program(
@@ -1605,7 +1799,9 @@ def execute_range_device(engine, plan, table):
             memo["delta"], memo["lo"], memo["hi"],
             spec=prog_spec,
         )
-        out = np.asarray(out)
+        # fold=False leaves the series axis un-folded: rows [g:] are the
+        # padded/inactive tail (fold=True already has exactly g rows)
+        out = np.asarray(out)[:, :g]
     if prog_spec not in entry.program_specs:
         entry.program_specs[prog_spec] = True
         concurrency.Thread(

@@ -259,11 +259,13 @@ class QueryEngine:
     inserts the cross-device collectives for group folds (SURVEY.md §2.7
     #1-2 — the region-partition + merge-scan analog over ICI)."""
 
-    def __init__(self, *, prefer_device: bool | None = None, mesh=None):
+    def __init__(self, *, prefer_device: bool | None = None, mesh=None,
+                 mesh_opts=None):
         self.prefer_device = prefer_device
         # write/restore device grid snapshots across restarts
         self.persist_device_cache = True
         self.mesh = mesh
+        self.mesh_opts = mesh_opts
         from greptimedb_tpu.query.device_range import DeviceRangeCache
 
         self.range_cache = DeviceRangeCache()
@@ -540,6 +542,7 @@ class QueryEngine:
             results, path = grouped_reduce(
                 specs, values, gid, valid_map, g, ts=ts,
                 prefer_device=self.prefer_device, mesh=self.mesh,
+                mesh_opts=self.mesh_opts,
             )
         stats.add("agg_groups", g)
         self._record_path("aggregate", path)

@@ -130,6 +130,109 @@ class SelectPlan:
         return out
 
 
+# ----------------------------------------------------------------------
+# replicate-vs-shard planning (multi-chip mesh execution)
+# ----------------------------------------------------------------------
+#
+# Every device execution site with a mesh consults decide_mesh_execution
+# before placing state: small grids replicate (single-device — launch +
+# collective latency dominates), large decomposable reductions shard the
+# series/row axis across the mesh and run the shard_map programs in
+# parallel/dist.py / query/reduce.py / query/device_range.py /
+# promql/fast.py. The decision (mode + reason + device count) lands in
+# EXPLAIN ANALYZE and the gtpu_mesh_* metrics.
+
+# aggregate shapes whose sharded fold is exact (blocked partials +
+# psum/pmin/pmax/staged selection reproduce the unsharded result
+# bit-for-bit; see parallel/mesh.FOLD_BLOCKS)
+SHARDABLE_ROW_OPS = frozenset({
+    "count", "sum", "mean", "min", "max", "first_value", "last_value",
+})
+# grid paths additionally shard var/stddev: their s/s2 folds ride the
+# same 8-block exact combine (the row path's per-block on-device mean
+# does not, so it stays replicated there)
+SHARDABLE_GRID_OPS = frozenset(SHARDABLE_ROW_OPS | {
+    "var_pop", "var_samp", "stddev_pop", "stddev_samp",
+})
+
+
+@dataclass(frozen=True)
+class MeshDecision:
+    mode: str            # "shard" | "replicate"
+    reason: str          # why (threshold, op shape, mesh geometry, ...)
+    devices: int = 1     # shard-axis devices the query will use
+
+    @property
+    def shard(self) -> bool:
+        return self.mode == "shard"
+
+    def label(self) -> str:
+        return f"{self.mode}({self.reason})"
+
+
+def decide_mesh_execution(
+    mesh, *, kind: str, series: int | None = None, rows: int | None = None,
+    ops=(), opts=None,
+) -> MeshDecision:
+    """Choose replicate vs shard for one query execution site.
+
+    kind: "range" | "aggregate" | "promql" | "topk" | "window" — grid
+    kinds gate on `series` (shard_min_series), row kinds on `rows`
+    (shard_min_rows). `ops` are normalized aggregate op names; a single
+    non-decomposable op forces replicate (the whole query runs as one
+    program)."""
+    from greptimedb_tpu.parallel.mesh import (
+        FOLD_BLOCKS, MeshOptions, shard_count,
+    )
+
+    n_dev = shard_count(mesh)
+    if mesh is None or n_dev <= 1:
+        return MeshDecision("replicate", "no_mesh")
+    opts = opts or MeshOptions()
+    shardable = (SHARDABLE_GRID_OPS if kind in ("range", "promql")
+                 else SHARDABLE_ROW_OPS)
+    bad = [op for op in ops if op not in shardable]
+    if bad:
+        return MeshDecision("replicate", f"non_decomposable:{bad[0]}",
+                            devices=n_dev)
+    if FOLD_BLOCKS % n_dev != 0:
+        # blocked exact folds need the shard count to divide the fixed
+        # block count; other geometries run replicated (still correct)
+        return MeshDecision("replicate", "mesh_indivisible", devices=n_dev)
+    if kind in ("range", "promql"):
+        if series is not None and series < max(opts.shard_min_series, 1):
+            return MeshDecision("replicate", "small_grid", devices=n_dev)
+    else:
+        if rows is not None and rows < max(opts.shard_min_rows, 1):
+            return MeshDecision("replicate", "small_rowset", devices=n_dev)
+    return MeshDecision("shard", "large_grid" if kind in ("range", "promql")
+                        else "large_rowset", devices=n_dev)
+
+
+def record_mesh_decision(decision: MeshDecision, kind: str) -> None:
+    """Surface one decision in EXPLAIN ANALYZE + gtpu_mesh_* metrics.
+    No-op counters-wise when no mesh is configured (devices == 1) so the
+    single-device deployment's metric surface stays unchanged."""
+    from greptimedb_tpu.query import stats
+
+    stats.note(f"mesh_decision_{kind}", decision.label())
+    if decision.devices <= 1:
+        return
+    if decision.shard:
+        # only sharded executions spread over the mesh; a replicated
+        # query on a meshed process still runs on one device
+        active = stats.active()
+        if active is not None:
+            active.counters["mesh_devices"] = decision.devices
+    from greptimedb_tpu.telemetry.metrics import global_registry
+
+    global_registry.counter(
+        "gtpu_mesh_queries_total",
+        "Mesh execution decisions by mode/reason/site",
+        labels=("kind", "mode", "reason"),
+    ).labels(kind, decision.mode, decision.reason).inc()
+
+
 _NORMALIZE_AGG = {
     "avg": "mean", "mean": "mean", "sum": "sum", "min": "min", "max": "max",
     "count": "count", "stddev": "stddev_samp", "stddev_pop": "stddev_pop",

@@ -18,6 +18,7 @@ import numpy as np
 
 from greptimedb_tpu.datatypes.batch import bucket_size, pad_to
 from greptimedb_tpu.errors import UnsupportedError
+from greptimedb_tpu.program_cache import ProgramCache
 
 DEVICE_THRESHOLD = 262_144  # rows below this stay on host
 
@@ -311,6 +312,128 @@ def _fused_program():
 
 _2_31M = 2**31 - 1
 _FUSED = None
+_SHARDED_FUSED = ProgramCache(lambda mesh: _sharded_fused_program(mesh))
+
+
+def _pow2_floor(n: int) -> int:
+    b = 1
+    while b * 2 <= n:
+        b *= 2
+    return b
+
+
+def _pick_blocks(nb: int, gb: int) -> int:
+    """Power-of-two row-block count for the fused program, independent
+    of mesh geometry: sharded and unsharded runs of the same query use
+    the SAME block boundaries, so per-block f32 partials (and therefore
+    the host f64 combine) agree bit-for-bit."""
+    return max(1, min(nb, _pow2_floor(max(8, (1 << 20) // max(gb, 1)))))
+
+
+def _sharded_fused_program(mesh):
+    """shard_map twin of _fused_program: rows sharded over AXIS_SHARD,
+    each shard computes its aligned slice of the per-(group, block)
+    partials locally (identical rows, identical scatter order), blocked
+    sections concatenate by output sharding, extremes recombine with
+    pmin/pmax and first/last winners with staged exact selection +
+    psum value extraction (the dist_segment_agg pattern from
+    parallel/dist.py generalized to the fused multi-aggregate layout)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from greptimedb_tpu.parallel import dist as D
+    from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+
+    ns = mesh.shape[AXIS_SHARD]
+
+    @functools.partial(jax.jit, static_argnames=("spec",))
+    def program(vals, masks, gid, tshi, tslo, *, spec):
+        gb, blocks, mask_rows, items = spec
+        bl = blocks // ns  # local blocks per shard (aligned boundaries)
+
+        def local(vals, masks, gid, tshi, tslo):
+            nbl = gid.shape[0]
+            per = -(-nbl // bl)
+            block = (jnp.arange(nbl, dtype=jnp.int32)
+                     // jnp.int32(per))
+            trash2 = jnp.int32(gb * bl)
+            shard = jax.lax.axis_index(AXIS_SHARD)
+            blocked = []
+            single = []
+
+            def pseg2(v, mask):
+                s2 = jnp.where(mask, gid * jnp.int32(bl) + block, trash2)
+                p = jax.ops.segment_sum(
+                    jnp.where(mask, v, 0.0).astype(jnp.float32),
+                    s2, num_segments=gb * bl + 1,
+                )
+                return p[:-1].reshape(gb, bl).T  # (bl_local, gb)
+
+            for mi in range(mask_rows):
+                blocked.append(pseg2(jnp.ones(nbl, jnp.float32),
+                                     masks[mi]))
+            idx_g = shard * jnp.int32(nbl) + jnp.arange(
+                nbl, dtype=jnp.int32
+            )
+            for op, vi, mi in items:
+                mask = masks[mi]
+                if op == "count":
+                    continue  # rides the mask's count rows
+                v = vals[vi]
+                if op in ("sum", "mean"):
+                    blocked.append(pseg2(v, mask))
+                elif op in ("min", "max"):
+                    ext = jax.ops.segment_max if op == "max" else (
+                        jax.ops.segment_min
+                    )
+                    ident = -jnp.inf if op == "max" else jnp.inf
+                    sg = jnp.where(mask, gid, jnp.int32(gb))
+                    r = ext(
+                        jnp.where(mask, v, ident).astype(jnp.float32),
+                        sg, num_segments=gb + 1,
+                    )[:-1]
+                    single.append(D.pext(r, AXIS_SHARD,
+                                         take_max=op == "max"))
+                elif op in ("first_value", "last_value"):
+                    last = op == "last_value"
+                    ext = jax.ops.segment_max if last else (
+                        jax.ops.segment_min
+                    )
+                    sent = jnp.int32(-1 if last else _2_31M)
+                    sg = jnp.where(mask, gid, jnp.int32(gb))
+
+                    def stage(key, tie, sg=sg, ext=ext, sent=sent,
+                              last=last, mask=mask):
+                        t = jnp.where(tie, key, sent)
+                        w = ext(t, sg, num_segments=gb + 1)[:-1]
+                        w = D.pext(w, AXIS_SHARD, take_max=last)
+                        return tie & (key == w[sg.clip(0, gb - 1)]) & mask
+
+                    tie = mask
+                    tie = stage(tshi, tie)
+                    tie = stage(tslo, tie)
+                    tie = stage(idx_g, tie)  # global row idx: unique
+                    r = jax.ops.segment_sum(
+                        jnp.where(tie, v, 0.0).astype(jnp.float32), sg,
+                        num_segments=gb + 1,
+                    )[:-1]
+                    single.append(jax.lax.psum(r, AXIS_SHARD))
+            out_b = jnp.stack(blocked)  # (sections, bl_local, gb)
+            out_s = (jnp.stack(single) if single
+                     else jnp.zeros((0, gb), jnp.float32))
+            return out_b, out_s
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(None, AXIS_SHARD), P(None, AXIS_SHARD),
+                      P(AXIS_SHARD), P(AXIS_SHARD), P(AXIS_SHARD)),
+            out_specs=(P(None, AXIS_SHARD, None), P()),
+            check_rep=False,
+        )(vals, masks, gid, tshi, tslo)
+
+    return program
 
 
 def _make_row_put(mesh):
@@ -356,9 +479,13 @@ def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts,
 
         shards = mesh.shape[AXIS_SHARD]
         nb = max(nb, shards)  # bucket sizes are powers of two
-    put2, put1 = _make_row_put(mesh)
     gb = _pad_group_count(g)
-    blocks = max(1, min(nb, (1 << 20) // gb))
+    blocks = _pick_blocks(nb, gb)
+    if mesh is not None and (blocks % shards or nb % blocks):
+        # shard boundaries must align with block boundaries for the
+        # exact blocked combine; degenerate geometries run single-device
+        mesh = None
+    put2, put1 = _make_row_put(mesh)
 
     # distinct validity masks (mask 0 = all-valid)
     mask_keys = [None]
@@ -399,9 +526,33 @@ def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts,
         for _, op, vk, _ in specs
     )
     spec = (gb, blocks, len(mask_arrays), items)
-    out_mat = np.asarray(
-        _FUSED(d_vals, d_masks, d_gid, d_tshi, d_tslo, spec=spec)
-    ).astype(np.float64)
+    if mesh is not None:
+        prog = _SHARDED_FUSED.get(mesh)
+        out_b, out_s = prog(d_vals, d_masks, d_gid, d_tshi, d_tslo,
+                            spec=spec)
+        out_b = np.asarray(out_b).astype(np.float64)
+        out_s = np.asarray(out_s).astype(np.float64)
+        # reassemble the single-device program's row layout so the host
+        # f64 combine below is shared verbatim
+        pieces = []
+        bi = si = 0
+        for _ in mask_arrays:
+            pieces.append(out_b[bi])
+            bi += 1
+        for op2, _vi, _mi in items:
+            if op2 == "count":
+                continue
+            if op2 in ("sum", "mean"):
+                pieces.append(out_b[bi])
+                bi += 1
+            else:
+                pieces.append(out_s[si][None, :])
+                si += 1
+        out_mat = np.concatenate(pieces, axis=0)
+    else:
+        out_mat = np.asarray(
+            _FUSED(d_vals, d_masks, d_gid, d_tshi, d_tslo, spec=spec)
+        ).astype(np.float64)
 
     # decode: host f64 combine of the blocked partials
     cnts = []
@@ -450,6 +601,7 @@ def grouped_reduce(
     ts: np.ndarray | None = None,
     prefer_device: bool | None = None,
     mesh=None,
+    mesh_opts=None,
 ) -> tuple[dict, str]:
     """specs: list of (out_name, op, value_key|None, q|None). values: key ->
     per-row array. valid_map: key -> bool array (all-valid if missing).
@@ -471,8 +623,19 @@ def grouped_reduce(
     ):
         path = "host:dtype"
     if path == "device":
+        use_mesh = None
+        if mesh is not None:
+            from greptimedb_tpu.query import planner as qplanner
+
+            dec = qplanner.decide_mesh_execution(
+                mesh, kind="aggregate", rows=n,
+                ops=[op for _, op, _, _ in specs], opts=mesh_opts,
+            )
+            qplanner.record_mesh_decision(dec, "aggregate")
+            if dec.shard:
+                use_mesh = mesh
         return _device_reduce_fused(
-            specs, values, gid, valid_map, g, ts, mesh=mesh
+            specs, values, gid, valid_map, g, ts, mesh=use_mesh
         ), path
     out = {}
     for name, op, vk, q in specs:

@@ -23,6 +23,7 @@ from __future__ import annotations
 import numpy as np
 
 from greptimedb_tpu.errors import PlanError, UnsupportedError
+from greptimedb_tpu.program_cache import ProgramCache
 from greptimedb_tpu.query.expr import Col, eval_expr
 from greptimedb_tpu.sql import ast as A
 
@@ -573,14 +574,125 @@ def _agg_over(name, vals, valid, mode, part_start, peer_start, part_id, n,
     return run, (run_cnt_b > 0)
 
 
+# compiled halo-window programs, keyed (mesh, k)
+_HALO_PROGRAMS = ProgramCache(
+    lambda key: _rows_pre_halo_program(*key), cap=8
+)
+_ROWS_PRE_MAX_HALO = 4096  # halo cells shipped per shard boundary
+
+
+def _rows_pre_halo_program(mesh, k: int):
+    """shard_map sliding-frame program: rows sharded over AXIS_SHARD,
+    each shard prepends the previous shard's k-row tail (halo_prev_1d)
+    so frames crossing the shard boundary stay local, then computes the
+    frame sum/count by local f64 prefix-sum difference. The halo is the
+    only cross-device traffic — one (k,) ppermute per input."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from greptimedb_tpu.parallel.dist import halo_prev_1d
+    from greptimedb_tpu.parallel.mesh import AXIS_SHARD
+
+    @jax.jit
+    def program(x, cnt, fs):
+        def local(x, cnt, fs):
+            n_loc = x.shape[0]
+            base = jax.lax.axis_index(AXIS_SHARD) * n_loc
+            cx = jnp.cumsum(halo_prev_1d(x, k, fill=0.0))
+            cc = jnp.cumsum(halo_prev_1d(cnt, k, fill=0.0))
+            end = jnp.arange(n_loc, dtype=jnp.int32) + k
+            # frame start in halo'd coords; the first shard's halo is
+            # zero-filled and fs >= 0, so it never leaks into a frame
+            rel = jnp.clip(fs - base + k, 0, end)
+            w_sum = cx[end] - jnp.where(rel > 0, cx[rel - 1], 0.0)
+            w_cnt = cc[end] - jnp.where(rel > 0, cc[rel - 1], 0.0)
+            return w_sum, w_cnt
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(AXIS_SHARD), P(AXIS_SHARD), P(AXIS_SHARD)),
+            out_specs=(P(AXIS_SHARD), P(AXIS_SHARD)),
+            check_rep=False,
+        )(x, cnt, fs)
+
+    return program
+
+
+def _rows_pre_sharded(name, numeric, cnt, fs, n, k: int):
+    """Mesh path for ROWS k PRECEDING sum/count/avg, or None when the
+    process-wide mesh / query shape doesn't qualify."""
+    if name not in ("sum", "avg", "mean", "count"):
+        return None
+    if k < 1 or k > _ROWS_PRE_MAX_HALO or not _x64_enabled():
+        return None
+    from greptimedb_tpu.parallel.mesh import (
+        AXIS_SHARD, global_mesh, global_mesh_opts, shard_count,
+    )
+    from greptimedb_tpu.query import planner, stats
+
+    mesh = global_mesh()
+    ns = shard_count(mesh)
+    if ns <= 1:
+        return None
+    if n < DEVICE_THRESHOLD:
+        # below the device-execution floor the host path wins regardless
+        # of the operator's shard threshold
+        return None
+    if not np.isfinite(numeric).all():
+        # non-finite values stay on the host baseline: its global-cumsum
+        # NaN/inf smear is the established comparison semantics (same
+        # guard as _running_scans' no-x64 path), while per-shard cumsums
+        # would localize the smear to one shard
+        return None
+    dec = planner.decide_mesh_execution(
+        mesh, kind="window", rows=n, opts=global_mesh_opts(),
+    )
+    planner.record_mesh_decision(dec, "window")
+    if not dec.shard:
+        return None
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_pad = -(-n // ns) * ns
+    pad = n_pad - n
+    x = np.pad(numeric, (0, pad))
+    c = np.pad(cnt.astype(np.float64), (0, pad))
+    # padded rows: empty frame (fs == own index -> w spans one 0 cell)
+    fs_p = np.pad(fs, (0, pad), constant_values=0).astype(np.int32)
+    if pad:
+        fs_p[n:] = np.arange(n, n_pad, dtype=np.int32)
+    prog = _HALO_PROGRAMS.get((mesh, k))
+    sh = NamedSharding(mesh, P(AXIS_SHARD))
+    with stats.timed("window_device_ms"):
+        w_sum, w_cnt = prog(
+            jax.device_put(x, sh), jax.device_put(c, sh),
+            jax.device_put(fs_p, sh),
+        )
+        w_sum = np.asarray(w_sum, np.float64)[:n]
+        w_cnt = np.asarray(w_cnt, np.float64)[:n]
+    stats.note("exec_path_window", "device_mesh")
+    if name == "count":
+        return w_cnt.astype(np.int64), None
+    if name in ("avg", "mean"):
+        return w_sum / np.maximum(w_cnt, 1), (w_cnt > 0)
+    return w_sum, (w_cnt > 0)
+
+
 def _agg_rows_pre(name, numeric, cnt, valid, part_start, n, k: int):
     """ROWS BETWEEN k PRECEDING AND CURRENT ROW: sliding frames via
     prefix-sum differences (sum/count/avg) or a windowed reduce
-    (min/max)."""
+    (min/max); decomposable frames run row-sharded over the process-
+    wide mesh (halo exchange covers frames crossing shard boundaries)."""
     start_idx = np.maximum.accumulate(
         np.where(part_start, np.arange(n), 0)
     )
     fs = np.maximum(np.arange(n) - k, start_idx)  # frame start
+    sharded = _rows_pre_sharded(name, numeric, cnt, fs, n, k)
+    if sharded is not None:
+        return sharded
     if name in ("sum", "avg", "mean", "count"):
         csum = np.cumsum(numeric)
         ccnt = np.cumsum(cnt)

@@ -144,6 +144,38 @@ class FileContext:
         # names of functions passed to pl.pallas_call(...) anywhere in
         # the module: their bodies run traced on device
         self.pallas_kernels: set[str] = set()
+        # functions passed to shard_map(f, ...): traced device bodies
+        # too, mapped to the axis names their call site binds (resolved
+        # from in_specs/out_specs/mesh literals + module string
+        # constants; GT013 checks collectives against this set). Keyed
+        # by (name, def lineno) — the call anchors to the NEAREST
+        # preceding def of that name, so same-named closures in one
+        # module (promql/fast.py has several `def local` shard_map
+        # bodies) neither merge their axis bindings nor mark an
+        # unrelated same-named helper as device scope.
+        self.shard_map_axes: dict[tuple[str, int], set[str]] = {}
+        func_lines: dict[str, list[int]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_lines.setdefault(node.name, []).append(node.lineno)
+        for lines in func_lines.values():
+            lines.sort()
+
+        def _def_key(name: str, call_line: int) -> tuple[str, int] | None:
+            lines = func_lines.get(name)
+            if not lines:
+                return None  # imported callee: no body in this module
+            prior = [ln for ln in lines if ln <= call_line]
+            return (name, prior[-1] if prior else lines[0])
+        # module-level NAME = "str" constants (axis-name resolution)
+        self.str_constants: dict[str, str] = {}
+        for node in tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                self.str_constants[node.targets[0].id] = node.value.value
         for node in ast.walk(tree):
             if isinstance(node, ast.Call):
                 f = dotted_name(node.func)
@@ -151,12 +183,69 @@ class FileContext:
                     k = dotted_name(node.args[0])
                     if k:
                         self.pallas_kernels.add(k.split(".")[-1])
+                if f and f.split(".")[-1] == "shard_map" and node.args:
+                    k = dotted_name(node.args[0])
+                    if k:
+                        # axis names live in the specs (positional mesh
+                        # is args[1]); from a mesh expression only
+                        # string LITERALS count — a bare `mesh` variable
+                        # is not an axis name
+                        axes: set[str] = set()
+                        spec_nodes = list(node.args[2:])
+                        mesh_nodes = list(node.args[1:2])
+                        for kw in node.keywords:
+                            (mesh_nodes if kw.arg == "mesh"
+                             else spec_nodes).append(kw.value)
+                        for sub in spec_nodes:
+                            axes |= self._axis_names_in(sub)
+                        for sub in mesh_nodes:
+                            axes |= {
+                                n.value for n in ast.walk(sub)
+                                if isinstance(n, ast.Constant)
+                                and isinstance(n.value, str)
+                            }
+                        key = _def_key(k.split(".")[-1], node.lineno)
+                        if key is not None:
+                            self.shard_map_axes.setdefault(
+                                key, set()
+                            ).update(axes)
         # interprocedural layer: per-function blocking/host-sync taint
         # over the module-local call graph (import here — callgraph
         # imports this module)
         from greptimedb_tpu.tools.lint.callgraph import ModuleSummary
 
         self.call_summary = ModuleSummary(tree)
+
+    def _axis_names_in(self, node: ast.AST) -> set[str]:
+        """Axis-name candidates inside a shard_map spec subtree: string
+        literals plus identifiers (resolved through module string
+        constants when possible, kept as `id:NAME` markers otherwise so
+        unresolved-but-identical names still match). Callee names
+        (`P(...)`, `PartitionSpec(...)`) are NOT axis candidates."""
+        callee_ids: set[int] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                callee_ids.update(id(c) for c in ast.walk(n.func))
+        out: set[str] = set()
+        for n in ast.walk(node):
+            if id(n) in callee_ids:
+                continue
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                out.add(n.value)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                v = self.str_constants.get(n.id)
+                out.add(v if v is not None else f"id:{n.id}")
+        return out
+
+    def axis_name_of(self, node: ast.AST) -> str | None:
+        """The axis-name value of one collective argument, in the same
+        resolution space as _axis_names_in; None when dynamic."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            v = self.str_constants.get(node.id)
+            return v if v is not None else f"id:{node.id}"
+        return None
 
     @property
     def current_class(self) -> str | None:
@@ -275,13 +364,17 @@ class ModuleLinter(ast.NodeVisitor):
             is_jit, st = jit_decorator_info(dec, params)
             if is_jit:
                 jitted, static = True, st
-        pallas = node.name in ctx.pallas_kernels
+        # Pallas kernels and shard_map bodies both run traced on device:
+        # host syncs / recompile hazards inside them are as real as in
+        # a @jax.jit function
+        kernel = (node.name in ctx.pallas_kernels
+                  or (node.name, node.lineno) in ctx.shard_map_axes)
         enclosing_device = bool(ctx.func_stack and ctx.func_stack[-1].device)
         fi = FuncInfo(
             node=node, name=node.name,
             params={p for p in params if p not in ("self", "cls")},
             jitted=jitted, static=static,
-            device=jitted or pallas or enclosing_device,
+            device=jitted or kernel or enclosing_device,
         )
         ctx.func_stack.append(fi)
         # loops/locks of the enclosing scope don't wrap this body

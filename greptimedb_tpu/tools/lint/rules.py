@@ -578,6 +578,69 @@ class UnboundedBlockingCall(Rule):
 _MUTABLE_CTORS = {"list", "dict", "set"}
 
 
+# collective -> index of its axis-name argument
+_COLLECTIVES = {
+    "psum": 1, "pmin": 1, "pmax": 1, "pmean": 1, "all_gather": 1,
+    "ppermute": 1, "psum_scatter": 1, "all_to_all": 1, "axis_index": 0,
+}
+
+
+@register
+class UnboundCollectiveAxis(Rule):
+    id = "GT013"
+    name = "unbound-collective-axis"
+    description = (
+        "A collective (psum/pmin/pmax/all_gather/...) inside a "
+        "shard_map body references an axis name the enclosing "
+        "shard_map call does not bind: it fails at trace time with an "
+        "unbound-axis error, or silently reduces over the wrong axis "
+        "when an outer mesh happens to share the name."
+    )
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        f = dotted_name(node.func)
+        if not f:
+            return
+        short = f.split(".")[-1]
+        pos = _COLLECTIVES.get(short)
+        if pos is None:
+            return
+        # innermost enclosing shard_map kernel with a known binding
+        bound = None
+        for fi in reversed(ctx.func_stack):
+            axes = ctx.shard_map_axes.get((fi.name, fi.node.lineno))
+            if axes:
+                bound = axes
+                break
+        if not bound:
+            return
+        axis_node = None
+        if len(node.args) > pos:
+            axis_node = node.args[pos]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    axis_node = kw.value
+        if axis_node is None:
+            return
+        axis = ctx.axis_name_of(axis_node)
+        if axis is None or axis in bound:
+            return
+        # only compare within one resolution space: an unresolved
+        # identifier could still equal a literal axis name (and vice
+        # versa), so mixed comparisons stay silent
+        if axis.startswith("id:"):
+            if not all(a.startswith("id:") for a in bound):
+                return
+        elif any(a.startswith("id:") for a in bound):
+            return
+        shown = sorted(a.removeprefix("id:") for a in bound)
+        ctx.report(self, node,
+                   f"collective {short}(...) references axis "
+                   f"{axis.removeprefix('id:')!r} not bound by the "
+                   f"enclosing shard_map (binds: {', '.join(shown)})")
+
+
 @register
 class MutableDefaultArg(Rule):
     id = "GT010"

@@ -35,7 +35,7 @@ def rules_hit(src: str, select: str | None = None):
 
 def test_registry_has_all_rules():
     ids = sorted(all_rules())
-    assert ids == [f"GT{n:03d}" for n in range(1, 13)]
+    assert ids == [f"GT{n:03d}" for n in range(1, 14)]
     for rule in all_rules().values():
         assert rule.name and rule.description
 
@@ -164,6 +164,41 @@ def test_gt004_positive_inside_pallas_kernel():
             return pl.pallas_call(my_kernel, out_shape=None)(x)
     """)
     assert ("GT004", 5) in hits
+
+
+def test_gt004_positive_inside_shard_map_body():
+    # shard_map bodies run traced on device exactly like jit/Pallas
+    hits = rules_hit("""
+        import jax
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def run(mesh, x):
+            def local(x):
+                return np.asarray(x).sum()
+
+            return shard_map(local, mesh=mesh, in_specs=(P("s"),),
+                             out_specs=P())(x)
+    """)
+    assert ("GT004", 9) in hits
+
+
+def test_gt005_positive_inside_shard_map_body():
+    hits = rules_hit("""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def run(mesh, x):
+            def local(x):
+                if x > 0:
+                    x = x - 1
+                return x
+
+            return shard_map(local, mesh=mesh, in_specs=(P("s"),),
+                             out_specs=P("s"))(x)
+    """)
+    assert ("GT005", 7) in hits
 
 
 def test_gt004_negative_host_code_and_static():
@@ -411,6 +446,115 @@ def test_gt010_negative_private_none_tuple():
 # ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
+# GT013 collective axis not bound by the enclosing shard_map
+# ---------------------------------------------------------------------------
+
+def test_gt013_positive_unbound_literal_axis():
+    hits = rules_hit("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def run(mesh, x):
+            def local(x):
+                return jax.lax.psum(x, "time")
+
+            return shard_map(local, mesh=mesh, in_specs=(P("shard"),),
+                             out_specs=P())(x)
+    """, select="GT013")
+    assert hits == [("GT013", 8)]
+
+
+def test_gt013_positive_unresolved_identifier_axis():
+    # both sides unresolved identifiers: compared by name
+    hits = rules_hit("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from somewhere import AXIS_A, AXIS_B
+
+        def run(mesh, x):
+            def local(x):
+                return jax.lax.pmax(x, AXIS_B)
+
+            return shard_map(local, mesh=mesh, in_specs=(P(AXIS_A),),
+                             out_specs=P())(x)
+    """, select="GT013")
+    assert hits == [("GT013", 9)]
+
+
+def test_gt013_positive_module_constant_resolution():
+    # module constants resolve to their string values before comparing
+    hits = rules_hit("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        AXIS_S = "shard"
+
+        def run(mesh, x):
+            def local(x):
+                return jax.lax.all_gather(x, "ici")
+
+            return shard_map(local, mesh=mesh, in_specs=(P(AXIS_S),),
+                             out_specs=P())(x)
+    """, select="GT013")
+    assert hits == [("GT013", 10)]
+
+
+def test_gt013_negative_bound_axis_and_mixed_spaces():
+    # bound literal axis: clean
+    assert rules_hit("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def run(mesh, x):
+            def local(x):
+                return jax.lax.psum(x, "shard")
+
+            return shard_map(local, mesh=mesh, in_specs=(P("shard"),),
+                             out_specs=P())(x)
+    """, select="GT013") == []
+    # module constant on both sides: resolves and matches
+    assert rules_hit("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        AXIS_S = "shard"
+
+        def run(mesh, x):
+            def local(x):
+                return jax.lax.pmin(x, AXIS_S)
+
+            return shard_map(local, mesh=mesh, in_specs=(P(AXIS_S),),
+                             out_specs=P())(x)
+    """, select="GT013") == []
+    # unresolved identifier vs literal specs: can't compare, stays quiet
+    assert rules_hit("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from somewhere import AXIS_T
+
+        def run(mesh, x):
+            def local(x):
+                return jax.lax.psum(x, AXIS_T)
+
+            return shard_map(local, mesh=mesh, in_specs=(P("shard"),),
+                             out_specs=P())(x)
+    """, select="GT013") == []
+    # collective outside any shard_map body: out of scope
+    assert rules_hit("""
+        import jax
+
+        def helper(x, axis_name="shard"):
+            return jax.lax.psum(x, axis_name)
+    """, select="GT013") == []
+
 
 def test_suppression_same_line():
     src = """

@@ -25,6 +25,10 @@ FLAGSHIP = (
     "ORDER BY ts, host"
 )
 
+# test grids are tiny; force the replicate-vs-shard planner to shard so
+# the mesh programs actually run (prod defaults gate on 4096 series)
+FORCE_SHARD = M.MeshOptions(shard_min_series=1, shard_min_rows=1)
+
 
 @pytest.fixture
 def inst(tmp_path, rng, devices):
@@ -69,7 +73,7 @@ def _compare(ra, rb):
 def test_sql_on_8device_mesh_matches_single(inst, devices):
     mesh = M.make_mesh(devices)  # 8-way series sharding
     e1 = QueryEngine(prefer_device=True)
-    em = QueryEngine(prefer_device=True, mesh=mesh)
+    em = QueryEngine(prefer_device=True, mesh=mesh, mesh_opts=FORCE_SHARD)
     r1 = _run(e1, inst, FLAGSHIP)
     assert e1.last_exec_path == "device"
     rm = _run(em, inst, FLAGSHIP)
@@ -84,7 +88,7 @@ def test_sql_on_8device_mesh_matches_single(inst, devices):
 
 def test_sql_on_mesh_global_group(inst, devices):
     mesh = M.make_mesh(devices)
-    em = QueryEngine(prefer_device=True, mesh=mesh)
+    em = QueryEngine(prefer_device=True, mesh=mesh, mesh_opts=FORCE_SHARD)
     q = ("SELECT ts, avg(u) RANGE '2m', count(*) RANGE '2m' FROM cpu "
          "ALIGN '1m' BY () ORDER BY ts")
     eh = QueryEngine(prefer_device=False)
@@ -125,7 +129,8 @@ def test_cluster_sql_on_mesh(tmp_path, rng, devices):
                        all_columns=["ts", "host", "u"])
     eh = QueryEngine(prefer_device=False)
     rh = eh.execute(plan, cluster.table("public", "cpu"))
-    em = QueryEngine(prefer_device=True, mesh=M.make_mesh(devices))
+    em = QueryEngine(prefer_device=True, mesh=M.make_mesh(devices),
+                     mesh_opts=FORCE_SHARD)
     rm = em.execute(plan, cluster.table("public", "cpu"))
     assert em.last_exec_path == "device"
     _compare(rh, rm)
@@ -136,7 +141,7 @@ def test_groupby_on_8device_mesh_matches_host(inst, devices):
     """Plain GROUP BY: the fused reduce program runs row-sharded over
     the mesh (VERDICT r3 task #2); results must equal the host path."""
     mesh = M.make_mesh(devices)
-    em = QueryEngine(prefer_device=True, mesh=mesh)
+    em = QueryEngine(prefer_device=True, mesh=mesh, mesh_opts=FORCE_SHARD)
     eh = QueryEngine(prefer_device=False)
     q = ("SELECT host, count(u), sum(u), avg(u), min(v), max(v), "
          "stddev_samp(u) FROM cpu GROUP BY host ORDER BY host")
@@ -156,6 +161,7 @@ def test_promql_fast_on_8device_mesh_matches_host(tmp_path, rng, devices):
     def build(home, mesh):
         rng = np.random.default_rng(7)  # identical data in both builds
         i = Standalone(str(home), prefer_device=True, mesh=mesh,
+                       mesh_opts=None if mesh is None else FORCE_SHARD,
                        warm_start=False)
         i.execute_sql(
             "create table http_requests (ts timestamp time index, "
@@ -202,3 +208,178 @@ def test_promql_fast_on_8device_mesh_matches_host(tmp_path, rng, devices):
         F.invalidate_cache()
         i1.close()
         im.close()
+
+
+# ----------------------------------------------------------------------
+# replicate-vs-shard planner + observability (ISSUE 7)
+# ----------------------------------------------------------------------
+
+
+def test_planner_replicate_vs_shard_decisions(devices):
+    """decide_mesh_execution: large grids shard, small ones replicate,
+    non-decomposable aggregates force replicate, and a missing mesh is
+    always replicate."""
+    from greptimedb_tpu.query.planner import decide_mesh_execution
+
+    mesh = M.make_mesh(devices)
+    opts = M.MeshOptions()  # prod defaults: 4096 series / 256k rows
+
+    d = decide_mesh_execution(mesh, kind="range", series=100_000,
+                              ops=("sum", "mean"), opts=opts)
+    assert d.shard and d.reason == "large_grid" and d.devices == 8
+
+    d = decide_mesh_execution(mesh, kind="range", series=64,
+                              ops=("sum",), opts=opts)
+    assert not d.shard and d.reason == "small_grid"
+
+    d = decide_mesh_execution(mesh, kind="aggregate", rows=1_000_000,
+                              ops=("count", "max"), opts=opts)
+    assert d.shard and d.reason == "large_rowset"
+
+    d = decide_mesh_execution(mesh, kind="aggregate", rows=500,
+                              ops=("count",), opts=opts)
+    assert not d.shard and d.reason == "small_rowset"
+
+    # median is not decomposable: the whole query runs replicated
+    d = decide_mesh_execution(mesh, kind="aggregate", rows=1_000_000,
+                              ops=("median",), opts=opts)
+    assert not d.shard and d.reason == "non_decomposable:median"
+
+    d = decide_mesh_execution(None, kind="range", series=1_000_000)
+    assert not d.shard and d.reason == "no_mesh"
+
+
+def test_planner_decision_through_query_path(inst, devices):
+    """The live query path consults the planner: with prod thresholds a
+    24-series grid replicates (single-device placement); with forced
+    thresholds the same query shards over 8 devices."""
+    from greptimedb_tpu.query import stats as qstats
+
+    mesh = M.make_mesh(devices)
+    q = ("SELECT ts, host, avg(u) RANGE '1m' FROM cpu ALIGN '1m' "
+         "BY (host) ORDER BY ts, host")
+
+    e_def = QueryEngine(prefer_device=True, mesh=mesh,
+                        mesh_opts=M.MeshOptions())
+    with qstats.collect() as st:
+        _run(e_def, inst, q)
+    assert st.notes["mesh_decision_range"] == "replicate(small_grid)"
+    entry = next(iter(e_def.range_cache._entries.values()))
+    assert entry.mesh is None
+
+    e_force = QueryEngine(prefer_device=True, mesh=mesh,
+                          mesh_opts=FORCE_SHARD)
+    with qstats.collect() as st:
+        _run(e_force, inst, q)
+    assert st.notes["mesh_decision_range"] == "shard(large_grid)"
+    assert st.counters["mesh_devices"] == 8
+    entry = next(iter(e_force.range_cache._entries.values()))
+    assert entry.mesh is mesh
+
+
+def test_mesh_metrics_and_explain_analyze(tmp_path, rng):
+    """gtpu_mesh_* must render in /metrics AND runtime_metrics, and
+    EXPLAIN ANALYZE must carry the replicate-vs-shard decision. Uses the
+    full [mesh]-config lifecycle (configure() from TOML-shaped knobs)."""
+    import urllib.request
+
+    from greptimedb_tpu.servers.http import HttpServer
+
+    M.reset_for_tests()
+    try:
+        opts = M.mesh_options_from({
+            "enabled": True, "shard_min_series": 1, "shard_min_rows": 1,
+        })
+        mesh = M.configure(opts)
+        assert mesh is not None and M.shard_count(mesh) == 8
+        inst = Standalone(str(tmp_path), mesh=mesh, mesh_opts=opts,
+                          prefer_device=True)
+        inst.execute_sql(
+            "create table cpu (ts timestamp time index, host string "
+            "primary key, u double)"
+        )
+        tab = inst.catalog.table("public", "cpu")
+        n_hosts, t = 16, 240
+        ts = np.tile(np.arange(t) * 10_000, n_hosts).astype(np.int64)
+        hosts = np.repeat(
+            [f"h{i:02d}" for i in range(n_hosts)], t
+        ).astype(object)
+        tab.write({"host": hosts}, ts, {"u": rng.random(n_hosts * t)})
+        r = inst.sql(
+            "EXPLAIN ANALYZE SELECT ts, host, avg(u) RANGE '1m' FROM cpu "
+            "ALIGN '1m' BY (host) ORDER BY ts, host"
+        )
+        text = "\n".join(row[0] for row in r.rows())
+        assert "mesh_decision_range: shard(large_grid)" in text
+        assert "mesh_devices: 8" in text
+        srv = HttpServer(inst, port=0).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=30
+            ) as resp:
+                body = resp.read().decode()
+            assert "gtpu_mesh_devices 8" in body
+            assert ('gtpu_mesh_queries_total{kind="range",mode="shard",'
+                    'reason="large_grid"}') in body
+        finally:
+            srv.stop()
+        res = inst.sql("select metric_name from "
+                       "information_schema.runtime_metrics")
+        names = list(res.column("metric_name").values)
+        assert "gtpu_mesh_devices" in names
+        assert "gtpu_mesh_queries_total" in names
+        inst.close()
+    finally:
+        M.reset_for_tests()
+
+
+def test_rows_preceding_window_on_global_mesh(tmp_path, rng, monkeypatch,
+                                              devices):
+    """ROWS k PRECEDING frames run the halo shard_map program when the
+    process-wide mesh is configured, matching the host baseline within
+    the documented ~ulp tolerance; exact counts stay exact."""
+    from greptimedb_tpu.query import stats as qstats
+    from greptimedb_tpu.query import window_fns as W
+
+    M.reset_for_tests()
+    try:
+        mesh = M.configure(M.MeshOptions(enabled=True, shard_min_rows=1))
+        assert mesh is not None and mesh.shape[M.AXIS_SHARD] == 8
+        monkeypatch.setattr(W, "DEVICE_THRESHOLD", 100)
+        inst = Standalone(str(tmp_path / "d"), prefer_device=False,
+                          warm_start=False)
+        try:
+            inst.execute_sql(
+                "create table w (ts timestamp time index, g string "
+                "primary key, v double)"
+            )
+            tab = inst.catalog.table("public", "w")
+            n = 4000
+            ts = np.tile(np.arange(n // 4) * 1000, 4).astype(np.int64)
+            gs = np.repeat(
+                [f"g{i}" for i in range(4)], n // 4
+            ).astype(object)
+            tab.write({"g": gs}, ts, {"v": rng.random(n) * 100})
+            q = ("select g, ts, sum(v) over (partition by g order by ts "
+                 "rows between 5 preceding and current row) as s, "
+                 "count(v) over (partition by g order by ts "
+                 "rows between 5 preceding and current row) as c "
+                 "from w order by g, ts")
+            with qstats.collect() as st:
+                dev = inst.sql(q).rows()
+            assert st.notes.get("exec_path_window") == "device_mesh"
+            # host baseline: with the global mesh dropped the same
+            # query must run the host path
+            M.reset_for_tests()
+            with qstats.collect() as st2:
+                host = inst.sql(q).rows()
+            assert st2.notes.get("exec_path_window") != "device_mesh"
+            assert len(host) == len(dev) == n
+            for h, d in zip(host, dev):
+                assert h[0] == d[0] and h[1] == d[1]
+                np.testing.assert_allclose(d[2], h[2], rtol=1e-9)
+                assert h[3] == d[3]
+        finally:
+            inst.close()
+    finally:
+        M.reset_for_tests()
