@@ -353,8 +353,15 @@ def _make_instance(opts):
 
     mesh_opts = mesh_mod.mesh_options_from(opts.section("mesh"))
     mesh = mesh_mod.configure(mesh_opts)
+    # [tracing] knobs: sampling + ring capacity for this process
+    from greptimedb_tpu.telemetry import tracing as _tracing
+
+    _tracing.configure(opts.section("tracing"))
+    prefer_device = opts.get("query.prefer_device")
     inst = Standalone(
         mesh=mesh, mesh_opts=mesh_opts,
+        prefer_device=(None if prefer_device is None
+                       else bool(prefer_device)),
         engine_config=EngineConfig(
             data_root=opts.get("data_home"),
             enable_background=opts.get("engine.enable_background", True),
@@ -515,6 +522,9 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst,
 
 
 def _start_frontend(opts):
+    from greptimedb_tpu.telemetry import tracing as _tracing
+
+    _tracing.configure(opts.section("tracing"))
     meta_addr = opts.get("metasrv.addr") or ""
     if meta_addr:
         # distributed frontend: catalog in the metasrv kv, regions on

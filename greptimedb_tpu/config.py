@@ -129,6 +129,24 @@ DEFAULTS: dict = {
         "hold_time_ms": 1000.0,   # GTS103 lock hold-time threshold
         "fail_on_cycle": True,    # findings fail the run (vs report)
     },
+    # end-to-end distributed tracing (telemetry/tracing.py): every
+    # query/ingest batch produces one stitched trace across processes
+    # (frontend sched/plan/fan-out + datanode scan + device
+    # compile/execute/transfer spans under a shared trace_id), served
+    # by /v1/traces + information_schema.traces. Sampling is
+    # TAIL-BASED: slow (>= slow_ms), errored and shed statements are
+    # ALWAYS kept; the rest keep with probability sample_ratio
+    "tracing": {
+        "enable": True,
+        "sample_ratio": 1.0,    # head probability for unremarkable traces
+        "capacity": 256,        # trace ring size (0 = unbounded; bench
+                                # refuses to run like that)
+        "slow_ms": 5000.0,      # always-keep threshold for slow traces
+    },
+    # query execution device preference (None = row-count heuristic);
+    # true forces the grid/device fast paths — what the dist-process
+    # tracing test uses to exercise device attribution on CPU jax
+    "query": {"prefer_device": None},
     "logging": {
         "level": "info",
         # statements slower than threshold land in the slow-query log +

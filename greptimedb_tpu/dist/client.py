@@ -215,6 +215,7 @@ class DatanodeClient:
 
         from greptimedb_tpu.dist.codec import arrow_to_scan
         from greptimedb_tpu.sched import deadline as _dl
+        from greptimedb_tpu.telemetry import tracing
 
         from greptimedb_tpu.dist import plan_codec
 
@@ -234,15 +235,25 @@ class DatanodeClient:
         }
         if timeout is not None:
             ticket["deadline_s"] = round(timeout, 3)
+        tp = tracing.traceparent()
+        if tp is not None:
+            # the datanode parents its scan spans under ours and ships
+            # them back (gtdb:spans): data-shipping queries stitch too
+            ticket["traceparent"] = tp
         try:
-            reader = self._client().do_get(
-                flight.Ticket(json.dumps(ticket).encode()),
-                options=flight.FlightCallOptions(timeout=timeout),
-            )
-            table = reader.read_all()
+            with tracing.child_span("dist.rpc", datanode=self.addr,
+                                    rpc="region_scan"):
+                reader = self._client().do_get(
+                    flight.Ticket(json.dumps(ticket).encode()),
+                    options=flight.FlightCallOptions(timeout=timeout),
+                )
+                table = reader.read_all()
         except flight.FlightError as e:
             self._raise(e, deadline=timeout is not None)
         meta = table.schema.metadata or {}
+        raw_spans = meta.get(b"gtdb:spans")
+        if raw_spans:
+            tracing.ingest_spans(json.loads(raw_spans))
         stats = json.loads(meta.get(b"gtdb:stats", b"{}"))
         names = (fields if fields is not None else [
             f.name for f in table.schema
@@ -404,11 +415,26 @@ class MetaClient:
             f"no reachable metasrv leader among {self.addrs}: {last}"
         )
 
+    @staticmethod
+    def _trace_headers(base: dict | None = None) -> dict:
+        """Outbound W3C trace context on every metasrv call: control-
+        plane work done on behalf of a traced statement (route refresh,
+        DDL kv) stays attributable to that statement's trace."""
+        from greptimedb_tpu.telemetry import tracing
+
+        headers = dict(base or {})
+        tp = tracing.traceparent()
+        if tp is not None:
+            headers["traceparent"] = tp
+        return headers
+
     def _post(self, path: str, doc: dict) -> dict:
         def go(addr):
             req = urllib.request.Request(
                 f"http://{addr}{path}", data=json.dumps(doc).encode(),
-                headers={"Content-Type": "application/json"},
+                headers=self._trace_headers(
+                    {"Content-Type": "application/json"}
+                ),
             )
             with urllib.request.urlopen(req,
                                         timeout=self.timeout) as resp:
@@ -423,8 +449,11 @@ class MetaClient:
 
     def _get(self, path: str) -> dict:
         def go(addr):
+            req = urllib.request.Request(
+                f"http://{addr}{path}", headers=self._trace_headers()
+            )
             with urllib.request.urlopen(
-                f"http://{addr}{path}", timeout=self.timeout
+                req, timeout=self.timeout
             ) as resp:
                 return json.loads(resp.read() or b"{}")
 

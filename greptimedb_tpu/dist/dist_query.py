@@ -123,9 +123,15 @@ class _StageClock:
         return _Ctx()
 
     def done(self):
+        from greptimedb_tpu.telemetry import tracing
+
         for stage, ms in self.ms.items():
             stats.add(f"dist_stage_{stage}_ms", ms)
             _STAGE_MS.labels(stage).inc(ms)
+            # the SAME per-stage numbers ride the active trace as
+            # completed child spans, so traces and the
+            # gtpu_dist_query_stage_ms metrics always agree
+            tracing.event_span(f"dist.{stage}", ms)
         _QUERIES.inc()
 
 
@@ -259,6 +265,7 @@ def _fan_out_stream(instance, table, partial: SelectPlan, clock,
     (addr, missing_region_count, error) instead of failing the whole
     query; otherwise the typed error propagates."""
     from greptimedb_tpu.servers.remote import arrow_to_result
+    from greptimedb_tpu.telemetry import tracing
 
     t0 = time.perf_counter()
     plan_json = _plan_doc(partial)
@@ -271,8 +278,17 @@ def _fan_out_stream(instance, table, partial: SelectPlan, clock,
     timeout = _dl.call_timeout()
     dl_field = (b'' if timeout is None
                 else b'"deadline_s":%.3f,' % timeout)
+    # trace context crosses the Flight hop as a ticket field (stripped
+    # from the datanode's decode-memo key like deadline_s, so hot
+    # queries keep cache-hitting); the datanode parents its spans under
+    # ours and ships them back in gtdb:spans — resolved HERE because
+    # pool workers do not inherit this thread's contextvars
+    parent_span = tracing.current_span()
+    tp = tracing.traceparent()
+    tp_field = (b'' if tp is None
+                else b'"traceparent":"%s",' % tp.encode())
     tickets = [
-        (client, b'{"rpc":"partial_sql",' + dl_field
+        (client, b'{"rpc":"partial_sql",' + dl_field + tp_field
          + b'"mode":"plan","plan":'
          + plan_json + b',"table":' + info_json + b',"region_ids":'
          + json.dumps(list(rids)).encode() + b"}", len(rids))
@@ -282,19 +298,29 @@ def _fan_out_stream(instance, table, partial: SelectPlan, clock,
 
     def one(client, ticket, nrids):
         t = time.perf_counter()
-        try:
-            arrow = client.partial_sql_ticket(ticket, timeout=timeout)
-        except (DatanodeUnavailableError,
-                QueryDeadlineExceededError) as e:
-            if failures is None:
-                raise
-            failures.append((client.addr, nrids, e))
-            return None
-        res = arrow_to_result(arrow)
+        with tracing.child_span("dist.rpc", _parent=parent_span,
+                                datanode=client.addr) as rpc_sp:
+            try:
+                arrow = client.partial_sql_ticket(ticket,
+                                                  timeout=timeout)
+            except (DatanodeUnavailableError,
+                    QueryDeadlineExceededError) as e:
+                rpc_sp.attributes["error"] = \
+                    f"{type(e).__name__}: {e}"
+                if failures is None:
+                    raise
+                failures.append((client.addr, nrids, e))
+                return None
+            res = arrow_to_result(arrow)
         rpc_ms = (time.perf_counter() - t) * 1000.0
         meta = arrow.schema.metadata or {}
         stage = json.loads(meta.get(b"gtdb:stage_stats", b"{}"))
         path = meta.get(b"gtdb:exec_path", b"?").decode()
+        raw_spans = meta.get(b"gtdb:spans")
+        if raw_spans:
+            # stitch the datanode's spans into OUR ring: one trace now
+            # covers frontend and datanode work
+            tracing.ingest_spans(json.loads(raw_spans))
         return client.addr, res, stage, path, rpc_ms, arrow.num_rows
 
     t_fan = time.perf_counter()

@@ -437,6 +437,8 @@ class DistInstance(Standalone):
         """One DoPut with applied-ack drain; raises on failure."""
         import pyarrow.flight as flight
 
+        from greptimedb_tpu.telemetry import tracing
+
         cli = self._flow_client_for(addr)
         descriptor = flight.FlightDescriptor.for_path(
             f"flow_mirror:{db}.{name}"
@@ -448,7 +450,19 @@ class DistInstance(Standalone):
                 descriptor, batch.schema,
                 options=flight.FlightCallOptions(timeout=5.0),
             )
-            writer.write_batch(batch)
+            tp = tracing.traceparent()
+            if tp is not None:
+                # trace context on the batch metadata: the flownode's
+                # evaluation span joins this insert's trace
+                import json as _json
+
+                import pyarrow as _pa
+
+                writer.write_with_metadata(batch, _pa.py_buffer(
+                    _json.dumps({"traceparent": tp}).encode()
+                ))
+            else:
+                writer.write_batch(batch)
             # drain the ack so the flownode has APPLIED the delta
             # before this insert returns (a flush must see it)
             writer.done_writing()

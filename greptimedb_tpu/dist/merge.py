@@ -32,11 +32,13 @@ from greptimedb_tpu import concurrency
 
 _DECODE_LRU_MAX = 64
 
-# the frontend splices the remaining deadline budget into the ticket
-# (dist_query.py _fan_out_stream); it varies per query, so the decode
-# memo keys on the ticket WITHOUT it — otherwise every deadline-bound
-# repeat of a hot query would miss the plan-decode cache
+# the frontend splices the remaining deadline budget AND the trace
+# context into the ticket (dist_query.py _fan_out_stream); both vary
+# per query, so the decode memo keys on the ticket WITHOUT them —
+# otherwise every deadline-bound or traced repeat of a hot query would
+# miss the plan-decode cache
 _DEADLINE_FIELD_RE = re.compile(r'"deadline_s":[0-9.eE+-]+,')
+_TRACEPARENT_FIELD_RE = re.compile(r'"traceparent":"[0-9a-f-]*",')
 _decode_lock = concurrency.Lock()
 _decode_cache: OrderedDict[str, tuple] = OrderedDict()
 
@@ -106,12 +108,14 @@ def exec_partial(instance, doc: dict, raw: str | None = None):
     query surface."""
     from greptimedb_tpu.query import stats as qstats
     from greptimedb_tpu.servers.flight import result_to_arrow
+    from greptimedb_tpu.telemetry import tracing
 
     if doc.get("mode") != "plan":
         raise ValueError("partial_sql requires mode='plan'")
     t0 = time.perf_counter()
     if raw is not None:
         raw = _DEADLINE_FIELD_RE.sub("", raw, count=1)
+        raw = _TRACEPARENT_FIELD_RE.sub("", raw, count=1)
     plan, info = _decode_ticket(raw, doc)
     rs = instance.region_server
     rids = [int(r) for r in doc["region_ids"]]
@@ -128,7 +132,15 @@ def exec_partial(instance, doc: dict, raw: str | None = None):
     try:
         if dl is not None:
             dl.check("partial query")
-        with qstats.collect() as collected:
+        # continue the frontend's trace: every span this execution
+        # produces (scan cache hit/miss, device compile/execute/
+        # transfer) is collected and shipped back in gtdb:spans so the
+        # frontend's ring holds ONE stitched trace
+        with tracing.export_spans() as exported, \
+                tracing.start_remote(
+                    doc.get("traceparent"), "datanode.partial",
+                    regions=len(rids), kind=plan.kind,
+                ), qstats.collect() as collected:
             res = instance.query_engine.execute(plan, table)
     finally:
         if token is not None:
@@ -141,4 +153,8 @@ def exec_partial(instance, doc: dict, raw: str | None = None):
         "exec_ms": exec_ms,
     }).encode()
     meta[b"gtdb:exec_path"] = instance.query_engine.last_exec_path.encode()
+    if doc.get("traceparent") and exported:
+        meta[b"gtdb:spans"] = json.dumps(
+            [s.to_json() for s in exported]
+        ).encode()
     return out.replace_schema_metadata(meta)

@@ -327,6 +327,7 @@ class RegionServer:
             predicate_fingerprint,
         )
         from greptimedb_tpu.query import stats as qstats
+        from greptimedb_tpu.telemetry import tracing
 
         rids = [int(r) for r in region_ids]
         regions = [self._region(rid) for rid in rids]
@@ -335,34 +336,47 @@ class RegionServer:
         tag_names = list(regions[0].meta.tag_names)
         names = (field_names if field_names is not None
                  else list(regions[0].meta.field_names))
-        # TTL regions clamp ts_min to (now - ttl) INSIDE Region.scan, so
-        # a cached merge would keep serving rows past their expiry even
-        # though no version changed — never cache those
-        cacheable = all(r.meta.options.ttl_ms is None for r in regions)
-        if not cacheable:
-            qstats.add("dist_scan_cache_bypass", 1)
+        # a traced scan shows WHERE the rows came from: the merged-scan
+        # cache (hit), a cold merge (miss) or a TTL bypass — the same
+        # attribution gtpu_dist_scan_cache_* counters aggregate
+        with tracing.child_span("datanode.scan",
+                                regions=len(regions)) as scan_sp:
+            # TTL regions clamp ts_min to (now - ttl) INSIDE
+            # Region.scan, so a cached merge would keep serving rows
+            # past their expiry even though no version changed — never
+            # cache those
+            cacheable = all(
+                r.meta.options.ttl_ms is None for r in regions
+            )
+            if not cacheable:
+                qstats.add("dist_scan_cache_bypass", 1)
+                scan_sp.attributes["scan_cache"] = "bypass"
+                rows, tag_values, stats = self._scan_merged(
+                    regions, tag_names, names, ts_min=ts_min,
+                    ts_max=ts_max, matchers=matchers, fulltext=fulltext,
+                )
+                return ScanEntry((), rows, tag_values, names, stats,
+                                 _entry_nbytes(rows, tag_values))
+            versions = tuple(r.physical_version for r in regions)
+            key = (tuple(rids), tuple(names),
+                   predicate_fingerprint(ts_min, ts_max, matchers,
+                                         fulltext))
+            entry = self.scan_cache.get(key, versions)
+            if entry is not None:
+                qstats.add("dist_scan_cache_hits", 1)
+                scan_sp.attributes["scan_cache"] = "hit"
+                return entry
+            qstats.add("dist_scan_cache_misses", 1)
+            scan_sp.attributes["scan_cache"] = "miss"
             rows, tag_values, stats = self._scan_merged(
                 regions, tag_names, names, ts_min=ts_min, ts_max=ts_max,
                 matchers=matchers, fulltext=fulltext,
             )
-            return ScanEntry((), rows, tag_values, names, stats,
-                             _entry_nbytes(rows, tag_values))
-        versions = tuple(r.physical_version for r in regions)
-        key = (tuple(rids), tuple(names),
-               predicate_fingerprint(ts_min, ts_max, matchers, fulltext))
-        entry = self.scan_cache.get(key, versions)
-        if entry is not None:
-            qstats.add("dist_scan_cache_hits", 1)
+            scan_sp.attributes["rows"] = stats.get("rows_scanned", 0)
+            entry = ScanEntry(versions, rows, tag_values, names, stats,
+                              _entry_nbytes(rows, tag_values))
+            self.scan_cache.put(key, entry)
             return entry
-        qstats.add("dist_scan_cache_misses", 1)
-        rows, tag_values, stats = self._scan_merged(
-            regions, tag_names, names, ts_min=ts_min, ts_max=ts_max,
-            matchers=matchers, fulltext=fulltext,
-        )
-        entry = ScanEntry(versions, rows, tag_values, names, stats,
-                          _entry_nbytes(rows, tag_values))
-        self.scan_cache.put(key, entry)
-        return entry
 
     def _pool(self):
         """Bounded shared pool for intra-datanode region parallelism."""

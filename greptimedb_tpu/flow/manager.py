@@ -353,6 +353,16 @@ class FlowManager:
                 traceback.print_exc()
 
     def _apply_delta(self, flow: Flow, table, data: dict, valid: dict):
+        from greptimedb_tpu.telemetry import tracing
+
+        # joins the triggering insert's trace (directly in standalone,
+        # via the mirrored traceparent on a flownode); tick-driven
+        # backfills carry no trace and skip the span entirely
+        with tracing.child_span("flow.eval", flow=flow.name):
+            self._apply_delta_traced(flow, table, data, valid)
+
+    def _apply_delta_traced(self, flow: Flow, table, data: dict,
+                            valid: dict):
         if flow.plan is None:
             with flow.lock:
                 # concurrent first inserts must not each build a plan +

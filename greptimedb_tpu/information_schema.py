@@ -309,7 +309,8 @@ def _collations_doc(inst) -> dict[str, list]:
 
 def _slow_queries_doc(inst) -> dict[str, list]:
     rows = {"cost_time_ms": [], "threshold_ms": [], "query": [],
-            "schema_name": [], "channel": [], "timestamp": []}
+            "schema_name": [], "channel": [], "timestamp": [],
+            "trace_id": []}
     log = getattr(inst, "slow_query_log", None)
     if log is not None:
         for e in log.entries():
@@ -319,6 +320,32 @@ def _slow_queries_doc(inst) -> dict[str, list]:
             rows["schema_name"].append(e["schema"])
             rows["channel"].append(e["channel"])
             rows["timestamp"].append(e["ts_ms"])
+            rows["trace_id"].append(e.get("trace_id", ""))
+    return rows
+
+
+def _traces_doc(inst) -> dict[str, list]:
+    """The in-memory trace ring, one row per span (the SQL-queryable
+    face of /v1/traces: `SELECT * FROM information_schema.traces WHERE
+    trace_id = ...` renders the same stitched spans)."""
+    import json as _json
+
+    from greptimedb_tpu.telemetry.tracing import global_traces
+
+    rows = {"trace_id": [], "span_id": [], "parent_span_id": [],
+            "span_name": [], "start_timestamp": [], "duration_ms": [],
+            "attributes": []}
+    for tr in global_traces.traces(limit=global_traces.cap or 256):
+        for s in tr["spans"]:
+            rows["trace_id"].append(tr["trace_id"])
+            rows["span_id"].append(s["span_id"])
+            rows["parent_span_id"].append(s["parent_id"] or "")
+            rows["span_name"].append(s["name"])
+            rows["start_timestamp"].append(int(s["start_ms"]))
+            rows["duration_ms"].append(
+                -1.0 if s["duration_ms"] is None else s["duration_ms"]
+            )
+            rows["attributes"].append(_json.dumps(s["attributes"]))
     return rows
 
 
@@ -341,6 +368,7 @@ _PROVIDERS = {
     "character_sets": _character_sets_doc,
     "collations": _collations_doc,
     "slow_queries": _slow_queries_doc,
+    "traces": _traces_doc,
 }
 
 

@@ -42,6 +42,11 @@ class IngestEntry:
     retryable: bool = True
     # route-refresh retries already burned on this entry's rows
     attempts: int = 0
+    # W3C trace context of the statement that produced this write,
+    # captured at submit time (the sender thread has no request
+    # context); rides the wire group's metadata so the datanode apply
+    # joins the insert's trace
+    traceparent: str | None = None
     ticket: object | None = field(default=None, repr=False)
     # post-coalesce: every ticket the merged entry must complete
     tickets: list = field(default_factory=list, repr=False)
@@ -121,6 +126,12 @@ def coalesce_entries(entries: list[IngestEntry]) -> list[IngestEntry]:
             op=first.op, skip_wal=first.skip_wal,
             retryable=all(e.retryable for e in group),
             attempts=max(e.attempts for e in group),
+            # coalesced batches span statements; attribute the group to
+            # the first traced one (the others still correlate via the
+            # datanode's gtpu ingest metrics)
+            traceparent=next(
+                (e.traceparent for e in group if e.traceparent), None
+            ),
         )
         valid = {}
         for f in first.fields:

@@ -154,12 +154,19 @@ class IngestPipeline:
         statement layer's refresh-and-replay backstop can fire)."""
         if self._closed:
             raise IngestOverloadedError("ingest pipeline is closed")
+        from greptimedb_tpu.telemetry import tracing
+
         ticket = WriteTicket()
         ticket.add_parts(len(entries))
+        # capture the statement's trace context HERE (the sender thread
+        # that ships the coalesced group has no request context)
+        tp = tracing.traceparent()
         submitted = 0
         try:
             for e in entries:
                 e.ticket = ticket
+                if tp is not None and e.traceparent is None:
+                    e.traceparent = tp
                 self.sender_for(e.client).submit(e)
                 submitted += 1
         except IngestOverloadedError as shed:

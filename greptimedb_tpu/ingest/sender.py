@@ -246,6 +246,10 @@ class DatanodeSender:
                 "region_id": e.region_id, "op": int(e.op),
                 "skip_wal": bool(e.skip_wal),
             }
+            if e.traceparent:
+                # the datanode opens a span under the insert's trace
+                # when the group applies (servers/flight.py)
+                meta["traceparent"] = e.traceparent
             encoded.append((e, batch, meta))
         # one wire group per schema (a region's table has one shape)
         by_schema: dict[tuple, list] = {}

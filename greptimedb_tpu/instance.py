@@ -248,16 +248,29 @@ class Standalone:
                     ) -> list[Output]:
         import time as _time
 
+        from greptimedb_tpu.telemetry import tracing
+
         ctx = ctx or QueryContext()
         outputs = []
         t0 = _time.perf_counter()
+        trace_id = None
         try:
-            for stmt in parse_sql(sql):
-                outputs.append(self.execute_statement(stmt, ctx))
+            # one span per statement batch: the root on wires that
+            # carry no traceparent (mysql/postgres/flight), a child of
+            # the server's request span on HTTP — and the trace_id the
+            # slow-query log links back to
+            with tracing.span("sql.execute", db=ctx.database,
+                              channel=ctx.channel) as root:
+                trace_id = root.trace_id or None
+                for stmt in parse_sql(sql):
+                    outputs.append(self.execute_statement(stmt, ctx))
         finally:
+            # duration from the monotonic perf counter (GT011), never
+            # wall-clock arithmetic
             self.slow_query_log.maybe_record(
                 sql, _time.perf_counter() - t0,
                 db=ctx.database, channel=ctx.channel,
+                trace_id=trace_id,
             )
         return outputs
 
@@ -884,10 +897,14 @@ class Standalone:
             ts_name = table.ts_name
             tag_names = table.tag_names
             all_columns = table.schema.column_names
-        plan = plan_select(
-            stmt, ts_name=ts_name, tag_names=tag_names,
-            all_columns=all_columns,
-        )
+        from greptimedb_tpu.telemetry import tracing
+
+        with tracing.child_span("query.plan",
+                                table=stmt.from_table or ""):
+            plan = plan_select(
+                stmt, ts_name=ts_name, tag_names=tag_names,
+                all_columns=all_columns,
+            )
         if table is not None and getattr(table, "remote", False):
             # distributed tables: try the MergeScan split first (partial
             # plans execute datanode-side, only partial states cross the
@@ -926,9 +943,10 @@ class Standalone:
             import time as _time
 
             from greptimedb_tpu.query import stats as qstats
+            from greptimedb_tpu.telemetry import tracing
 
             t0 = _time.perf_counter()
-            with qstats.collect() as st:
+            with qstats.collect() as st, tracing.export_spans() as tspans:
                 if isinstance(stmt.statement, A.SetOp):
                     from greptimedb_tpu.query import relational
 
@@ -940,6 +958,22 @@ class Standalone:
                 f"  Metrics: rows={res.num_rows} elapsed={dt:.3f}ms"
             )
             lines.extend(st.lines())
+            if tspans:
+                # the span tree of THIS execution, inline (sched queue,
+                # scan cache hit/miss, fan-out, device compile/execute/
+                # transfer) — same spans /v1/traces serves
+                tid = tracing.current_trace_id()
+                remote = tracing.global_traces.trace(tid) if tid else []
+                local_ids = {s.span_id for s in tspans}
+                docs = [s.to_json() for s in tspans] + [
+                    d for d in remote
+                    if d["span_id"] not in local_ids
+                    and d.get("duration_ms") is not None
+                ]
+                lines.append(f"  Trace: {tid or '(sampling disabled)'}")
+                lines.extend(
+                    "    " + ln for ln in tracing.render_tree(docs)
+                )
         return _result_from_lists(["plan"], [lines])
 
     def _tql(self, stmt: A.Tql, ctx: QueryContext) -> QueryResult:

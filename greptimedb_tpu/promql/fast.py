@@ -273,8 +273,15 @@ def _build_entry(table, fieldname: str, version, mesh=None,
         gvals = jax.device_put(gvals, sh2)
         ghas = jax.device_put(ghas, sh2)
         gtsg = jax.device_put(gtsg, sh2)
-    gvals.block_until_ready()
     nbytes = s_pad * nc * 9
+    # the grid BUILD is the big host->device transfer of this path:
+    # attribute it on the trace (a first query over a cold selector
+    # pays it; steady-state queries hit the resident grid)
+    from greptimedb_tpu.telemetry import tracing as _tracing
+
+    with _tracing.child_span("device.upload", site="promql_grid",
+                             upload_bytes=nbytes):
+        gvals.block_until_ready()
     _FAST_HITS.labels("grid_build").inc()
     global_registry.gauge(
         "greptime_promql_grid_build_seconds",
@@ -887,16 +894,27 @@ def try_fast_histogram(engine, phi: float, inner, ev):
         return _empty_vector(ev)
     lookback_ticks = max(int(ev.lookback_ms // entry.spec.unit), 1)
     _note_mesh_decision(entry, auto_spmd_site="histogram")
-    packed = _fused_hist_query(
-        entry.vals, entry.has, entry.tsg, smask, d_gid, d_slot,
-        jnp.asarray(uniq_le, jnp.float32), lo, hi, t_end,
-        jnp.float32(phi),
-        fname=fname, agg_op=agg_op, g_agg=g_agg, g=g, b=b,
-        range_ticks=range_ticks,
-        range_seconds=range_seconds, l_cells=l_cells,
-        tps=entry.spec.tps, fargs=fargs, lookback_ticks=lookback_ticks,
-    )
-    packed_np = np.asarray(packed, np.float64)
+    from greptimedb_tpu.telemetry import device_trace
+
+    with device_trace.device_call(
+            "promql_histogram", key=("hist", fname, agg_op, g_agg, g, b,
+                                     range_ticks, range_seconds,
+                                     l_cells, entry.spec.tps, fargs,
+                                     lookback_ticks)) as dcall:
+        packed = _fused_hist_query(
+            entry.vals, entry.has, entry.tsg, smask, d_gid, d_slot,
+            jnp.asarray(uniq_le, jnp.float32), lo, hi, t_end,
+            jnp.float32(phi),
+            fname=fname, agg_op=agg_op, g_agg=g_agg, g=g, b=b,
+            range_ticks=range_ticks,
+            range_seconds=range_seconds, l_cells=l_cells,
+            tps=entry.spec.tps, fargs=fargs,
+            lookback_ticks=lookback_ticks,
+        )
+        packed.block_until_ready()
+        dcall.executed()
+        packed_np = np.asarray(packed, np.float64)
+        dcall.transfer(packed_np.nbytes, "readback")
     vals_np = packed_np[:g]
     pres_np = packed_np[g:] != 0.0
     keep = pres_np.any(axis=1)
@@ -936,14 +954,25 @@ def try_fast(engine, e, ev):
     program = (_fused_query if entry.mesh is None
                else _get_sharded_query(entry.mesh))
     _note_mesh_decision(entry)
-    packed = program(
-        entry.vals, entry.has, entry.tsg, smask, gid,
-        lo, hi, t_end,
-        fname=fname, op=e.op, g=g, range_ticks=range_ticks,
-        range_seconds=range_seconds, l_cells=l_cells,
-        tps=entry.spec.tps, fargs=fargs, lookback_ticks=lookback_ticks,
-    )
-    packed_np = np.asarray(packed, np.float64)
+    from greptimedb_tpu.telemetry import device_trace
+
+    with device_trace.device_call(
+            "promql", key=("promql", entry.mesh is None, fname, e.op,
+                           g, range_ticks, range_seconds, l_cells,
+                           entry.spec.tps, fargs, lookback_ticks),
+            groups=g) as dcall:
+        packed = program(
+            entry.vals, entry.has, entry.tsg, smask, gid,
+            lo, hi, t_end,
+            fname=fname, op=e.op, g=g, range_ticks=range_ticks,
+            range_seconds=range_seconds, l_cells=l_cells,
+            tps=entry.spec.tps, fargs=fargs,
+            lookback_ticks=lookback_ticks,
+        )
+        packed.block_until_ready()
+        dcall.executed()
+        packed_np = np.asarray(packed, np.float64)
+        dcall.transfer(packed_np.nbytes, "readback")
     vals_np = packed_np[:g]
     pres_np = packed_np[g:] != 0.0
     keep = pres_np.any(axis=1)
@@ -1183,13 +1212,24 @@ def try_fast_topk(engine, e, ev):
     topk_prog = (_fused_topk if entry.mesh is None
                  else _get_sharded_topk(entry.mesh))
     _note_mesh_decision(entry)
-    packed = np.asarray(topk_prog(
-        entry.vals, entry.has, entry.tsg, smask, lo, hi, t_end,
-        fname=fname, k=kk, largest=e.op == "topk",
-        range_ticks=range_ticks, range_seconds=range_seconds,
-        l_cells=l_cells, tps=entry.spec.tps, fargs=fargs,
-        lookback_ticks=lookback_ticks,
-    ))
+    from greptimedb_tpu.telemetry import device_trace
+
+    with device_trace.device_call(
+            "topk", key=("topk", entry.mesh is None, fname, kk,
+                         e.op == "topk", range_ticks, range_seconds,
+                         l_cells, entry.spec.tps, fargs,
+                         lookback_ticks)) as dcall:
+        packed_dev = topk_prog(
+            entry.vals, entry.has, entry.tsg, smask, lo, hi, t_end,
+            fname=fname, k=kk, largest=e.op == "topk",
+            range_ticks=range_ticks, range_seconds=range_seconds,
+            l_cells=l_cells, tps=entry.spec.tps, fargs=fargs,
+            lookback_ticks=lookback_ticks,
+        )
+        packed_dev.block_until_ready()
+        dcall.executed()
+        packed = np.asarray(packed_dev)
+        dcall.transfer(packed.nbytes, "readback")
     jj = packed.shape[0] // 3
     top_vals = packed[:jj].astype(np.float64)      # (J, k)
     top_idx = packed[jj:2 * jj].astype(np.int64)
@@ -1376,21 +1416,32 @@ def try_fast_binary(engine, e, ev, *, agg=None):
         gid = jnp.zeros(entry_l.s_pad, jnp.int32)
     lookback_ticks = max(int(ev.lookback_ms // entry_l.spec.unit), 1)
     _note_mesh_decision(entry_l, auto_spmd_site="binary")
-    packed = _fused_binary(
-        entry_l.vals, entry_l.has, entry_l.tsg, smask_l,
-        lo_l, hi_l, t_end_l,
-        entry_r.vals, entry_r.has, entry_r.tsg, smask_r,
-        lo_r, hi_r, t_end_r,
-        gid,
-        fname_l=fname_l, fname_r=fname_r, op=e.op,
-        bool_mod=bool(e.bool_mod), agg_op=agg_op, g=g,
-        range_ticks_l=rt_l, range_ticks_r=rt_r,
-        range_seconds_l=rs_l, range_seconds_r=rs_r,
-        l_cells_l=lc_l, l_cells_r=lc_r, tps=entry_l.spec.tps,
-        fargs_l=fargs_l, fargs_r=fargs_r,
-        lookback_ticks=lookback_ticks,
-    )
-    packed_np = np.asarray(packed, np.float64)
+    from greptimedb_tpu.telemetry import device_trace
+
+    with device_trace.device_call(
+            "promql_binary", key=("binary", fname_l, fname_r, e.op,
+                                  bool(e.bool_mod), agg_op, g, rt_l,
+                                  rt_r, rs_l, rs_r, lc_l, lc_r,
+                                  entry_l.spec.tps, fargs_l, fargs_r,
+                                  lookback_ticks)) as dcall:
+        packed = _fused_binary(
+            entry_l.vals, entry_l.has, entry_l.tsg, smask_l,
+            lo_l, hi_l, t_end_l,
+            entry_r.vals, entry_r.has, entry_r.tsg, smask_r,
+            lo_r, hi_r, t_end_r,
+            gid,
+            fname_l=fname_l, fname_r=fname_r, op=e.op,
+            bool_mod=bool(e.bool_mod), agg_op=agg_op, g=g,
+            range_ticks_l=rt_l, range_ticks_r=rt_r,
+            range_seconds_l=rs_l, range_seconds_r=rs_r,
+            l_cells_l=lc_l, l_cells_r=lc_r, tps=entry_l.spec.tps,
+            fargs_l=fargs_l, fargs_r=fargs_r,
+            lookback_ticks=lookback_ticks,
+        )
+        packed.block_until_ready()
+        dcall.executed()
+        packed_np = np.asarray(packed, np.float64)
+        dcall.transfer(packed_np.nbytes, "readback")
     if agg_op:
         vals_np = packed_np[:g]
         pres_np = packed_np[g:] != 0.0

@@ -1731,6 +1731,7 @@ def execute_range_device(engine, plan, table):
         tuple(k.expr.name for k in plan.keys),
         delta, lo_c, hi_c,
     )
+    uploaded_bytes = 0
     memo = entry.query_memo.get(memo_key)
     if memo is None:
         gid_full, g, key_cols = _group_ids_from_sids(
@@ -1752,6 +1753,9 @@ def execute_range_device(engine, plan, table):
             "delta": jnp.int32(delta), "lo": jnp.int32(lo_c),
             "hi": jnp.int32(hi_c),
         }
+        # host-side sizes as the upload proxy (the devices hold the
+        # padded copies): per-query tunnel traffic for the trace span
+        uploaded_bytes = int(gid_full.nbytes) + int(active.nbytes)
         if len(entry.query_memo) >= 32:
             entry.query_memo.pop(next(iter(entry.query_memo)))
         entry.query_memo[memo_key] = memo
@@ -1793,16 +1797,35 @@ def execute_range_device(engine, plan, table):
             # DOCUMENTED bit-identity exception; surface it
             stats.note("mesh_fold_range", "auto_spmd(oversized_fold)")
     prog_spec = (stride, n_steps, g, memo["fold"], nanenc, prog_items)
-    with stats.timed("device_exec_ms"):
+    # device-time attribution: one span per jit/shard_map invocation
+    # carrying compile (first-call vs cache-hit), block_until_ready
+    # execute time and readback bytes — the tunnel floor becomes a
+    # named span on the trace. Attribution comes from device_trace's
+    # PROCESS-level memo, matching the jit cache's scope (the
+    # entry-level program_specs memo resets with every rebuilt grid
+    # entry — e.g. each datanode partial builds a fresh table — and
+    # would mislabel warm programs as first_call).
+    from greptimedb_tpu.telemetry import device_trace
+
+    first_spec = prog_spec not in entry.program_specs
+    with stats.timed("device_exec_ms"), \
+            device_trace.device_call(
+                "range", key=("range", prog_spec),
+                groups=g, steps=n_steps) as dcall:
+        if uploaded_bytes:
+            dcall.transfer(uploaded_bytes, "upload")
         out = program(
             arrs, memo["gid"], memo["mask"],
             memo["delta"], memo["lo"], memo["hi"],
             spec=prog_spec,
         )
+        out.block_until_ready()
+        dcall.executed()
         # fold=False leaves the series axis un-folded: rows [g:] are the
         # padded/inactive tail (fold=True already has exactly g rows)
         out = np.asarray(out)[:, :g]
-    if prog_spec not in entry.program_specs:
+        dcall.transfer(out.nbytes, "readback")
+    if first_spec:
         entry.program_specs[prog_spec] = True
         concurrency.Thread(
             target=_persist_program_specs, args=(entry, table),

@@ -526,12 +526,27 @@ def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts,
         for _, op, vk, _ in specs
     )
     spec = (gb, blocks, len(mask_arrays), items)
+    # device-time attribution at the jit/shard_map call boundary
+    # (telemetry/device_trace): compile first-call vs cache-hit,
+    # block_until_ready execute time, host<->device bytes
+    from greptimedb_tpu.telemetry import device_trace
+
+    upload = sum(int(a.nbytes) for a in (
+        d_vals, d_masks, d_gid, d_tshi, d_tslo
+    ) if hasattr(a, "nbytes"))
     if mesh is not None:
         prog = _SHARDED_FUSED.get(mesh)
-        out_b, out_s = prog(d_vals, d_masks, d_gid, d_tshi, d_tslo,
-                            spec=spec)
-        out_b = np.asarray(out_b).astype(np.float64)
-        out_s = np.asarray(out_s).astype(np.float64)
+        with device_trace.device_call(
+                "groupby", key=("groupby-sharded", spec),
+                groups=g) as dcall:
+            dcall.transfer(upload, "upload")
+            out_b, out_s = prog(d_vals, d_masks, d_gid, d_tshi, d_tslo,
+                                spec=spec)
+            out_b.block_until_ready()
+            dcall.executed()
+            out_b = np.asarray(out_b).astype(np.float64)
+            out_s = np.asarray(out_s).astype(np.float64)
+            dcall.transfer(out_b.nbytes + out_s.nbytes, "readback")
         # reassemble the single-device program's row layout so the host
         # f64 combine below is shared verbatim
         pieces = []
@@ -550,9 +565,15 @@ def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts,
                 si += 1
         out_mat = np.concatenate(pieces, axis=0)
     else:
-        out_mat = np.asarray(
-            _FUSED(d_vals, d_masks, d_gid, d_tshi, d_tslo, spec=spec)
-        ).astype(np.float64)
+        with device_trace.device_call(
+                "groupby", key=("groupby", spec), groups=g) as dcall:
+            dcall.transfer(upload, "upload")
+            out_dev = _FUSED(d_vals, d_masks, d_gid, d_tshi, d_tslo,
+                             spec=spec)
+            out_dev.block_until_ready()
+            dcall.executed()
+            out_mat = np.asarray(out_dev).astype(np.float64)
+            dcall.transfer(out_mat.nbytes, "readback")
 
     # decode: host f64 combine of the blocked partials
     cnts = []

@@ -409,7 +409,15 @@ class _Admission:
         )
         self._dl_token = _deadline.bind(self.deadline)
         try:
-            self._c._acquire(self._tenant, self.deadline)
+            # the admit span's duration IS the queue wait: a trace of a
+            # statement that queued shows its sojourn next to the
+            # execution spans (and a shed raises inside the span, so
+            # shed traces carry the error and survive tail sampling)
+            from greptimedb_tpu.telemetry import tracing
+
+            with tracing.child_span("sched.admit",
+                                    tenant=self._tenant):
+                self._c._acquire(self._tenant, self.deadline)
         except BaseException:
             _deadline.reset(self._dl_token)
             self._dl_token = None

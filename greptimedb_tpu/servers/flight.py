@@ -161,9 +161,13 @@ class FlightServer(flight.FlightServerBase):
 
     # ---- queries ------------------------------------------------------
     def _run_sql(self, sql: str) -> pa.Table:
-        # raw SQL, or a JSON envelope {"sql": ..., "db": ...} so remote
-        # frontends can forward session database context
+        from greptimedb_tpu.telemetry import tracing
+
+        # raw SQL, or a JSON envelope {"sql": ..., "db": ...,
+        # "traceparent": ...} so remote frontends can forward session
+        # database AND trace context
         db = "public"
+        tp = None
         if sql.startswith("{"):
             try:
                 import json
@@ -171,9 +175,11 @@ class FlightServer(flight.FlightServerBase):
                 doc = json.loads(sql)
                 sql = doc["sql"]
                 db = doc.get("db") or "public"
+                tp = doc.get("traceparent")
             except (ValueError, KeyError):
                 pass
-        outs = self.instance.execute_sql(sql, QueryContext(database=db))
+        with tracing.start_remote(tp, "flight sql", db=db):
+            outs = self.instance.execute_sql(sql, QueryContext(database=db))
         out = outs[-1]
         if out.result is None:
             # DML/DDL ack: marked in schema metadata so remote frontends
@@ -224,6 +230,7 @@ class FlightServer(flight.FlightServerBase):
         if rpc == "region_scan":
             from greptimedb_tpu.dist import plan_codec
             from greptimedb_tpu.sched import deadline as _dl
+            from greptimedb_tpu.telemetry import tracing
 
             rs = self._region_server()
             # re-anchor the shipped deadline budget for cooperative
@@ -234,25 +241,37 @@ class FlightServer(flight.FlightServerBase):
             try:
                 if dl is not None:
                     dl.check("region scan")
-                rows, tag_values, names, stats = rs.scan(
-                    doc["region_ids"],
-                    ts_min=doc.get("ts_min"), ts_max=doc.get("ts_max"),
-                    field_names=doc.get("fields"),
-                    matchers=(
-                        [(m[0], m[1], plan_codec.decode(m[2]))
-                         for m in doc["matchers"]]
-                        if doc.get("matchers") else None
-                    ),
-                    fulltext=(
-                        [tuple(f) for f in doc["fulltext"]]
-                        if doc.get("fulltext") else None
-                    ),
-                )
+                # continue the frontend's trace; the produced spans
+                # (merged scan, cache hit/miss) ship back in gtdb:spans
+                with tracing.export_spans() as exported, \
+                        tracing.start_remote(
+                            doc.get("traceparent"),
+                            "datanode.region_scan",
+                            regions=len(doc["region_ids"]),
+                        ):
+                    rows, tag_values, names, stats = rs.scan(
+                        doc["region_ids"],
+                        ts_min=doc.get("ts_min"),
+                        ts_max=doc.get("ts_max"),
+                        field_names=doc.get("fields"),
+                        matchers=(
+                            [(m[0], m[1], plan_codec.decode(m[2]))
+                             for m in doc["matchers"]]
+                            if doc.get("matchers") else None
+                        ),
+                        fulltext=(
+                            [tuple(f) for f in doc["fulltext"]]
+                            if doc.get("fulltext") else None
+                        ),
+                    )
             finally:
                 if token is not None:
                     _dl.reset(token)
+            extra = {"gtdb:stats": stats}
+            if doc.get("traceparent") and exported:
+                extra["gtdb:spans"] = [s.to_json() for s in exported]
             return dist_codec.scan_to_arrow(
-                rows, tag_values, names, extra_meta={"gtdb:stats": stats}
+                rows, tag_values, names, extra_meta=extra
             )
         if rpc == "partial_sql":
             from greptimedb_tpu.dist.merge import exec_partial
@@ -350,6 +369,10 @@ class FlightServer(flight.FlightServerBase):
         inst = self.instance
         if getattr(inst, "flows", None) is None:
             raise flight.FlightServerError("this node does not run flows")
+        import json
+
+        from greptimedb_tpu.telemetry import tracing
+
         db, _, tname = name.partition(".")
         # DistCatalogManager.table() refreshes from the shared kv on a
         # miss, so a just-created source table resolves here
@@ -358,6 +381,19 @@ class FlightServer(flight.FlightServerBase):
             if chunk.data is None:
                 continue
             batch = chunk.data
+            # the mirroring frontend stamps its trace context on the
+            # batch metadata: the flow evaluation joins the insert's
+            # trace
+            tp = None
+            if chunk.app_metadata:
+                try:
+                    doc = json.loads(chunk.app_metadata.to_pybytes())
+                except ValueError:
+                    doc = None
+                # valid JSON that is not an object (e.g. an array)
+                # must be ignored, not abort the stream
+                if isinstance(doc, dict):
+                    tp = doc.get("traceparent")
             data: dict = {}
             valid: dict = {}
             for i in range(batch.num_columns):
@@ -369,7 +405,17 @@ class FlightServer(flight.FlightServerBase):
                 data[cname] = hc.values
                 valid[cname] = hc.valid_mask
             try:
-                inst.flows.on_insert(db, tname, table, data, valid)
+                if tp:
+                    with tracing.start_remote(
+                            tp, "flownode.mirror_apply",
+                            table=f"{db}.{tname}",
+                            rows=batch.num_rows):
+                        inst.flows.on_insert(db, tname, table, data,
+                                             valid)
+                else:
+                    # untraced mirror: no root span — a per-batch root
+                    # would churn real query traces out of the ring
+                    inst.flows.on_insert(db, tname, table, data, valid)
             except Exception as e:  # noqa: BLE001 - RPC boundary
                 raise wrap_flight_error(e) from e
 
@@ -513,8 +559,23 @@ class FlightServer(flight.FlightServerBase):
                 continue
             gid = meta.get("group", 0)
             batches, pending = pending, []
+            # trace context rides the group's end-marker metadata
+            # (ingest/sender.py): the apply joins the INSERT's trace on
+            # this datanode's ring under the shared trace_id
+            tp = next(
+                (m.get("traceparent") for m, _ in batches
+                 if m.get("traceparent")), None,
+            )
             try:
-                rows = self._apply_region_batches(rs, batches)
+                if tp:
+                    from greptimedb_tpu.telemetry import tracing
+
+                    with tracing.start_remote(
+                            tp, "datanode.ingest_group",
+                            batches=len(batches)):
+                        rows = self._apply_region_batches(rs, batches)
+                else:
+                    rows = self._apply_region_batches(rs, batches)
                 ack = {"group": gid, "rows": rows}
             except Exception as e:  # noqa: BLE001 - ack carries it
                 code = 0

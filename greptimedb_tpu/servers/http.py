@@ -343,14 +343,22 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             if path == "/v1/traces" or path.startswith("/v1/traces/"):
                 from greptimedb_tpu.telemetry.tracing import global_traces
 
+                params = self._params()
+                tid = params.get("trace_id")
                 if path.startswith("/v1/traces/"):
-                    tid = path.rsplit("/", 1)[-1]
+                    tid = path.rsplit("/", 1)[-1].split("?", 1)[0]
+                if tid:
+                    # ?trace_id= filtering: exactly one stitched trace
                     return self._json(200, {
                         "trace_id": tid,
                         "spans": global_traces.trace(tid),
                     })
+                try:
+                    limit = int(params.get("limit", "50") or 50)
+                except ValueError:
+                    return self._error(400, "bad limit")
                 return self._json(
-                    200, {"traces": global_traces.traces()}
+                    200, {"traces": global_traces.traces(limit)}
                 )
             if path == "/debug/prof/cpu":
                 # sampling CPU profile of the whole process (pprof

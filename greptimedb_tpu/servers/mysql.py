@@ -366,16 +366,24 @@ class _Handler(socketserver.BaseRequestHandler):
         if _AT_VAR_STMT_RE.fullmatch(stripped):
             self._at_vars(conn, stripped, ctx)
             return
-        try:
-            outs = inst.execute_sql(stripped, ctx)
-        except Exception as e:  # noqa: BLE001 - protocol boundary
-            conn.send_packet(self._err(1064, "42000", wire_message(e)))
-            return
-        out = outs[-1]
-        if out.result is None:
-            conn.send_packet(self._ok(out.affected_rows or 0))
-            return
-        self._send_resultset(conn, out.result)
+        from greptimedb_tpu.telemetry import tracing
+
+        # per-message root span (the MySQL wire carries no traceparent):
+        # covers execution AND resultset encoding, so wire-encode time
+        # is attributable per trace like the HTTP request span
+        with tracing.start_remote(None, "mysql query"):
+            try:
+                outs = inst.execute_sql(stripped, ctx)
+            except Exception as e:  # noqa: BLE001 - protocol boundary
+                conn.send_packet(
+                    self._err(1064, "42000", wire_message(e))
+                )
+                return
+            out = outs[-1]
+            if out.result is None:
+                conn.send_packet(self._ok(out.affected_rows or 0))
+                return
+            self._send_resultset(conn, out.result)
 
     def _at_vars(self, conn: _Conn, sql: str, ctx):
         names = _AT_VAR_RE.findall(sql)

@@ -394,8 +394,6 @@ class _Handler(socketserver.BaseRequestHandler):
         conn.send(_ready())
 
     def _execute(self, conn: _Conn, inst, ctx, sql: str, *, extended: bool):
-        import re
-
         from greptimedb_tpu.sql.parser import parse_sql
 
         # simple protocol allows multiple statements per Query message:
@@ -405,6 +403,17 @@ class _Handler(socketserver.BaseRequestHandler):
         except Exception as e:  # noqa: BLE001 - protocol boundary
             conn.send(_error("42601", str(e)))
             return
+        from greptimedb_tpu.telemetry import tracing
+
+        # per-message root span (the PG wire carries no traceparent):
+        # multi-statement simple-protocol messages share ONE trace, and
+        # row encoding is attributable like the HTTP request span
+        with tracing.start_remote(None, "postgres query"):
+            self._execute_traced(conn, inst, ctx, sql, stmts)
+
+    def _execute_traced(self, conn, inst, ctx, sql, stmts):
+        import re
+
         exec_stmt = getattr(inst, "execute_statement", None)
         if exec_stmt is None:
             # remote (frontend-role) instances forward whole strings;

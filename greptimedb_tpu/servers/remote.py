@@ -112,9 +112,14 @@ class RemoteInstance:
         from greptimedb_tpu.sched import deadline as _dl
 
         db = getattr(ctx, "database", None) or "public"
-        ticket = flight.Ticket(
-            json.dumps({"sql": sql, "db": db}).encode()
-        )
+        from greptimedb_tpu.telemetry import tracing
+
+        envelope = {"sql": sql, "db": db}
+        tp = tracing.traceparent()
+        if tp is not None:
+            # the datanode continues this trace (flight.py _run_sql)
+            envelope["traceparent"] = tp
+        ticket = flight.Ticket(json.dumps(envelope).encode())
         try:
             # bounded by the active query deadline when one is set;
             # None = explicitly unbounded (legacy proxy path)

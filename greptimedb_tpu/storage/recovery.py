@@ -73,6 +73,12 @@ _regions_total = global_registry.counter(
 
 def record_stage(stage: str, ms: float) -> None:
     _stage_ms.labels(stage).inc(ms)
+    # the SAME stage numbers ride the active trace (the region.open
+    # span engine.open_region parents per region) so a recovery trace
+    # and gtpu_recovery_stage_ms_total always agree
+    from greptimedb_tpu.telemetry import tracing
+
+    tracing.event_span(f"recovery.{stage}", ms)
 
 
 def record_region() -> None:

@@ -299,8 +299,17 @@ class Region:
                     "fmt": 2, "op": op, "base_seq": base_seq,
                     "new_series": [[sid, tags] for sid, tags in delta],
                 })
+                from greptimedb_tpu.telemetry import tracing
+
                 try:
-                    self.wal.append(payload)
+                    # joins the INSERT's trace when one is active (the
+                    # background paths carry none, so this is free
+                    # there); duration = durability cost of this batch
+                    with tracing.child_span(
+                            "wal.append",
+                            region=self.meta.region_id,
+                            bytes=len(payload)):
+                        self.wal.append(payload)
                 except Exception:
                     # the registry already holds the delta; make sure a
                     # future successful entry re-reports it (ensure_series
@@ -421,6 +430,13 @@ class Region:
 
     def flush(self) -> SstMeta | None:
         """Freeze the memtable, write an SST, commit manifest, trim WAL."""
+        from greptimedb_tpu.telemetry import tracing
+
+        with tracing.child_span("region.flush",
+                                region=self.meta.region_id):
+            return self._flush_traced()
+
+    def _flush_traced(self) -> SstMeta | None:
         with self._lock:
             if self.memtable.is_empty:
                 return None

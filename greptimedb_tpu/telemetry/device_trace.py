@@ -1,0 +1,94 @@
+"""Device-time attribution for traces.
+
+The BENCH_r03-r05 story is that ~1-30ms of database time rides on a
+~90-280ms host<->device tunnel floor — but until now no single query
+could SHOW which part it paid: XLA compilation (first call for a program
+shape), device execution (dispatch + block_until_ready), or host<->
+device transfer (uploads of masks/grids, result readback). This module
+wraps the jit/shard_map CALL BOUNDARY in query/device_range.py,
+query/reduce.py and promql/fast.py — always from HOST scope, never
+inside a traced function (gtlint GT014 flags a span or metric call
+inside device scope: it is a host-sync/recompile hazard).
+
+Each wrapped call produces one `device.execute` span carrying:
+- site: which kernel family ran (range / groupby / promql / topk / ...)
+- compile: "first_call" (this process had not executed this static
+  program shape before — the duration includes XLA compilation) or
+  "cache_hit" (steady state)
+- execute_ms: time to completion of the device computation
+  (block_until_ready), excluding result readback
+- upload_bytes / readback_bytes: host->device and device->host traffic
+  attributable to this call
+"""
+
+from __future__ import annotations
+
+import time
+
+from greptimedb_tpu import concurrency
+from greptimedb_tpu.telemetry import tracing
+
+# (site, static program key) shapes this process has already executed:
+# membership decides first_call vs cache_hit attribution. Bounded the
+# same way the jit caches are in practice (program shapes are few).
+_SEEN_MAX = 4096
+_seen: set = set()
+_seen_lock = concurrency.Lock()
+
+
+def note_compile(site: str, key) -> str:
+    """Record one execution of (site, key); returns the compile
+    attribution for THIS call."""
+    k = (site, key)
+    with _seen_lock:
+        if k in _seen:
+            return "cache_hit"
+        if len(_seen) >= _SEEN_MAX:
+            _seen.clear()  # rare; worst case a few re-labelled firsts
+        _seen.add(k)
+        return "first_call"
+
+
+class device_call:
+    """`with device_trace.device_call("range", key=spec) as d:` — wraps
+    one jit/shard_map invocation. The span duration covers dispatch +
+    execute + readback; call `d.executed()` right after
+    block_until_ready so execute time splits from readback, and
+    `d.transfer(nbytes, "upload"|"readback")` for tunnel traffic."""
+
+    __slots__ = ("_cm", "_span", "_mono0", "site")
+
+    def __init__(self, site: str, *, key=None, **attrs):
+        self.site = site
+        # skip the compile-memo lookup entirely off-trace: the memo
+        # only feeds the span attribute, and the hot path must stay
+        # zero-cost when no trace is active
+        if tracing.enabled() and tracing.current_span() is not None:
+            self._cm = tracing.child_span(
+                "device.execute", site=site,
+                compile=note_compile(site, key), **attrs,
+            )
+        else:
+            self._cm = tracing.child_span("device.execute")
+        self._span = None
+        self._mono0 = 0.0
+
+    def __enter__(self) -> "device_call":
+        self._span = self._cm.__enter__()
+        self._mono0 = time.monotonic()
+        return self
+
+    def executed(self):
+        """Mark the device computation complete (call right after
+        block_until_ready); the remainder of the span is readback."""
+        self._span.attributes["execute_ms"] = round(
+            (time.monotonic() - self._mono0) * 1000.0, 3
+        )
+
+    def transfer(self, nbytes: int, direction: str = "readback"):
+        key = f"{direction}_bytes"
+        attrs = self._span.attributes
+        attrs[key] = int(attrs.get(key, 0)) + int(nbytes)
+
+    def __exit__(self, exc_type, exc, tb):
+        return self._cm.__exit__(exc_type, exc, tb)

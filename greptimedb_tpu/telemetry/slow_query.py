@@ -31,7 +31,12 @@ class SlowQueryLog:
         self.total_recorded = 0
 
     def maybe_record(self, sql: str, elapsed_s: float, *, db: str = "",
-                     channel: str = ""):
+                     channel: str = "", trace_id: str | None = None):
+        """Record one slow statement. `elapsed_s` MUST come from the
+        monotonic clock (time.monotonic()/perf_counter deltas, never
+        time.time() arithmetic — gtlint GT011); ts_ms below is an
+        epoch-ms display timestamp only. `trace_id` links the entry to
+        its trace in /v1/traces + information_schema.traces."""
         if not self.enable or elapsed_s < self.threshold_s:
             return
         if self.sample_ratio < 1.0 and random.random() > self.sample_ratio:
@@ -43,13 +48,15 @@ class SlowQueryLog:
             "query": sql[:4096],
             "schema": db,
             "channel": channel,
+            "trace_id": trace_id or "",
         }
         with self._lock:
             self._ring.append(entry)
             self.total_recorded += 1
         logger.warning(
-            "slow query (%.1f ms > %.0f ms) [%s]: %s",
-            entry["cost_ms"], entry["threshold_ms"], db, entry["query"],
+            "slow query (%.1f ms > %.0f ms) [%s] trace=%s: %s",
+            entry["cost_ms"], entry["threshold_ms"], db,
+            entry["trace_id"] or "-", entry["query"],
         )
 
     def entries(self) -> list[dict]:

@@ -641,6 +641,90 @@ class UnboundCollectiveAxis(Rule):
                    f"enclosing shard_map (binds: {', '.join(shown)})")
 
 
+# telemetry surfaces whose invocation inside a traced (device) scope
+# is a hazard: span context managers allocate + touch contextvars and
+# the ring lock; metric/stat calls take locks and read wall clocks.
+# Inside jit/shard_map these either burn host work on every trace, or
+# capture a Python-side value and silently stop updating after the
+# first compilation — and any traced-value argument forces a host sync.
+_STATS_MODULES = {"stats", "qstats"}
+_STATS_FUNCS = {"add", "note", "timed"}
+# mutating methods only: flagging .labels() too would double-report
+# the idiomatic _METRIC.labels(x).inc(1) chain
+_METRIC_METHODS = {"inc", "dec", "observe", "set"}
+
+
+def _is_metric_constant(node: ast.AST) -> bool:
+    """Module-level metric objects follow the ALL_CAPS constant idiom
+    (`_STAGE_MS.labels(...).inc(...)`, `_REQS.inc()`)."""
+    while isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        node = node.value
+        while isinstance(node, (ast.Attribute, ast.Call)):
+            node = (node.value if isinstance(node, ast.Attribute)
+                    else node.func)
+    if not isinstance(node, ast.Name):
+        return False
+    name = node.id.lstrip("_")
+    return bool(name) and name.isupper()
+
+
+@register
+class TelemetryInDeviceScope(Rule):
+    id = "GT014"
+    name = "telemetry-in-device-scope"
+    description = (
+        "A tracing span or metrics/stats call inside a jit/shard_map/"
+        "Pallas device scope is a host-sync and recompile hazard: the "
+        "call runs at TRACE time (so it fires once per compilation, "
+        "not once per execution — metrics silently freeze), touches "
+        "locks/contextvars on the host, and any traced-value argument "
+        "forces a device->host transfer. Wrap the CALL boundary from "
+        "host scope instead (telemetry/device_trace.py)."
+    )
+
+    def _report(self, node, ctx: FileContext, what: str):
+        ctx.report(self, node,
+                   f"{what} inside a jitted/device function runs at "
+                   "trace time, not execution time; move the "
+                   "span/metric to the host-side call boundary "
+                   "(telemetry/device_trace.py)")
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        if ctx.device_func is None:
+            return
+        f = dotted_name(node.func)
+        if f:
+            parts = f.split(".")
+            if any(seg == "tracing" for seg in parts[:-1]) or (
+                    len(parts) >= 2
+                    and parts[-2] in ("tracing", "device_trace")):
+                self._report(node, ctx, f"tracing call {f}(...)")
+                return
+            if f in ("span", "start_remote", "child_span",
+                     "event_span", "device_call"):
+                # bare-name telemetry entry points (from-imports)
+                self._report(node, ctx, f"tracing call {f}(...)")
+                return
+            if (len(parts) == 2 and parts[0] in _STATS_MODULES
+                    and parts[1] in _STATS_FUNCS):
+                self._report(node, ctx, f"stats call {f}(...)")
+                return
+            if "global_registry" in parts:
+                self._report(node, ctx, f"metrics call {f}(...)")
+                return
+        # metric-object method calls: _COUNTER.labels(x).inc(1) — the
+        # receiver is a module-level ALL_CAPS metric constant
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_METHODS
+                and _is_metric_constant(node.func)):
+            self._report(
+                node, ctx,
+                f".{node.func.attr}() on a module-level metric"
+            )
+
+
 @register
 class MutableDefaultArg(Rule):
     id = "GT010"

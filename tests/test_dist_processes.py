@@ -527,3 +527,217 @@ def test_flownode_crash_mirror_replay(tmp_path):
                 p.kill()
         for log in logs:
             log.close()
+
+
+def _spawn_env(args, log, extra_env):
+    env = _child_env()
+    env.update(extra_env)
+    return subprocess.Popen(
+        [sys.executable, "-m", "greptimedb_tpu.cli", *args],
+        env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+    )
+
+
+def _sql_traced(addr: str, sql: str, traceparent: str, *,
+                params: str = "", timeout=120.0):
+    body = urllib.parse.urlencode({"sql": sql}).encode()
+    req = urllib.request.Request(
+        f"http://{addr}/v1/sql{params}", data=body,
+        headers={"Content-Type": "application/x-www-form-urlencoded",
+                 "traceparent": traceparent},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _trace(addr: str, trace_id: str) -> list:
+    with urllib.request.urlopen(
+        f"http://{addr}/v1/traces?trace_id={trace_id}", timeout=10
+    ) as resp:
+        return json.loads(resp.read())["spans"]
+
+
+def test_distributed_trace_stitching(tmp_path):
+    """ONE stitched trace for a distributed query served through the
+    real multi-process frontend: sched queue, plan, fan-out RPC,
+    per-datanode scan (with cache hit/miss), merge stages and device
+    compile/execute/transfer spans all share the trace_id the client
+    sent, with parent links that resolve inside the trace. Also: a
+    shed (429) and a deadline-expired (503) query still produce KEPT
+    traces even at sample_ratio=0 (tail-based sampling), while an
+    unremarkable query's trace is dropped."""
+    procs, logs = [], []
+
+    def spawn(args, name, extra_env=None):
+        log = open(tmp_path / f"{name}.log", "w")
+        logs.append(log)
+        p = _spawn_env(args, log, extra_env or {})
+        procs.append(p)
+        return p
+
+    try:
+        meta_port = _free_port()
+        spawn(["metasrv", "start", "--data-home",
+               str(tmp_path / "meta"),
+               "--metasrv-addr", f"127.0.0.1:{meta_port}",
+               "--http-addr", ""], "metasrv")
+        _wait_http(f"127.0.0.1:{meta_port}")
+
+        dn_port = _free_port()
+        # prefer_device forces the grid/device fast path on the
+        # datanode even for a small table, so the stitched trace
+        # carries real device compile/execute/transfer spans
+        spawn(["datanode", "start",
+               "--data-home", str(tmp_path / "dn0"),
+               "--flight-addr", f"127.0.0.1:{dn_port}",
+               "--metasrv-addr", f"127.0.0.1:{meta_port}",
+               "--node-id", "0", "--http-addr", "", "--mysql-addr",
+               "", "--postgres-addr", "", "--no-flows"], "dn0",
+              {"GREPTIMEDB_TPU__QUERY__PREFER_DEVICE": "true"})
+        _wait_port(dn_port)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{meta_port}/peers", timeout=2
+            ) as resp:
+                if len(json.loads(resp.read())) >= 1:
+                    break
+            time.sleep(0.2)
+
+        fe_port = _free_port()
+        spawn(["frontend", "start", "--data-home",
+               str(tmp_path / "fe"),
+               "--http-addr", f"127.0.0.1:{fe_port}",
+               "--metasrv-addr", f"127.0.0.1:{meta_port}",
+               "--mysql-addr", "", "--postgres-addr", "",
+               "--flight-addr", ""], "frontend")
+        fe = f"127.0.0.1:{fe_port}"
+        _wait_http(fe, path="/health")
+
+        _sql(fe, "create table cpu (ts timestamp time index, host "
+                 "string primary key, usage double) with "
+                 "(num_regions = 2)")
+        values = ", ".join(
+            f"('h{i % 4}', {1_700_000_000_000 + p * 5_000}, {i + p})"
+            for p in range(12) for i in range(4)
+        )
+        _sql(fe, f"insert into cpu (host, ts, usage) values {values}")
+
+        range_sql = ("select ts, host, avg(usage) range '10s' from "
+                     "cpu align '10s' by (host) order by ts, host")
+        # warm once (device grid build + XLA compile on the datanode),
+        # then the traced run: its scan should hit the datanode's
+        # merged-scan cache and its device program memo
+        tid_warm = "aa" * 16
+        _sql_traced(fe, range_sql, f"00-{tid_warm}-{'11' * 8}-01")
+        tid = "bb" * 16
+        doc = _sql_traced(fe, range_sql, f"00-{tid}-{'22' * 8}-01")
+        assert _rows(doc), "traced query returned no rows"
+
+        spans = _trace(fe, tid)
+        by_name: dict = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        # every hop of the hot path is present, under ONE trace_id
+        for name in ("http /v1/sql", "sql.execute", "sql.Select",
+                     "sched.admit", "query.plan", "dist.rpc",
+                     "datanode.partial", "datanode.scan",
+                     "device.execute"):
+            assert name in by_name, (
+                f"span {name!r} missing from stitched trace: "
+                f"{sorted(by_name)}"
+            )
+        assert all(s["trace_id"] == tid for s in spans)
+        # parent links resolve inside the trace (the cross-process
+        # spans parent under the frontend's spans, not dangle) — the
+        # sole exception is the HTTP root, whose parent is the span id
+        # the CLIENT sent in its traceparent header
+        ids = {s["span_id"] for s in spans}
+        client_span = "22" * 8
+        dangling = [
+            s["name"] for s in spans
+            if s["parent_id"] is not None and s["parent_id"] not in ids
+            and s["parent_id"] != client_span
+        ]
+        assert not dangling, f"dangling parent links: {dangling}"
+        root = by_name["http /v1/sql"][0]
+        assert root["parent_id"] == client_span
+        # datanode spans hang off the frontend statement span
+        dn_parent = by_name["datanode.partial"][0]["parent_id"]
+        assert dn_parent in {
+            s["span_id"] for s in by_name["sql.Select"]
+        }
+        # scan-cache attribution on the datanode scan (warm run => hit)
+        caches = {
+            s["attributes"].get("scan_cache")
+            for s in by_name["datanode.scan"]
+        }
+        assert caches & {"hit", "miss"}, caches
+        # device attribution: compile state + execute/readback numbers
+        dev = by_name["device.execute"][0]["attributes"]
+        assert dev.get("compile") in ("first_call", "cache_hit")
+        assert "execute_ms" in dev and "readback_bytes" in dev
+        # the warm (cold-compile) run is stitched too
+        warm_names = {s["name"] for s in _trace(fe, tid_warm)}
+        assert "device.execute" in warm_names
+
+        # ---- tail-kept shed + deadline traces at sample_ratio=0 -----
+        fe2_port = _free_port()
+        spawn(["frontend", "start", "--data-home",
+               str(tmp_path / "fe2"),
+               "--http-addr", f"127.0.0.1:{fe2_port}",
+               "--metasrv-addr", f"127.0.0.1:{meta_port}",
+               "--mysql-addr", "", "--postgres-addr", "",
+               "--flight-addr", ""], "frontend2",
+              {"GREPTIMEDB_TPU__TRACING__SAMPLE_RATIO": "0",
+               "GREPTIMEDB_TPU__SCHEDULER__TENANT_QPS": "0.01",
+               "GREPTIMEDB_TPU__SCHEDULER__TENANT_BURST": "1"})
+        fe2 = f"127.0.0.1:{fe2_port}"
+        _wait_http(fe2, path="/health")
+
+        # burns the single burst token; unremarkable => DROPPED at
+        # sample_ratio=0 (tail sampling really drops)
+        tid_ok = "cc" * 16
+        _sql_traced(fe2, "select 1", f"00-{tid_ok}-{'33' * 8}-01")
+        assert _trace(fe2, tid_ok) == []
+
+        # over-quota => 429, trace KEPT (error survives tail sampling)
+        tid_shed = "dd" * 16
+        try:
+            _sql_traced(fe2, "select 1", f"00-{tid_shed}-{'44' * 8}-01")
+            raise AssertionError("expected 429 shed")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+        shed_spans = _trace(fe2, tid_shed)
+        assert any(
+            "error" in s["attributes"] for s in shed_spans
+        ), shed_spans
+        assert {s["name"] for s in shed_spans} >= {"sched.admit"}
+
+        # deadline expired before execution => 503, trace KEPT
+        time.sleep(1.5)  # a fresh qps token for the deadline query
+        tid_dl = "ee" * 16
+        try:
+            _sql_traced(fe2, "select count(*) from cpu",
+                        f"00-{tid_dl}-{'55' * 8}-01",
+                        params="?timeout=0.000001")
+            raise AssertionError("expected 503 deadline")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        dl_spans = _trace(fe2, tid_dl)
+        assert any(
+            "deadline" in s["attributes"].get("error", "").lower()
+            or "Deadline" in s["attributes"].get("error", "")
+            for s in dl_spans
+        ), dl_spans
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()

@@ -35,7 +35,7 @@ def rules_hit(src: str, select: str | None = None):
 
 def test_registry_has_all_rules():
     ids = sorted(all_rules())
-    assert ids == [f"GT{n:03d}" for n in range(1, 14)]
+    assert ids == [f"GT{n:03d}" for n in range(1, 15)]
     for rule in all_rules().values():
         assert rule.name and rule.description
 
@@ -554,6 +554,90 @@ def test_gt013_negative_bound_axis_and_mixed_spaces():
         def helper(x, axis_name="shard"):
             return jax.lax.psum(x, axis_name)
     """, select="GT013") == []
+
+
+# ---------------------------------------------------------------------------
+# GT014 tracing/metrics calls inside jit/shard_map device scope
+# ---------------------------------------------------------------------------
+
+def test_gt014_positive_tracing_span_in_jit():
+    hits = rules_hit("""
+        import jax
+        from greptimedb_tpu.telemetry import tracing
+
+        @jax.jit
+        def kernel(x):
+            with tracing.span("device.step"):
+                return x + 1
+    """, select="GT014")
+    assert hits == [("GT014", 7)]
+
+
+def test_gt014_positive_stats_and_metric_in_shard_map_body():
+    hits = rules_hit("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from greptimedb_tpu.query import stats
+        from greptimedb_tpu.telemetry.metrics import global_registry
+
+        _CALLS = global_registry.counter("calls", "c", ("k",))
+
+        def run(mesh, x):
+            def local(x):
+                stats.add("device_steps", 1)
+                _CALLS.labels("a").inc()
+                return jax.lax.psum(x, "shard")
+
+            return shard_map(local, mesh=mesh, in_specs=(P("shard"),),
+                             out_specs=P())(x)
+    """, select="GT014")
+    assert hits == [("GT014", 12), ("GT014", 13)]
+
+
+def test_gt014_positive_nested_def_inherits_device_scope():
+    # a helper nested inside a jitted function traces on device too
+    hits = rules_hit("""
+        import jax
+        from greptimedb_tpu.telemetry import tracing
+
+        @jax.jit
+        def kernel(x):
+            def inner(y):
+                tracing.event_span("step", 1.0)
+                return y
+
+            return inner(x)
+    """, select="GT014")
+    assert hits == [("GT014", 8)]
+
+
+def test_gt014_negative_host_scope_and_lowercase_receiver():
+    # the same calls OUTSIDE device scope are the intended idiom
+    assert rules_hit("""
+        import jax
+        from greptimedb_tpu.telemetry import tracing
+        from greptimedb_tpu.query import stats
+
+        @jax.jit
+        def kernel(x):
+            return x + 1
+
+        def host(x):
+            with tracing.span("device.execute"):
+                out = kernel(x)
+            stats.add("device_readback_bytes", 8)
+            return out
+    """, select="GT014") == []
+    # lowercase method receivers inside jit are not metric constants
+    assert rules_hit("""
+        import jax
+
+        @jax.jit
+        def kernel(x, acc):
+            y = acc.set(1)
+            return x.inc() + y.observe()
+    """, select="GT014") == []
 
 
 def test_suppression_same_line():

@@ -99,3 +99,289 @@ def test_http_traces_endpoint(tmp_path):
     finally:
         srv.stop()
         inst.close()
+
+
+def test_configure_and_ring_bounds():
+    cfg = tracing.configure({"sample_ratio": 0.5, "capacity": 7,
+                             "slow_ms": 123.0})
+    try:
+        assert cfg.sample_ratio == 0.5
+        assert tracing.global_traces.cap == 7
+        assert not tracing.ring_unbounded()
+        for i in range(20):
+            with tracing.span(f"t{i}"):
+                pass
+        assert len(tracing.global_traces.traces(limit=100)) <= 7
+        tracing.configure({"capacity": 0})
+        assert tracing.ring_unbounded()
+    finally:
+        tracing.configure({})
+
+
+def test_tail_sampling_drops_unremarkable_keeps_error_and_slow():
+    tracing.configure({"sample_ratio": 0.0, "slow_ms": 50.0})
+    try:
+        # unremarkable root: dropped at decision time
+        with tracing.span("boring") as sp:
+            pass
+        assert tracing.global_traces.trace(sp.trace_id) == []
+        # errored trace: kept (error can be on a CHILD span)
+        try:
+            with tracing.span("root") as rsp:
+                with tracing.span("child"):
+                    raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        assert tracing.global_traces.trace(rsp.trace_id)
+        # slow root: kept
+        import time as _time
+
+        with tracing.span("slowroot") as ssp:
+            _time.sleep(0.06)
+        assert tracing.global_traces.trace(ssp.trace_id)
+        # mark_keep: kept
+        with tracing.span("marked") as msp:
+            tracing.mark_keep()
+        assert tracing.global_traces.trace(msp.trace_id)
+    finally:
+        tracing.configure({})
+
+
+def test_disabled_tracing_is_inert():
+    tracing.configure({"enable": False})
+    try:
+        with tracing.span("x") as sp:
+            assert sp.trace_id == ""
+            assert tracing.current_trace_id() is None
+            assert tracing.traceparent() is None
+            with tracing.child_span("y") as c:
+                c.attributes["k"] = 1  # writes land nowhere
+        assert tracing.global_traces.traces() == []
+    finally:
+        tracing.configure({})
+
+
+def test_child_span_without_trace_is_noop():
+    with tracing.child_span("orphan") as sp:
+        assert sp.trace_id == ""
+    assert tracing.global_traces.traces() == []
+
+
+def test_event_span_and_duration_monotonic():
+    with tracing.span("root") as root:
+        tracing.event_span("dist.merge", 12.5, stage="merge")
+    spans = tracing.global_traces.trace(root.trace_id)
+    ev = next(s for s in spans if s["name"] == "dist.merge")
+    assert ev["duration_ms"] == 12.5
+    assert ev["parent_id"] == root.span_id
+    rt = next(s for s in spans if s["name"] == "root")
+    # durations come off the monotonic clock: never negative
+    assert rt["duration_ms"] is not None and rt["duration_ms"] >= 0
+
+
+def test_export_and_ingest_spans_round_trip():
+    with tracing.export_spans() as exported:
+        with tracing.span("datanode.partial") as sp:
+            with tracing.span("datanode.scan"):
+                pass
+    assert {s.name for s in exported} == {
+        "datanode.partial", "datanode.scan"
+    }
+    docs = [s.to_json() for s in exported]
+    tracing.global_traces.clear()
+    tracing.ingest_spans(docs)
+    spans = tracing.global_traces.trace(sp.trace_id)
+    assert {s["name"] for s in spans} == {
+        "datanode.partial", "datanode.scan"
+    }
+
+
+def test_render_tree_shape():
+    with tracing.span("a") as a:
+        with tracing.span("b"):
+            pass
+        with tracing.span("c", x=1):
+            pass
+    lines = tracing.render_tree(tracing.global_traces.trace(a.trace_id))
+    assert lines[0].startswith("a ")
+    assert all(ln.startswith("  ") for ln in lines[1:])
+    assert any("{x=1}" in ln for ln in lines)
+
+
+def test_traceparent_helper_and_remote_parenting():
+    assert tracing.traceparent() is None
+    with tracing.span("root") as sp:
+        tp = tracing.traceparent()
+        assert tp == f"00-{sp.trace_id}-{sp.span_id}-01"
+    with tracing.start_remote(tp, "over-there") as rsp:
+        assert rsp.trace_id == sp.trace_id
+        assert rsp.parent_id == sp.span_id
+
+
+def test_information_schema_traces_and_slow_query_trace_id(tmp_path):
+    inst = Standalone(str(tmp_path / "data"), warm_start=False)
+    try:
+        inst.slow_query_log.threshold_s = 0.0  # record everything
+        inst.sql("CREATE TABLE t (v DOUBLE, ts TIMESTAMP TIME INDEX)")
+        inst.sql("INSERT INTO t (v, ts) VALUES (1.0, 1)")
+        inst.sql("SELECT count(*) FROM t")
+        res = inst.sql("SELECT span_name, trace_id FROM "
+                       "information_schema.traces")
+        names = set(res.cols[0].values.tolist())
+        assert "sql.Select" in names and "sql.execute" in names
+        # slow-query entries carry the trace id of their statement
+        entries = inst.slow_query_log.entries()
+        assert entries and all(e["trace_id"] for e in entries)
+        tids = {s for s in res.cols[1].values.tolist()}
+        assert entries[-1]["trace_id"] in tids
+        sq = inst.sql("SELECT trace_id FROM "
+                      "information_schema.slow_queries")
+        assert sq.num_rows == len(entries)
+    finally:
+        inst.close()
+
+
+def test_explain_analyze_renders_span_tree(tmp_path):
+    inst = Standalone(str(tmp_path / "data"), warm_start=False)
+    try:
+        inst.sql("CREATE TABLE t (v DOUBLE, ts TIMESTAMP TIME INDEX)")
+        inst.sql("INSERT INTO t (v, ts) VALUES (1.0, 1), (2.0, 2)")
+        res = inst.sql("EXPLAIN ANALYZE SELECT count(*) FROM t")
+        text = "\n".join(res.cols[0].values.tolist())
+        assert "Trace:" in text
+        assert "query.scan" in text
+    finally:
+        inst.close()
+
+
+def test_device_spans_on_range_query(tmp_path):
+    """prefer_device forces the grid path: the trace carries a
+    device.execute span with compile/execute/readback attribution."""
+    pytest.importorskip("jax")
+    inst = Standalone(str(tmp_path / "data"), warm_start=False,
+                      prefer_device=True)
+    try:
+        inst.sql("CREATE TABLE m (host STRING PRIMARY KEY, v DOUBLE, "
+                 "ts TIMESTAMP TIME INDEX)")
+        vals = ", ".join(
+            f"('h{i % 3}', {i}.0, {1_700_000_000_000 + i * 1000})"
+            for i in range(30)
+        )
+        inst.sql(f"INSERT INTO m (host, v, ts) VALUES {vals}")
+        q = ("SELECT ts, host, avg(v) RANGE '10s' FROM m "
+             "ALIGN '10s' BY (host)")
+        with tracing.span("req") as root:
+            inst.sql(q)
+        spans = tracing.global_traces.trace(root.trace_id)
+        dev = [s for s in spans if s["name"] == "device.execute"]
+        assert dev, {s["name"] for s in spans}
+        attrs = dev[0]["attributes"]
+        assert attrs["site"] == "range"
+        assert attrs["compile"] == "first_call"
+        assert attrs["readback_bytes"] > 0
+        assert "execute_ms" in attrs
+        # steady state: same program shape is a cache hit
+        with tracing.span("req2") as root2:
+            inst.sql(q)
+        dev2 = [
+            s for s in tracing.global_traces.trace(root2.trace_id)
+            if s["name"] == "device.execute"
+        ]
+        assert dev2 and dev2[0]["attributes"]["compile"] == "cache_hit"
+    finally:
+        inst.close()
+
+
+def test_http_traces_query_param_filter(tmp_path):
+    from greptimedb_tpu.servers.http import HttpServer
+
+    inst = Standalone(str(tmp_path / "data"), warm_start=False)
+    srv = HttpServer(inst, port=0).start()
+    try:
+        import urllib.parse
+
+        tid = "ab" * 16
+        data = urllib.parse.urlencode({"sql": "SELECT 1"}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/sql", data=data,
+            headers={"traceparent": f"00-{tid}-{'cd' * 8}-01"},
+        )
+        urllib.request.urlopen(req, timeout=10)
+        out = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/v1/traces?trace_id={tid}",
+            timeout=10,
+        ).read())
+        assert out["trace_id"] == tid
+        assert {s["name"] for s in out["spans"]} >= {"sql.Select"}
+        # bounded listing with ?limit=
+        out = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/v1/traces?limit=1",
+            timeout=10,
+        ).read())
+        assert len(out["traces"]) <= 1
+    finally:
+        srv.stop()
+        inst.close()
+
+
+def test_child_exit_never_rolls_sampling_dice():
+    """Only the process-local ROOT decides keep/drop: with
+    sample_ratio=0, children (including ones under a remote parent)
+    finishing early must not drop the in-flight trace before the root
+    sees the error that makes it kept."""
+    tracing.configure({"sample_ratio": 0.0})
+    try:
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        try:
+            with tracing.start_remote(tp, "datanode.partial"):
+                with tracing.span("device.execute"):
+                    pass  # unremarkable child exits first
+                raise RuntimeError("late failure")
+        except RuntimeError:
+            pass
+        spans = tracing.global_traces.trace("ab" * 16)
+        assert {s["name"] for s in spans} == {
+            "datanode.partial", "device.execute"
+        }
+    finally:
+        tracing.configure({})
+
+
+def test_malformed_traceparent_never_taints_trace_id():
+    """Trace ids are client-controlled and spliced into hand-built
+    ticket JSON: anything but strict lowercase hex starts a fresh
+    root instead of inheriting the tainted id."""
+    bad = [
+        "00-" + 'x"' * 16 + "-" + "cd" * 8 + "-01",   # quote in id
+        "00-" + "AB" * 16 + "-" + "cd" * 8 + "-01",   # uppercase hex
+        "00-" + "00" * 16 + "-" + "cd" * 8 + "-01",   # all-zero id
+        "00-" + "ab" * 16 + "-" + "cd" * 8,           # missing flags
+    ]
+    for tp in bad:
+        with tracing.start_remote(tp, "h") as sp:
+            assert sp.parent_id is None, tp
+            assert sp.trace_id not in tp
+
+
+def test_sibling_root_drop_cannot_destroy_errored_trace():
+    """Two concurrent local roots on one traceparent: the first root
+    finishing unremarkably (sampled out) must not drop the trace while
+    the second is still in flight and about to record an error."""
+    tracing.configure({"sample_ratio": 0.0})
+    try:
+        tp = "00-" + "ef" * 16 + "-" + "ab" * 8 + "-01"
+        b = tracing.start_remote(tp, "request-b")
+        b.__enter__()
+        # sibling A finishes first, unremarkable => would have dropped
+        with tracing.start_remote(tp, "request-a"):
+            pass
+        assert tracing.global_traces.trace("ef" * 16), \
+            "sibling drop destroyed the in-flight trace"
+        try:
+            raise RuntimeError("late error on B")
+        except RuntimeError as e:
+            b.__exit__(type(e), e, e.__traceback__)
+        spans = tracing.global_traces.trace("ef" * 16)
+        assert {s["name"] for s in spans} >= {"request-a", "request-b"}
+    finally:
+        tracing.configure({})
