@@ -439,6 +439,18 @@ class CatalogManager:
             _promql_fast.drop_table_entries(table)
         except ImportError:  # pragma: no cover - promql optional
             pass
+        self._purge_result_caches(table)
+
+    def _purge_result_caches(self, table):
+        """Drop cached result payloads for a dropped table: a recreated
+        table can reuse the table id and coincidentally match versions,
+        so LRU aging alone is not enough. (Session-registry buffers are
+        keyed per grid entry and released by the grid caches when they
+        drop an entry — DeviceRangeCache._release /
+        SelectorGridCache._release.)"""
+        rc = getattr(self, "result_cache", None)
+        if rc is not None:
+            rc.purge_table(table.info.database, table.info.table_id)
 
     def table(self, database: str, name: str) -> Table:
         with self._lock:

@@ -267,6 +267,17 @@ class Table:
             tuple(self.tag_names),
         )
 
+    def physical_version(self) -> tuple:
+        """data_version extended with each region's manifest version:
+        additionally bumps on flush/compact/schema commits. The frontend
+        result cache (query/result_cache.py) keys on THIS — the same
+        conservative discipline as the datanode merged-scan cache."""
+        return (
+            tuple(r.physical_version for r in self.regions),
+            tuple(self.schema.column_names),
+            tuple(self.tag_names),
+        )
+
     def row_count(self) -> int:
         """Approximate row count (memtable + SST rows, before dedup)."""
         total = 0

@@ -333,6 +333,19 @@ def _advertise_addr(opts, srv) -> str | None:
     return f"{host}:{srv.server.port}"
 
 
+def _result_path_options(inst, opts):
+    """[sessions] + [result_cache] knobs: the device-resident result
+    path (persistent query sessions, frontend result-set cache)."""
+    from greptimedb_tpu.query import sessions as _sessions
+    from greptimedb_tpu.query.result_cache import ResultCache
+
+    _sessions.configure(opts.section("sessions"))
+    inst.result_cache = ResultCache.from_options(
+        opts.section("result_cache")
+    )
+    inst.catalog.result_cache = inst.result_cache
+
+
 def _make_instance(opts):
     from greptimedb_tpu.instance import Standalone
     from greptimedb_tpu.storage.engine import EngineConfig
@@ -387,6 +400,7 @@ def _make_instance(opts):
     inst.scheduler = AdmissionController(
         SchedulerConfig.from_options(opts.section("scheduler"))
     )
+    _result_path_options(inst, opts)
     from greptimedb_tpu.telemetry.slow_query import SlowQueryLog
 
     inst.slow_query_log = SlowQueryLog(
@@ -538,6 +552,7 @@ def _start_frontend(opts):
             dist_query_options=opts.section("dist_query"),
             scheduler_options=opts.section("scheduler"),
         )
+        _result_path_options(inst, opts)
         target = f"metasrv {meta_addr}"
     else:
         # legacy single-datanode proxy: forward statements over Flight

@@ -147,6 +147,29 @@ DEFAULTS: dict = {
     # true forces the grid/device fast paths — what the dist-process
     # tracing test uses to exercise device attribution on CPU jax
     "query": {"prefer_device": None},
+    # persistent query sessions (query/sessions.py): folded device
+    # RESULT buffers stay HBM-resident across polls, so a repeated
+    # dashboard query skips the program dispatch round trip and delta
+    # polls slice device-side. LRU byte budget over HBM.
+    "sessions": {
+        "enable": True,
+        "hbm_bytes": 1073741824,
+    },
+    # frontend result-set cache (query/result_cache.py): completed
+    # result payloads keyed on (statement fingerprint, physical
+    # versions), served without touching datanode or device while
+    # versions match. Off by default: turning it on makes REPEATED
+    # identical statements answer from the frontend (dashboards want
+    # this; debugging repeated-execution behavior does not).
+    # validate_interval_ms > 0 bounds how often a dist frontend
+    # re-validates versions against the datanodes (staleness bound);
+    # 0 validates every poll (free locally, one cheap metadata action
+    # per datanode for dist tables).
+    "result_cache": {
+        "enable": False,
+        "bytes": 268435456,
+        "validate_interval_ms": 0.0,
+    },
     "logging": {
         "level": "info",
         # statements slower than threshold land in the slow-query log +

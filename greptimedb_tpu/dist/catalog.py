@@ -218,6 +218,10 @@ class DistCatalogManager(CatalogManager):
                 self._local_gen += 1
             for tname, table in dropped.items():
                 self._teardown_table(name, tname, table)
+                # same purge contract as drop_table: cached payloads
+                # must not outlive the table (a recreated table id
+                # could coincidentally match versions)
+                self._purge_result_caches(table)
             for vname in vnames:
                 self.meta.kv_delete(f"{VIEW_PREFIX}{name}/{vname}")
             self.meta.kv_delete(DB_PREFIX + name)
@@ -400,6 +404,7 @@ class DistCatalogManager(CatalogManager):
                 return
             raise TableNotFoundError(f"table not found: {name}")
         self._teardown_table(database, name, table)
+        self._purge_result_caches(table)
 
     def _teardown_table(self, database: str, name: str, table):
         """Region teardown + kv deletes, run OUTSIDE self._lock:

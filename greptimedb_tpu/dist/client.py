@@ -202,6 +202,12 @@ class DatanodeClient:
             timeout=_op_timeout(15.0),
         ).get("versions", {})
 
+    def physical_versions(self, region_ids: list[int]) -> dict:
+        return self.action(
+            "physical_versions", {"region_ids": region_ids},
+            timeout=_op_timeout(15.0),
+        ).get("versions", {})
+
     # ---- data plane ---------------------------------------------------
     def region_scan(self, region_ids: list[int], *, ts_min=None,
                     ts_max=None, fields=None, matchers=None,
@@ -350,6 +356,119 @@ class _NotLeaderError(GreptimeError):
         self.leader = leader
 
 
+class _MetaHttpError(Exception):
+    """A reached metasrv answered with an HTTP error status."""
+
+    def __init__(self, status: int, detail: str | None):
+        super().__init__(f"HTTP {status}: {detail}")
+        self.status = status
+        self.detail = detail
+
+
+def _http_client_exceptions():
+    import http.client
+
+    # BadStatusLine and friends are HTTPException, not OSError; a
+    # half-closed kept-alive connection surfaces as one
+    return http.client.HTTPException
+
+
+class _KeepAliveHTTP:
+    """Pooled persistent HTTP/1.1 connections per address.
+
+    The dist control plane talks to the metasrv constantly (heartbeats,
+    route refresh, kv) and dashboard pollers hit the frontend once per
+    panel per tick; paying TCP setup per request inflates the measured
+    request floor (ISSUE 9). Each request TAKES an idle connection from
+    the per-address free list (or dials a fresh one) and returns it
+    after the round — concurrent callers never serialize behind one
+    connection, and no lock is ever held across the wire. A reused
+    connection the peer idle-closed retries once on a fresh dial; a
+    fresh dial's failure surfaces straight to the caller's retry/rotate
+    policy, matching the old per-request urlopen semantics."""
+
+    _POOL_MAX = 4  # idle connections retained per address
+
+    def __init__(self, timeout: float):
+        self.timeout = timeout
+        self._lock = concurrency.Lock()
+        self._idle: dict[str, list] = {}
+        self._closed = False
+
+    def _take(self, addr: str):
+        with self._lock:
+            pool = self._idle.get(addr)
+            if pool:
+                return pool.pop()
+        return None
+
+    def _give(self, addr: str, conn) -> None:
+        with self._lock:
+            if not self._closed:
+                pool = self._idle.setdefault(addr, [])
+                if len(pool) < self._POOL_MAX:
+                    pool.append(conn)
+                    return
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            conns = [c for pool in self._idle.values() for c in pool]
+            self._idle.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def request(self, addr: str, method: str, path: str,
+                body: bytes | None = None,
+                headers: dict | None = None) -> tuple[int, bytes]:
+        import http.client
+
+        host, _, port = addr.partition(":")
+        for attempt in (0, 1):
+            conn = self._take(addr)
+            fresh = conn is None
+            if fresh:
+                conn = http.client.HTTPConnection(
+                    host, int(port or 80), timeout=self.timeout
+                )
+            try:
+                conn.request(method, path, body=body,
+                             headers=headers or {})
+                resp = conn.getresponse()
+                data = resp.read()  # drain: keeps the conn reusable
+            except TimeoutError:
+                # a SLOW peer, not a stale connection: re-sending the
+                # request would double the wait (and the server-side
+                # work) — surface it to the caller's retry/rotate
+                # policy immediately
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                raise
+            except (http.client.HTTPException, OSError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                # only a REUSED connection retries (the peer may have
+                # idle-closed it — that failure is instant); a fresh
+                # dial's failure is real
+                if fresh or attempt:
+                    raise
+                continue
+            self._give(addr, conn)
+            return resp.status, data
+        raise AssertionError("unreachable")
+
+
 class MetaClient:
     """Metasrv control plane over HTTP (kv, routes, allocation).
 
@@ -366,6 +485,10 @@ class MetaClient:
             raise GreptimeError("metasrv address list is empty")
         self._cur = 0
         self.timeout = timeout
+        # kept-alive connections: the control plane polls constantly
+        # (heartbeats every 2s, route refresh, kv) — per-request TCP
+        # setup was inflating the measured request floor
+        self._http = _KeepAliveHTTP(timeout)
 
     @property
     def addr(self) -> str:
@@ -393,18 +516,14 @@ class MetaClient:
                 last = e
                 self._rotate(e.leader)
                 pause = 0.25
-            except urllib.error.HTTPError as e:
+            except _MetaHttpError as e:
                 # reached a server: app-level failure, don't rotate;
                 # surface the server's error body, not just the code
-                try:
-                    detail = json.loads(e.read() or b"{}").get("error")
-                except Exception:  # noqa: BLE001 - body not JSON
-                    detail = None
                 raise GreptimeError(
-                    f"metasrv: {detail or f'HTTP {e.code}'}"
+                    f"metasrv: {e.detail or f'HTTP {e.status}'}"
                 ) from None
-            except (urllib.error.URLError, OSError,
-                    ConnectionError) as e:
+            except (urllib.error.URLError, OSError, ConnectionError,
+                    _http_client_exceptions()) as e:
                 last = e
                 self._rotate()
                 pause = 0.05
@@ -428,36 +547,44 @@ class MetaClient:
             headers["traceparent"] = tp
         return headers
 
+    def _request(self, addr: str, method: str, path: str,
+                 body: bytes | None, headers: dict) -> dict:
+        status, data = self._http.request(
+            addr, method, path, body=body, headers=headers
+        )
+        if status >= 400:
+            try:
+                detail = json.loads(data or b"{}").get("error")
+            except ValueError:
+                detail = None
+            raise _MetaHttpError(status, detail)
+        out = json.loads(data or b"{}")
+        if isinstance(out, dict) and out.get("error"):
+            if out["error"] == "not leader":
+                raise _NotLeaderError(out.get("leader"))
+            raise GreptimeError(f"metasrv: {out['error']}")
+        return out
+
     def _post(self, path: str, doc: dict) -> dict:
+        body = json.dumps(doc).encode()
+
         def go(addr):
-            req = urllib.request.Request(
-                f"http://{addr}{path}", data=json.dumps(doc).encode(),
-                headers=self._trace_headers(
-                    {"Content-Type": "application/json"}
-                ),
+            return self._request(
+                addr, "POST", path, body,
+                self._trace_headers({"Content-Type": "application/json"}),
             )
-            with urllib.request.urlopen(req,
-                                        timeout=self.timeout) as resp:
-                out = json.loads(resp.read() or b"{}")
-            if isinstance(out, dict) and out.get("error"):
-                if out["error"] == "not leader":
-                    raise _NotLeaderError(out.get("leader"))
-                raise GreptimeError(f"metasrv: {out['error']}")
-            return out
 
         return self._do(go)
 
     def _get(self, path: str) -> dict:
         def go(addr):
-            req = urllib.request.Request(
-                f"http://{addr}{path}", headers=self._trace_headers()
-            )
-            with urllib.request.urlopen(
-                req, timeout=self.timeout
-            ) as resp:
-                return json.loads(resp.read() or b"{}")
+            return self._request(addr, "GET", path, None,
+                                 self._trace_headers())
 
         return self._do(go)
+
+    def close(self):
+        self._http.close()
 
     # ---- kv -----------------------------------------------------------
     def kv_get(self, key: str) -> str | None:

@@ -278,6 +278,15 @@ def _fan_out_stream(instance, table, partial: SelectPlan, clock,
     timeout = _dl.call_timeout()
     dl_field = (b'' if timeout is None
                 else b'"deadline_s":%.3f,' % timeout)
+    # delta-poll cursor rides the ticket (stripped from the datanode's
+    # decode-memo key like deadline_s/traceparent, so hot queries keep
+    # cache-hitting): datanodes emit only rows past the watermark and
+    # the merged partials stay ≪ the full result on the wire
+    from greptimedb_tpu.query import sessions as _sessions
+
+    since = _sessions.current_since()
+    since_field = (b'' if since is None
+                   else b'"since_ms":%d,' % since)
     # trace context crosses the Flight hop as a ticket field (stripped
     # from the datanode's decode-memo key like deadline_s, so hot
     # queries keep cache-hitting); the datanode parents its spans under
@@ -289,7 +298,7 @@ def _fan_out_stream(instance, table, partial: SelectPlan, clock,
                 else b'"traceparent":"%s",' % tp.encode())
     tickets = [
         (client, b'{"rpc":"partial_sql",' + dl_field + tp_field
-         + b'"mode":"plan","plan":'
+         + since_field + b'"mode":"plan","plan":'
          + plan_json + b',"table":' + info_json + b',"region_ids":'
          + json.dumps(list(rids)).encode() + b"}", len(rids))
         for client, rids in groups

@@ -56,6 +56,9 @@ class DistInstance(Standalone):
         self.catalog = DistCatalogManager(
             self.engine, self.meta, ingest_options=ingest_options
         )
+        # re-attach the result-cache purge handle: the base __init__
+        # hung it on the scratch catalog this line just replaced
+        self.catalog.result_cache = self.result_cache
         self.distributed = True
         self.flownode_addr = flownode_addr
         self._flow_clients: dict[str, object] = {}
@@ -694,5 +697,6 @@ class DistInstance(Standalone):
             for cli in clients:
                 cli.close()
             self.catalog.close()
+            self.meta.close()
         finally:
             super().close()

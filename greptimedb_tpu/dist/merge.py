@@ -32,13 +32,14 @@ from greptimedb_tpu import concurrency
 
 _DECODE_LRU_MAX = 64
 
-# the frontend splices the remaining deadline budget AND the trace
-# context into the ticket (dist_query.py _fan_out_stream); both vary
-# per query, so the decode memo keys on the ticket WITHOUT them —
-# otherwise every deadline-bound or traced repeat of a hot query would
-# miss the plan-decode cache
+# the frontend splices the remaining deadline budget, the trace
+# context AND the delta-poll cursor into the ticket (dist_query.py
+# _fan_out_stream); all vary per query, so the decode memo keys on the
+# ticket WITHOUT them — otherwise every deadline-bound, traced or
+# cursor-bearing repeat of a hot query would miss the plan-decode cache
 _DEADLINE_FIELD_RE = re.compile(r'"deadline_s":[0-9.eE+-]+,')
 _TRACEPARENT_FIELD_RE = re.compile(r'"traceparent":"[0-9a-f-]*",')
+_SINCE_FIELD_RE = re.compile(r'"since_ms":-?\d+,')
 _decode_lock = concurrency.Lock()
 _decode_cache: OrderedDict[str, tuple] = OrderedDict()
 
@@ -48,6 +49,13 @@ class _DatanodeTable(Table):
     through the RegionServer merged-scan cache. Everything else (schema
     accessors, device fast paths reading region internals) is the plain
     local-table behavior."""
+
+    # a fresh instance is assembled per exec_partial call, so its id —
+    # and any grid entry keyed on it — never repeats: session-registry
+    # puts keyed through it could only accumulate dead buffers
+    # (query/sessions.py). The merged-scan cache + jit program cache
+    # still serve the repeated-partial steady state.
+    session_cacheable = False
 
     def __init__(self, info, regions, region_server, region_ids):
         super().__init__(info, regions)
@@ -116,6 +124,7 @@ def exec_partial(instance, doc: dict, raw: str | None = None):
     if raw is not None:
         raw = _DEADLINE_FIELD_RE.sub("", raw, count=1)
         raw = _TRACEPARENT_FIELD_RE.sub("", raw, count=1)
+        raw = _SINCE_FIELD_RE.sub("", raw, count=1)
     plan, info = _decode_ticket(raw, doc)
     rs = instance.region_server
     rids = [int(r) for r in doc["region_ids"]]
@@ -126,9 +135,15 @@ def exec_partial(instance, doc: dict, raw: str | None = None):
     # datanode-side, so even a query the gRPC deadline cannot abort
     # (already executing) stays bounded
     from greptimedb_tpu.sched.deadline import Deadline, bind, reset
+    from greptimedb_tpu.query import sessions as _sessions
 
     dl = Deadline.from_timeout(doc.get("deadline_s"))
     token = bind(dl) if dl is not None else None
+    # re-anchor the shipped delta cursor: the datanode-side execution
+    # slices its row emission (and device readback) to rows past it
+    since = doc.get("since_ms")
+    since_token = (_sessions.bind_since(since)
+                   if since is not None else None)
     try:
         if dl is not None:
             dl.check("partial query")
@@ -143,6 +158,8 @@ def exec_partial(instance, doc: dict, raw: str | None = None):
                 ), qstats.collect() as collected:
             res = instance.query_engine.execute(plan, table)
     finally:
+        if since_token is not None:
+            _sessions.reset_since(since_token)
         if token is not None:
             reset(token)
     exec_ms = (time.perf_counter() - t0) * 1000.0

@@ -474,3 +474,15 @@ class RegionServer:
             except RegionNotFoundError:
                 out[str(rid)] = None
         return out
+
+    def physical_versions(self, region_ids: list[int]) -> dict:
+        """Per-region physical versions (data_version + manifest
+        version): the frontend result cache validates against these —
+        one cheap action instead of a full query."""
+        out = {}
+        for rid in region_ids:
+            try:
+                out[str(rid)] = self._region(int(rid)).physical_version
+            except RegionNotFoundError:
+                out[str(rid)] = None
+        return out

@@ -115,6 +115,11 @@ class RemoteRegion:
         v = self.client.data_versions([self.meta.region_id])
         return v.get(str(self.meta.region_id))
 
+    @property
+    def physical_version(self):
+        v = self.client.physical_versions([self.meta.region_id])
+        return v.get(str(self.meta.region_id))
+
 
 class RemoteTable(Table):
     """Table over remote regions; scans group regions per datanode.
@@ -240,6 +245,21 @@ class RemoteTable(Table):
         return (
             tuple(versions.get(str(r.meta.region_id))
                   for r in self.regions),
+            tuple(self.schema.column_names),
+            tuple(self.tag_names),
+        )
+
+    def physical_version(self) -> tuple:
+        """One physical_versions action per datanode: the frontend
+        result cache's validation cost for a dist table — a cheap
+        metadata round, never a scan."""
+        versions = {}
+        for client, rids in self._by_datanode(self.regions):
+            versions.update(client.physical_versions(rids))
+        return (
+            tuple(tuple(v) if isinstance(v, list) else v
+                  for v in (versions.get(str(r.meta.region_id))
+                            for r in self.regions)),
             tuple(self.schema.column_names),
             tuple(self.tag_names),
         )

@@ -207,6 +207,13 @@ class Standalone:
         from greptimedb_tpu.sched import AdmissionController
 
         self.scheduler = AdmissionController()
+        # frontend result-set cache (query/result_cache.py): disabled
+        # by default — cli.py swaps in the [result_cache]-configured
+        # one. The catalog gets a handle so drop_table can purge.
+        from greptimedb_tpu.query.result_cache import ResultCache
+
+        self.result_cache = ResultCache(enabled=False)
+        self.catalog.result_cache = self.result_cache
         from greptimedb_tpu.telemetry.slow_query import SlowQueryLog
 
         self.slow_query_log = SlowQueryLog()
@@ -905,6 +912,91 @@ class Standalone:
                 stmt, ts_name=ts_name, tag_names=tag_names,
                 all_columns=all_columns,
             )
+        return self._execute_select_plan(plan, table, ctx)
+
+    def _execute_select_plan(self, plan, table, ctx: QueryContext):
+        """Run a planned single-table SELECT through the device-resident
+        result path: frontend result cache first (query/result_cache.py
+        — a repeated poll on unchanged physical versions never touches
+        the datanode or the device), then the `since` delta cursor bound
+        for the execution layers (sliced device readback / scan ts
+        tightening)."""
+        from greptimedb_tpu.query import sessions
+        from greptimedb_tpu.query import stats as qstats
+        from greptimedb_tpu.telemetry import tracing
+
+        since = ctx.extensions.get("since_ms")
+        rc = getattr(self, "result_cache", None)
+        fp = versions = None
+        # EXPLAIN ANALYZE collects real execution stats: bypass so its
+        # metrics reflect an actual run, never a cached payload
+        use_cache = (rc is not None and rc.eligible(plan, table)
+                     and qstats.active() is None)
+        if use_cache and since is not None:
+            from greptimedb_tpu.query import result_cache as RC
+
+            # a since-poll can only be served from the cached FULL
+            # payload when the host row filter is equivalent to the
+            # execution-path cursor (applied BEFORE ORDER BY/LIMIT):
+            # LIMIT/OFFSET plans and row-returning plans that do not
+            # project the time index must execute the delta instead.
+            # Aggregates ignore the cursor entirely, so their cached
+            # payload stays equivalent.
+            if plan.kind != "aggregate" and (
+                plan.limit is not None or bool(plan.offset)
+                or RC.ts_output_name(plan, table) is None
+            ):
+                use_cache = False
+        if use_cache:
+            from greptimedb_tpu.query import result_cache as RC
+
+            db = table.info.database
+            fp = RC.plan_fingerprint(plan)
+            try:
+                versions = rc.current_versions(table)
+            except Exception:  # noqa: BLE001 - datanode down/unreachable
+                # version validation must never own failure semantics:
+                # the execution path below maps unreachable datanodes to
+                # the typed unavailable error or a degraded partial
+                # result ([scheduler] allow_partial_results)
+                use_cache = False
+                versions = None
+        if use_cache:
+            entry = rc.get(db, table, fp, versions)
+            if entry is not None:
+                tracing.set_attr(result_cache="hit")
+                qstats.note("result_cache", "hit")
+                # truthful path attribution: the cached payload came
+                # from this execution path (bench/EXPLAIN assertions)
+                self.query_engine.last_exec_path = entry.exec_path
+                res = entry.result
+                if since is not None:
+                    res = RC.filter_since(res, entry.ts_name, since)
+                return res
+            tracing.set_attr(result_cache="miss")
+            qstats.note("result_cache", "miss")
+        elif rc is not None and rc.enabled:
+            tracing.set_attr(result_cache="bypass")
+            qstats.note("result_cache", "bypass")
+        token = sessions.bind_since(since) if since is not None else None
+        try:
+            res = self._run_select_plan(plan, table)
+        finally:
+            if token is not None:
+                sessions.reset_since(token)
+        if use_cache and since is None and not getattr(res, "partial",
+                                                       False):
+            # only FULL, complete results are cached: a delta answer
+            # under a cursor (or a degraded partial) must never be
+            # served as the statement's payload
+            from greptimedb_tpu.query import result_cache as RC
+
+            rc.put(table.info.database, table, fp, versions, res,
+                   RC.ts_output_name(plan, table),
+                   self.query_engine.last_exec_path)
+        return res
+
+    def _run_select_plan(self, plan, table):
         if table is not None and getattr(table, "remote", False):
             # distributed tables: try the MergeScan split first (partial
             # plans execute datanode-side, only partial states cross the

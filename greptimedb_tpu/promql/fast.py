@@ -132,10 +132,23 @@ class SelectorGridCache:
         if e is None:
             return None
         with self._lock:
+            old = self._entries.get(key)
+            if old is not None and old is not e:
+                self._release(old)  # stale version: free its buffers
             self._entries[key] = e
             e.last_used = time.monotonic()
             self._evict_locked(keep=key)
         return e
+
+    @staticmethod
+    def _release(entry: "_Entry"):
+        """Drop the entry's session-resident result buffers with it: a
+        freed _Entry's id() can be reused by a new entry whose version
+        coincides, and the packed buffers would otherwise pin HBM until
+        unrelated LRU pressure (query/sessions.py purge contract)."""
+        from greptimedb_tpu.query import sessions as _sessions
+
+        _sessions.global_sessions.purge_table(("promql", id(entry)))
 
     def _evict_locked(self, keep):
         budget = _budget_bytes()
@@ -147,12 +160,16 @@ class SelectorGridCache:
         ):
             if key == keep:
                 continue
-            total -= self._entries.pop(key).nbytes
+            victim = self._entries.pop(key)
+            self._release(victim)
+            total -= victim.nbytes
             if total <= budget:
                 return
 
     def invalidate(self):
         with self._lock:
+            for e in self._entries.values():
+                self._release(e)
             self._entries.clear()
 
     def drop_table(self, table):
@@ -160,10 +177,32 @@ class SelectorGridCache:
             for key in [
                 k for k, e in self._entries.items() if e.table is table
             ]:
-                del self._entries[key]
+                self._release(self._entries.pop(key))
 
 
 _CACHE = SelectorGridCache()
+
+
+def _session_exec(entry: _Entry, skey: tuple, run):
+    """Persistent query session for a fused program's packed result: an
+    identical repeated poll serves the HBM-resident buffer without
+    re-dispatching the program (query/sessions.py — each dispatch is a
+    full RTT on a tunnel-attached chip). The shape key embeds the
+    device-array identities of the cached masks/grouping/window inputs
+    (match_cache/group_cache/win_cache): same id => same immutable
+    buffer, and an evicted input only costs a false miss. Entry version
+    rides the registry's validation, so any data change invalidates."""
+    from greptimedb_tpu.query import sessions as _sessions
+
+    tkey = ("promql", id(entry))
+    buf = _sessions.global_sessions.get(tkey, skey, entry.version)
+    if buf is None:
+        buf = run()
+        buf.block_until_ready()
+        _sessions.global_sessions.put(
+            tkey, skey, entry.version, buf, int(buf.nbytes)
+        )
+    return buf
 
 
 def _series_sharding(mesh, ndim: int):
@@ -896,12 +935,19 @@ def try_fast_histogram(engine, phi: float, inner, ev):
     _note_mesh_decision(entry, auto_spmd_site="histogram")
     from greptimedb_tpu.telemetry import device_trace
 
+    from greptimedb_tpu.query import readback as _readback
+
+    skey = ("hist", fname, agg_op, g_agg, g, b, range_ticks,
+            range_seconds, l_cells, entry.spec.tps, fargs,
+            lookback_ticks, float(phi),
+            np.asarray(uniq_le).tobytes(),
+            id(smask), id(d_gid), id(d_slot), id(lo), id(hi), id(t_end))
     with device_trace.device_call(
             "promql_histogram", key=("hist", fname, agg_op, g_agg, g, b,
                                      range_ticks, range_seconds,
                                      l_cells, entry.spec.tps, fargs,
                                      lookback_ticks)) as dcall:
-        packed = _fused_hist_query(
+        packed = _session_exec(entry, skey, lambda: _fused_hist_query(
             entry.vals, entry.has, entry.tsg, smask, d_gid, d_slot,
             jnp.asarray(uniq_le, jnp.float32), lo, hi, t_end,
             jnp.float32(phi),
@@ -910,10 +956,9 @@ def try_fast_histogram(engine, phi: float, inner, ev):
             range_seconds=range_seconds, l_cells=l_cells,
             tps=entry.spec.tps, fargs=fargs,
             lookback_ticks=lookback_ticks,
-        )
-        packed.block_until_ready()
+        ))
         dcall.executed()
-        packed_np = np.asarray(packed, np.float64)
+        packed_np = _readback.read_full(packed, np.float64)
         dcall.transfer(packed_np.nbytes, "readback")
     vals_np = packed_np[:g]
     pres_np = packed_np[g:] != 0.0
@@ -956,22 +1001,27 @@ def try_fast(engine, e, ev):
     _note_mesh_decision(entry)
     from greptimedb_tpu.telemetry import device_trace
 
+    from greptimedb_tpu.query import readback as _readback
+
+    skey = ("q", entry.mesh is None, fname, e.op, g, range_ticks,
+            range_seconds, l_cells, entry.spec.tps, fargs,
+            lookback_ticks, id(smask), id(gid), id(lo), id(hi),
+            id(t_end))
     with device_trace.device_call(
             "promql", key=("promql", entry.mesh is None, fname, e.op,
                            g, range_ticks, range_seconds, l_cells,
                            entry.spec.tps, fargs, lookback_ticks),
             groups=g) as dcall:
-        packed = program(
+        packed = _session_exec(entry, skey, lambda: program(
             entry.vals, entry.has, entry.tsg, smask, gid,
             lo, hi, t_end,
             fname=fname, op=e.op, g=g, range_ticks=range_ticks,
             range_seconds=range_seconds, l_cells=l_cells,
             tps=entry.spec.tps, fargs=fargs,
             lookback_ticks=lookback_ticks,
-        )
-        packed.block_until_ready()
+        ))
         dcall.executed()
-        packed_np = np.asarray(packed, np.float64)
+        packed_np = _readback.read_full(packed, np.float64)
         dcall.transfer(packed_np.nbytes, "readback")
     vals_np = packed_np[:g]
     pres_np = packed_np[g:] != 0.0
@@ -1214,21 +1264,25 @@ def try_fast_topk(engine, e, ev):
     _note_mesh_decision(entry)
     from greptimedb_tpu.telemetry import device_trace
 
+    from greptimedb_tpu.query import readback as _readback
+
+    skey = ("topk", entry.mesh is None, fname, kk, e.op == "topk",
+            range_ticks, range_seconds, l_cells, entry.spec.tps, fargs,
+            lookback_ticks, id(smask), id(lo), id(hi), id(t_end))
     with device_trace.device_call(
             "topk", key=("topk", entry.mesh is None, fname, kk,
                          e.op == "topk", range_ticks, range_seconds,
                          l_cells, entry.spec.tps, fargs,
                          lookback_ticks)) as dcall:
-        packed_dev = topk_prog(
+        packed_dev = _session_exec(entry, skey, lambda: topk_prog(
             entry.vals, entry.has, entry.tsg, smask, lo, hi, t_end,
             fname=fname, k=kk, largest=e.op == "topk",
             range_ticks=range_ticks, range_seconds=range_seconds,
             l_cells=l_cells, tps=entry.spec.tps, fargs=fargs,
             lookback_ticks=lookback_ticks,
-        )
-        packed_dev.block_until_ready()
+        ))
         dcall.executed()
-        packed = np.asarray(packed_dev)
+        packed = _readback.read_full(packed_dev)
         dcall.transfer(packed.nbytes, "readback")
     jj = packed.shape[0] // 3
     top_vals = packed[:jj].astype(np.float64)      # (J, k)
@@ -1418,13 +1472,21 @@ def try_fast_binary(engine, e, ev, *, agg=None):
     _note_mesh_decision(entry_l, auto_spmd_site="binary")
     from greptimedb_tpu.telemetry import device_trace
 
+    from greptimedb_tpu.query import readback as _readback
+
+    skey = ("binary", id(entry_r), fname_l, fname_r, e.op,
+            bool(e.bool_mod), agg_op, g, rt_l, rt_r, rs_l, rs_r,
+            lc_l, lc_r, entry_l.spec.tps, fargs_l, fargs_r,
+            lookback_ticks, id(smask_l), id(smask_r), id(gid),
+            id(lo_l), id(hi_l), id(t_end_l), id(lo_r), id(hi_r),
+            id(t_end_r), entry_r.version)
     with device_trace.device_call(
             "promql_binary", key=("binary", fname_l, fname_r, e.op,
                                   bool(e.bool_mod), agg_op, g, rt_l,
                                   rt_r, rs_l, rs_r, lc_l, lc_r,
                                   entry_l.spec.tps, fargs_l, fargs_r,
                                   lookback_ticks)) as dcall:
-        packed = _fused_binary(
+        packed = _session_exec(entry_l, skey, lambda: _fused_binary(
             entry_l.vals, entry_l.has, entry_l.tsg, smask_l,
             lo_l, hi_l, t_end_l,
             entry_r.vals, entry_r.has, entry_r.tsg, smask_r,
@@ -1437,10 +1499,9 @@ def try_fast_binary(engine, e, ev, *, agg=None):
             l_cells_l=lc_l, l_cells_r=lc_r, tps=entry_l.spec.tps,
             fargs_l=fargs_l, fargs_r=fargs_r,
             lookback_ticks=lookback_ticks,
-        )
-        packed.block_until_ready()
+        ))
         dcall.executed()
-        packed_np = np.asarray(packed, np.float64)
+        packed_np = _readback.read_full(packed, np.float64)
         dcall.transfer(packed_np.nbytes, "readback")
     if agg_op:
         vals_np = packed_np[:g]

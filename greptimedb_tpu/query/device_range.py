@@ -161,6 +161,17 @@ class DeviceRangeCache:
         self._lock = concurrency.Lock()
         self.byte_budget = byte_budget
 
+    @staticmethod
+    def _release(entry: "_Entry"):
+        """Drop the entry's session-resident result buffers with it
+        (query/sessions.py): session keys embed id(entry), so a
+        replaced/evicted grid entry's buffers could otherwise never be
+        probed again — each (write, poll) cycle would strand one folded
+        buffer per query shape until LRU byte pressure."""
+        from greptimedb_tpu.query import sessions as _sessions
+
+        _sessions.global_sessions.purge_table(("range", id(entry)))
+
     def lookup_compatible(self, tkey, version, r0: int, align_to: int
                           ) -> _Entry | None:
         """Find a live entry for `tkey` whose resolution serves a query
@@ -173,6 +184,7 @@ class DeviceRangeCache:
                 e = self._entries[key]
                 if e.version != version:
                     del self._entries[key]
+                    self._release(e)
                     continue
                 if r0 % e.res == 0 and align_to % e.res == e.phase:
                     self._entries.pop(key)
@@ -185,7 +197,9 @@ class DeviceRangeCache:
             self._insert_locked(key, entry)
 
     def _insert_locked(self, key: tuple, entry: _Entry):
-        self._entries.pop(key, None)
+        old = self._entries.pop(key, None)
+        if old is not None and old is not entry:
+            self._release(old)
         total = sum(e.bytes() for e in self._entries.values())
         total += entry.bytes()
         while self._entries and (
@@ -193,6 +207,7 @@ class DeviceRangeCache:
             or total > self.byte_budget
         ):
             victim = self._entries.pop(next(iter(self._entries)))
+            self._release(victim)
             total -= victim.bytes()
         self._entries[key] = entry
 
@@ -227,11 +242,14 @@ class DeviceRangeCache:
                 if self._entries[key] is entry:
                     continue
                 victim = self._entries.pop(key)
+                self._release(victim)
                 total -= victim.bytes()
             return total <= self.byte_budget
 
     def clear(self):
         with self._lock:
+            for e in self._entries.values():
+                self._release(e)
             self._entries.clear()
 
 
@@ -1797,33 +1815,83 @@ def execute_range_device(engine, plan, table):
             # DOCUMENTED bit-identity exception; surface it
             stats.note("mesh_fold_range", "auto_spmd(oversized_fold)")
     prog_spec = (stride, n_steps, g, memo["fold"], nanenc, prog_items)
-    # device-time attribution: one span per jit/shard_map invocation
-    # carrying compile (first-call vs cache-hit), block_until_ready
-    # execute time and readback bytes — the tunnel floor becomes a
-    # named span on the trace. Attribution comes from device_trace's
-    # PROCESS-level memo, matching the jit cache's scope (the
-    # entry-level program_specs memo resets with every rebuilt grid
-    # entry — e.g. each datanode partial builds a fresh table — and
-    # would mislabel warm programs as first_call).
+    from greptimedb_tpu.query import readback, sessions
     from greptimedb_tpu.telemetry import device_trace
 
+    # delta-poll cursor: j0 = first step whose __ts is past the
+    # client's watermark. With FILL the full grid must assemble first
+    # (PREV/LINEAR carry from pre-cursor steps), so the cursor moves
+    # to cell emission; otherwise only delta steps cross the tunnel.
+    since = sessions.current_since()
+    has_fill = plan.fill is not None or any(
+        r.fill is not None for r in plan.range_items
+    )
+    j0 = 0
+    if since is not None and not has_fill:
+        j0 = int(np.searchsorted(step_ts, since, side="right"))
+        if j0 >= n_steps:
+            return empty  # the client has every step already
+
+    # persistent query session: the folded RESULT buffer of this exact
+    # query shape stays HBM-resident across polls — a repeated
+    # dashboard query skips the program dispatch round trip entirely
+    # (each dispatch is a full RTT on a tunnel-attached chip) and the
+    # delta path slices the resident buffer device-side below
+    # keyed to THIS grid entry (id): two engines over the same table
+    # (e.g. the sharded and single-device twins in the parity fuzz)
+    # must not blindly share buffers across entries, and the cache
+    # releases an entry's buffers when it drops the entry
+    # (DeviceRangeCache._release — id reuse can never serve stale).
+    # Tables assembled per-call (datanode partials) opt out — their
+    # entry ids never repeat, so puts could only accumulate dead
+    # buffers.
+    use_sessions = getattr(table, "session_cacheable", True)
+    session_tkey = ("range", id(entry))
+    session_key = (memo_key, prog_spec)
+    out_dev = (sessions.global_sessions.get(
+        session_tkey, session_key, entry.version
+    ) if use_sessions else None)
+    # device-time attribution: one span per query carrying compile
+    # (first-call vs cache-hit), block_until_ready execute time and
+    # transfer bytes — the tunnel floor becomes a named span on the
+    # trace. Attribution comes from device_trace's PROCESS-level memo,
+    # matching the jit cache's scope (the entry-level program_specs
+    # memo resets with every rebuilt grid entry — e.g. each datanode
+    # partial builds a fresh table — and would mislabel warm programs
+    # as first_call). A session hit keeps the span (execute is the
+    # skipped dispatch, ~0) so traces always show the device leg.
     first_spec = prog_spec not in entry.program_specs
     with stats.timed("device_exec_ms"), \
             device_trace.device_call(
                 "range", key=("range", prog_spec),
                 groups=g, steps=n_steps) as dcall:
-        if uploaded_bytes:
-            dcall.transfer(uploaded_bytes, "upload")
-        out = program(
-            arrs, memo["gid"], memo["mask"],
-            memo["delta"], memo["lo"], memo["hi"],
-            spec=prog_spec,
-        )
-        out.block_until_ready()
-        dcall.executed()
-        # fold=False leaves the series axis un-folded: rows [g:] are the
-        # padded/inactive tail (fold=True already has exactly g rows)
-        out = np.asarray(out)[:, :g]
+        if out_dev is not None:
+            stats.note("device_session", "hit")
+            dcall.executed()
+        else:
+            stats.note("device_session", "miss")
+            if uploaded_bytes:
+                dcall.transfer(uploaded_bytes, "upload")
+            out_dev = program(
+                arrs, memo["gid"], memo["mask"],
+                memo["delta"], memo["lo"], memo["hi"],
+                spec=prog_spec,
+            )
+            out_dev.block_until_ready()
+            dcall.executed()
+            if use_sessions:
+                sessions.global_sessions.put(
+                    session_tkey, session_key, entry.version, out_dev,
+                    int(out_dev.nbytes),
+                )
+        # fold=False leaves the series axis un-folded: rows [g:] are
+        # the padded/inactive tail (fold=True already has exactly g
+        # rows). Both slices happen on the DEVICE array, so a delta
+        # poll moves only the unseen steps across the tunnel
+        # (readback.read_delta feeds
+        # gtpu_readback_bytes_total{mode=full|delta}).
+        sliced = out_dev if memo["fold"] else out_dev[:, :g]
+        out = readback.read_delta(sliced, j0, axis=-1)
         dcall.transfer(out.nbytes, "readback")
     if first_spec:
         entry.program_specs[prog_spec] = True
@@ -1831,6 +1899,8 @@ def execute_range_device(engine, plan, table):
             target=_persist_program_specs, args=(entry, table),
             daemon=True, name="program-specs-persist",
         ).start()
+    step_ts_eff = step_ts[j0:] if j0 else step_ts
+    n_steps_eff = n_steps - j0
     stats.add("device_readback_bytes", out.nbytes)
     stats.add("range_groups", g)
     stats.add("range_steps", n_steps)
@@ -1852,5 +1922,7 @@ def execute_range_device(engine, plan, table):
         item_vals[item.key] = vals[i]
         item_present[item.key] = pres[i]
     return engine._assemble_range_result(
-        plan, table, item_vals, item_present, key_cols, step_ts, g, n_steps,
+        plan, table, item_vals, item_present, key_cols, step_ts_eff,
+        g, n_steps_eff,
+        since_ms=since if has_fill else None,
     )

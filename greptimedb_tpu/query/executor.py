@@ -52,32 +52,39 @@ class QueryResult:
     def rows(self) -> list[list]:
         """Row-major python values (None for nulls) — protocol output.
         Decimal columns render as scale-fixed strings (the exact wire
-        form; they compute as float64 internally, datatypes/types.py)."""
-        out = []
+        form; they compute as float64 internally, datatypes/types.py).
+
+        COLUMN-wise materialization: ndarray.tolist() converts a whole
+        column at C speed (numpy scalars become native python values),
+        then one zip transposes — the per-row-per-cell python loop this
+        replaces dominated large result serving (the tsbs_high_cpu_all
+        shape returns ~1.7M rows x 12 columns)."""
         pycols = []
         for j, c in enumerate(self.cols):
             vals = c.values
-            valid = c.valid_mask
             dt = self.types.get(self.names[j])
             scale = (
                 dt.scale if dt is not None and dt.is_decimal() else None
             )
-            pycols.append((vals, valid, scale))
-        for i in range(self.num_rows):
-            row = []
-            for vals, valid, scale in pycols:
-                if not valid[i]:
-                    row.append(None)
-                else:
-                    v = vals[i]
-                    if scale is not None:
-                        row.append(f"{float(v):.{scale}f}")
-                    else:
-                        row.append(
-                            v.item() if isinstance(v, np.generic) else v
-                        )
-            out.append(row)
-        return out
+            if scale is not None:
+                lst = [f"{float(v):.{scale}f}" for v in vals.tolist()]
+            elif vals.dtype == object:
+                # object cells may hold numpy scalars: unwrap like the
+                # per-cell .item() path did
+                lst = [
+                    v.item() if isinstance(v, np.generic) else v
+                    for v in vals
+                ]
+            else:
+                lst = vals.tolist()
+            if c.validity is not None and not c.validity.all():
+                invalid = np.flatnonzero(~c.validity)
+                for i in invalid.tolist():
+                    lst[i] = None
+            pycols.append(lst)
+        if not pycols:
+            return [[] for _ in range(self.num_rows)]
+        return [list(r) for r in zip(*pycols)]
 
     def column(self, name: str) -> Col:
         return self.cols[self.names.index(name)]
@@ -343,9 +350,22 @@ class QueryEngine:
                 ] or None
             from greptimedb_tpu.telemetry import tracing
 
+            ts_min = plan.scan.ts_min
+            if plan.kind == "plain":
+                # delta-poll cursor: for row-returning plain selects the
+                # `since` watermark IS a ts lower bound, applied at scan
+                # time (before ORDER BY/LIMIT, like an extra WHERE).
+                # Aggregate/range states must fold the FULL row set —
+                # range emission filters at assembly instead.
+                from greptimedb_tpu.query import sessions
+
+                since = sessions.current_since()
+                if since is not None:
+                    ts_min = (since + 1 if ts_min is None
+                              else max(ts_min, since + 1))
             with tracing.span("query.scan", table=table.name):
                 data = table.scan(
-                    ts_min=plan.scan.ts_min,
+                    ts_min=ts_min,
                     ts_max=plan.scan.ts_max,
                     field_names=field_names,
                     matchers=plan.scan.matchers or None,
@@ -655,16 +675,23 @@ class QueryEngine:
             )
             item_vals[item.key] = vals
             item_present[item.key] = present
+        from greptimedb_tpu.query import sessions
+
         return self._assemble_range_result(
             plan, table, item_vals, item_present, key_cols, step_ts,
-            g, n_steps,
+            g, n_steps, since_ms=sessions.current_since(),
         )
 
     def _assemble_range_result(self, plan, table, item_vals, item_present,
-                               key_cols, step_ts, g, n_steps) -> QueryResult:
+                               key_cols, step_ts, g, n_steps,
+                               since_ms: int | None = None) -> QueryResult:
         """Fill + output assembly over (g, n_steps) per-item grids — shared
         by the host path and the device grid-cache path
-        (query/device_range.py)."""
+        (query/device_range.py). `since_ms` is the delta-poll cursor:
+        only cells whose step ts is strictly greater are EMITTED (the
+        fill math still runs over the full grid first, so PREV/LINEAR
+        carry from pre-cursor steps stays identical to the full
+        result)."""
         ts_type = table.schema.time_index.data_type
         names = [nm for _, nm in plan.post_items]
         any_present = np.zeros((g, n_steps), dtype=bool)
@@ -685,6 +712,8 @@ class QueryEngine:
             cell_mask = np.ones((g, n_steps), dtype=bool)
         else:
             cell_mask = any_present
+        if since_ms is not None:
+            cell_mask = cell_mask & (step_ts > since_ms)[None, :]
         if not plan.order_by:
             # construct rows already in the default (ts, group keys) order:
             # rank groups once (g keys, not g*steps rows), then emit

@@ -41,6 +41,11 @@ class ScanSpec:
     # exactly by the residual, this only skips row groups that cannot
     # contain a match
     fulltext: list = dc_field(default_factory=list)
+    # a ts bound (or RANGE ... TO now) was folded from a volatile
+    # expression (now()/current_timestamp): the concrete value differs
+    # on every plan, so caches keyed on the plan fingerprint must
+    # bypass — each invocation would insert a dead never-hit entry
+    volatile_bounds: bool = False
 
 
 @dataclass
@@ -257,6 +262,31 @@ def split_conjuncts(e: A.Expr | None) -> list[A.Expr]:
     return [e]
 
 
+_VOLATILE_CALLS = frozenset({
+    "now", "current_timestamp", "current_time", "current_date",
+    "localtime", "localtimestamp", "random", "rand", "uuid",
+})
+
+
+def _has_volatile_call(e) -> bool:
+    """Does the expression tree contain an evaluation-time-dependent
+    function call (the fold would freeze a different value per plan)?"""
+    if isinstance(e, A.FuncCall) and e.name.lower() in _VOLATILE_CALLS:
+        return True
+    import dataclasses as _dc
+
+    if _dc.is_dataclass(e) and not isinstance(e, type):
+        for f in _dc.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, A.Expr) and _has_volatile_call(v):
+                return True
+            if isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, A.Expr) and _has_volatile_call(x):
+                        return True
+    return False
+
+
 def _try_const(e: A.Expr):
     """Constant-fold an expression with no column refs; None on failure."""
     from greptimedb_tpu.query.expr import collect_columns
@@ -294,6 +324,8 @@ def analyze_where(
     residual: list[A.Expr] = []
     for c in split_conjuncts(where):
         if _absorb_time(c, ts_name, spec):
+            if _has_volatile_call(c):
+                spec.volatile_bounds = True
             continue
         if _absorb_matcher(c, tag_names, spec):
             continue
@@ -658,6 +690,8 @@ def _plan_range(
             import time as _time
 
             align_to = int(_time.time() * 1000)
+            # folded wall clock: the plan re-fingerprints every call
+            scan.volatile_bounds = True
         elif t in ("", "calendar"):
             align_to = 0
         else:

@@ -544,8 +544,10 @@ def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts,
                                 spec=spec)
             out_b.block_until_ready()
             dcall.executed()
-            out_b = np.asarray(out_b).astype(np.float64)
-            out_s = np.asarray(out_s).astype(np.float64)
+            from greptimedb_tpu.query import readback as _readback
+
+            out_b = _readback.read_full(out_b, np.float64)
+            out_s = _readback.read_full(out_s, np.float64)
             dcall.transfer(out_b.nbytes + out_s.nbytes, "readback")
         # reassemble the single-device program's row layout so the host
         # f64 combine below is shared verbatim
@@ -572,7 +574,9 @@ def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts,
                              spec=spec)
             out_dev.block_until_ready()
             dcall.executed()
-            out_mat = np.asarray(out_dev).astype(np.float64)
+            from greptimedb_tpu.query import readback as _readback
+
+            out_mat = _readback.read_full(out_dev, np.float64)
             dcall.transfer(out_mat.nbytes, "readback")
 
     # decode: host f64 combine of the blocked partials

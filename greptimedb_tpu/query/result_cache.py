@@ -1,0 +1,322 @@
+"""Frontend result-set cache: completed query payloads served edge-side.
+
+The third leg of the device-resident result path: where the session
+registry (query/sessions.py) keeps device RESULT buffers resident and
+the grid caches keep input state resident, this cache keeps the
+*finished host result* of a statement and serves a repeated poll
+without touching the datanode (or the device) at all — the tf.data
+"cache at the serving edge instead of recomputing per poll" design.
+
+Keyed on (database, table id, normalized-statement fingerprint);
+validated against the table's PHYSICAL version set (storage/region.py
+physical_version — write, flush, compact, truncate, ALTER all bump it;
+region migration re-anchors it), the same discipline as the datanode
+merged-scan cache. Prepared-statement params are substituted into the
+text before parsing, so they ride the fingerprint. TTL'd tables bypass
+(their scan window is wall-clock-derived); plans containing volatile
+functions (now()/random()/...) bypass; EXPLAIN ANALYZE bypasses so its
+metrics reflect a real execution.
+
+`since` delta polls serve from the cached FULL result by a host-side
+row filter on the time-index output column — zero datanode traffic,
+zero device readback. A miss while `since` is bound executes the delta
+(sliced device readback) and is NOT cached (only full results are).
+
+Bounded by an LRU byte budget; `gtpu_result_cache_{hits,misses,
+evictions}_total` + bytes/entries gauges export through the global
+registry, and the active trace span gets `result_cache=hit|miss|bypass`
+attribution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from collections import OrderedDict
+
+from greptimedb_tpu.sql import ast as A
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+from greptimedb_tpu import concurrency
+
+_HITS = global_registry.counter(
+    "gtpu_result_cache_hits_total",
+    "frontend result-set cache hits (served without datanode/device)",
+)
+_MISSES = global_registry.counter(
+    "gtpu_result_cache_misses_total",
+    "frontend result-set cache misses",
+)
+_EVICTIONS = global_registry.counter(
+    "gtpu_result_cache_evictions_total",
+    "frontend result-set cache entries evicted (budget or staleness)",
+)
+_BYTES = global_registry.gauge(
+    "gtpu_result_cache_bytes",
+    "bytes held by the frontend result-set cache",
+)
+_ENTRIES = global_registry.gauge(
+    "gtpu_result_cache_entries",
+    "entries held by the frontend result-set cache",
+)
+
+_DEFAULT_BYTES = 256 * 1024 * 1024
+
+# functions whose value depends on evaluation time/randomness: caching
+# the result would freeze them (the planner folds WHERE-clause time
+# bounds to concrete ms before the plan reaches us, so those are safe)
+_VOLATILE_FUNCS = frozenset({
+    "now", "current_timestamp", "current_time", "current_date",
+    "localtime", "localtimestamp", "random", "rand", "uuid",
+})
+
+
+def _expr_has_volatile(e) -> bool:
+    if isinstance(e, A.FuncCall):
+        if e.name.lower() in _VOLATILE_FUNCS:
+            return True
+    if dataclasses.is_dataclass(e) and not isinstance(e, type):
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, A.Expr) and _expr_has_volatile(v):
+                return True
+            if isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, A.Expr) and _expr_has_volatile(x):
+                        return True
+                    if (isinstance(x, tuple) and x
+                            and isinstance(x[0], A.Expr)
+                            and _expr_has_volatile(x[0])):
+                        return True
+    return False
+
+
+def plan_volatile(plan) -> bool:
+    """True when any expression in the plan is evaluation-time
+    dependent (now()/random()/... anywhere in items, keys, aggs,
+    range args, having, order_by or the residual filter)."""
+    exprs = [e for e, _ in (plan.items or [])]
+    exprs += [e for e, _ in (plan.post_items or [])]
+    exprs += [k.expr for k in plan.keys]
+    exprs += [a.arg for a in plan.aggs if a.arg is not None]
+    exprs += [r.arg for r in plan.range_items if r.arg is not None]
+    if plan.having is not None:
+        exprs.append(plan.having)
+    exprs += [o.expr for o in plan.order_by]
+    if plan.scan.residual is not None:
+        exprs.append(plan.scan.residual)
+    return any(_expr_has_volatile(e) for e in exprs)
+
+
+def plan_fingerprint(plan) -> str:
+    """Deterministic identity of a planned statement. The dataclass
+    repr is deterministic; full matcher regex patterns are appended
+    because re.Pattern repr truncates long patterns (same scheme as
+    dist/dist_query._plan_fingerprint)."""
+    extra = "".join(
+        str(getattr(m[2], "pattern", ""))
+        for m in plan.scan.matchers or []
+    )
+    return repr(plan) + "\x00" + extra
+
+
+def ts_output_name(plan, table) -> str | None:
+    """Name of the time-index output column a `since` delta filter
+    applies to, or None when the projection does not carry it."""
+    if plan.kind == "range":
+        for e, nm in plan.post_items:
+            if isinstance(e, A.Column) and e.name == "__ts":
+                return nm
+        return None
+    if plan.kind == "plain" and table is not None:
+        ts = table.ts_name
+        for e, nm in plan.items:
+            if isinstance(e, A.Column) and e.name == ts:
+                return nm
+    return None
+
+
+def filter_since(res, ts_name: str | None, since_ms: int):
+    """Rows of `res` whose `ts_name` column is strictly greater than
+    the watermark; full result when the projection lacks the column
+    (the client cannot be delta-served without a time column)."""
+    from greptimedb_tpu.query.executor import QueryResult
+
+    if ts_name is None or ts_name not in res.names:
+        return res
+    col = res.column(ts_name)
+    keep = np.asarray(col.values, np.int64) > int(since_ms)
+    if keep.all():
+        return res
+    from greptimedb_tpu.query.executor import _slice_result
+
+    idx = np.flatnonzero(keep)
+    out = QueryResult(res.names, _slice_result(res.cols, idx), res.types)
+    out.partial = getattr(res, "partial", False)
+    if out.partial:
+        out.missing_regions = getattr(res, "missing_regions", 0)
+    return out
+
+
+def _result_nbytes(res) -> int:
+    n = 0
+    for c in res.cols:
+        v = c.values
+        if v.dtype == object:
+            n += len(v) * 64  # strings: conservative estimate
+        else:
+            n += int(v.nbytes)
+        if c.validity is not None:
+            n += int(c.validity.nbytes)
+    return n
+
+
+class _Entry:
+    __slots__ = ("versions", "result", "ts_name", "exec_path", "nbytes")
+
+    def __init__(self, versions, result, ts_name, exec_path, nbytes):
+        self.versions = versions
+        self.result = result
+        self.ts_name = ts_name
+        self.exec_path = exec_path
+        self.nbytes = nbytes
+
+
+class ResultCache:
+    """LRU byte-budgeted cache of finished QueryResults, physical-
+    version validated."""
+
+    def __init__(self, max_bytes: int = _DEFAULT_BYTES,
+                 enabled: bool = False,
+                 validate_interval_ms: float = 0.0):
+        self.max_bytes = int(max_bytes)
+        self.enabled = bool(enabled)
+        # > 0: a version snapshot this fresh (monotonic ms) serves
+        # without re-validating — for REMOTE tables this is the "serve
+        # without touching the datanode" staleness bound; 0 = exact
+        # validation every poll (free locally, one cheap data_versions
+        # action per datanode for dist tables)
+        self.validate_interval_ms = float(validate_interval_ms)
+        self._lock = concurrency.Lock()
+        self._entries: OrderedDict[tuple, _Entry] = OrderedDict()
+        self._bytes = 0
+        # table key -> (monotonic_s, versions) snapshot for the
+        # validate-interval path
+        self._version_snap: dict = {}
+
+    @classmethod
+    def from_options(cls, options: dict | None) -> "ResultCache":
+        o = options or {}
+        return cls(
+            max_bytes=int(o.get("bytes", _DEFAULT_BYTES)),
+            enabled=bool(o.get("enable", False)),
+            validate_interval_ms=float(
+                o.get("validate_interval_ms", 0.0)
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    def eligible(self, plan, table) -> bool:
+        if not self.enabled or table is None:
+            return False
+        if plan.kind not in ("plain", "aggregate", "range"):
+            return False
+        if table.info.options.get("ttl"):
+            return False  # wall-clock-derived scan window
+        if getattr(plan.scan, "volatile_bounds", False):
+            # a now()-folded bound re-fingerprints every call: caching
+            # would insert one dead never-hit entry per poll
+            return False
+        return not plan_volatile(plan)
+
+    def current_versions(self, table):
+        """The table's physical version set, memoized for
+        validate_interval_ms when configured."""
+        import time as _time
+
+        tkey = (table.info.database, table.info.table_id)
+        if self.validate_interval_ms > 0:
+            snap = self._version_snap.get(tkey)
+            now = _time.monotonic()
+            if (snap is not None
+                    and (now - snap[0]) * 1000.0
+                    <= self.validate_interval_ms):
+                return snap[1]
+            versions = table.physical_version()
+            self._version_snap[tkey] = (now, versions)
+            return versions
+        return table.physical_version()
+
+    def get(self, db: str, table, fingerprint: str, versions):
+        key = (db, table.info.table_id, fingerprint)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                _MISSES.inc()
+                return None
+            if e.versions != versions:
+                self._drop_locked(key, e)
+                _MISSES.inc()
+                return None
+            self._entries.move_to_end(key)
+            _HITS.inc()
+            return e
+
+    def put(self, db: str, table, fingerprint: str, versions, result,
+            ts_name: str | None, exec_path: str):
+        nbytes = _result_nbytes(result)
+        if nbytes > self.max_bytes:
+            return
+        key = (db, table.info.table_id, fingerprint)
+        entry = _Entry(versions, result, ts_name, exec_path, nbytes)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = entry
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                k = next(iter(self._entries))
+                self._drop_locked(k, self._entries[k])
+            self._publish_locked()
+
+    # ------------------------------------------------------------------
+    def purge_table(self, db: str, table_id: int) -> None:
+        """Drop every entry for the table (drop/close: a recreated
+        table can reuse the id and coincidentally match versions)."""
+        with self._lock:
+            stale = [k for k in self._entries
+                     if k[0] == db and k[1] == table_id]
+            for k in stale:
+                self._drop_locked(k, self._entries[k])
+            self._version_snap.pop((db, table_id), None)
+            if stale:
+                self._publish_locked()
+
+    def clear(self) -> None:
+        with self._lock:
+            for k in list(self._entries):
+                self._drop_locked(k, self._entries[k])
+            self._version_snap.clear()
+            self._publish_locked()
+
+    def _drop_locked(self, key, entry) -> None:
+        self._entries.pop(key, None)
+        self._bytes -= entry.nbytes
+        _EVICTIONS.inc()
+
+    def _publish_locked(self) -> None:
+        _BYTES.set(float(self._bytes))
+        _ENTRIES.set(float(len(self._entries)))
+
+    @property
+    def entry_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def byte_count(self) -> int:
+        with self._lock:
+            return self._bytes

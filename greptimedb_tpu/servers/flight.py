@@ -325,6 +325,10 @@ class FlightServer(flight.FlightServerBase):
             return {"versions": rs.data_versions(
                 [int(r) for r in body["region_ids"]]
             )}
+        elif kind == "physical_versions":
+            return {"versions": rs.physical_versions(
+                [int(r) for r in body["region_ids"]]
+            )}
         elif kind == "list_regions":
             return {"region_ids": rs.region_ids()}
         else:

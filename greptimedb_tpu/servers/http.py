@@ -494,6 +494,21 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 if not math.isfinite(t) or t <= 0:
                     return self._error(400, f"bad timeout {timeout!r}")
                 ctx.extensions["deadline_s"] = t
+            # delta-poll cursor: ?since=<epoch ms> (or X-Greptime-Since)
+            # restricts row-returning SELECTs to rows whose time index
+            # is strictly greater — the incremental-readback protocol
+            # (query/sessions.py); the client advances it to the max ts
+            # it has seen
+            since = (params.get("since")
+                     or self.headers.get("X-Greptime-Since"))
+            if since is not None:
+                try:
+                    s = float(since)
+                except ValueError:
+                    return self._error(400, f"bad since {since!r}")
+                if not math.isfinite(s) or s < 0:
+                    return self._error(400, f"bad since {since!r}")
+                ctx.extensions["since_ms"] = int(s)
             t0 = time.perf_counter()
             outputs = instance.execute_sql(sql, ctx)
             elapsed = (time.perf_counter() - t0) * 1000

@@ -31,6 +31,11 @@ from greptimedb_tpu import concurrency
 def _make_handler(metasrv: Metasrv, kv: KvBackend):
     class Handler(BaseHTTPRequestHandler):
         server_version = "greptimedb-tpu-metasrv"
+        # HTTP/1.1 keep-alive: the control plane is polled constantly
+        # (heartbeats, route refresh, kv) and every response carries
+        # Content-Length, so clients (dist/client._KeepAliveHTTP) hold
+        # one connection instead of a TCP handshake per round
+        protocol_version = "HTTP/1.1"
 
         def log_message(self, *args):
             pass

@@ -725,6 +725,77 @@ class TelemetryInDeviceScope(Rule):
             )
 
 
+_READBACK_CALLS = {"np.asarray", "numpy.asarray", "onp.asarray",
+                   "jax.device_get"}
+
+
+@register
+class FullBufferReadback(Rule):
+    id = "GT015"
+    name = "full-buffer-readback"
+    description = (
+        "np.asarray()/jax.device_get() on a device result buffer (a "
+        "name this function called .block_until_ready() on) reads the "
+        "WHOLE buffer back across the host<->device tunnel, "
+        "unattributed. Route result readbacks through "
+        "query/readback.read_full (bytes land on "
+        "gtpu_readback_bytes_total) or read_delta (a since-cursor poll "
+        "slices device-side and ships only the unseen rows)."
+    )
+
+    @staticmethod
+    def _scan_blocked(scope, *, skip_nested: bool) -> set[str]:
+        """Names `X` with an `X.block_until_ready()` call in `scope`'s
+        own statements — the device-result-buffer idiom."""
+        names: set[str] = set()
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if skip_nested and isinstance(node, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef,
+                                                 ast.Lambda)):
+                continue
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"
+                    and isinstance(node.func.value, ast.Name)):
+                names.add(node.func.value.id)
+            stack.extend(ast.iter_child_nodes(node))
+        return names
+
+    def _blocked_names(self, ctx: FileContext) -> set[str]:
+        cache = getattr(ctx, "_gt015_scopes", None)
+        if cache is None:
+            cache = ctx._gt015_scopes = {}
+        fi = ctx.current_func
+        if fi is None:
+            if "module" not in cache:
+                cache["module"] = self._scan_blocked(ctx.tree,
+                                                     skip_nested=True)
+            return cache["module"]
+        key = id(fi.node)
+        if key not in cache:
+            cache[key] = self._scan_blocked(fi.node, skip_nested=True)
+        return cache[key]
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        if ctx.path.replace("\\", "/").endswith("query/readback.py"):
+            return  # the helpers ARE the blessed readback point
+        d = dotted_name(node.func)
+        if d not in _READBACK_CALLS or not node.args:
+            return
+        arg = node.args[0]
+        if not isinstance(arg, ast.Name):
+            return
+        if arg.id in self._blocked_names(ctx):
+            ctx.report(self, node,
+                       f"{d}({arg.id}) reads the whole device buffer "
+                       "back unattributed; use query/readback."
+                       "read_full (or read_delta for a since-cursor "
+                       "slice) so the bytes land on "
+                       "gtpu_readback_bytes_total")
+
+
 @register
 class MutableDefaultArg(Rule):
     id = "GT010"

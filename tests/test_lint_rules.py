@@ -35,7 +35,7 @@ def rules_hit(src: str, select: str | None = None):
 
 def test_registry_has_all_rules():
     ids = sorted(all_rules())
-    assert ids == [f"GT{n:03d}" for n in range(1, 15)]
+    assert ids == [f"GT{n:03d}" for n in range(1, 16)]
     for rule in all_rules().values():
         assert rule.name and rule.description
 
@@ -638,6 +638,64 @@ def test_gt014_negative_host_scope_and_lowercase_receiver():
             y = acc.set(1)
             return x.inc() + y.observe()
     """, select="GT014") == []
+
+
+# ---------------------------------------------------------------------------
+# GT015 full-buffer readback on a device result buffer
+# ---------------------------------------------------------------------------
+
+def test_gt015_positive_asarray_and_device_get():
+    hits = rules_hit("""
+        import numpy as np
+
+        def run(program, arrs):
+            out = program(arrs)
+            out.block_until_ready()
+            host = np.asarray(out)
+            return host
+    """, select="GT015")
+    assert hits == [("GT015", 7)]
+    hits = rules_hit("""
+        import jax
+
+        def run(program, arrs):
+            packed = program(arrs)
+            packed.block_until_ready()
+            return jax.device_get(packed)
+    """, select="GT015")
+    assert hits == [("GT015", 7)]
+
+
+def test_gt015_negative_helper_and_host_arrays():
+    # readback through the blessed helpers is the intended idiom
+    assert rules_hit("""
+        from greptimedb_tpu.query import readback
+
+        def run(program, arrs, j0):
+            out = program(arrs)
+            out.block_until_ready()
+            return readback.read_delta(out, j0, axis=-1)
+    """, select="GT015") == []
+    # np.asarray on a plain host value (no block_until_ready) is fine
+    assert rules_hit("""
+        import numpy as np
+
+        def convert(vals):
+            arr = np.asarray(vals)
+            return arr
+    """, select="GT015") == []
+    # a DIFFERENT function's device buffer does not taint this one
+    assert rules_hit("""
+        import numpy as np
+
+        def a(program, arrs):
+            out = program(arrs)
+            out.block_until_ready()
+            return out
+
+        def b(out):
+            return np.asarray(out)
+    """, select="GT015") == []
 
 
 def test_suppression_same_line():
