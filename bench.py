@@ -1825,11 +1825,12 @@ class _KeepAliveConn:
 
 def _dash_counter(name: str, *labels) -> float:
     # importing the defining modules first pins each metric's label
-    # schema (the registry is get-or-create by name)
+    # schema; get() is the lookup API (re-declaring a labelled metric
+    # with a different label set raises MetricRegistrationError)
     from greptimedb_tpu.query import readback, result_cache  # noqa: F401
     from greptimedb_tpu.telemetry.metrics import global_registry
 
-    return global_registry.counter(name).labels(*labels).value
+    return global_registry.get(name).labels(*labels).value
 
 
 def _dash_panels(table: str) -> list[str]:
@@ -2221,6 +2222,260 @@ def _measure(inst, query, *, result_elems: int, runs: int,
                        runs=runs)
 
 
+# ---------------------------------------------------------------------------
+# memwatch: dashboard-poll + ingest soak against the memory accountant
+# (ISSUE 11). Leak gate: unaccounted device bytes < 5% of accounted and
+# non-growing across rounds. Pressure gate: a [memory]
+# device_budget_bytes configured BELOW the sum of the individual pool
+# budgets is enforced via cross-pool eviction. Overhead gate: the
+# accounting layer costs <= 3% on the warm poll loop vs disabled.
+# ---------------------------------------------------------------------------
+
+MEMW_HOSTS = 64
+MEMW_CELLS = 720
+MEMW_ROUNDS = 8             # soak rounds (each: polls + ingest + census)
+MEMW_LEAK_FRACTION = 0.05   # unaccounted must stay under 5% of accounted
+MEMW_OVERHEAD_PCT = 3.0
+MEMW_GROW_SLACK = 256 * 1024  # jit-constant noise allowance (bytes)
+
+
+def _memw_cross_evicted() -> float:
+    from greptimedb_tpu.telemetry.metrics import global_registry
+
+    m = global_registry.get("gtpu_mem_cross_pool_evicted_bytes_total")
+    return sum(c.value for _k, c in m._snapshot())
+
+
+def memwatch_probe(base_dir: str | None = None):
+    import gc
+    import os
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import urllib.request
+
+    from greptimedb_tpu.instance import Standalone
+    from greptimedb_tpu.promql.engine import PromEngine
+    from greptimedb_tpu.servers.http import HttpServer
+    from greptimedb_tpu.telemetry import memory as _memory
+
+    _assert_sanitizer_off()
+    acct = _memory.global_accountant
+    tmp = base_dir or _tempfile.mkdtemp(prefix="gtpu_memw_")
+    own_tmp = base_dir is None
+    inst = Standalone(os.path.join(tmp, "data"), prefer_device=True,
+                      warm_start=False)
+    srv = HttpServer(inst, port=0).start()
+    rng = np.random.default_rng(29)
+    try:
+        # ---- seed: two RANGE tables + one promql metric table -------
+        tables = {}
+        for name in ("mw_a", "mw_b"):
+            inst.execute_sql(
+                f"create table {name} (ts timestamp time index, "
+                "hostname string primary key, v1 double, v2 double)"
+            )
+            t = inst.catalog.table("public", name)
+            ts = np.tile(
+                np.arange(MEMW_CELLS, dtype=np.int64) * DASH_INTERVAL_MS,
+                MEMW_HOSTS,
+            )
+            hs = np.repeat(np.asarray(
+                [f"host_{i}" for i in range(MEMW_HOSTS)], object
+            ), MEMW_CELLS)
+            t.write({"hostname": hs}, ts, {
+                "v1": rng.random(len(ts)) * 100.0,
+                "v2": rng.random(len(ts)) * 10.0,
+            }, skip_wal=True)
+            tables[name] = t
+        inst.execute_sql(
+            "create table mw_prom (ts timestamp time index, "
+            "host string primary key, greptime_value double)"
+        )
+        tprom = inst.catalog.table("public", "mw_prom")
+        n_prom = MEMW_CELLS
+        pts = np.tile(np.arange(n_prom, dtype=np.int64) * 15_000, 8)
+        phs = np.repeat(np.asarray(
+            [f"h{i}" for i in range(8)], object), n_prom)
+        tprom.write({"host": phs}, pts, {
+            "greptime_value": np.cumsum(
+                rng.uniform(0, 5, len(pts))
+            ).astype(np.float64),
+        }, skip_wal=True)
+        prom_end = int(pts.max())
+        peng = PromEngine(inst)
+
+        conn = _KeepAliveConn(srv.port)
+        panels = _dash_panels("mw_a") + _dash_panels("mw_b")
+        watermark = MEMW_CELLS * DASH_INTERVAL_MS
+
+        def poll_round():
+            for q in panels:
+                doc = conn.sql(q, since=watermark - 60_000)
+                assert doc["output"], q
+            peng.query_range(
+                "sum by (host) (rate(mw_prom[1m]))",
+                120_000, prom_end, 30_000,
+            )
+
+        def scrape(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}{path}", timeout=30
+            ) as r:
+                return r.read().decode()
+
+        poll_round()  # build grids/sessions + compile before measuring
+        assert inst.query_engine.last_exec_path == "device"
+
+        # ---- overhead: warm poll loop, accounting on vs off ---------
+        def timed_polls(n=4):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                poll_round()
+            return time.perf_counter() - t0
+
+        on_t, off_t = [], []
+        for _ in range(3):
+            acct.enabled = False
+            acct.census_on_scrape = False
+            off_t.append(timed_polls())
+            acct.enabled = True
+            acct.census_on_scrape = True
+            on_t.append(timed_polls())
+        overhead_pct = (min(on_t) - min(off_t)) / min(off_t) * 100.0
+
+        # ---- leak-gate soak: polls + ingest, census each round ------
+        rounds = []
+        pool_peaks: dict[str, int] = {}
+        ing_rows = 0
+        for r in range(MEMW_ROUNDS):
+            # ingest: new data lands on both tables (version bumps ->
+            # grid rebuilds -> the OLD entries and their session
+            # buffers must actually free, or unaccounted/accounted
+            # bytes grow round over round)
+            ts0 = (MEMW_CELLS + r * 30) * DASH_INTERVAL_MS
+            ts = np.tile(
+                ts0 + np.arange(30, dtype=np.int64) * DASH_INTERVAL_MS,
+                MEMW_HOSTS,
+            )
+            hs = np.repeat(np.asarray(
+                [f"host_{i}" for i in range(MEMW_HOSTS)], object
+            ), 30)
+            for t in tables.values():
+                t.write({"hostname": hs}, ts, {
+                    "v1": rng.random(len(ts)) * 100.0,
+                    "v2": rng.random(len(ts)) * 10.0,
+                }, skip_wal=True)
+                ing_rows += len(ts)
+            poll_round()
+            gc.collect()
+            c = acct.census()
+            for st in acct.snapshot():
+                if st.tier == "device":
+                    pool_peaks[st.name] = max(
+                        pool_peaks.get(st.name, 0), st.bytes
+                    )
+            rounds.append((c["accounted_bytes"],
+                           c["unaccounted_bytes"]))
+            print(f"# memwatch round {r}: accounted="
+                  f"{c['accounted_bytes']} unaccounted="
+                  f"{c['unaccounted_bytes']}", file=sys.stderr)
+        accounted, unaccounted = rounds[-1]
+        leak_fraction = unaccounted / max(accounted, 1)
+        assert leak_fraction < MEMW_LEAK_FRACTION, (
+            f"unaccounted device bytes {unaccounted} are "
+            f"{leak_fraction:.1%} of accounted {accounted} "
+            f"(gate {MEMW_LEAK_FRACTION:.0%})"
+        )
+        # non-growing: after the warmup rounds (jit constants settle),
+        # the unaccounted residue must be flat
+        early = rounds[len(rounds) // 2][1]
+        assert unaccounted <= early + MEMW_GROW_SLACK, (
+            f"unaccounted device bytes grew {early} -> {unaccounted} "
+            "across the soak (leak)"
+        )
+
+        # ---- unified surfaces agree ---------------------------------
+        hbm = json.loads(scrape("/debug/prof/hbm?format=json&top=5"))
+        hbm_pools = {p["pool"] for p in hbm["pools"]}
+        for name in ("range_grid", "sessions", "promql_grid",
+                     "trace_ring"):
+            assert name in hbm_pools, (name, sorted(hbm_pools))
+        census_sum = sum(
+            p.get("census_bytes", 0) for p in hbm["pools"]
+            if p["tier"] == "device"
+        )
+        assert census_sum == hbm["census"]["accounted_bytes"]
+        rows = inst.sql(
+            "select pool from information_schema.memory_pools"
+        ).rows()
+        assert {r[0] for r in rows} >= hbm_pools
+
+        # ---- pressure: global watermark below the pool-budget sum ---
+        base_bytes = acct.device_bytes()
+        pool_budget_sum = sum(
+            st.budget_bytes for st in acct.snapshot()
+            if st.tier == "device"
+        )
+        budget = max(base_bytes // 2, 1 << 20)
+        assert budget < pool_budget_sum
+        cross0 = _memw_cross_evicted()
+        _memory.configure({"device_budget_bytes": budget})
+        over = []
+        for _ in range(2):
+            poll_round()
+            over.append(acct.device_bytes())
+        cross_evicted = _memw_cross_evicted() - cross0
+        assert cross_evicted > 0, (
+            "cross-pool eviction never fired under the watermark"
+        )
+        assert max(over) <= budget, (
+            f"device pool bytes {max(over)} exceeded the "
+            f"{budget} watermark"
+        )
+        assert overhead_pct <= MEMW_OVERHEAD_PCT, (
+            f"accounting overhead {overhead_pct:.2f}% exceeds "
+            f"{MEMW_OVERHEAD_PCT}%"
+        )
+
+        doc = {
+            "metric": "memwatch_unaccounted_fraction",
+            "value": round(leak_fraction, 5),
+            "unit": "fraction",
+            "accounted_bytes": int(accounted),
+            "unaccounted_bytes": int(unaccounted),
+            "accounting_overhead_pct": round(overhead_pct, 2),
+            "device_budget_bytes": int(budget),
+            "pool_budget_sum_bytes": int(pool_budget_sum),
+            "device_bytes_under_pressure": int(max(over)),
+            "cross_pool_evicted_bytes": int(cross_evicted),
+            "ingested_rows": int(ing_rows),
+            "rounds": MEMW_ROUNDS,
+            "pool_peak_bytes": {
+                k: int(v) for k, v in sorted(pool_peaks.items())
+            },
+        }
+        print(json.dumps(doc, separators=(",", ":")))
+        print(json.dumps({**doc, "summary": {
+            "memwatch_unaccounted_fraction": {"v": doc["value"]},
+            "memwatch_accounting_overhead_pct": {
+                "v": doc["accounting_overhead_pct"]},
+            "memwatch_cross_pool_evicted_bytes": {
+                "v": doc["cross_pool_evicted_bytes"]},
+            "memwatch_device_bytes_under_pressure": {
+                "v": doc["device_bytes_under_pressure"]},
+            "memwatch_pool_peak_bytes": {"v": doc["pool_peak_bytes"]},
+        }}, separators=(",", ":")))
+        conn.close()
+    finally:
+        acct.device_budget_bytes = 0
+        acct.enabled = True
+        acct.census_on_scrape = True
+        srv.stop()
+        inst.close()
+        if own_tmp:
+            _shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _measure_fn(run, *, label: str, result_elems: int, runs: int):
     """(adjusted ms, raw wall median ms, floor median ms) for a callable.
 
@@ -2274,5 +2529,7 @@ if __name__ == "__main__":
         dashboard_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "multichip":
         multichip_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "memwatch":
+        memwatch_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
     else:
         main()

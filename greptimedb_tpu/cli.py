@@ -370,6 +370,10 @@ def _make_instance(opts):
     from greptimedb_tpu.telemetry import tracing as _tracing
 
     _tracing.configure(opts.section("tracing"))
+    # [memory] knobs: global device watermark + census cadence
+    from greptimedb_tpu.telemetry import memory as _memory
+
+    _memory.configure(opts.section("memory"))
     prefer_device = opts.get("query.prefer_device")
     inst = Standalone(
         mesh=mesh, mesh_opts=mesh_opts,
@@ -536,9 +540,11 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst,
 
 
 def _start_frontend(opts):
+    from greptimedb_tpu.telemetry import memory as _memory
     from greptimedb_tpu.telemetry import tracing as _tracing
 
     _tracing.configure(opts.section("tracing"))
+    _memory.configure(opts.section("memory"))
     meta_addr = opts.get("metasrv.addr") or ""
     if meta_addr:
         # distributed frontend: catalog in the metasrv kv, regions on

@@ -170,6 +170,20 @@ DEFAULTS: dict = {
         "bytes": 268435456,
         "validate_interval_ms": 0.0,
     },
+    # unified memory observability (telemetry/memory.py): every
+    # byte-budgeted pool (device grid/session caches, host scan/result/
+    # page caches, trace ring, ingest queues) registers with one
+    # process-wide accountant. device_budget_bytes > 0 adds a GLOBAL
+    # HBM watermark below the sum of individual pool budgets, enforced
+    # by demand-driven proportional eviction across the device pools;
+    # census_on_scrape reconciles owner-tagged buffers against
+    # jax.live_arrays() on every /metrics render so
+    # gtpu_mem_unaccounted_device_bytes is an always-on leak detector
+    "memory": {
+        "enable": True,
+        "device_budget_bytes": 0,   # 0 = per-pool budgets only
+        "census_on_scrape": True,
+    },
     "logging": {
         "level": "info",
         # statements slower than threshold land in the slow-query log +

@@ -118,6 +118,14 @@ class ScanCache:
         self._lock = concurrency.Lock()
         self._entries: OrderedDict[tuple, ScanEntry] = OrderedDict()
         self._bytes = 0
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.register_pool(
+            "scan_cache", "host", self, stats=ScanCache._mem_stats
+        )
 
     # ------------------------------------------------------------------
     def get(self, key: tuple, current_versions: tuple) -> ScanEntry | None:
@@ -125,15 +133,18 @@ class ScanCache:
             e = self._entries.get(key)
             if e is None:
                 _MISSES.inc()
+                self._misses += 1
                 return None
             if e.data_versions != current_versions:
                 # a region's data changed since this entry was built:
                 # it can never be served again — release it now
                 self._drop_locked(key, e)
                 _MISSES.inc()
+                self._misses += 1
                 return None
             self._entries.move_to_end(key)
             _HITS.inc()
+            self._hits += 1
             return e
 
     def put(self, key: tuple, entry: ScanEntry) -> None:
@@ -173,7 +184,18 @@ class ScanCache:
         self._entries.pop(key, None)
         self._bytes -= entry.nbytes
         _EVICTIONS.inc()
+        self._evictions += 1
         self._publish_locked()
+
+    def _mem_stats(self) -> dict:
+        with self._lock:
+            return {
+                "bytes": self._bytes,
+                "entries": len(self._entries),
+                "budget_bytes": self.max_bytes,
+                "hits": self._hits, "misses": self._misses,
+                "evictions": self._evictions,
+            }
 
     def _publish_locked(self) -> None:
         _BYTES.set(float(self._bytes))

@@ -349,6 +349,34 @@ def _traces_doc(inst) -> dict[str, list]:
     return rows
 
 
+def _memory_pools_doc(inst) -> dict[str, list]:
+    """The process-wide memory accountant's ledger, one row per
+    registered pool (telemetry/memory.py — the SQL face of
+    /debug/prof/hbm). Device pools additionally carry their live-
+    buffer-census bytes; the census residue rides the
+    gtpu_mem_unaccounted_device_bytes gauge in runtime_metrics."""
+    from greptimedb_tpu.telemetry import memory as _memory
+
+    doc = _memory.hbm_report(top=0)
+    rows = {"pool": [], "tier": [], "bytes": [], "entries": [],
+            "budget_bytes": [], "max_entries": [], "hits": [],
+            "misses": [], "evictions": [], "census_bytes": [],
+            "instances": []}
+    for p in doc["pools"]:
+        rows["pool"].append(p["pool"])
+        rows["tier"].append(p["tier"])
+        rows["bytes"].append(p["bytes"])
+        rows["entries"].append(p["entries"])
+        rows["budget_bytes"].append(p["budget_bytes"])
+        rows["max_entries"].append(p["max_entries"])
+        rows["hits"].append(p["hits"])
+        rows["misses"].append(p["misses"])
+        rows["evictions"].append(p["evictions"])
+        rows["census_bytes"].append(int(p.get("census_bytes", 0)))
+        rows["instances"].append(p["instances"])
+    return rows
+
+
 _PROVIDERS = {
     "tables": _tables_doc,
     "columns": _columns_doc,
@@ -369,6 +397,7 @@ _PROVIDERS = {
     "collations": _collations_doc,
     "slow_queries": _slow_queries_doc,
     "traces": _traces_doc,
+    "memory_pools": _memory_pools_doc,
 }
 
 

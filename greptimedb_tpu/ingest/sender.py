@@ -78,6 +78,26 @@ _RECONNECTS = global_registry.counter(
 )
 
 
+def _entry_nbytes(entry) -> int:
+    """Host bytes pinned by one queued IngestEntry (array payloads plus
+    a flat per-row estimate for object-dtype tag columns), memoized on
+    the entry so the accountant's queue walk stays cheap."""
+    cached = getattr(entry, "_nbytes", None)
+    if cached is not None:
+        return cached
+    n = int(entry.ts.nbytes)
+    for col in (entry.tag_columns, entry.fields,
+                entry.field_valid or {}):
+        for v in col.values():
+            nb = getattr(v, "nbytes", None)
+            if nb is None or getattr(v, "dtype", None) == object:
+                n += 64 * entry.rows
+            else:
+                n += int(nb)
+    entry._nbytes = n
+    return n
+
+
 def _ack_error(ack: dict) -> GreptimeError | None:
     if not ack.get("error"):
         return None
@@ -119,10 +139,26 @@ class DatanodeSender:
         self._closed = False
         self._last_send = time.monotonic()
         self._delay = AdaptiveDelay(config.max_delay_s)
+        self._sheds = 0
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.register_pool(
+            "ingest_queue", "host", self,
+            stats=DatanodeSender._mem_stats,
+        )
         self._worker = concurrency.Thread(
             target=self._run, daemon=True, name=f"ingest-{self.addr}"
         )
         self._worker.start()
+
+    def _mem_stats(self) -> dict:
+        with self._cv:
+            return {
+                "bytes": sum(_entry_nbytes(e) for e in self._queue),
+                "entries": self._queued_rows,
+                "max_entries": self.cfg.queue_max_rows,
+                "evictions": self._sheds,
+            }
 
     # ---- accepting edge ----------------------------------------------
     def _pending_rows(self) -> int:
@@ -145,6 +181,7 @@ class DatanodeSender:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._cv.wait(remaining):
                     _SHED.labels(self.addr).inc()
+                    self._sheds += 1
                     raise IngestOverloadedError(
                         f"ingest queue for datanode {self.addr} is "
                         f"full ({self.cfg.queue_max_rows} rows) and did "

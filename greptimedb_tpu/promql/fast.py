@@ -117,6 +117,17 @@ class SelectorGridCache:
     def __init__(self):
         self._entries: dict[tuple, _Entry] = {}
         self._lock = concurrency.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.register_pool(
+            "promql_grid", "device", self,
+            stats=SelectorGridCache._mem_stats,
+            evict=SelectorGridCache.evict_bytes,
+            buffers=SelectorGridCache._device_buffers,
+        )
 
     def get_entry(self, table, fieldname: str, mesh=None,
                   mesh_opts=None) -> _Entry | None:
@@ -126,7 +137,9 @@ class SelectorGridCache:
             e = self._entries.get(key)
             if e is not None and e.table is table and e.version == version:
                 e.last_used = time.monotonic()
+                self._hits += 1
                 return e
+            self._misses += 1
         e = _build_entry(table, fieldname, version, mesh=mesh,
                          mesh_opts=mesh_opts)
         if e is None:
@@ -138,16 +151,19 @@ class SelectorGridCache:
             self._entries[key] = e
             e.last_used = time.monotonic()
             self._evict_locked(keep=key)
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.note_device_bytes()
         return e
 
-    @staticmethod
-    def _release(entry: "_Entry"):
+    def _release(self, entry: "_Entry"):
         """Drop the entry's session-resident result buffers with it: a
         freed _Entry's id() can be reused by a new entry whose version
         coincides, and the packed buffers would otherwise pin HBM until
         unrelated LRU pressure (query/sessions.py purge contract)."""
         from greptimedb_tpu.query import sessions as _sessions
 
+        self._evictions += 1
         _sessions.global_sessions.purge_table(("promql", id(entry)))
 
     def _evict_locked(self, keep):
@@ -178,6 +194,70 @@ class SelectorGridCache:
                 k for k, e in self._entries.items() if e.table is table
             ]:
                 self._release(self._entries.pop(key))
+
+    # ------------------------------------------------------------------
+    # memory accountant surface (telemetry/memory.py)
+    # ------------------------------------------------------------------
+    def _mem_stats(self) -> dict:
+        from greptimedb_tpu.telemetry.memory import iter_device_arrays
+
+        with self._lock:
+            total = 0
+            seen: set[int] = set()
+            for e in self._entries.values():
+                total += e.nbytes
+                # derived per-query device inputs (match masks, group
+                # ids, window indices) pinned on the entry count too —
+                # the global watermark must see every resident byte
+                # (same arrays the census enumerates)
+                for cname in ("match_cache", "group_cache",
+                              "win_cache"):
+                    for v in list((getattr(e, cname, None) or {})
+                                  .values()):
+                        for arr in iter_device_arrays(v):
+                            if id(arr) not in seen:
+                                seen.add(id(arr))
+                                total += int(arr.nbytes)
+            return {
+                "bytes": total,
+                "entries": len(self._entries),
+                "budget_bytes": _budget_bytes(),
+                "hits": self._hits, "misses": self._misses,
+                "evictions": self._evictions,
+            }
+
+    def evict_bytes(self, target: int) -> int:
+        """Shed least-recently-used grids until `target` bytes are
+        freed (cross-pool pressure from the global device watermark)."""
+        freed = 0
+        with self._lock:
+            for key, e in sorted(
+                self._entries.items(), key=lambda kv: kv[1].last_used
+            ):
+                if freed >= target:
+                    break
+                self._release(self._entries.pop(key))
+                freed += e.nbytes
+        return freed
+
+    def _device_buffers(self):
+        from greptimedb_tpu.telemetry.memory import iter_device_arrays
+
+        out = []
+        with self._lock:
+            for key, e in self._entries.items():
+                tag = f"promql:{e.fieldname}"
+                for arr in (e.vals, e.has, e.tsg):
+                    if arr is not None:
+                        out.append((arr, tag))
+                # derived per-query device inputs (match masks, group
+                # ids, window indices) pinned on the entry
+                for cname in ("match_cache", "group_cache", "win_cache"):
+                    cache = getattr(e, cname, None) or {}
+                    for v in list(cache.values()):
+                        for arr in iter_device_arrays(v):
+                            out.append((arr, f"{tag}:{cname}"))
+        return out
 
 
 _CACHE = SelectorGridCache()

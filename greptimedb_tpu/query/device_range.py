@@ -160,9 +160,19 @@ class DeviceRangeCache:
         self._entries: dict[tuple, _Entry] = {}
         self._lock = concurrency.Lock()
         self.byte_budget = byte_budget
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        from greptimedb_tpu.telemetry import memory as _memory
 
-    @staticmethod
-    def _release(entry: "_Entry"):
+        _memory.register_pool(
+            "range_grid", "device", self,
+            stats=DeviceRangeCache._mem_stats,
+            evict=DeviceRangeCache.evict_bytes,
+            buffers=DeviceRangeCache._device_buffers,
+        )
+
+    def _release(self, entry: "_Entry"):
         """Drop the entry's session-resident result buffers with it
         (query/sessions.py): session keys embed id(entry), so a
         replaced/evicted grid entry's buffers could otherwise never be
@@ -170,6 +180,7 @@ class DeviceRangeCache:
         buffer per query shape until LRU byte pressure."""
         from greptimedb_tpu.query import sessions as _sessions
 
+        self._evictions += 1
         _sessions.global_sessions.purge_table(("range", id(entry)))
 
     def lookup_compatible(self, tkey, version, r0: int, align_to: int
@@ -189,12 +200,17 @@ class DeviceRangeCache:
                 if r0 % e.res == 0 and align_to % e.res == e.phase:
                     self._entries.pop(key)
                     self._entries[key] = e
+                    self._hits += 1
                     return e
+            self._misses += 1
         return None
 
     def insert(self, key: tuple, entry: _Entry):
         with self._lock:
             self._insert_locked(key, entry)
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.note_device_bytes()
 
     def _insert_locked(self, key: tuple, entry: _Entry):
         old = self._entries.pop(key, None)
@@ -222,7 +238,13 @@ class DeviceRangeCache:
             if any(k[0] == key[0] for k in self._entries):
                 return False
             self._insert_locked(key, entry)
-            return True
+        # warm-start restores grow the pool like any query-path insert:
+        # the global watermark applies from the first restored grid,
+        # not from the first later query
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.note_device_bytes()
+        return True
 
     def total_bytes(self) -> int:
         with self._lock:
@@ -251,6 +273,72 @@ class DeviceRangeCache:
             for e in self._entries.values():
                 self._release(e)
             self._entries.clear()
+
+    # ------------------------------------------------------------------
+    # memory accountant surface (telemetry/memory.py)
+    # ------------------------------------------------------------------
+    def _mem_stats(self) -> dict:
+        with self._lock:
+            total = 0
+            for e in self._entries.values():
+                total += e.bytes()
+                # per-query-shape gid/mask device inputs ride the
+                # entry (query_memo) but are outside recount_bytes'
+                # grid contract — the watermark must still see them
+                # (the census enumerates the same arrays)
+                for memo in list(e.query_memo.values()):
+                    for k in ("gid", "mask"):
+                        arr = memo.get(k)
+                        if arr is not None:
+                            total += int(getattr(arr, "nbytes", 0))
+            return {
+                "bytes": total,
+                "entries": len(self._entries),
+                "budget_bytes": self.byte_budget,
+                "max_entries": _MAX_ENTRIES,
+                "hits": self._hits, "misses": self._misses,
+                "evictions": self._evictions,
+            }
+
+    def evict_bytes(self, target: int) -> int:
+        """Shed LRU grid entries until `target` bytes are freed
+        (cross-pool pressure from the global device watermark)."""
+        freed = 0
+        with self._lock:
+            while freed < target and self._entries:
+                key = next(iter(self._entries))
+                victim = self._entries.pop(key)
+                self._release(victim)
+                freed += victim.bytes()
+        return freed
+
+    def _device_buffers(self):
+        out = []
+        with self._lock:
+            for key, e in self._entries.items():
+                tag = f"range:{key[0][0]}.{key[0][1]}"
+                seen = set()
+                for arr in (e.nrow, e.imin, e.imax):
+                    if arr is not None and id(arr) not in seen:
+                        seen.add(id(arr))
+                        out.append((arr, tag))
+                # list() snapshots: fields/query_memo grow under the
+                # entry's grow_lock / query path, not this cache lock
+                for fname, d in list(e.fields.items()):
+                    for arr in list(d.values()):
+                        if id(arr) not in seen:
+                            seen.add(id(arr))
+                            out.append((arr, f"{tag}:{fname}"))
+                # per-query-shape device inputs (gid/mask uploads) the
+                # steady state keeps resident — without owner tags the
+                # census would read them as leaks
+                for memo in list(e.query_memo.values()):
+                    for k in ("gid", "mask"):
+                        arr = memo.get(k)
+                        if arr is not None and id(arr) not in seen:
+                            seen.add(id(arr))
+                            out.append((arr, f"{tag}:query_memo"))
+        return out
 
 
 # ----------------------------------------------------------------------
@@ -845,6 +933,56 @@ def _persist_program_specs(entry: _Entry, table) -> None:
         _log.debug("program-spec snapshot write skipped: %s", e)
 
 
+class _WarmScratch:
+    """Device buffers pinned by the warm-start precompile pass (the
+    zero sid/mask spec inputs each persisted program is re-invoked
+    with). They exist only while `precompile_programs` runs, but
+    without an owner tag every warm restart would read as a transient
+    device leak in the census — so they register as their own pool and
+    drop when the pass finishes."""
+
+    def __init__(self):
+        self._lock = concurrency.Lock()
+        self._bufs: dict[int, tuple] = {}   # id -> (arr, label)
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.register_pool(
+            "warm_precompile", "device", self,
+            stats=_WarmScratch._mem_stats,
+            buffers=_WarmScratch._device_buffers,
+        )
+
+    def hold(self, arr, label: str):
+        with self._lock:
+            self._bufs[id(arr)] = (arr, label)
+        return arr
+
+    def drop(self, *arrs):
+        with self._lock:
+            for arr in arrs:
+                self._bufs.pop(id(arr), None)
+
+    def _mem_stats(self) -> dict:
+        with self._lock:
+            return {
+                "bytes": sum(
+                    int(getattr(a, "nbytes", 0))
+                    for a, _ in self._bufs.values()
+                ),
+                "entries": len(self._bufs),
+            }
+
+    def _device_buffers(self):
+        with self._lock:
+            return [
+                (a, f"warm_precompile:{label}")
+                for a, label in self._bufs.values()
+            ]
+
+
+_WARM_SCRATCH = _WarmScratch()
+
+
 def precompile_programs(entry: _Entry, table) -> int:
     """Re-invoke the range program for every persisted spec with the
     restored grids (values are irrelevant — static spec + array
@@ -871,6 +1009,23 @@ def precompile_programs(entry: _Entry, table) -> int:
         _log.debug("prelude precompile skipped: %s", e)
     entry_mesh = getattr(entry, "mesh", None)
     _, put1 = _make_put(entry_mesh)
+    # spec inputs shared by every precompile invocation below: pinned
+    # (and owner-tagged) in the warm-scratch pool for the duration
+    label = f"{table.info.database}.{table.info.name}"
+    zero_sid = _WARM_SCRATCH.hold(
+        put1(np.zeros(entry.num_series, np.int32)), label
+    )
+    ones_mask = _WARM_SCRATCH.hold(
+        put1(np.ones(entry.num_series, bool)), label
+    )
+    try:
+        return _precompile_loop(entry, doc, entry_mesh, zero_sid,
+                                ones_mask, jnp)
+    finally:
+        _WARM_SCRATCH.drop(zero_sid, ones_mask)
+
+
+def _precompile_loop(entry, doc, entry_mesh, zero_sid, ones_mask, jnp):
     done = 0
     for s in doc:
         try:
@@ -906,8 +1061,8 @@ def precompile_programs(entry: _Entry, table) -> int:
                 program = get_sharded_program(entry_mesh)
             out = program(
                 arrs,
-                put1(np.zeros(entry.num_series, np.int32)),
-                put1(np.ones(entry.num_series, bool)),
+                zero_sid,
+                ones_mask,
                 jnp.int32(0), jnp.int32(-(2**31) + 1),
                 jnp.int32(2**31 - 1),
                 spec=spec,
@@ -1019,7 +1174,12 @@ def ensure_states(entry: _Entry, plan, table, items,
     if table.data_version() != entry.version:
         return False  # racing write; caller falls back / rebuilds later
     with entry.grow_lock:
-        return _ensure_states_locked(entry, plan, table, items, cache, jnp)
+        ok = _ensure_states_locked(entry, plan, table, items, cache, jnp)
+    if ok:
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.note_device_bytes()
+    return ok
 
 
 def _ensure_states_locked(entry, plan, table, items, cache, jnp) -> bool:

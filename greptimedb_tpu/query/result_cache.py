@@ -205,6 +205,14 @@ class ResultCache:
         # table key -> (monotonic_s, versions) snapshot for the
         # validate-interval path
         self._version_snap: dict = {}
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.register_pool(
+            "result_cache", "host", self, stats=ResultCache._mem_stats
+        )
 
     @classmethod
     def from_options(cls, options: dict | None) -> "ResultCache":
@@ -255,13 +263,16 @@ class ResultCache:
             e = self._entries.get(key)
             if e is None:
                 _MISSES.inc()
+                self._misses += 1
                 return None
             if e.versions != versions:
                 self._drop_locked(key, e)
                 _MISSES.inc()
+                self._misses += 1
                 return None
             self._entries.move_to_end(key)
             _HITS.inc()
+            self._hits += 1
             return e
 
     def put(self, db: str, table, fingerprint: str, versions, result,
@@ -306,6 +317,17 @@ class ResultCache:
         self._entries.pop(key, None)
         self._bytes -= entry.nbytes
         _EVICTIONS.inc()
+        self._evictions += 1
+
+    def _mem_stats(self) -> dict:
+        with self._lock:
+            return {
+                "bytes": self._bytes,
+                "entries": len(self._entries),
+                "budget_bytes": self.max_bytes if self.enabled else 0,
+                "hits": self._hits, "misses": self._misses,
+                "evictions": self._evictions,
+            }
 
     def _publish_locked(self) -> None:
         _BYTES.set(float(self._bytes))

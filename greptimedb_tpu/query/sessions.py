@@ -71,6 +71,19 @@ class SessionRegistry:
         # purge_table can drop a dropped table's buffers eagerly
         self._entries: OrderedDict[tuple, tuple] = OrderedDict()
         self._bytes = 0
+        # per-instance tallies for the memory accountant (the module
+        # metric counters above are process-wide)
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.register_pool(
+            "sessions", "device", self,
+            stats=SessionRegistry._mem_stats,
+            evict=SessionRegistry.evict_bytes,
+            buffers=SessionRegistry._device_buffers,
+        )
 
     # ------------------------------------------------------------------
     def get(self, tkey, shape_key, version):
@@ -81,15 +94,18 @@ class SessionRegistry:
             hit = self._entries.get(key)
             if hit is None:
                 _MISSES.inc()
+                self._misses += 1
                 return None
             if hit[0] != version:
                 # the table's data changed since this buffer was folded:
                 # it can never be served again — release the HBM now
                 self._drop_locked(key)
                 _MISSES.inc()
+                self._misses += 1
                 return None
             self._entries.move_to_end(key)
             _HITS.inc()
+            self._hits += 1
             return hit[1]
 
     def put(self, tkey, shape_key, version, buf, nbytes: int):
@@ -107,6 +123,11 @@ class SessionRegistry:
                     and len(self._entries) > 1:
                 self._drop_locked(next(iter(self._entries)))
             self._publish_locked()
+        # cross-pool pressure check OUTSIDE the lock: the global
+        # watermark may evict from OTHER pools (and re-enter this one)
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.note_device_bytes()
 
     # ------------------------------------------------------------------
     def purge_table(self, tkey) -> None:
@@ -130,7 +151,41 @@ class SessionRegistry:
         if ent is not None:
             self._bytes -= ent[2]
             _EVICTIONS.inc()
+            self._evictions += 1
         self._publish_locked()
+
+    # ------------------------------------------------------------------
+    # memory accountant surface (telemetry/memory.py)
+    # ------------------------------------------------------------------
+    def _mem_stats(self) -> dict:
+        with self._lock:
+            return {
+                "bytes": self._bytes,
+                "entries": len(self._entries),
+                "budget_bytes": self.max_bytes if self.enabled else 0,
+                "max_entries": _MAX_ENTRIES,
+                "hits": self._hits, "misses": self._misses,
+                "evictions": self._evictions,
+            }
+
+    def evict_bytes(self, target: int) -> int:
+        """Shed LRU entries until `target` bytes are freed (cross-pool
+        pressure from the global [memory] device_budget_bytes
+        watermark). Returns bytes actually freed."""
+        freed = 0
+        with self._lock:
+            while freed < target and self._entries:
+                key = next(iter(self._entries))
+                freed += self._entries[key][2]
+                self._drop_locked(key)
+        return freed
+
+    def _device_buffers(self):
+        with self._lock:
+            return [
+                (ent[1], f"sessions:{key[0]!r}")
+                for key, ent in self._entries.items()
+            ]
 
     def _publish_locked(self) -> None:
         _BYTES.set(float(self._bytes))

@@ -183,7 +183,7 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             "/v1/prometheus/read", "/v1/influxdb/", "/influxdb/",
             "/v1/events", "/v1/opentsdb/api/put", "/api/put",
             "/v1/otlp/v1/metrics", "/v1/traces", "/v1/traces/",
-            "/debug/prof/cpu", "/debug/prof/mem",
+            "/debug/prof/cpu", "/debug/prof/mem", "/debug/prof/hbm",
         )
 
         def _raw_path(self) -> str:
@@ -371,20 +371,48 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 except ValueError:
                     return self._error(400, "bad seconds")
                 stacks = pprof.sample_cpu(seconds)
-                if params.get("format", "text") == "collapsed":
+                fmt = params.get("format", "text")
+                if fmt == "collapsed":
                     body = pprof.render_collapsed(stacks)
+                elif fmt == "speedscope":
+                    return self._send(
+                        200,
+                        pprof.render_speedscope(stacks).encode(),
+                        "application/json",
+                    )
                 else:
                     body = pprof.render_report(stacks)
                 return self._send(200, body.encode(), "text/plain")
             if path == "/debug/prof/mem":
                 from greptimedb_tpu.telemetry import pprof
 
+                params = self._params()
                 try:
-                    top = int(self._params().get("top", "30"))
+                    top = int(params.get("top", "30"))
                 except ValueError:
                     return self._error(400, "bad top")
+                diff = params.get("diff", "0") not in ("0", "", "false")
                 return self._send(
-                    200, pprof.mem_profile(top).encode(), "text/plain"
+                    200, pprof.mem_profile(top, diff=diff).encode(),
+                    "text/plain",
+                )
+            if path == "/debug/prof/hbm":
+                # unified memory observability (telemetry/memory.py):
+                # per-pool bytes, top-N live device buffers with owner
+                # attribution, and the unaccounted leak residue
+                from greptimedb_tpu.telemetry import memory as _memory
+
+                params = self._params()
+                try:
+                    top = int(params.get("top", "10"))
+                except ValueError:
+                    return self._error(400, "bad top")
+                doc = _memory.hbm_report(top=top)
+                if params.get("format", "text") == "json":
+                    return self._json(200, doc)
+                return self._send(
+                    200, _memory.render_hbm_text(doc).encode(),
+                    "text/plain",
                 )
             if path == "/v1/sql":
                 return self._handle_sql()

@@ -340,6 +340,17 @@ class CachedObjectStore(ObjectStore):
             collections.OrderedDict()
         )
         self._bytes = 0
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        # bytes here live on local DISK, not RAM — registered all the
+        # same: it is a byte-budgeted pool and belongs on the one ledger
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.register_pool(
+            "object_store_cache", "host", self,
+            stats=CachedObjectStore._mem_stats,
+        )
         os.makedirs(cache_dir, exist_ok=True)
         # recover the cache index from disk (files named by path hash);
         # drop leftover .tmp files from interrupted writes
@@ -383,17 +394,30 @@ class CachedObjectStore(ObjectStore):
             while self._bytes > self.max_bytes and self._lru:
                 k, sz = self._lru.popitem(last=False)
                 self._bytes -= sz
+                self._evictions += 1
                 try:
                     os.remove(os.path.join(self.cache_dir, k))
                 except FileNotFoundError:
                     pass
 
+    def _mem_stats(self) -> dict:
+        with self._lock:
+            return {
+                "bytes": self._bytes,
+                "entries": len(self._lru),
+                "budget_bytes": self.max_bytes,
+                "hits": self._hits, "misses": self._misses,
+                "evictions": self._evictions,
+            }
+
     def _cache_get(self, path: str) -> bytes | None:
         key = self._key(path)
         with self._lock:
             if key not in self._lru:
+                self._misses += 1
                 return None
             self._lru.move_to_end(key)
+            self._hits += 1
         try:
             with open(os.path.join(self.cache_dir, key), "rb") as f:
                 return f.read()

@@ -27,6 +27,22 @@ class PageCache:
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.register_pool(
+            "page_cache", "host", self, stats=PageCache._mem_stats
+        )
+
+    def _mem_stats(self) -> dict:
+        with self._lock:
+            return {
+                "bytes": self._bytes,
+                "entries": len(self._entries),
+                "budget_bytes": self.capacity,
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions,
+            }
 
     def get(self, key: tuple):
         """-> (values, validity|None) or None."""
@@ -51,6 +67,7 @@ class PageCache:
             while self._bytes > self.capacity and self._entries:
                 _, (_, b) = self._entries.popitem(last=False)
                 self._bytes -= b
+                self.evictions += 1
 
     def put_free(self, key: tuple, value, nbytes: int) -> bool:
         """Install only while FREE budget remains — never evicts.

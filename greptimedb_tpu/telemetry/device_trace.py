@@ -91,4 +91,19 @@ class device_call:
         attrs[key] = int(attrs.get(key, 0)) + int(nbytes)
 
     def __exit__(self, exc_type, exc, tb):
+        sp = self._span
+        if sp is not None and sp.trace_id:
+            # per-query device-bytes attribution: the HBM pinned by the
+            # registered device pools at the moment this call finished
+            # (telemetry/memory.py ledger), so every device.* span on a
+            # trace shows what the chip was holding when it ran
+            from greptimedb_tpu.telemetry import memory as _memory
+
+            acct = _memory.global_accountant
+            if acct.enabled:
+                # TTL-cached: a burst of traced device calls must not
+                # take every pool's lock per span
+                sp.attributes["device_pool_bytes"] = (
+                    acct.device_bytes_cached()
+                )
         return self._cm.__exit__(exc_type, exc, tb)

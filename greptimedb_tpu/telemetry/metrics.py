@@ -12,6 +12,16 @@ import time
 
 from greptimedb_tpu import concurrency
 
+
+class MetricRegistrationError(TypeError):
+    """A metric name was re-registered as a different type or with a
+    different label set. The registry is get-or-create by name, so the
+    second registration used to silently return the FIRST metric — and
+    the caller's `.labels(...)` then raised (or mislabelled) far from
+    the actual bug. Raised at registration time instead, naming both
+    schemas."""
+
+
 class _Metric:
     def __init__(self, name: str, help_: str, label_names: tuple[str, ...]):
         self.name = name
@@ -179,22 +189,30 @@ class Histogram(_Metric):
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
         for key, c in self._snapshot():
+            # read counts/total/count under the child lock: a scrape
+            # racing observe() must never see a half-applied observation
+            # (low buckets bumped, high buckets not yet — a non-monotone
+            # cumulative family — or sum/count disagreeing with +Inf)
+            with c._lock:
+                counts = list(c.counts)
+                total = c.total
+                count = c.count
             # observe() increments every bucket with v <= bound, so counts
             # are already cumulative as the exposition format requires
-            for b, n in zip(self.buckets, c.counts):
+            for b, n in zip(self.buckets, counts):
                 lab = _fmt_labels(
                     self.label_names + ("le",), key + (repr(float(b)),)
                 )
                 out.append(f"{self.name}_bucket{lab} {n}")
             lab = _fmt_labels(self.label_names + ("le",), key + ("+Inf",))
-            out.append(f"{self.name}_bucket{lab} {c.count}")
+            out.append(f"{self.name}_bucket{lab} {count}")
             out.append(
                 f"{self.name}_sum{_fmt_labels(self.label_names, key)} "
-                f"{c.total}"
+                f"{total}"
             )
             out.append(
                 f"{self.name}_count{_fmt_labels(self.label_names, key)} "
-                f"{c.count}"
+                f"{count}"
             )
         return out
 
@@ -203,28 +221,78 @@ class MetricsRegistry:
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}
         self._lock = concurrency.Lock()
+        # scrape-time callbacks (run at the START of render, outside the
+        # registry lock): pull-model publishers — the memory accountant
+        # refreshes its per-pool gauges here so /metrics always shows
+        # current pool state without a background thread
+        self._collectors: list = []
 
     def counter(self, name, help_="", labels=()) -> Counter:
-        return self._get(name, lambda: Counter(name, help_, tuple(labels)))
+        return self._get(name, Counter, tuple(labels),
+                         lambda: Counter(name, help_, tuple(labels)))
 
     def gauge(self, name, help_="", labels=()) -> Gauge:
-        return self._get(name, lambda: Gauge(name, help_, tuple(labels)))
+        return self._get(name, Gauge, tuple(labels),
+                         lambda: Gauge(name, help_, tuple(labels)))
 
     def histogram(self, name, help_="", labels=(),
                   buckets=_DEFAULT_BUCKETS) -> Histogram:
         return self._get(
-            name, lambda: Histogram(name, help_, tuple(labels), buckets)
+            name, Histogram, tuple(labels),
+            lambda: Histogram(name, help_, tuple(labels), buckets)
         )
 
-    def _get(self, name, factory):
+    def _get(self, name, cls, label_names, factory):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = factory()
                 self._metrics[name] = m
-            return m
+                return m
+        # conflict checks OUTSIDE the lock (pure reads of immutable
+        # registration-time attributes)
+        if type(m) is not cls:
+            raise MetricRegistrationError(
+                f"metric {name!r} already registered as "
+                f"{type(m).__name__}, re-registered as {cls.__name__}"
+            )
+        if m.label_names != label_names:
+            raise MetricRegistrationError(
+                f"metric {name!r} already registered with labels "
+                f"{m.label_names!r}, re-registered with {label_names!r}"
+                " — use MetricsRegistry.get(name) for lookups"
+            )
+        return m
+
+    def get(self, name) -> _Metric:
+        """Look up an existing metric WITHOUT declaring its schema
+        (bench/test readers that only consume values). KeyError when
+        the metric has not been registered by its owning module yet."""
+        with self._lock:
+            m = self._metrics.get(name)
+        if m is None:
+            raise KeyError(f"metric {name!r} is not registered")
+        return m
+
+    def register_collector(self, fn) -> None:
+        """Add a scrape-time callback invoked before every render()."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
 
     def render(self) -> str:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - a broken publisher
+                # must never take /metrics down with it
+                import logging
+
+                logging.getLogger("greptimedb_tpu.metrics").debug(
+                    "metrics collector failed: %s", e
+                )
         with self._lock:
             metrics = list(self._metrics.values())
         lines = []

@@ -75,6 +75,45 @@ def render_collapsed(stacks: Counter) -> str:
     ) + ("\n" if stacks else "")
 
 
+def render_speedscope(stacks: Counter, name: str = "cpu") -> str:
+    """Speedscope file-format JSON (https://www.speedscope.app) from
+    collapsed stacks: one `sampled` profile aggregating every thread,
+    weights = sample counts. Drag the response onto speedscope (or
+    `speedscope profile.json`) for an interactive flamegraph."""
+    import json
+
+    frame_index: dict[str, int] = {}
+    frames: list[dict] = []
+    samples: list[list[int]] = []
+    weights: list[int] = []
+    for stack, count in stacks.most_common():
+        parts = stack.split(";")  # parts[0] is the thread name
+        idxs = []
+        for fr in parts:
+            i = frame_index.get(fr)
+            if i is None:
+                i = frame_index[fr] = len(frames)
+                frames.append({"name": fr})
+            idxs.append(i)
+        samples.append(idxs)
+        weights.append(count)
+    total = sum(weights)
+    return json.dumps({
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "shared": {"frames": frames},
+        "profiles": [{
+            "type": "sampled",
+            "name": name,
+            "unit": "none",
+            "startValue": 0,
+            "endValue": total,
+            "samples": samples,
+            "weights": weights,
+        }],
+        "exporter": "greptimedb-tpu pprof",
+    })
+
+
 def render_report(stacks: Counter, top: int = 40) -> str:
     """Aggregated self-time report (like `pprof -top`)."""
     total = sum(stacks.values())
@@ -102,11 +141,22 @@ def render_report(stacks: Counter, top: int = 40) -> str:
 # ----------------------------------------------------------------------
 
 _tracemalloc_lock = concurrency.Lock()
+# previous snapshot for the ?diff=1 mode: growth since the LAST
+# mem_profile call (either mode updates it), so two diff requests
+# bracket exactly the interval between them
+_last_snapshot = None
 
-def mem_profile(top: int = 30) -> str:
+
+def mem_profile(top: int = 30, diff: bool = False) -> str:
     """Top heap allocation sites. Starts tracemalloc on first use (the
     first call reports allocations made after it — like enabling jemalloc
-    profiling at runtime)."""
+    profiling at runtime).
+
+    diff=True reports top allocation-site GROWTH since the previous
+    snapshot instead of absolute bytes — the mode that finds a slow
+    host-side leak that absolute top-N hides under steady large
+    allocations."""
+    global _last_snapshot
     import tracemalloc
 
     with _tracemalloc_lock:
@@ -116,14 +166,45 @@ def mem_profile(top: int = 30) -> str:
                 "tracemalloc started; allocations are now being tracked.\n"
                 "Request this endpoint again to see a snapshot.\n"
             )
-    snap = tracemalloc.take_snapshot()
-    stats = snap.statistics("lineno")
+        snap = tracemalloc.take_snapshot()
+        prev, _last_snapshot = _last_snapshot, snap
+    top = max(1, min(int(top), 200))
     current, peak = tracemalloc.get_traced_memory()
-    lines = [
-        f"traced current={current / 1e6:.1f}MB peak={peak / 1e6:.1f}MB",
-        "", f"{'bytes':>12} {'count':>8}  site",
-    ]
-    for st in stats[:max(1, min(int(top), 200))]:
+    head = f"traced current={current / 1e6:.1f}MB peak={peak / 1e6:.1f}MB"
+    if diff:
+        if prev is None:
+            return (
+                head + "\nno previous snapshot; request again to see "
+                "allocation-site growth since this one.\n"
+            )
+        stats = snap.compare_to(prev, "lineno")
+        lines = [
+            head, "",
+            f"{'growth':>12} {'count+':>8}  site (since previous "
+            "snapshot)",
+        ]
+        shown = 0
+        # compare_to sorts by ABS(size_diff): a large deallocation can
+        # rank above every real growth site, so skip non-positive
+        # entries instead of stopping at the first one
+        for st in stats:
+            if st.size_diff <= 0:
+                continue
+            if shown >= top:
+                break
+            frame = st.traceback[0]
+            lines.append(
+                f"{st.size_diff:>+12} {st.count_diff:>+8}  "
+                f"{frame.filename.rsplit('/', 1)[-1]}:{frame.lineno}"
+            )
+            shown += 1
+        if shown == 0:
+            lines.append("(no allocation-site growth since the "
+                         "previous snapshot)")
+        return "\n".join(lines) + "\n"
+    stats = snap.statistics("lineno")
+    lines = [head, "", f"{'bytes':>12} {'count':>8}  site"]
+    for st in stats[:top]:
         frame = st.traceback[0]
         lines.append(
             f"{st.size:>12} {st.count:>8}  "

@@ -146,10 +146,32 @@ class _TraceStore:
         # writing — the LAST root out makes the final drop decision
         self._active: dict[str, int] = {}
         self.cap = cap
+        self.evicted_traces = 0
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        _memory.register_pool(
+            "trace_ring", "host", self, stats=_TraceStore._mem_stats
+        )
 
     # a client/proxy bug resending one traceparent forever must not
     # grow a single trace unboundedly
     MAX_SPANS_PER_TRACE = 512
+
+    # flat per-span host-byte estimate for the memory accountant (a
+    # Span dataclass + ids + a small attribute dict; exact accounting
+    # would walk every attribute on every scrape)
+    SPAN_EST_BYTES = 512
+
+    def _mem_stats(self) -> dict:
+        with self._lock:
+            n_spans = sum(len(s) for s in self._spans.values())
+            return {
+                "bytes": n_spans * self.SPAN_EST_BYTES,
+                "entries": n_spans,
+                "max_entries": max(self.cap, 0)
+                * self.MAX_SPANS_PER_TRACE,
+                "evictions": self.evicted_traces,
+            }
 
     def set_cap(self, cap: int):
         with self._lock:
@@ -163,6 +185,7 @@ class _TraceStore:
             victim = self._order.pop(0)
             self._spans.pop(victim, None)
             self._kept.discard(victim)
+            self.evicted_traces += 1
 
     def record(self, span: Span):
         with self._lock:

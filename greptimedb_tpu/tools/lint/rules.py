@@ -796,6 +796,162 @@ class FullBufferReadback(Rule):
                        "gtpu_readback_bytes_total")
 
 
+# byte-budget attribute/value tokens: the LRU-with-byte-budget idiom
+# assigns self.max_bytes / self.byte_budget / self.capacity =
+# capacity_bytes / ... in __init__. Entry-count-only containers
+# (capacity without "byte" anywhere) are not byte pools.
+_GT016_BUDGET_TOKENS = ("max_bytes", "byte_budget", "budget_bytes",
+                        "capacity_bytes", "hbm_bytes")
+_GT016_DEVICE_PUTS = ("device_put", "asarray")
+
+
+@register
+class UnregisteredMemoryPool(Rule):
+    id = "GT016"
+    name = "unregistered-memory-pool"
+    description = (
+        "A byte-budgeted container (a class assigning a byte budget "
+        "AND an entries dict, or a module-level dict cache holding "
+        "device arrays) that never registers with the process-wide "
+        "memory accountant (telemetry/memory.py register_pool) is an "
+        "invisible memory pool: its bytes appear in no unified "
+        "surface, the device census reads its buffers as leaks, and "
+        "the global [memory] device_budget_bytes watermark cannot "
+        "evict from it."
+    )
+
+    @staticmethod
+    def _is_exempt(ctx: FileContext) -> bool:
+        # the accountant itself is not a pool
+        return ctx.path.replace("\\", "/").endswith(
+            "telemetry/memory.py"
+        )
+
+    @staticmethod
+    def _self_attr_target(node):
+        """The attribute name of a `self.X = ...` / `self.X: T = ...`
+        assignment, else None."""
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgt = node.target
+        else:
+            return None
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            return tgt.attr
+        return None
+
+    @staticmethod
+    def _is_dict_value(node) -> bool:
+        value = (node.value if isinstance(node, (ast.Assign,
+                                                 ast.AnnAssign))
+                 else None)
+        if isinstance(value, ast.Dict):
+            return True
+        if isinstance(value, ast.Call):
+            f = dotted_name(value.func)
+            return f is not None and f.split(".")[-1] in (
+                "dict", "OrderedDict"
+            )
+        return False
+
+    def _budget_assign(self, node) -> bool:
+        attr = self._self_attr_target(node)
+        if attr is None:
+            return False
+        low = attr.lstrip("_").lower()
+        if any(tok in low for tok in _GT016_BUDGET_TOKENS):
+            return True
+        value = node.value
+        return any(
+            isinstance(n, ast.Name)
+            and any(tok in n.id.lower() for tok in _GT016_BUDGET_TOKENS)
+            for n in ast.walk(value)
+        )
+
+    def visit_ClassDef(self, node: ast.ClassDef, ctx: FileContext):
+        if self._is_exempt(ctx):
+            return
+        has_budget = False
+        has_container = False
+        registers = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                f = dotted_name(sub.func)
+                if f and f.split(".")[-1] == "register_pool":
+                    registers = True
+                    break
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                if self._self_attr_target(sub) is not None:
+                    if self._is_dict_value(sub):
+                        has_container = True
+                    if self._budget_assign(sub):
+                        has_budget = True
+        if has_budget and has_container and not registers:
+            ctx.report(self, node,
+                       f"class {node.name} holds a byte-budgeted "
+                       "entries container but never calls "
+                       "memory.register_pool(); register it so its "
+                       "bytes land on gtpu_mem_* and the device "
+                       "census/global watermark can see it")
+
+    def visit_Module(self, node: ast.Module, ctx: FileContext):
+        """Module-level dict caches holding device arrays: a
+        `_GRIDS = {}` that gets `_GRIDS[k] = jax.device_put(...)` /
+        `jnp.asarray(...)` somewhere in the module pins HBM outside
+        any class — the accountant must know about it too."""
+        if self._is_exempt(ctx):
+            return
+        module_dicts: set[str] = set()
+        for stmt in node.body:
+            name = None
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                name = stmt.targets[0].id
+            elif (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and stmt.value is not None):
+                name = stmt.target.id
+            if name is not None and self._is_dict_value(stmt):
+                module_dicts.add(name)
+        if not module_dicts:
+            return
+        registers = any(
+            isinstance(sub, ast.Call)
+            and (dotted_name(sub.func) or "").split(".")[-1]
+            == "register_pool"
+            for sub in ast.walk(node)
+        )
+        if registers:
+            return
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Assign)
+                    and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Subscript)):
+                continue
+            base = sub.targets[0].value
+            if not (isinstance(base, ast.Name)
+                    and base.id in module_dicts):
+                continue
+            holds_device = any(
+                isinstance(n, ast.Call)
+                and (dotted_name(n.func) or "").split(".")[-1]
+                in _GT016_DEVICE_PUTS
+                and (dotted_name(n.func) or "").split(".")[0]
+                in ("jax", "jnp")
+                for n in ast.walk(sub.value)
+            )
+            if holds_device:
+                ctx.report(self, sub,
+                           f"module-level dict {base.id} caches device "
+                           "arrays but the module never calls "
+                           "memory.register_pool(); the census reads "
+                           "these buffers as unaccounted leaks")
+                return
+
+
 @register
 class MutableDefaultArg(Rule):
     id = "GT010"

@@ -35,7 +35,7 @@ def rules_hit(src: str, select: str | None = None):
 
 def test_registry_has_all_rules():
     ids = sorted(all_rules())
-    assert ids == [f"GT{n:03d}" for n in range(1, 16)]
+    assert ids == [f"GT{n:03d}" for n in range(1, 17)]
     for rule in all_rules().values():
         assert rule.name and rule.description
 
@@ -696,6 +696,95 @@ def test_gt015_negative_helper_and_host_arrays():
         def b(out):
             return np.asarray(out)
     """, select="GT015") == []
+
+
+# ---------------------------------------------------------------------------
+# GT016 byte-budgeted container not registered with the memory accountant
+# ---------------------------------------------------------------------------
+
+def test_gt016_positive_unregistered_byte_pool():
+    hits = rules_hit("""
+        from collections import OrderedDict
+
+        class GridCache:
+            def __init__(self, max_bytes):
+                self.max_bytes = int(max_bytes)
+                self._entries = OrderedDict()
+                self._bytes = 0
+    """, select="GT016")
+    assert hits == [("GT016", 4)]
+    # budget riding the VALUE name (self.capacity = capacity_bytes)
+    hits = rules_hit("""
+        class PageCache:
+            def __init__(self, capacity_bytes):
+                self.capacity = capacity_bytes
+                self._entries = {}
+    """, select="GT016")
+    assert hits == [("GT016", 2)]
+
+
+def test_gt016_positive_module_dict_of_device_arrays():
+    hits = rules_hit("""
+        import jax
+
+        _GRIDS = {}
+
+        def cache_grid(key, host_arr):
+            _GRIDS[key] = jax.device_put(host_arr)
+    """, select="GT016")
+    assert [h[0] for h in hits] == ["GT016"]
+
+
+def test_gt016_negative_registered_and_non_pools():
+    # registering with the accountant silences the rule
+    assert rules_hit("""
+        from collections import OrderedDict
+        from greptimedb_tpu.telemetry import memory
+
+        class GridCache:
+            def __init__(self, max_bytes):
+                self.max_bytes = int(max_bytes)
+                self._entries = OrderedDict()
+                memory.register_pool(
+                    "grids", "device", self, stats=GridCache._stats
+                )
+
+            def _stats(self):
+                return {"bytes": 0}
+    """, select="GT016") == []
+    # entry-count config objects are not byte pools
+    assert rules_hit("""
+        class TracingConfig:
+            def __init__(self, capacity):
+                self.capacity = int(capacity)
+                self.extra = {}
+    """, select="GT016") == []
+    # a budget without an entries container (a sizing constant holder)
+    assert rules_hit("""
+        class Sizer:
+            def __init__(self, max_bytes):
+                self.max_bytes = max_bytes
+    """, select="GT016") == []
+    # module dicts holding host-side objects are fine
+    assert rules_hit("""
+        _LOCKS = {}
+
+        def lock_for(key):
+            import threading
+            _LOCKS[key] = threading.Lock()
+            return _LOCKS[key]
+    """, select="GT016") == []
+    # a registering module's device-array dict is fine too
+    assert rules_hit("""
+        import jax
+        from greptimedb_tpu.telemetry import memory
+
+        _GRIDS = {}
+        memory.register_pool("grids", "device", object(), stats=len)
+
+        def cache_grid(key, host_arr):
+            _GRIDS[key] = jax.device_put(host_arr)
+    """, select="GT016") == []
 
 
 def test_suppression_same_line():

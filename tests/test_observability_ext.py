@@ -86,6 +86,63 @@ def test_debug_prof_routes(server):
     assert code == 200
 
 
+def test_cpu_profile_speedscope_format(server):
+    stop = threading.Event()
+
+    def busy_speedscope_target():
+        while not stop.wait(0.001):
+            sum(i * i for i in range(500))
+
+    t = threading.Thread(target=busy_speedscope_target, name="busy-ss")
+    t.start()
+    try:
+        code, body = _get(
+            server, "/debug/prof/cpu?seconds=0.4&format=speedscope"
+        )
+    finally:
+        stop.set()
+        t.join()
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["$schema"] == (
+        "https://www.speedscope.app/file-format-schema.json"
+    )
+    frames = doc["shared"]["frames"]
+    prof = doc["profiles"][0]
+    assert prof["type"] == "sampled"
+    assert len(prof["samples"]) == len(prof["weights"])
+    assert prof["samples"], "no samples captured"
+    # every sample is a stack of valid frame indices
+    for stack in prof["samples"]:
+        assert stack and all(0 <= i < len(frames) for i in stack)
+    assert prof["endValue"] == sum(prof["weights"])
+    names = "".join(f["name"] for f in frames)
+    assert "busy_speedscope_target" in names
+
+
+def test_mem_profile_diff_reports_growth():
+    pprof.mem_profile()          # ensures tracemalloc is tracing
+    pprof.mem_profile()          # baseline snapshot stored
+    hold = [bytearray(1024) for _ in range(3000)]
+    out = pprof.mem_profile(top=40, diff=True)
+    assert "since previous snapshot" in out
+    # growth is signed and attributed to this allocation site
+    assert "test_observability_ext.py" in out, out
+    assert "+" in out
+    del hold
+    # the diff updated the stored snapshot: an immediate second diff
+    # reports against NOW, not the original baseline
+    out2 = pprof.mem_profile(top=5, diff=True)
+    assert "since previous snapshot" in out2
+
+
+def test_mem_profile_diff_http_route(server):
+    _get(server, "/debug/prof/mem")        # start/advance snapshots
+    code, body = _get(server, "/debug/prof/mem?diff=1&top=10")
+    assert code == 200
+    assert b"snapshot" in body
+
+
 # ---------------------------------------------------------------------
 # metrics self-export
 # ---------------------------------------------------------------------

@@ -20,7 +20,7 @@ from greptimedb_tpu.telemetry.metrics import global_registry
 
 
 def _counter(name: str, *labels) -> float:
-    return global_registry.counter(name).labels(*labels).value
+    return global_registry.get(name).labels(*labels).value
 
 
 def _enable_rc(inst, **kw) -> ResultCache:
