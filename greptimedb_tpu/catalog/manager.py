@@ -157,6 +157,17 @@ def region_options_from_table(options: dict) -> RegionOptions:
         )
         if ms > 0:
             opts.compaction_window_ms = ms
+    for key in ("compaction.twcs.trigger_file_num",
+                "compaction.twcs.max_active_window_files"):
+        # the reference's L0 trigger knob (twcs max_active_window_*
+        # options); lenient on reopen like every other option here
+        if key in options:
+            try:
+                n = int(str(options[key]))
+            except ValueError:
+                continue
+            if n > 0:
+                opts.compaction_trigger_files = n
     return opts
 
 

@@ -352,6 +352,7 @@ def _make_instance(opts):
     from greptimedb_tpu.storage.object_store import (
         object_store_from_options,
     )
+    from greptimedb_tpu.storage.compaction import compaction_options_from
     from greptimedb_tpu.storage.recovery import recovery_options_from
 
     store = None
@@ -359,6 +360,16 @@ def _make_instance(opts):
     if (str(storage.get("type", "fs")).lower() != "fs"
             or storage.get("root")):
         store = object_store_from_options(storage, opts.get("data_home"))
+    # dedicated cold-tier store ([storage.cold]); absent, regions fall
+    # back to the primary store beneath any local read cache
+    cold_store = None
+    cold_cfg = storage.get("cold")
+    if isinstance(cold_cfg, dict) and cold_cfg:
+        import os as _os
+
+        cold_store = object_store_from_options(
+            cold_cfg, _os.path.join(opts.get("data_home"), "cold")
+        )
     # process-wide query mesh ([mesh] knobs): built once from the
     # visible devices and threaded into every QueryEngine this process
     # creates (the replicate-vs-shard planner gates per-query use)
@@ -388,8 +399,12 @@ def _make_instance(opts):
             wal_backend=opts.get("wal.backend", "fs"),
             wal_topics=int(opts.get("wal.topics", 4)),
             recovery=recovery_options_from(opts.section("recovery")),
+            compaction=compaction_options_from(
+                opts.section("compaction")
+            ),
         ),
         store=store,
+        cold_store=cold_store,
     )
     if opts.get("flow.enable", True):
         try:

@@ -44,6 +44,11 @@ DEFAULTS: dict = {
         "type": "fs",            # fs | memory | s3
         # s3: bucket/endpoint/access_key_id/secret_access_key/region/root
         "cache_capacity_bytes": 0,
+        # optional dedicated cold-tier store ([storage.cold], same
+        # keys as [storage]): compaction rewrites windows past
+        # [compaction] cold_horizon_ms onto it. Absent, cold files ride
+        # the primary store BENEATH any local read cache.
+        "cold": {},
     },
     "flow": {"enable": True, "tick_interval_s": 1.0},
     # pipelined wire-ingest dataplane (greptimedb_tpu/ingest/): the
@@ -81,6 +86,22 @@ DEFAULTS: dict = {
         "checkpoint_interval_edits": 64,
         "flush_after_replay": True,
         "restore_ssts": False,          # eager fetch+verify+warm at open
+    },
+    # compaction + tiered-storage dataplane (storage/compaction.py):
+    # leveled TWCS merges on a bounded per-engine pool with
+    # device-accelerated merge, tombstone GC when a merge covers every
+    # overlapping live file, and hot/cold tiering past the horizon.
+    # The L0 trigger + window stay per-table (WITH(...) options).
+    "compaction": {
+        "workers": 1,                    # bounded merge pool size
+        "l1_trigger_files": 4,           # L1 -> L2 file-count trigger
+        "l1_trigger_bytes": 268435456,   # L1 -> L2 byte trigger (0=off)
+        "l2_trigger_files": 4,           # L2 self-merge trigger
+        "cold_horizon_ms": 0,            # rewrite older windows cold; 0=off
+        "device_merge_min_rows": 262144, # device merge threshold; <=0 host
+        "verify_device_merge": False,    # assert device == host per merge
+        "prefetch_depth": 4,             # pipelined compaction-read window
+        "cleanup_orphans": True,         # drop unreferenced SSTs at open
     },
     # query admission control + scheduling (sched/): per-tenant token
     # buckets and concurrency limits over a bounded priority queue,

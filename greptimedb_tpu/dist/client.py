@@ -174,9 +174,11 @@ class DatanodeClient:
             .get("flushed")
         )
 
-    def compact_region(self, region_id: int) -> bool:
+    def compact_region(self, region_id: int, *,
+                       force: bool = False) -> bool:
         return bool(
-            self.action("compact_region", {"region_id": region_id},
+            self.action("compact_region",
+                        {"region_id": region_id, "force": force},
                         timeout=_op_timeout(300.0))
             .get("compacted")
         )

@@ -183,10 +183,12 @@ class RegionServer:
     def flush_region(self, region_id: int) -> bool:
         return self._region(region_id).flush() is not None
 
-    def compact_region(self, region_id: int) -> bool:
-        from greptimedb_tpu.storage.compaction import compact_once
-
-        return bool(compact_once(self._region(region_id)))
+    def compact_region(self, region_id: int, *,
+                       force: bool = False) -> bool:
+        # routes through the engine's bounded compaction pool (the
+        # region carries the scheduler handle), so ADMIN-triggered
+        # merges obey the same concurrency cap as background ones
+        return bool(self._region(region_id).compact(force=force))
 
     def truncate_region(self, region_id: int) -> None:
         self._region(region_id).truncate()

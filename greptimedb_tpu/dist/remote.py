@@ -103,8 +103,10 @@ class RemoteRegion:
         return True if self.client.flush_region(self.meta.region_id) \
             else None
 
-    def compact(self) -> bool:
-        return bool(self.client.compact_region(self.meta.region_id))
+    def compact(self, *, force: bool = False) -> bool:
+        return bool(
+            self.client.compact_region(self.meta.region_id, force=force)
+        )
 
     def truncate(self):
         self.client.truncate_region(self.meta.region_id)

@@ -130,6 +130,14 @@ class SstRestoreError(StorageError):
     see WHICH object to repair instead of a decode traceback."""
 
 
+class CompactionError(StorageError):
+    """A compaction job failed: a picked input could not be fetched/
+    verified, the device merge diverged from the host path under
+    verification, or the output commit lost its race irrecoverably.
+    Carries the region id and failing stage so ADMIN callers (and the
+    wire, via [gtdb:<code>]) see what to retry."""
+
+
 class DatanodeUnavailableError(GreptimeError):
     """A datanode process is unreachable (connection refused/timeout) —
     retryable after a route refresh (failover may have moved its

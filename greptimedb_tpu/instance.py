@@ -190,11 +190,12 @@ class Standalone:
     def __init__(self, data_root: str = "./greptimedb_tpu_data", *,
                  engine_config: EngineConfig | None = None,
                  prefer_device: bool | None = None, mesh=None,
-                 mesh_opts=None, warm_start: bool = True, store=None):
+                 mesh_opts=None, warm_start: bool = True, store=None,
+                 cold_store=None):
         cfg = engine_config or EngineConfig(data_root=data_root,
                                             enable_background=False)
         _enable_xla_persistent_cache(cfg.data_root)
-        self.engine = TsdbEngine(cfg, store=store)
+        self.engine = TsdbEngine(cfg, store=store, cold_store=cold_store)
         self.catalog = CatalogManager(self.engine)
         self.query_engine = QueryEngine(prefer_device=prefer_device,
                                         mesh=mesh, mesh_opts=mesh_opts)
@@ -509,13 +510,23 @@ class Standalone:
             ident = const_str(0)
             db, tname = self._resolve(ident, ctx)
             table = self.catalog.table(db, tname)
-            n = 0
-            for region in table.regions:
-                if name == "flush_table":
-                    if region.flush() is not None:
-                        n += 1
-                elif region.compact():
-                    n += 1
+            # ride the engine's bounded compaction pool: regions fan
+            # out under the same concurrency cap as background merges
+            # ([compaction] workers — at the default of 1 they
+            # serialize, and an in-flight background merge is awaited
+            # first). Errors stay typed across every wire
+            # ([gtdb:<code>]). ADMIN compaction is FORCED: every
+            # multi-file window merges to the top level.
+            sched = self.engine.compaction
+            if name == "flush_table":
+                results = sched.map_sync(
+                    lambda r: r.flush() is not None, table.regions
+                )
+            else:
+                results = sched.map_sync(
+                    lambda r: bool(r.compact(force=True)), table.regions
+                )
+            n = sum(1 for ok in results if ok)
             return Output.records(_result_from_lists(
                 [f"ADMIN {name}('{ident}')"], [[n]]
             ))
@@ -525,7 +536,7 @@ class Standalone:
             if name == "flush_region":
                 n = 1 if region.flush() is not None else 0
             else:
-                n = 1 if region.compact() else 0
+                n = 1 if region.compact(force=True) else 0
             return Output.records(_result_from_lists(
                 [f"ADMIN {name}({rid})"], [[n]]
             ))

@@ -308,7 +308,10 @@ class FlightServer(flight.FlightServerBase):
         elif kind == "flush_region":
             return {"flushed": rs.flush_region(int(body["region_id"]))}
         elif kind == "compact_region":
-            return {"compacted": rs.compact_region(int(body["region_id"]))}
+            return {"compacted": rs.compact_region(
+                int(body["region_id"]),
+                force=bool(body.get("force", False)),
+            )}
         elif kind == "truncate_region":
             rs.truncate_region(int(body["region_id"]))
         elif kind == "alter_region":

@@ -20,18 +20,25 @@ rejoin and standalone catalog load.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 
 from dataclasses import dataclass, field
 
 from greptimedb_tpu.errors import RegionNotFoundError
-from greptimedb_tpu.storage.compaction import compact_once
+from greptimedb_tpu.storage.compaction import (
+    CompactionOptions,
+    CompactionScheduler,
+)
 from greptimedb_tpu.storage.object_store import FsObjectStore, ObjectStore
 from greptimedb_tpu.storage.recovery import RecoveryOptions
 from greptimedb_tpu.storage.region import Region, RegionMetadata
 
 from greptimedb_tpu import concurrency
+
+_log = logging.getLogger("greptimedb_tpu.storage.engine")
+
 
 @dataclass
 class EngineConfig:
@@ -55,6 +62,10 @@ class EngineConfig:
     wal_topics: int = 4
     # recovery dataplane knobs ([recovery] TOML section)
     recovery: RecoveryOptions = field(default_factory=RecoveryOptions)
+    # compaction + tiering dataplane knobs ([compaction] TOML section)
+    compaction: CompactionOptions = field(
+        default_factory=CompactionOptions
+    )
 
 
 class _OpenSlot:
@@ -87,9 +98,17 @@ class _OpenSlot:
 
 class TsdbEngine:
     def __init__(self, config: EngineConfig | None = None,
-                 store: ObjectStore | None = None):
+                 store: ObjectStore | None = None,
+                 cold_store: ObjectStore | None = None):
         self.config = config or EngineConfig()
         self.store = store or FsObjectStore(self.config.data_root)
+        # dedicated cold-tier store ([storage.cold]); None = regions
+        # derive it (raw store beneath any local read cache)
+        self.cold_store = cold_store
+        # bounded per-engine compaction pool: merges run off the
+        # maintenance thread so a long merge never stalls maybe_flush
+        # or other regions; ADMIN compact/flush ride the same pool
+        self.compaction = CompactionScheduler(self.config.compaction)
         self._regions: dict[int, Region] = {}
         self._opening: dict[int, _OpenSlot] = {}
         self._topics: dict[int, object] = {}
@@ -228,6 +247,21 @@ class TsdbEngine:
         rec = self.config.recovery
         t0 = _time.perf_counter()
         region = self._build_region(meta)
+        if self.config.compaction.cleanup_orphans:
+            # crash-mid-compaction/flush leftovers: SST objects the
+            # loaded manifest does not reference. Before the recovery
+            # flush below, so the listing races no writes of our own.
+            from greptimedb_tpu.storage.compaction import (
+                cleanup_orphan_ssts,
+            )
+
+            try:
+                cleanup_orphan_ssts(region)
+            except Exception:  # noqa: BLE001 - cleanup is best-effort
+                _log.warning(
+                    "orphan sst cleanup failed for region %s",
+                    meta.region_id, exc_info=True,
+                )
         if rec.flush_after_replay and \
                 region.recovery_stats.get("replayed_entries"):
             # WAL truncation after the recovery flush: persist the
@@ -284,12 +318,16 @@ class TsdbEngine:
                 f"unknown wal_backend {self.config.wal_backend!r} "
                 "(fs | object | shared)"
             )
-        return Region(
+        region = Region(
             meta, self.store, wal_dir, log_store=log_store,
             checkpoint_interval_edits=(
                 self.config.recovery.checkpoint_interval_edits
             ),
+            cold_store=self.cold_store,
         )
+        region._compaction = self.compaction
+        region._compaction_opts = self.config.compaction
+        return region
 
     def _assign_topic(self, region_id: int, wal_root: str) -> int:
         """Persisted region->topic assignment (WalOptionsAllocator
@@ -361,9 +399,14 @@ class TsdbEngine:
         if region:
             region.close()
             for meta in region.manifest.state.ssts:
-                self.store.delete(meta.path)
+                # tier-aware: cold files may live on a separate store
+                region.store_for(meta).delete(meta.path)
             for m in self.store.list(region.prefix + "/"):
                 self.store.delete(m.path)
+            cold = region.cold_store
+            if cold is not self.store:
+                for m in cold.list(region.prefix + "/"):
+                    cold.delete(m.path)
             if hasattr(region.wal, "drop"):
                 # shared-topic view: forget the region so its dead
                 # entries stop pinning topic truncation
@@ -398,26 +441,46 @@ class TsdbEngine:
     # ---- maintenance --------------------------------------------------
     def maybe_flush(self):
         """Flush regions over their own threshold, plus the largest ones
-        while the global write-buffer budget is exceeded."""
+        while the global write-buffer budget is exceeded. One region's
+        failing flush must not starve the others of theirs."""
         regions = self.regions()
         for r in regions:
             if r.should_flush:
-                r.flush()
+                try:
+                    r.flush()
+                except Exception:  # noqa: BLE001 - isolated per region
+                    _log.warning("maintenance flush failed for region "
+                                 "%s", r.meta.region_id, exc_info=True)
         total = sum(r.memtable.bytes for r in regions)
         if total > self.config.global_write_buffer_bytes:
             for r in sorted(regions, key=lambda r: -r.memtable.bytes):
                 if total <= self.config.global_write_buffer_bytes:
                     break
                 total -= r.memtable.bytes
-                r.flush()
+                try:
+                    r.flush()
+                except Exception:  # noqa: BLE001 - isolated per region
+                    _log.warning("budget flush failed for region %s",
+                                 r.meta.region_id, exc_info=True)
 
     def run_maintenance(self):
+        """One maintenance tick: flushes, TTL expiry, compaction
+        scheduling. Failures are isolated PER REGION — one region's
+        failing purge/compact no longer aborts the remaining regions'
+        maintenance for the tick — and compaction merges run on the
+        bounded pool, not this thread."""
         from greptimedb_tpu.storage.compaction import purge_expired
 
         self.maybe_flush()
-        for r in self.regions():
-            purge_expired(r)
-            compact_once(r)
+        regions = self.regions()
+        for r in regions:
+            try:
+                purge_expired(r)
+                self.compaction.maybe_schedule(r)
+            except Exception:  # noqa: BLE001 - isolated per region
+                _log.warning("maintenance failed for region %s",
+                             r.meta.region_id, exc_info=True)
+        self.compaction.update_read_amp(regions)
 
     def _ensure_background(self):
         """Lazy-start the maintenance thread on first region open."""
@@ -447,6 +510,9 @@ class TsdbEngine:
         self._stop.set()
         if self._bg:
             self._bg.join(timeout=10)
+        # stop the merge pool before closing regions: a merge landing
+        # after its region closed would commit into a dead manifest
+        self.compaction.close()
         # drain in-flight opens: a region landing after the close loop
         # snapshot would keep its WAL handle (and replayed rows) open
         while True:

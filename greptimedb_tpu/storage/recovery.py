@@ -172,11 +172,13 @@ def _fetch_verified(store, meta) -> bytes:
     ) from last
 
 
-def _decode_install(meta, data: bytes, *, budget_full: bool
-                    ) -> tuple[int, bool]:
+def _decode_install(meta, data: bytes, *, budget_full: bool,
+                    warm: bool = True) -> tuple[int, bool]:
     """Verify the Parquet payload against the manifest entry and warm
     the page cache with its decoded columns while there is FREE budget
     (never evicting — recovery must not push out hot scan data).
+    Cold-tier files verify only (``warm=False``): their columns must
+    not occupy page-cache budget hot scans want.
     Returns (columns installed, budget_full)."""
     import io
 
@@ -195,8 +197,8 @@ def _decode_install(meta, data: bytes, *, budget_full: bool
             raise ValueError(
                 f"row count {md.num_rows} != manifest {meta.rows}"
             )
-        if budget_full:
-            return 0, True
+        if budget_full or not warm:
+            return 0, budget_full
         cols = list(pf.schema_arrow.names)
         installed = 0
         for g in range(md.num_row_groups):
@@ -220,6 +222,70 @@ def _decode_install(meta, data: bytes, *, budget_full: bool
         raise SstRestoreError(
             f"corrupt sst object during restore: {meta.path}: {e}"
         ) from e
+
+
+class PipelinedFetcher:
+    """Bounded-readahead fetch of ``(store, SstMeta)`` items, yielding
+    ``(meta, data)`` in submission order with up to ``depth`` verified
+    ranged gets in flight — the shared read machinery of SST restore
+    AND compaction inputs. Byte counts verify against each manifest
+    entry (:func:`_fetch_verified`); the raw-byte window is bounded so
+    a deep readahead over multi-hundred-MB SSTs cannot OOM the node.
+    Use as a context manager; ``depth <= 0`` (or a single item)
+    degrades to serial fetch with no pool."""
+
+    def __init__(self, items, *, depth: int,
+                 window_bytes: int = _RESTORE_WINDOW_BYTES):
+        self._items = list(items)
+        self._depth = int(depth)
+        self._window_bytes = window_bytes
+        self._pool = None
+        self._pending: deque = deque()
+        self._nxt = 0
+        self._inflight_bytes = 0
+
+    def __enter__(self) -> "PipelinedFetcher":
+        if self._depth > 0 and len(self._items) > 1:
+            self._pool = concurrency.ThreadPoolExecutor(
+                max_workers=min(self._depth, len(self._items)),
+                thread_name_prefix="gtpu-sst-fetch",
+            )
+            self._fill()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True, cancel_futures=True)
+        return False
+
+    def _fill(self):
+        # readahead bounded by BOTH file count (depth) and raw bytes
+        # in flight; a single oversized file still gets one slot
+        while self._nxt < len(self._items) and \
+                len(self._pending) < self._depth:
+            store, m = self._items[self._nxt]
+            if self._pending and (self._inflight_bytes + m.size_bytes
+                                  > self._window_bytes):
+                break
+            self._pending.append(
+                (m, self._pool.submit(_fetch_verified, store, m))
+            )
+            self._inflight_bytes += m.size_bytes
+            self._nxt += 1
+
+    def __iter__(self):
+        if self._pool is None:
+            for store, m in self._items[self._nxt:]:
+                yield m, _fetch_verified(store, m)
+            return
+        while self._pending:
+            m, fut = self._pending.popleft()
+            data = fut.result()
+            self._inflight_bytes -= m.size_bytes
+            # keep the readahead window full before the caller decodes
+            self._fill()
+            yield m, data
 
 
 def restore_region_ssts(region, *, prefetch_depth: int | None = None,
@@ -251,60 +317,23 @@ def restore_region_ssts(region, *, prefetch_depth: int | None = None,
     if ssts:
         # restore reads are write-once/read-once: go beneath the local
         # read cache (CachedObjectStore) exactly like the WAL does, so
-        # a 900 MB restore can never evict hot scan objects from it
-        from greptimedb_tpu.storage.object_store import CachedObjectStore
+        # a 900 MB restore can never evict hot scan objects from it.
+        # Tier-aware: cold files fetch from the cold store and verify
+        # only (no page-cache warm — cold columns must not take budget
+        # hot scans want).
+        from greptimedb_tpu.storage.sst import TIER_COLD
 
-        store = region.store
-        raw = (store.inner if isinstance(store, CachedObjectStore)
-               else store)
         budget_full = False
-        if depth <= 0:
-            for m in ssts:
-                data = _fetch_verified(raw, m)
+        items = [(region.raw_store_for(m), m) for m in ssts]
+        with PipelinedFetcher(items, depth=depth) as fetcher:
+            for m, data in fetcher:
                 installed, budget_full = _decode_install(
-                    m, data, budget_full=budget_full
+                    m, data, budget_full=budget_full,
+                    warm=getattr(m, "tier", "hot") != TIER_COLD,
                 )
                 stats["files"] += 1
                 stats["bytes"] += len(data)
                 stats["installed_cols"] += installed
-        else:
-            with concurrency.ThreadPoolExecutor(
-                max_workers=min(depth, len(ssts)),
-                thread_name_prefix="gtpu-sst-restore",
-            ) as pool:
-                pending: deque = deque()
-                state = {"nxt": 0, "window_bytes": 0}
-
-                def fill_window():
-                    # readahead bounded by BOTH file count (depth) and
-                    # raw bytes in flight (_RESTORE_WINDOW_BYTES); a
-                    # single oversized file still gets one slot
-                    while state["nxt"] < len(ssts) and \
-                            len(pending) < depth:
-                        m = ssts[state["nxt"]]
-                        if pending and (state["window_bytes"]
-                                        + m.size_bytes
-                                        > _RESTORE_WINDOW_BYTES):
-                            break
-                        pending.append(
-                            (m, pool.submit(_fetch_verified, raw, m))
-                        )
-                        state["window_bytes"] += m.size_bytes
-                        state["nxt"] += 1
-
-                fill_window()
-                while pending:
-                    m, fut = pending.popleft()
-                    data = fut.result()
-                    state["window_bytes"] -= m.size_bytes
-                    # keep the readahead window full before decoding
-                    fill_window()
-                    installed, budget_full = _decode_install(
-                        m, data, budget_full=budget_full
-                    )
-                    stats["files"] += 1
-                    stats["bytes"] += len(data)
-                    stats["installed_cols"] += installed
     ms = (time.perf_counter() - t0) * 1000.0
     stats["ms"] = ms
     rec = getattr(region, "recovery_stats", None)

@@ -166,6 +166,7 @@ class Region:
         prefix: str | None = None,
         log_store=None,
         checkpoint_interval_edits: int | None = None,
+        cold_store: ObjectStore | None = None,
     ):
         import time as _time
 
@@ -173,6 +174,14 @@ class Region:
 
         self.meta = meta
         self.store = store
+        # cold-tier store (compaction tiering). None = derive: the raw
+        # store beneath any local read cache, so cold reads/writes
+        # never evict hot objects from it
+        self._cold_store = cold_store
+        # compaction pool handle + engine-wide options; wired by the
+        # owning engine (a bare Region compacts inline with defaults)
+        self._compaction = None
+        self._compaction_opts = None
         self.prefix = prefix or f"data/region_{meta.region_id}"
         # pluggable WAL backend: node-local segment files by default, or
         # any LogStore (e.g. ObjectStoreLogStore for the remote-WAL
@@ -243,6 +252,39 @@ class Region:
         served across any physical mutation of the region, even ones
         that provably preserve the logical row set."""
         return self.data_version + (self.manifest.version,)
+
+    # ------------------------------------------------------------------
+    # tiered stores
+    # ------------------------------------------------------------------
+    @property
+    def cold_store(self) -> ObjectStore:
+        """The cold tier's store: the configured [storage.cold] store,
+        or the raw store beneath the local read cache (cold data must
+        not evict hot objects from it)."""
+        if self._cold_store is not None:
+            return self._cold_store
+        from greptimedb_tpu.storage.object_store import CachedObjectStore
+
+        if isinstance(self.store, CachedObjectStore):
+            return self.store.inner
+        return self.store
+
+    def store_for_tier(self, tier: str) -> ObjectStore:
+        from greptimedb_tpu.storage.sst import TIER_COLD
+
+        return self.cold_store if tier == TIER_COLD else self.store
+
+    def store_for(self, meta: SstMeta) -> ObjectStore:
+        """The store holding this SST (tier-aware reads/deletes)."""
+        return self.store_for_tier(getattr(meta, "tier", "hot"))
+
+    def raw_store_for(self, meta: SstMeta) -> ObjectStore:
+        """Like store_for, beneath any local read cache: compaction and
+        restore reads are read-once and must not churn the cache."""
+        from greptimedb_tpu.storage.object_store import CachedObjectStore
+
+        st = self.store_for(meta)
+        return st.inner if isinstance(st, CachedObjectStore) else st
 
     # ------------------------------------------------------------------
     # write path
@@ -526,7 +568,8 @@ class Region:
         # filter alone does the matching.
         ft = fulltext if self.meta.options.append_mode else None
         for meta in ssts:
-            r = read_sst(self.store, meta, ts_min=ts_min, ts_max=ts_max,
+            r = read_sst(self.store_for(meta), meta,
+                         ts_min=ts_min, ts_max=ts_max,
                          field_names=scan_names, sids=sids, fulltext=ft)
             if r is not None:
                 chunks.append(r)
@@ -621,12 +664,17 @@ class Region:
         return True
 
     # ------------------------------------------------------------------
-    def compact(self) -> bool:
-        """Run one compaction round if the TWCS picker selects files.
-        The uniform surface shared with RemoteRegion.compact()."""
+    def compact(self, *, force: bool = False) -> bool:
+        """Run triggered compactions (``force`` merges every
+        multi-file window to the top level — the ADMIN semantics).
+        Routes through the owning engine's bounded compaction pool
+        when one is attached; a bare Region compacts inline. The
+        uniform surface shared with RemoteRegion.compact()."""
         from greptimedb_tpu.storage.compaction import compact_once
 
-        return bool(compact_once(self))
+        if self._compaction is not None:
+            return self._compaction.compact_sync(self, force=force)
+        return bool(compact_once(self, force=force))
 
     def invalidate_scan_cache(self):
         """Explicit invalidation for schema changes (ALTER drops/adds can
@@ -645,9 +693,10 @@ class Region:
             )
             self._frozen.clear()
             for s in self.manifest.state.ssts:
-                self.store.delete(s.path)
+                st = self.store_for(s)
+                st.delete(s.path)
                 if s.fulltext:
-                    self.store.delete(sidecar_path(s.path))
+                    st.delete(sidecar_path(s.path))
             self.manifest.commit({
                 "kind": "truncate",
                 "truncated_entry_id": entry_id,

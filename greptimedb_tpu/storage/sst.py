@@ -35,6 +35,13 @@ SEQ_COL = "__seq"
 OP_COL = "__op"
 _INTERNAL = (SERIES_COL, TS_COL, SEQ_COL, OP_COL)
 
+# storage tiers (compaction tiering): hot files live on the region's
+# primary store (with any local read cache); cold files live on the
+# cold store (the raw store beneath the cache, or a dedicated
+# [storage.cold] store) and never pollute hot caches
+TIER_HOT = "hot"
+TIER_COLD = "cold"
+
 
 @dataclass
 class SstMeta:
@@ -48,6 +55,8 @@ class SstMeta:
     level: int = 0
     # a <path>.puffin sidecar with flush-time fulltext term indexes
     fulltext: bool = False
+    # storage tier; manifests written before tiering default to hot
+    tier: str = TIER_HOT
 
     def to_json(self) -> dict:
         return self.__dict__.copy()
@@ -181,6 +190,7 @@ def write_sst(
     *,
     row_group_rows: int = 256 * 1024,
     level: int = 0,
+    tier: str = TIER_HOT,
     fulltext_fields: list | None = None,
 ) -> SstMeta:
     """Write sorted rows as one Parquet object; returns its metadata."""
@@ -223,6 +233,51 @@ def write_sst(
         size_bytes=len(data),
         fulltext=sidecar is not None,
         level=level,
+        tier=tier,
+    )
+
+
+def read_sst_bytes(
+    data: bytes,
+    *,
+    field_names: list[str] | None = None,
+) -> ColumnarRows | None:
+    """Decode a whole SST from already-fetched (and byte-verified)
+    bytes — the compaction read path: inputs arrive through the
+    recovery dataplane's pipelined fetcher, so there is no store or
+    pruning here, just the columns. Uses the same Arrow column decode
+    as the scan path."""
+    from greptimedb_tpu.storage.page_cache import decode_arrow_column
+
+    pf = pq.ParquetFile(io.BytesIO(data))
+    if pf.metadata.num_rows == 0:
+        return None
+    schema_names = pf.schema_arrow.names
+    wanted = (
+        field_names if field_names is not None
+        else [n for n in schema_names if n not in _INTERNAL]
+    )
+    cols = list(_INTERNAL) + [n for n in wanted if n in schema_names]
+    tbl = pf.read(columns=cols)
+    decoded = {c: decode_arrow_column(tbl.column(c)) for c in cols}
+    fields = {}
+    valids = {}
+    has_nulls = False
+    n = pf.metadata.num_rows
+    for name in wanted:
+        if name not in schema_names:
+            continue
+        values, validity = decoded[name]
+        if validity is not None:
+            has_nulls = True
+            valids[name] = validity
+        else:
+            valids[name] = np.ones(n, dtype=bool)
+        fields[name] = values
+    return ColumnarRows(
+        sid=decoded[SERIES_COL][0], ts=decoded[TS_COL][0],
+        seq=decoded[SEQ_COL][0], op=decoded[OP_COL][0],
+        fields=fields, field_valid=valids if has_nulls else None,
     )
 
 
