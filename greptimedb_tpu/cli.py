@@ -385,6 +385,10 @@ def _make_instance(opts):
     from greptimedb_tpu.telemetry import memory as _memory
 
     _memory.configure(opts.section("memory"))
+    # [stmt_stats] knobs: fingerprint registry size + metric label cap
+    from greptimedb_tpu.telemetry import stmt_stats as _stmt_stats
+
+    _stmt_stats.configure(opts.section("stmt_stats"))
     prefer_device = opts.get("query.prefer_device")
     inst = Standalone(
         mesh=mesh, mesh_opts=mesh_opts,
@@ -556,10 +560,14 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst,
 
 def _start_frontend(opts):
     from greptimedb_tpu.telemetry import memory as _memory
+    from greptimedb_tpu.telemetry import stmt_stats as _stmt_stats
     from greptimedb_tpu.telemetry import tracing as _tracing
 
     _tracing.configure(opts.section("tracing"))
     _memory.configure(opts.section("memory"))
+    # the frontend owns statement execution in a dist topology, so the
+    # statement-statistics registry lives here ([stmt_stats] knobs)
+    _stmt_stats.configure(opts.section("stmt_stats"))
     meta_addr = opts.get("metasrv.addr") or ""
     if meta_addr:
         # distributed frontend: catalog in the metasrv kv, regions on

@@ -205,6 +205,21 @@ DEFAULTS: dict = {
         "device_budget_bytes": 0,   # 0 = per-pool budgets only
         "census_on_scrape": True,
     },
+    # statement statistics (telemetry/stmt_stats.py): every executed
+    # statement folds into a registry row keyed by its normalized
+    # fingerprint (literals/IN-lists folded) — calls, errors, latency
+    # percentiles, exec path, compile/cache hits, transfer bytes, shed
+    # counts, last trace id. Surfaced as information_schema.
+    # statement_statistics, /v1/stats/statements and gtpu_stmt_*
+    # metrics. max_fingerprints bounds the registry (LRU rows collapse
+    # into "_other"); metric_fingerprints bounds the /metrics label
+    # cardinality (first-come, later fingerprints export as "_other").
+    # Reset at runtime with ADMIN reset_statement_statistics().
+    "stmt_stats": {
+        "enable": True,
+        "max_fingerprints": 512,
+        "metric_fingerprints": 64,
+    },
     "logging": {
         "level": "info",
         # statements slower than threshold land in the slow-query log +

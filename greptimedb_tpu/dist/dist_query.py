@@ -56,6 +56,7 @@ from greptimedb_tpu.errors import (
 )
 from greptimedb_tpu.query import stats
 from greptimedb_tpu.sched import deadline as _dl
+from greptimedb_tpu.telemetry import stmt_stats
 from greptimedb_tpu.query.executor import (
     Col,
     DictSource,
@@ -358,6 +359,18 @@ def _fan_out_stream(instance, table, partial: SelectPlan, clock,
                                                 0),
                 "partial_rows": nrows,
             }))
+            # fold EVERY datanode's rpc time + scan-cache attribution
+            # into the frontend statement's ONE statistics row (the
+            # pool workers above do not inherit contextvars, so the
+            # fold happens here on the statement's own thread)
+            stmt_stats.add("dist_rpc_ms", rpc_ms)
+            stmt_stats.add("dist_datanodes", 1)
+            sc_hits = counters.get("dist_scan_cache_hits", 0)
+            sc_miss = counters.get("dist_scan_cache_misses", 0)
+            if sc_hits:
+                stmt_stats.add("scan_cache_hits", sc_hits)
+            if sc_miss:
+                stmt_stats.add("scan_cache_misses", sc_miss)
             exec_ms = float(stage.get("exec_ms", 0.0))
             exec_max = max(exec_max, exec_ms)
             wire_max = max(wire_max, rpc_ms - exec_ms)

@@ -310,7 +310,7 @@ def _collations_doc(inst) -> dict[str, list]:
 def _slow_queries_doc(inst) -> dict[str, list]:
     rows = {"cost_time_ms": [], "threshold_ms": [], "query": [],
             "schema_name": [], "channel": [], "timestamp": [],
-            "trace_id": []}
+            "trace_id": [], "fingerprint": []}
     log = getattr(inst, "slow_query_log", None)
     if log is not None:
         for e in log.entries():
@@ -321,6 +321,40 @@ def _slow_queries_doc(inst) -> dict[str, list]:
             rows["channel"].append(e["channel"])
             rows["timestamp"].append(e["ts_ms"])
             rows["trace_id"].append(e.get("trace_id", ""))
+            # joins the aggregate statement_statistics row for this
+            # statement shape (see README "Statement statistics")
+            rows["fingerprint"].append(e.get("fingerprint", ""))
+    return rows
+
+
+def _statement_statistics_doc(inst) -> dict[str, list]:
+    """The process-wide statement-statistics registry
+    (telemetry/stmt_stats.py), one row per (schema, fingerprint) —
+    the pg_stat_statements face of the node. `last_trace_id` is an
+    exemplar: join it against information_schema.traces (or
+    /v1/traces?trace_id=) for one concrete execution of the shape."""
+    import json as _json
+
+    from greptimedb_tpu.telemetry.stmt_stats import global_stmt_stats
+
+    cols = [
+        "fingerprint", "schema_name", "tenant", "channel", "query",
+        "calls", "errors", "errors_by_code", "rows_returned",
+        "total_ms", "mean_ms", "p50_ms", "p99_ms", "queue_total_ms",
+        "queue_p99_ms", "exec_path", "mesh_decision", "compile_count",
+        "compile_cache_hits", "upload_bytes", "readback_full_bytes",
+        "readback_delta_bytes", "session_hit_rate",
+        "result_cache_hit_rate", "scan_cache_hit_rate", "shed_count",
+        "deadline_count", "datanodes", "rpc_ms", "last_trace_id",
+        "first_seen_ms", "last_seen_ms",
+    ]
+    rows: dict[str, list] = {c: [] for c in cols}
+    for doc in global_stmt_stats.snapshot():
+        for c in cols:
+            v = doc.get(c)
+            if c == "errors_by_code":
+                v = _json.dumps(v or {})
+            rows[c].append(v)
     return rows
 
 
@@ -398,6 +432,7 @@ _PROVIDERS = {
     "slow_queries": _slow_queries_doc,
     "traces": _traces_doc,
     "memory_pools": _memory_pools_doc,
+    "statement_statistics": _statement_statistics_doc,
 }
 
 

@@ -256,12 +256,17 @@ class Standalone:
                     ) -> list[Output]:
         import time as _time
 
-        from greptimedb_tpu.telemetry import tracing
+        from greptimedb_tpu.telemetry import stmt_stats, tracing
 
         ctx = ctx or QueryContext()
         outputs = []
         t0 = _time.perf_counter()
         trace_id = None
+        # per-statement fingerprints resolved from the raw TEXT (the
+        # AST has no literal spans left to fold); aligned with
+        # parse_sql's statement order by the shared ';' split
+        fps = (stmt_stats.fingerprint_sql(sql)
+               if stmt_stats.enabled() else [])
         try:
             # one span per statement batch: the root on wires that
             # carry no traceparent (mysql/postgres/flight), a child of
@@ -270,8 +275,14 @@ class Standalone:
             with tracing.span("sql.execute", db=ctx.database,
                               channel=ctx.channel) as root:
                 trace_id = root.trace_id or None
-                for stmt in parse_sql(sql):
-                    outputs.append(self.execute_statement(stmt, ctx))
+                for i, stmt in enumerate(parse_sql(sql)):
+                    token = stmt_stats.bind_fingerprint(
+                        fps[i] if i < len(fps) else None
+                    )
+                    try:
+                        outputs.append(self.execute_statement(stmt, ctx))
+                    finally:
+                        stmt_stats.reset_fingerprint(token)
         finally:
             # duration from the monotonic perf counter (GT011), never
             # wall-clock arithmetic
@@ -279,6 +290,7 @@ class Standalone:
                 sql, _time.perf_counter() - t0,
                 db=ctx.database, channel=ctx.channel,
                 trace_id=trace_id,
+                fingerprint=fps[0].fp if fps else "",
             )
         return outputs
 
@@ -295,7 +307,7 @@ class Standalone:
     # ------------------------------------------------------------------
     def execute_statement(self, stmt: A.Statement, ctx: QueryContext
                           ) -> Output:
-        from greptimedb_tpu.telemetry import tracing
+        from greptimedb_tpu.telemetry import stmt_stats, tracing
 
         from greptimedb_tpu import cancellation
 
@@ -305,7 +317,12 @@ class Standalone:
             lambda: self._process_list.check_killed(pid)
         )
         try:
-            with tracing.span(f"sql.{kind}"):
+            # one statement-statistics observation per statement:
+            # everything the execution layers attribute (queue time,
+            # exec path, compile/cache hits, transfer bytes, dist rpc
+            # time) folds into the fingerprint's registry row on exit
+            with stmt_stats.global_stmt_stats.observe(ctx, kind) as obs, \
+                    tracing.span(f"sql.{kind}"):
                 if isinstance(stmt, _ADMITTED_STATEMENTS):
                     # data-plane statements go through admission
                     # control (quota/slot/deadline); control-plane
@@ -314,9 +331,15 @@ class Standalone:
                     # overloaded instance
                     with self.scheduler.admit(ctx):
                         self._process_list.set_state(pid, "Running")
-                        return self._execute_statement(stmt, ctx)
-                self._process_list.set_state(pid, "Running")
-                return self._execute_statement(stmt, ctx)
+                        out = self._execute_statement(stmt, ctx)
+                else:
+                    self._process_list.set_state(pid, "Running")
+                    out = self._execute_statement(stmt, ctx)
+                if obs is not None:
+                    obs.add("rows", out.result.num_rows
+                            if out.result is not None
+                            else (out.affected_rows or 0))
+                return out
         finally:
             cancellation.reset(token)
             self._process_list.unregister(pid)
@@ -562,6 +585,17 @@ class Standalone:
             ok = self._process_list.kill(str(target))
             return Output.records(_result_from_lists(
                 [f"ADMIN kill('{target}')"], [[1 if ok else 0]]
+            ))
+        if name == "reset_statement_statistics":
+            # pg_stat_statements_reset() analog: drops every registry
+            # row; the monotone gtpu_stmt_* counters keep counting
+            from greptimedb_tpu.telemetry.stmt_stats import (
+                global_stmt_stats,
+            )
+
+            n = global_stmt_stats.reset()
+            return Output.records(_result_from_lists(
+                ["ADMIN reset_statement_statistics()"], [[n]]
             ))
         raise UnsupportedError(f"unknown admin function {name!r}")
 
@@ -934,7 +968,7 @@ class Standalone:
         tightening)."""
         from greptimedb_tpu.query import sessions
         from greptimedb_tpu.query import stats as qstats
-        from greptimedb_tpu.telemetry import tracing
+        from greptimedb_tpu.telemetry import stmt_stats, tracing
 
         since = ctx.extensions.get("since_ms")
         rc = getattr(self, "result_cache", None)
@@ -977,6 +1011,7 @@ class Standalone:
             if entry is not None:
                 tracing.set_attr(result_cache="hit")
                 qstats.note("result_cache", "hit")
+                stmt_stats.add("result_cache_hits")
                 # truthful path attribution: the cached payload came
                 # from this execution path (bench/EXPLAIN assertions)
                 self.query_engine.last_exec_path = entry.exec_path
@@ -986,9 +1021,11 @@ class Standalone:
                 return res
             tracing.set_attr(result_cache="miss")
             qstats.note("result_cache", "miss")
+            stmt_stats.add("result_cache_misses")
         elif rc is not None and rc.enabled:
             tracing.set_attr(result_cache="bypass")
             qstats.note("result_cache", "bypass")
+            stmt_stats.add("result_cache_bypass")
         token = sessions.bind_since(since) if since is not None else None
         try:
             res = self._run_select_plan(plan, table)
@@ -1050,6 +1087,15 @@ class Standalone:
 
             t0 = _time.perf_counter()
             with qstats.collect() as st, tracing.export_spans() as tspans:
+                # stamp the ANALYZED statement's fingerprint so the
+                # rendered metrics join its statement_statistics row
+                # (the inner fingerprint: "EXPLAIN ANALYZE <q>" and a
+                # plain "<q>" share it)
+                from greptimedb_tpu.telemetry import stmt_stats
+
+                sfp = stmt_stats.explain_fingerprint()
+                if sfp:
+                    st.note("stmt_fingerprint", sfp)
                 if isinstance(stmt.statement, A.SetOp):
                     from greptimedb_tpu.query import relational
 

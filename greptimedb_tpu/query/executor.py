@@ -283,9 +283,11 @@ class QueryEngine:
         reasons (/metrics gtpu_query_exec_path_total)."""
         self.last_exec_path = "device" if path == "device" else "host"
         from greptimedb_tpu.query import stats
+        from greptimedb_tpu.telemetry import stmt_stats
         from greptimedb_tpu.telemetry.metrics import global_registry
 
         stats.note(f"exec_path_{kind}", path)
+        stmt_stats.note_exec_path(path)
         global_registry.counter(
             "gtpu_query_exec_path_total",
             "Query executions by path (device | host:<fallback reason>)",

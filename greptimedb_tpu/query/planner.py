@@ -219,12 +219,15 @@ def record_mesh_decision(decision: MeshDecision, kind: str) -> None:
     No-op counters-wise when no mesh is configured (devices == 1) so the
     single-device deployment's metric surface stays unchanged."""
     from greptimedb_tpu.query import stats
-    from greptimedb_tpu.telemetry import tracing
+    from greptimedb_tpu.telemetry import stmt_stats, tracing
 
     stats.note(f"mesh_decision_{kind}", decision.label())
     # the same decision rides the active trace span, so a trace shows
-    # replicate-vs-shard next to the device.execute spans it produced
+    # replicate-vs-shard next to the device.execute spans it produced —
+    # and the statement's statistics row, so an operator can ask which
+    # fingerprints actually shard across the mesh
     tracing.set_attr(**{f"mesh_decision_{kind}": decision.label()})
+    stmt_stats.note("mesh_decision", decision.label())
     if decision.devices <= 1:
         return
     if decision.shard:

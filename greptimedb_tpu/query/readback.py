@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from greptimedb_tpu.telemetry import stmt_stats
 from greptimedb_tpu.telemetry.metrics import global_registry
 
 _READBACK_BYTES = global_registry.counter(
@@ -39,6 +40,7 @@ def read_full(arr, dtype=None) -> np.ndarray:
     """Materialize a whole device buffer on host (mode=full)."""
     out = _materialize(arr, dtype)
     _READBACK_BYTES.labels("full").inc(int(out.nbytes))
+    stmt_stats.add("readback_full_bytes", int(out.nbytes))
     return out
 
 
@@ -55,6 +57,7 @@ def read_delta(arr, lo: int, *, axis: int = -1, dtype=None) -> np.ndarray:
     idx[axis] = slice(lo, None)
     out = _materialize(arr[tuple(idx)], dtype)
     _READBACK_BYTES.labels("delta").inc(int(out.nbytes))
+    stmt_stats.add("readback_delta_bytes", int(out.nbytes))
     return out
 
 

@@ -89,24 +89,30 @@ class SessionRegistry:
     def get(self, tkey, shape_key, version):
         if not self.enabled:
             return None
+        from greptimedb_tpu.telemetry import stmt_stats  # cycle-safe lazy
+
         key = (tkey, shape_key)
         with self._lock:
             hit = self._entries.get(key)
             if hit is None:
                 _MISSES.inc()
                 self._misses += 1
-                return None
-            if hit[0] != version:
+            elif hit[0] != version:
                 # the table's data changed since this buffer was folded:
                 # it can never be served again — release the HBM now
                 self._drop_locked(key)
                 _MISSES.inc()
                 self._misses += 1
-                return None
-            self._entries.move_to_end(key)
-            _HITS.inc()
-            self._hits += 1
-            return hit[1]
+                hit = None
+            else:
+                self._entries.move_to_end(key)
+                _HITS.inc()
+                self._hits += 1
+        # per-statement attribution OUTSIDE the lock: the row for a
+        # polled fingerprint shows its session hit rate
+        stmt_stats.add("session_hits" if hit is not None
+                       else "session_misses")
+        return None if hit is None else hit[1]
 
     def put(self, tkey, shape_key, version, buf, nbytes: int):
         if not self.enabled or nbytes > self.max_bytes:

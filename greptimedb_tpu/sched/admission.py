@@ -30,6 +30,7 @@ from greptimedb_tpu.errors import (
     QueryQueueTimeoutError,
 )
 from greptimedb_tpu.sched import deadline as _deadline
+from greptimedb_tpu.telemetry import stmt_stats
 from greptimedb_tpu.telemetry.metrics import global_registry
 
 _QUEUE_DEPTH = global_registry.gauge(
@@ -412,12 +413,18 @@ class _Admission:
             # the admit span's duration IS the queue wait: a trace of a
             # statement that queued shows its sojourn next to the
             # execution spans (and a shed raises inside the span, so
-            # shed traces carry the error and survive tail sampling)
+            # shed traces carry the error and survive tail sampling).
+            # The same sojourn lands on the statement's statistics row
+            # (stmt_stats queue-time histogram); a shed raises typed
+            # and is classified by status code at the fold.
             from greptimedb_tpu.telemetry import tracing
 
+            t0 = time.monotonic()
             with tracing.child_span("sched.admit",
                                     tenant=self._tenant):
                 self._c._acquire(self._tenant, self.deadline)
+            stmt_stats.add("queue_ms",
+                           (time.monotonic() - t0) * 1000.0)
         except BaseException:
             _deadline.reset(self._dl_token)
             self._dl_token = None

@@ -179,7 +179,11 @@ class FlightServer(flight.FlightServerBase):
             except (ValueError, KeyError):
                 pass
         with tracing.start_remote(tp, "flight sql", db=db):
-            outs = self.instance.execute_sql(sql, QueryContext(database=db))
+            # channel tagged so the fingerprint row attributes its
+            # traffic to the Flight wire (statement statistics)
+            outs = self.instance.execute_sql(
+                sql, QueryContext(database=db, channel="flight")
+            )
         out = outs[-1]
         if out.result is None:
             # DML/DDL ack: marked in schema metadata so remote frontends

@@ -183,6 +183,7 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             "/v1/prometheus/read", "/v1/influxdb/", "/influxdb/",
             "/v1/events", "/v1/opentsdb/api/put", "/api/put",
             "/v1/otlp/v1/metrics", "/v1/traces", "/v1/traces/",
+            "/v1/stats/statements",
             "/debug/prof/cpu", "/debug/prof/mem", "/debug/prof/hbm",
         )
 
@@ -249,7 +250,7 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             self._dispatch("POST")
 
         _UNTRACED = ("/health", "/ready", "/-/healthy", "/-/ready",
-                     "/metrics", "/v1/traces")
+                     "/metrics", "/v1/traces", "/v1/stats/statements")
 
         def _dispatch(self, method: str):
             from greptimedb_tpu.telemetry import tracing
@@ -277,10 +278,13 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                     )
 
                     try:
-                        check_basic_auth(
+                        # stashed for the route handlers: /v1/sql tags
+                        # the statement's tenant (admission + statement
+                        # statistics) without re-validating credentials
+                        self._auth_user = check_basic_auth(
                             self.headers.get("Authorization"),
                             user_provider,
-                        )
+                        ) or ""
                     except AccessDeniedError as e:
                         body = json.dumps(
                             {"error": str(e), "code": 401}
@@ -360,6 +364,27 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 return self._json(
                     200, {"traces": global_traces.traces(limit)}
                 )
+            if path == "/v1/stats/statements":
+                # the aggregate statement-statistics registry
+                # (telemetry/stmt_stats.py), ordered + bounded:
+                # ?order_by=calls|total_ms|p99_ms|...&limit=N
+                from greptimedb_tpu.telemetry.stmt_stats import (
+                    global_stmt_stats,
+                )
+
+                params = self._params()
+                try:
+                    limit = int(params.get("limit", "0") or 0)
+                except ValueError:
+                    return self._error(400, "bad limit")
+                if limit < 0:
+                    return self._error(400, "bad limit")
+                return self._json(200, {
+                    "statements": global_stmt_stats.snapshot(
+                        order_by=params.get("order_by", "total_ms"),
+                        limit=limit,
+                    ),
+                })
             if path == "/debug/prof/cpu":
                 # sampling CPU profile of the whole process (pprof
                 # analog, src/servers/src/http/pprof.rs)
@@ -506,6 +531,10 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             if fmt not in ("csv", "table", "greptimedb_v1"):
                 return self._error(400, f"unknown format {fmt!r}")
             ctx = QueryContext(database=db)
+            # the dispatch gate validated the Authorization header and
+            # stashed the user: the tenant on admission + statement-
+            # statistics rows, with no second credential check
+            ctx.username = getattr(self, "_auth_user", "")
             # per-request deadline: ?timeout=<seconds> or the
             # X-Greptime-Timeout header override the [scheduler]
             # default; the admission controller binds it end to end

@@ -213,6 +213,9 @@ class _Handler(socketserver.BaseRequestHandler):
             return
         if db:
             ctx.database = db
+        # tenant identity for admission + statement statistics: the
+        # fingerprint rows this connection produces carry the user
+        ctx.username = user or ""
         conn.send_packet(self._ok())
         # binary prepared statements: per-connection registry
         # stmt_id -> [sql, n_params, last_bound_types]

@@ -258,6 +258,8 @@ class _Handler(socketserver.BaseRequestHandler):
         ctx = QueryContext(channel="postgres")
         if params.get("database"):
             ctx.database = params["database"]
+        # tenant identity for admission + statement statistics
+        ctx.username = user or ""
         inst = server.instance
         prepared: dict[str, str] = {}
         portals: dict[str, str] = {}

@@ -26,7 +26,7 @@ from __future__ import annotations
 import time
 
 from greptimedb_tpu import concurrency
-from greptimedb_tpu.telemetry import tracing
+from greptimedb_tpu.telemetry import stmt_stats, tracing
 
 # (site, static program key) shapes this process has already executed:
 # membership decides first_call vs cache_hit attribution. Bounded the
@@ -56,17 +56,26 @@ class device_call:
     block_until_ready so execute time splits from readback, and
     `d.transfer(nbytes, "upload"|"readback")` for tunnel traffic."""
 
-    __slots__ = ("_cm", "_span", "_mono0", "site")
+    __slots__ = ("_cm", "_span", "_mono0", "site", "_stmt")
 
     def __init__(self, site: str, *, key=None, **attrs):
         self.site = site
-        # skip the compile-memo lookup entirely off-trace: the memo
-        # only feeds the span attribute, and the hot path must stay
-        # zero-cost when no trace is active
-        if tracing.enabled() and tracing.current_span() is not None:
+        # skip the compile-memo lookup entirely when NEITHER a trace
+        # nor a statement observation is active: the memo only feeds
+        # attribution, and the bare hot path must stay zero-cost
+        self._stmt = stmt_stats.active() is not None
+        traced = tracing.enabled() and tracing.current_span() is not None
+        if traced or self._stmt:
+            comp = note_compile(site, key)
+            if self._stmt:
+                # per-statement compile-vs-program-cache attribution:
+                # a repeatedly polled fingerprint shows compile=1 /
+                # cache_hit=N-1 in statement_statistics
+                stmt_stats.add("compile_first" if comp == "first_call"
+                               else "compile_cache_hit")
+        if traced:
             self._cm = tracing.child_span(
-                "device.execute", site=site,
-                compile=note_compile(site, key), **attrs,
+                "device.execute", site=site, compile=comp, **attrs,
             )
         else:
             self._cm = tracing.child_span("device.execute")
@@ -89,6 +98,10 @@ class device_call:
         key = f"{direction}_bytes"
         attrs = self._span.attributes
         attrs[key] = int(attrs.get(key, 0)) + int(nbytes)
+        if self._stmt and direction == "upload":
+            # readback bytes are attributed (full vs delta) at the one
+            # blessed crossing in query/readback.py; uploads only here
+            stmt_stats.add("upload_bytes", int(nbytes))
 
     def __exit__(self, exc_type, exc, tb):
         sp = self._span

@@ -70,6 +70,13 @@ class ExportMetricsTask:
 
     def start(self):
         self.instance.catalog.create_database(self.db, if_not_exists=True)
+        # one immediate tick BEFORE the interval loop: the first
+        # samples land at startup, not a full interval_s later (an
+        # operator querying greptime_metrics right after boot sees
+        # data; the loop thread then keeps the cadence). A failing
+        # first tick must not abort startup — it counts like a loop
+        # failure and the loop retries.
+        self._safe_tick()
         self._thread = concurrency.Thread(
             target=self._loop, daemon=True, name="export-metrics"
         )
@@ -77,36 +84,51 @@ class ExportMetricsTask:
         return self
 
     def tick(self):
-        """One scrape+import cycle (also called by the loop)."""
+        """One scrape+import cycle (also called by the loop). Duration
+        lands on the greptime_export_metrics_duration_seconds histogram
+        so a slow scrape/import (large registry, slow storage) is
+        visible before it starts eating the interval."""
+        import time as _time
+
         from greptimedb_tpu.servers.prom_store import apply_series
 
-        series = scrape_registry()
-        if series:
-            self.samples_written += apply_series(
-                self.instance, series, db=self.db
-            )
-        self.runs += 1
+        t0 = _time.perf_counter()
+        try:
+            series = scrape_registry()
+            if series:
+                self.samples_written += apply_series(
+                    self.instance, series, db=self.db
+                )
+            self.runs += 1
+        finally:
+            global_registry.histogram(
+                "greptime_export_metrics_duration_seconds",
+                "wall time of one metrics self-export tick",
+            ).observe(_time.perf_counter() - t0)
 
-    def _loop(self):
+    def _safe_tick(self):
         import logging
 
+        try:
+            self.tick()
+        except Exception as e:  # export must never take the node down,
+            # but persistent failures need a trace: log each distinct
+            # error once and count every failure in the registry
+            self.failures += 1
+            global_registry.counter(
+                "greptime_export_metrics_failures_total",
+                "metrics self-export tick failures",
+            ).inc()
+            msg = f"{type(e).__name__}: {e}"
+            if msg != self._last_error:
+                self._last_error = msg
+                logging.getLogger("greptimedb_tpu.export").warning(
+                    "metrics self-export failing: %s", msg
+                )
+
+    def _loop(self):
         while not self._stop.wait(self.interval_s):
-            try:
-                self.tick()
-            except Exception as e:  # export must never take the node down,
-                # but persistent failures need a trace: log each distinct
-                # error once and count every failure in the registry
-                self.failures += 1
-                global_registry.counter(
-                    "greptime_export_metrics_failures_total",
-                    "metrics self-export tick failures",
-                ).inc()
-                msg = f"{type(e).__name__}: {e}"
-                if msg != self._last_error:
-                    self._last_error = msg
-                    logging.getLogger("greptimedb_tpu.export").warning(
-                        "metrics self-export failing: %s", msg
-                    )
+            self._safe_tick()
 
     def stop(self):
         self._stop.set()

@@ -31,12 +31,15 @@ class SlowQueryLog:
         self.total_recorded = 0
 
     def maybe_record(self, sql: str, elapsed_s: float, *, db: str = "",
-                     channel: str = "", trace_id: str | None = None):
+                     channel: str = "", trace_id: str | None = None,
+                     fingerprint: str = ""):
         """Record one slow statement. `elapsed_s` MUST come from the
         monotonic clock (time.monotonic()/perf_counter deltas, never
         time.time() arithmetic — gtlint GT011); ts_ms below is an
         epoch-ms display timestamp only. `trace_id` links the entry to
-        its trace in /v1/traces + information_schema.traces."""
+        its trace in /v1/traces + information_schema.traces;
+        `fingerprint` (the batch's first statement) joins it to its
+        aggregate `information_schema.statement_statistics` row."""
         if not self.enable or elapsed_s < self.threshold_s:
             return
         if self.sample_ratio < 1.0 and random.random() > self.sample_ratio:
@@ -49,6 +52,7 @@ class SlowQueryLog:
             "schema": db,
             "channel": channel,
             "trace_id": trace_id or "",
+            "fingerprint": fingerprint or "",
         }
         with self._lock:
             self._ring.append(entry)

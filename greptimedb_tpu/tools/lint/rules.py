@@ -983,3 +983,78 @@ class MutableDefaultArg(Rule):
 
     visit_FunctionDef = _check
     visit_AsyncFunctionDef = _check
+
+
+# metric-registration receivers GT017 inspects: the in-process
+# prometheus registries (global_registry / a local `registry` /
+# `self._registry` handle). Unrelated `.counter(...)` methods on other
+# objects stay silent.
+_GT017_KINDS = ("counter", "gauge", "histogram")
+_GT017_TIME_TOKENS = ("seconds", "duration", "latency", "_time",
+                      "elapsed", "_ms")
+
+
+@register
+class MetricNamingConvention(Rule):
+    id = "GT017"
+    name = "metric-naming-convention"
+    description = (
+        "Prometheus naming conventions keep the exported surface "
+        "machine-readable: counter names end `_total`, a histogram "
+        "measuring time carries its unit suffix (`_seconds` or `_ms`, "
+        "matching what it observes), and label names are lowercase "
+        "(dashboards and the self-export reingest key on exact label "
+        "names)."
+    )
+
+    @staticmethod
+    def _registry_receiver(node: ast.Call) -> bool:
+        f = dotted_name(node.func)
+        if f is None:
+            return False
+        parts = f.split(".")
+        if parts[-1] not in _GT017_KINDS or len(parts) < 2:
+            return False
+        recv = parts[-2].lstrip("_").lower()
+        return recv == "registry" or recv.endswith("registry")
+
+    @staticmethod
+    def _literal(node) -> str | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        return None
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        if not self._registry_receiver(node):
+            return
+        kind = dotted_name(node.func).split(".")[-1]
+        name = self._literal(node.args[0]) if node.args else None
+        if name is not None:
+            if kind == "counter" and not name.endswith("_total"):
+                ctx.report(self, node,
+                           f"counter {name!r} must end in '_total' "
+                           "(prometheus counter naming convention)")
+            if kind == "histogram":
+                low = name.lower()
+                timeish = any(t in low for t in _GT017_TIME_TOKENS)
+                if timeish and not (low.endswith("_seconds")
+                                    or low.endswith("_ms")):
+                    ctx.report(self, node,
+                               f"time histogram {name!r} must carry "
+                               "its unit suffix ('_seconds' or '_ms' "
+                               "matching the observed unit)")
+        # label names: the `labels=` keyword (or third positional arg)
+        labels_node = None
+        for kw in node.keywords:
+            if kw.arg == "labels":
+                labels_node = kw.value
+        if labels_node is None and len(node.args) >= 3:
+            labels_node = node.args[2]
+        if isinstance(labels_node, (ast.Tuple, ast.List)):
+            for el in labels_node.elts:
+                lab = self._literal(el)
+                if lab is not None and lab != lab.lower():
+                    ctx.report(self, el,
+                               f"label name {lab!r} must be lowercase "
+                               "(exported label names are part of the "
+                               "query surface)")

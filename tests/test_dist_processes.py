@@ -741,3 +741,55 @@ def test_distributed_trace_stitching(tmp_path):
                 p.kill()
         for log in logs:
             log.close()
+
+
+def test_dist_statement_statistics_fold_one_row(topology):
+    """A distributed query's per-datanode rpc time folds into ONE
+    fingerprint row on the FRONTEND: repeated polls of a decomposable
+    GROUP BY (fanned over 3 datanode processes) land on a single
+    statement_statistics row whose datanode count and rpc_ms reflect
+    every fan-out leg, with exec_path=dist."""
+    fe = topology["frontend"]
+    _sql(fe, "create table cpu (ts timestamp time index, host string "
+             "primary key, usage double) with (num_regions = 3)")
+    values = ", ".join(
+        f"('h{i % 5}', {1_700_000_000_000 + p * 5_000}, {i + p})"
+        for p in range(6) for i in range(5)
+    )
+    _sql(fe, f"insert into cpu (host, ts, usage) values {values}")
+
+    n = 4
+    for _ in range(n - 1):
+        # identical polls: the repeats hit the datanode scan caches
+        doc = _sql(fe, "select host, count(usage), sum(usage) from cpu "
+                       "where ts > 0 group by host order by host")
+        assert len(_rows(doc)) == 5
+    # a different literal is the SAME fingerprint (normalization)
+    doc = _sql(fe, "select host, count(usage), sum(usage) from cpu "
+                   "where ts > 7 group by host order by host")
+    assert len(_rows(doc)) == 5
+
+    doc = _sql(fe, "select calls, datanodes, rpc_ms, exec_path, "
+                   "scan_cache_hit_rate from "
+                   "information_schema.statement_statistics "
+                   "where query like '%count ( usage )%' "
+                   "and query like '%where%'")
+    rows = _rows(doc)
+    assert len(rows) == 1, f"polls must fold into ONE row: {rows}"
+    calls, datanodes, rpc_ms, exec_path, sc_rate = rows[0]
+    assert calls == n
+    # every poll fanned out to all 3 datanode processes
+    assert datanodes == 3 * n
+    assert rpc_ms > 0.0
+    assert exec_path == "dist"
+    # repeated identical scans warm the datanode merged-scan caches
+    assert sc_rate > 0.0
+
+    # the HTTP face serves the same row
+    with urllib.request.urlopen(
+        f"http://{fe}/v1/stats/statements?order_by=rpc_ms&limit=1",
+        timeout=10,
+    ) as resp:
+        top = json.loads(resp.read())["statements"][0]
+    assert top["datanodes"] == 3 * n
+    assert top["exec_path"] == "dist"

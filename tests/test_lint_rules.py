@@ -35,7 +35,7 @@ def rules_hit(src: str, select: str | None = None):
 
 def test_registry_has_all_rules():
     ids = sorted(all_rules())
-    assert ids == [f"GT{n:03d}" for n in range(1, 17)]
+    assert ids == [f"GT{n:03d}" for n in range(1, 18)]
     for rule in all_rules().values():
         assert rule.name and rule.description
 
@@ -1378,6 +1378,63 @@ def test_gt012_suppressible():
             return conn.do_put(desc, schema)
     """, "GT012")
     assert act == [] and [f.rule for f in sup] == ["GT012"]
+
+
+# ---------------------------------------------------------------------------
+# GT017 metric naming conventions
+# ---------------------------------------------------------------------------
+
+def test_gt017_positive_counter_without_total():
+    hits = rules_hit("""
+        from greptimedb_tpu.telemetry.metrics import global_registry
+
+        C = global_registry.counter("gtpu_things", "things counted")
+    """, select="GT017")
+    assert hits == [("GT017", 4)]
+
+
+def test_gt017_positive_time_histogram_without_unit():
+    hits = rules_hit("""
+        H = global_registry.histogram(
+            "gtpu_query_latency", "query latency",
+        )
+    """, select="GT017")
+    assert [h[0] for h in hits] == ["GT017"]
+    # _ms is as valid a unit suffix as _seconds
+    assert rules_hit("""
+        H = registry.histogram("gtpu_stage_duration_ms", "stage time")
+    """, select="GT017") == []
+
+
+def test_gt017_positive_uppercase_label():
+    hits = rules_hit("""
+        C = global_registry.counter(
+            "gtpu_sheds_total", "sheds",
+            labels=("Tenant", "reason"),
+        )
+    """, select="GT017")
+    assert hits == [("GT017", 4)]
+
+
+def test_gt017_negative_conforming_and_foreign_receivers():
+    # conforming registrations: no findings
+    assert rules_hit("""
+        C = global_registry.counter(
+            "gtpu_calls_total", "calls", labels=("db", "code"),
+        )
+        G = global_registry.gauge("gtpu_depth", "queue depth")
+        H = self._registry.histogram(
+            "gtpu_queue_time_seconds", "sojourn",
+        )
+        B = registry.histogram("gtpu_batch_rows", "rows per batch")
+    """, select="GT017") == []
+    # .counter()/.histogram() on a NON-registry receiver is not a
+    # metric registration
+    assert rules_hit("""
+        n = collections.Counter()
+        x = stats.counter("whatever")
+        y = panel.histogram("Latency")
+    """, select="GT017") == []
 
 
 # ---------------------------------------------------------------------------
