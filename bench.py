@@ -326,6 +326,199 @@ def _stmt_stats_overhead_line() -> str | None:
     })
 
 
+# the flagship double-groupby shape with the device-program profiler
+# on vs off, in ALTERNATING child processes (ISSUE 14). Sessions are
+# DISABLED in both modes so every poll actually DISPATCHES a program —
+# with session buffers on, warm polls skip the dispatch and there is
+# nothing for the profiler to fold. The ratio is
+# `device_profiler_overhead_pct` with a HARD <= 3% gate, and the "on"
+# child additionally asserts the roofline contract: every dispatched
+# program carries a bound=compute|memory verdict, every program with a
+# steady-state sample carries %-of-peak > 0, and the three surfaces
+# (registry snapshot == information_schema.device_programs ==
+# gtpu_device_program_* metrics) agree exactly.
+_DEVICE_PROF_PROBE = r"""
+import sys, time, tempfile, shutil
+import numpy as np
+
+mode = sys.argv[1]
+from greptimedb_tpu.telemetry import device_programs
+# explicit CPU peaks: the roofline verdict needs hardware peaks, and
+# the bench box is not a TPU (where v5e defaults would kick in).
+# Nominal single-core numbers; cache-resident working sets can still
+# exceed the DRAM figure — the verdict, not the precise pct, is the
+# contract here
+device_programs.configure({
+    "enable": mode == "on",
+    "peak_tflops": 0.5, "peak_hbm_gbps": 200.0,
+})
+from greptimedb_tpu.query import sessions
+sessions.configure({"enable": False})
+from greptimedb_tpu.instance import Standalone
+
+tmp = tempfile.mkdtemp(prefix="gtpu_devprof_probe_")
+try:
+    inst = Standalone(tmp, prefer_device=True, warm_start=False)
+    fields = ["usage_user", "usage_system"]
+    cols = ", ".join(f"{f} double" for f in fields)
+    inst.execute_sql(
+        f"create table cpu (ts timestamp time index, "
+        f"hostname string primary key, {cols})"
+    )
+    table = inst.catalog.table("public", "cpu")
+    rng = np.random.default_rng(7)
+    nh = 2048
+    hosts = np.asarray([f"host_{i}" for i in range(nh)], dtype=object)
+    cells = 720  # 2h at 10s
+    ts = np.tile(np.arange(cells, dtype=np.int64) * 10_000, nh)
+    hs = np.repeat(hosts, cells)
+    n = len(ts)
+    data = {f: rng.random(n) * 100.0 for f in fields}
+    table.write({"hostname": hs}, ts, data, skip_wal=True)
+    table.flush()
+    items = ", ".join(
+        f"{op}({f}) RANGE '1h'"
+        for f in fields for op in ("avg", "max", "min", "sum")
+    )
+    query = (f"SELECT ts, hostname, {items} FROM cpu "
+             f"ALIGN '1h' BY (hostname)")
+    inst.sql(query)  # warm: grid build + XLA compile
+    import gc
+
+    gc.disable()  # a collection mid-loop would swamp the ~us effect
+    try:
+        best = 1e9
+        for _ in range(60):
+            t0 = time.perf_counter()
+            inst.sql(query)
+            best = min(best, time.perf_counter() - t0)
+    finally:
+        gc.enable()
+    if mode == "on":
+        import json as _json
+        from greptimedb_tpu.telemetry.device_programs import (
+            global_programs,
+        )
+        from greptimedb_tpu.telemetry.metrics import global_registry
+
+        # a ts-bounded twin of the same window re-dispatches the
+        # memoized prelude (same program, new memo key) and a GROUP BY
+        # exercises the fused-reduce program, so every site has a
+        # steady-state sample behind its %-of-peak
+        inst.sql(query.replace("FROM cpu ", "FROM cpu WHERE ts >= 0 "))
+        inst.sql(query.replace("FROM cpu ", "FROM cpu WHERE ts >= 0 "))
+        for _ in range(3):
+            inst.sql("SELECT hostname, avg(usage_user) FROM cpu "
+                     "GROUP BY hostname")
+        docs = [d for d in global_programs.snapshot()
+                if d["program"] != "_other"]
+        assert docs, "no device-program rows after the flagship run"
+        # 3-surface agreement: registry == information_schema == metrics
+        info = inst.sql(
+            "SELECT site, program, calls, bound, pct_of_peak "
+            "FROM information_schema.device_programs"
+        ).rows()
+        info_map = {(r[0], r[1]): (r[2], r[3], r[4]) for r in info}
+        global_registry.render()  # refresh the pull-model families
+        m_calls = global_registry.get("gtpu_device_program_calls_total")
+        m_pct = global_registry.get("gtpu_device_program_pct_of_peak")
+        for d in docs:
+            key = (d["site"], d["program"])
+            assert info_map.get(key) == (
+                d["calls"], d["bound"], d["pct_of_peak"]
+            ), f"information_schema disagrees for {key}: " \
+               f"{info_map.get(key)} vs {d}"
+            assert m_calls.labels(*key).value == d["calls"], key
+            assert abs(m_pct.labels(*key).value - d["pct_of_peak"]) \
+                < 1e-9, key
+        for d in docs:
+            assert d["analysis"] == "ok", d
+            assert d["bound"] in ("compute", "memory"), d
+            assert d["flops"] > 0, d
+        # every site was given a steady-state sample above, so the
+        # %-of-peak contract is unconditional across the board
+        steady = [d for d in docs if d["pct_of_peak"] > 0]
+        assert len(steady) == len(docs), (
+            "every dispatched program must carry %-of-peak",
+            [d for d in docs if d["pct_of_peak"] <= 0],
+        )
+        print("PROGRAMS " + _json.dumps([
+            {k: d[k] for k in ("site", "program", "calls", "bound",
+                               "pct_of_peak", "achieved_gflops",
+                               "achieved_hbm_gbps", "flops",
+                               "compile_ms")}
+            for d in docs
+        ]))
+    print(best)
+    inst.close()
+finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+"""
+
+
+def _device_profiler_overhead_line() -> str | None:
+    """Flagship-shape query wall time with the device-program profiler
+    enabled vs disabled, in alternating child processes (sessions off
+    so every poll dispatches — the profiler folds per DISPATCH). The
+    on-child also enforces the roofline contract; its per-program
+    verdicts ride the emitted line."""
+    import os
+    import subprocess
+
+    def one(mode: str) -> tuple[float, list]:
+        p = subprocess.run(
+            [sys.executable, "-c", _DEVICE_PROF_PROBE, mode],
+            stdout=subprocess.PIPE, text=True, timeout=600,
+            env=dict(os.environ),
+        )
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"probe exited {p.returncode}: {p.stdout[-500:]}"
+            )
+        out = p.stdout.strip().splitlines()
+        programs = []
+        for ln in out:
+            if ln.startswith("PROGRAMS "):
+                programs = json.loads(ln[len("PROGRAMS "):])
+        return float(out[-1]), programs
+
+    try:
+        rounds = []
+        programs: list = []
+        for _ in range(5):
+            off, _n = one("off")
+            on, progs = one("on")
+            programs = progs or programs
+            rounds.append((on, off))
+        off_s = min(off for _, off in rounds)
+        on_s = min(on for on, _ in rounds)
+    except Exception as e:  # noqa: BLE001 - additive metric only
+        print(f"# device-profiler overhead probe failed: {e}",
+              file=sys.stderr)
+        return None
+    pct = (on_s / max(off_s, 1e-9) - 1.0) * 100.0
+    # the gate is HARD (ISSUE 14): per-dispatch registry folding past
+    # 3% on the flagship shape is a regression
+    assert pct <= 3.0, (
+        f"device profiler overhead {pct:.1f}% exceeds the 3% gate "
+        f"(floor over 5 alternating rounds; "
+        f"on {on_s * 1000:.2f}ms vs off {off_s * 1000:.2f}ms)"
+    )
+    assert programs, "the on-child reported no program verdicts"
+    return json.dumps({
+        "metric": "device_profiler_overhead_pct",
+        "value": round(pct, 1),
+        "unit": "%",
+        "off_ms": round(off_s * 1000.0, 3),
+        "on_ms": round(on_s * 1000.0, 3),
+        "rounds": [[round(on * 1000.0, 3), round(off * 1000.0, 3)]
+                   for on, off in rounds],
+        # per-program roofline verdicts from the flagship run (every
+        # surface agreed; see _DEVICE_PROF_PROBE asserts)
+        "programs": programs,
+    })
+
+
 def _san_overhead_line() -> str | None:
     """Wall-time of the concurrency micro-suite with vs without
     GTPU_SAN=1 (best of 3 each, child processes so the env gate is the
@@ -450,6 +643,9 @@ def main():
         stmt_line = _stmt_stats_overhead_line()
         if stmt_line:
             lines.append(stmt_line)
+        devprof_line = _device_profiler_overhead_line()
+        if devprof_line:
+            lines.append(devprof_line)
         _emit_ordered(lines, cold_line)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -1903,7 +2099,12 @@ DASH_INTERVAL_MS = 10_000
 DASH_POLLS = 40             # warm polls per panel
 DASH_RATE = 100.0           # open-loop arrival rate (polls/s, all panels)
 DASH_WORKERS = 4
-DASH_P50_TARGET_MS = 40.0   # vs the ~106ms wire/readback floor (r05)
+# db+serve budget ON TOP of the measured no-op HTTP round-trip floor:
+# the gate is `noop_p50 + budget`, so it catches engine/result-path
+# regressions instead of the box (PR 13 note: a 1-core box pays ~44ms
+# of pure HTTP socket scheduling for a 0.6ms db-time poll — a fixed
+# 40ms wall gate failed at baseline there)
+DASH_P50_BUDGET_MS = 40.0   # vs the ~106ms wire/readback floor (r05)
 DASH_HIT_RATE_TARGET = 0.9
 DASH_DELTA_FRACTION = 0.10  # delta readback must stay under 10% of full
 
@@ -1921,13 +2122,9 @@ class _KeepAliveConn:
         )
         self._conn = self._mk()
 
-    def sql(self, q: str, since=None) -> dict:
+    def get(self, path: str) -> dict:
         import http.client
-        import urllib.parse
 
-        path = "/v1/sql?sql=" + urllib.parse.quote(q)
-        if since is not None:
-            path += f"&since={int(since)}"
         for attempt in (0, 1):
             try:
                 self._conn.request("GET", path)
@@ -1941,6 +2138,14 @@ class _KeepAliveConn:
                 self._conn.close()
                 self._conn = self._mk()
         raise AssertionError("unreachable")
+
+    def sql(self, q: str, since=None) -> dict:
+        import urllib.parse
+
+        path = "/v1/sql?sql=" + urllib.parse.quote(q)
+        if since is not None:
+            path += f"&since={int(since)}"
+        return self.get(path)
 
     def close(self):
         self._conn.close()
@@ -2015,18 +2220,64 @@ def _pct(sorted_vals, q):
                            int(q * len(sorted_vals)))]
 
 
+def _dash_storm(port: int, n_polls: int, do_poll):
+    """Open-loop poll storm: DASH_WORKERS keep-alive workers draining
+    a fixed DASH_RATE arrival schedule with no backoff. do_poll(conn,
+    i) performs one poll and returns its db-time ms; the storm records
+    (wall_ms, db_ms) per poll."""
+    import threading
+
+    schedule = [i / DASH_RATE for i in range(n_polls)]
+    results: list[tuple[float, float]] = []
+    res_lock = threading.Lock()
+    idx = [0]
+
+    def worker():
+        conn = _KeepAliveConn(port)
+        try:
+            while True:
+                with res_lock:
+                    i = idx[0]
+                    if i >= n_polls:
+                        return
+                    idx[0] += 1
+                target = t_start + schedule[i]
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                t0 = time.perf_counter()
+                db = do_poll(conn, i)
+                wall = (time.perf_counter() - t0) * 1000
+                with res_lock:
+                    results.append((wall, float(db)))
+        finally:
+            conn.close()
+
+    t_start = time.perf_counter()
+    workers = [
+        threading.Thread(target=worker, daemon=True, name=f"dash-{i}")
+        for i in range(DASH_WORKERS)
+    ]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=120)
+    return results
+
+
 def dashboard_probe(base_dir: str | None = None):
     """Open-loop repeated-poll panel workload over HTTP with keep-alive
     connections and `since` delta cursors: N panels x M polls against a
     result-cache-enabled standalone. Reports end-to-end raw_wall
-    p50/p99 alongside db time; asserts warm-poll p50 <= 40ms (vs the
-    ~106ms wire/readback floor of BENCH_r05), result-cache hit rate >=
-    0.9 on the steady-state loop, delta readback bytes < 10% of
-    full-result bytes, and dist/standalone + cached/uncached parity."""
+    p50/p99 alongside db time; asserts warm-poll p50 <= the gate
+    derived from a measured no-op HTTP round-trip floor (same storm
+    harness polling /health) + a 40ms db/serve budget, result-cache
+    hit rate >= 0.9 on the steady-state loop, delta readback bytes <
+    10% of full-result bytes, and dist/standalone + cached/uncached
+    parity."""
     import os
     import shutil as _shutil
     import tempfile as _tempfile
-    import threading
 
     from greptimedb_tpu.instance import Standalone
     from greptimedb_tpu.query.result_cache import ResultCache
@@ -2068,52 +2319,35 @@ def dashboard_probe(base_dir: str | None = None):
             _dash_counter("gtpu_readback_bytes_total", "full") - full_rb0
         )
 
+        # ---- no-op HTTP floor: the SAME open-loop storm harness
+        # (worker count, arrival rate, keep-alive connections) polling
+        # /health — what this box charges for a round trip with ZERO
+        # engine work. The warm-poll gate derives from it so it
+        # catches result-path regressions, not HTTP socket scheduling
+        # on a loaded 1-core box.
+        n_polls = DASH_POLLS * len(panels)
+        noop_results = _dash_storm(
+            srv.port, n_polls,
+            lambda conn, i: (conn.get("/health"), 0.0)[1],
+        )
+        assert len(noop_results) == n_polls, (
+            len(noop_results), n_polls,
+        )
+        noop_p50 = _pct(sorted(w for w, _ in noop_results), 0.50)
+        gate_ms = noop_p50 + DASH_P50_BUDGET_MS
+
         # ---- warm open-loop poll storm: since = watermark - 1 window
         # (each poll re-reads the last window, the dashboard steady
         # state), fixed arrival rate, no backoff
         h0 = _dash_counter("gtpu_result_cache_hits_total")
         m0 = _dash_counter("gtpu_result_cache_misses_total")
-        n_polls = DASH_POLLS * len(panels)
-        schedule = [i / DASH_RATE for i in range(n_polls)]
-        results: list[tuple[float, float]] = []
-        res_lock = threading.Lock()
-        idx = [0]
 
-        def worker():
-            conn = _KeepAliveConn(srv.port)
-            try:
-                while True:
-                    with res_lock:
-                        i = idx[0]
-                        if i >= n_polls:
-                            return
-                        idx[0] += 1
-                    target = t_start + schedule[i]
-                    delay = target - time.perf_counter()
-                    if delay > 0:
-                        time.sleep(delay)
-                    p = i % len(panels)
-                    t0 = time.perf_counter()
-                    doc = conn.sql(panels[p],
-                                   since=watermarks[p] - 60_000)
-                    wall = (time.perf_counter() - t0) * 1000
-                    with res_lock:
-                        results.append(
-                            (wall, float(doc["execution_time_ms"]))
-                        )
-            finally:
-                conn.close()
+        def poll_panel(conn, i):
+            p = i % len(panels)
+            doc = conn.sql(panels[p], since=watermarks[p] - 60_000)
+            return float(doc["execution_time_ms"])
 
-        t_start = time.perf_counter()
-        workers = [
-            threading.Thread(target=worker, daemon=True,
-                             name=f"dash-{i}")
-            for i in range(DASH_WORKERS)
-        ]
-        for w in workers:
-            w.start()
-        for w in workers:
-            w.join(timeout=120)
+        results = _dash_storm(srv.port, n_polls, poll_panel)
         assert len(results) == n_polls, (len(results), n_polls)
         hits = _dash_counter("gtpu_result_cache_hits_total") - h0
         misses = _dash_counter("gtpu_result_cache_misses_total") - m0
@@ -2212,9 +2446,10 @@ def dashboard_probe(base_dir: str | None = None):
             assert d["exec_path"] == "device", d
 
         # ---- report + assert ---------------------------------------
-        assert warm_p50 <= DASH_P50_TARGET_MS, (
-            f"warm-poll p50 {warm_p50:.1f}ms exceeds the "
-            f"{DASH_P50_TARGET_MS}ms target"
+        assert warm_p50 <= gate_ms, (
+            f"warm-poll p50 {warm_p50:.1f}ms exceeds the derived gate "
+            f"{gate_ms:.1f}ms (no-op HTTP floor p50 {noop_p50:.1f}ms "
+            f"+ {DASH_P50_BUDGET_MS}ms db/serve budget)"
         )
         assert hit_rate >= DASH_HIT_RATE_TARGET, (
             f"result-cache hit rate {hit_rate:.2f} below "
@@ -2233,6 +2468,11 @@ def dashboard_probe(base_dir: str | None = None):
             # metric paid in BENCH_r05
             "vs_baseline": round(106.0 / max(warm_p50, 1e-9), 2),
             "warm_poll_p99_ms": round(warm_p99, 3),
+            # the measured zero-engine-work HTTP round trip this box
+            # pays under the same storm harness, and the gate derived
+            # from it (noop_p50 + budget)
+            "noop_http_p50_ms": round(noop_p50, 3),
+            "warm_poll_gate_ms": round(gate_ms, 3),
             "db_time_p50_ms": round(_pct(dbs, 0.50), 3),
             "cold_poll_ms_median": round(
                 sorted(cold_walls)[len(cold_walls) // 2], 3

@@ -389,6 +389,10 @@ def _make_instance(opts):
     from greptimedb_tpu.telemetry import stmt_stats as _stmt_stats
 
     _stmt_stats.configure(opts.section("stmt_stats"))
+    # [profiling] knobs: device-program registry + roofline peaks
+    from greptimedb_tpu.telemetry import device_programs as _dev_prog
+
+    _dev_prog.configure(opts.section("profiling"))
     prefer_device = opts.get("query.prefer_device")
     inst = Standalone(
         mesh=mesh, mesh_opts=mesh_opts,
@@ -559,6 +563,7 @@ def _heartbeat_loop(meta_addr: str, node_id: int, inst,
 
 
 def _start_frontend(opts):
+    from greptimedb_tpu.telemetry import device_programs as _dev_prog
     from greptimedb_tpu.telemetry import memory as _memory
     from greptimedb_tpu.telemetry import stmt_stats as _stmt_stats
     from greptimedb_tpu.telemetry import tracing as _tracing
@@ -568,6 +573,9 @@ def _start_frontend(opts):
     # the frontend owns statement execution in a dist topology, so the
     # statement-statistics registry lives here ([stmt_stats] knobs)
     _stmt_stats.configure(opts.section("stmt_stats"))
+    # frontends rarely dispatch programs themselves, but the registry
+    # still profiles any local device path ([profiling] knobs)
+    _dev_prog.configure(opts.section("profiling"))
     meta_addr = opts.get("metasrv.addr") or ""
     if meta_addr:
         # distributed frontend: catalog in the metasrv kv, regions on
@@ -620,6 +628,14 @@ def _start_metasrv(opts):
 def _start_flownode(opts):
     meta_addr = opts.get("metasrv.addr") or ""
     if meta_addr:
+        # flow evals dispatch device programs (flow/device_state.py),
+        # so the dist flownode configures the profiler too (the
+        # standalone path rides _make_instance below)
+        from greptimedb_tpu.telemetry import (
+            device_programs as _dev_prog,
+        )
+
+        _dev_prog.configure(opts.section("profiling"))
         # distributed flownode: shared-kv catalog (source/sink tables
         # are RemoteTables over the datanodes), flows local, mirrored
         # deltas arrive over Flight (dist/frontend.py flow mirroring)

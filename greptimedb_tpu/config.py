@@ -220,6 +220,34 @@ DEFAULTS: dict = {
         "max_fingerprints": 512,
         "metric_fingerprints": 64,
     },
+    # device program profiler (telemetry/device_programs.py): every
+    # jit/shard_map program dispatched through a device_call registers
+    # one row — calls, compile_ms, execute p50/p99, transfer bytes,
+    # XLA cost_analysis flops / bytes accessed, memory_analysis
+    # temp/output bytes, and a roofline verdict (bound=compute|memory,
+    # %-of-peak) against the hardware peaks. Surfaced as
+    # information_schema.device_programs, /debug/prof/device and
+    # gtpu_device_program_* metrics; reset with ADMIN
+    # reset_device_profiler(). peak_tflops / peak_hbm_gbps at 0 mean
+    # auto: TPU backends default to v5e single-chip numbers (197
+    # TFLOP/s bf16, 819 GB/s HBM); CPU runs report achieved-only.
+    # analysis=false skips the lazy XLA cost/memory analysis (rows
+    # keep per-call stats only). trace_dir is where
+    # /debug/prof/device/trace?seconds= writes its TensorBoard/
+    # perfetto-loadable captures ("" = the system temp dir).
+    # metric_programs bounds the /metrics label cardinality (first-
+    # come, like stmt_stats' metric_fingerprints — exported series can
+    # never be evicted, so programs past the cap export under
+    # program="_other").
+    "profiling": {
+        "enable": True,
+        "max_programs": 256,
+        "metric_programs": 128,
+        "peak_tflops": 0.0,
+        "peak_hbm_gbps": 0.0,
+        "analysis": True,
+        "trace_dir": "",
+    },
     "logging": {
         "level": "info",
         # statements slower than threshold land in the slow-query log +

@@ -390,15 +390,36 @@ class DeviceFlowState:
                     np.ones(n, bool) if validity is None
                     else np.asarray(validity, bool)
                 )
-        self.state = _apply_program(
-            self.state,
-            jnp.asarray(gids.astype(np.int32)),
-            jnp.asarray(hi),
-            jnp.asarray(lo),
-            tuple(jnp.asarray(v) for v in vals),
-            tuple(jnp.asarray(h) for h in has),
-            ops=self.ops, g=self.capacity,
+        # flow evals carry the same compile/execute/transfer
+        # attribution (and device-program registry rows) as the query
+        # path. The apply deliberately does NOT block_until_ready —
+        # the delta fold overlaps host work, and the next apply's data
+        # dependency orders it anyway — so the timing is flagged
+        # dispatch_only and the profiler suppresses achieved-rate
+        # claims for this program.
+        from greptimedb_tpu.telemetry import device_trace
+
+        d_gid = jnp.asarray(gids.astype(np.int32))
+        d_hi = jnp.asarray(hi)
+        d_lo = jnp.asarray(lo)
+        d_vals = tuple(jnp.asarray(v) for v in vals)
+        d_has = tuple(jnp.asarray(h) for h in has)
+        upload = int(
+            d_gid.nbytes + d_hi.nbytes + d_lo.nbytes
+            + sum(int(v.nbytes) for v in d_vals)
+            + sum(int(h.nbytes) for h in d_has)
         )
+        with device_trace.device_call(
+                "flow_apply",
+                key=("flow_apply", self.ops, self.capacity),
+                rows=n) as dcall:
+            dcall.transfer(upload, "upload")
+            self.state = dcall.run(
+                _apply_program,
+                self.state, d_gid, d_hi, d_lo, d_vals, d_has,
+                ops=self.ops, g=self.capacity,
+            )
+            dcall.executed(dispatch_only=True)
         self.dirty[np.unique(gids)] = True
         self.processed += n
 
@@ -417,14 +438,31 @@ class DeviceFlowState:
         """Outside the lock: one finalize program for every group; the
         dirty slice is gathered on device so only it crosses to the
         host. Returns (dirty_gids, {agg_idx: (values, present)})."""
+        from greptimedb_tpu.telemetry import device_trace
+
         state, cap, dirty = snap
-        outs, pres = _finalize_program(state, ops=self.ops, g=cap)
-        didx = jnp.asarray(dirty.astype(np.int32))
-        per_agg = {
-            j: (np.asarray(jnp.take(outs[j], didx), np.float64),
-                np.asarray(jnp.take(pres[j], didx), bool))
-            for j in range(len(self.ops))
-        }
+        with device_trace.device_call(
+                "flow_finalize",
+                key=("flow_finalize", self.ops, cap),
+                groups=int(len(dirty))) as dcall:
+            outs, pres = dcall.run(
+                _finalize_program, state, ops=self.ops, g=cap
+            )
+            outs[0].block_until_ready()
+            dcall.executed()
+            didx = jnp.asarray(dirty.astype(np.int32))
+            per_agg = {}
+            nbytes = 0
+            for j in range(len(self.ops)):
+                v_d = jnp.take(outs[j], didx)
+                p_d = jnp.take(pres[j], didx)
+                # count the DEVICE arrays' bytes: the host copies widen
+                # to float64, which would double the reported tunnel
+                # traffic in the platform-float32 device mode
+                nbytes += int(v_d.nbytes) + int(p_d.nbytes)
+                per_agg[j] = (np.asarray(v_d, np.float64),
+                              np.asarray(p_d, bool))
+            dcall.transfer(nbytes)
         return dirty, per_agg
 
     # ---- demotion ------------------------------------------------------

@@ -346,7 +346,7 @@ def _statement_statistics_doc(inst) -> dict[str, list]:
         "readback_delta_bytes", "session_hit_rate",
         "result_cache_hit_rate", "scan_cache_hit_rate", "shed_count",
         "deadline_count", "datanodes", "rpc_ms", "last_trace_id",
-        "first_seen_ms", "last_seen_ms",
+        "program_ids", "first_seen_ms", "last_seen_ms",
     ]
     rows: dict[str, list] = {c: [] for c in cols}
     for doc in global_stmt_stats.snapshot():
@@ -354,6 +354,39 @@ def _statement_statistics_doc(inst) -> dict[str, list]:
             v = doc.get(c)
             if c == "errors_by_code":
                 v = _json.dumps(v or {})
+            elif c == "program_ids":
+                # joins information_schema.device_programs.program
+                v = _json.dumps(v or [])
+            rows[c].append(v)
+    return rows
+
+
+def _device_programs_doc(inst) -> dict[str, list]:
+    """The process-wide device-program profiler
+    (telemetry/device_programs.py), one row per compiled XLA program —
+    the SQL face of /debug/prof/device. Consulting the table triggers
+    the lazy XLA cost/memory analysis, so flops / roofline columns are
+    populated for every analyzable program. `program` joins the
+    statement_statistics `program_ids` column and the `program` attr
+    on device.execute spans."""
+    from greptimedb_tpu.telemetry.device_programs import global_programs
+
+    cols = [
+        "site", "program", "key", "calls", "errors", "compile_ms",
+        "execute_ms_total", "execute_p50_ms", "execute_p99_ms",
+        "device_ms_total", "upload_bytes", "readback_bytes",
+        "dispatch_only", "analysis", "analysis_error", "flops",
+        "bytes_accessed", "temp_bytes", "output_bytes",
+        "argument_bytes", "aot_compile_ms", "achieved_gflops",
+        "achieved_hbm_gbps", "bound", "pct_of_peak", "first_seen_ms",
+        "last_seen_ms",
+    ]
+    rows: dict[str, list] = {c: [] for c in cols}
+    for doc in global_programs.snapshot():
+        for c in cols:
+            v = doc.get(c)
+            if c == "dispatch_only":
+                v = 1 if v else 0
             rows[c].append(v)
     return rows
 
@@ -433,6 +466,7 @@ _PROVIDERS = {
     "traces": _traces_doc,
     "memory_pools": _memory_pools_doc,
     "statement_statistics": _statement_statistics_doc,
+    "device_programs": _device_programs_doc,
 }
 
 

@@ -586,6 +586,18 @@ class Standalone:
             return Output.records(_result_from_lists(
                 [f"ADMIN kill('{target}')"], [[1 if ok else 0]]
             ))
+        if name == "reset_device_profiler":
+            # drops every device-program registry row; the exported
+            # gtpu_device_program_* series zero at the next scrape so
+            # all three surfaces stay equal (documented counter reset)
+            from greptimedb_tpu.telemetry.device_programs import (
+                global_programs,
+            )
+
+            n = global_programs.reset()
+            return Output.records(_result_from_lists(
+                ["ADMIN reset_device_profiler()"], [[n]]
+            ))
         if name == "reset_statement_statistics":
             # pg_stat_statements_reset() analog: drops every registry
             # row; the monotone gtpu_stmt_* counters keep counting

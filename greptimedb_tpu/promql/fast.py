@@ -1027,7 +1027,8 @@ def try_fast_histogram(engine, phi: float, inner, ev):
                                      range_ticks, range_seconds,
                                      l_cells, entry.spec.tps, fargs,
                                      lookback_ticks)) as dcall:
-        packed = _session_exec(entry, skey, lambda: _fused_hist_query(
+        packed = _session_exec(entry, skey, lambda: dcall.run(
+            _fused_hist_query,
             entry.vals, entry.has, entry.tsg, smask, d_gid, d_slot,
             jnp.asarray(uniq_le, jnp.float32), lo, hi, t_end,
             jnp.float32(phi),
@@ -1092,7 +1093,8 @@ def try_fast(engine, e, ev):
                            g, range_ticks, range_seconds, l_cells,
                            entry.spec.tps, fargs, lookback_ticks),
             groups=g) as dcall:
-        packed = _session_exec(entry, skey, lambda: program(
+        packed = _session_exec(entry, skey, lambda: dcall.run(
+            program,
             entry.vals, entry.has, entry.tsg, smask, gid,
             lo, hi, t_end,
             fname=fname, op=e.op, g=g, range_ticks=range_ticks,
@@ -1354,7 +1356,8 @@ def try_fast_topk(engine, e, ev):
                          e.op == "topk", range_ticks, range_seconds,
                          l_cells, entry.spec.tps, fargs,
                          lookback_ticks)) as dcall:
-        packed_dev = _session_exec(entry, skey, lambda: topk_prog(
+        packed_dev = _session_exec(entry, skey, lambda: dcall.run(
+            topk_prog,
             entry.vals, entry.has, entry.tsg, smask, lo, hi, t_end,
             fname=fname, k=kk, largest=e.op == "topk",
             range_ticks=range_ticks, range_seconds=range_seconds,
@@ -1566,7 +1569,8 @@ def try_fast_binary(engine, e, ev, *, agg=None):
                                   rt_r, rs_l, rs_r, lc_l, lc_r,
                                   entry_l.spec.tps, fargs_l, fargs_r,
                                   lookback_ticks)) as dcall:
-        packed = _session_exec(entry_l, skey, lambda: _fused_binary(
+        packed = _session_exec(entry_l, skey, lambda: dcall.run(
+            _fused_binary,
             entry_l.vals, entry_l.has, entry_l.tsg, smask_l,
             lo_l, hi_l, t_end_l,
             entry_r.vals, entry_r.has, entry_r.tsg, smask_r,

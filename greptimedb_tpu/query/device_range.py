@@ -1053,21 +1053,33 @@ def _precompile_loop(entry, doc, entry_mesh, zero_sid, ones_mask, jnp):
             # (sharded entries use the shard_map twin except for
             # affordable blocked folds)
             program = get_program()
+            prog_tag = "single" if entry_mesh is None else "auto_spmd"
             if entry_mesh is not None and (
                 not spec[3]
                 or _fold_blocks(spec[2], entry.nb,
                                 entry.num_series) != 1
             ):
                 program = get_sharded_program(entry_mesh)
-            out = program(
-                arrs,
-                zero_sid,
-                ones_mask,
-                jnp.int32(0), jnp.int32(-(2**31) + 1),
-                jnp.int32(2**31 - 1),
-                spec=spec,
-            )
-            out.block_until_ready()
+                prog_tag = "sharded"
+            # the warm dispatch rides the same device_call boundary
+            # (same registry key as the query path) so the profiler
+            # row attributes the compile to the program that will
+            # serve queries
+            from greptimedb_tpu.telemetry import device_trace
+
+            with device_trace.device_call(
+                    "range", key=("range", prog_tag, spec)) as dcall:
+                out = dcall.run(
+                    program,
+                    arrs,
+                    zero_sid,
+                    ones_mask,
+                    jnp.int32(0), jnp.int32(-(2**31) + 1),
+                    jnp.int32(2**31 - 1),
+                    spec=spec,
+                )
+                out.block_until_ready()
+                dcall.executed()
             entry.program_specs[spec] = True
             done += 1
         except Exception:  # noqa: BLE001 - best-effort warm
@@ -1107,8 +1119,22 @@ def force_resident(entry: _Entry) -> None:
         # attachment ship only the touched tiles)
         return sum(x.sum().astype(jnp.float32) for x in xs)
 
-    # float() is a real synchronization point (device->host readback)
-    float(touch(*arrs))
+    from greptimedb_tpu.telemetry import device_trace
+
+    # the warm materialization is a real dispatch (and the host->device
+    # attachment it forces is real tunnel traffic): profile it like
+    # every other program, keyed by the grid geometry
+    with device_trace.device_call(
+            "warm_touch",
+            key=("warm_touch", tuple(tuple(a.shape) for a in arrs)),
+    ) as dcall:
+        dcall.transfer(
+            sum(int(getattr(a, "nbytes", 0)) for a in arrs), "upload"
+        )
+        # float() is a real synchronization point (device->host
+        # readback)
+        float(dcall.run(touch, *arrs))
+        dcall.executed()
 
 
 def warm_from_snapshots(engine, catalog) -> int:
@@ -1300,11 +1326,26 @@ def run_prelude(entry: _Entry, sid_mask: np.ndarray, lo: int, hi: int):
         _PRELUDE = _prelude_program()
     mask = (jnp.asarray(sid_mask) if sid_mask is not None
             else jnp.ones((entry.num_series,), bool))
-    act, c_lo, i_lo, c_hi, i_hi = _PRELUDE(
-        entry.nrow, entry.imin, entry.imax, mask,
-        np.int32(_clamp_i32(lo)), np.int32(_clamp_i32(hi)),
-    )
-    act = np.asarray(act)
+    from greptimedb_tpu.telemetry import device_trace
+
+    # the prelude runs before every device RANGE query; it registers
+    # with the program profiler like every other dispatch (shape is
+    # the program identity — one compiled prelude per grid geometry)
+    from greptimedb_tpu.query import readback as _readback
+
+    with device_trace.device_call(
+            "range_prelude",
+            key=("prelude", tuple(entry.nrow.shape))) as dcall:
+        act_d, c_lo, i_lo, c_hi, i_hi = dcall.run(
+            _PRELUDE, entry.nrow, entry.imin, entry.imax, mask,
+            np.int32(_clamp_i32(lo)), np.int32(_clamp_i32(hi)),
+        )
+        act_d.block_until_ready()
+        dcall.executed()
+        # execute split from readback like every other site; the
+        # active-sid mask crosses at the blessed readback boundary
+        act = _readback.read_full(act_d)
+        dcall.transfer(act.nbytes)
     if not act.any():
         out = (act, None, None)
     else:
@@ -1961,6 +2002,7 @@ def execute_range_device(engine, plan, table):
         entry.nan_ok.get(fname, fname == "__rows__") for fname, _ in items
     )
     program = get_program()
+    prog_tag = "single"
     entry_mesh = getattr(entry, "mesh", None)
     if entry_mesh is not None:
         if (not memo["fold"]
@@ -1968,12 +2010,14 @@ def execute_range_device(engine, plan, table):
             # explicit-collective shard_map program with the blocked
             # exact fold (bit-identical across mesh sizes)
             program = get_sharded_program(entry_mesh)
+            prog_tag = "sharded"
         else:
             # oversized blocked fold (FOLD_BLOCKS*g*nb past the partial
             # budget): stays on the auto-SPMD jit program — still
             # sharded, but XLA picks the combine order, so this is a
             # DOCUMENTED bit-identity exception; surface it
             stats.note("mesh_fold_range", "auto_spmd(oversized_fold)")
+            prog_tag = "auto_spmd"
     prog_spec = (stride, n_steps, g, memo["fold"], nanenc, prog_items)
     from greptimedb_tpu.query import readback, sessions
     from greptimedb_tpu.telemetry import device_trace
@@ -2021,9 +2065,12 @@ def execute_range_device(engine, plan, table):
     # as first_call). A session hit keeps the span (execute is the
     # skipped dispatch, ~0) so traces always show the device leg.
     first_spec = prog_spec not in entry.program_specs
+    # program identity carries the mesh variant (single-device vs
+    # shard_map twin vs auto-SPMD fold): the profiler must never
+    # cross-serve mesh twins under one registry row
     with stats.timed("device_exec_ms"), \
             device_trace.device_call(
-                "range", key=("range", prog_spec),
+                "range", key=("range", prog_tag, prog_spec),
                 groups=g, steps=n_steps) as dcall:
         if out_dev is not None:
             stats.note("device_session", "hit")
@@ -2032,7 +2079,8 @@ def execute_range_device(engine, plan, table):
             stats.note("device_session", "miss")
             if uploaded_bytes:
                 dcall.transfer(uploaded_bytes, "upload")
-            out_dev = program(
+            out_dev = dcall.run(
+                program,
                 arrs, memo["gid"], memo["mask"],
                 memo["delta"], memo["lo"], memo["hi"],
                 spec=prog_spec,

@@ -540,8 +540,8 @@ def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts,
                 "groupby", key=("groupby-sharded", spec),
                 groups=g) as dcall:
             dcall.transfer(upload, "upload")
-            out_b, out_s = prog(d_vals, d_masks, d_gid, d_tshi, d_tslo,
-                                spec=spec)
+            out_b, out_s = dcall.run(prog, d_vals, d_masks, d_gid,
+                                     d_tshi, d_tslo, spec=spec)
             out_b.block_until_ready()
             dcall.executed()
             from greptimedb_tpu.query import readback as _readback
@@ -570,8 +570,8 @@ def _device_reduce_fused(specs, values: dict, gid, valid_map, g: int, ts,
         with device_trace.device_call(
                 "groupby", key=("groupby", spec), groups=g) as dcall:
             dcall.transfer(upload, "upload")
-            out_dev = _FUSED(d_vals, d_masks, d_gid, d_tshi, d_tslo,
-                             spec=spec)
+            out_dev = dcall.run(_FUSED, d_vals, d_masks, d_gid, d_tshi,
+                                d_tslo, spec=spec)
             out_dev.block_until_ready()
             dcall.executed()
             from greptimedb_tpu.query import readback as _readback

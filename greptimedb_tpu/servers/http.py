@@ -185,6 +185,7 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             "/v1/otlp/v1/metrics", "/v1/traces", "/v1/traces/",
             "/v1/stats/statements",
             "/debug/prof/cpu", "/debug/prof/mem", "/debug/prof/hbm",
+            "/debug/prof/device", "/debug/prof/device/trace",
         )
 
         def _raw_path(self) -> str:
@@ -439,6 +440,50 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                     200, _memory.render_hbm_text(doc).encode(),
                     "text/plain",
                 )
+            if path == "/debug/prof/device":
+                # the device-program profiler
+                # (telemetry/device_programs.py): per-program calls /
+                # compile / execute percentiles, XLA cost analysis and
+                # the roofline verdict, top-N by cumulative device time
+                from greptimedb_tpu.telemetry import (
+                    device_programs as _dp,
+                )
+
+                params = self._params()
+                try:
+                    top = int(params.get("top", "20"))
+                except ValueError:
+                    return self._error(400, "bad top")
+                doc = _dp.global_programs.report(top=top)
+                if params.get("format", "text") == "json":
+                    return self._json(200, doc)
+                return self._send(
+                    200, _dp.render_text(doc).encode(), "text/plain"
+                )
+            if path == "/debug/prof/device/trace":
+                # on-demand device trace capture via jax.profiler:
+                # blocks for ?seconds= and returns the TensorBoard/
+                # perfetto-loadable trace directory it wrote
+                from greptimedb_tpu.telemetry import (
+                    device_programs as _dp,
+                )
+
+                params = self._params()
+                try:
+                    seconds = float(params.get("seconds", "1"))
+                except ValueError:
+                    return self._error(400, "bad seconds")
+                if not (0.0 < seconds <= 60.0):
+                    return self._error(
+                        400, "seconds must be in (0, 60]"
+                    )
+                try:
+                    doc = _dp.capture_trace(
+                        seconds, params.get("dir") or None
+                    )
+                except _dp.CaptureBusyError as e:
+                    return self._error(409, str(e))
+                return self._json(200, doc)
             if path == "/v1/sql":
                 return self._handle_sql()
             if path == "/v1/promql":

@@ -151,7 +151,8 @@ def _device_merge_indices(rows: ColumnarRows, *, backfill: bool,
     with device_trace.device_call("compact_merge", key=key,
                                   rows=n) as d:
         d.transfer(upload, "upload")
-        order_d, keep_d, fills_d = prog(
+        order_d, keep_d, fills_d = d.run(
+            prog,
             up["sid"], up["ts_hi"], up["ts_lo"], up["seq_hi"],
             up["seq_lo"], up["op"], np.int32(n), valids,
             drop_deletes=drop_deletes,

@@ -1,4 +1,4 @@
-"""Device-time attribution for traces.
+"""Device-time attribution for traces + the program-profiler boundary.
 
 The BENCH_r03-r05 story is that ~1-30ms of database time rides on a
 ~90-280ms host<->device tunnel floor — but until now no single query
@@ -6,9 +6,11 @@ could SHOW which part it paid: XLA compilation (first call for a program
 shape), device execution (dispatch + block_until_ready), or host<->
 device transfer (uploads of masks/grids, result readback). This module
 wraps the jit/shard_map CALL BOUNDARY in query/device_range.py,
-query/reduce.py and promql/fast.py — always from HOST scope, never
-inside a traced function (gtlint GT014 flags a span or metric call
-inside device scope: it is a host-sync/recompile hazard).
+query/reduce.py, promql/fast.py, storage/device_merge.py and
+flow/device_state.py — always from HOST scope, never inside a traced
+function (gtlint GT014 flags a span or metric call inside device scope:
+it is a host-sync/recompile hazard; GT018 flags a jit-produced callable
+invoked OUTSIDE a device_call scope: an untracked dispatch).
 
 Each wrapped call produces one `device.execute` span carrying:
 - site: which kernel family ran (range / groupby / promql / topk / ...)
@@ -19,6 +21,17 @@ Each wrapped call produces one `device.execute` span carrying:
   (block_until_ready), excluding result readback
 - upload_bytes / readback_bytes: host->device and device->host traffic
   attributable to this call
+- program + roofline attribution (telemetry/device_programs.py): the
+  program-registry id, and — once the program's XLA cost analysis has
+  run — flops, bound=compute|memory and this call's achieved GFLOP/s
+  / %-of-peak
+
+Dispatching THROUGH `device_call.run(fn, *args, **kw)` additionally
+folds the call into the process-wide device-program registry
+(telemetry/device_programs.py): calls, compile/execute timing, transfer
+bytes, and the argument shape specs the lazy XLA cost analysis lowers
+against. A session hit that skips the dispatch keeps its span but does
+NOT count as a program call — the registry describes real dispatches.
 """
 
 from __future__ import annotations
@@ -52,14 +65,27 @@ def note_compile(site: str, key) -> str:
 class device_call:
     """`with device_trace.device_call("range", key=spec) as d:` — wraps
     one jit/shard_map invocation. The span duration covers dispatch +
-    execute + readback; call `d.executed()` right after
-    block_until_ready so execute time splits from readback, and
+    execute + readback; dispatch the program via `d.run(fn, *args,
+    **kw)` so it registers with the device-program profiler, call
+    `d.executed()` right after block_until_ready so execute time splits
+    from readback (pass `dispatch_only=True` when the caller
+    deliberately does not block — async flow applies), and
     `d.transfer(nbytes, "upload"|"readback")` for tunnel traffic."""
 
-    __slots__ = ("_cm", "_span", "_mono0", "site", "_stmt")
+    __slots__ = ("_cm", "_span", "_mono0", "site", "_stmt", "key",
+                 "_rec", "_first", "_run_t0", "_exec_ms", "_up", "_rb",
+                 "_dispatch_only")
 
     def __init__(self, site: str, *, key=None, **attrs):
         self.site = site
+        self.key = key
+        self._rec = None
+        self._first = False
+        self._run_t0 = 0.0
+        self._exec_ms = None
+        self._up = 0
+        self._rb = 0
+        self._dispatch_only = False
         # skip the compile-memo lookup entirely when NEITHER a trace
         # nor a statement observation is active: the memo only feeds
         # attribution, and the bare hot path must stay zero-cost
@@ -87,24 +113,139 @@ class device_call:
         self._mono0 = time.monotonic()
         return self
 
-    def executed(self):
+    def run(self, fn, *args, **kw):
+        """Dispatch the program. Registers (site, key) with the
+        device-program registry — first dispatch captures the argument
+        shape specs for the lazy XLA cost analysis — and anchors the
+        execute timer at the dispatch, so session lookups before it
+        never count as device time."""
+        from greptimedb_tpu.telemetry import device_programs
+
+        reg = device_programs.global_programs
+        if reg.config.enable:
+            prep = reg.prepare(self.site, self.key, fn, args, kw)
+            if prep is not None:
+                self._rec, self._first = prep
+        self._run_t0 = time.monotonic()
+        return fn(*args, **kw)
+
+    def executed(self, *, dispatch_only: bool = False):
         """Mark the device computation complete (call right after
-        block_until_ready); the remainder of the span is readback."""
+        block_until_ready); the remainder of the span is readback.
+        dispatch_only=True records that the caller did NOT block — the
+        timing covers dispatch, not the computation — so the profiler
+        suppresses achieved-rate claims for this program."""
+        now = time.monotonic()
+        self._exec_ms = (now - (self._run_t0 or self._mono0)) * 1000.0
+        self._dispatch_only = dispatch_only
         self._span.attributes["execute_ms"] = round(
-            (time.monotonic() - self._mono0) * 1000.0, 3
+            (now - self._mono0) * 1000.0, 3
         )
 
     def transfer(self, nbytes: int, direction: str = "readback"):
+        nbytes = int(nbytes)
+        if direction == "upload":
+            self._up += nbytes
+        else:
+            self._rb += nbytes
         key = f"{direction}_bytes"
         attrs = self._span.attributes
-        attrs[key] = int(attrs.get(key, 0)) + int(nbytes)
+        attrs[key] = int(attrs.get(key, 0)) + nbytes
         if self._stmt and direction == "upload":
             # readback bytes are attributed (full vs delta) at the one
             # blessed crossing in query/readback.py; uploads only here
             stmt_stats.add("upload_bytes", int(nbytes))
 
+    def _fold_program(self, sp, rec, *, dispatched: bool):
+        """Fold the dispatch into the program registry (when one
+        happened) + attach the program / roofline attribution to the
+        span, EXPLAIN ANALYZE stats and the statement observation.
+        A no-dispatch path (session hit) attributes without folding —
+        and without per-call achieved rates, since no compute ran."""
+        from greptimedb_tpu.telemetry import device_programs
+
+        reg = device_programs.global_programs
+        if dispatched:
+            reg.finish(rec, execute_ms=self._exec_ms,
+                       upload=self._up, readback=self._rb,
+                       dispatch_only=self._dispatch_only,
+                       run_start=self._run_t0 or None)
+        if self._stmt:
+            # program-registry link: the statement_statistics row lists
+            # the program ids its executions used (dispatched, or
+            # served from the program's session buffer)
+            stmt_stats.note_program(rec.prog_id)
+        roof = None
+        if rec.analysis == "ok":
+            pf, pb, _plat, _src = reg.peaks()
+            bound, _pct = rec.roofline(pf, pb)
+            gflops = gbps = pct = 0.0
+            if (dispatched and self._exec_ms and self._exec_ms > 0
+                    and not self._dispatch_only and not self._first):
+                s = self._exec_ms / 1000.0
+                gflops = rec.flops / s / 1e9
+                gbps = rec.bytes_accessed / s / 1e9
+                if bound == "compute":
+                    pct = gflops / (pf * 1e3) * 100.0
+                elif bound == "memory":
+                    pct = gbps / pb * 100.0
+            roof = (bound, gflops, gbps, pct)
+        traced = sp is not None and sp.trace_id
+        if traced:
+            sp.attributes["program"] = rec.prog_id
+            if roof is not None:
+                sp.attributes["flops"] = rec.flops
+                if roof[0]:
+                    sp.attributes["roofline_bound"] = roof[0]
+                    if dispatched:
+                        sp.attributes["pct_of_peak"] = round(roof[3], 3)
+                if dispatched:
+                    sp.attributes["achieved_gflops"] = round(roof[1], 3)
+        from greptimedb_tpu.query import stats as qstats
+
+        if qstats.active() is not None:
+            qstats.note(f"device_program_{self.site}", rec.prog_id)
+            if roof is not None and roof[0]:
+                if dispatched:
+                    qstats.note(
+                        f"roofline_{self.site}",
+                        f"{roof[0]}-bound {roof[3]:.1f}% of peak "
+                        f"({roof[1]:.1f} GFLOP/s, {roof[2]:.1f} GB/s)",
+                    )
+                else:
+                    # steady-state row numbers: this call served from
+                    # the session buffer, no program ran
+                    _bound, row_pct = rec.roofline(pf, pb)
+                    g, b = rec.achieved()
+                    qstats.note(
+                        f"roofline_{self.site}",
+                        f"{roof[0]}-bound {row_pct:.1f}% of peak at "
+                        f"p50 ({g:.1f} GFLOP/s, {b:.1f} GB/s; served "
+                        "from the session buffer)",
+                    )
+
     def __exit__(self, exc_type, exc, tb):
         sp = self._span
+        rec = self._rec
+        dispatched = rec is not None
+        if rec is None:
+            # no dispatch happened (session hit): when someone is
+            # watching (trace / statement stats / EXPLAIN ANALYZE),
+            # attribute the program row read-only — the warm steady
+            # state must not lose the program link
+            watching = self._stmt or (sp is not None and sp.trace_id)
+            if not watching:
+                from greptimedb_tpu.query import stats as qstats
+
+                watching = qstats.active() is not None
+            if watching:
+                from greptimedb_tpu.telemetry import device_programs
+
+                rec = device_programs.global_programs.lookup(
+                    self.site, self.key
+                )
+        if rec is not None:
+            self._fold_program(sp, rec, dispatched=dispatched)
         if sp is not None and sp.trace_id:
             # per-query device-bytes attribution: the HBM pinned by the
             # registered device pools at the moment this call finished

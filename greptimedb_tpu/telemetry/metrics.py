@@ -217,6 +217,47 @@ class Histogram(_Metric):
         return out
 
 
+def observe_bucket(buckets: list, bounds: tuple, v: float):
+    """Non-cumulative bucket observe for registry-local histograms
+    (stmt_stats latency/queue, device-program execute): one increment
+    per observation, with a trailing OVERFLOW slot past the last bound
+    so slow outliers still count toward the percentiles. `buckets`
+    must be len(bounds) + 1."""
+    for i, b in enumerate(bounds):
+        if v <= b:
+            buckets[i] += 1
+            return
+    buckets[-1] += 1
+
+
+def bucket_quantile(buckets: list, bounds: tuple, q: float) -> float:
+    """Linear-interpolated quantile over observe_bucket counts; the
+    overflow slot reports at the last bound (a floor — the registries
+    do not track the true maximum)."""
+    total = sum(buckets)
+    if total == 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    prev = 0.0
+    for i, b in enumerate(bounds):
+        n = buckets[i]
+        if n and cum + n >= target:
+            return prev + (b - prev) * ((target - cum) / n)
+        cum += n
+        prev = b
+    return bounds[-1]
+
+
+def set_child_value(child, value: float):
+    """Pull-model publisher helper: overwrite a counter/gauge child's
+    value under its lock (scrape-time publishers — stmt_stats, the
+    device-program profiler — refresh exported families from their
+    registries instead of incrementing on the hot path)."""
+    with child._lock:
+        child.value = float(value)
+
+
 class MetricsRegistry:
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}

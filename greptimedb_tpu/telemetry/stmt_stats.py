@@ -42,7 +42,11 @@ import time
 from collections import OrderedDict
 
 from greptimedb_tpu import concurrency
-from greptimedb_tpu.telemetry.metrics import global_registry
+from greptimedb_tpu.telemetry import metrics as _metrics
+from greptimedb_tpu.telemetry.metrics import (
+    global_registry,
+    set_child_value as _set_counter,
+)
 
 # ---------------------------------------------------------------------------
 # metrics — PULL-model: the gtpu_stmt_* families are published from
@@ -265,7 +269,7 @@ def fingerprint_sql(sql: str) -> list[StmtFingerprint]:
 
 class _Obs:
     __slots__ = ("fp", "text", "inner_fp", "db", "tenant", "channel",
-                 "counters", "notes", "trace_id")
+                 "counters", "notes", "trace_id", "programs")
 
     def __init__(self, fp: StmtFingerprint, db: str, tenant: str,
                  channel: str, trace_id: str | None):
@@ -278,6 +282,10 @@ class _Obs:
         self.counters: dict[str, float] = {}
         self.notes: dict[str, str] = {}
         self.trace_id = trace_id
+        # device-program registry ids this statement dispatched
+        # (telemetry/device_programs.py; bounded — a statement shape
+        # touches a handful of compiled programs)
+        self.programs: list[str] | None = None
 
     def add(self, key: str, n: float = 1):
         self.counters[key] = self.counters.get(key, 0) + n
@@ -318,6 +326,22 @@ def note(key: str, value: str):
     obs = _current.get()
     if obs is not None:
         obs.note(key, value)
+
+
+_MAX_OBS_PROGRAMS = 16
+
+
+def note_program(prog_id: str):
+    """Link the active statement observation to a device-program
+    registry row (called by device_trace at the dispatch boundary)."""
+    obs = _current.get()
+    if obs is None:
+        return
+    progs = obs.programs
+    if progs is None:
+        progs = obs.programs = []
+    if prog_id not in progs and len(progs) < _MAX_OBS_PROGRAMS:
+        progs.append(prog_id)
 
 
 def note_exec_path(path: str):
@@ -397,7 +421,7 @@ class _Row:
         "scan_cache_hits", "scan_cache_misses",
         "shed_count", "deadline_count", "datanodes", "rpc_ms",
         "last_trace_id", "first_seen_ms", "last_seen_ms",
-        "metric_fp",
+        "metric_fp", "program_ids",
     )
 
     def __init__(self, fingerprint: str, db: str, tenant: str,
@@ -435,6 +459,10 @@ class _Row:
         self.datanodes = 0
         self.rpc_ms = 0.0
         self.last_trace_id = ""
+        # device-program registry ids executions of this shape have
+        # dispatched (joins information_schema.device_programs /
+        # /debug/prof/device on the `program` column; bounded)
+        self.program_ids: list[str] = []
         self.first_seen_ms = int(time.time() * 1000)
         self.last_seen_ms = self.first_seen_ms
         # the /metrics label this row publishes under (its own
@@ -477,6 +505,11 @@ class _Row:
                 _observe_buckets(self.queue_buckets, v)
         if obs.trace_id:
             self.last_trace_id = obs.trace_id
+        if obs.programs:
+            for pid in obs.programs:
+                if (pid not in self.program_ids
+                        and len(self.program_ids) < _MAX_OBS_PROGRAMS):
+                    self.program_ids.append(pid)
 
     def fold_row(self, other: "_Row"):
         """Merge another row into this one (LRU eviction into _other)."""
@@ -512,6 +545,10 @@ class _Row:
         self.last_seen_ms = max(self.last_seen_ms, other.last_seen_ms)
         if other.last_trace_id:
             self.last_trace_id = other.last_trace_id
+        for pid in other.program_ids:
+            if (pid not in self.program_ids
+                    and len(self.program_ids) < _MAX_OBS_PROGRAMS):
+                self.program_ids.append(pid)
 
     # -- rendering -----------------------------------------------------
     def to_doc(self) -> dict:
@@ -559,6 +596,7 @@ class _Row:
             "datanodes": int(self.datanodes),
             "rpc_ms": round(self.rpc_ms, 3),
             "last_trace_id": self.last_trace_id,
+            "program_ids": list(self.program_ids),
             "first_seen_ms": self.first_seen_ms,
             "last_seen_ms": self.last_seen_ms,
         }
@@ -570,33 +608,13 @@ def _rate(hits: int, misses: int) -> float:
 
 
 def _observe_buckets(buckets: list[int], v_ms: float):
-    for i, b in enumerate(_BUCKETS_MS):
-        if v_ms <= b:
-            buckets[i] += 1
-            # buckets are NON-cumulative here (one increment per
-            # observation); _quantile accumulates
-            return
-    buckets[-1] += 1  # overflow: past the last bound
+    # buckets are NON-cumulative (one increment per observation, with
+    # the trailing overflow slot); _quantile accumulates
+    _metrics.observe_bucket(buckets, _BUCKETS_MS, v_ms)
 
 
 def _quantile(buckets: list[int], q: float) -> float:
-    total = sum(buckets)
-    if total == 0:
-        return 0.0
-    target = q * total
-    cum = 0
-    prev_bound = 0.0
-    for i, b in enumerate(_BUCKETS_MS):
-        n = buckets[i]
-        if n and cum + n >= target:
-            # linear interpolation inside the bucket
-            frac = (target - cum) / n
-            return prev_bound + (b - prev_bound) * frac
-        cum += n
-        prev_bound = b
-    # the quantile falls in the overflow bucket: report the last bound
-    # (a floor — the registry does not track the true maximum)
-    return _BUCKETS_MS[-1]
+    return _metrics.bucket_quantile(buckets, _BUCKETS_MS, q)
 
 
 _ORDER_KEYS = frozenset({
@@ -646,11 +664,6 @@ class _MetricBase:
             self.lat_buckets[i] += other.lat_buckets[i]
         for code, n in other.errors.items():
             self.errors[code] = self.errors.get(code, 0) + n
-
-
-def _set_counter(child, value: float):
-    with child._lock:
-        child.value = float(value)
 
 
 class _Observation:

@@ -140,6 +140,9 @@ class FileContext:
         self.node_stack: list[ast.AST] = []
         self.lock_depth = 0
         self.loop_depth = 0
+        # `with device_call(...):` nesting (telemetry/device_trace):
+        # GT018 allows jit dispatches only inside one
+        self.device_call_depth = 0
         self.exc_names: list[str] = []
         # names of functions passed to pl.pallas_call(...) anywhere in
         # the module: their bodies run traced on device
@@ -167,6 +170,28 @@ class FileContext:
                 return None  # imported callee: no body in this module
             prior = [ln for ln in lines if ln <= call_line]
             return (name, prior[-1] if prior else lines[0])
+        # names bound to jit-PRODUCED callables anywhere in the module
+        # (GT018): @jax.jit / @functools.partial(jax.jit, ...)
+        # decorated defs, and NAME = jax.jit(...) assignments. Calling
+        # one from host scope outside a device_call is an untracked
+        # device dispatch.
+        self.jit_callables: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = [a.arg for a in (
+                    node.args.posonlyargs + node.args.args
+                    + node.args.kwonlyargs
+                )]
+                for dec in node.decorator_list:
+                    if jit_decorator_info(dec, params)[0]:
+                        self.jit_callables.add(node.name)
+                        break
+            elif (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and dotted_name(node.value.func) in _JIT_NAMES):
+                self.jit_callables.add(node.targets[0].id)
         # module-level NAME = "str" constants (axis-name resolution)
         self.str_constants: dict[str, str] = {}
         for node in tree.body:
@@ -377,9 +402,12 @@ class ModuleLinter(ast.NodeVisitor):
             device=jitted or kernel or enclosing_device,
         )
         ctx.func_stack.append(fi)
-        # loops/locks of the enclosing scope don't wrap this body
+        # loops/locks/device_call scopes of the enclosing scope don't
+        # wrap this body (a nested def's body runs later, elsewhere;
+        # lambdas are NOT defs and keep the enclosing scope)
         saved_loop, saved_lock = ctx.loop_depth, ctx.lock_depth
-        ctx.loop_depth = ctx.lock_depth = 0
+        saved_dev = ctx.device_call_depth
+        ctx.loop_depth = ctx.lock_depth = ctx.device_call_depth = 0
         try:
             for child in ast.iter_child_nodes(node):
                 if child in node.decorator_list:
@@ -387,6 +415,7 @@ class ModuleLinter(ast.NodeVisitor):
                 self.visit(child)
         finally:
             ctx.loop_depth, ctx.lock_depth = saved_loop, saved_lock
+            ctx.device_call_depth = saved_dev
             ctx.func_stack.pop()
 
     scope_FunctionDef = _scope_func
@@ -405,20 +434,27 @@ class ModuleLinter(ast.NodeVisitor):
     def scope_With(self, node):
         ctx = self.ctx
         holds_lock = False
+        in_device_call = False
         for item in node.items:
             self.visit(item.context_expr)
             if item.optional_vars is not None:
                 self.visit(item.optional_vars)
             if _looks_like_lock(item.context_expr):
                 holds_lock = True
+            if _looks_like_device_call(item.context_expr):
+                in_device_call = True
         if holds_lock:
             ctx.lock_depth += 1
+        if in_device_call:
+            ctx.device_call_depth += 1
         try:
             for stmt in node.body:
                 self.visit(stmt)
         finally:
             if holds_lock:
                 ctx.lock_depth -= 1
+            if in_device_call:
+                ctx.device_call_depth -= 1
 
     def scope_ClassDef(self, node):
         self.ctx.class_stack.append(node)
@@ -438,6 +474,17 @@ class ModuleLinter(ast.NodeVisitor):
         finally:
             if pushed:
                 ctx.exc_names.pop()
+
+
+def _looks_like_device_call(expr: ast.AST) -> bool:
+    """`with device_call(...)` / `with device_trace.device_call(...)`:
+    the tracked-dispatch scope GT018 requires around jit calls. Chained
+    context managers (`with stats.timed(...), device_call(...) as d:`)
+    are handled per-item by scope_With."""
+    if not isinstance(expr, ast.Call):
+        return False
+    d = dotted_name(expr.func)
+    return d is not None and d.split(".")[-1] == "device_call"
 
 
 def _looks_like_lock(expr: ast.AST) -> bool:

@@ -995,6 +995,41 @@ _GT017_TIME_TOKENS = ("seconds", "duration", "latency", "_time",
 
 
 @register
+class UntrackedDeviceDispatch(Rule):
+    id = "GT018"
+    name = "untracked-device-dispatch"
+    description = (
+        "Calling a jit/shard_map-produced callable outside a "
+        "`device_call` scope dispatches an XLA program the device "
+        "profiler (telemetry/device_programs.py) cannot see: no "
+        "compile/execute attribution, no registry row, no roofline "
+        "verdict. Dispatch through "
+        "`with device_trace.device_call(site, key=...) as d: "
+        "d.run(fn, ...)` instead. Calls INSIDE jit/shard_map/Pallas "
+        "scope are inlining (tracing), not dispatches, and stay "
+        "silent; so do callables the walker cannot prove jit-produced "
+        "(builder-returned programs), which the registry still counts "
+        "at their device_call site."
+    )
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        if not isinstance(node.func, ast.Name):
+            return
+        if node.func.id not in ctx.jit_callables:
+            return
+        if ctx.device_func is not None:
+            return  # traced scope: inlined into the enclosing program
+        if ctx.device_call_depth > 0:
+            return  # tracked dispatch
+        ctx.report(self, node,
+                   f"jit-produced callable {node.func.id}() dispatched "
+                   "outside a device_call scope — the device profiler "
+                   "cannot attribute it; wrap the dispatch in `with "
+                   "device_trace.device_call(site, key=...) as d: "
+                   "d.run(...)`")
+
+
+@register
 class MetricNamingConvention(Rule):
     id = "GT017"
     name = "metric-naming-convention"

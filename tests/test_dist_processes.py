@@ -696,10 +696,35 @@ def test_distributed_trace_stitching(tmp_path):
         _wait_http(fe2, path="/health")
 
         # burns the single burst token; unremarkable => DROPPED at
-        # sample_ratio=0 (tail sampling really drops)
+        # sample_ratio=0 (tail sampling really drops). The server
+        # sends the response BEFORE the root span exits (the tail
+        # decision fires at exit), so on a slow box a /v1/traces
+        # request can observe the still-in-flight trace — poll until
+        # the decision lands: dropped means it vanishes, kept would
+        # persist with a finished (duration-stamped) root.
         tid_ok = "cc" * 16
         _sql_traced(fe2, "select 1", f"00-{tid_ok}-{'33' * 8}-01")
-        assert _trace(fe2, tid_ok) == []
+        deadline = time.monotonic() + 5.0
+        decided_streak = 0
+        while True:
+            ok_spans = _trace(fe2, tid_ok)
+            if ok_spans == []:
+                break  # tail-dropped
+            # a fully duration-stamped trace is only a KEEP verdict if
+            # it PERSISTS: the root stamps end_ms a few statements
+            # before the tail decision runs, so a single observation
+            # in that window would misread a correct drop
+            if all(s["duration_ms"] is not None for s in ok_spans):
+                decided_streak += 1
+            else:
+                decided_streak = 0
+            assert decided_streak < 3, (
+                "unremarkable trace KEPT at sample_ratio=0", ok_spans,
+            )
+            assert time.monotonic() < deadline, (
+                "trace still undecided after 5s", ok_spans,
+            )
+            time.sleep(0.05)
 
         # over-quota => 429, trace KEPT (error survives tail sampling)
         tid_shed = "dd" * 16

@@ -35,7 +35,7 @@ def rules_hit(src: str, select: str | None = None):
 
 def test_registry_has_all_rules():
     ids = sorted(all_rules())
-    assert ids == [f"GT{n:03d}" for n in range(1, 18)]
+    assert ids == [f"GT{n:03d}" for n in range(1, 19)]
     for rule in all_rules().values():
         assert rule.name and rule.description
 
@@ -1435,6 +1435,107 @@ def test_gt017_negative_conforming_and_foreign_receivers():
         x = stats.counter("whatever")
         y = panel.histogram("Latency")
     """, select="GT017") == []
+
+
+# ---------------------------------------------------------------------------
+# GT018 untracked device dispatch
+# ---------------------------------------------------------------------------
+
+def test_gt018_positive_decorated_jit_called_host_scope():
+    hits = rules_hit("""
+        import functools, jax
+
+        @functools.partial(jax.jit, static_argnames=("g",))
+        def prog(x, *, g):
+            return x + g
+
+        def serve(x):
+            return prog(x, g=4)
+    """, select="GT018")
+    assert hits == [("GT018", 9)]
+
+
+def test_gt018_positive_jit_assignment_called_host_scope():
+    hits = rules_hit("""
+        import jax
+
+        touch = jax.jit(lambda x: x.sum())
+
+        def warm(arrs):
+            return float(touch(arrs))
+    """, select="GT018")
+    assert hits == [("GT018", 7)]
+
+
+def test_gt018_negative_inside_device_call_scope():
+    assert rules_hit("""
+        import jax
+        from greptimedb_tpu.telemetry import device_trace
+
+        @jax.jit
+        def prog(x):
+            return x * 2
+
+        def serve(x):
+            with device_trace.device_call("site", key=("k",)) as d:
+                return d.run(prog, x)
+
+        def serve_direct(x):
+            with device_trace.device_call("site") as d:
+                out = prog(x)
+                d.executed()
+                return out
+
+        def serve_chained(x, stats):
+            with stats.timed("ms"), device_trace.device_call("s") as d:
+                return d.run(prog, x)
+
+        def serve_lambda(x, session_exec):
+            with device_trace.device_call("s") as d:
+                return session_exec(lambda: d.run(prog, x))
+    """, select="GT018") == []
+
+
+def test_gt018_negative_device_scope_and_unknown_callees():
+    # a call INSIDE jit scope is inlining (tracing), not a dispatch;
+    # builder-returned programs (name assigned from a helper call) are
+    # not provably jit-produced and stay silent
+    assert rules_hit("""
+        import jax
+
+        @jax.jit
+        def inner(x):
+            return x + 1
+
+        @jax.jit
+        def outer(x):
+            return inner(x) * 2
+
+        def get_program():
+            return jax.jit(lambda v: v)
+
+        def serve(x):
+            program = get_program()
+            return program(x)
+    """, select="GT018") == []
+
+
+def test_gt018_nested_def_does_not_inherit_device_call_scope():
+    hits = rules_hit("""
+        import jax
+        from greptimedb_tpu.telemetry import device_trace
+
+        @jax.jit
+        def prog(x):
+            return x
+
+        def serve(x):
+            with device_trace.device_call("s") as d:
+                def later():
+                    return prog(x)
+                return d.run(prog, x), later
+    """, select="GT018")
+    assert hits == [("GT018", 12)]
 
 
 # ---------------------------------------------------------------------------

@@ -275,17 +275,24 @@ def test_device_spans_on_range_query(tmp_path):
         spans = tracing.global_traces.trace(root.trace_id)
         dev = [s for s in spans if s["name"] == "device.execute"]
         assert dev, {s["name"] for s in spans}
-        attrs = dev[0]["attributes"]
-        assert attrs["site"] == "range"
+        # the prelude dispatch carries its own span now; the range
+        # program's span is the one with site=range
+        sites = {s["attributes"]["site"] for s in dev}
+        assert {"range", "range_prelude"} <= sites
+        attrs = [s for s in dev
+                 if s["attributes"]["site"] == "range"][0]["attributes"]
         assert attrs["compile"] == "first_call"
         assert attrs["readback_bytes"] > 0
         assert "execute_ms" in attrs
+        # the program-profiler link rides the span
+        assert attrs.get("program")
         # steady state: same program shape is a cache hit
         with tracing.span("req2") as root2:
             inst.sql(q)
         dev2 = [
             s for s in tracing.global_traces.trace(root2.trace_id)
             if s["name"] == "device.execute"
+            and s["attributes"]["site"] == "range"
         ]
         assert dev2 and dev2[0]["attributes"]["compile"] == "cache_hit"
     finally:
