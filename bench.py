@@ -1038,6 +1038,213 @@ def cold_start_probe(data_dir: str):
 
 
 # ----------------------------------------------------------------------
+# fleet observability probe (`python bench.py fleet`, ISSUE 15):
+# a real wire topology (in-process metasrv HTTP + 2 datanode Flight
+# servers + DistInstance frontend) with REAL heartbeat loops, fleet
+# enrichment ON vs OFF in ALTERNATING child processes. The on-child
+# additionally hammers the federated scrape concurrently with the
+# query loop, so the measured overhead covers heartbeat payloads AND
+# cluster fan-out riding the same node. HARD <= 3% gate on the
+# flagship-shape dist poll floor; federated-scrape latency and
+# per-node sample counts ride the metric line + final summary.
+# ----------------------------------------------------------------------
+
+FLEET_OVERHEAD_GATE_PCT = 3.0
+
+_FLEET_PROBE = r"""
+import sys, time, tempfile, shutil, json, threading
+import numpy as np
+
+mode = sys.argv[1]
+from greptimedb_tpu.dist import fleet
+fleet.configure({"enable": mode == "on",
+                 "stats_interval_s": 0.25,
+                 "heartbeat_interval_s": 0.25})
+from greptimedb_tpu.dist.client import MetaClient
+from greptimedb_tpu.dist.frontend import DistInstance
+from greptimedb_tpu.dist.region_server import RegionServer
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.servers.flight import FlightFrontend
+from greptimedb_tpu.servers.meta_http import MetasrvServer
+from greptimedb_tpu.storage.engine import EngineConfig
+
+tmp = tempfile.mkdtemp(prefix="gtpu_fleet_probe_")
+stops = []
+try:
+    meta = MetasrvServer(addr="127.0.0.1", port=0,
+                         data_home=f"{tmp}/meta").start()
+    meta_addr = f"127.0.0.1:{meta.port}"
+    dns = []
+    for i in range(2):
+        dn = Standalone(
+            engine_config=EngineConfig(data_root=f"{tmp}/dn{i}",
+                                       enable_background=False),
+            prefer_device=False, warm_start=False,
+        )
+        dn.region_server = RegionServer(dn.engine, f"{tmp}/dn{i}")
+        fs = FlightFrontend(dn, port=0).start()
+        addr = f"127.0.0.1:{fs.server.port}"
+        # heartbeats run in BOTH modes (they are the existing liveness
+        # channel); only the enrichment payload + fan-out differ
+        stops.append(fleet.start_heartbeat(
+            meta_addr, i, dn, role="datanode", addr=addr,
+            interval_s=0.25))
+        dns.append((dn, fs))
+    fe = DistInstance(f"{tmp}/fe", meta_addr, prefer_device=False)
+    fe.node_addr = "127.0.0.1:0"
+    stops.append(fleet.start_heartbeat(
+        meta_addr, fleet.derive_node_id("frontend", "bench"), fe,
+        role="frontend", interval_s=0.25))
+
+    fields = ["usage_user", "usage_system"]
+    cols = ", ".join(f"{f} double" for f in fields)
+    fe.execute_sql(
+        f"create table cpu (ts timestamp time index, hostname string "
+        f"primary key, {cols}) with (num_regions = 2)"
+    )
+    table = fe.catalog.table("public", "cpu")
+    rng = np.random.default_rng(7)
+    nh, cells = 512, 360
+    hosts = np.asarray([f"host_{i}" for i in range(nh)], dtype=object)
+    ts = np.tile(np.arange(cells, dtype=np.int64) * 10_000, nh)
+    hs = np.repeat(hosts, cells)
+    data = {f: rng.random(len(ts)) * 100.0 for f in fields}
+    table.write({"hostname": hs}, ts, data)
+    items = ", ".join(
+        f"{op}({f}) RANGE '1h'"
+        for f in fields for op in ("avg", "max", "min", "sum")
+    )
+    query = (f"SELECT ts, hostname, {items} FROM cpu "
+             f"ALIGN '1h' BY (hostname)")
+    fe.sql(query)  # warm: plan docs + datanode scan caches
+
+    scrape_ms = []
+    node_rows = {}
+    stop_scrape = threading.Event()
+
+    def scraper():
+        # concurrent federated scrapes: the on-mode measurement covers
+        # fan-out riding the same node as the query loop
+        while not stop_scrape.wait(0.5):
+            t0 = time.perf_counter()
+            text = fleet.federated_metrics(fe, force=True)
+            scrape_ms.append((time.perf_counter() - t0) * 1000.0)
+            counts = {}
+            for line in text.splitlines():
+                if 'node="' in line and not line.startswith("#"):
+                    n = line.split('node="', 1)[1].split('"', 1)[0]
+                    counts[n] = counts.get(n, 0) + 1
+            node_rows.update(counts)
+
+    th = None
+    if mode == "on":
+        time.sleep(1.0)  # let enriched heartbeats land
+        th = threading.Thread(target=scraper, daemon=True)
+        th.start()
+    import gc
+
+    gc.disable()
+    try:
+        best = 1e9
+        for _ in range(50):
+            t0 = time.perf_counter()
+            fe.sql(query)
+            best = min(best, time.perf_counter() - t0)
+    finally:
+        gc.enable()
+    stop_scrape.set()
+    if th is not None:
+        th.join(timeout=10)
+    out = {"best_s": best}
+    if mode == "on":
+        sm = sorted(scrape_ms)
+        out["scrape_ms_p50"] = sm[len(sm) // 2] if sm else None
+        out["node_rows"] = node_rows
+        # contract: the fan-out actually covered every node
+        assert len(node_rows) >= 3, node_rows
+        assert all(v > 0 for v in node_rows.values()), node_rows
+    print(json.dumps(out))
+    for s in stops:
+        s()
+    fe.close()
+    for dn, fs in dns:
+        fs.close(grace_s=1.0)
+        dn.close()
+    meta.close()
+finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+"""
+
+
+def fleet_probe():
+    """`python bench.py fleet`: heartbeat-enrichment + fan-out overhead
+    (alternating child procs, flagship dist shape, HARD <= 3% gate),
+    plus federated-scrape latency and per-node sample counts — on the
+    metric line AND the final JSON summary."""
+    import os
+    import subprocess
+
+    _assert_sanitizer_off()
+
+    def one(mode: str) -> dict:
+        p = subprocess.run(
+            [sys.executable, "-c", _FLEET_PROBE, mode],
+            stdout=subprocess.PIPE, text=True, timeout=600,
+            env=dict(os.environ),
+        )
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"probe exited {p.returncode}: {p.stdout[-500:]}"
+            )
+        return json.loads(p.stdout.strip().splitlines()[-1])
+
+    rounds = []
+    on_doc = None
+    for _ in range(3):
+        off = one("off")
+        on = one("on")
+        on_doc = on
+        rounds.append((on["best_s"], off["best_s"]))
+    off_s = min(off for _, off in rounds)
+    on_s = min(on for on, _ in rounds)
+    pct = (on_s / max(off_s, 1e-9) - 1.0) * 100.0
+    scrape_p50 = on_doc.get("scrape_ms_p50")
+    node_rows = on_doc.get("node_rows") or {}
+    print(f"# fleet: overhead {pct:.1f}% (on {on_s * 1000:.2f}ms vs "
+          f"off {off_s * 1000:.2f}ms), federated scrape p50 "
+          f"{scrape_p50:.1f}ms over {len(node_rows)} nodes, rows "
+          f"{sorted(node_rows.values())}", file=sys.stderr)
+    # the gate is HARD: enrichment+fan-out past 3% on the flagship
+    # dist shape is a regression, not a number to report
+    assert pct <= FLEET_OVERHEAD_GATE_PCT, (
+        f"fleet overhead {pct:.1f}% exceeds the "
+        f"{FLEET_OVERHEAD_GATE_PCT}% gate (floor over 3 alternating "
+        f"rounds; on {on_s * 1000:.2f}ms vs off {off_s * 1000:.2f}ms)"
+    )
+    doc = {
+        "metric": "fleet_overhead_pct",
+        "value": round(pct, 1),
+        "unit": "%",
+        "vs_baseline": round(pct / FLEET_OVERHEAD_GATE_PCT, 2),
+        "on_ms": round(on_s * 1000.0, 3),
+        "off_ms": round(off_s * 1000.0, 3),
+        "rounds": [[round(on * 1000.0, 3), round(off * 1000.0, 3)]
+                   for on, off in rounds],
+        "federated_scrape_p50_ms": round(scrape_p50, 2),
+        "federated_nodes": len(node_rows),
+        "per_node_rows": {k: int(v)
+                          for k, v in sorted(node_rows.items())},
+    }
+    print(json.dumps(doc, separators=(",", ":")))
+    print(json.dumps({**doc, "summary": {
+        "fleet_overhead_pct": {"v": doc["value"]},
+        "fleet_federated_scrape_p50_ms": {
+            "v": doc["federated_scrape_p50_ms"]},
+        "fleet_federated_nodes": {"v": doc["federated_nodes"]},
+    }}, separators=(",", ":")))
+
+
+# ----------------------------------------------------------------------
 # admission-control storm probe (`python bench.py storm [dir]`):
 # open-loop mixed-tenant query storm + concurrent ingest against one
 # standalone instance with real [scheduler] limits. Reports
@@ -3177,5 +3384,7 @@ if __name__ == "__main__":
         memwatch_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "soak":
         soak_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "fleet":
+        fleet_probe()
     else:
         main()

@@ -21,6 +21,7 @@ Role topology:
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import time
@@ -283,9 +284,11 @@ def _telemetry(opts, closers, *, mode: str):
     closers.append(task.stop)
 
 
-def _export_metrics(inst, opts, closers):
+def _export_metrics(inst, opts, closers, *, role: str = ""):
     """Self-import node metrics (independent of the HTTP server; a node
-    with http disabled still exports)."""
+    with http disabled still exports). Series are stamped with
+    node/role labels so two roles exporting into the same
+    greptime_metrics database never collide into one series."""
     if not opts.get("export_metrics.enable", False):
         return
     if not hasattr(getattr(inst, "catalog", None), "create_database"):
@@ -296,6 +299,7 @@ def _export_metrics(inst, opts, closers):
         inst,
         db=opts.get("export_metrics.db", "greptime_metrics"),
         interval_s=float(opts.get("export_metrics.write_interval_s", 30.0)),
+        role=role or None,
     ).start()
     closers.append(task.stop)
 
@@ -389,6 +393,11 @@ def _make_instance(opts):
     from greptimedb_tpu.telemetry import stmt_stats as _stmt_stats
 
     _stmt_stats.configure(opts.section("stmt_stats"))
+    # [fleet] knobs: heartbeat telemetry cadence + cluster fan-out
+    # bounds + federated-scrape cache TTL (dist/fleet.py)
+    from greptimedb_tpu.dist import fleet as _fleet
+
+    _fleet.configure(opts.section("fleet"))
     # [profiling] knobs: device-program registry + roofline peaks
     from greptimedb_tpu.telemetry import device_programs as _dev_prog
 
@@ -444,7 +453,9 @@ def _start_standalone(opts):
     inst = _make_instance(opts)
     closers = [inst.close]
     server = _http_server(inst, opts, closers)
-    _export_metrics(inst, opts, closers)
+    if server is not None:
+        inst.node_addr = f"{server.addr}:{server.port}"
+    _export_metrics(inst, opts, closers, role="standalone")
     _telemetry(opts, closers, mode="standalone")
     _wire_protocols(inst, opts, closers)
     _flight_server(inst, opts, closers)
@@ -457,6 +468,7 @@ def _start_standalone(opts):
 
 def _start_datanode(opts):
     inst = _make_instance(opts)
+    inst.node_role = "datanode"
     closers = [inst.close]
     # region-server surface: per-region open/write/scan/partial-SQL for
     # the distributed topology (dist/region_server.py)
@@ -471,95 +483,24 @@ def _start_datanode(opts):
     )
     flight_srv = _flight_server(inst, opts, closers)
     _http_server(inst, opts, closers)
-    _export_metrics(inst, opts, closers)
+    _export_metrics(inst, opts, closers, role="datanode")
     _telemetry(opts, closers, mode="datanode")
     meta_addr = opts.get("datanode.metasrv_addr") or ""
     if meta_addr:
+        from greptimedb_tpu.dist import fleet
+
+        fleet.configure(opts.section("fleet"))
         node_id = int(opts.get("datanode.node_id", 0))
-        closers.append(
-            _heartbeat_loop(meta_addr, node_id, inst,
-                            flight_addr=_advertise_addr(opts, flight_srv))
-        )
+        inst.node_id = node_id
+        closers.append(fleet.start_heartbeat(
+            meta_addr, node_id, inst, role="datanode",
+            addr=_advertise_addr(opts, flight_srv),
+        ))
     print(
         f"greptimedb-tpu datanode (node {opts.get('datanode.node_id')}) "
         f"flight on {opts.get('grpc.addr')}", flush=True,
     )
     return _serve_until_signal(closers)
-
-
-def _heartbeat_loop(meta_addr: str, node_id: int, inst,
-                    flight_addr: str | None = None):
-    """Register + heartbeat against the metasrv HTTP service. The
-    MetaClient follows leader redirects across a comma-separated
-    --metasrv-addr list, so a metasrv leader kill re-registers this node
-    with the new leader on the next beat."""
-    import logging
-    import threading
-
-    from greptimedb_tpu.dist.client import MetaClient
-
-    _hb_log = logging.getLogger("greptimedb_tpu.heartbeat")
-
-    stop = concurrency.Event()
-    client = MetaClient(meta_addr)
-
-    def loop():
-        registered = False
-        last_leader = client.addr
-        while True:   # register immediately, THEN pace by the interval
-            try:
-                if client.addr != last_leader:
-                    # leader moved: its memory has no liveness record of
-                    # us — re-register before the next heartbeat
-                    registered = False
-                    last_leader = client.addr
-                if not registered:
-                    client.register(node_id, flight_addr)
-                    registered = True
-                stats = {}
-                try:
-                    for t in inst.catalog.all_tables():
-                        for r in t.regions:
-                            stats[str(r.meta.region_id)] = {
-                                "rows": int(getattr(r.memtable, "rows",
-                                                    0)),
-                            }
-                except Exception as e:  # noqa: BLE001
-                    # stats are advisory; heartbeat with what we have
-                    _hb_log.debug("region stat collection: %s", e)
-                for ins in client.heartbeat(node_id, stats):
-                    if ins.get("type") == "grant_lease":
-                        rs = getattr(inst, "region_server", None)
-                        if rs is not None:
-                            rs.renew_leases(
-                                ins.get("regions") or [],
-                                float(ins.get("lease_secs", 10.0)),
-                            )
-                    else:
-                        # other mailbox instructions are logged; region
-                        # movement is driven by the metasrv directly
-                        # over Flight (dist/wire_cluster.py)
-                        print(f"# metasrv instruction: {ins}", flush=True)
-            except Exception:
-                registered = False
-            # lease enforcement runs even (especially) when heartbeats
-            # fail: a partitioned node fences its regions instead of
-            # split-braining with a failover target. Nothing here may
-            # kill the loop — a dead loop means no fencing at all.
-            try:
-                rs = getattr(inst, "region_server", None)
-                if rs is not None:
-                    for rid in rs.enforce_leases():
-                        print(f"# region {rid} lease expired: fenced",
-                              flush=True)
-            except Exception as e:  # noqa: BLE001
-                print(f"# lease enforcement failed: {e}", flush=True)
-            if stop.wait(2.0):
-                return
-
-    t = concurrency.Thread(target=loop, daemon=True, name="dn-heartbeat")
-    t.start()
-    return stop.set
 
 
 def _start_frontend(opts):
@@ -600,9 +541,26 @@ def _start_frontend(opts):
             addrs = [a for a in addrs.split(",") if a]
         inst = RemoteInstance(addrs)
         target = f"datanodes {addrs}"
+    inst.node_role = "frontend"
     closers = [inst.close]
     _wire_protocols(inst, opts, closers)
     server = _http_server(inst, opts, closers)
+    if server is not None:
+        inst.node_addr = f"{server.addr}:{server.port}"
+    if meta_addr:
+        # the frontend heartbeats too: the fleet plane needs ITS
+        # uptime/memory/query counters on cluster_node_stats, and the
+        # metasrv's phi verdict covers every role, not just datanodes
+        from greptimedb_tpu.dist import fleet
+
+        fleet.configure(opts.section("fleet"))
+        inst.node_id = fleet.derive_node_id(
+            "frontend", inst.node_addr or f"pid:{os.getpid()}"
+        )
+        closers.append(fleet.start_heartbeat(
+            meta_addr, inst.node_id, inst, role="frontend",
+            addr=inst.node_addr or None,
+        ))
     _telemetry(opts, closers, mode="frontend")
     print(
         f"greptimedb-tpu frontend -> {target} on "
@@ -618,6 +576,11 @@ def _start_metasrv(opts):
     srv = MetasrvServer(
         addr=mh, port=mp, data_home=opts.get("data_home"),
         selector=opts.get("metasrv.selector", "round_robin"),
+        phi_threshold=float(opts.get("metasrv.phi_threshold", 8.0)),
+        acceptable_pause_ms=float(
+            opts.get("metasrv.acceptable_pause_ms", 10000.0)
+        ),
+        stats_history=int(opts.get("fleet.history", 32)),
     ).start()
     closers = [srv.close]
     _telemetry(opts, closers, mode="metasrv")
@@ -643,6 +606,7 @@ def _start_flownode(opts):
 
         inst = DistInstance(opts.get("data_home"), meta_addr,
                             ingest_options=opts.section("ingest"))
+        inst.node_role = "flownode"
         inst.enable_flows(
             tick_interval_s=opts.get("flow.tick_interval_s", 1.0)
         )
@@ -663,6 +627,19 @@ def _start_flownode(opts):
                 )
         except Exception as e:  # noqa: BLE001 - registration best-effort
             print(f"# flownode registration failed: {e}", flush=True)
+        # heartbeat as a fleet member too: liveness + node-stats ride
+        # the same channel as every other role
+        from greptimedb_tpu.dist import fleet
+
+        fleet.configure(opts.section("fleet"))
+        fl_addr = _advertise_addr(opts, flight_srv) or ""
+        inst.node_id = fleet.derive_node_id(
+            "flownode", fl_addr or f"pid:{os.getpid()}"
+        )
+        closers.append(fleet.start_heartbeat(
+            meta_addr, inst.node_id, inst, role="flownode",
+            addr=fl_addr or None,
+        ))
         server = _http_server(inst, opts, closers)
         print(
             f"greptimedb-tpu flownode (dist, metasrv {meta_addr}) "

@@ -139,8 +139,30 @@ DEFAULTS: dict = {
         # flight addresses of the datanodes this frontend fans out to
         "datanode_addrs": [],
     },
-    "metasrv": {"addr": "127.0.0.1:4010", "selector": "round_robin"},
+    "metasrv": {
+        "addr": "127.0.0.1:4010", "selector": "round_robin",
+        # phi-accrual failure detection (meta/failure_detector.py):
+        # threshold + acceptable heartbeat pause drive how fast a
+        # silent node flips UNHEALTHY -> DOWN on the cluster surfaces
+        "phi_threshold": 8.0,
+        "acceptable_pause_ms": 10000.0,
+    },
     "datanode": {"node_id": 0, "metasrv_addr": ""},
+    # fleet observability plane (dist/fleet.py + telemetry/
+    # node_stats.py): every role attaches a compact node-stats payload
+    # to its metasrv heartbeat; the frontend serves cluster-wide
+    # information_schema.cluster_* tables by fanning the bounded
+    # node_telemetry Flight action to every peer, /v1/cluster/metrics
+    # federates every node's metric families behind a TTL cache, and
+    # /health?deep=1 + /v1/cluster/health run real readiness probes
+    "fleet": {
+        "enable": True,
+        "stats_interval_s": 2.0,     # min spacing of heartbeat payloads
+        "heartbeat_interval_s": 2.0,  # heartbeat loop cadence
+        "history": 32,               # metasrv per-node sample ring size
+        "fanout_timeout_s": 5.0,     # per-peer bound for cluster_* fan-out
+        "cache_ttl_s": 5.0,          # federated-scrape cache TTL
+    },
     # gtsan cooperative concurrency sanitizer (tools/san): off by
     # default — the concurrency facade hands out raw stdlib objects
     # and adds no per-operation cost. enable=true (or GTPU_SAN=1)

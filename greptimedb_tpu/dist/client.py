@@ -210,6 +210,16 @@ class DatanodeClient:
             timeout=_op_timeout(15.0),
         ).get("versions", {})
 
+    def node_telemetry(self, body: dict | None = None, *,
+                       timeout: float) -> dict:
+        """Fleet fan-out: this peer's information_schema telemetry
+        docs / metrics text / deep-health JSON (dist/fleet.py). The
+        caller ALWAYS bounds the call — a hung peer must degrade the
+        cluster_* tables to reachable-peers-plus-status, not stall the
+        frontend's scrape."""
+        return self.action("node_telemetry", body or {},
+                           timeout=timeout)
+
     # ---- data plane ---------------------------------------------------
     def region_scan(self, region_ids: list[int], *, ts_min=None,
                     ts_max=None, fields=None, matchers=None,
@@ -628,13 +638,35 @@ class MetaClient:
     def remove_routes(self, region_ids: list[int]):
         self._post("/remove_routes", {"region_ids": region_ids})
 
-    def register(self, node_id: int, addr: str | None = None):
-        self._post("/register", {"node_id": node_id, "addr": addr})
-
-    def heartbeat(self, node_id: int, region_stats: dict | None = None
-                  ) -> list[dict]:
-        """One heartbeat; returns the leader's mailbox instructions."""
-        resp = self._post("/heartbeat", {
-            "node_id": node_id, "region_stats": region_stats or {},
+    def register(self, node_id: int, addr: str | None = None,
+                 role: str = "datanode"):
+        self._post("/register", {
+            "node_id": node_id, "addr": addr, "role": role,
         })
+
+    def heartbeat(self, node_id: int, region_stats: dict | None = None,
+                  node_stats: dict | None = None,
+                  role: str | None = None,
+                  addr: str | None = None) -> list[dict]:
+        """One heartbeat; returns the leader's mailbox instructions.
+        `node_stats` is the optional fleet-telemetry payload
+        (telemetry/node_stats.build_node_stats); `role` and `addr`
+        ride every beat so a leader that lost this node's registration
+        (restart) re-learns its identity even with enrichment disabled
+        — the client's beats may never fail across the transition, so
+        an explicit re-register cannot be relied on."""
+        doc = {"node_id": node_id, "region_stats": region_stats or {}}
+        if node_stats:
+            doc["node_stats"] = node_stats
+        if role:
+            doc["role"] = role
+        if addr:
+            doc["addr"] = addr
+        resp = self._post("/heartbeat", doc)
         return resp.get("instructions") or []
+
+    def cluster(self, *, history: bool = False) -> dict:
+        """The leader's fleet-state document ({nodes: [...], metasrv:
+        {...}}, servers/meta_http.py /cluster): liveness verdicts and
+        heartbeat-carried node stats for every registered role."""
+        return self._get("/cluster" + ("?history=1" if history else ""))

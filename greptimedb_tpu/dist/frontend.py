@@ -60,6 +60,9 @@ class DistInstance(Standalone):
         # hung it on the scratch catalog this line just replaced
         self.catalog.result_cache = self.result_cache
         self.distributed = True
+        # fleet identity: the dist role default; cli flips flownode
+        # processes and stamps the dialable address once bound
+        self.node_role = "frontend"
         self.flownode_addr = flownode_addr
         self._flow_clients: dict[str, object] = {}
         # (db, table) -> [flownode addrs] from the kv flow-route book

@@ -179,20 +179,56 @@ def _partitions_doc(inst) -> dict[str, list]:
 
 
 def _region_peers_doc(inst) -> dict[str, list]:
+    """Real routing + liveness per region: route/addr from the metasrv
+    (dist) and status from the phi-accrual detector; local regions
+    report their actual open/writable state — nothing is hardcoded."""
     rows = {"region_id": [], "table_id": [], "peer_id": [],
             "peer_addr": [], "is_leader": [], "status": []}
-    cluster = getattr(inst, "cluster", None)
+    routes: dict[int, int] = {}
+    peers: dict[int, str] = {}
+    statuses: dict[int, str] = {}
+    meta = getattr(inst, "meta", None)
+    if meta is not None and hasattr(meta, "routes"):
+        try:
+            routes = meta.routes()
+            # ONE fleet-state round carries both the datanode addrs
+            # and the phi verdicts (no separate peers() call)
+            for n in meta.cluster().get("nodes") or []:
+                statuses[n["node_id"]] = n["status"]
+                if n.get("addr"):
+                    peers[n["node_id"]] = n["addr"]
+        except Exception as e:  # noqa: BLE001 - metasrv unreachable:
+            # the table still answers with what the catalog knows
+            import logging
+
+            logging.getLogger("greptimedb_tpu.information_schema").debug(
+                "region_peers metasrv lookup failed: %s", e
+            )
+    local_id = int(getattr(inst, "node_id", 0) or 0)
+    local_addr = getattr(inst, "node_addr", "") or ""
     for t in inst.catalog.all_tables():
         for r in t.regions:
-            rows["region_id"].append(r.meta.region_id)
+            rid = r.meta.region_id
+            rows["region_id"].append(rid)
             rows["table_id"].append(t.info.table_id)
-            node = 0
-            if cluster is not None and hasattr(cluster, "route_of"):
-                node = cluster.route_of(r.meta.region_id) or 0
-            rows["peer_id"].append(node)
-            rows["peer_addr"].append("")
-            rows["is_leader"].append("Yes")
-            rows["status"].append("ALIVE")
+            if getattr(r, "remote", False):
+                node = routes.get(rid, 0)
+                rows["peer_id"].append(int(node))
+                rows["peer_addr"].append(peers.get(node, ""))
+                rows["is_leader"].append(
+                    "Yes" if rid in routes else "No"
+                )
+                rows["status"].append(statuses.get(node, "UNKNOWN"))
+            else:
+                # locally-hosted region: this process is the peer, and
+                # the region's own writability is its real state
+                rows["peer_id"].append(local_id)
+                rows["peer_addr"].append(local_addr)
+                rows["is_leader"].append("Yes")
+                rows["status"].append(
+                    "ALIVE" if getattr(r, "writable", True)
+                    else "DOWNGRADED"
+                )
     return rows
 
 
@@ -216,35 +252,35 @@ def _runtime_metrics_doc(inst) -> dict[str, list]:
 
 
 def _cluster_info_doc(inst) -> dict[str, list]:
+    """One row per fleet member from the metasrv peer book + heartbeat
+    registry (dist) or the live local process (standalone): real
+    addresses, real last-heartbeat activity, real phi-accrual status."""
+    from greptimedb_tpu.dist import fleet
     from greptimedb_tpu.version import __version__
 
-    cluster = getattr(inst, "cluster", None)
-    datanodes = getattr(cluster, "datanodes", None) if cluster else None
-    if datanodes:
-        rows = {"peer_id": [], "peer_type": [], "peer_addr": [],
-                "version": [], "git_commit": [], "active_time": []}
-        for node_id in sorted(datanodes):
-            rows["peer_id"].append(int(node_id))
-            rows["peer_type"].append("DATANODE")
-            rows["peer_addr"].append("")
-            rows["version"].append(__version__)
-            rows["git_commit"].append("")
-            rows["active_time"].append("")
-        rows["peer_id"].append(-1)
-        rows["peer_type"].append("METASRV")
-        rows["peer_addr"].append("")
-        rows["version"].append(__version__)
+    rows = {"peer_id": [], "peer_type": [], "peer_addr": [],
+            "version": [], "git_commit": [], "start_time_ms": [],
+            "uptime_s": [], "active_time": [], "status": []}
+    nodes = fleet.cluster_nodes(inst)
+    standalone = (len(nodes) == 1
+                  and nodes[0].get("role") == "standalone")
+    for node in nodes:
+        st = node.get("stats") or {}
+        rows["peer_id"].append(int(node.get("node_id", 0)))
+        rows["peer_type"].append(
+            "STANDALONE" if standalone
+            else str(node.get("role", "")).upper()
+        )
+        rows["peer_addr"].append(str(node.get("addr", "") or ""))
+        rows["version"].append(str(st.get("version") or __version__))
         rows["git_commit"].append("")
-        rows["active_time"].append("")
-        return rows
-    return {
-        "peer_id": [0],
-        "peer_type": ["STANDALONE"],
-        "peer_addr": [""],
-        "version": [__version__],
-        "git_commit": [""],
-        "active_time": [""],
-    }
+        rows["start_time_ms"].append(int(st.get("start_ms", 0) or 0))
+        rows["uptime_s"].append(float(st.get("uptime_s", 0.0) or 0.0))
+        rows["active_time"].append(
+            str(int(node.get("last_heartbeat_ms") or 0))
+        )
+        rows["status"].append(str(node.get("status", "UNKNOWN")))
+    return rows
 
 
 def _procedure_info_doc(inst) -> dict[str, list]:
@@ -444,6 +480,32 @@ def _memory_pools_doc(inst) -> dict[str, list]:
     return rows
 
 
+# ----------------------------------------------------------------------
+# cluster-wide tables (dist/fleet.py): the per-node telemetry surfaces
+# above, fanned out to every peer over the bounded node_telemetry
+# Flight action and merged with peer/peer_status columns. A down node
+# degrades to one status row instead of erroring the query.
+# ----------------------------------------------------------------------
+
+def _cluster_node_stats_doc(inst) -> dict[str, list]:
+    """One row per fleet member from the heartbeat-carried node-stats
+    payloads + the metasrv's phi-accrual verdict (standalone: the one
+    local node)."""
+    from greptimedb_tpu.dist import fleet
+
+    return fleet.cluster_node_stats_doc(inst)
+
+
+def _make_cluster_table(table: str):
+    def provider(inst) -> dict[str, list]:
+        from greptimedb_tpu.dist import fleet
+
+        return fleet.cluster_table_doc(inst, table)
+
+    provider.__name__ = f"_cluster_{table}_doc"
+    return provider
+
+
 _PROVIDERS = {
     "tables": _tables_doc,
     "columns": _columns_doc,
@@ -467,6 +529,13 @@ _PROVIDERS = {
     "memory_pools": _memory_pools_doc,
     "statement_statistics": _statement_statistics_doc,
     "device_programs": _device_programs_doc,
+    "cluster_node_stats": _cluster_node_stats_doc,
+    "cluster_runtime_metrics": _make_cluster_table("runtime_metrics"),
+    "cluster_statement_statistics": _make_cluster_table(
+        "statement_statistics"
+    ),
+    "cluster_device_programs": _make_cluster_table("device_programs"),
+    "cluster_memory_pools": _make_cluster_table("memory_pools"),
 }
 
 

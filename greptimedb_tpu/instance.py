@@ -202,6 +202,13 @@ class Standalone:
         self.flows = None  # wired by flow.FlowManager when enabled
         self._procedures = []
         self._process_list = _ProcessList()
+        # fleet identity (telemetry/node_stats.py): the role this
+        # process plays and the address peers dial it on; cli.py stamps
+        # the real values once servers are bound (DistInstance flips
+        # the role to frontend/flownode)
+        self.node_role = "standalone"
+        self.node_addr = ""
+        self.node_id = 0
         # admission control + deadline scheduling (sched/): default
         # config is permissive (no quotas/limits => never queues or
         # sheds); cli.py swaps in the [scheduler]-configured one

@@ -17,6 +17,7 @@ import json
 import logging
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 from greptimedb_tpu.errors import IllegalStateError
@@ -39,6 +40,13 @@ class NodeInfo:
     last_heartbeat_ms: float = 0.0
     region_stats: dict = field(default_factory=dict)  # region_id -> stats
     alive: bool = True
+    # fleet observability: role/addr declared at registration (or by
+    # the first enriched heartbeat), the latest heartbeat-carried
+    # node-stats payload, and a bounded ring of recent samples
+    role: str = "datanode"
+    addr: str = ""
+    stats: dict = field(default_factory=dict)
+    stats_history: deque = field(default_factory=deque)
 
     @property
     def load(self) -> int:
@@ -55,7 +63,11 @@ class Selector:
         self._rr = 0
 
     def select(self, nodes: list[NodeInfo], n: int) -> list[int]:
-        alive = [nd for nd in nodes if nd.alive]
+        # only DATANODES host regions: frontends/flownodes heartbeat
+        # into the same registry for fleet observability but must never
+        # be placement targets
+        alive = [nd for nd in nodes
+                 if nd.alive and nd.role == "datanode"]
         if not alive:
             raise IllegalStateError("no alive datanodes")
         out = []
@@ -72,7 +84,9 @@ class Selector:
 
 class Metasrv:
     def __init__(self, kv: KvBackend, *, selector: str = "round_robin",
-                 phi_threshold: float = 8.0):
+                 phi_threshold: float = 8.0,
+                 acceptable_pause_ms: float = 10_000.0,
+                 stats_history: int = 32):
         self.kv = kv
         self.selector = Selector(selector)
         self.nodes: dict[int, NodeInfo] = {}
@@ -80,6 +94,9 @@ class Metasrv:
         self.procedures = ProcedureManager(kv)
         self.maintenance_mode = False
         self.phi_threshold = phi_threshold
+        self.acceptable_pause_ms = acceptable_pause_ms
+        # bounded per-node ring of heartbeat-carried node-stats samples
+        self.stats_history = max(1, int(stats_history))
         self._mailbox: dict[int, list[dict]] = {}
         self._lock = concurrency.RLock()
         self._failover_cb = None  # set by the cluster: (region, from, to)
@@ -88,16 +105,21 @@ class Metasrv:
     # ------------------------------------------------------------------
     # node lifecycle + heartbeats
     # ------------------------------------------------------------------
-    def register_node(self, node_id: int, addr: str | None = None):
+    def register_node(self, node_id: int, addr: str | None = None,
+                      role: str = "datanode"):
         with self._lock:
-            self.nodes[node_id] = NodeInfo(node_id)
+            node = NodeInfo(node_id, role=role, addr=addr or "")
+            node.stats_history = deque(maxlen=self.stats_history)
+            self.nodes[node_id] = node
             self.detectors[node_id] = PhiAccrualFailureDetector(
-                threshold=self.phi_threshold
+                threshold=self.phi_threshold,
+                acceptable_heartbeat_pause_ms=self.acceptable_pause_ms,
             )
             self._mailbox.setdefault(node_id, [])
-            if addr:
+            if addr and role == "datanode":
                 # persisted peer address book: frontends resolve region
                 # routes to datanode Flight addresses through this
+                # (datanodes only — it feeds region routing)
                 self.kv.put_json(PEER_PREFIX + str(node_id), addr)
 
     def peers(self) -> dict[int, str]:
@@ -107,22 +129,64 @@ class Metasrv:
         }
 
     def heartbeat(self, node_id: int, region_stats: dict,
-                  now_ms: float | None = None) -> list[dict]:
+                  now_ms: float | None = None,
+                  node_stats: dict | None = None,
+                  role: str | None = None,
+                  addr: str | None = None) -> list[dict]:
         """Handler pipeline: keep lease, collect stats, feed detector,
         drain mailbox instructions (returned in the heartbeat response as
-        in the reference's mailbox design)."""
+        in the reference's mailbox design). `node_stats` is the
+        heartbeat-carried node telemetry payload
+        (telemetry/node_stats.build_node_stats): the latest sample plus
+        a bounded ring of recent ones are kept per node, and the
+        payload's role/addr heal a registration the leader lost (an HA
+        leader change re-learns the fleet from heartbeats alone)."""
         now_ms = now_ms if now_ms is not None else time.time() * 1000
+        # the sender's IDENTITY (role + addr) rides EVERY beat
+        # (explicit params, else the enriched payload): a restarted
+        # leader whose first contact with a node is a heartbeat — the
+        # client's beats kept succeeding, so it never re-registers —
+        # must still learn the right role (a frontend can never become
+        # a placement target) and heal the address book (a datanode
+        # with no peer-book addr is undialable). Absent both, the
+        # legacy datanode default applies.
+        beat_role = role or (node_stats or {}).get("role")
+        beat_addr = addr or (node_stats or {}).get("addr")
         with self._lock:
             node = self.nodes.get(node_id)
             if node is None:
-                self.register_node(node_id)
+                self.register_node(node_id, beat_addr,
+                                   role=beat_role or "datanode")
                 node = self.nodes[node_id]
+            else:
+                if beat_role:
+                    node.role = str(beat_role)
+                if beat_addr and node.addr != beat_addr:
+                    node.addr = str(beat_addr)
+                    if node.role == "datanode":
+                        # heal the persisted peer book too (one kv
+                        # write on CHANGE only, never per beat)
+                        self.kv.put_json(PEER_PREFIX + str(node_id),
+                                         node.addr)
             node.last_heartbeat_ms = now_ms
             node.region_stats = region_stats
             node.alive = True
+            if node_stats:
+                node.stats = node_stats
+                if node.stats_history.maxlen is None:
+                    node.stats_history = deque(
+                        maxlen=self.stats_history
+                    )
+                node.stats_history.append(
+                    {"ts_ms": now_ms, **node_stats}
+                )
             self.detectors[node_id].heartbeat(now_ms)
             instructions = self._mailbox.get(node_id, [])
             self._mailbox[node_id] = []
+            if node.role != "datanode":
+                # non-region roles get no lease grant (nothing routes
+                # to them); the heartbeat is pure liveness + telemetry
+                return instructions
             # region lease grant: every region this node leads
             leases = [
                 rid for rid, nid in self._all_routes().items()
@@ -133,6 +197,66 @@ class Metasrv:
                 "regions": leases,
                 "lease_secs": LEASE_SECS,
             }]
+
+    # ------------------------------------------------------------------
+    # fleet state (information_schema.cluster_* / meta_http /cluster)
+    # ------------------------------------------------------------------
+    def node_status(self, node_id: int,
+                    now_ms: float | None = None) -> str:
+        """Phi-accrual verdict for one node: ALIVE below half the
+        threshold, UNHEALTHY between, DOWN past it (or already marked
+        dead by the supervisor tick). UNKNOWN = registered but never
+        heartbeated."""
+        now_ms = now_ms if now_ms is not None else time.time() * 1000
+        with self._lock:
+            node = self.nodes.get(node_id)
+            det = self.detectors.get(node_id)
+        if node is None or det is None:
+            return "UNKNOWN"
+        if not node.alive:
+            return "DOWN"
+        if det.last_heartbeat_ms is None:
+            return "UNKNOWN"
+        phi = det.phi(now_ms)
+        if phi >= self.phi_threshold:
+            return "DOWN"
+        if phi >= self.phi_threshold * 0.5:
+            return "UNHEALTHY"
+        return "ALIVE"
+
+    def cluster_nodes(self, now_ms: float | None = None, *,
+                      history: bool = False) -> list[dict]:
+        """One document per registered node: identity, liveness verdict
+        (live phi value included), the latest heartbeat-carried
+        node-stats payload, and optionally the bounded sample ring."""
+        now_ms = now_ms if now_ms is not None else time.time() * 1000
+        out = []
+        # the whole snapshot builds under the lock (RLock — node_status
+        # re-enters): a heartbeat appending to a node's stats ring
+        # mid-copy would otherwise tear the deque iteration
+        with self._lock:
+            nodes = sorted(self.nodes.values(), key=lambda n: n.node_id)
+            phis = {
+                nid: det.phi(now_ms)
+                if det.last_heartbeat_ms is not None else None
+                for nid, det in self.detectors.items()
+            }
+            for node in nodes:
+                doc = {
+                    "node_id": node.node_id,
+                    "role": node.role,
+                    "addr": (node.addr
+                             or (node.stats or {}).get("addr", "")),
+                    "status": self.node_status(node.node_id, now_ms),
+                    "phi": phis.get(node.node_id),
+                    "last_heartbeat_ms": node.last_heartbeat_ms,
+                    "region_count": len(node.region_stats),
+                    "stats": dict(node.stats),
+                }
+                if history:
+                    doc["history"] = list(node.stats_history)
+                out.append(doc)
+        return out
 
     def send_instruction(self, node_id: int, instruction: dict):
         with self._lock:

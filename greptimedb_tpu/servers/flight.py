@@ -299,6 +299,15 @@ class FlightServer(flight.FlightServerBase):
         return [flight.Result(json.dumps(out or {}).encode())]
 
     def _do_action(self, kind: str, body: dict) -> dict | None:
+        if kind == "node_telemetry":
+            # fleet observability fan-out (dist/fleet.py): any role
+            # with a Flight server answers with its node-stats payload,
+            # requested information_schema telemetry docs, metrics
+            # text and/or deep-health JSON — all local reads, so a
+            # telemetry scrape can never wedge behind the data plane
+            from greptimedb_tpu.dist import fleet
+
+            return fleet.node_telemetry_local(self.instance, body)
         if kind in ("create_flow", "drop_flow", "flow_infos",
                     "flow_sources", "flow_epoch", "flush_flow"):
             return self._flow_action(kind, body)
@@ -441,6 +450,8 @@ class FlightServer(flight.FlightServerBase):
             ("region_stats", "per-region row/byte statistics"),
             ("data_versions", "per-region logical data versions"),
             ("list_regions", "region ids served by this datanode"),
+            ("node_telemetry", "node stats / telemetry docs / metrics "
+                               "text / deep health for the fleet plane"),
         ]
 
     def get_flight_info(self, context, descriptor: flight.FlightDescriptor):

@@ -184,6 +184,7 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             "/v1/events", "/v1/opentsdb/api/put", "/api/put",
             "/v1/otlp/v1/metrics", "/v1/traces", "/v1/traces/",
             "/v1/stats/statements",
+            "/v1/cluster/metrics", "/v1/cluster/health",
             "/debug/prof/cpu", "/debug/prof/mem", "/debug/prof/hbm",
             "/debug/prof/device", "/debug/prof/device/trace",
         )
@@ -251,7 +252,8 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
             self._dispatch("POST")
 
         _UNTRACED = ("/health", "/ready", "/-/healthy", "/-/ready",
-                     "/metrics", "/v1/traces", "/v1/stats/statements")
+                     "/metrics", "/v1/traces", "/v1/stats/statements",
+                     "/v1/cluster/metrics", "/v1/cluster/health")
 
         def _dispatch(self, method: str):
             from greptimedb_tpu.telemetry import tracing
@@ -333,7 +335,38 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
 
         def _route_request(self, method: str, path: str):
             if path in ("/health", "/ready", "/-/healthy", "/-/ready"):
+                params = self._params()
+                if params.get("deep") not in (None, "", "0", "false"):
+                    # real per-role readiness (telemetry/node_stats.py):
+                    # engine open, data dir appendable, object store
+                    # reachable, device dispatch OK, metasrv heartbeat
+                    # fresh — 503 when degraded so probes can act on it
+                    from greptimedb_tpu.telemetry import (
+                        node_stats as _ns,
+                    )
+
+                    doc = _ns.deep_health(instance)
+                    return self._json(
+                        200 if doc["status"] == "ok" else 503, doc
+                    )
                 return self._json(200, {})
+            if path == "/v1/cluster/metrics":
+                # federated scrape: every node's gtpu_*/greptime_*
+                # families re-labeled with node/role, TTL-cached so
+                # scrapes cannot stampede the fleet (dist/fleet.py)
+                from greptimedb_tpu.dist import fleet
+
+                return self._send(
+                    200, fleet.federated_metrics(instance).encode(),
+                    "text/plain; version=0.0.4",
+                )
+            if path == "/v1/cluster/health":
+                from greptimedb_tpu.dist import fleet
+
+                doc = fleet.federated_health(instance)
+                return self._json(
+                    200 if doc["status"] == "ok" else 503, doc
+                )
             if path == "/status":
                 return self._json(200, {
                     "source_time": "", "commit": "", "branch": "",

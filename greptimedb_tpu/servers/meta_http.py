@@ -60,6 +60,28 @@ def _make_handler(metasrv: Metasrv, kv: KvBackend):
                 return self._json(200, {
                     "status": "ok",
                     "is_leader": owner.election.is_leader,
+                    "uptime_s": owner.uptime_s(),
+                })
+            if path == "/cluster":
+                # fleet state lives in the LEADER's memory (liveness,
+                # detectors, heartbeat-carried stats): followers
+                # redirect like the POST surface does
+                if not owner.election.is_leader:
+                    leader, _exp = owner.election.leader()
+                    return self._json(200, {
+                        "error": "not leader", "leader": leader,
+                    })
+                query = self.path.partition("?")[2]
+                with_history = "history=1" in query
+                return self._json(200, {
+                    "nodes": metasrv.cluster_nodes(
+                        history=with_history
+                    ),
+                    "metasrv": {
+                        "addr": owner.election.me,
+                        "is_leader": owner.election.is_leader,
+                        "uptime_s": owner.uptime_s(),
+                    },
                 })
             if path == "/leader":
                 leader, expires = owner.election.leader()
@@ -100,8 +122,10 @@ def _make_handler(metasrv: Metasrv, kv: KvBackend):
                 })
             try:
                 if path == "/register":
-                    metasrv.register_node(int(doc["node_id"]),
-                                          doc.get("addr"))
+                    metasrv.register_node(
+                        int(doc["node_id"]), doc.get("addr"),
+                        role=str(doc.get("role") or "datanode"),
+                    )
                     return self._json(200, {})
                 if path == "/allocate":
                     routes = metasrv.allocate_regions(
@@ -119,6 +143,9 @@ def _make_handler(metasrv: Metasrv, kv: KvBackend):
                     instructions = metasrv.heartbeat(
                         int(doc["node_id"]),
                         doc.get("region_stats") or {},
+                        node_stats=doc.get("node_stats") or None,
+                        role=doc.get("role") or None,
+                        addr=doc.get("addr") or None,
                     )
                     return self._json(
                         200, {"instructions": instructions or []}
@@ -169,12 +196,22 @@ class MetasrvServer:
     def __init__(self, *, addr: str = "127.0.0.1", port: int = 4010,
                  data_home: str | None = None,
                  selector: str = "round_robin",
-                 election_lease_s: float = 5.0):
+                 election_lease_s: float = 5.0,
+                 phi_threshold: float = 8.0,
+                 acceptable_pause_ms: float = 10_000.0,
+                 stats_history: int = 32):
+        import time as _time
+
         self.kv: KvBackend = (
             FsKv(f"{data_home}/metasrv/kv.json") if data_home
             else MemoryKv()
         )
-        self.metasrv = Metasrv(self.kv, selector=selector)
+        self.metasrv = Metasrv(
+            self.kv, selector=selector, phi_threshold=phi_threshold,
+            acceptable_pause_ms=acceptable_pause_ms,
+            stats_history=stats_history,
+        )
+        self._started_monotonic = _time.monotonic()
         # region failover/migration executes against datanode PROCESSES
         # over Flight (dist/wire_cluster.py); procedures resume across
         # metasrv restarts via the persisted procedure store
@@ -204,6 +241,11 @@ class MetasrvServer:
             target=self._tick_loop, daemon=True, name="metasrv-tick"
         )
         self._stop = concurrency.Event()
+
+    def uptime_s(self) -> float:
+        import time as _time
+
+        return round(_time.monotonic() - self._started_monotonic, 3)
 
     def _tick_loop(self):
         while not self._stop.wait(1.0):

@@ -28,9 +28,14 @@ _LINE = re.compile(
 _LABEL = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 
-def scrape_registry(now_ms: int | None = None) -> list:
+def scrape_registry(now_ms: int | None = None,
+                    extra_labels: dict | None = None) -> list:
     """Render the global registry and parse it into remote-write-shaped
-    series: [(labels-with-__name__, [(value, ts_ms)])]."""
+    series: [(labels-with-__name__, [(value, ts_ms)])]. `extra_labels`
+    (e.g. {"node": ..., "role": ...}) stamp every series WITHOUT
+    overriding a label the metric already carries — two roles exporting
+    into one greptime_metrics database must never collide into one
+    series."""
     now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
     series = []
     for line in global_registry.render().splitlines():
@@ -48,6 +53,10 @@ def scrape_registry(now_ms: int | None = None) -> list:
         if m.group("labels"):
             for lk, lv in _LABEL.findall(m.group("labels")):
                 labels[lk] = lv.replace('\\"', '"').replace("\\\\", "\\")
+        if extra_labels:
+            for lk, lv in extra_labels.items():
+                if lv:
+                    labels.setdefault(lk, str(lv))
         series.append((labels, [(value, now_ms)]))
     return series
 
@@ -57,16 +66,31 @@ class ExportMetricsTask:
     object with the catalog/_notify_flows surface apply_series needs)."""
 
     def __init__(self, instance, *, db: str = "greptime_metrics",
-                 interval_s: float = 30.0):
+                 interval_s: float = 30.0, node: str | None = None,
+                 role: str | None = None):
         self.instance = instance
         self.db = db
         self.interval_s = max(1.0, float(interval_s))
+        # node/role identity labels stamped on every re-ingested
+        # series. None = resolve from the instance AT TICK TIME (the
+        # dialable address may bind after this task is constructed).
+        self.node = node
+        self.role = role
         self._stop = concurrency.Event()
         self._thread: threading.Thread | None = None
         self.runs = 0
         self.samples_written = 0
         self.failures = 0
         self._last_error: str | None = None
+
+    def _identity_labels(self) -> dict:
+        node = self.node
+        if node is None:
+            node = getattr(self.instance, "node_addr", "") or ""
+        role = self.role
+        if role is None:
+            role = getattr(self.instance, "node_role", "") or ""
+        return {"node": node, "role": role}
 
     def start(self):
         self.instance.catalog.create_database(self.db, if_not_exists=True)
@@ -94,7 +118,9 @@ class ExportMetricsTask:
 
         t0 = _time.perf_counter()
         try:
-            series = scrape_registry()
+            series = scrape_registry(
+                extra_labels=self._identity_labels()
+            )
             if series:
                 self.samples_written += apply_series(
                     self.instance, series, db=self.db

@@ -1093,3 +1093,102 @@ class MetricNamingConvention(Rule):
                                f"label name {lab!r} must be lowercase "
                                "(exported label names are part of the "
                                "query surface)")
+
+
+# scrape/heartbeat-path entry points GT019 guards: callbacks handed to
+# a metrics registry's register_collector (run on EVERY /metrics
+# render), the stats/buffers/evict hooks registered with the memory
+# accountant (same scrape path), and the heartbeat-payload builder
+# contract (telemetry/node_stats.build_node_stats rides every metasrv
+# heartbeat).
+_GT019_BUILDER_NAMES = {"build_node_stats"}
+
+
+@register
+class UnboundedScrapePathIO(Rule):
+    id = "GT019"
+    name = "unbounded-io-in-scrape-path"
+    description = (
+        "Blocking network I/O without an explicit bound inside a "
+        "registered MetricsRegistry collector hook or a heartbeat-"
+        "payload builder: collectors run on every /metrics render and "
+        "the payload builder rides every metasrv heartbeat, so one "
+        "hung peer would stall every scrape/heartbeat of this node — "
+        "exactly the liveness channel that must never hang. Pass an "
+        "explicit timeout/options bound, or move the I/O off the "
+        "scrape path entirely (cache it from a background task)."
+    )
+
+    def _hooks(self, ctx: FileContext) -> set[str]:
+        """Names of this file's scrape-path functions: anything handed
+        to <registry>.register_collector(...), the named stats/evict/
+        buffers callbacks of a register_pool(...) call, and the
+        heartbeat-payload builder names."""
+        cache = getattr(ctx, "_gt019_hooks", None)
+        if cache is not None:
+            return cache
+        hooks = set(_GT019_BUILDER_NAMES)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = dotted_name(node.func)
+            if f is None:
+                continue
+            short = f.split(".")[-1]
+            if short == "register_collector" and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Name):
+                    hooks.add(a.id)
+            elif short == "register_pool":
+                for kw in node.keywords:
+                    if (kw.arg in ("stats", "buffers", "evict")
+                            and isinstance(kw.value, ast.Name)):
+                        hooks.add(kw.value.id)
+        ctx._gt019_hooks = hooks
+        return hooks
+
+    @staticmethod
+    def _has_kw(node: ast.Call, name: str) -> bool:
+        return any(kw.arg == name for kw in node.keywords)
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        if not ctx.func_stack:
+            return
+        hooks = self._hooks(ctx)
+        # nested defs inside a hook are still on the scrape path
+        if not any(fi.name in hooks for fi in ctx.func_stack):
+            return
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            attr = node.func.id
+        else:
+            return
+        if attr in _FLIGHT_CLIENT_CALLS:
+            # NO self/cls exemption here: inside a collector even an
+            # internally-dispatched Flight call is wire I/O riding the
+            # scrape path
+            if not self._has_kw(node, "options"):
+                ctx.report(self, node,
+                           f".{attr}(...) inside a scrape/heartbeat "
+                           "hook without explicit call options — a "
+                           "hung peer stalls every scrape of this "
+                           "node; pass options=FlightCallOptions("
+                           "timeout=...) or move the I/O off the "
+                           "scrape path")
+        elif attr in _TIMEOUT_KW_CALLS:
+            pos_ok = (len(node.args) >= 3 if attr == "urlopen"
+                      else len(node.args) >= 2)
+            if not pos_ok and not self._has_kw(node, "timeout"):
+                ctx.report(self, node,
+                           f"{attr}(...) inside a scrape/heartbeat "
+                           "hook without a timeout — a hung peer "
+                           "stalls every scrape/heartbeat of this "
+                           "node; pass an explicit timeout")
+        elif attr == "HTTPConnection":
+            if not self._has_kw(node, "timeout"):
+                ctx.report(self, node,
+                           "HTTPConnection(...) inside a scrape/"
+                           "heartbeat hook without a timeout — "
+                           "requests on it block forever against a "
+                           "blackholed peer; pass timeout=")

@@ -818,3 +818,185 @@ def test_dist_statement_statistics_fold_one_row(topology):
         top = json.loads(resp.read())["statements"][0]
     assert top["datanodes"] == 3 * n
     assert top["exec_path"] == "dist"
+
+
+def test_fleet_observability(tmp_path):
+    """Fleet observability plane (ISSUE 15) on a REAL wire topology:
+    metasrv + 2 datanodes + frontend + flownode, each its own process.
+    One frontend SQL poll returns a cluster_node_stats row per live
+    node (real addr/uptime/memory from heartbeat payloads); SIGKILL a
+    datanode -> its status flips DOWN within the phi window and the
+    cluster_* fan-out tables keep answering (degraded, status-marked)
+    inside the request deadline; /v1/cluster/metrics federates every
+    node's gtpu_* families with node labels."""
+    procs = []
+    logs = []
+    # tightened phi window + heartbeat cadence so the DOWN flip lands
+    # in test time, not the production 10s acceptable pause
+    fleet_env = {
+        "GREPTIMEDB_TPU__METASRV__ACCEPTABLE_PAUSE_MS": "2500",
+        "GREPTIMEDB_TPU__FLEET__HEARTBEAT_INTERVAL_S": "0.5",
+        "GREPTIMEDB_TPU__FLEET__STATS_INTERVAL_S": "0.5",
+    }
+
+    def spawn(args, name):
+        log = open(tmp_path / f"{name}.log", "w")
+        logs.append(log)
+        p = _spawn_env(args, log, fleet_env)
+        procs.append(p)
+        return p
+
+    try:
+        meta_port = _free_port()
+        spawn(["metasrv", "start", "--data-home",
+               str(tmp_path / "meta"),
+               "--metasrv-addr", f"127.0.0.1:{meta_port}",
+               "--http-addr", ""], "metasrv")
+        _wait_http(f"127.0.0.1:{meta_port}")
+
+        dn_ports = []
+        dn_procs = {}
+        for i in range(2):
+            port = _free_port()
+            dn_ports.append(port)
+            dn_procs[i] = spawn(
+                ["datanode", "start",
+                 "--data-home", str(tmp_path / f"dn{i}"),
+                 "--flight-addr", f"127.0.0.1:{port}",
+                 "--metasrv-addr", f"127.0.0.1:{meta_port}",
+                 "--node-id", str(i), "--http-addr", "",
+                 "--mysql-addr", "", "--postgres-addr", "",
+                 "--no-flows"], f"dn{i}")
+        for port in dn_ports:
+            _wait_port(port)
+
+        flow_port = _free_port()
+        spawn(["flownode", "start",
+               "--data-home", str(tmp_path / "flow"),
+               "--flight-addr", f"127.0.0.1:{flow_port}",
+               "--metasrv-addr", f"127.0.0.1:{meta_port}",
+               "--http-addr", "", "--mysql-addr", "",
+               "--postgres-addr", ""], "flownode")
+        _wait_port(flow_port)
+
+        fe_port = _free_port()
+        spawn(["frontend", "start", "--data-home", str(tmp_path / "fe"),
+               "--http-addr", f"127.0.0.1:{fe_port}",
+               "--metasrv-addr", f"127.0.0.1:{meta_port}",
+               "--flownode-addr", f"127.0.0.1:{flow_port}",
+               "--mysql-addr", "", "--postgres-addr", "",
+               "--flight-addr", ""], "frontend")
+        fe = f"127.0.0.1:{fe_port}"
+        _wait_http(fe, path="/health")
+
+        # ONE frontend SQL poll eventually returns a row per live node
+        # (2 datanodes + flownode + frontend), every one ALIVE with a
+        # real addr and uptime carried by its heartbeat payload
+        deadline = time.time() + 120
+        rows = []
+        while time.time() < deadline:
+            doc = _sql(fe, "select role, addr, status, uptime_s, "
+                           "mem_host_bytes from information_schema."
+                           "cluster_node_stats where role != 'metasrv'")
+            rows = _rows(doc)
+            roles = sorted(r[0] for r in rows
+                           if r[2] == "ALIVE" and r[1] and r[3] > 0)
+            if roles == ["datanode", "datanode", "flownode",
+                         "frontend"]:
+                break
+            time.sleep(0.5)
+        assert sorted(r[0] for r in rows) == [
+            "datanode", "datanode", "flownode", "frontend",
+        ], rows
+        assert all(r[1] and r[2] == "ALIVE" and r[3] > 0
+                   for r in rows), rows
+
+        _sql(fe, "create table cpu (ts timestamp time index, host "
+                 "string primary key, usage double) "
+                 "with (num_regions = 2)")
+        _sql(fe, "insert into cpu (host, ts, usage) values "
+                 "('h1', 1000, 1.0), ('h2', 2000, 2.0)")
+
+        # region_peers resolves real datanode addrs + detector status
+        doc = _sql(fe, "select peer_addr, status from "
+                       "information_schema.region_peers")
+        peer_rows = _rows(doc)
+        assert len(peer_rows) == 2
+        assert {a for a, _s in peer_rows} == {
+            f"127.0.0.1:{p}" for p in dn_ports
+        }
+        assert all(s == "ALIVE" for _a, s in peer_rows)
+
+        # cluster fan-out: every peer contributes rows
+        doc = _sql(fe, "select distinct peer, peer_status from "
+                       "information_schema.cluster_runtime_metrics")
+        peers_ok = {p for p, s in _rows(doc) if s == "ok"}
+        for port in dn_ports + [flow_port]:
+            assert f"127.0.0.1:{port}" in peers_ok
+
+        # federated metrics: every node's gtpu_* families, node-labeled
+        with urllib.request.urlopen(
+            f"http://{fe}/v1/cluster/metrics", timeout=30
+        ) as resp:
+            text = resp.read().decode()
+        assert "gtpu_fleet_heartbeats_total" in text
+        for port in dn_ports + [flow_port]:
+            assert f'node="127.0.0.1:{port}"' in text, port
+        # deep health: real per-role readiness on the frontend
+        with urllib.request.urlopen(
+            f"http://{fe}/health?deep=1", timeout=30
+        ) as resp:
+            hdoc = json.loads(resp.read())
+        assert hdoc["status"] == "ok" and hdoc["checks"]
+
+        # SIGKILL one datanode: no shutdown path runs, heartbeats just
+        # stop — the phi detector must flip it DOWN within the window
+        dn_procs[1].kill()
+        dn_procs[1].wait(timeout=10)
+        deadline = time.time() + 45
+        status = None
+        while time.time() < deadline:
+            doc = _sql(fe, "select status from information_schema."
+                           "cluster_node_stats where peer_id = 1")
+            got = _rows(doc)
+            status = got[0][0] if got else None
+            if status == "DOWN":
+                break
+            time.sleep(0.5)
+        assert status == "DOWN", status
+
+        # fan-out tables degrade to reachable peers + status column,
+        # answering inside the request deadline (?timeout= binds it)
+        t0 = time.time()
+        doc = _sql(fe, "select distinct peer, peer_status from "
+                       "information_schema.cluster_runtime_metrics")
+        elapsed = time.time() - t0
+        got = {p: s for p, s in _rows(doc)}
+        assert got[f"127.0.0.1:{dn_ports[0]}"] == "ok"
+        assert got[f"127.0.0.1:{flow_port}"] == "ok"
+        assert got[f"127.0.0.1:{dn_ports[1]}"] != "ok"
+        assert elapsed < 10.0, elapsed
+
+        # federated health reports the dead node, aggregate degraded
+        req = urllib.request.Request(f"http://{fe}/v1/cluster/health")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                hdoc = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            hdoc = json.loads(e.read())
+        assert hdoc["status"] == "degraded"
+        dead = [n for n in hdoc["nodes"]
+                if n["peer"] == f"127.0.0.1:{dn_ports[1]}"]
+        assert dead and dead[0]["status"] == "unreachable"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()

@@ -35,7 +35,7 @@ def rules_hit(src: str, select: str | None = None):
 
 def test_registry_has_all_rules():
     ids = sorted(all_rules())
-    assert ids == [f"GT{n:03d}" for n in range(1, 19)]
+    assert ids == [f"GT{n:03d}" for n in range(1, 20)]
     for rule in all_rules().values():
         assert rule.name and rule.description
 
@@ -1536,6 +1536,81 @@ def test_gt018_nested_def_does_not_inherit_device_call_scope():
                 return d.run(prog, x), later
     """, select="GT018")
     assert hits == [("GT018", 12)]
+
+
+# ---------------------------------------------------------------------------
+# GT019 unbounded I/O in scrape/heartbeat paths
+# ---------------------------------------------------------------------------
+
+def test_gt019_positive_collector_urlopen_unbounded():
+    hits = rules_hit("""
+        from urllib.request import urlopen
+        from greptimedb_tpu.telemetry.metrics import global_registry
+
+        def _collect():
+            urlopen("http://peer:4000/metrics")
+
+        global_registry.register_collector(_collect)
+    """, select="GT019")
+    assert hits == [("GT019", 6)]
+
+
+def test_gt019_positive_heartbeat_builder_flight_call():
+    hits = rules_hit("""
+        def build_node_stats(inst):
+            out = {}
+            out["peer"] = inst.client.do_action("region_stats")
+            return out
+    """, select="GT019")
+    assert hits == [("GT019", 4)]
+
+
+def test_gt019_positive_pool_stats_hook_httpconn():
+    hits = rules_hit("""
+        import http.client
+        from greptimedb_tpu.telemetry import memory
+
+        def _pool_stats(pool):
+            conn = http.client.HTTPConnection("peer", 80)
+            return {}
+
+        memory.register_pool("p", "host", object(), stats=_pool_stats)
+    """, select="GT019")
+    assert hits == [("GT019", 6)]
+
+
+def test_gt019_positive_nested_def_inherits_hook_scope():
+    hits = rules_hit("""
+        from urllib.request import urlopen
+        from greptimedb_tpu.telemetry.metrics import global_registry
+
+        def _collect():
+            def inner():
+                urlopen("http://peer:4000/metrics")
+            inner()
+
+        global_registry.register_collector(_collect)
+    """, select="GT019")
+    assert hits == [("GT019", 7)]
+
+
+def test_gt019_negative_bounded_and_off_path():
+    # bounded calls in a hook are fine; the same unbounded calls
+    # OUTSIDE a registered hook are not GT019's business (GT012 covers
+    # the general case)
+    assert rules_hit("""
+        from urllib.request import urlopen
+        from greptimedb_tpu.telemetry.metrics import global_registry
+
+        def _collect():
+            urlopen("http://peer:4000/metrics", timeout=2.0)
+            cli.do_action("x", options=opts)
+
+        global_registry.register_collector(_collect)
+
+        def not_a_hook():
+            urlopen("http://peer:4000/metrics")
+    """, select="GT019") == []
 
 
 # ---------------------------------------------------------------------------
