@@ -1,0 +1,92 @@
+"""gtune — the adaptive control plane closing the observability loop.
+
+PRs 10-15 built the measurement plane (per-fingerprint statement
+statistics, per-program rooflines, the per-pool HBM ledger, fleet node
+stats); this package closes the loop in the tf.data-AUTOTUNE mold: a
+sensor layer over those existing in-memory surfaces, feedback
+controllers with hard guardrails, and a knob registry that is the
+single sanctioned writer for every runtime-mutable knob.
+
+Layout:
+- knobs.py        KnobRegistry + the standard knob set (the validated
+                  update API `ADMIN set_config` also rides)
+- sensors.py      read-only signal extraction from telemetry surfaces
+- controllers.py  admission concurrency, planner shard thresholds,
+                  HBM budget reallocation, compaction pacing
+- runtime.py      the per-process tick loop, freeze, audit surfaces
+
+Off by default (`[autotune] enable = false`): a process that never
+enables it gets a registry (so `ADMIN set_config` and the
+information_schema surfaces work) and nothing else — no thread, no
+sensor reads, bit-for-bit identical knob values.
+"""
+
+from __future__ import annotations
+
+from greptimedb_tpu.autotune.controllers import (
+    AdmissionConcurrencyController,
+    CompactionPacingController,
+    Controller,
+    Guardrails,
+    HbmBudgetController,
+    PlannerThresholdController,
+)
+from greptimedb_tpu.autotune.knobs import (
+    KnobChange,
+    KnobRegistry,
+    KnobSpec,
+    build_registry,
+)
+from greptimedb_tpu.autotune.runtime import AutotuneRuntime
+from greptimedb_tpu.autotune.sensors import (
+    AdmissionSensor,
+    CompactionSensor,
+    HbmSensor,
+    PlannerSensor,
+)
+
+__all__ = [
+    "AdmissionConcurrencyController",
+    "AdmissionSensor",
+    "AutotuneRuntime",
+    "CompactionPacingController",
+    "CompactionSensor",
+    "Controller",
+    "Guardrails",
+    "HbmBudgetController",
+    "HbmSensor",
+    "KnobChange",
+    "KnobRegistry",
+    "KnobSpec",
+    "PlannerSensor",
+    "PlannerThresholdController",
+    "build_registry",
+    "build_runtime",
+]
+
+
+def build_runtime(inst, section: dict | None = None
+                  ) -> tuple[KnobRegistry, AutotuneRuntime]:
+    """Wire the standard control plane over a Standalone instance:
+    the full knob set, the four controllers on their real sensors,
+    and a (not yet started) runtime. `section` is the `[autotune]`
+    TOML dict; without it everything is registered but disabled."""
+    o = section or {}
+    registry = build_registry(inst, history=int(o.get("history", 256)))
+    baseline_workers = 1
+    try:
+        baseline_workers = int(inst.engine.compaction.opts.workers)
+    except (AttributeError, TypeError, ValueError):
+        pass  # engine not fully wired (tests): keep the default of 1
+    controllers = [
+        AdmissionConcurrencyController(
+            registry, AdmissionSensor(inst)),
+        PlannerThresholdController(registry, PlannerSensor(inst)),
+        HbmBudgetController(registry, HbmSensor(registry)),
+        CompactionPacingController(
+            registry, CompactionSensor(inst),
+            baseline_workers=baseline_workers),
+    ]
+    runtime = AutotuneRuntime(registry, controllers)
+    runtime.apply_options(o)
+    return registry, runtime

@@ -1,0 +1,278 @@
+"""Feedback controllers: sensor signal -> guarded knob movement.
+
+Each controller is a pure function of its sensor's current signal
+(tests drive them with simulated sensors), wrapped in the shared
+guardrails:
+
+- step clamp    — one decision moves a knob at most ``step`` of its
+                  current value (never to/through zero)
+- hysteresis    — a target inside ``band`` of the current value is
+                  noise, not a decision
+- cooldown      — after a decision the controller holds for
+                  ``cooldown_ticks`` ticks so the system's response
+                  lands in the sensors before the next move
+- freeze/enable — the runtime skips frozen/disabled controllers
+                  entirely (zero decisions, zero knob reads)
+
+All writes go through KnobRegistry.set (bounds re-checked, change
+logged, metrics published) — controllers never touch a live object
+directly (gtlint GT021)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class Guardrails:
+    step: float = 0.25        # max relative movement per decision
+    band: float = 0.15        # hysteresis dead-band (relative)
+    cooldown_ticks: int = 2   # ticks to hold after a decision
+
+
+class Controller:
+    """Base: cooldown/enable bookkeeping + the guarded step helper."""
+
+    name = "base"
+
+    def __init__(self, knobs, sense, *, enabled: bool = True,
+                 rails: Guardrails | None = None):
+        self.knobs = knobs
+        self.sense = sense
+        self.enabled = bool(enabled)
+        self.rails = rails or Guardrails()
+        self._tick = 0
+        self._last_change_tick: int | None = None
+
+    def tick(self) -> int:
+        """One control step. Returns the number of applied knob
+        changes (0 while disabled, cooling down, or signal-less)."""
+        self._tick += 1
+        if not self.enabled:
+            return 0
+        if (self._last_change_tick is not None
+                and self._tick - self._last_change_tick
+                < self.rails.cooldown_ticks):
+            return 0
+        sig = self.sense()
+        if not sig:
+            return 0
+        applied = self.decide(sig)
+        if applied:
+            self._last_change_tick = self._tick
+        return applied
+
+    def decide(self, sig) -> int:  # pragma: no cover - subclass hook
+        raise NotImplementedError
+
+    # ---- the guarded actuation primitive ------------------------------
+    def _move(self, knob: str, target: float, evidence: dict) -> int:
+        """Step the knob toward ``target``: hysteresis-banded, step-
+        clamped, bound-clamped, applied through the registry. Returns
+        1 when a change landed, 0 when the move was absorbed."""
+        cur = self.knobs.get(knob)
+        r = self.rails
+        if abs(target - cur) <= r.band * abs(cur):
+            return 0
+        lo_step = cur * (1.0 - r.step)
+        hi_step = cur * (1.0 + r.step)
+        new = min(max(float(target), lo_step), hi_step)
+        spec = self.knobs.spec(knob)
+        if spec is not None and spec.kind is int:
+            new = int(round(new))
+            if new == cur:
+                # integer knobs always move at least one notch once
+                # the target cleared the hysteresis band
+                new = cur + (1 if target > cur else -1)
+        if spec is not None:
+            if spec.lo is not None:
+                new = max(new, spec.kind(spec.lo))
+            if spec.hi is not None:
+                new = min(new, spec.kind(spec.hi))
+        if new == cur:
+            return 0
+        self.knobs.set(knob, new, source=self.name, evidence=evidence)
+        return 1
+
+
+# ----------------------------------------------------------------------
+# admission: cost-aware concurrency
+# ----------------------------------------------------------------------
+
+class AdmissionConcurrencyController(Controller):
+    """Sizes `[scheduler] max_concurrency` from measured statement
+    cost instead of a hand-picked constant.
+
+    Raise: statements are queued AND the queue-wait p99 is large
+    relative to the per-fingerprint mean cost (waiting dominates
+    working — slots, not capacity, are the bottleneck).
+    Lower: the queue has been empty and the running count sits well
+    under the limit — shrink toward the observed need so a later load
+    spike degrades gradually (queue first) instead of thrashing.
+    A limit of 0 means the operator chose 'unlimited': the controller
+    never turns admission control on by itself."""
+
+    name = "admission"
+    # queue wait above this multiple of mean statement cost = pressure
+    QUEUE_COST_RATIO = 1.0
+
+    def decide(self, sig: dict) -> int:
+        knob = "scheduler.max_concurrency"
+        cur = int(self.knobs.get(knob))
+        if cur <= 0:
+            return 0
+        queued = int(sig.get("queued") or 0)
+        running = int(sig.get("running") or 0)
+        mean_cost = sig.get("mean_cost_ms")
+        qp99 = sig.get("queue_p99_ms")
+        evidence = {k: sig[k] for k in
+                    ("running", "queued", "mean_cost_ms", "queue_p99_ms",
+                     "shed_total") if k in sig}
+        evidence["top"] = sig.get("top") or []
+        evidence["limit"] = cur
+        if queued > 0:
+            pressured = True
+            if mean_cost and qp99 is not None:
+                pressured = (qp99
+                             >= self.QUEUE_COST_RATIO * float(mean_cost))
+            if pressured:
+                return self._move(knob, cur * (1.0 + self.rails.step),
+                                  evidence)
+            return 0
+        if running < cur * (1.0 - self.rails.band):
+            # idle headroom: one slot above the observed concurrency
+            return self._move(knob, max(1, running + 1), evidence)
+        return 0
+
+
+# ----------------------------------------------------------------------
+# planner: shard/replicate thresholds
+# ----------------------------------------------------------------------
+
+class PlannerThresholdController(Controller):
+    """Moves `[mesh] shard_min_series` / `shard_min_rows` from the
+    MEASURED shard-vs-replicate latency ratio: when sharded
+    statements run faster than replicated ones, work near the
+    threshold is being left on the single-device path — lower it;
+    when replicate wins (shard overhead dominating at the current
+    margin), raise it. Both thresholds move by the same relative
+    factor so the grid and row paths stay consistent."""
+
+    name = "planner"
+
+    def decide(self, sig: dict) -> int:
+        shard_ms = float(sig.get("shard_ms") or 0.0)
+        repl_ms = float(sig.get("replicate_ms") or 0.0)
+        if shard_ms <= 0.0 or repl_ms <= 0.0:
+            return 0
+        speedup = repl_ms / shard_ms
+        band = self.rails.band
+        if abs(speedup - 1.0) <= band:
+            return 0
+        factor = ((1.0 - self.rails.step) if speedup > 1.0
+                  else (1.0 + self.rails.step))
+        evidence = dict(sig)
+        evidence["shard_speedup"] = round(speedup, 3)
+        n = 0
+        for knob in ("mesh.shard_min_series", "mesh.shard_min_rows"):
+            cur = self.knobs.get(knob)
+            n += self._move(knob, cur * factor, evidence)
+        return n
+
+
+# ----------------------------------------------------------------------
+# HBM: budget reallocation across the cache pools
+# ----------------------------------------------------------------------
+
+class HbmBudgetController(Controller):
+    """Shifts byte budget between the registered cache pools
+    (sessions / result / scan) toward the pool with the highest miss
+    pressure per budget byte. Conservative by construction: the total
+    budget is CONSERVED (one donor shrinks by exactly what one
+    receiver gains), a transfer needs an actively evicting receiver,
+    and the donor must be measurably colder than the receiver (the
+    hysteresis band) so two warm pools never see-saw."""
+
+    name = "hbm"
+    # smallest transfer worth the churn (a starved pool near zero
+    # budget still gets off the ground)
+    MIN_TRANSFER = 64 * 1024
+
+    @staticmethod
+    def _pressure(p: dict) -> float:
+        return p["misses_d"] / max(float(p["budget"]), 1.0)
+
+    def decide(self, pools: list[dict]) -> int:
+        if len(pools) < 2:
+            return 0
+        recv = max(pools, key=self._pressure)
+        if recv["misses_d"] <= 0 or recv["evictions_d"] <= 0:
+            return 0  # nobody is budget-starved
+        donors = [p for p in pools if p is not recv]
+        donor = min(donors, key=self._pressure)
+        if (self._pressure(donor) * (1.0 + self.rails.band)
+                >= self._pressure(recv)):
+            return 0  # not enough contrast to act on
+        # exact byte swap, step-clamped against the SMALLER budget so
+        # neither pool moves more than `step` of itself in one decision
+        delta = max(
+            self.MIN_TRANSFER,
+            int(min(donor["budget"], recv["budget"]) * self.rails.step),
+        )
+        dspec = self.knobs.spec(donor["knob"])
+        floor = int(dspec.lo or 0) if dspec is not None else 0
+        delta = min(delta, max(0, donor["budget"] - floor))
+        if delta <= 0:
+            return 0
+        evidence = {"receiver": dict(recv), "donor": dict(donor),
+                    "transfer_bytes": delta}
+        self.knobs.set(donor["knob"], donor["budget"] - delta,
+                       source=self.name, evidence=evidence)
+        self.knobs.set(recv["knob"], recv["budget"] + delta,
+                       source=self.name, evidence=evidence)
+        return 2
+
+
+# ----------------------------------------------------------------------
+# compaction: pacing from read-amp vs ingest rate
+# ----------------------------------------------------------------------
+
+class CompactionPacingController(Controller):
+    """Paces merges against the measured read/write balance: read-amp
+    past the L1 trigger means scans are paying for deferred merges —
+    tighten the trigger first (cheap), widen the pool when the
+    trigger is already at its floor (parallel merges). Read-amp well
+    under the trigger with the pool widened means merging outran
+    ingest — give the width back so merge threads don't sit on the
+    thread budget. The trigger is never relaxed past its configured
+    start (write-amp guard), and the pool never shrinks below 1."""
+
+    name = "compaction"
+
+    def __init__(self, knobs, sense, *, baseline_workers: int = 1,
+                 **kw):
+        super().__init__(knobs, sense, **kw)
+        self.baseline_workers = max(1, int(baseline_workers))
+
+    def decide(self, sig: dict) -> int:
+        trigger = int(self.knobs.get("compaction.l1_trigger_files"))
+        workers = int(self.knobs.get("compaction.workers"))
+        read_amp = int(sig.get("read_amp") or 0)
+        evidence = dict(sig)
+        evidence.update({"l1_trigger_files": trigger,
+                         "workers": workers})
+        spec = self.knobs.spec("compaction.l1_trigger_files")
+        floor = int(spec.lo) if spec and spec.lo is not None else 2
+        if read_amp > trigger * (1.0 + self.rails.band):
+            if trigger > floor:
+                return self._move("compaction.l1_trigger_files",
+                                  trigger * (1.0 - self.rails.step),
+                                  evidence)
+            return self._move("compaction.workers", workers + 1,
+                              evidence)
+        if (read_amp < trigger * (1.0 - self.rails.band)
+                and workers > self.baseline_workers):
+            return self._move("compaction.workers",
+                              max(self.baseline_workers, workers - 1),
+                              evidence)
+        return 0

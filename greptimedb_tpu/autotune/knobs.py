@@ -1,0 +1,318 @@
+"""Runtime-mutable knob registry: the single sanctioned write path.
+
+Every knob the control plane may move at runtime registers here with a
+typed bound and a pair of closures over the live object (read the
+current value / apply a validated one). ``KnobRegistry.set`` is the ONE
+write path — the autotune controllers (autotune/controllers.py) and
+``ADMIN set_config('<section>.<knob>', <value>)`` both go through it,
+so every change is validated against the declared bounds, lands in the
+change log (the ``information_schema.autotune_decisions`` surface), and
+publishes on ``gtpu_autotune_knob_value{knob=...}``. gtlint GT021 keeps
+everything else out: a direct assignment to a registered knob attribute
+outside the owning object / this package is a lint finding, so two
+tuners can never fight over the same knob.
+
+Deliberately NOT here: durability/correctness knobs (WAL backend,
+manifest cadence, merge modes, recovery options). Autotune moves
+performance trade-offs only; anything that can lose or corrupt data
+stays frozen at process start.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from greptimedb_tpu import concurrency
+from greptimedb_tpu.errors import InvalidArgumentError
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+_KNOB_VALUE = global_registry.gauge(
+    "gtpu_autotune_knob_value",
+    "current value of each registered runtime-mutable knob",
+    labels=("knob",),
+)
+_DECISIONS = global_registry.counter(
+    "gtpu_autotune_decisions_total",
+    "applied knob changes (controller label: which tuner, or 'admin')",
+    labels=("controller",),
+)
+
+
+@dataclass
+class KnobSpec:
+    """One runtime-mutable knob: dotted path, type, bounds, accessors."""
+
+    path: str                  # "scheduler.max_concurrency"
+    kind: type                 # int | float | bool
+    lo: float | None
+    hi: float | None
+    doc: str
+    getter: object             # () -> current value
+    setter: object             # (validated value) -> None
+    # pool name in the memory accountant for byte-budget knobs (the
+    # HBM reallocation controller maps pool pressure -> knob)
+    pool: str | None = None
+
+
+@dataclass
+class KnobChange:
+    """One applied change — the audit-log row."""
+
+    ts_ms: int
+    controller: str            # "admin" or the controller name
+    knob: str
+    old: object
+    new: object
+    evidence: dict = field(default_factory=dict)
+
+    def to_doc(self) -> dict:
+        return {
+            "ts_ms": self.ts_ms, "controller": self.controller,
+            "knob": self.knob, "old": self.old, "new": self.new,
+            "evidence": json.dumps(self.evidence, sort_keys=True,
+                                   default=str),
+        }
+
+
+class KnobRegistry:
+    """Validated update API over the registered knob set.
+
+    All mutation rides ``set``: type coercion, bound check, apply via
+    the spec's setter, change-log append, metric publish — under one
+    lock so concurrent ADMIN/controller writers serialize."""
+
+    def __init__(self, history: int = 256):
+        self._lock = concurrency.Lock()
+        self._specs: dict[str, KnobSpec] = {}
+        self._changes: deque[KnobChange] = deque(maxlen=max(history, 1))
+
+    # ---- registration -------------------------------------------------
+    def register(self, spec: KnobSpec) -> None:
+        with self._lock:
+            self._specs[spec.path] = spec
+        try:
+            _KNOB_VALUE.labels(spec.path).set(float(spec.getter()))
+        except (AttributeError, TypeError, ValueError):
+            pass  # live object not wired yet; gauge appears on first set
+
+    def paths(self) -> list[str]:
+        with self._lock:
+            return sorted(self._specs)
+
+    def spec(self, path: str) -> KnobSpec | None:
+        with self._lock:
+            return self._specs.get(path)
+
+    # ---- read ---------------------------------------------------------
+    def get(self, path: str):
+        spec = self.spec(path)
+        if spec is None:
+            raise InvalidArgumentError(
+                f"unknown runtime-mutable knob {path!r}; "
+                f"known: {', '.join(self.paths())}"
+            )
+        return spec.getter()
+
+    def _coerce(self, spec: KnobSpec, value):
+        if spec.kind is bool:
+            if isinstance(value, bool):
+                return value
+            if isinstance(value, (int, float)) and value in (0, 1):
+                return bool(value)
+            if isinstance(value, str) and value.lower() in (
+                    "true", "false", "0", "1"):
+                return value.lower() in ("true", "1")
+            raise InvalidArgumentError(
+                f"knob {spec.path!r} expects a boolean, got {value!r}"
+            )
+        if isinstance(value, bool):
+            raise InvalidArgumentError(
+                f"knob {spec.path!r} expects {spec.kind.__name__}, "
+                f"got a boolean"
+            )
+        if isinstance(value, str):
+            try:
+                value = float(value) if spec.kind is float else int(value)
+            except ValueError:
+                raise InvalidArgumentError(
+                    f"knob {spec.path!r} expects "
+                    f"{spec.kind.__name__}, got {value!r}"
+                ) from None
+        if spec.kind is int:
+            if isinstance(value, float) and not value.is_integer():
+                raise InvalidArgumentError(
+                    f"knob {spec.path!r} expects an integer, "
+                    f"got {value!r}"
+                )
+            try:
+                return int(value)
+            except (TypeError, ValueError):
+                raise InvalidArgumentError(
+                    f"knob {spec.path!r} expects an integer, "
+                    f"got {value!r}"
+                ) from None
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            raise InvalidArgumentError(
+                f"knob {spec.path!r} expects a number, got {value!r}"
+            ) from None
+
+    # ---- the single write path ---------------------------------------
+    def set(self, path: str, value, *, source: str = "admin",
+            evidence: dict | None = None):
+        """Validate and apply one knob change. Returns (old, new).
+        Raises InvalidArgumentError on unknown knob / type mismatch /
+        out-of-bounds value. A no-op write (new == old) is applied but
+        NOT logged — hysteresis lives with the callers; the audit log
+        records actual movement."""
+        spec = self.spec(path)
+        if spec is None:
+            raise InvalidArgumentError(
+                f"unknown runtime-mutable knob {path!r}; "
+                f"known: {', '.join(self.paths())}"
+            )
+        new = self._coerce(spec, value)
+        if spec.lo is not None and new < spec.lo:
+            raise InvalidArgumentError(
+                f"knob {path!r}: {new!r} below the lower bound "
+                f"{spec.lo:g}"
+            )
+        if spec.hi is not None and new > spec.hi:
+            raise InvalidArgumentError(
+                f"knob {path!r}: {new!r} above the upper bound "
+                f"{spec.hi:g}"
+            )
+        with self._lock:
+            old = spec.getter()
+            if new == old:
+                return old, new
+            spec.setter(new)
+            change = KnobChange(
+                ts_ms=int(time.time() * 1000), controller=source,
+                knob=path, old=old, new=new,
+                evidence=dict(evidence or {}),
+            )
+            self._changes.append(change)
+        _KNOB_VALUE.labels(path).set(float(new))
+        _DECISIONS.labels(source).inc()
+        return old, new
+
+    # ---- audit surfaces ----------------------------------------------
+    def changes(self) -> list[KnobChange]:
+        with self._lock:
+            return list(self._changes)
+
+    def decision_count(self) -> int:
+        with self._lock:
+            return len(self._changes)
+
+    def snapshot(self) -> list[dict]:
+        """Current value + declared bounds per knob (the
+        information_schema.autotune_knobs surface)."""
+        out = []
+        for path in self.paths():
+            spec = self.spec(path)
+            if spec is None:
+                continue
+            try:
+                value = spec.getter()
+            except Exception:  # noqa: BLE001 - live object torn down
+                value = None
+            out.append({
+                "knob": path, "value": value,
+                "kind": spec.kind.__name__,
+                "lo": spec.lo, "hi": spec.hi,
+                "pool": spec.pool or "", "doc": spec.doc,
+            })
+        return out
+
+
+# ----------------------------------------------------------------------
+# the standard knob set over a Standalone instance
+# ----------------------------------------------------------------------
+
+def build_registry(inst, history: int = 256) -> KnobRegistry:
+    """Register every runtime-mutable knob the controllers may move.
+
+    Accessors close over ``inst`` by attribute lookup at call time, so
+    cli.py swapping in the [scheduler]/[result_cache]-configured
+    objects AFTER Standalone.__init__ is picked up transparently.
+    Bounds are wide operator-sanity rails, not tuning targets — the
+    controllers add their own step clamps on top."""
+    from greptimedb_tpu.parallel import mesh as mesh_mod
+    from greptimedb_tpu.query import sessions as sessions_mod
+
+    reg = KnobRegistry(history=history)
+
+    def _mesh_opts():
+        return (getattr(inst.query_engine, "mesh_opts", None)
+                or mesh_mod.global_mesh_opts()
+                or mesh_mod.MeshOptions())
+
+    def _set_mesh(**kw):
+        new = mesh_mod.update_shard_thresholds(base=_mesh_opts(), **kw)
+        inst.query_engine.mesh_opts = new
+
+    reg.register(KnobSpec(
+        "scheduler.max_concurrency", int, 0, 65536,
+        "global execution slots (0 = unlimited)",
+        getter=lambda: inst.scheduler.config.max_concurrency,
+        setter=lambda v: inst.scheduler.set_max_concurrency(v),
+    ))
+    reg.register(KnobSpec(
+        "mesh.shard_min_series", int, 1, 1 << 24,
+        "grids below this series count replicate instead of shard",
+        getter=lambda: _mesh_opts().shard_min_series,
+        setter=lambda v: _set_mesh(shard_min_series=v),
+    ))
+    reg.register(KnobSpec(
+        "mesh.shard_min_rows", int, 1, 1 << 30,
+        "row reductions below this row count replicate",
+        getter=lambda: _mesh_opts().shard_min_rows,
+        setter=lambda v: _set_mesh(shard_min_rows=v),
+    ))
+    reg.register(KnobSpec(
+        "sessions.hbm_bytes", int, 0, 1 << 40,
+        "HBM byte budget for persistent session result buffers",
+        getter=lambda: sessions_mod.global_sessions.max_bytes,
+        setter=lambda v: sessions_mod.global_sessions.set_max_bytes(v),
+        pool="sessions",
+    ))
+    reg.register(KnobSpec(
+        "result_cache.bytes", int, 0, 1 << 40,
+        "frontend result-set cache byte budget",
+        getter=lambda: inst.result_cache.max_bytes,
+        setter=lambda v: inst.result_cache.set_max_bytes(v),
+        pool="result_cache",
+    ))
+    reg.register(KnobSpec(
+        "compaction.workers", int, 1, 64,
+        "bounded merge pool width",
+        getter=lambda: inst.engine.compaction.opts.workers,
+        setter=lambda v: inst.engine.compaction.set_workers(v),
+    ))
+    reg.register(KnobSpec(
+        "compaction.l1_trigger_files", int, 2, 256,
+        "L1 -> L2 promotion file-count trigger",
+        getter=lambda: inst.engine.compaction.opts.l1_trigger_files,
+        setter=lambda v: inst.engine.compaction.set_trigger_files(v),
+    ))
+    # datanode merged-scan cache, present only on roles that own a
+    # region server (dist datanode; standalone has no Flight scan path)
+    rs = getattr(inst, "region_server", None)
+    if rs is not None and getattr(rs, "scan_cache", None) is not None:
+        reg.register(KnobSpec(
+            "dist_query.scan_cache_bytes", int, 0, 1 << 40,
+            "datanode merged-scan cache byte budget",
+            getter=lambda: inst.region_server.scan_cache.max_bytes,
+            setter=lambda v: inst.region_server.scan_cache.set_max_bytes(
+                v
+            ),
+            pool="scan_cache",
+        ))
+    return reg

@@ -1,0 +1,223 @@
+"""Sensor layer: read-only views over the existing telemetry surfaces.
+
+Sensors read ONLY in-memory state the observability plane already
+maintains — the statement-statistics registry (queue/latency
+percentiles per fingerprint), the admission controller's live
+queue/running counts, the memory accountant's per-pool ledger, the
+device-program profiler, and the compaction read-amplification /
+ingest-rate counters. No sensor touches storage, dispatches a program,
+or takes a lock the hot path contends on; every callable returns a
+plain dict (or None for "no signal this tick") that doubles as the
+decision's evidence payload.
+
+Rate-style sensors (cache hit deltas, ingest rows/s) are CLASSES
+holding the previous counter snapshot: the controllers stay pure
+functions of the current signal, which is what lets
+tests/test_autotune.py drive them with simulated sensors.
+"""
+
+from __future__ import annotations
+
+
+def _metric_total(name: str) -> float:
+    """Sum of every label child of a registered counter/gauge; 0.0
+    when the owning module never registered it in this process."""
+    from greptimedb_tpu.telemetry.metrics import global_registry
+
+    try:
+        metric = global_registry.get(name)
+    except KeyError:
+        return 0.0
+    return float(sum(c.value for _k, c in metric._snapshot()))
+
+
+# ----------------------------------------------------------------------
+# admission: cost-aware concurrency
+# ----------------------------------------------------------------------
+
+class AdmissionSensor:
+    """Live queue pressure + per-fingerprint statement cost.
+
+    The cost estimate comes from the stmt_stats registry: the
+    call-weighted mean latency is the 'service time' the controller
+    normalizes queue wait against, and the top fingerprints by total
+    time ride along as evidence."""
+
+    def __init__(self, inst):
+        self._inst = inst
+
+    def __call__(self) -> dict | None:
+        sched = self._inst.scheduler
+        if not getattr(sched.config, "enable", False):
+            return None
+        snap = sched.snapshot()
+        sig = {
+            "running": int(snap.get("running", 0)),
+            "queued": int(snap.get("queued", 0)),
+            "mean_cost_ms": None,
+            "queue_p99_ms": None,
+            "shed_total": 0,
+            "top": [],
+        }
+        from greptimedb_tpu.telemetry import stmt_stats
+
+        if stmt_stats.enabled():
+            calls = 0
+            cost = 0.0
+            qp99 = 0.0
+            shed = 0
+            rows = stmt_stats.global_stmt_stats.snapshot()
+            for doc in rows:
+                c = int(doc.get("calls") or 0)
+                calls += c
+                cost += float(doc.get("mean_ms") or 0.0) * c
+                qp99 = max(qp99, float(doc.get("queue_p99_ms") or 0.0))
+                shed += int(doc.get("shed_count") or 0)
+            if calls:
+                sig["mean_cost_ms"] = cost / calls
+                sig["queue_p99_ms"] = qp99
+                sig["shed_total"] = shed
+                top = sorted(
+                    rows,
+                    key=lambda d: (float(d.get("mean_ms") or 0.0)
+                                   * int(d.get("calls") or 0)),
+                    reverse=True,
+                )[:3]
+                sig["top"] = [
+                    {"fingerprint": d.get("fingerprint"),
+                     "calls": d.get("calls"),
+                     "mean_ms": round(float(d.get("mean_ms") or 0.0), 3),
+                     "queue_p99_ms": round(
+                         float(d.get("queue_p99_ms") or 0.0), 3)}
+                    for d in top
+                ]
+        return sig
+
+
+# ----------------------------------------------------------------------
+# planner: measured shard-vs-replicate scaling
+# ----------------------------------------------------------------------
+
+class PlannerSensor:
+    """Call-weighted latency of sharded vs replicated statements.
+
+    Coarse by design: it compares the measured mean latency of
+    fingerprints the planner sent down each path (stmt_stats
+    mesh_decision attribution), not a controlled A/B of one statement —
+    the hysteresis band absorbs the cross-statement noise, and the
+    sensor stays silent (None) without a multi-device mesh or enough
+    samples on BOTH paths."""
+
+    MIN_CALLS = 8
+
+    def __init__(self, inst):
+        self._inst = inst
+
+    def __call__(self) -> dict | None:
+        from greptimedb_tpu.parallel.mesh import global_mesh, shard_count
+
+        if shard_count(global_mesh()) <= 1:
+            return None
+        from greptimedb_tpu.telemetry import stmt_stats
+
+        if not stmt_stats.enabled():
+            return None
+        shard_ms = shard_calls = 0.0
+        repl_ms = repl_calls = 0.0
+        for doc in stmt_stats.global_stmt_stats.snapshot():
+            dec = str(doc.get("mesh_decision") or "")
+            c = int(doc.get("calls") or 0)
+            m = float(doc.get("mean_ms") or 0.0)
+            if dec.startswith("shard"):
+                shard_calls += c
+                shard_ms += m * c
+            elif dec.startswith("replicate"):
+                repl_calls += c
+                repl_ms += m * c
+        if shard_calls < self.MIN_CALLS or repl_calls < self.MIN_CALLS:
+            return None
+        return {
+            "shard_ms": shard_ms / shard_calls,
+            "replicate_ms": repl_ms / repl_calls,
+            "shard_calls": int(shard_calls),
+            "replicate_calls": int(repl_calls),
+        }
+
+
+# ----------------------------------------------------------------------
+# HBM: hit-rate-per-byte across the budgeted pools
+# ----------------------------------------------------------------------
+
+class HbmSensor:
+    """Per-tick hit/miss/eviction DELTAS for every pool whose byte
+    budget is a registered knob (KnobSpec.pool links them). Budgets
+    come from the knob registry (the accountant reports 0 for a
+    disabled pool, which would hide a resizable budget)."""
+
+    def __init__(self, knobs):
+        self._knobs = knobs
+        self._prev: dict[str, tuple] = {}
+
+    def __call__(self) -> list[dict] | None:
+        from greptimedb_tpu.telemetry import memory as _memory
+
+        by_pool = {}
+        for p in _memory.global_accountant.snapshot():
+            by_pool.setdefault(p.name, p)
+        out = []
+        for path in self._knobs.paths():
+            spec = self._knobs.spec(path)
+            if spec is None or not spec.pool:
+                continue
+            p = by_pool.get(spec.pool)
+            if p is None:
+                continue
+            prev = self._prev.get(spec.pool, (0, 0, 0))
+            cur = (int(p.hits), int(p.misses), int(p.evictions))
+            self._prev[spec.pool] = cur
+            out.append({
+                "knob": path, "pool": spec.pool,
+                "budget": int(self._knobs.get(path)),
+                "bytes": int(p.bytes),
+                "hits_d": max(0, cur[0] - prev[0]),
+                "misses_d": max(0, cur[1] - prev[1]),
+                "evictions_d": max(0, cur[2] - prev[2]),
+            })
+        return out or None
+
+
+# ----------------------------------------------------------------------
+# compaction: read-amplification vs ingest rate
+# ----------------------------------------------------------------------
+
+class CompactionSensor:
+    """Live read-amp over the engine's open regions + the ingest-row
+    rate since the previous tick (gtpu_ingest_rows_total delta over a
+    monotonic interval)."""
+
+    def __init__(self, inst):
+        self._inst = inst
+        self._prev_rows: float | None = None
+        self._prev_t: float | None = None
+
+    def __call__(self) -> dict | None:
+        import time as _time
+
+        from greptimedb_tpu.storage.compaction import read_amplification
+
+        engine = self._inst.engine
+        regions = engine.regions()
+        read_amp = max(
+            (read_amplification(r) for r in regions), default=0
+        )
+        rows = _metric_total("gtpu_ingest_rows_total")
+        now = _time.monotonic()
+        rps = 0.0
+        if self._prev_t is not None and now > self._prev_t:
+            rps = max(0.0, rows - self._prev_rows) / (now - self._prev_t)
+        self._prev_rows, self._prev_t = rows, now
+        return {
+            "read_amp": int(read_amp),
+            "ingest_rows_per_s": round(rps, 1),
+            "regions": len(regions),
+        }

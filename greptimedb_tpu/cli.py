@@ -446,6 +446,12 @@ def _make_instance(opts):
             opts.get("logging.slow_query.sample_ratio", 1.0)
         ),
     )
+    # [autotune] knobs: apply AFTER the scheduler/result-cache swaps
+    # above so the controllers tune the operator-configured objects;
+    # the knob registry reads through `inst` attributes, so the swapped
+    # instances are what set_config and the controllers see
+    inst.autotune.apply_options(opts.section("autotune"))
+    inst.autotune.start()
     return inst
 
 

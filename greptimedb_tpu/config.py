@@ -122,6 +122,25 @@ DEFAULTS: dict = {
         # qps/burst/concurrency/priority (lower priority runs first)
         "tenants": {},
     },
+    # adaptive control plane (autotune/): feedback controllers over the
+    # observability surfaces move the runtime-mutable knobs through the
+    # validated registry (ADMIN set_config rides the same path).
+    # Off by default — enabling it hands the listed knobs to the
+    # controllers; durability/correctness knobs are never registered.
+    "autotune": {
+        "enable": False,
+        "tick_interval_s": 5.0,      # control-loop cadence
+        "history": 256,              # decision audit-log ring size
+        # shared guardrails (controllers.py Guardrails)
+        "step": 0.25,                # max relative knob move per decision
+        "band": 0.15,                # hysteresis dead-band
+        "cooldown_ticks": 2,         # hold ticks after a decision
+        # per-controller enables
+        "admission": True,           # [scheduler] max_concurrency
+        "planner": True,             # [mesh] shard_min_series/rows
+        "hbm": True,                 # session/result/scan byte budgets
+        "compaction": True,          # [compaction] workers/trigger
+    },
     # multi-chip sharded query execution (parallel/mesh.py): one
     # process-wide mesh over the visible devices; large grids shard the
     # series axis across it and the shard_map reduction programs

@@ -162,6 +162,16 @@ class ScanCache:
             self._publish_locked()
 
     # ------------------------------------------------------------------
+    def set_max_bytes(self, v: int) -> None:
+        """Runtime budget update (autotune/knobs.py is the sanctioned
+        caller — GT021). A shrink trims LRU entries immediately."""
+        with self._lock:
+            self.max_bytes = int(v)
+            while self._bytes > self.max_bytes and self._entries:
+                k = next(iter(self._entries))
+                self._drop_locked(k, self._entries[k])
+            self._publish_locked()
+
     def purge_region(self, region_id: int) -> None:
         """Drop every entry whose region set contains `region_id`
         (close/drop/migrate/alter: version comparison may not cover

@@ -480,6 +480,49 @@ def _memory_pools_doc(inst) -> dict[str, list]:
     return rows
 
 
+def _autotune_decisions_doc(inst) -> dict[str, list]:
+    """The control plane's audit log (autotune/knobs.py change log):
+    one row per applied knob change — controller decisions AND
+    operator ADMIN set_config calls ride the same single write path,
+    so this table, gtpu_autotune_decisions_total and the knob-value
+    gauges can never disagree."""
+    rows = {"ts": [], "controller": [], "knob": [], "old_value": [],
+            "new_value": [], "evidence": []}
+    knobs = getattr(inst, "knobs", None)
+    if knobs is None:
+        return rows
+    for ch in knobs.changes():
+        doc = ch.to_doc()
+        rows["ts"].append(int(doc["ts_ms"]))
+        rows["controller"].append(doc["controller"])
+        rows["knob"].append(doc["knob"])
+        rows["old_value"].append(str(doc["old"]))
+        rows["new_value"].append(str(doc["new"]))
+        rows["evidence"].append(doc["evidence"])
+    return rows
+
+
+def _autotune_knobs_doc(inst) -> dict[str, list]:
+    """Every registered runtime-mutable knob with its live value and
+    declared bounds — what `ADMIN set_config` may touch."""
+    rows = {"knob": [], "value": [], "kind": [], "lower_bound": [],
+            "upper_bound": [], "pool": [], "doc": []}
+    knobs = getattr(inst, "knobs", None)
+    if knobs is None:
+        return rows
+    for d in knobs.snapshot():
+        rows["knob"].append(d["knob"])
+        rows["value"].append(str(d["value"]))
+        rows["kind"].append(d["kind"])
+        rows["lower_bound"].append(
+            -1 if d["lo"] is None else int(d["lo"]))
+        rows["upper_bound"].append(
+            -1 if d["hi"] is None else int(d["hi"]))
+        rows["pool"].append(d["pool"])
+        rows["doc"].append(d["doc"])
+    return rows
+
+
 # ----------------------------------------------------------------------
 # cluster-wide tables (dist/fleet.py): the per-node telemetry surfaces
 # above, fanned out to every peer over the bounded node_telemetry
@@ -529,6 +572,8 @@ _PROVIDERS = {
     "memory_pools": _memory_pools_doc,
     "statement_statistics": _statement_statistics_doc,
     "device_programs": _device_programs_doc,
+    "autotune_decisions": _autotune_decisions_doc,
+    "autotune_knobs": _autotune_knobs_doc,
     "cluster_node_stats": _cluster_node_stats_doc,
     "cluster_runtime_metrics": _make_cluster_table("runtime_metrics"),
     "cluster_statement_statistics": _make_cluster_table(

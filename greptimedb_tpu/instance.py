@@ -225,6 +225,13 @@ class Standalone:
         from greptimedb_tpu.telemetry.slow_query import SlowQueryLog
 
         self.slow_query_log = SlowQueryLog()
+        # adaptive control plane (autotune/): the knob registry backs
+        # ADMIN set_config + information_schema.autotune_* even when
+        # the controller loop is off; cli.py applies the [autotune]
+        # section and starts the tick thread when enabled
+        from greptimedb_tpu.autotune import build_runtime
+
+        self.knobs, self.autotune = build_runtime(self)
         if warm_start:
             # restore device grid snapshots in the background so the
             # first query after a restart skips the SST rescan
@@ -247,6 +254,9 @@ class Standalone:
             ).start()
 
     def close(self):
+        # stop the control loop FIRST: a tick racing teardown would
+        # read sensors over closing pools
+        self.autotune.close()
         if self.flows is not None:
             self.flows.stop()
         # fence the region server FIRST: a parked ingest stream must
@@ -592,6 +602,28 @@ class Standalone:
             ok = self._process_list.kill(str(target))
             return Output.records(_result_from_lists(
                 [f"ADMIN kill('{target}')"], [[1 if ok else 0]]
+            ))
+        if name == "set_config":
+            # the validated runtime-knob update API (autotune/knobs.py):
+            # typed bounds, change log, gtpu_autotune_knob_value —
+            # the same single write path the controllers use
+            path = const_str(0)
+            value = eval_const(arg(1))
+            old, new = self.knobs.set(path, value, source="admin")
+            return Output.records(_result_from_lists(
+                [f"ADMIN set_config('{path}')"], [[f"{old} -> {new}"]]
+            ))
+        if name == "autotune_freeze":
+            # hard freeze: controllers stop moving knobs until
+            # autotune_unfreeze(); set_config stays available
+            self.autotune.freeze(True)
+            return Output.records(_result_from_lists(
+                ["ADMIN autotune_freeze()"], [[1]]
+            ))
+        if name == "autotune_unfreeze":
+            self.autotune.freeze(False)
+            return Output.records(_result_from_lists(
+                ["ADMIN autotune_unfreeze()"], [[1]]
             ))
         if name == "reset_device_profiler":
             # drops every device-program registry row; the exported

@@ -187,6 +187,33 @@ def global_mesh_opts() -> MeshOptions | None:
         return _global_opts
 
 
+def update_shard_thresholds(*, base: MeshOptions | None = None,
+                            shard_min_series: int | None = None,
+                            shard_min_rows: int | None = None
+                            ) -> MeshOptions:
+    """Runtime update of the planner replicate/shard thresholds
+    (autotune/knobs.py is the sanctioned caller — GT021). MeshOptions
+    is frozen, so the process-wide object is SWAPPED, never mutated:
+    sites reading via global_mesh_opts() see the new thresholds on
+    their next plan; callers holding their own reference
+    (QueryEngine.mesh_opts) are re-pointed by the knob's apply hook."""
+    import dataclasses
+
+    global _global_opts
+    with _state_lock:
+        cur = base or _global_opts or MeshOptions()
+        kw = {}
+        if shard_min_series is not None:
+            kw["shard_min_series"] = int(shard_min_series)
+        if shard_min_rows is not None:
+            kw["shard_min_rows"] = int(shard_min_rows)
+        new = dataclasses.replace(cur, **kw)
+        # keep the no-engine-in-reach sites (global_mesh_opts readers)
+        # on the same thresholds as the engine-held reference
+        _global_opts = new
+        return new
+
+
 def reset_for_tests() -> None:
     """Drop the process-wide mesh so tests can reconfigure."""
     global _global_mesh, _global_opts, _configured

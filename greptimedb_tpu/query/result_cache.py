@@ -319,6 +319,16 @@ class ResultCache:
         _EVICTIONS.inc()
         self._evictions += 1
 
+    def set_max_bytes(self, v: int) -> None:
+        """Runtime budget update (autotune/knobs.py is the sanctioned
+        caller — GT021). A shrink trims LRU entries immediately."""
+        with self._lock:
+            self.max_bytes = int(v)
+            while self._bytes > self.max_bytes and self._entries:
+                k = next(iter(self._entries))
+                self._drop_locked(k, self._entries[k])
+            self._publish_locked()
+
     def _mem_stats(self) -> dict:
         with self._lock:
             return {

@@ -186,6 +186,17 @@ class SessionRegistry:
                 self._drop_locked(key)
         return freed
 
+    def set_max_bytes(self, v: int) -> None:
+        """Runtime budget update (autotune/knobs.py is the sanctioned
+        caller — GT021). A shrink trims LRU entries immediately so the
+        freed HBM is available to whichever pool the reallocation
+        controller is growing."""
+        with self._lock:
+            self.max_bytes = int(v)
+            while self._bytes > self.max_bytes and self._entries:
+                self._drop_locked(next(iter(self._entries)))
+            self._publish_locked()
+
     def _device_buffers(self):
         with self._lock:
             return [

@@ -330,6 +330,33 @@ class AdmissionController:
             "queue-time SLO; the instance is saturated"
         )
 
+    def set_max_concurrency(self, v: int) -> None:
+        """Runtime limit update (autotune/knobs.py is the sanctioned
+        caller — GT021). Raising the limit hands the new slots to the
+        best queued waiters immediately; _can_run_locked reads the
+        config live, so a lowered limit takes effect as running
+        statements release (running work is never preempted)."""
+        wakes: list[_Waiter] = []
+        with self._lock:
+            self.config.max_concurrency = int(v)
+            stash = []
+            while self._heap:
+                prio, seq, w = heapq.heappop(self._heap)
+                if w.abandoned:
+                    continue
+                if self._can_run_locked(w.tenant, w.limits):
+                    self._start_locked(w.tenant)
+                    w.admitted = True
+                    self._queued -= 1
+                    _QUEUE_DEPTH.set(self._queued)
+                    wakes.append(w)
+                    continue
+                stash.append((prio, seq, w))
+            for item in stash:
+                heapq.heappush(self._heap, item)
+        for w in wakes:
+            w.event.set()
+
     def _release(self, tenant: str):
         if not self.config.enable:
             return

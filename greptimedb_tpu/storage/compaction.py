@@ -591,6 +591,25 @@ class CompactionScheduler:
                 )
             return self._pool
 
+    def set_workers(self, n: int) -> None:
+        """Runtime pool-width update (autotune/knobs.py is the
+        sanctioned caller — GT021). Growth takes effect immediately
+        (the executor spawns threads up to _max_workers on demand);
+        a shrink applies lazily — already-started worker threads
+        finish their jobs and then idle, new submissions respect the
+        lower width at the next pool (re)build."""
+        with self._lock:
+            self.opts.workers = max(1, int(n))
+            if self._pool is not None:
+                self._pool._max_workers = self.opts.workers
+
+    def set_trigger_files(self, n: int) -> None:
+        """Runtime L1 -> L2 promotion trigger update (autotune/knobs.py
+        is the sanctioned caller — GT021). The picker reads opts live
+        on every probe, so the next maintenance tick uses it."""
+        with self._lock:
+            self.opts.l1_trigger_files = max(2, int(n))
+
     def close(self):
         with self._lock:
             self._closed = True

@@ -1192,3 +1192,67 @@ class UnboundedScrapePathIO(Rule):
                            "heartbeat hook without a timeout — "
                            "requests on it block forever against a "
                            "blackholed peer; pass timeout=")
+
+
+# runtime-mutable knob attributes GT021 guards (the standard knob set
+# autotune/knobs.build_registry registers). The sanctioned writers:
+# the autotune package (the registry's apply closures), the owning
+# object's own methods (root `self`/`cls` — set_max_bytes and friends
+# mutate their own field), and process-start config appliers
+# (configure/from_options/__init__). GT020 is reserved.
+_GT021_KNOB_ATTRS = {
+    "max_concurrency", "shard_min_series", "shard_min_rows",
+    "max_bytes", "workers", "l1_trigger_files", "l2_trigger_files",
+}
+_GT021_EXEMPT_FUNCS = {"__init__", "configure", "from_options",
+                       "reset_for_tests"}
+
+
+@register
+class DirectKnobWrite(Rule):
+    id = "GT021"
+    name = "direct-knob-write"
+    description = (
+        "Direct assignment to a registered runtime-mutable knob "
+        "attribute outside the owning object / the autotune package. "
+        "Every runtime knob change must ride KnobRegistry.set (the "
+        "autotune actuators and ADMIN set_config both do) so the "
+        "bounds are validated, the change lands in the "
+        "information_schema.autotune_decisions audit log, and the "
+        "control loop stays the SINGLE writer — a second ad-hoc "
+        "writer and a controller would silently fight over the knob."
+    )
+
+    def _flag(self, target: ast.expr, ctx: FileContext):
+        if not isinstance(target, ast.Attribute):
+            return
+        if target.attr not in _GT021_KNOB_ATTRS:
+            return
+        root = target.value
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name) and root.id in ("self", "cls"):
+            return  # the owning object mutating its own field
+        path = ctx.path.replace("\\", "/")
+        if "/autotune/" in path or path.startswith("autotune/"):
+            return  # the registry's apply closures ARE the write path
+        if any(fi.name in _GT021_EXEMPT_FUNCS
+               for fi in ctx.func_stack):
+            return  # process-start config applier
+        ctx.report(self, target,
+                   f"direct write to runtime-mutable knob attribute "
+                   f"`.{target.attr}`; route it through "
+                   f"KnobRegistry.set (ADMIN set_config / the "
+                   f"autotune actuators) so bounds are validated and "
+                   f"the change is audited")
+
+    def visit_Assign(self, node: ast.Assign, ctx: FileContext):
+        for t in node.targets:
+            if isinstance(t, ast.Tuple):
+                for e in t.elts:
+                    self._flag(e, ctx)
+            else:
+                self._flag(t, ctx)
+
+    def visit_AugAssign(self, node: ast.AugAssign, ctx: FileContext):
+        self._flag(node.target, ctx)

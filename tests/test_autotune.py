@@ -1,0 +1,652 @@
+"""gtune adaptive control plane (greptimedb_tpu/autotune/).
+
+Knob registry validation and the single-write-path audit log,
+controller convergence on simulated sensors (monotone approach, no
+oscillation past the hysteresis band), guardrail semantics (step
+clamp, cooldown spacing, freeze/disable), per-controller failure
+isolation, and cross-surface agreement: the same decisions at the
+same values on information_schema.autotune_decisions, ADMIN
+set_config, and the gtpu_autotune_* metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from greptimedb_tpu.autotune import (
+    AdmissionConcurrencyController,
+    AutotuneRuntime,
+    CompactionPacingController,
+    Guardrails,
+    HbmBudgetController,
+    KnobRegistry,
+    KnobSpec,
+    PlannerThresholdController,
+)
+from greptimedb_tpu.errors import InvalidArgumentError
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+
+def _metric_value(name: str, *labels: str) -> float:
+    """Current value of one labeled child (sum of all children when
+    no labels given); 0.0 when the metric never registered."""
+    try:
+        metric = global_registry.get(name)
+    except KeyError:
+        return 0.0
+    total = 0.0
+    for key, child in metric._snapshot():
+        if not labels or tuple(labels) == tuple(key):
+            total += child.value
+    return total
+
+
+def _knob(path: str, kind=int, lo=0.0, hi=float(1 << 40), init=0,
+          pool: str | None = None):
+    """A KnobSpec over a one-slot box — the simulated live object."""
+    box = {"v": kind(init)}
+    spec = KnobSpec(
+        path, kind, lo, hi, f"test knob {path}",
+        getter=lambda: box["v"],
+        setter=lambda nv: box.__setitem__("v", nv),
+        pool=pool,
+    )
+    return spec, box
+
+
+def _registry(*specs) -> KnobRegistry:
+    reg = KnobRegistry()
+    for s in specs:
+        reg.register(s)
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# knob registry: the single validated write path
+# ---------------------------------------------------------------------------
+
+def test_registry_set_applies_logs_and_publishes():
+    spec, box = _knob("scheduler.max_concurrency", init=4)
+    reg = _registry(spec)
+    before = _metric_value("gtpu_autotune_decisions_total", "admission")
+
+    old, new = reg.set("scheduler.max_concurrency", 8,
+                       source="admission", evidence={"queued": 3})
+    assert (old, new) == (4, 8)
+    assert box["v"] == 8 and reg.get("scheduler.max_concurrency") == 8
+    (ch,) = reg.changes()
+    assert (ch.controller, ch.knob, ch.old, ch.new) == (
+        "admission", "scheduler.max_concurrency", 4, 8)
+    assert ch.evidence == {"queued": 3}
+    assert json.loads(ch.to_doc()["evidence"]) == {"queued": 3}
+    assert _metric_value("gtpu_autotune_knob_value",
+                         "scheduler.max_concurrency") == 8.0
+    assert _metric_value("gtpu_autotune_decisions_total",
+                         "admission") == before + 1
+
+
+def test_registry_noop_write_is_not_logged():
+    spec, _ = _knob("k", init=8)
+    reg = _registry(spec)
+    assert reg.set("k", 8) == (8, 8)
+    assert reg.changes() == [] and reg.decision_count() == 0
+
+
+def test_registry_type_coercion():
+    ispec, ibox = _knob("i", kind=int, init=1)
+    fspec, fbox = _knob("f", kind=float, init=1.0)
+    bspec, bbox = _knob("b", kind=bool, lo=None, hi=None, init=False)
+    reg = _registry(ispec, fspec, bspec)
+    reg.set("i", "8")
+    assert ibox["v"] == 8 and isinstance(ibox["v"], int)
+    reg.set("i", 16.0)          # integral float is fine
+    assert ibox["v"] == 16
+    reg.set("f", "2.5")
+    assert fbox["v"] == 2.5
+    for truthy in (True, 1, "true", "1"):
+        reg.set("b", False)
+        reg.set("b", truthy)
+        assert bbox["v"] is True
+    reg.set("b", "false")
+    assert bbox["v"] is False
+
+
+def test_registry_rejects_bad_values():
+    ispec, ibox = _knob("i", kind=int, lo=1, hi=64, init=8)
+    bspec, _ = _knob("b", kind=bool, lo=None, hi=None, init=False)
+    reg = _registry(ispec, bspec)
+    with pytest.raises(InvalidArgumentError):
+        reg.set("no.such.knob", 1)
+    with pytest.raises(InvalidArgumentError):
+        reg.set("i", 8.5)            # fractional on an int knob
+    with pytest.raises(InvalidArgumentError):
+        reg.set("i", True)           # bool is not an int here
+    with pytest.raises(InvalidArgumentError):
+        reg.set("i", "not-a-number")
+    with pytest.raises(InvalidArgumentError):
+        reg.set("i", 0)              # below lo
+    with pytest.raises(InvalidArgumentError):
+        reg.set("i", 65)             # above hi
+    with pytest.raises(InvalidArgumentError):
+        reg.set("b", "maybe")
+    assert ibox["v"] == 8            # nothing applied
+    assert reg.changes() == []
+
+
+def test_registry_snapshot_surface():
+    spec, _ = _knob("result_cache.bytes", init=1024, pool="result_cache")
+    reg = _registry(spec)
+    (row,) = reg.snapshot()
+    assert row["knob"] == "result_cache.bytes"
+    assert row["value"] == 1024 and row["kind"] == "int"
+    assert row["pool"] == "result_cache"
+
+
+# ---------------------------------------------------------------------------
+# admission controller on a simulated sensor
+# ---------------------------------------------------------------------------
+
+def _admission(init_limit, sense, **rails):
+    spec, box = _knob("scheduler.max_concurrency", lo=0, hi=65536,
+                      init=init_limit)
+    reg = _registry(spec)
+    c = AdmissionConcurrencyController(
+        reg, sense, rails=Guardrails(**rails) if rails else None)
+    return c, reg, box
+
+
+def test_admission_converges_up_without_oscillation():
+    """Queue pressure until the limit covers demand (8 slots), then
+    the signal goes quiet: the limit must ramp monotonically, settle,
+    and never oscillate past the hysteresis band."""
+    def sense():
+        limit = box["v"]
+        if limit < 8:
+            return {"running": limit, "queued": 8 - limit,
+                    "mean_cost_ms": 10.0, "queue_p99_ms": 50.0}
+        return {"running": 8, "queued": 0,
+                "mean_cost_ms": 10.0, "queue_p99_ms": 0.5}
+
+    c, reg, box = _admission(2, sense, cooldown_ticks=1)
+    trajectory = [box["v"]]
+    for _ in range(40):
+        c.tick()
+        trajectory.append(box["v"])
+    # monotone ramp: never a downward move during or after convergence
+    assert all(b >= a for a, b in zip(trajectory, trajectory[1:]))
+    final = trajectory[-1]
+    assert final >= 8
+    # settled: the last ticks produced no movement at all
+    assert trajectory[-5:] == [final] * 5
+    # every applied step respected the relative clamp
+    for ch in reg.changes():
+        assert ch.new <= int(round(ch.old * (1 + c.rails.step))) + 1
+
+
+def test_admission_idle_scale_down_is_step_clamped():
+    c, reg, box = _admission(
+        100, lambda: {"running": 2, "queued": 0,
+                      "mean_cost_ms": 5.0, "queue_p99_ms": 0.0},
+        cooldown_ticks=1)
+    c.tick()
+    # target is running+1 = 3, but one decision may shrink at most 25%
+    assert box["v"] == 75
+    c.tick()
+    assert box["v"] == 56  # int(round(75 * 0.75))
+
+
+def test_admission_never_enables_limiting_on_unlimited():
+    c, reg, box = _admission(
+        0, lambda: {"running": 50, "queued": 500,
+                    "mean_cost_ms": 10.0, "queue_p99_ms": 900.0})
+    assert c.tick() == 0 and box["v"] == 0 and reg.changes() == []
+
+
+def test_admission_cheap_queue_wait_is_not_pressure():
+    # statements queue briefly but wait far less than one service
+    # time: adding slots would not help; hold
+    c, reg, box = _admission(
+        4, lambda: {"running": 4, "queued": 1,
+                    "mean_cost_ms": 100.0, "queue_p99_ms": 2.0})
+    assert c.tick() == 0 and box["v"] == 4
+
+
+def test_cooldown_spaces_decisions():
+    c, reg, box = _admission(
+        2, lambda: {"running": 2, "queued": 9,
+                    "mean_cost_ms": 10.0, "queue_p99_ms": 80.0},
+        cooldown_ticks=3)
+    change_ticks = []
+    for t in range(1, 13):
+        if c.tick():
+            change_ticks.append(t)
+    assert change_ticks  # pressure did move the knob
+    gaps = [b - a for a, b in zip(change_ticks, change_ticks[1:])]
+    assert gaps and all(g >= 3 for g in gaps)
+
+
+def test_disabled_controller_never_reads_its_sensor():
+    calls = []
+
+    def sense():
+        calls.append(1)
+        return {"running": 0, "queued": 9, "mean_cost_ms": 1.0,
+                "queue_p99_ms": 50.0}
+
+    c, reg, box = _admission(2, sense)
+    c.enabled = False
+    assert all(c.tick() == 0 for _ in range(5))
+    assert calls == [] and box["v"] == 2
+
+
+# ---------------------------------------------------------------------------
+# planner controller
+# ---------------------------------------------------------------------------
+
+def _planner(init_series, init_rows, sense, **rails):
+    s1, b1 = _knob("mesh.shard_min_series", lo=1, hi=1 << 24,
+                   init=init_series)
+    s2, b2 = _knob("mesh.shard_min_rows", lo=1, hi=1 << 30,
+                   init=init_rows)
+    reg = _registry(s1, s2)
+    c = PlannerThresholdController(
+        reg, sense, rails=Guardrails(**rails) if rails else None)
+    return c, reg, b1, b2
+
+
+def test_planner_moves_both_thresholds_together():
+    c, reg, b1, b2 = _planner(
+        4096, 1 << 16,
+        lambda: {"shard_ms": 10.0, "replicate_ms": 20.0})  # shard wins
+    assert c.tick() == 2
+    assert b1["v"] == int(round(4096 * 0.75))
+    assert b2["v"] == int(round((1 << 16) * 0.75))
+    # replicate wins -> thresholds go back up
+    c2, reg2, r1, r2 = _planner(
+        4096, 1 << 16,
+        lambda: {"shard_ms": 20.0, "replicate_ms": 10.0})
+    assert c2.tick() == 2
+    assert r1["v"] == int(round(4096 * 1.25))
+
+
+def test_planner_holds_inside_hysteresis_band():
+    c, reg, b1, b2 = _planner(
+        4096, 1 << 16,
+        lambda: {"shard_ms": 10.0, "replicate_ms": 11.0})  # 10% apart
+    assert c.tick() == 0 and b1["v"] == 4096 and reg.changes() == []
+
+
+def test_planner_converges_to_break_even_threshold():
+    """Simulated system whose shard speedup is proportional to the
+    threshold (break-even at 1024): the controller must walk the
+    threshold down into the hysteresis band around 1024 and stop."""
+    OPT = 1024
+
+    def sense():
+        return {"shard_ms": 10.0,
+                "replicate_ms": 10.0 * (b1["v"] / OPT)}
+
+    c, reg, b1, b2 = _planner(8192, 8192 * 64, sense,
+                              cooldown_ticks=1)
+    trajectory = [b1["v"]]
+    for _ in range(60):
+        c.tick()
+        trajectory.append(b1["v"])
+    assert all(b <= a for a, b in zip(trajectory, trajectory[1:]))
+    final = trajectory[-1]
+    assert OPT * (1 - c.rails.band) <= final <= OPT * (1 + c.rails.band)
+    assert trajectory[-5:] == [final] * 5  # no oscillation at the end
+
+
+# ---------------------------------------------------------------------------
+# HBM budget controller
+# ---------------------------------------------------------------------------
+
+def _hbm_pools(sessions_bytes, result_bytes):
+    s1, b1 = _knob("sessions.hbm_bytes", lo=0, init=sessions_bytes,
+                   pool="sessions")
+    s2, b2 = _knob("result_cache.bytes", lo=0, init=result_bytes,
+                   pool="result_cache")
+    reg = _registry(s1, s2)
+    return reg, b1, b2
+
+
+def _pool_sig(reg, knob, pool, *, misses_d, evictions_d, hits_d=0):
+    return {"knob": knob, "pool": pool, "budget": int(reg.get(knob)),
+            "bytes": int(reg.get(knob)), "hits_d": hits_d,
+            "misses_d": misses_d, "evictions_d": evictions_d}
+
+
+def test_hbm_moves_budget_toward_miss_pressure_conserving_total():
+    reg, sess, res = _hbm_pools(8 << 20, 1 << 20)
+
+    def sense():
+        return [
+            _pool_sig(reg, "sessions.hbm_bytes", "sessions",
+                      misses_d=0, evictions_d=0, hits_d=100),
+            _pool_sig(reg, "result_cache.bytes", "result_cache",
+                      misses_d=500, evictions_d=50),
+        ]
+
+    c = HbmBudgetController(reg, sense, rails=Guardrails())
+    total = sess["v"] + res["v"]
+    assert c.tick() == 2
+    assert sess["v"] + res["v"] == total      # bytes conserved exactly
+    assert res["v"] > (1 << 20) and sess["v"] < (8 << 20)
+    moved = res["v"] - (1 << 20)
+    assert moved >= HbmBudgetController.MIN_TRANSFER
+    # step-clamped against the smaller budget
+    assert moved <= max(HbmBudgetController.MIN_TRANSFER,
+                        int((1 << 20) * c.rails.step))
+    assert {ch.controller for ch in reg.changes()} == {"hbm"}
+
+
+def test_hbm_holds_without_evictions_or_contrast():
+    reg, sess, res = _hbm_pools(4 << 20, 4 << 20)
+    # misses but no evictions: pool is not budget-starved
+    c = HbmBudgetController(reg, lambda: [
+        _pool_sig(reg, "sessions.hbm_bytes", "sessions",
+                  misses_d=0, evictions_d=0),
+        _pool_sig(reg, "result_cache.bytes", "result_cache",
+                  misses_d=100, evictions_d=0),
+    ])
+    assert c.tick() == 0
+    # both pools equally warm: not enough contrast to act on
+    c2 = HbmBudgetController(reg, lambda: [
+        _pool_sig(reg, "sessions.hbm_bytes", "sessions",
+                  misses_d=100, evictions_d=10),
+        _pool_sig(reg, "result_cache.bytes", "result_cache",
+                  misses_d=100, evictions_d=10),
+    ])
+    assert c2.tick() == 0
+    assert sess["v"] == 4 << 20 and res["v"] == 4 << 20
+
+
+def test_hbm_repeated_ticks_drain_donor_only_to_its_floor():
+    reg, sess, res = _hbm_pools(1 << 20, 1 << 20)
+
+    def sense():
+        return [
+            _pool_sig(reg, "sessions.hbm_bytes", "sessions",
+                      misses_d=0, evictions_d=0),
+            _pool_sig(reg, "result_cache.bytes", "result_cache",
+                      misses_d=500, evictions_d=50),
+        ]
+
+    c = HbmBudgetController(reg, sense,
+                            rails=Guardrails(cooldown_ticks=1))
+    total = sess["v"] + res["v"]
+    for _ in range(200):
+        c.tick()
+    assert sess["v"] + res["v"] == total
+    assert sess["v"] >= 0                     # never below the bound
+    assert res["v"] <= total
+
+
+# ---------------------------------------------------------------------------
+# compaction pacing controller
+# ---------------------------------------------------------------------------
+
+def _compaction(workers, trigger, sense, baseline=1, **rails):
+    s1, b1 = _knob("compaction.workers", lo=1, hi=64, init=workers)
+    s2, b2 = _knob("compaction.l1_trigger_files", lo=2, hi=256,
+                   init=trigger)
+    reg = _registry(s1, s2)
+    c = CompactionPacingController(
+        reg, sense, baseline_workers=baseline,
+        rails=Guardrails(**rails) if rails else None)
+    return c, reg, b1, b2
+
+
+def test_compaction_tightens_trigger_under_read_amp():
+    c, reg, workers, trigger = _compaction(
+        1, 8, lambda: {"read_amp": 20, "ingest_rows_per_s": 100.0})
+    assert c.tick() == 1
+    assert trigger["v"] == 6 and workers["v"] == 1
+
+
+def test_compaction_widens_pool_when_trigger_at_floor():
+    c, reg, workers, trigger = _compaction(
+        1, 2, lambda: {"read_amp": 20, "ingest_rows_per_s": 100.0})
+    assert c.tick() == 1
+    assert trigger["v"] == 2 and workers["v"] == 2
+
+
+def test_compaction_gives_width_back_when_merges_catch_up():
+    c, reg, workers, trigger = _compaction(
+        4, 8, lambda: {"read_amp": 1, "ingest_rows_per_s": 0.0},
+        baseline=2, cooldown_ticks=1)
+    for _ in range(10):
+        c.tick()
+    assert workers["v"] == 2   # back to baseline, never below it
+
+
+# ---------------------------------------------------------------------------
+# runtime: freeze / disable / isolation / lifecycle
+# ---------------------------------------------------------------------------
+
+class _Recorder:
+    """Controller stub: counts ticks, applies one change per tick."""
+
+    name = "recorder"
+
+    def __init__(self, reg, knob):
+        self.reg, self.knob = reg, knob
+        self.enabled = True
+        self.rails = Guardrails()
+        self.ticks = 0
+
+    def tick(self):
+        self.ticks += 1
+        cur = self.reg.get(self.knob)
+        self.reg.set(self.knob, cur + 1, source=self.name,
+                     evidence={"tick": self.ticks})
+        return 1
+
+
+class _Raiser:
+    name = "raiser"
+    enabled = True
+    rails = Guardrails()
+    raised = 0
+
+    def tick(self):
+        self.raised += 1
+        raise RuntimeError("sensor backend went away")
+
+
+def test_runtime_disabled_is_bit_for_bit_noop():
+    spec, box = _knob("k", init=5)
+    reg = _registry(spec)
+    rec = _Recorder(reg, "k")
+    rt = AutotuneRuntime(reg, [rec], enabled=False)
+    ticks_before = _metric_value("gtpu_autotune_ticks_total")
+    assert all(rt.tick_once() == 0 for _ in range(5))
+    assert rec.ticks == 0 and box["v"] == 5 and reg.changes() == []
+    assert _metric_value("gtpu_autotune_ticks_total") == ticks_before
+
+
+def test_runtime_frozen_ticks_but_never_moves():
+    spec, box = _knob("k", init=5)
+    reg = _registry(spec)
+    rec = _Recorder(reg, "k")
+    rt = AutotuneRuntime(reg, [rec], enabled=True)
+    rt.freeze(True)
+    ticks_before = _metric_value("gtpu_autotune_ticks_total")
+    assert rt.tick_once() == 0
+    assert _metric_value("gtpu_autotune_frozen") == 1.0
+    assert _metric_value("gtpu_autotune_ticks_total") == ticks_before + 1
+    assert rec.ticks == 0 and box["v"] == 5
+    rt.freeze(False)
+    assert _metric_value("gtpu_autotune_frozen") == 0.0
+    assert rt.tick_once() == 1 and box["v"] == 6
+
+
+def test_runtime_isolates_a_raising_controller():
+    spec, box = _knob("k", init=5)
+    reg = _registry(spec)
+    bad, good = _Raiser(), _Recorder(reg, "k")
+    rt = AutotuneRuntime(reg, [bad, good], enabled=True)
+    errs_before = _metric_value(
+        "gtpu_autotune_controller_errors_total", "raiser")
+    assert rt.tick_once() == 1          # the good controller still ran
+    assert box["v"] == 6 and bad.raised == 1
+    assert _metric_value("gtpu_autotune_controller_errors_total",
+                         "raiser") == errs_before + 1
+    assert rt.tick_once() == 1          # and the loop survives
+
+
+def test_runtime_apply_options():
+    spec, _ = _knob("k", init=5)
+    reg = _registry(spec)
+    a, b = _Recorder(reg, "k"), _Recorder(reg, "k")
+    a.name, b.name = "admission", "planner"
+    rt = AutotuneRuntime(reg, [a, b])
+    rt.apply_options({
+        "enable": True, "tick_interval_s": 0.25, "planner": False,
+        "step": 0.5, "band": 0.05, "cooldown_ticks": 7,
+    })
+    assert rt.enabled and rt.interval_s == 0.25
+    assert a.enabled and not b.enabled
+    assert a.rails.step == 0.5 and a.rails.band == 0.05
+    assert a.rails.cooldown_ticks == 7
+
+
+def test_runtime_thread_lifecycle():
+    spec, box = _knob("k", init=0)
+    reg = _registry(spec)
+    rec = _Recorder(reg, "k")
+    rt = AutotuneRuntime(reg, [rec], interval_s=0.01, enabled=True)
+    rt.start()
+    deadline = time.monotonic() + 5.0
+    while rec.ticks < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    rt.close()
+    assert rec.ticks >= 3
+    ticks_at_close = rec.ticks
+    time.sleep(0.05)
+    assert rec.ticks == ticks_at_close  # loop actually stopped
+    rt.close()                           # idempotent
+
+
+# ---------------------------------------------------------------------------
+# Standalone integration: ADMIN + information_schema + metrics agree
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def inst(tmp_path):
+    inst = Standalone(str(tmp_path), prefer_device=False,
+                      warm_start=False)
+    yield inst
+    inst.close()
+
+
+def test_standalone_registers_standard_knob_set(inst):
+    assert set(inst.knobs.paths()) >= {
+        "scheduler.max_concurrency",
+        "mesh.shard_min_series", "mesh.shard_min_rows",
+        "sessions.hbm_bytes", "result_cache.bytes",
+        "compaction.workers", "compaction.l1_trigger_files",
+    }
+
+
+def test_admin_set_config_round_trip(inst):
+    old = inst.knobs.get("scheduler.max_concurrency")
+    r = inst.sql("ADMIN set_config('scheduler.max_concurrency', 12)")
+    assert r.cols[0].values[0] == f"{old} -> 12"
+    assert inst.knobs.get("scheduler.max_concurrency") == 12
+    assert inst.scheduler.config.max_concurrency == 12
+    (ch,) = inst.knobs.changes()
+    assert ch.controller == "admin" and ch.new == 12
+
+
+def test_admin_set_config_typed_errors(inst):
+    with pytest.raises(InvalidArgumentError):
+        inst.sql("ADMIN set_config('no.such.knob', 1)")
+    with pytest.raises(InvalidArgumentError):
+        inst.sql("ADMIN set_config('compaction.workers', 10000)")
+    with pytest.raises(InvalidArgumentError):
+        inst.sql("ADMIN set_config('compaction.workers', 'lots')")
+    assert inst.knobs.changes() == []
+
+
+def test_admin_freeze_unfreeze(inst):
+    assert inst.sql("ADMIN autotune_freeze()").cols[0].values[0] == 1
+    assert inst.autotune.frozen
+    assert _metric_value("gtpu_autotune_frozen") == 1.0
+    assert inst.sql("ADMIN autotune_unfreeze()").cols[0].values[0] == 1
+    assert not inst.autotune.frozen
+    assert _metric_value("gtpu_autotune_frozen") == 0.0
+
+
+def test_information_schema_autotune_knobs(inst):
+    r = inst.sql("select knob, kind, lower_bound, upper_bound, pool "
+                 "from information_schema.autotune_knobs")
+    rows = {row[0]: row for row in r.rows()}
+    assert "result_cache.bytes" in rows
+    knob, kind, lo, hi, pool = rows["result_cache.bytes"]
+    assert kind == "int" and lo == 0 and pool == "result_cache"
+
+
+def test_decisions_agree_across_every_surface(inst):
+    """The audit invariant: after a mix of ADMIN and controller
+    writes, information_schema.autotune_decisions, the registry
+    change log, gtpu_autotune_decisions_total and the knob-value
+    gauges all tell the same story."""
+    dec_before = _metric_value("gtpu_autotune_decisions_total")
+    inst.sql("ADMIN set_config('compaction.workers', 3)")
+    inst.sql("ADMIN set_config('result_cache.bytes', 123456)")
+    # a controller write through the same path
+    inst.knobs.set("compaction.l1_trigger_files", 6,
+                   source="compaction", evidence={"read_amp": 20})
+
+    changes = inst.knobs.changes()
+    assert len(changes) == 3
+    assert inst.knobs.decision_count() == 3
+    assert _metric_value("gtpu_autotune_decisions_total") \
+        == dec_before + 3
+
+    r = inst.sql("select controller, knob, old_value, new_value, "
+                 "evidence from information_schema.autotune_decisions")
+    rows = list(r.rows())
+    assert len(rows) == 3
+    for ch, row in zip(changes, rows):
+        assert row[0] == ch.controller and row[1] == ch.knob
+        assert row[2] == str(ch.old) and row[3] == str(ch.new)
+        assert json.loads(row[4]) == ch.evidence
+    # evidence of the controller write survived the JSON round trip
+    assert json.loads(rows[2][4]) == {"read_amp": 20}
+    # the knob gauges agree with the live values
+    for knob in ("compaction.workers", "result_cache.bytes",
+                 "compaction.l1_trigger_files"):
+        assert _metric_value("gtpu_autotune_knob_value", knob) \
+            == float(inst.knobs.get(knob))
+
+
+def test_standalone_disabled_runtime_is_noop(inst):
+    """Default config ships the control plane disabled: a tick must
+    not move any knob, log any decision, or read any sensor."""
+    assert not inst.autotune.enabled
+    before = {p: inst.knobs.get(p) for p in inst.knobs.paths()}
+    ticks_before = _metric_value("gtpu_autotune_ticks_total")
+    assert inst.autotune.tick_once() == 0
+    assert {p: inst.knobs.get(p) for p in inst.knobs.paths()} == before
+    assert inst.knobs.changes() == []
+    assert _metric_value("gtpu_autotune_ticks_total") == ticks_before
+
+
+def test_standalone_enabled_tick_survives_and_audits(inst):
+    """Flip the runtime on against the REAL sensors: the tick must
+    complete (no sensor raises against a live instance) and any
+    decision it makes must land in the audit log."""
+    inst.autotune.apply_options({"enable": True})
+    n = inst.autotune.tick_once()
+    assert n == inst.knobs.decision_count()
+    for doc in inst.autotune.decisions():
+        assert doc["controller"] in ("admission", "planner", "hbm",
+                                     "compaction")
+        assert json.loads(doc["evidence"]) is not None

@@ -35,7 +35,8 @@ def rules_hit(src: str, select: str | None = None):
 
 def test_registry_has_all_rules():
     ids = sorted(all_rules())
-    assert ids == [f"GT{n:03d}" for n in range(1, 20)]
+    # GT020 is unassigned/reserved; the registry jumps to GT021.
+    assert ids == [f"GT{n:03d}" for n in range(1, 20)] + ["GT021"]
     for rule in all_rules().values():
         assert rule.name and rule.description
 
@@ -1691,6 +1692,64 @@ def test_changed_run_does_not_report_foreign_stale(tmp_path):
     res = lint_paths([str(target)], baseline=base)
     assert len(res["stale_baseline"]) == 1
     assert not res["clean"]
+
+
+# ---------------------------------------------------------------------------
+# GT021 direct runtime-knob write
+# ---------------------------------------------------------------------------
+
+def test_gt021_positive_direct_and_augmented_write():
+    hits = rules_hit("""
+        def detune(inst, opts):
+            inst.scheduler.config.max_concurrency = 4
+            opts.l1_trigger_files += 2
+            a, inst.compaction.opts.workers = 1, 8
+    """, select="GT021")
+    assert hits == [("GT021", 3), ("GT021", 4), ("GT021", 5)]
+
+
+def test_gt021_positive_module_scope_write():
+    hits = rules_hit("""
+        import somewhere
+        somewhere.cache.max_bytes = 1 << 20
+    """, select="GT021")
+    assert hits == [("GT021", 3)]
+
+
+def test_gt021_negative_registry_self_and_config_appliers():
+    hits = rules_hit("""
+        class Cache:
+            def __init__(self, n):
+                self.max_bytes = n          # owning object
+
+            def set_max_bytes(self, v):
+                self.max_bytes = int(v)     # owning object
+
+        def configure(inst, opts):
+            inst.cache.max_bytes = opts.n   # process-start applier
+
+        def from_options(o):
+            o.scheduler.max_concurrency = 8
+
+        def actuate(registry):
+            registry.set("result_cache.bytes", 1 << 20)  # sanctioned
+            max_bytes = 7                   # plain Name, not an attr
+    """, select="GT021")
+    assert hits == []
+
+
+def test_gt021_negative_autotune_package_path():
+    src = textwrap.dedent("""
+        def apply(inst, v):
+            inst.cache.max_bytes = int(v)
+    """)
+    act, _ = lint_source(
+        "greptimedb_tpu/autotune/knobs.py", src, select={"GT021"})
+    assert act == []
+    # same source outside the package IS flagged
+    act, _ = lint_source("greptimedb_tpu/other.py", src,
+                         select={"GT021"})
+    assert [f.rule for f in act] == ["GT021"]
 
 
 if __name__ == "__main__":
