@@ -1463,6 +1463,69 @@ MC_SQL = (
 )
 
 
+def _mc_force_devices():
+    """8 virtual CPU devices, pinned before the jax backend initializes
+    (shared by the multichip probes)."""
+    import os
+
+    flag = "--xla_force_host_platform_device_count=8"
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            f"{os.environ.get('XLA_FLAGS', '')} {flag}".strip()
+        )
+    import jax
+
+    if len(jax.devices()) < 8:
+        # site hooks may pin a real 1-chip platform; fall back to the
+        # virtual CPU devices like dryrun_multichip does
+        from jax.extend.backend import clear_backends
+
+        jax.config.update("jax_platforms", "cpu")
+        clear_backends()
+    devices = jax.devices()[:8]
+    assert len(devices) == 8, f"need 8 devices, have {len(devices)}"
+    return devices
+
+
+def _mc_ingest_cpu(inst):
+    """The flagship double-groupby dataset: MC_HOSTS series x MC_CELLS
+    cells (~1M rows), chunked ingest."""
+    inst.execute_sql(
+        "create table cpu (ts timestamp time index, host string "
+        "primary key, u double, v double)"
+    )
+    table = inst.catalog.table("public", "cpu")
+    rng = np.random.default_rng(7)
+    ts_block = (np.arange(MC_CELLS) * 10_000 + 1_700_000_000_000)
+    chunk = 512
+    for h0 in range(0, MC_HOSTS, chunk):
+        n = min(chunk, MC_HOSTS - h0)
+        hosts = np.repeat(
+            [f"h{h0 + i:05d}" for i in range(n)], MC_CELLS
+        ).astype(object)
+        ts = np.tile(ts_block, n).astype(np.int64)
+        table.write({"host": hosts}, ts, {
+            "u": rng.random(n * MC_CELLS) * 100,
+            "v": rng.random(n * MC_CELLS),
+        })
+    return ts_block, rng
+
+
+def _mc_cols_identical(ref, res, tag: str):
+    """Bit-identical table parity (NaN == NaN) — the sharding and the
+    kernel-variant contract alike."""
+    assert res.num_rows == ref.num_rows, (
+        f"{tag}: {res.num_rows} rows vs {ref.num_rows}"
+    )
+    for i, name in enumerate(res.names):
+        a = np.asarray(ref.cols[i].values)
+        b = np.asarray(res.cols[i].values)
+        assert ((a == b) | (a != a) & (b != b)).all(), (
+            f"{tag}: column {name} differs"
+        )
+
+
 def multichip_probe(base_dir: str | None = None):
     """Partial-build + steady query latency of the flagship double-groupby
     RANGE query at mesh sizes 1/2/4/8 over the SAME dataset, on a forced
@@ -1483,24 +1546,7 @@ def multichip_probe(base_dir: str | None = None):
     import tempfile as _tempfile
 
     _assert_sanitizer_off()
-    # 8 virtual CPU devices, pinned before the jax backend initializes
-    flag = "--xla_force_host_platform_device_count=8"
-    if "--xla_force_host_platform_device_count" not in os.environ.get(
-            "XLA_FLAGS", ""):
-        os.environ["XLA_FLAGS"] = (
-            f"{os.environ.get('XLA_FLAGS', '')} {flag}".strip()
-        )
-    import jax
-
-    if len(jax.devices()) < 8:
-        # site hooks may pin a real 1-chip platform; fall back to the
-        # virtual CPU devices like dryrun_multichip does
-        from jax.extend.backend import clear_backends
-
-        jax.config.update("jax_platforms", "cpu")
-        clear_backends()
-    devices = jax.devices()[:8]
-    assert len(devices) == 8, f"need 8 devices, have {len(devices)}"
+    devices = _mc_force_devices()
 
     from greptimedb_tpu.instance import Standalone
     from greptimedb_tpu.parallel import mesh as M
@@ -1513,25 +1559,7 @@ def multichip_probe(base_dir: str | None = None):
     inst = Standalone(os.path.join(tmp, "data"), prefer_device=True,
                       warm_start=False)
     try:
-        inst.execute_sql(
-            "create table cpu (ts timestamp time index, host string "
-            "primary key, u double, v double)"
-        )
-        table = inst.catalog.table("public", "cpu")
-        rng = np.random.default_rng(7)
-        ts_block = (np.arange(MC_CELLS) * 10_000 + 1_700_000_000_000)
-        # chunked ingest: MC_HOSTS series x MC_CELLS cells (~1M rows)
-        chunk = 512
-        for h0 in range(0, MC_HOSTS, chunk):
-            n = min(chunk, MC_HOSTS - h0)
-            hosts = np.repeat(
-                [f"h{h0 + i:05d}" for i in range(n)], MC_CELLS
-            ).astype(object)
-            ts = np.tile(ts_block, n).astype(np.int64)
-            table.write({"host": hosts}, ts, {
-                "u": rng.random(n * MC_CELLS) * 100,
-                "v": rng.random(n * MC_CELLS),
-            })
+        ts_block, rng = _mc_ingest_cpu(inst)
         stmt = parse_sql(MC_SQL)[0]
         plan, ptable = inst.plan(stmt, QueryContext())
 
@@ -1574,16 +1602,10 @@ def multichip_probe(base_dir: str | None = None):
                 base_per_chip = per_chip
             else:
                 # bit-identical parity is the sharding contract
-                assert res.num_rows == ref_result.num_rows
-                for i, name in enumerate(res.names):
-                    a = np.asarray(ref_result.cols[i].values)
-                    b = np.asarray(res.cols[i].values)
-                    assert (
-                        (a == b) | (a != a) & (b != b)
-                    ).all(), (
-                        f"mesh={n_dev}: column {name} differs from "
-                        "the single-device result"
-                    )
+                _mc_cols_identical(
+                    ref_result, res,
+                    f"mesh={n_dev} vs the single-device result",
+                )
             per_mesh[str(n_dev)] = {
                 "build_ms": round(build_ms, 1),
                 "query_ms": round(query_ms, 1),
@@ -1662,6 +1684,347 @@ def multichip_probe(base_dir: str | None = None):
                 "v": per_mesh["8"]["series_per_chip"]},
         }}, separators=(",", ":")))
     finally:
+        inst.close()
+        if own_tmp:
+            _shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# multichip kernels phase: Pallas kernel paths vs the XLA collective paths
+# ---------------------------------------------------------------------------
+
+KP_SERIES = 1_000_000   # north-star topk cardinality (BASELINE.md)
+KP_SAMPLES = 4          # 1M series x 4 samples at 30s (~4M rows)
+KP_INTERVAL = 30_000
+KP_K = 100              # <= [mesh] pallas_max_k (128)
+KP_RUNS = 3             # steady-state samples per config (min reported)
+KP_SHARE_MIN = 0.99     # kernel-path decision share gate on ON legs
+
+
+def _kp_kernel_counters() -> tuple[float, float]:
+    """(pallas, xla) decision totals across every `<kind>_kernel` site
+    of gtpu_mesh_queries_total, from the registry text."""
+    from greptimedb_tpu.telemetry.metrics import global_registry
+
+    pallas = xla = 0.0
+    for ln in global_registry.render().splitlines():
+        if not ln.startswith("gtpu_mesh_queries_total{"):
+            continue
+        if '_kernel"' not in ln:
+            continue
+        val = float(ln.rsplit(" ", 1)[1])
+        if 'mode="pallas"' in ln:
+            pallas += val
+        elif 'mode="xla"' in ln:
+            xla += val
+    return pallas, xla
+
+
+def _kp_comm_bytes() -> float:
+    """Total declared collective traffic across device programs."""
+    from greptimedb_tpu.telemetry.metrics import global_registry
+
+    total = 0.0
+    for ln in global_registry.render().splitlines():
+        if ln.startswith("gtpu_device_program_comm_bytes_total{"):
+            total += float(ln.rsplit(" ", 1)[1])
+    return total
+
+
+def _kp_prom_identical(ref, res, tag: str):
+    l1 = [frozenset(lb.items()) for lb in ref.labels]
+    l2 = [frozenset(lb.items()) for lb in res.labels]
+    assert l1 == l2, f"{tag}: labels differ"
+    assert (ref.present == res.present).all(), f"{tag}: presence differs"
+    a = np.where(ref.present, ref.values, 0.0)
+    b = np.where(res.present, res.values, 0.0)
+    assert np.array_equal(a, b, equal_nan=True), (
+        f"{tag}: values not bit-identical"
+    )
+
+
+def multichip_kernels_probe(base_dir: str | None = None):
+    """The Pallas kernel program variants (parallel/kernels/) against
+    the XLA collective paths at mesh sizes 1/2/4/8: the flagship
+    double-groupby RANGE query (ring fold) and a 1M-series PromQL topk
+    (ring topk merge), kernels on vs off over the SAME dataset.
+
+    On a CPU host the kernels run under the Pallas interpreter
+    (`pallas_kernels = "on"`), so wall ms is informational — the HARD
+    gates are the contract: per-chip work scaling strictly monotone
+    1->8 on both legs, kernels-on results BIT-IDENTICAL to kernels-off
+    and to the single-device engine, and the kernel-path share of
+    planner decisions >= KP_SHARE_MIN on every ON leg. Declared
+    collective traffic (gtpu_device_program_comm_bytes_total) is
+    reported next to the readback bytes it rides with."""
+    import os
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    _assert_sanitizer_off()
+    devices = _mc_force_devices()
+
+    from greptimedb_tpu.instance import Standalone
+    from greptimedb_tpu.parallel import mesh as M
+    from greptimedb_tpu.query import readback as _rb
+    from greptimedb_tpu.query.executor import QueryEngine
+    from greptimedb_tpu.session import QueryContext
+    from greptimedb_tpu.sql.parser import parse_sql
+
+    opts_on = M.MeshOptions(pallas_kernels="on")
+    opts_off = M.MeshOptions(pallas_kernels="off")
+
+    tmp = base_dir or _tempfile.mkdtemp(prefix="gtpu_mc_kernels_")
+    own_tmp = base_dir is None
+    inst = Standalone(os.path.join(tmp, "data"), prefer_device=True,
+                      warm_start=False)
+    try:
+        # ---- leg 1: double-groupby-all through the ring fold --------
+        _mc_ingest_cpu(inst)
+        stmt = parse_sql(MC_SQL)[0]
+        plan, ptable = inst.plan(stmt, QueryContext())
+        groupby: dict[str, dict] = {}
+        ref_result = None
+        base_per_chip = None
+        comm_doc = {}
+        for n_dev in (1, 2, 4, 8):
+            mesh = None if n_dev == 1 else M.make_mesh(devices[:n_dev])
+            legs = (("on", opts_on),) if n_dev == 1 else (
+                ("on", opts_on), ("off", opts_off))
+            row: dict[str, object] = {}
+            for tag, opts in legs:
+                engine = QueryEngine(prefer_device=True, mesh=mesh,
+                                     mesh_opts=opts)
+                engine.persist_device_cache = False
+                p0, x0 = _kp_kernel_counters()
+                c0, r0 = _kp_comm_bytes(), _rb.readback_bytes("full")
+                t0 = time.perf_counter()
+                res = engine.execute(plan, ptable)
+                build_ms = (time.perf_counter() - t0) * 1000
+                assert engine.last_exec_path == "device", (
+                    f"mesh={n_dev} {tag}: fell off the device path"
+                )
+                samples = []
+                for _ in range(KP_RUNS):
+                    t0 = time.perf_counter()
+                    res = engine.execute(plan, ptable)
+                    samples.append((time.perf_counter() - t0) * 1000)
+                p1, x1 = _kp_kernel_counters()
+                c1, r1 = _kp_comm_bytes(), _rb.readback_bytes("full")
+                row[f"build_ms_{tag}"] = round(build_ms, 1)
+                row[f"query_ms_{tag}"] = round(min(samples), 1)
+                entry = next(
+                    iter(engine.range_cache._entries.values())
+                )
+                per_chip = int(entry.nrow.shape[0]) // n_dev
+                if n_dev > 1:
+                    dec = entry.mesh_decision
+                    assert dec is not None and dec.shard, (
+                        f"mesh={n_dev} {tag}: planner chose "
+                        f"{dec.label() if dec else None}"
+                    )
+                    share = (p1 - p0) / max((p1 - p0) + (x1 - x0), 1.0)
+                    if tag == "on":
+                        # HARD gate: the sharded executions really took
+                        # the Pallas ring-fold path
+                        assert share >= KP_SHARE_MIN, (
+                            f"mesh={n_dev}: kernel share {share:.2f} < "
+                            f"{KP_SHARE_MIN}"
+                        )
+                        row["kernel_share"] = round(share, 3)
+                        if n_dev == 8:
+                            comm = c1 - c0
+                            rb = r1 - r0
+                            comm_doc["groupby_comm_bytes_per_query"] = (
+                                int(comm // (KP_RUNS + 1))
+                            )
+                            comm_doc["groupby_comm_share"] = round(
+                                comm / max(comm + rb, 1.0), 3
+                            )
+                    else:
+                        assert p1 - p0 == 0, (
+                            f"mesh={n_dev}: kernels_off leg still ran "
+                            "Pallas programs"
+                        )
+                if ref_result is None:
+                    ref_result = res
+                    base_per_chip = per_chip
+                else:
+                    _mc_cols_identical(
+                        ref_result, res,
+                        f"groupby mesh={n_dev} kernels={tag}",
+                    )
+                engine.range_cache.clear()
+            row["series_per_chip"] = per_chip
+            row["work_scaling"] = round(base_per_chip / per_chip, 2)
+            groupby[str(n_dev)] = row
+        scalings = [groupby[str(n)]["work_scaling"] for n in (1, 2, 4, 8)]
+        assert all(b > a for a, b in zip(scalings, scalings[1:])), (
+            f"groupby per-chip work scaling not monotone: {scalings}"
+        )
+
+        # ---- leg 2: 1M-series topk through the ring topk merge ------
+        from greptimedb_tpu.promql import fast as F
+        from greptimedb_tpu.promql.engine import PromEngine
+
+        inst.execute_sql(
+            "create table prom_bench (ts timestamp time index, "
+            "host string, dc string, greptime_value double, "
+            "primary key (host, dc))"
+        )
+        table = inst.catalog.table("public", "prom_bench")
+        hosts = np.asarray(
+            [f"host_{i}" for i in range(KP_SERIES)], object)
+        dcs = np.asarray(
+            [f"dc{i % 32}" for i in range(KP_SERIES)], object)
+        prng = np.random.default_rng(11)
+        t0_data = 1_700_000_000_000
+        t_load = time.perf_counter()
+        for s in range(KP_SAMPLES):
+            ts = np.full(KP_SERIES, t0_data + s * KP_INTERVAL, np.int64)
+            table.write(
+                {"host": hosts, "dc": dcs}, ts,
+                {"greptime_value":
+                    np.cumsum(prng.random(KP_SERIES)) + s * 50.0},
+                skip_wal=True,
+            )
+        print(
+            f"# kernels probe: ingested {KP_SERIES * KP_SAMPLES} rows "
+            f"({KP_SERIES} series) in "
+            f"{time.perf_counter() - t_load:.1f}s", file=sys.stderr,
+        )
+        q = f"topk({KP_K}, rate(prom_bench[1m]))"
+        start = t0_data + 60_000
+        end = t0_data + (KP_SAMPLES - 1) * KP_INTERVAL
+        step = KP_INTERVAL
+        qe = inst.query_engine
+        topk: dict[str, dict] = {}
+        ref_vec = None
+        base_per_chip = None
+        for n_dev in (1, 2, 4, 8):
+            qe.mesh = None if n_dev == 1 else M.make_mesh(
+                devices[:n_dev])
+            legs = (("on", opts_on),) if n_dev == 1 else (
+                ("on", opts_on), ("off", opts_off))
+            row = {}
+            for tag, opts in legs:
+                qe.mesh_opts = opts
+                # rebuild the grid entry under THIS leg's opts: the
+                # cached entry re-records its build-time kernel label
+                # per query, which must match the leg
+                F.invalidate_cache()
+                p0, x0 = _kp_kernel_counters()
+                c0, r0 = _kp_comm_bytes(), _rb.readback_bytes("full")
+                t0 = time.perf_counter()
+                vec, _ = PromEngine(inst).query_range(q, start, end,
+                                                      step)
+                build_ms = (time.perf_counter() - t0) * 1000
+                samples = []
+                for _ in range(KP_RUNS):
+                    t0 = time.perf_counter()
+                    vec, _ = PromEngine(inst).query_range(
+                        q, start, end, step)
+                    samples.append((time.perf_counter() - t0) * 1000)
+                p1, x1 = _kp_kernel_counters()
+                c1, r1 = _kp_comm_bytes(), _rb.readback_bytes("full")
+                row[f"build_ms_{tag}"] = round(build_ms, 1)
+                row[f"query_ms_{tag}"] = round(min(samples), 1)
+                entry = next(iter(F._CACHE._entries.values()))
+                per_chip = int(entry.s_pad) // n_dev
+                if n_dev > 1:
+                    assert entry.mesh is not None, (
+                        f"topk mesh={n_dev}: grid not sharded"
+                    )
+                    assert len(entry.vals.devices()) == n_dev
+                    share = (p1 - p0) / max((p1 - p0) + (x1 - x0), 1.0)
+                    if tag == "on":
+                        assert share >= KP_SHARE_MIN, (
+                            f"topk mesh={n_dev}: kernel share "
+                            f"{share:.2f} < {KP_SHARE_MIN}"
+                        )
+                        row["kernel_share"] = round(share, 3)
+                        if n_dev == 8:
+                            comm = c1 - c0
+                            rb = r1 - r0
+                            comm_doc["topk_comm_bytes_per_query"] = (
+                                int(comm // (KP_RUNS + 1))
+                            )
+                            comm_doc["topk_comm_share"] = round(
+                                comm / max(comm + rb, 1.0), 3
+                            )
+                    else:
+                        assert p1 - p0 == 0, (
+                            f"topk mesh={n_dev}: kernels_off leg still "
+                            "ran Pallas programs"
+                        )
+                if ref_vec is None:
+                    ref_vec = vec
+                    base_per_chip = per_chip
+                else:
+                    _kp_prom_identical(
+                        ref_vec, vec,
+                        f"topk mesh={n_dev} kernels={tag}",
+                    )
+            row["series_per_chip"] = per_chip
+            row["work_scaling"] = round(base_per_chip / per_chip, 2)
+            topk[str(n_dev)] = row
+        scalings = [topk[str(n)]["work_scaling"] for n in (1, 2, 4, 8)]
+        assert all(b > a for a, b in zip(scalings, scalings[1:])), (
+            f"topk per-chip work scaling not monotone: {scalings}"
+        )
+
+        # ---- report -------------------------------------------------
+        lines = [
+            json.dumps({"metric": "multichip_kernels_groupby",
+                        "unit": "ms", "per_mesh": groupby,
+                        "series": MC_HOSTS},
+                       separators=(",", ":")),
+            json.dumps({"metric": "multichip_kernels_topk",
+                        "unit": "ms", "per_mesh": topk,
+                        "series": KP_SERIES, "k": KP_K},
+                       separators=(",", ":")),
+        ]
+        doc = {
+            "metric": "multichip_kernels_share_m8",
+            "value": min(groupby["8"]["kernel_share"],
+                         topk["8"]["kernel_share"]),
+            "unit": "share",
+            "comm": comm_doc,
+            "parity": "bit_identical_on_off_and_vs_single_device",
+            "note": ("CPU host: kernels run under the Pallas "
+                     "interpreter, wall ms is informational; the gates "
+                     "are work scaling, bit-identity, and kernel-path "
+                     "share"),
+        }
+        lines.append(json.dumps(doc, separators=(",", ":")))
+        for ln in lines:
+            print(ln)
+        # final summary line mirrors the orchestrated bench contract
+        print(json.dumps({**doc, "summary": {
+            "kernels_groupby_share_m8": {
+                "v": groupby["8"]["kernel_share"]},
+            "kernels_topk_share_m8": {"v": topk["8"]["kernel_share"]},
+            "kernels_groupby_query_ms_on_m8": {
+                "v": groupby["8"]["query_ms_on"]},
+            "kernels_groupby_query_ms_off_m8": {
+                "v": groupby["8"]["query_ms_off"]},
+            "kernels_topk_query_ms_on_m8": {
+                "v": topk["8"]["query_ms_on"]},
+            "kernels_topk_query_ms_off_m8": {
+                "v": topk["8"]["query_ms_off"]},
+            "kernels_groupby_work_scaling_x8": {
+                "v": groupby["8"]["work_scaling"]},
+            "kernels_topk_work_scaling_x8": {
+                "v": topk["8"]["work_scaling"]},
+            "kernels_groupby_comm_bytes_per_query_m8": {
+                "v": comm_doc.get("groupby_comm_bytes_per_query", 0)},
+            "kernels_topk_comm_bytes_per_query_m8": {
+                "v": comm_doc.get("topk_comm_bytes_per_query", 0)},
+        }}, separators=(",", ":")))
+    finally:
+        from greptimedb_tpu.promql import fast as F
+
+        F.invalidate_cache()
         inst.close()
         if own_tmp:
             _shutil.rmtree(tmp, ignore_errors=True)
@@ -3958,7 +4321,11 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 2 and sys.argv[1] == "dashboard":
         dashboard_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "multichip":
-        multichip_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
+        if len(sys.argv) >= 3 and sys.argv[2] == "kernels":
+            multichip_kernels_probe(
+                sys.argv[3] if len(sys.argv) >= 4 else None)
+        else:
+            multichip_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "memwatch":
         memwatch_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "soak":

@@ -153,6 +153,13 @@ DEFAULTS: dict = {
         "force_host_device_count": 0,   # CPU simulation (virtual devices)
         "shard_min_series": 4096,       # grids below this replicate
         "shard_min_rows": 262144,       # row reductions below this replicate
+        # Pallas kernel paths (parallel/kernels): auto|on|off — auto
+        # enables them on the native TPU backend only; on forces them
+        # everywhere (interpret mode off-TPU); off keeps the XLA paths.
+        "pallas_kernels": "auto",
+        "pallas_min_series": 4096,      # kernel grid floor (stay XLA below)
+        "pallas_min_rows": 262144,      # fused merge-gather row floor
+        "pallas_max_k": 128,            # topk merge kernel O(k^2) cap
     },
     "frontend": {
         # flight addresses of the datanodes this frontend fans out to

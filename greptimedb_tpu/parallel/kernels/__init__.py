@@ -1,0 +1,56 @@
+"""Pallas TPU kernels for the shuffle- and merge-bound paths.
+
+The device profiler's roofline verdicts (telemetry/device_programs)
+say the cross-shard paths are communication-bound, not compute-bound:
+the sharded query path moves cross-shard state through `gather_blocks`
++ host-ordered folds, and the compaction device merge computes only a
+permutation on device and gathers every value column on the host. The
+kernels here keep that state where the reduction runs:
+
+- ring_fold    — hash-groupby shuffle: the blocked cross-shard group
+  fold as a sequential ring (2(ns-1) neighbor hops of the (g, nb)
+  accumulator) instead of an all_gather of every shard's partial
+  blocks, folding in the canonical FOLD_BLOCKS left-fold order so the
+  bit-identity contract across mesh 1/2/4/8 holds by construction.
+- topk_merge   — distributed topk: per-shard candidate heaps merged
+  pairwise around the ring by a merge-path k-selection kernel instead
+  of all-gathering ns*k candidates to every shard.
+- merge_gather — compaction fused merge-gather: the lexsort
+  permutation/keep-mask/fill indices applied to uint32-packed value
+  planes ON DEVICE, so compacted values cross the tunnel exactly once
+  (readback = output columns only).
+
+Kernel selection is planner-driven (query/planner.decide_kernel — the
+`kernel=pallas|xla` dimension of decide_mesh_execution) and every
+kernel ships an interpret-mode twin (`pl.pallas_call(interpret=True)`)
+so tier-1 under JAX_PLATFORMS=cpu exercises the real kernel bodies and
+the mesh-parity fuzz asserts bit-identity against the XLA path.
+"""
+
+from greptimedb_tpu.parallel.kernels.base import (
+    interpret_mode,
+    kernel_mode,
+    kernels_enabled,
+    native_available,
+    ring_comm_bytes,
+    sequential_ring,
+)
+from greptimedb_tpu.parallel.kernels.ring_fold import RingFoldCtx
+from greptimedb_tpu.parallel.kernels.topk_merge import (
+    ring_topk_merge,
+    topk_comm_bytes,
+)
+from greptimedb_tpu.parallel.kernels import merge_gather
+
+__all__ = [
+    "RingFoldCtx",
+    "interpret_mode",
+    "kernel_mode",
+    "kernels_enabled",
+    "merge_gather",
+    "native_available",
+    "ring_comm_bytes",
+    "ring_topk_merge",
+    "sequential_ring",
+    "topk_comm_bytes",
+]

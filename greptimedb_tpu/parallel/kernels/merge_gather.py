@@ -1,0 +1,146 @@
+"""Fused compaction merge-gather: value columns reordered on device.
+
+The classic compaction path (storage/device_merge) computes the lexsort
+permutation / keep mask / backfill indices on device, reads *all three*
+back, and then gathers every value column on the host — each input run
+crosses the tunnel twice (once up as sort keys never, but every value
+column comes back whole). The fused path keeps the permutation on
+device: value columns are packed into uint32 bit planes (bit-exact for
+every fixed-width dtype), a Pallas gather kernel applies the
+device-resident source indices, and only the gathered output planes are
+read back — readback == output bytes, regression-pinned.
+
+Plane packing is pure bit movement (numpy views + zero-extension),
+never value conversion, so reassembled columns are byte-identical to
+the host gather for every payload including NaN bit patterns and -0.0.
+Object/string columns have no fixed-width plane form and take the
+classic host-gather path — the documented exception to the fused
+readback contract.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from greptimedb_tpu.parallel.kernels.base import native_available
+
+
+# ----------------------------------------------------------------------
+# uint32 plane codecs (host side, bit-exact by construction)
+# ----------------------------------------------------------------------
+
+def packable(dtype) -> bool:
+    """True when the dtype has a fixed-width uint32 plane form."""
+    dt = np.dtype(dtype)
+    return dt.kind in "biufmM" and dt.itemsize in (1, 2, 4, 8)
+
+
+def _unsigned_twin(dt: np.dtype) -> np.dtype:
+    """The same-width unsigned dtype a column is viewed through before
+    zero-extension (views reinterpret bits; astype would convert)."""
+    return np.dtype(f"u{dt.itemsize}")
+
+
+def pack_planes(col: np.ndarray) -> np.ndarray:
+    """Pack a 1-D fixed-width column into a (P, n) uint32 plane matrix:
+    8-byte dtypes view as little-endian lo/hi uint32 pairs (P=2), 4-byte
+    dtypes view directly (P=1), narrower dtypes view through their
+    unsigned twin and zero-extend (P=1)."""
+    dt = col.dtype
+    col = np.ascontiguousarray(col)
+    if dt.itemsize == 8:
+        flat = col.view(np.uint32)
+        return np.stack([flat[0::2], flat[1::2]])
+    if dt.itemsize == 4:
+        return col.view(np.uint32)[None, :]
+    return col.view(_unsigned_twin(dt)).astype(np.uint32)[None, :]
+
+
+def unpack_planes(planes: np.ndarray, dtype, n: int) -> np.ndarray:
+    """Invert pack_planes: (P, >=n) uint32 planes back to a length-n
+    column of `dtype`, byte-identical to the original rows."""
+    dt = np.dtype(dtype)
+    planes = np.asarray(planes, dtype=np.uint32)[:, :n]
+    if dt.itemsize == 8:
+        pair = np.empty(2 * n, dtype=np.uint32)
+        pair[0::2] = planes[0]
+        pair[1::2] = planes[1]
+        return pair.view(dt)
+    if dt.itemsize == 4:
+        return np.ascontiguousarray(planes[0]).view(dt)
+    narrow = planes[0].astype(_unsigned_twin(dt))
+    return narrow.view(dt)
+
+
+def plane_count(dtype) -> int:
+    return 2 if np.dtype(dtype).itemsize == 8 else 1
+
+
+def planes_bytes(p: int, n: int) -> int:
+    """Readback size of a gathered (P, n) uint32 plane matrix."""
+    return 4 * int(p) * int(n)
+
+
+# ----------------------------------------------------------------------
+# gather kernels
+# ----------------------------------------------------------------------
+
+def _take_kernel(planes_ref, src_ref, out_ref):
+    """Interpret twin: whole-block gather along the row axis."""
+    import jax.numpy as jnp
+
+    out_ref[...] = jnp.take(planes_ref[...], src_ref[...], axis=1)
+
+
+def _prefetch_gather_kernel(idx_ref, planes_ref, out_ref):
+    """Native body: the scalar-prefetched index map already steered this
+    grid step's (P, 1) input block to column idx[j]; copy it out."""
+    del idx_ref
+    out_ref[...] = planes_ref[...]
+
+
+def gather_planes(planes, src, *, interpret: bool):
+    """Apply device-resident source indices to a (P, n) uint32 plane
+    matrix, producing the (P, n_out) gathered planes. `src` is int32 —
+    the composed order/keep/fill permutation from the merge program.
+    Traceable (call under jit / device_call)."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    p, _ = planes.shape
+    n_out = src.shape[0]
+    out_shape = jax.ShapeDtypeStruct((p, n_out), planes.dtype)
+    if not interpret and native_available():
+        from jax.experimental.pallas import tpu as pltpu
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_out,),
+            in_specs=[pl.BlockSpec((p, 1), lambda j, idx: (0, idx[j]))],
+            out_specs=pl.BlockSpec((p, 1), lambda j, idx: (0, j)),
+        )
+        return pl.pallas_call(
+            _prefetch_gather_kernel,
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(src, planes)
+    return pl.pallas_call(
+        _take_kernel,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(planes, src)
+
+
+@functools.lru_cache(maxsize=32)
+def gather_program(p: int, n: int, n_out: int, interpret: bool):
+    """jit-compiled gather for a (P, n) plane matrix and n_out target
+    rows, cached per shape (compaction buckets repeat heavily)."""
+    import jax
+
+    def run(planes, src):
+        return gather_planes(planes, src, interpret=interpret)
+
+    return jax.jit(run)

@@ -1,0 +1,139 @@
+"""Distributed topk: per-shard candidate heaps merged around the ring.
+
+The XLA path all-gathers every shard's (J, kl) candidate planes to
+every shard and reselects with top_k over the ns*kl concatenation. The
+kernel path keeps candidates where they were selected: the accumulated
+top-k walks the ring (2(ns-1) hops of (J, k) planes) and each shard
+merges its local candidates on top with a merge-path k-selection
+kernel. Tie-breaks favor the accumulator — i.e. the earlier shard,
+i.e. the lower concatenation position — which is exactly
+jax.lax.top_k's documented lower-index-wins rule over the shard-order
+concatenation, so the selected winners (values, indices, presence)
+match the all-gather path exactly.
+
+Candidate planes are gathered through the merge positions as int32
+bitcasts (jax.lax.bitcast_convert_type), never float arithmetic: a
+one-hot float multiply would turn -inf * 0 into NaN, and a float
+where+sum would normalize -0.0 — int selection is exact for every
+payload including NaN bit patterns.
+"""
+
+from __future__ import annotations
+
+from greptimedb_tpu.parallel.kernels.base import (
+    ring_comm_bytes,
+    sequential_ring,
+)
+
+
+def _merge_topk_kernel(a_key_ref, a_val_ref, a_idx_ref, a_pres_ref,
+                       b_key_ref, b_val_ref, b_idx_ref, b_pres_ref,
+                       o_key_ref, o_val_ref, o_idx_ref, o_pres_ref):
+    """Stable merge of two descending candidate lists, truncated to the
+    accumulator width. Merge-path ranks: a[i] lands at i + #(b > a[i]),
+    b[j] at j + #(a >= b[j]) — `>=` gives equal keys to the
+    accumulator, making the merge the stable order of the shard-order
+    concatenation."""
+    import jax
+    import jax.numpy as jnp
+
+    a_key = a_key_ref[...]                      # (J, kk) desc
+    b_key = b_key_ref[...]                      # (J, kl) desc
+    kk = a_key.shape[1]
+    kl = b_key.shape[1]
+    iota_a = jnp.arange(kk, dtype=jnp.int32)
+    iota_b = jnp.arange(kl, dtype=jnp.int32)
+    gt = b_key[:, None, :] > a_key[:, :, None]  # (J, kk, kl)
+    # dtype pinned on every sum: under jax_enable_x64 an unpinned int32
+    # sum widens to int64, which would break the int32 bitcast selects
+    pos_a = iota_a[None, :] + jnp.sum(gt, axis=2, dtype=jnp.int32)
+    ge = a_key[:, :, None] >= b_key[:, None, :]
+    pos_b = iota_b[None, :] + jnp.sum(ge, axis=1, dtype=jnp.int32)
+    slots = jnp.arange(kk, dtype=jnp.int32)
+
+    def place(plane_a, plane_b):
+        # each output slot < kk receives exactly one source element
+        # (pos_a/pos_b enumerate the merged order); slots past kk fall
+        # off the one-hot and are dropped
+        hit_a = pos_a[:, :, None] == slots[None, None, :]
+        hit_b = pos_b[:, :, None] == slots[None, None, :]
+        zero = jnp.zeros((), jnp.int32)
+        return (
+            jnp.sum(jnp.where(hit_a, plane_a[:, :, None], zero),
+                    axis=1, dtype=jnp.int32)
+            + jnp.sum(jnp.where(hit_b, plane_b[:, :, None], zero),
+                      axis=1, dtype=jnp.int32)
+        )
+
+    bits = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)  # noqa: E731
+    f32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.float32)  # noqa: E731
+    o_key_ref[...] = f32(place(bits(a_key), bits(b_key)))
+    o_val_ref[...] = f32(place(bits(a_val_ref[...]), bits(b_val_ref[...])))
+    o_idx_ref[...] = place(a_idx_ref[...], b_idx_ref[...])
+    o_pres_ref[...] = place(
+        a_pres_ref[...].astype(jnp.int32), b_pres_ref[...].astype(jnp.int32)
+    ) > 0
+
+
+def merge_candidates(acc, loc, *, interpret: bool):
+    """One merge hop: acc/loc = (key, val, idx, pres) plane tuples of
+    shapes (J, kk)/(J, kl); returns the merged (J, kk) planes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ak, av, ai, ap = acc
+    bk, bv, bi, bp = loc
+    out = pl.pallas_call(
+        _merge_topk_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct(ak.shape, jnp.float32),
+            jax.ShapeDtypeStruct(ak.shape, jnp.float32),
+            jax.ShapeDtypeStruct(ak.shape, jnp.int32),
+            jax.ShapeDtypeStruct(ak.shape, jnp.bool_),
+        ],
+        interpret=interpret,
+    )(ak, av, ai, ap, bk, bv, bi, bp)
+    return tuple(out)
+
+
+_IDX_SENTINEL = 2**31 - 1
+
+
+def ring_topk_merge(l_key, l_val, l_idx, l_pres, *, k: int, ns: int,
+                    interpret: bool):
+    """Ring-merge per-shard candidate planes (J, kl) into the global
+    (J, k) winners, identical on every shard and bit-identical (in the
+    present slots) to top_k over the shard-order all_gather. kl may be
+    below k (fewer local series than k): the seed pads with -inf keys /
+    absent presence, which only ever tie with other absent candidates
+    and are dropped by the caller's isfinite(key) presence check."""
+    import jax.numpy as jnp
+
+    j = l_key.shape[0]
+    kl = l_key.shape[1]
+    local = (l_key.astype(jnp.float32), l_val.astype(jnp.float32),
+             l_idx.astype(jnp.int32), l_pres)
+    if kl < k:
+        pad = k - kl
+
+        def ext(x, fill):
+            return jnp.concatenate(
+                [x, jnp.full((j, pad), fill, x.dtype)], axis=1
+            )
+
+        seed = (ext(local[0], -jnp.inf), ext(local[1], 0.0),
+                ext(local[2], _IDX_SENTINEL), ext(local[3], False))
+    else:
+        seed = local
+
+    def comb(acc):
+        return merge_candidates(acc, local, interpret=interpret)
+
+    return sequential_ring(seed, comb, ns)
+
+
+def topk_comm_bytes(ns: int, j: int, k: int) -> int:
+    """Declared inter-chip traffic of one topk ring: (J, k) key/val/idx
+    f32+f32+int32 planes plus the bool presence plane, 2(ns-1) hops."""
+    return ring_comm_bytes(ns, (4 + 4 + 4 + 1) * int(j) * int(k))

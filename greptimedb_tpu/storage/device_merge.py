@@ -48,12 +48,19 @@ def _pad_to_bucket(n: int) -> int:
 
 def _build_program():
     """Compile-once builder for the merge program (jax import deferred:
-    the storage layer must stay importable without a device runtime)."""
+    the storage layer must stay importable without a device runtime).
+
+    Two variants behind the static `fused` flag: the classic one reads
+    the full permutation / keep mask / fill indices back so the host can
+    gather; the fused one (parallel/kernels merge-gather path) keeps
+    all of them device-resident, composing them into per-output-row
+    SOURCE indices in original row space — the only thing the host ever
+    reads back from it is the kept-row COUNT (4 bytes)."""
     import jax
     import jax.numpy as jnp
 
     def prog(sid, ts_hi, ts_lo, seq_hi, seq_lo, op, n_real, valids,
-             *, drop_deletes):
+             *, drop_deletes, fused=False):
         n = sid.shape[0]
         order = jnp.lexsort((seq_lo, seq_hi, ts_lo, ts_hi, sid))
         s_sid = sid[order]
@@ -82,9 +89,28 @@ def _build_program():
                 sv = v[order]
                 m = jax.lax.cummax(jnp.where(sv, idx, -1))
                 fills[name] = jnp.where(m >= run_start, m, idx)
-        return order.astype(jnp.int32), keep, fills
+        order_i = order.astype(jnp.int32)
+        if not fused:
+            return order_i, keep, fills
+        # fused: compact the kept rows' ORIGINAL indices to the front.
+        # ck-1 ranks each kept sorted position among the keeps; dropped
+        # rows scatter to the n slot and fall off the [:n] slice. The
+        # host never sees these indices — the gather kernel consumes
+        # them in place (kernels/merge_gather.py).
+        # dtype pinned: under jax_enable_x64 cumsum would widen to
+        # int64 (8-byte count readback, int64 scatter targets)
+        ck = jnp.cumsum(keep, dtype=jnp.int32)
+        n_keep = ck[-1]
+        tgt = jnp.where(keep, ck - 1, n)
+        src_keep = jnp.zeros(n + 1, jnp.int32).at[tgt].set(order_i)[:n]
+        src_fills = {
+            name: jnp.zeros(n + 1, jnp.int32)
+                     .at[tgt].set(order_i[f])[:n]
+            for name, f in fills.items()
+        }
+        return n_keep, src_keep, src_fills
 
-    return jax.jit(prog, static_argnames=("drop_deletes",))
+    return jax.jit(prog, static_argnames=("drop_deletes", "fused"))
 
 
 def _get_program():
@@ -106,15 +132,9 @@ def _split64(a: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return hi.astype(hi_dt), lo.astype(np.uint32)
 
 
-def _device_merge_indices(rows: ColumnarRows, *, backfill: bool,
-                          drop_deletes: bool):
-    """Run the device program; returns (keep_row_indices, fill_src) in
-    ORIGINAL row index space — fill_src maps each kept output row to
-    the original row its field value/validity comes from (last_non_null
-    only; None otherwise)."""
-    from greptimedb_tpu.query import readback
-    from greptimedb_tpu.telemetry import device_trace
-
+def _prep_uploads(rows: ColumnarRows, *, backfill: bool):
+    """Bucket-padded sort-key uploads shared by the classic and fused
+    merge programs: (upload dict, valids dict, upload bytes, pad)."""
     n = len(rows)
     pad = _pad_to_bucket(n)
     ts_hi, ts_lo = _split64(np.asarray(rows.ts, np.int64))
@@ -146,6 +166,20 @@ def _device_merge_indices(rows: ColumnarRows, *, backfill: bool,
     upload = sum(a.nbytes for a in up.values()) + sum(
         a.nbytes for a in valids.values()
     )
+    return up, valids, upload, pad
+
+
+def _device_merge_indices(rows: ColumnarRows, *, backfill: bool,
+                          drop_deletes: bool):
+    """Run the device program; returns (keep_row_indices, fill_src) in
+    ORIGINAL row index space — fill_src maps each kept output row to
+    the original row its field value/validity comes from (last_non_null
+    only; None otherwise)."""
+    from greptimedb_tpu.query import readback
+    from greptimedb_tpu.telemetry import device_trace
+
+    n = len(rows)
+    up, valids, upload, pad = _prep_uploads(rows, backfill=backfill)
     prog = _get_program()
     key = (pad, tuple(sorted(valids)), drop_deletes)
     with device_trace.device_call("compact_merge", key=key,
@@ -172,6 +206,164 @@ def _device_merge_indices(rows: ColumnarRows, *, backfill: bool,
             name: order[f][keep] for name, f in fills.items()
         }
     return keep_idx, fill_src
+
+
+# ----------------------------------------------------------------------
+# fused merge-gather (parallel/kernels/merge_gather.py): the permutation
+# never comes back — value columns are gathered ON DEVICE and only the
+# output planes cross the tunnel
+# ----------------------------------------------------------------------
+
+
+def _fused_supported(rows: ColumnarRows) -> bool:
+    """Every column needs a fixed-width uint32 plane form; object /
+    string fields take the classic path (the documented exception to
+    the fused readback contract)."""
+    try:
+        from greptimedb_tpu.parallel.kernels import merge_gather as mg
+    except ImportError:
+        return False
+    cols = [rows.sid, rows.ts, rows.seq, rows.op]
+    cols.extend(rows.fields.values())
+    if rows.field_valid is not None:
+        cols.extend(rows.field_valid.values())
+    return all(mg.packable(np.asarray(c).dtype) for c in cols)
+
+
+def _fused_wanted(n: int) -> bool:
+    """Planner gate for the fused variant: pallas_kernels mode + the
+    pallas_min_rows threshold (query/planner.decide_kernel), recorded
+    in EXPLAIN ANALYZE / gtpu_mesh_queries_total like every other
+    kernel decision."""
+    try:
+        from greptimedb_tpu.parallel import mesh as pmesh
+        from greptimedb_tpu.query.planner import (
+            decide_kernel, record_kernel_decision,
+        )
+    except ImportError:
+        return False
+    kdec, reason = decide_kernel("merge", rows=n,
+                                 opts=pmesh.global_mesh_opts())
+    record_kernel_decision("merge", kdec, reason)
+    return kdec == "pallas"
+
+
+def _gather_group(cols, src_dev, *, pad: int, n: int, n_keep: int,
+                  n_out: int, interp: bool):
+    """Pack one source-index group's columns into a single uint32 plane
+    matrix, gather it through the device-resident indices, read back
+    only the gathered output planes, and unpack per column."""
+    from greptimedb_tpu.parallel.kernels import merge_gather as mg
+    from greptimedb_tpu.query import readback
+    from greptimedb_tpu.telemetry import device_trace
+
+    mats, metas = [], []
+    for tag, col in cols:
+        col = np.asarray(col)
+        planes = mg.pack_planes(col)
+        metas.append((tag, col.dtype, planes.shape[0]))
+        mats.append(planes)
+    big = np.concatenate(mats, axis=0)
+    if pad != n:
+        big = np.concatenate(
+            [big, np.zeros((big.shape[0], pad - n), np.uint32)], axis=1
+        )
+    p_total = big.shape[0]
+    run = mg.gather_program(p_total, pad, n_out, interp)
+    with device_trace.device_call(
+            "compact_gather", key=(p_total, pad, n_out, interp),
+            rows=n) as d:
+        d.transfer(big.nbytes, "upload")
+        out_d = d.run(run, big, src_dev[:n_out])
+        out_d.block_until_ready()
+        d.executed()
+        out = readback.read_full(out_d)
+        d.transfer(out.nbytes)
+    res, off = {}, 0
+    for tag, dt, p_i in metas:
+        res[tag] = mg.unpack_planes(out[off:off + p_i], dt, n_keep)
+        off += p_i
+    return res
+
+
+def _device_merge_fused(rows: ColumnarRows, *, backfill: bool,
+                        drop_deletes: bool) -> ColumnarRows:
+    """Two-phase fused merge: phase 1 runs the sort/dedup program with
+    `fused=True` — the composed source indices stay device-resident and
+    the ONLY readback is the kept-row count (4 bytes). Phase 2 packs
+    every value column into uint32 bit planes, gathers them through
+    those indices with the Pallas gather kernel, and reads back the
+    gathered output planes — readback == output columns, never the
+    per-input-run index arrays the classic path pays for."""
+    from greptimedb_tpu.parallel.kernels.base import interpret_mode
+    from greptimedb_tpu.query import readback
+    from greptimedb_tpu.telemetry import device_trace
+
+    n = len(rows)
+    up, valids, upload, pad = _prep_uploads(rows, backfill=backfill)
+    prog = _get_program()
+    key = (pad, tuple(sorted(valids)), drop_deletes, "fused")
+    with device_trace.device_call("compact_merge", key=key,
+                                  rows=n) as d:
+        d.transfer(upload, "upload")
+        n_keep_d, src_keep_d, src_fills_d = d.run(
+            prog,
+            up["sid"], up["ts_hi"], up["ts_lo"], up["seq_hi"],
+            up["seq_lo"], up["op"], np.int32(n), valids,
+            drop_deletes=drop_deletes, fused=True,
+        )
+        n_keep_d.block_until_ready()
+        d.executed()
+        n_keep = int(readback.read_full(n_keep_d))
+        d.transfer(4)
+    has_valid = rows.field_valid is not None
+    if n_keep == 0:
+        return ColumnarRows(
+            sid=rows.sid[:0], ts=rows.ts[:0], seq=rows.seq[:0],
+            op=rows.op[:0],
+            fields={name: v[:0] for name, v in rows.fields.items()},
+            field_valid=(
+                {name: v[:0] for name, v in rows.field_valid.items()}
+                if has_valid else None
+            ),
+        )
+    interp = interpret_mode()
+    n_out = _pad_to_bucket(n_keep)
+    fill_names = set(src_fills_d)
+    keep_cols = [
+        (("k", "sid"), rows.sid), (("k", "ts"), rows.ts),
+        (("k", "seq"), rows.seq), (("k", "op"), rows.op),
+    ]
+    fill_groups = {}
+    for name, vals in rows.fields.items():
+        v = rows.field_valid.get(name) if has_valid else None
+        if name in fill_names:
+            grp = fill_groups.setdefault(name, [])
+            grp.append((("f", name), vals))
+            if v is not None:
+                grp.append((("v", name), v))
+        else:
+            keep_cols.append((("f", name), vals))
+            if v is not None:
+                keep_cols.append((("v", name), v))
+    got = _gather_group(keep_cols, src_keep_d, pad=pad, n=n,
+                        n_keep=n_keep, n_out=n_out, interp=interp)
+    for name, grp in fill_groups.items():
+        got.update(_gather_group(grp, src_fills_d[name], pad=pad, n=n,
+                                 n_keep=n_keep, n_out=n_out,
+                                 interp=interp))
+    fields = {name: got[("f", name)] for name in rows.fields}
+    out_valids = None
+    if has_valid:
+        out_valids = {name: got[("v", name)]
+                      for name in rows.field_valid
+                      if ("v", name) in got}
+    return ColumnarRows(
+        sid=got[("k", "sid")], ts=got[("k", "ts")],
+        seq=got[("k", "seq")], op=got[("k", "op")],
+        fields=fields,
+        field_valid=out_valids if out_valids else None,
+    )
 
 
 def host_merge(rows: ColumnarRows, *, merge_mode: str,
@@ -202,6 +394,21 @@ def merge_rows(
         return host_merge(rows, merge_mode=merge_mode,
                           drop_deletes=drop_deletes), "host"
     backfill = merge_mode == "last_non_null" and rows.field_valid is not None
+    if _fused_supported(rows) and _fused_wanted(n):
+        try:
+            out = _device_merge_fused(
+                rows, backfill=backfill, drop_deletes=drop_deletes
+            )
+        except ImportError:
+            out = None  # no jax runtime: classic path decides below
+        if out is not None:
+            if verify:
+                _assert_identical(
+                    out,
+                    host_merge(rows, merge_mode=merge_mode,
+                               drop_deletes=drop_deletes),
+                )
+            return out, "device"
     try:
         keep_idx, fill_src = _device_merge_indices(
             rows, backfill=backfill, drop_deletes=drop_deletes

@@ -74,11 +74,21 @@ class device_call:
 
     __slots__ = ("_cm", "_span", "_mono0", "site", "_stmt", "key",
                  "_rec", "_first", "_run_t0", "_exec_ms", "_up", "_rb",
-                 "_dispatch_only")
+                 "_dispatch_only", "collective", "comm_bytes")
 
-    def __init__(self, site: str, *, key=None, **attrs):
+    def __init__(self, site: str, *, key=None, collective: bool = False,
+                 comm_bytes: int = 0, **attrs):
         self.site = site
         self.key = key
+        # collective-time attribution (kernel programs with declared
+        # inter-chip copies): rides the span AND the program row, so
+        # bench multichip can report communication share per mesh size
+        self.collective = bool(collective)
+        self.comm_bytes = int(comm_bytes)
+        if self.collective:
+            attrs = dict(attrs)
+            attrs["collective"] = True
+            attrs["comm_bytes"] = self.comm_bytes
         self._rec = None
         self._first = False
         self._run_t0 = 0.0
@@ -169,7 +179,9 @@ class device_call:
             reg.finish(rec, execute_ms=self._exec_ms,
                        upload=self._up, readback=self._rb,
                        dispatch_only=self._dispatch_only,
-                       run_start=self._run_t0 or None)
+                       run_start=self._run_t0 or None,
+                       collective=self.collective,
+                       comm_bytes=self.comm_bytes)
         if self._stmt:
             # program-registry link: the statement_statistics row lists
             # the program ids its executions used (dispatched, or

@@ -1256,3 +1256,98 @@ class DirectKnobWrite(Rule):
 
     def visit_AugAssign(self, node: ast.AugAssign, ctx: FileContext):
         self._flag(node.target, ctx)
+
+
+@register
+class PallasCallHygiene(Rule):
+    id = "GT022"
+    name = "pallas-call-hygiene"
+    description = (
+        "Pallas kernel dispatch hygiene. Every pallas_call must thread "
+        "`interpret=` from the kernels config (interpret_mode() or a "
+        "parameter): a hard-coded literal either pins the slow "
+        "interpreter onto real TPUs (True) or breaks the CPU twin the "
+        "CI runs on (False, or the keyword missing entirely). And a "
+        "make_async_remote_copy whose device_id names a mesh axis the "
+        "enclosing shard_map does not bind fails at trace time or "
+        "RDMAs around the wrong ring — the same unbound-axis hazard "
+        "GT013 guards for collectives. (Kernel bodies themselves are "
+        "already device scope: GT004/GT014 apply inside them.)"
+    )
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        f = dotted_name(node.func)
+        if not f:
+            return
+        short = f.split(".")[-1]
+        if short == "pallas_call":
+            self._check_interpret(node, ctx)
+        elif short == "make_async_remote_copy":
+            self._check_device_id(node, ctx)
+
+    def _check_interpret(self, node: ast.Call, ctx: FileContext):
+        kw = None
+        for k in node.keywords:
+            if k.arg == "interpret":
+                kw = k
+        if kw is None:
+            if any(k.arg is None for k in node.keywords):
+                return  # a **kwargs splat may carry interpret=
+            ctx.report(self, node,
+                       "pallas_call without `interpret=` — thread it "
+                       "from the kernels config (interpret_mode() or a "
+                       "parameter); without it the CPU interpret twin "
+                       "can never run this kernel")
+        elif (isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, bool)):
+            ctx.report(self, node,
+                       f"pallas_call with hard-coded interpret="
+                       f"{kw.value.value} — thread it from the kernels "
+                       "config (interpret_mode() or a parameter) so one "
+                       "call site serves both the CPU interpret twin "
+                       "and the native Mosaic path")
+
+    def _check_device_id(self, node: ast.Call, ctx: FileContext):
+        dev = None
+        for k in node.keywords:
+            if k.arg == "device_id":
+                dev = k.value
+        if dev is None:
+            return
+        # innermost enclosing shard_map kernel with a known binding
+        # (same anchoring as GT013)
+        bound = None
+        for fi in reversed(ctx.func_stack):
+            axes = ctx.shard_map_axes.get((fi.name, fi.node.lineno))
+            if axes:
+                bound = axes
+                break
+        if not bound:
+            return
+        # axis-name candidates inside the device_id expression. The
+        # mesh-keyed form carries axis names as string literals (or
+        # module constants resolving to them); axis_index(...) subtrees
+        # are GT013's domain (it flags the call itself) and unresolved
+        # bare identifiers are device-index arithmetic (`right`, `my`),
+        # not axis names — both stay out of the candidate set.
+        skip: set[int] = set()
+        for n in ast.walk(dev):
+            if isinstance(n, ast.Call):
+                d = dotted_name(n.func)
+                if d is not None and d.split(".")[-1] == "axis_index":
+                    skip.update(id(c) for c in ast.walk(n))
+                else:
+                    skip.update(id(c) for c in ast.walk(n.func))
+        if any(a.startswith("id:") for a in bound):
+            return  # unresolved binding side: can't compare literals
+        for n in ast.walk(dev):
+            if id(n) in skip:
+                continue
+            axis = ctx.axis_name_of(n)
+            if axis is None or axis in bound or axis.startswith("id:"):
+                continue
+            shown = sorted(bound)
+            ctx.report(self, node,
+                       f"make_async_remote_copy device_id references "
+                       f"axis {axis!r} not bound by the enclosing "
+                       f"shard_map (binds: {', '.join(shown)})")

@@ -36,7 +36,7 @@ def rules_hit(src: str, select: str | None = None):
 def test_registry_has_all_rules():
     ids = sorted(all_rules())
     # GT020 is unassigned/reserved; the registry jumps to GT021.
-    assert ids == [f"GT{n:03d}" for n in range(1, 20)] + ["GT021"]
+    assert ids == [f"GT{n:03d}" for n in range(1, 20)] + ["GT021", "GT022"]
     for rule in all_rules().values():
         assert rule.name and rule.description
 
@@ -1750,6 +1750,145 @@ def test_gt021_negative_autotune_package_path():
     act, _ = lint_source("greptimedb_tpu/other.py", src,
                          select={"GT021"})
     assert [f.rule for f in act] == ["GT021"]
+
+
+# ---------------------------------------------------------------------------
+# GT022 pallas_call hygiene
+# ---------------------------------------------------------------------------
+
+def test_gt022_positive_hardcoded_and_missing_interpret():
+    hits = rules_hit("""
+        import jax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + x_ref[...]
+
+        def run(x):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=True,
+            )(x)
+
+        def run2(x):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+    """, select="GT022")
+    assert hits == [("GT022", 9), ("GT022", 16)]
+
+
+def test_gt022_negative_threaded_interpret():
+    assert rules_hit("""
+        import jax
+        from jax.experimental import pallas as pl
+        from greptimedb_tpu.parallel.kernels import interpret_mode
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + x_ref[...]
+
+        def run(x, interpret):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=interpret,
+            )(x)
+
+        def run2(x):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=interpret_mode(),
+            )(x)
+
+        def run3(x, **kw):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                **kw,
+            )(x)
+    """, select="GT022") == []
+
+
+def test_gt022_positive_unbound_device_id_axis():
+    hits = rules_hit("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.experimental.pallas import tpu as pltpu
+        from jax.sharding import PartitionSpec as P
+
+        def run(mesh, x):
+            def body(ref, o_ref):
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=ref, dst_ref=o_ref,
+                    device_id=("time", 1),
+                    device_id_type=pltpu.DeviceIdType.MESH,
+                )
+                rdma.start()
+
+            return shard_map(body, mesh=mesh, in_specs=(P("shard"),),
+                             out_specs=P("shard"))(x)
+    """, select="GT022")
+    assert hits == [("GT022", 9)]
+
+
+def test_gt022_negative_bound_or_computed_device_id():
+    # mesh-form device_id naming the bound axis: clean
+    assert rules_hit("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.experimental.pallas import tpu as pltpu
+        from jax.sharding import PartitionSpec as P
+
+        def run(mesh, x):
+            def body(ref, o_ref):
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=ref, dst_ref=o_ref,
+                    device_id=("shard", 1),
+                    device_id_type=pltpu.DeviceIdType.MESH,
+                )
+                rdma.start()
+
+            return shard_map(body, mesh=mesh, in_specs=(P("shard"),),
+                             out_specs=P("shard"))(x)
+    """, select="GT022") == []
+    # computed logical device id: identifiers are index arithmetic,
+    # not axis names; the axis_index subtree is GT013's domain
+    assert rules_hit("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.experimental.pallas import tpu as pltpu
+        from jax.sharding import PartitionSpec as P
+
+        def run(mesh, x):
+            def body(ref, o_ref):
+                my = jax.lax.axis_index("shard")
+                right = jax.lax.rem(my + 1, 4)
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=ref, dst_ref=o_ref,
+                    device_id=(right,),
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+                rdma.start()
+
+            return shard_map(body, mesh=mesh, in_specs=(P("shard"),),
+                             out_specs=P("shard"))(x)
+    """, select="GT022") == []
+    # outside any shard_map body (a bare pallas kernel helper): no
+    # binding to compare against, stays quiet
+    assert rules_hit("""
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(ref, o_ref):
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=ref, dst_ref=o_ref,
+                device_id=("time", 1),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            rdma.start()
+    """, select="GT022") == []
 
 
 if __name__ == "__main__":
