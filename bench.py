@@ -4309,6 +4309,48 @@ def autotune_probe(base_dir: str | None = None):
             _shutil.rmtree(tmp, ignore_errors=True)
 
 
+LINT_WALL_GATE_S = 20.0
+
+
+def lint_probe():
+    """`python bench.py lint`: full-package gtlint wall time (all 26
+    rules including the GT023-GT027 dataflow verifier) with a HARD
+    <= 20s gate — the one-walk + lazy-fixpoint design is the reason
+    the device-contract rules can live in the tier-1 gate at all, so
+    its cost is regression-pinned like any other metric."""
+    import os
+
+    from greptimedb_tpu.tools.lint import run
+
+    pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "greptimedb_tpu")
+    t0 = time.perf_counter()
+    res = run([pkg])
+    wall = time.perf_counter() - t0
+    assert not res["errors"], f"unparseable files: {res['errors']}"
+    # the gate is HARD: a lint pass slower than 20s stops being a
+    # pre-commit tool and starts being skipped
+    assert wall <= LINT_WALL_GATE_S, (
+        f"gtlint wall {wall:.1f}s exceeds the {LINT_WALL_GATE_S:.0f}s "
+        f"gate over {res['counts']['files']} files — profile the "
+        f"dataflow fixpoint (ScopeAnalysis) before shipping"
+    )
+    doc = {
+        "metric": "lint_wall_s",
+        "value": round(wall, 2),
+        "unit": "s",
+        "vs_baseline": round(wall / LINT_WALL_GATE_S, 2),
+        "files": res["counts"]["files"],
+        "findings_new": res["counts"]["new"],
+        "suppressed": res["counts"]["suppressed"],
+    }
+    print(json.dumps(doc, separators=(",", ":")))
+    print(json.dumps({**doc, "summary": {
+        "lint_wall_s": {"v": doc["value"]},
+        "lint_files": {"v": doc["files"]},
+    }}, separators=(",", ":")))
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--phase1":
         phase1(sys.argv[2])
@@ -4334,5 +4376,7 @@ if __name__ == "__main__":
         fleet_probe()
     elif len(sys.argv) >= 2 and sys.argv[1] == "autotune":
         autotune_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "lint":
+        lint_probe()
     else:
         main()

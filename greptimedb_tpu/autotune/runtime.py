@@ -111,8 +111,13 @@ class AutotuneRuntime:
         if not self.enabled or self._thread is not None:
             return
         self._stop.clear()
+        # contract: the controller loop is a process-lifetime daemon
+        # with no submitting request — every autotune.tick span is
+        # DELIBERATELY its own root trace, not a child of whichever
+        # request happened to call start()
         self._thread = concurrency.Thread(
-            target=self._run, name="gtpu-autotune", daemon=True
+            target=self._run,  # gtlint: disable=GT027
+            name="gtpu-autotune", daemon=True,
         )
         self._thread.start()
         _log.info("[autotune] control loop started "

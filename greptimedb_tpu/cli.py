@@ -78,6 +78,9 @@ def build_parser() -> argparse.ArgumentParser:
                       help="lint only files differing from this git "
                            "ref (fast pre-commit runs)")
     lint.add_argument("--list-rules", action="store_true")
+    lint.add_argument("--explain", default=None, metavar="GTxxx",
+                      help="print one rule's doc, examples, and "
+                           "suppression syntax (exit 2 on unknown id)")
 
     san = sub.add_parser(
         "san", help="run a command under the gtsan concurrency "
@@ -130,6 +133,8 @@ def main(argv=None):
             fwd += ["--select", args.select]
         if args.changed:
             fwd += ["--changed", args.changed]
+        if args.explain:
+            fwd += ["--explain", args.explain]
         return lint_main(fwd)
     if args.role == "san":
         from greptimedb_tpu.tools.san.runner import main as san_main

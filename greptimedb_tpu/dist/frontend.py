@@ -615,8 +615,13 @@ class DistInstance(Standalone):
             if self._mirror_stop or addr in self._mirror_retriers:
                 return
             self._mirror_retriers.add(addr)
+        # contract: background replay has no originating request —
+        # _ship_mirror's traceparent() read is MEANT to see empty
+        # context here (replayed deltas carry no trace header, while
+        # the inline mirror path forwards the live one)
         concurrency.Thread(
-            target=self._mirror_retry_loop, args=(addr,),
+            target=self._mirror_retry_loop,  # gtlint: disable=GT027
+            args=(addr,),
             daemon=True, name=f"mirror-retry-{addr}",
         ).start()
 

@@ -130,8 +130,12 @@ class FlowManager:
         self._lock = concurrency.RLock()
         self._stop = concurrency.Event()
         self._load()
+        # contract: the ticker is a manager-lifetime daemon; flow
+        # window flushes it drives are their own root traces (the
+        # request-attributed path is the inline flush on insert)
         self._ticker = concurrency.Thread(
-            target=self._tick_loop, daemon=True, name="flow-ticker"
+            target=self._tick_loop,  # gtlint: disable=GT027
+            daemon=True, name="flow-ticker",
         )
         self._ticker.start()
 

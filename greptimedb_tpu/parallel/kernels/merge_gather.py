@@ -121,9 +121,15 @@ def gather_planes(planes, src, *, interpret: bool):
             in_specs=[pl.BlockSpec((p, 1), lambda j, idx: (0, idx[j]))],
             out_specs=pl.BlockSpec((p, 1), lambda j, idx: (0, j)),
         )
+        # contract: the (P, 1) blocks are DELIBERATELY one lane wide —
+        # the prefetched index picks one source column per grid step,
+        # so a 128-lane block would gather 128 contiguous columns the
+        # permutation does not have. Mosaic pads the lane dim; the
+        # relayout cost is the price of a data-dependent gather and is
+        # covered by the planner's size gate (decide_kernel).
         return pl.pallas_call(
             _prefetch_gather_kernel,
-            grid_spec=grid_spec,
+            grid_spec=grid_spec,  # gtlint: disable=GT023
             out_shape=out_shape,
             interpret=interpret,
         )(src, planes)

@@ -646,10 +646,17 @@ class CompactionScheduler:
                 return None
         pool = self._ensure_pool()
         est = sum(m.size_bytes for t in tasks or () for m in t.files)
+        from greptimedb_tpu.telemetry import tracing
+
+        # captured HERE, on the submitting thread: the worker runs
+        # with empty context, so without an explicit parent the merge
+        # span silently detaches from the request that triggered it
+        # (GT027)
+        parent = tracing.current_span()
         with self._lock:
             if self._closed or rid in self._inflight:
                 return None
-            fut = pool.submit(self._run_region, region, force)
+            fut = pool.submit(self._run_region, region, force, parent)
             self._inflight[rid] = fut
             # merge working-set estimate for the memory ledger:
             # compressed input size (decoded columns run a few x
@@ -668,9 +675,19 @@ class CompactionScheduler:
             self._inflight.pop(rid, None)
             self._inflight_bytes.pop(rid, None)
 
-    def _run_region(self, region, force: bool = False) -> bool:
+    def _run_region(self, region, force: bool = False,
+                    _trace_parent=None) -> bool:
+        from greptimedb_tpu.telemetry import tracing
+
         try:
-            return compact_once(region, self.opts, force=force)
+            # a traced trigger (flush under a query, ADMIN compact)
+            # gets its background merge attributed to its trace;
+            # untraced maintenance ticks pay nothing (child_span with
+            # no parent is a no-op)
+            with tracing.child_span("compaction.job",
+                                    _parent=_trace_parent,
+                                    region=region.meta.region_id):
+                return compact_once(region, self.opts, force=force)
         except Exception:
             # the background path has no caller to observe the Future:
             # a failing merge must surface in the log (the errors
@@ -737,13 +754,21 @@ class CompactionScheduler:
         re-raises after all complete (typed errors cross every wire)."""
         from concurrent.futures import CancelledError
 
+        from greptimedb_tpu.telemetry import tracing
+
         items = list(items)
         if not items:
             return []
         if self._in_worker():
             return [fn(it) for it in items]
         pool = self._ensure_pool()
-        futs = [pool.submit(fn, it) for it in items]
+        # same contract as schedule(): the parent span is captured on
+        # the submitting (request) thread, because the worker's context
+        # is empty — without the rebind the per-region work of an ADMIN
+        # fan-out lands in detached root traces (GT027)
+        parent = tracing.current_span()
+        futs = [pool.submit(self._run_fanout, fn, it, parent)
+                for it in items]
         results, first_err = [], None
         for fut in futs:
             try:
@@ -762,6 +787,16 @@ class CompactionScheduler:
         if first_err is not None:
             raise first_err
         return results
+
+    def _run_fanout(self, fn, item, _trace_parent=None):
+        from greptimedb_tpu.telemetry import tracing
+
+        # no-op for untraced callers (child_span without a parent);
+        # a traced ADMIN request nests every region's flush/compact —
+        # including compact_sync's in-worker inline pass — under it
+        with tracing.child_span("compaction.fanout",
+                                _parent=_trace_parent):
+            return fn(item)
 
     # -- observability --------------------------------------------------
     def update_read_amp(self, regions) -> int:

@@ -180,3 +180,217 @@ class ModuleSummary:
         q = _callee_qualname(call, cls, self.classes,
                              set(self.funcs))
         return self.funcs.get(q) if q is not None else None
+
+
+# -- contextvar-read taint (GT027) -------------------------------------
+#
+# Request state in this codebase travels in contextvars; a function
+# handed to a pool/Thread runs with EMPTY context, so any transitive
+# read of one of these families silently sees "no deadline" / "no
+# trace" / "no stats sink" instead of the submitting request's state.
+# The tables below name the module-facade readers per family; a read
+# with an explicit parent (`child_span(..., _parent=x)`) is a REBIND,
+# not a read -- that is exactly the hand-fix engine.open_region and
+# dist_query ship.
+
+# (module alias, function) -> family; aliases are matched on the last
+# two dotted segments with leading underscores stripped, so
+# `tracing.span`, `_deadline.check` and `sessions.current_since` all
+# resolve regardless of import spelling
+CTXVAR_READERS: dict[tuple[str, str], str] = {
+    ("deadline", "current"): "deadline",
+    ("deadline", "remaining"): "deadline",
+    ("deadline", "call_timeout"): "deadline",
+    ("deadline", "check"): "deadline",
+    ("cancellation", "checkpoint"): "deadline",
+    ("tracing", "span"): "tracing",
+    ("tracing", "child_span"): "tracing",
+    ("tracing", "event_span"): "tracing",
+    ("tracing", "current_span"): "tracing",
+    ("tracing", "current_trace_id"): "tracing",
+    ("tracing", "traceparent"): "tracing",
+    ("tracing", "set_attr"): "tracing",
+    ("tracing", "mark_keep"): "tracing",
+    ("stats", "add"): "stats",
+    ("stats", "note"): "stats",
+    ("stats", "timed"): "stats",
+    ("stats", "active"): "stats",
+    ("stmt_stats", "add"): "stmt_stats",
+    ("stmt_stats", "note"): "stmt_stats",
+    ("stmt_stats", "active"): "stmt_stats",
+    ("stmt_stats", "note_program"): "stmt_stats",
+    ("stmt_stats", "note_exec_path"): "stmt_stats",
+    ("sessions", "current_since"): "since",
+}
+
+# bare-name readers for `from ... import X` spellings; only names
+# unambiguous enough to never collide with local helpers
+CTXVAR_BARE_READERS: dict[str, str] = {
+    "checkpoint": "deadline",
+    "child_span": "tracing",
+    "event_span": "tracing",
+    "current_span": "tracing",
+    "current_trace_id": "tracing",
+    "traceparent": "tracing",
+    "current_since": "since",
+}
+
+# calls that REBIND a family for the code under them (context managers
+# or setters); "*" = rebinds everything (contextvars.copy_context)
+CTXVAR_BINDERS: dict[tuple[str, str], str] = {
+    ("deadline", "bind"): "deadline",
+    ("sessions", "bind_since"): "since",
+    ("tracing", "start_remote"): "tracing",
+    ("stats", "collect"): "stats",
+    ("stmt_stats", "observe"): "stmt_stats",
+}
+
+# readers that accept an explicit parent kwarg: passing a non-None
+# `_parent`/`parent` turns the call from a read into a rebind
+_PARENTED_READERS = {"span", "child_span"}
+
+
+def _reader_key(call: ast.Call) -> tuple[str, str] | None:
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return (f.value.id.lstrip("_"), f.attr)
+    return None
+
+
+def _has_explicit_parent(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg in ("_parent", "parent"):
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    return False
+
+
+@dataclasses.dataclass
+class CtxFuncInfo:
+    key: tuple[str, int]            # (name, def lineno)
+    node: ast.AST
+    # family -> witness chain down to the leaf read
+    reads: dict = dataclasses.field(default_factory=dict)
+    binds: set = dataclasses.field(default_factory=set)
+    calls: list = dataclasses.field(default_factory=list)
+    eff: dict = dataclasses.field(default_factory=dict)
+
+
+class CtxVarSummary:
+    """Per-def contextvar-read taint over ALL defs in the module
+    (nested closures included -- they are exactly what gets handed to
+    pools), with module-local call edges resolved to the nearest
+    preceding def of the callee's bare name."""
+
+    def __init__(self, tree: ast.Module):
+        self.defs: dict[tuple[str, int], CtxFuncInfo] = {}
+        self._by_name: dict[str, list[int]] = {}
+        # module-level ContextVar names: reads/sets on them are their
+        # own per-variable family
+        self.local_cvars: set[str] = set()
+        for s in tree.body:
+            if (isinstance(s, ast.Assign) and len(s.targets) == 1
+                    and isinstance(s.targets[0], ast.Name)
+                    and isinstance(s.value, ast.Call)):
+                d = dotted_name(s.value.func) or ""
+                if d.rsplit(".", 1)[-1] == "ContextVar":
+                    self.local_cvars.add(s.targets[0].id)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = (node.name, node.lineno)
+                self.defs[key] = self._summarize(key, node)
+                self._by_name.setdefault(node.name, []).append(
+                    node.lineno)
+        for lns in self._by_name.values():
+            lns.sort()
+        self._propagate()
+
+    def _summarize(self, key, func) -> CtxFuncInfo:
+        info = CtxFuncInfo(key=key, node=func)
+        for call in ModuleSummary._own_calls(func):
+            d = dotted_name(call.func) or ""
+            if "copy_context" in d:
+                info.binds.add("*")
+                continue
+            rk = _reader_key(call)
+            if rk is not None:
+                if rk in CTXVAR_BINDERS:
+                    info.binds.add(CTXVAR_BINDERS[rk])
+                    continue
+                fam = CTXVAR_READERS.get(rk)
+                if fam is not None:
+                    if (rk[1] in _PARENTED_READERS
+                            and _has_explicit_parent(call)):
+                        # explicit parent = rebind for the body
+                        info.binds.add(fam)
+                    else:
+                        info.reads.setdefault(fam, [
+                            f"{rk[0]}.{rk[1]} (line {call.lineno})"])
+                    continue
+                # module-level ContextVar accessed directly
+                recv = call.func.value.id
+                if recv in self.local_cvars:
+                    if call.func.attr == "get":
+                        info.reads.setdefault(f"ctxvar {recv}", [
+                            f"{recv}.get (line {call.lineno})"])
+                    elif call.func.attr == "set":
+                        info.binds.add(f"ctxvar {recv}")
+                    continue
+            elif isinstance(call.func, ast.Name):
+                fam = CTXVAR_BARE_READERS.get(call.func.id)
+                if fam is not None:
+                    if (call.func.id in _PARENTED_READERS
+                            and _has_explicit_parent(call)):
+                        info.binds.add(fam)
+                    else:
+                        info.reads.setdefault(fam, [
+                            f"{call.func.id} (line {call.lineno})"])
+                    continue
+            # call edge by bare name (module func, self/cls method, or
+            # nested def -- nearest preceding def wins)
+            name = None
+            if isinstance(call.func, ast.Name):
+                name = call.func.id
+            elif (isinstance(call.func, ast.Attribute)
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id in ("self", "cls")):
+                name = call.func.attr
+            if name is not None:
+                info.calls.append((name, call.lineno))
+        return info
+
+    def _resolve(self, name: str, use_line: int
+                 ) -> CtxFuncInfo | None:
+        lns = self._by_name.get(name)
+        if not lns:
+            return None
+        prior = [ln for ln in lns if ln <= use_line]
+        return self.defs[(name, prior[-1] if prior else lns[0])]
+
+    def _propagate(self):
+        for info in self.defs.values():
+            info.eff = {f: c for f, c in info.reads.items()
+                        if "*" not in info.binds
+                        and f not in info.binds}
+        changed = True
+        while changed:
+            changed = False
+            for info in self.defs.values():
+                if "*" in info.binds:
+                    continue
+                for name, lineno in info.calls:
+                    callee = self._resolve(name, lineno)
+                    if callee is None or callee is info:
+                        continue
+                    for fam, chain in callee.eff.items():
+                        if fam in info.binds or fam in info.eff:
+                            continue
+                        info.eff[fam] = [
+                            f"{name} (line {lineno})"] + chain
+                        changed = True
+
+    # rule-facing: the families `name` (a def visible at use_line)
+    # transitively reads without rebinding, with witness chains
+    def effective_reads(self, name: str, use_line: int) -> dict | None:
+        info = self._resolve(name, use_line)
+        return dict(info.eff) if info is not None else None

@@ -38,6 +38,10 @@ class Rule:
     id: str = ""
     name: str = ""
     description: str = ""
+    # minimal firing / clean snippets for `lint --explain` (validated
+    # by the explain meta-test: pos must fire, neg must stay silent)
+    example_pos: str = ""
+    example_neg: str = ""
 
 
 _REGISTRY: dict[str, Rule] = {}
@@ -52,8 +56,12 @@ def register(cls: type[Rule]) -> type[Rule]:
 
 
 def all_rules() -> dict[str, Rule]:
-    # rules live in rules.py; importing it populates the registry
+    # rules live in rules.py (AST rules) and rules_device.py (dataflow
+    # device-contract rules); importing them populates the registry
     from greptimedb_tpu.tools.lint import rules as _rules  # noqa: F401
+    from greptimedb_tpu.tools.lint import (  # noqa: F401
+        rules_device as _rules_device,
+    )
 
     return dict(sorted(_REGISTRY.items()))
 
@@ -240,6 +248,32 @@ class FileContext:
         from greptimedb_tpu.tools.lint.callgraph import ModuleSummary
 
         self.call_summary = ModuleSummary(tree)
+        # lazy heavyweight layers: built on first rule demand so files
+        # no dataflow rule cares about pay nothing
+        self._dataflow = None
+        self._ctxvars = None
+
+    def dataflow(self):
+        """Lazy per-file abstract interpretation (dataflow.py)."""
+        if self._dataflow is None:
+            from greptimedb_tpu.tools.lint.dataflow import FileAnalyses
+
+            self._dataflow = FileAnalyses(self.tree)
+        return self._dataflow
+
+    def dataflow_scope(self):
+        """ScopeAnalysis for the function being visited (module scope
+        when the walk is at top level)."""
+        fi = self.current_func
+        return self.dataflow().scope(fi.node if fi is not None else None)
+
+    def ctxvars(self):
+        """Lazy per-file contextvar-read taint (callgraph.py)."""
+        if self._ctxvars is None:
+            from greptimedb_tpu.tools.lint.callgraph import CtxVarSummary
+
+            self._ctxvars = CtxVarSummary(self.tree)
+        return self._ctxvars
 
     def _axis_names_in(self, node: ast.AST) -> set[str]:
         """Axis-name candidates inside a shard_map spec subtree: string

@@ -1,0 +1,1107 @@
+"""Intraprocedural abstract interpretation for the dataflow rules.
+
+One :class:`FileAnalyses` per linted file hands out per-scope
+:class:`ScopeAnalysis` objects on demand (rules only pay for scopes
+they ask about). Each scope gets a small CFG over its statement list
+and a worklist fixpoint over an abstract-value lattice:
+
+* ``AV`` values track what the rules need -- python ints/strs/tuples
+  with optional concrete payloads, array shape/dtype (each dimension
+  independently ``int`` or unknown ``None``), ``ShapeDtypeStruct``,
+  ``BlockSpec``, ``PartitionSpec``, VMEM scratch shapes -- and a
+  single TOP element for everything else.
+* The lattice has finite height (join degrades unequal payloads to
+  "unknown of the same kind", then to TOP), so loop re-entry widening
+  is just join; a per-block visit cap backstops pathological inputs.
+* Conservatism is the contract: rules must treat ``None``/TOP as
+  "no fact" and stay silent, so an unknown shape can never fire.
+
+Module scope is scanned linearly to seed constants (including
+``FOLD_BLOCKS`` imported from ``parallel.mesh`` -- the cross-path
+fold-block padding contract GT025 verifies).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+# Module constants the lattice knows even across files. FOLD_BLOCKS
+# is the greptimedb_tpu.parallel.mesh padding contract every device
+# twin relies on for bit-identity; a unit test pins this against the
+# real module so the model cannot drift.
+KNOWN_CONSTANTS = {"FOLD_BLOCKS": 8}
+
+_DTYPE_NAMES = frozenset({
+    "float64", "float32", "float16", "bfloat16",
+    "int64", "int32", "int16", "int8",
+    "uint64", "uint32", "uint16", "uint8",
+    "bool_", "complex64", "complex128",
+    "float8_e4m3fn", "float8_e5m2",
+})
+
+# -- abstract values ---------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AV:
+    """One lattice element.
+
+    kind:
+      top      -- no information
+      int/float/str/bool/none -- python scalar; ``value`` holds the
+                  concrete payload when known (None = known kind only)
+      tuple    -- ``value`` is a tuple of AVs, or None when the length
+                  is unknown
+      array    -- device/host ndarray: ``shape`` is a tuple of
+                  int-or-None dims (or None when even the rank is
+                  unknown), ``dtype`` a numpy-style name or None.
+                  ``weak`` marks values born from python scalars
+                  (JAX weak types: they do not widen the other side).
+      sds      -- jax.ShapeDtypeStruct (same shape/dtype payload)
+      blockspec-- pl.BlockSpec; shape holds the block dims (None entry
+                  = squeezed/unknown dim)
+      pspec    -- PartitionSpec; ``value`` is the axis tuple
+      dtype    -- a dtype object/name; ``value`` is the name
+      sem      -- pltpu semaphore scratch (0 VMEM bytes)
+      func     -- a locally-defined function object
+    """
+
+    kind: str = "top"
+    value: object = None
+    shape: tuple | None = None
+    dtype: str | None = None
+    weak: bool = False
+
+
+TOP = AV()
+NONE = AV(kind="none")
+
+
+def _join_dim(a, b):
+    return a if a == b else None
+
+
+def join_shape(a: tuple | None, b: tuple | None) -> tuple | None:
+    if a is None or b is None or len(a) != len(b):
+        return None
+    return tuple(_join_dim(x, y) for x, y in zip(a, b))
+
+
+def join(a: AV, b: AV) -> AV:
+    if a == b:
+        return a
+    if a.kind != b.kind:
+        return TOP
+    k = a.kind
+    if k in ("int", "float", "str", "bool", "dtype"):
+        if a.value == b.value:
+            return AV(kind=k, value=a.value)
+        return AV(kind=k)
+    if k == "tuple":
+        if (a.value is not None and b.value is not None
+                and len(a.value) == len(b.value)):
+            return AV(kind=k, value=tuple(
+                join(x, y) for x, y in zip(a.value, b.value)))
+        return AV(kind=k)
+    if k in ("array", "sds", "blockspec"):
+        return AV(kind=k,
+                  shape=join_shape(a.shape, b.shape),
+                  dtype=a.dtype if a.dtype == b.dtype else None,
+                  weak=a.weak and b.weak)
+    if k == "pspec":
+        return AV(kind=k) if a.value != b.value else a
+    if k in ("none", "sem"):
+        return a
+    return TOP
+
+
+def join_env(a: dict, b: dict) -> dict:
+    """Pointwise env join; a name bound on only one path is TOP (it
+    may be unbound or hold an unknown prior value on the other)."""
+    out = {}
+    for name in set(a) | set(b):
+        va, vb = a.get(name), b.get(name)
+        out[name] = TOP if va is None or vb is None else join(va, vb)
+    return out
+
+
+# -- dtype promotion ---------------------------------------------------
+
+_FLOATS = ("bfloat16", "float16", "float32", "float64")
+_INTS = ("bool_", "int8", "uint8", "int16", "uint16",
+         "int32", "uint32", "int64", "uint64")
+
+
+def _rank(name: str, order) -> int:
+    try:
+        return order.index(name)
+    except ValueError:
+        return -1
+
+
+def promote(a: str | None, b: str | None,
+            a_weak: bool = False, b_weak: bool = False) -> str | None:
+    """JAX-style binary dtype promotion (the subset the repo uses).
+
+    Weak operands (python scalars) adopt the other side's dtype
+    instead of widening it; bf16+f16 promotes to f32; int+float takes
+    the float side. Returns None when either side is unknown."""
+    if a is None or b is None:
+        return None
+    if a == b:
+        return a
+    if a_weak and not b_weak:
+        # weak float against an int array still promotes to float
+        if a in _FLOATS and b in _INTS:
+            return "float32" if b != "float64" else "float64"
+        return b
+    if b_weak and not a_weak:
+        if b in _FLOATS and a in _INTS:
+            return "float32" if a != "float64" else "float64"
+        return a
+    a_f, b_f = a in _FLOATS, b in _FLOATS
+    if a_f and b_f:
+        if {a, b} == {"bfloat16", "float16"}:
+            return "float32"
+        return a if _rank(a, _FLOATS) >= _rank(b, _FLOATS) else b
+    if a_f != b_f:  # int x float -> the float side
+        return a if a_f else b
+    ra, rb = _rank(a, _INTS), _rank(b, _INTS)
+    if ra < 0 or rb < 0:
+        return None
+    return a if ra >= rb else b
+
+
+# -- helpers -----------------------------------------------------------
+
+
+def dotted(node) -> str | None:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _dtype_of(av: AV) -> str | None:
+    """Interpret an AV used in a dtype= position."""
+    if av.kind == "dtype":
+        return av.value
+    if av.kind == "str" and av.value in _DTYPE_NAMES:
+        return str(av.value).rstrip("_") if av.value == "bool_" else av.value
+    return None
+
+
+def _as_shape(av: AV) -> tuple | None:
+    """Interpret an AV used in a shape position: int -> (n,), tuple of
+    ints/Nones -> dims. None = unknown."""
+    if av.kind == "int":
+        return (av.value,) if isinstance(av.value, int) else (None,)
+    if av.kind == "tuple" and av.value is not None:
+        dims = []
+        for el in av.value:
+            if el.kind == "int" and isinstance(el.value, int):
+                dims.append(el.value)
+            elif el.kind == "none":
+                dims.append(None)
+            else:
+                dims.append(None)
+        return tuple(dims)
+    return None
+
+
+def _broadcast(a: tuple | None, b: tuple | None) -> tuple | None:
+    if a is None or b is None:
+        return None
+    if len(a) < len(b):
+        a = (1,) * (len(b) - len(a)) + a
+    elif len(b) < len(a):
+        b = (1,) * (len(a) - len(b)) + b
+    out = []
+    for x, y in zip(a, b):
+        if x == 1:
+            out.append(y)
+        elif y == 1 or x == y:
+            out.append(x)
+        elif x is None or y is None:
+            out.append(None)
+        else:  # static mismatch -- not this analysis's error to report
+            out.append(None)
+    return tuple(out)
+
+
+def _assigned_names(nodes) -> set:
+    """Names (re)bound anywhere inside the given statements."""
+    out = set()
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)):
+                out.add(sub.id)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                out.add(sub.name)
+            elif isinstance(sub, ast.ExceptHandler) and sub.name:
+                out.add(sub.name)
+    return out
+
+
+# -- CFG ---------------------------------------------------------------
+
+# block events: ("stmt", node) | ("eval", expr) |
+#               ("bind_iter", target, iter_expr) | ("degrade", names)
+
+
+class _CFG:
+    def __init__(self):
+        self.blocks: list[list] = []
+        self.succ: list[list[int]] = []
+        self.entry = self._new()
+
+    def _new(self) -> int:
+        self.blocks.append([])
+        self.succ.append([])
+        return len(self.blocks) - 1
+
+    def _edge(self, a: int, b: int):
+        if b not in self.succ[a]:
+            self.succ[a].append(b)
+
+    def build(self, body) -> None:
+        end = self._seq(body, self.entry, [])
+        self.exit_blocks = [i for i in range(len(self.blocks))
+                            if not self.succ[i]]
+        del end
+
+    def _seq(self, stmts, cur, loops):
+        for s in stmts:
+            if cur is None:  # unreachable tail: park it in a fresh
+                cur = self._new()  # block with no predecessors
+            cur = self._stmt(s, cur, loops)
+        return cur
+
+    def _stmt(self, s, cur, loops):
+        if isinstance(s, ast.If):
+            self.blocks[cur].append(("eval", s.test))
+            then_b = self._new()
+            self._edge(cur, then_b)
+            end_then = self._seq(s.body, then_b, loops)
+            join_b = self._new()
+            if s.orelse:
+                else_b = self._new()
+                self._edge(cur, else_b)
+                end_else = self._seq(s.orelse, else_b, loops)
+                if end_else is not None:
+                    self._edge(end_else, join_b)
+            else:
+                self._edge(cur, join_b)
+            if end_then is not None:
+                self._edge(end_then, join_b)
+            return join_b
+        if isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+            header = self._new()
+            if isinstance(s, ast.While):
+                self.blocks[header].append(("eval", s.test))
+            else:
+                self.blocks[cur].append(("eval", s.iter))
+                self.blocks[header].append(
+                    ("bind_iter", s.target, s.iter))
+            self._edge(cur, header)
+            body_b = self._new()
+            exit_b = self._new()
+            self._edge(header, body_b)
+            self._edge(header, exit_b)
+            end = self._seq(s.body, body_b, loops + [(header, exit_b)])
+            if end is not None:
+                self._edge(end, header)
+            if s.orelse:
+                return self._seq(s.orelse, exit_b, loops)
+            return exit_b
+        if isinstance(s, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            body_b = self._new()
+            self._edge(cur, body_b)
+            end_body = self._seq(s.body, body_b, loops)
+            if s.orelse and end_body is not None:
+                end_body = self._seq(s.orelse, end_body, loops)
+            join_b = self._new()
+            if end_body is not None:
+                self._edge(end_body, join_b)
+            degraded = _assigned_names(s.body)
+            for h in s.handlers:
+                h_b = self._new()
+                self._edge(cur, h_b)
+                # an exception may interrupt the body anywhere: every
+                # name it assigns is unknown at handler entry
+                self.blocks[h_b].append(("degrade", degraded))
+                if h.name:
+                    self.blocks[h_b].append(("degrade", {h.name}))
+                end_h = self._seq(h.body, h_b, loops)
+                if end_h is not None:
+                    self._edge(end_h, join_b)
+            if s.finalbody:
+                return self._seq(s.finalbody, join_b, loops)
+            return join_b
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self.blocks[cur].append(("eval", item.context_expr))
+                if item.optional_vars is not None:
+                    names = {sub.id for sub in
+                             ast.walk(item.optional_vars)
+                             if isinstance(sub, ast.Name)}
+                    self.blocks[cur].append(("degrade", names))
+            return self._seq(s.body, cur, loops)
+        if isinstance(s, (ast.Return, ast.Raise)):
+            self.blocks[cur].append(("stmt", s))
+            return None
+        if isinstance(s, ast.Break):
+            if loops:
+                self._edge(cur, loops[-1][1])
+            return None
+        if isinstance(s, ast.Continue):
+            if loops:
+                self._edge(cur, loops[-1][0])
+            return None
+        if isinstance(s, ast.Match):
+            self.blocks[cur].append(("eval", s.subject))
+            join_b = self._new()
+            for case in s.cases:
+                c_b = self._new()
+                self._edge(cur, c_b)
+                self.blocks[c_b].append(
+                    ("degrade", _assigned_names([case.pattern])))
+                end_c = self._seq(case.body, c_b, loops)
+                if end_c is not None:
+                    self._edge(end_c, join_b)
+            self._edge(cur, join_b)  # no case may match
+            return join_b
+        # simple statement (incl. nested def/class, assignments, ...)
+        self.blocks[cur].append(("stmt", s))
+        return cur
+
+
+# -- the interpreter ---------------------------------------------------
+
+_MAX_VISITS = 50  # per-block fixpoint backstop; join makes real code
+                  # converge in 2-3 passes
+
+
+class ScopeAnalysis:
+    """Fixpoint analysis of one function (or the module body).
+
+    ``value(node)`` returns the AV recorded for any expression node in
+    the scope after convergence (TOP when the node was unreachable or
+    never evaluated)."""
+
+    def __init__(self, body, module_env: dict, args: ast.arguments | None):
+        self.values: dict[int, AV] = {}
+        self._cfg = _CFG()
+        self._cfg.build(body)
+        entry = dict(module_env)
+        if args is not None:
+            for a in (list(args.posonlyargs) + list(args.args)
+                      + list(args.kwonlyargs)):
+                entry[a.arg] = TOP
+            if args.vararg:
+                entry[args.vararg.arg] = TOP
+            if args.kwarg:
+                entry[args.kwarg.arg] = TOP
+        self._solve(entry)
+
+    def value(self, node) -> AV:
+        return self.values.get(id(node), TOP)
+
+    # fixpoint ---------------------------------------------------------
+
+    def _solve(self, entry_env: dict):
+        cfg = self._cfg
+        n = len(cfg.blocks)
+        in_env: list[dict | None] = [None] * n
+        in_env[cfg.entry] = entry_env
+        visits = [0] * n
+        work = [cfg.entry]
+        preds: list[list[int]] = [[] for _ in range(n)]
+        for a in range(n):
+            for b in cfg.succ[a]:
+                preds[b].append(a)
+        out_env: list[dict | None] = [None] * n
+        while work:
+            b = work.pop()
+            env = in_env[b]
+            if env is None:
+                continue
+            visits[b] += 1
+            if visits[b] > _MAX_VISITS:
+                env = {k: TOP for k in env}
+            out = self._transfer(b, dict(env), record=False)
+            if out_env[b] is not None and out == out_env[b]:
+                continue
+            out_env[b] = out
+            for s in cfg.succ[b]:
+                merged = out if in_env[s] is None else join_env(
+                    in_env[s], out)
+                if in_env[s] is None or merged != in_env[s]:
+                    in_env[s] = merged
+                    if s not in work:
+                        work.append(s)
+        # recording pass over the converged envs
+        for b in range(n):
+            if in_env[b] is not None:
+                self._transfer(b, dict(in_env[b]), record=True)
+
+    # transfer ---------------------------------------------------------
+
+    def _transfer(self, block: int, env: dict, record: bool) -> dict:
+        for ev in self._cfg.blocks[block]:
+            tag = ev[0]
+            if tag == "eval":
+                self._eval(ev[1], env, record)
+            elif tag == "bind_iter":
+                self._bind_iter(ev[1], ev[2], env, record)
+            elif tag == "degrade":
+                for name in ev[1]:
+                    env[name] = TOP
+            else:
+                self._exec(ev[1], env, record)
+        return env
+
+    def _exec(self, s, env, record):
+        if isinstance(s, ast.Assign):
+            v = self._eval(s.value, env, record)
+            for t in s.targets:
+                self._assign(t, v, env)
+        elif isinstance(s, ast.AnnAssign):
+            v = (self._eval(s.value, env, record)
+                 if s.value is not None else TOP)
+            self._assign(s.target, v, env)
+        elif isinstance(s, ast.AugAssign):
+            # model x += y as x = x <op> y
+            self._eval(s.value, env, record)
+            if isinstance(s.target, ast.Name):
+                cur = env.get(s.target.id, TOP)
+                rhs = self._eval(s.value, env, False)
+                env[s.target.id] = self._binop(
+                    type(s.op), cur, rhs)
+        elif isinstance(s, (ast.Expr, ast.Return)):
+            if getattr(s, "value", None) is not None:
+                self._eval(s.value, env, record)
+        elif isinstance(s, ast.Raise):
+            if s.exc is not None:
+                self._eval(s.exc, env, record)
+        elif isinstance(s, ast.Assert):
+            self._eval(s.test, env, record)
+        elif isinstance(s, ast.ImportFrom):
+            mod = (s.module or "").rsplit(".", 1)[-1]
+            for alias in s.names:
+                name = alias.asname or alias.name
+                if alias.name in KNOWN_CONSTANTS and mod == "mesh":
+                    env[name] = AV(kind="int",
+                                   value=KNOWN_CONSTANTS[alias.name])
+                else:
+                    env[name] = TOP
+        elif isinstance(s, ast.Import):
+            for alias in s.names:
+                env[(alias.asname or alias.name).split(".")[0]] = TOP
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            env[s.name] = AV(kind="func", value=s.name)
+        elif isinstance(s, ast.ClassDef):
+            env[s.name] = TOP
+        elif isinstance(s, ast.Delete):
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    env[t.id] = TOP
+        # Pass/Global/Nonlocal/etc: no effect
+
+    def _assign(self, target, v: AV, env: dict):
+        if isinstance(target, ast.Name):
+            env[target.id] = v
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            els = (v.value if v.kind == "tuple" and v.value is not None
+                   and len(v.value) == len(target.elts) else None)
+            for i, t in enumerate(target.elts):
+                if isinstance(t, ast.Starred):
+                    self._assign(t.value, AV(kind="tuple"), env)
+                else:
+                    self._assign(t, els[i] if els else TOP, env)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, TOP, env)
+        # subscript/attribute stores: no tracked effect
+
+    def _bind_iter(self, target, iter_expr, env, record):
+        it = self._eval(iter_expr, env, False)
+        el = TOP
+        if it.kind == "tuple" and it.value:
+            el = it.value[0]
+            for x in it.value[1:]:
+                el = join(el, x)
+        elif it.kind == "array" and it.shape is not None and it.shape:
+            el = AV(kind="array", shape=tuple(it.shape[1:]),
+                    dtype=it.dtype)
+        elif it.kind == "int":  # range() modelled as int stream
+            el = AV(kind="int")
+        self._assign(target, el, env)
+
+    # expressions ------------------------------------------------------
+
+    def _eval(self, node, env, record) -> AV:
+        v = self._eval_inner(node, env, record)
+        if record:
+            self.values[id(node)] = v
+        return v
+
+    def _eval_inner(self, node, env, record) -> AV:
+        if isinstance(node, ast.Constant):
+            c = node.value
+            if isinstance(c, bool):
+                return AV(kind="bool", value=c)
+            if isinstance(c, int):
+                return AV(kind="int", value=c)
+            if isinstance(c, float):
+                return AV(kind="float", value=c)
+            if isinstance(c, str):
+                return AV(kind="str", value=c)
+            if c is None:
+                return NONE
+            return TOP
+        if isinstance(node, ast.Name):
+            return env.get(node.id, TOP)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            els = []
+            star = False
+            for e in node.elts:
+                if isinstance(e, ast.Starred):
+                    self._eval(e.value, env, record)
+                    star = True
+                else:
+                    els.append(self._eval(e, env, record))
+            return AV(kind="tuple",
+                      value=None if star else tuple(els))
+        if isinstance(node, ast.Attribute):
+            return self._attribute(node, env, record)
+        if isinstance(node, ast.Call):
+            return self._call(node, env, record)
+        if isinstance(node, ast.BinOp):
+            left = self._eval(node.left, env, record)
+            right = self._eval(node.right, env, record)
+            return self._binop(type(node.op), left, right)
+        if isinstance(node, ast.UnaryOp):
+            v = self._eval(node.operand, env, record)
+            if isinstance(node.op, ast.USub) and v.kind == "int" \
+                    and isinstance(v.value, int):
+                return AV(kind="int", value=-v.value)
+            if isinstance(node.op, ast.Not):
+                return AV(kind="bool")
+            return v if v.kind in ("int", "float", "array") else TOP
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node, env, record)
+        if isinstance(node, ast.Compare):
+            self._eval(node.left, env, record)
+            for c in node.comparators:
+                self._eval(c, env, record)
+            return AV(kind="bool")
+        if isinstance(node, ast.BoolOp):
+            vals = [self._eval(v, env, record) for v in node.values]
+            out = vals[0]
+            for v in vals[1:]:
+                out = join(out, v)
+            return out
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, env, record)
+            a = self._eval(node.body, env, record)
+            b = self._eval(node.orelse, env, record)
+            return join(a, b)
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self._eval(v.value, env, record)
+            return AV(kind="str")
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            # comprehension scopes are opaque; evaluate iterables for
+            # recording, result length unknown
+            for gen in node.generators:
+                self._eval(gen.iter, env, record)
+            return (AV(kind="tuple")
+                    if isinstance(node, (ast.ListComp, ast.GeneratorExp))
+                    else TOP)
+        if isinstance(node, ast.Starred):
+            self._eval(node.value, env, record)
+            return TOP
+        if isinstance(node, ast.Lambda):
+            return AV(kind="func")
+        if isinstance(node, ast.NamedExpr):
+            v = self._eval(node.value, env, record)
+            self._assign(node.target, v, env)
+            return v
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if k is not None:
+                    self._eval(k, env, record)
+            for v in node.values:
+                self._eval(v, env, record)
+            return TOP
+        if isinstance(node, (ast.Await, ast.YieldFrom)):
+            self._eval(node.value, env, record)
+            return TOP
+        if isinstance(node, ast.Yield):
+            if node.value is not None:
+                self._eval(node.value, env, record)
+            return TOP
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self._eval(part, env, record)
+            return AV(kind="slice")
+        return TOP
+
+    def _binop(self, op, left: AV, right: AV) -> AV:
+        if left.kind == "int" and right.kind == "int":
+            if isinstance(left.value, int) and isinstance(
+                    right.value, int):
+                try:
+                    v = {
+                        ast.Add: lambda a, b: a + b,
+                        ast.Sub: lambda a, b: a - b,
+                        ast.Mult: lambda a, b: a * b,
+                        ast.FloorDiv: lambda a, b: a // b,
+                        ast.Mod: lambda a, b: a % b,
+                        ast.Pow: lambda a, b: a ** b
+                        if abs(b) < 64 else None,
+                        ast.LShift: lambda a, b: a << b
+                        if 0 <= b < 256 else None,
+                        ast.RShift: lambda a, b: a >> b
+                        if 0 <= b < 256 else None,
+                        ast.BitAnd: lambda a, b: a & b,
+                        ast.BitOr: lambda a, b: a | b,
+                        ast.BitXor: lambda a, b: a ^ b,
+                    }.get(op, lambda a, b: None)(left.value, right.value)
+                except (ZeroDivisionError, OverflowError, ValueError):
+                    v = None
+                if op is ast.Div:
+                    return AV(kind="float")
+                return AV(kind="int", value=v)
+            return AV(kind="float" if op is ast.Div else "int")
+        if left.kind == "tuple" and right.kind == "tuple" \
+                and op is ast.Add:
+            if left.value is not None and right.value is not None:
+                return AV(kind="tuple", value=left.value + right.value)
+            return AV(kind="tuple")
+        if op is ast.Mult and {left.kind, right.kind} == {"tuple", "int"}:
+            tup, n = (left, right) if left.kind == "tuple" else (right,
+                                                                 left)
+            if tup.value is not None and isinstance(n.value, int) \
+                    and 0 <= n.value <= 64:
+                return AV(kind="tuple", value=tup.value * n.value)
+            return AV(kind="tuple")
+        kinds = {left.kind, right.kind}
+        if "array" in kinds and kinds <= {"array", "int", "float",
+                                          "bool"}:
+            la = left if left.kind == "array" else _scalar_array(left)
+            ra = right if right.kind == "array" else _scalar_array(right)
+            dt = promote(la.dtype, ra.dtype, la.weak, ra.weak)
+            if op is ast.Div and dt in ("int8", "int16", "int32",
+                                        "int64", "uint8", "uint16",
+                                        "uint32", "uint64"):
+                dt = "float32"
+            return AV(kind="array",
+                      shape=_broadcast(la.shape, ra.shape),
+                      dtype=dt, weak=la.weak and ra.weak)
+        if kinds <= {"int", "float"}:
+            return AV(kind="float")
+        if kinds == {"str"} and op is ast.Add:
+            return AV(kind="str")
+        return TOP
+
+    def _subscript(self, node, env, record) -> AV:
+        base = self._eval(node.value, env, record)
+        idx = self._eval(node.slice, env, record)
+        if base.kind == "tuple" and base.value is not None:
+            if idx.kind == "int" and isinstance(idx.value, int):
+                if -len(base.value) <= idx.value < len(base.value):
+                    return base.value[idx.value]
+                return TOP
+            if isinstance(node.slice, ast.Slice):
+                lo = node.slice.lower
+                hi = node.slice.upper
+                if node.slice.step is None:
+                    lo_v = (lo.value if isinstance(lo, ast.Constant)
+                            and isinstance(lo.value, int) else
+                            0 if lo is None else None)
+                    hi_v = (hi.value if isinstance(hi, ast.Constant)
+                            and isinstance(hi.value, int) else
+                            len(base.value) if hi is None else None)
+                    if lo_v is not None and hi_v is not None:
+                        return AV(kind="tuple",
+                                  value=base.value[lo_v:hi_v])
+            return AV(kind="tuple")
+        if base.kind == "array":
+            shape = base.shape
+            if shape is not None and shape:
+                if idx.kind == "int":
+                    return AV(kind="array", shape=tuple(shape[1:]),
+                              dtype=base.dtype, weak=base.weak)
+                if isinstance(node.slice, ast.Slice):
+                    return AV(kind="array",
+                              shape=(None,) + tuple(shape[1:]),
+                              dtype=base.dtype, weak=base.weak)
+            # unknown indexing keeps the dtype fact (accumulators)
+            return AV(kind="array", dtype=base.dtype, weak=base.weak)
+        return TOP
+
+    def _attribute(self, node, env, record) -> AV:
+        base = self._eval(node.value, env, record)
+        attr = node.attr
+        if attr in _DTYPE_NAMES and base.kind == "top":
+            # jnp.float32 / np.int64 on an (untracked) module alias
+            return AV(kind="dtype",
+                      value="bool" if attr == "bool_" else attr)
+        if base.kind in ("array", "sds"):
+            if attr == "shape":
+                if base.shape is None:
+                    return AV(kind="tuple")
+                return AV(kind="tuple", value=tuple(
+                    AV(kind="int", value=dd) if dd is not None
+                    else AV(kind="int") for dd in base.shape))
+            if attr == "dtype":
+                return (AV(kind="dtype", value=base.dtype)
+                        if base.dtype else AV(kind="dtype"))
+            if attr == "ndim":
+                return (AV(kind="int", value=len(base.shape))
+                        if base.shape is not None else AV(kind="int"))
+            if attr == "size":
+                if base.shape is not None and all(
+                        dd is not None for dd in base.shape):
+                    n = 1
+                    for dd in base.shape:
+                        n *= dd
+                    return AV(kind="int", value=n)
+                return AV(kind="int")
+            if attr == "T":
+                return AV(kind="array",
+                          shape=(tuple(reversed(base.shape))
+                                 if base.shape is not None else None),
+                          dtype=base.dtype)
+        if base.kind == "dtype" and attr == "itemsize":
+            from . import device_model
+            size = device_model.itemsize(base.value)
+            return AV(kind="int", value=size)
+        return TOP
+
+    # calls ------------------------------------------------------------
+
+    def _call(self, node, env, record) -> AV:
+        args = [self._eval(a, env, record) for a in node.args
+                if not isinstance(a, ast.Starred)]
+        for a in node.args:
+            if isinstance(a, ast.Starred):
+                self._eval(a.value, env, record)
+        kw = {}
+        for k in node.keywords:
+            v = self._eval(k.value, env, record)
+            if k.arg is not None:
+                kw[k.arg] = v
+        d = dotted(node.func) or ""
+        if isinstance(node.func, ast.Attribute):
+            # evaluate the receiver for method calls (records x in
+            # x.reshape(...)); dotted-name bases double-evaluate
+            # harmlessly
+            base = self._eval(node.func.value, env, record)
+        else:
+            base = None
+            if not isinstance(node.func, ast.Name):
+                # curried calls -- pl.pallas_call(...)(x): the inner
+                # call only appears as .func, so record it here
+                self._eval(node.func, env, record)
+        short = d.rsplit(".", 1)[-1] if d else ""
+
+        def kw_dtype(default=None, pos=None):
+            if "dtype" in kw:
+                return _dtype_of(kw["dtype"]) or None
+            if pos is not None and len(args) > pos:
+                got = _dtype_of(args[pos])
+                if got is not None:
+                    return got
+            return default
+
+        if short in ("zeros", "ones", "empty", "full"):
+            shape = _as_shape(args[0]) if args else None
+            dt = kw_dtype("float32", pos=2 if short == "full" else 1)
+            if short == "full" and dt is None:
+                dt = "float32"
+            return AV(kind="array", shape=shape, dtype=dt)
+        if short in ("zeros_like", "ones_like", "empty_like",
+                     "full_like"):
+            src = args[0] if args else TOP
+            return AV(kind="array",
+                      shape=src.shape if src.kind in ("array", "sds")
+                      else None,
+                      dtype=kw_dtype(src.dtype if src.kind in
+                                     ("array", "sds") else None))
+        if short in ("asarray", "array"):
+            src = args[0] if args else TOP
+            shape = src.shape if src.kind in ("array", "sds") else None
+            if src.kind == "tuple" and src.value is not None and all(
+                    e.kind in ("int", "float") for e in src.value):
+                shape = (len(src.value),)
+            dt = kw_dtype(src.dtype if src.kind in ("array", "sds")
+                          else None)
+            return AV(kind="array", shape=shape, dtype=dt)
+        if short == "arange":
+            n = None
+            if len(args) == 1 and args[0].kind == "int" and isinstance(
+                    args[0].value, int):
+                n = args[0].value
+            return AV(kind="array",
+                      shape=(n,) if n is not None else (None,),
+                      dtype=kw_dtype("int32"))
+        if short == "reshape":
+            if base is not None and base.kind in ("array", "sds"):
+                src = base  # x.reshape(...)
+                dims_args = args
+            elif args and args[0].kind in ("array", "sds"):
+                src = args[0]  # jnp.reshape(x, shape)
+                dims_args = args[1:]
+            else:
+                return TOP
+            new = self._reshape_dims(dims_args, src)
+            return AV(kind="array", shape=new, dtype=src.dtype,
+                      weak=src.weak)
+        if short == "astype" and base is not None:
+            dt = _dtype_of(args[0]) if args else None
+            return AV(kind="array",
+                      shape=base.shape if base.kind in ("array", "sds")
+                      else None, dtype=dt)
+        if short == "ShapeDtypeStruct":
+            shape = _as_shape(kw.get("shape", args[0] if args else TOP))
+            dtv = kw.get("dtype", args[1] if len(args) > 1 else TOP)
+            return AV(kind="sds", shape=shape, dtype=_dtype_of(dtv))
+        if short == "BlockSpec":
+            shape_av = kw.get("block_shape",
+                              args[0] if args else None)
+            shape = _as_shape(shape_av) if shape_av is not None else None
+            return AV(kind="blockspec", shape=shape)
+        if short in ("PrefetchScalarGridSpec", "GridSpec"):
+            # carry the parts so a grid_spec built in a local still
+            # reaches the pallas_call geometry; pairs keep AV hashable
+            return AV(kind="gridspec", value=tuple(
+                (k, v) for k, v in sorted(kw.items())))
+        if short in ("PartitionSpec", "P"):
+            return AV(kind="pspec", value=tuple(
+                a.value if a.kind in ("str", "none") else None
+                for a in args))
+        if short == "VMEM":
+            shape = _as_shape(args[0]) if args else None
+            dt = _dtype_of(args[1]) if len(args) > 1 else None
+            return AV(kind="array", shape=shape, dtype=dt)
+        if "SemaphoreType" in d:
+            return AV(kind="sem")
+        if short in ("sum", "max", "min", "mean", "prod"):
+            src = base if base is not None and base.kind == "array" \
+                else (args[0] if args and args[0].kind == "array"
+                      else None)
+            if src is None:
+                return TOP
+            dt = src.dtype
+            if short == "mean" and dt in _INTS:
+                dt = "float32"
+            dt = kw_dtype(dt)
+            axis = kw.get("axis")
+            keep = kw.get("keepdims")
+            shape = None
+            if axis is None and "axis" not in kw:
+                shape = ()
+            elif (axis is not None and axis.kind == "int"
+                  and isinstance(axis.value, int)
+                  and src.shape is not None
+                  and (keep is None or keep.value is False)):
+                ax = axis.value
+                if -len(src.shape) <= ax < len(src.shape):
+                    lst = list(src.shape)
+                    del lst[ax]
+                    shape = tuple(lst)
+            return AV(kind="array", shape=shape, dtype=dt)
+        if short == "where" and len(args) >= 3:
+            a, b = args[1], args[2]
+            la = a if a.kind == "array" else _scalar_array(a)
+            rb = b if b.kind == "array" else _scalar_array(b)
+            return AV(kind="array",
+                      shape=_broadcast(la.shape, rb.shape),
+                      dtype=promote(la.dtype, rb.dtype, la.weak,
+                                    rb.weak))
+        if short == "broadcast_to" and len(args) >= 2:
+            src = args[0]
+            return AV(kind="array", shape=_as_shape(args[1]),
+                      dtype=src.dtype if src.kind in ("array", "sds")
+                      else None)
+        if short == "transpose":
+            src = base if base is not None and base.kind == "array" \
+                else (args[0] if args and args[0].kind == "array"
+                      else None)
+            if src is not None and len(args) <= (
+                    0 if src is base else 1):
+                return AV(kind="array",
+                          shape=(tuple(reversed(src.shape))
+                                 if src.shape is not None else None),
+                          dtype=src.dtype)
+            return TOP
+        if short in ("concatenate", "stack", "dot", "matmul",
+                     "einsum", "take", "gather"):
+            dts = [a.dtype for a in args if a.kind == "array"]
+            if args and args[0].kind == "tuple" and args[0].value:
+                dts += [e.dtype for e in args[0].value
+                        if e.kind == "array"]
+            dt = dts[0] if dts and all(x == dts[0] for x in dts) \
+                else None
+            return AV(kind="array", dtype=dt)
+        if short == "range":
+            if args and args[-1].kind == "int":
+                return AV(kind="int", value=None)
+            return AV(kind="int")
+        if short == "len":
+            src = args[0] if args else TOP
+            if src.kind == "tuple" and src.value is not None:
+                return AV(kind="int", value=len(src.value))
+            if src.kind in ("array", "sds") and src.shape:
+                return (AV(kind="int", value=src.shape[0])
+                        if src.shape[0] is not None else AV(kind="int"))
+            return AV(kind="int")
+        if short in ("int", "round"):
+            return AV(kind="int",
+                      value=args[0].value if args
+                      and args[0].kind == "int" else None)
+        if short == "float":
+            return AV(kind="float")
+        if short == "tuple" and args:
+            return args[0] if args[0].kind == "tuple" else AV(
+                kind="tuple")
+        if short == "dtype" and args:  # jnp.dtype("float32")
+            return AV(kind="dtype", value=_dtype_of(args[0]))
+        return TOP
+
+    def _reshape_dims(self, dims_args, src: AV) -> tuple | None:
+        if len(dims_args) == 1 and dims_args[0].kind == "tuple":
+            new = _as_shape(dims_args[0])
+        elif dims_args and all(a.kind == "int" for a in dims_args):
+            new = tuple(a.value if isinstance(a.value, int) else None
+                        for a in dims_args)
+        else:
+            return None
+        if new is None:
+            return None
+        if -1 in new:
+            if (src.shape is not None
+                    and all(dd is not None for dd in src.shape)
+                    and all(dd is not None for dd in new)):
+                total = 1
+                for dd in src.shape:
+                    total *= dd
+                rest = 1
+                for dd in new:
+                    if dd != -1:
+                        rest *= dd
+                if rest and total % rest == 0:
+                    return tuple(total // rest if dd == -1 else dd
+                                 for dd in new)
+            return tuple(None if dd == -1 else dd for dd in new)
+        return new
+
+
+def _scalar_array(av: AV) -> AV:
+    """A python scalar entering array arithmetic: weakly-typed 0-d."""
+    if av.kind == "int" or av.kind == "bool":
+        return AV(kind="array", shape=(), dtype="int32", weak=True)
+    if av.kind == "float":
+        return AV(kind="array", shape=(), dtype="float32", weak=True)
+    return AV(kind="array")
+
+
+# -- per-file entry point ----------------------------------------------
+
+
+@dataclass
+class FileAnalyses:
+    """Lazy per-scope analyses for one parsed file."""
+
+    tree: ast.Module
+    _scopes: dict = field(default_factory=dict)
+    _module_env: dict | None = None
+
+    def module_env(self) -> dict:
+        if self._module_env is None:
+            self._module_env = _scan_module(self.tree)
+        return self._module_env
+
+    def scope(self, func_node=None) -> ScopeAnalysis:
+        """Analysis for a def node (or the module body when None)."""
+        key = id(func_node) if func_node is not None else 0
+        hit = self._scopes.get(key)
+        if hit is None:
+            if func_node is None:
+                hit = ScopeAnalysis(self.tree.body, self.module_env(),
+                                    None)
+            else:
+                hit = ScopeAnalysis(func_node.body, self.module_env(),
+                                    func_node.args)
+            self._scopes[key] = hit
+        return hit
+
+
+def _scan_module(tree: ast.Module) -> dict:
+    """Linear scan of module-level constants: ints, strings, simple
+    tuples, and KNOWN_CONSTANTS imports (module-level control flow for
+    constants is rare enough to ignore)."""
+    env: dict[str, AV] = {}
+
+    def const_av(node) -> AV | None:
+        if isinstance(node, ast.Constant):
+            c = node.value
+            if isinstance(c, bool):
+                return AV(kind="bool", value=c)
+            if isinstance(c, int):
+                return AV(kind="int", value=c)
+            if isinstance(c, float):
+                return AV(kind="float", value=c)
+            if isinstance(c, str):
+                return AV(kind="str", value=c)
+            return None
+        if isinstance(node, ast.Tuple):
+            els = [const_av(e) for e in node.elts]
+            if all(e is not None for e in els):
+                return AV(kind="tuple", value=tuple(els))
+        if isinstance(node, ast.BinOp):
+            left, right = const_av(node.left), const_av(node.right)
+            if (left is not None and right is not None
+                    and left.kind == right.kind == "int"
+                    and isinstance(left.value, int)
+                    and isinstance(right.value, int)):
+                try:
+                    op = {ast.Add: int.__add__, ast.Sub: int.__sub__,
+                          ast.Mult: int.__mul__,
+                          ast.FloorDiv: int.__floordiv__}.get(
+                              type(node.op))
+                    if op is not None:
+                        return AV(kind="int",
+                                  value=op(left.value, right.value))
+                except (ZeroDivisionError, OverflowError):
+                    return None
+        return None
+
+    for s in tree.body:
+        if isinstance(s, ast.Assign) and len(s.targets) == 1 \
+                and isinstance(s.targets[0], ast.Name):
+            v = const_av(s.value)
+            env[s.targets[0].id] = v if v is not None else TOP
+        elif isinstance(s, ast.AnnAssign) and isinstance(
+                s.target, ast.Name) and s.value is not None:
+            v = const_av(s.value)
+            env[s.target.id] = v if v is not None else TOP
+        elif isinstance(s, ast.ImportFrom):
+            mod = (s.module or "").rsplit(".", 1)[-1]
+            for alias in s.names:
+                if alias.name in KNOWN_CONSTANTS and mod == "mesh":
+                    env[alias.asname or alias.name] = AV(
+                        kind="int", value=KNOWN_CONSTANTS[alias.name])
+    return env

@@ -1,0 +1,103 @@
+"""Static TPU device model for the dataflow lint rules (GT023-GT026).
+
+Constants describe the TPU v5e core the paper targets, sourced from the
+Pallas TPU programming guide (tiling and memory-space tables):
+
+* vector lanes: the LAST block dimension must be a multiple of 128
+  (one vector lane row) for every dtype;
+* sublanes: the SECOND-TO-LAST block dimension tiles by dtype width --
+  8 for 4-byte types (f32/i32), 16 for 2-byte types (bf16/f16/i16),
+  32 for 1-byte types (i8/fp8) -- packing narrower types two/four per
+  32-bit sublane word;
+* VMEM: ~16 MiB per core. Pallas double-buffers every *blocked* ref in
+  a pipelined grid, so a blocked operand costs two block buffers;
+* 64-bit dtypes (f64/i64/u64) do not exist on the device datapath:
+  refs reaching a kernel in a 64-bit dtype are a compile error under
+  Mosaic (and a silent x64-disabled downcast on host paths, GT009).
+
+These are *model* numbers for static verdicts, not measurements: the
+rules built on them only fire when the dataflow lattice has concrete
+facts, so an unknown shape/dtype can never produce a finding.
+"""
+
+from __future__ import annotations
+
+# one vector-lane row: required multiple for the last block dim
+LANE = 128
+
+# usable VMEM per v5e core (the guide's ~16 MiB figure); the compiler
+# reserves a slice, so rules compare against the full budget only --
+# anything over this is unconditionally overcommitted
+VMEM_BYTES = 16 * 1024 * 1024
+
+# dtype -> itemsize in bytes, for the dtypes the codebase touches
+ITEMSIZE = {
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "float16": 2, "bfloat16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1, "bool_": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+
+# itemsize -> sublane multiple (second-to-last block dim)
+_SUBLANE_BY_ITEMSIZE = {4: 8, 2: 16, 1: 32}
+
+# dtypes with no device representation: a ref in one of these reaching
+# a kernel cannot compile under Mosaic
+ILLEGAL_DEVICE_DTYPES = frozenset({"float64", "int64", "uint64"})
+
+# 64-bit result dtypes a promotion can silently produce (GT026)
+WIDE_DTYPES = frozenset({"float64", "int64", "uint64", "complex128"})
+
+
+def itemsize(dtype: str | None) -> int | None:
+    """Bytes per element, or None for an unknown dtype name."""
+    if dtype is None:
+        return None
+    return ITEMSIZE.get(dtype)
+
+
+def sublane(dtype: str | None) -> int | None:
+    """Required multiple for the second-to-last block dim, or None
+    when the dtype (hence packing) is unknown."""
+    size = itemsize(dtype)
+    if size is None:
+        return None
+    return _SUBLANE_BY_ITEMSIZE.get(size, 8)
+
+
+def tile_ok(dim: int, multiple: int) -> bool:
+    return dim % multiple == 0
+
+
+def buffer_bytes(shape, dtype: str | None) -> int | None:
+    """Static VMEM footprint of one buffer of ``shape``/``dtype``,
+    padded up to the (sublane, lane) tile the hardware allocates.
+    Returns None unless every dimension and the dtype are known."""
+    if shape is None or any(d is None for d in shape):
+        return None
+    size = itemsize(dtype)
+    if size is None:
+        return None
+    dims = [d for d in shape]
+    if dims:
+        dims[-1] = _round_up(max(dims[-1], 1), LANE)
+    if len(dims) >= 2:
+        sub = sublane(dtype) or 8
+        dims[-2] = _round_up(max(dims[-2], 1), sub)
+    n = 1
+    for d in dims:
+        n *= max(int(d), 1)
+    return n * size
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((int(n) + m - 1) // m) * m
+
+
+def fmt_bytes(n: int) -> str:
+    if n >= 1024 * 1024:
+        return f"{n / (1024 * 1024):.1f}MiB"
+    if n >= 1024:
+        return f"{n / 1024:.1f}KiB"
+    return f"{n}B"

@@ -1351,3 +1351,329 @@ class PallasCallHygiene(Rule):
                        f"make_async_remote_copy device_id references "
                        f"axis {axis!r} not bound by the enclosing "
                        f"shard_map (binds: {', '.join(shown)})")
+
+
+# ----------------------------------------------------------------------
+# --explain examples
+# ----------------------------------------------------------------------
+# Minimal firing / clean snippet pairs for `lint --explain GTxxx`,
+# attached here so each rule body above stays focused on detection
+# logic. The explain meta-test lints every pair under a per-rule
+# select: the positive snippet must fire exactly that rule, the
+# negative must stay silent.
+
+_EXAMPLES = {
+    "GT001": ('''\
+try:
+    x = 1
+except Exception:
+    pass
+''', '''\
+import logging
+try:
+    x = 1
+except Exception as e:
+    logging.getLogger("x").warning("boom: %s", e)
+'''),
+    "GT002": ('''\
+def classify(e):
+    return "unavailable" in str(e).lower()
+''', '''\
+def classify(e):
+    return isinstance(e, ConnectionError)
+'''),
+    "GT003": ('''\
+def f():
+    raise Exception("boom")
+''', '''\
+def f():
+    raise ValueError("bad arg")
+'''),
+    "GT004": ('''\
+import jax
+
+@jax.jit
+def f(x):
+    return x.item()
+''', '''\
+import numpy as np
+
+def f(x):
+    return float(x) + np.asarray(x).sum()
+'''),
+    "GT005": ('''\
+import jax
+
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+''', '''\
+import jax
+
+@jax.jit
+def f(x):
+    if x.ndim == 2:
+        x = x.sum(axis=1)
+    return x
+'''),
+    "GT006": ('''\
+import jax
+
+def step(fns, x):
+    for f in fns:
+        x = jax.jit(f)(x)
+    return x
+''', '''\
+import jax
+
+def _impl(x):
+    return x + 1
+
+fast = jax.jit(_impl)
+'''),
+    "GT007": ('''\
+import threading
+import urllib.request
+
+lock = threading.Lock()
+
+def f():
+    with lock:
+        urllib.request.urlopen("http://x", timeout=5.0)
+''', '''\
+import threading
+import urllib.request
+
+lock = threading.Lock()
+
+def f():
+    with lock:
+        snapshot = 1
+    urllib.request.urlopen("http://x", timeout=5.0)
+    return snapshot
+'''),
+    "GT008": ('''\
+import threading
+
+def fire(target):
+    threading.Thread(target=target).start()
+''', '''\
+import threading
+
+def ok(target):
+    t = threading.Thread(target=target)
+    t.start()
+    t.join()
+'''),
+    "GT009": ('''\
+import jax.numpy as jnp
+
+def f(x):
+    return jnp.asarray(x, jnp.int64)
+''', '''\
+import jax.numpy as jnp
+import numpy as np
+
+def f(x):
+    return np.asarray(x, np.int64), jnp.asarray(x, jnp.int32)
+'''),
+    "GT010": ('''\
+def public(a, xs=[]):
+    return xs
+''', '''\
+def public(a, xs=None, t=()):
+    return xs or t
+'''),
+    "GT011": ('''\
+import time
+
+def f(start):
+    return time.time() - start
+''', '''\
+import time
+
+def f(start):
+    return time.monotonic() - start
+'''),
+    "GT012": ('''\
+import urllib.request
+
+def fetch(url):
+    return urllib.request.urlopen(url).read()
+''', '''\
+import urllib.request
+
+def fetch(url):
+    return urllib.request.urlopen(url, timeout=5.0).read()
+'''),
+    "GT013": ('''\
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+def run(mesh, x):
+    def local(x):
+        return jax.lax.psum(x, "time")
+
+    return shard_map(local, mesh=mesh, in_specs=(P("shard"),),
+                     out_specs=P())(x)
+''', '''\
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+def run(mesh, x):
+    def local(x):
+        return jax.lax.psum(x, "shard")
+
+    return shard_map(local, mesh=mesh, in_specs=(P("shard"),),
+                     out_specs=P())(x)
+'''),
+    "GT014": ('''\
+import jax
+from greptimedb_tpu.telemetry import tracing
+
+@jax.jit
+def kernel(x):
+    with tracing.span("device.step"):
+        return x + 1
+''', '''\
+import jax
+from greptimedb_tpu.telemetry import tracing
+
+@jax.jit
+def kernel(x):
+    return x + 1
+
+def host(x):
+    with tracing.span("device.execute"):
+        return kernel(x)
+'''),
+    "GT015": ('''\
+import numpy as np
+
+def run(program, arrs):
+    out = program(arrs)
+    out.block_until_ready()
+    return np.asarray(out)
+''', '''\
+from greptimedb_tpu.query import readback
+
+def run(program, arrs, j0):
+    out = program(arrs)
+    out.block_until_ready()
+    return readback.read_delta(out, j0, axis=-1)
+'''),
+    "GT016": ('''\
+from collections import OrderedDict
+
+class GridCache:
+    def __init__(self, max_bytes):
+        self.max_bytes = int(max_bytes)
+        self._entries = OrderedDict()
+''', '''\
+from collections import OrderedDict
+from greptimedb_tpu.telemetry import memory
+
+class GridCache:
+    def __init__(self, max_bytes):
+        self.max_bytes = int(max_bytes)
+        self._entries = OrderedDict()
+        memory.register_pool("grids", "device", self,
+                             stats=GridCache._stats)
+
+    def _stats(self):
+        return {"bytes": 0}
+'''),
+    "GT017": ('''\
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+C = global_registry.counter("gtpu_things", "things counted")
+''', '''\
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+C = global_registry.counter("gtpu_calls_total", "calls",
+                            labels=("db", "code"))
+'''),
+    "GT018": ('''\
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("g",))
+def prog(x, *, g):
+    return x + g
+
+def serve(x):
+    return prog(x, g=4)
+''', '''\
+import jax
+from greptimedb_tpu.telemetry import device_trace
+
+@jax.jit
+def prog(x):
+    return x * 2
+
+def serve(x):
+    with device_trace.device_call("site", key=("k",)) as d:
+        return d.run(prog, x)
+'''),
+    "GT019": ('''\
+from urllib.request import urlopen
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+def _collect():
+    urlopen("http://peer:4000/metrics")
+
+global_registry.register_collector(_collect)
+''', '''\
+from urllib.request import urlopen
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+def _collect():
+    urlopen("http://peer:4000/metrics", timeout=2.0)
+
+global_registry.register_collector(_collect)
+'''),
+    "GT021": ('''\
+def detune(inst):
+    inst.scheduler.config.max_concurrency = 4
+''', '''\
+def actuate(registry):
+    registry.set("scheduler.max_concurrency", 4)
+'''),
+    "GT022": ('''\
+import jax
+from jax.experimental import pallas as pl
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] + x_ref[...]
+
+def run(x):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True,
+    )(x)
+''', '''\
+import jax
+from jax.experimental import pallas as pl
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] + x_ref[...]
+
+def run(x, interpret):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x)
+'''),
+}
+
+for _cls in list(globals().values()):
+    if (isinstance(_cls, type) and issubclass(_cls, Rule)
+            and getattr(_cls, "id", None) in _EXAMPLES):
+        _cls.example_pos, _cls.example_neg = _EXAMPLES[_cls.id]
+del _cls

@@ -1,0 +1,615 @@
+"""gtlint device-contract rules (GT023-GT027).
+
+These rules sit on top of the abstract interpreter (dataflow.py) and
+the static TPU model (device_model.py): the CPU interpreter tier-1
+runs does not enforce Mosaic's tiling/VMEM/dtype legality, so a
+kernel can fuzz green on the CPU mesh and still fail to compile (or
+silently spill) on the v5e the paper targets. Every check here only
+fires on a *known* lattice fact -- an unknown shape or dtype is
+silence, never a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from greptimedb_tpu.tools.lint import device_model as dm
+from greptimedb_tpu.tools.lint.core import (
+    FileContext, Rule, dotted_name, register,
+)
+from greptimedb_tpu.tools.lint.dataflow import AV, promote
+
+_WIDE = dm.ILLEGAL_DEVICE_DTYPES
+_NARROW_INTS = frozenset({"int8", "int16", "int32",
+                          "uint8", "uint16", "uint32"})
+
+
+def _is_pallas_call(node: ast.Call) -> bool:
+    f = dotted_name(node.func)
+    return bool(f) and f.split(".")[-1] == "pallas_call"
+
+
+class _Geom:
+    """Static geometry of one pallas_call: paired (spec node, spec AV,
+    operand AV) rows, grid, scratch, out shapes."""
+
+    def __init__(self, node: ast.Call, ctx: FileContext):
+        an = ctx.dataflow_scope()
+        self.an = an
+        self.node = node
+        kws = {k.arg: k.value for k in node.keywords if k.arg}
+        grid_node = kws.get("grid")
+        in_specs_node = kws.get("in_specs")
+        out_specs_node = kws.get("out_specs")
+        scratch_node = kws.get("scratch_shapes")
+        self.nsp = 0
+        gs = kws.get("grid_spec")
+        gs_parts: dict[str, AV] = {}
+        if isinstance(gs, ast.Call):
+            gkws = {k.arg: k.value for k in gs.keywords if k.arg}
+            grid_node = gkws.get("grid", grid_node)
+            in_specs_node = gkws.get("in_specs", in_specs_node)
+            out_specs_node = gkws.get("out_specs", out_specs_node)
+            scratch_node = gkws.get("scratch_shapes", scratch_node)
+            nsp_node = gkws.get("num_scalar_prefetch")
+            if nsp_node is not None:
+                v = an.value(nsp_node)
+                if v.kind == "int" and isinstance(v.value, int):
+                    self.nsp = v.value
+        elif gs is not None:
+            # grid_spec built in a local: resolve through the lattice
+            v = an.value(gs)
+            if v.kind == "gridspec" and v.value is not None:
+                gs_parts = dict(v.value)
+                nsp_av = gs_parts.get("num_scalar_prefetch")
+                if nsp_av is not None and nsp_av.kind == "int" \
+                        and isinstance(nsp_av.value, int):
+                    self.nsp = nsp_av.value
+        self.has_grid = (grid_node is not None
+                         or gs_parts.get("grid") is not None)
+        self.in_specs = (self._items(in_specs_node)
+                         or self._av_items(gs, gs_parts.get("in_specs")))
+        self.out_specs = (self._items(out_specs_node)
+                          or self._av_items(gs,
+                                            gs_parts.get("out_specs")))
+        self.scratch = (self._items(scratch_node)
+                        or self._av_items(gs,
+                                          gs_parts.get("scratch_shapes")))
+        self.out_shapes = self._items(kws.get("out_shape"))
+        # operand AVs from the curried outer call, when visible
+        self.call_args: list[tuple[ast.AST, AV]] = []
+        parent = ctx.parent(1)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            self.call_args = [
+                (a, an.value(a)) for a in parent.args
+                if not isinstance(a, ast.Starred)]
+
+    def _items(self, list_node) -> list[tuple[ast.AST, AV]]:
+        """(node, AV) per element of a literal list/tuple keyword; a
+        single non-list value is one item; None/unresolvable -> []."""
+        if list_node is None:
+            return []
+        if isinstance(list_node, (ast.List, ast.Tuple)):
+            return [(el, self.an.value(el)) for el in list_node.elts
+                    if not isinstance(el, ast.Starred)]
+        v = self.an.value(list_node)
+        if v.kind == "tuple" and v.value is not None:
+            return [(list_node, el) for el in v.value]
+        return [(list_node, v)]
+
+    @staticmethod
+    def _av_items(anchor, av: AV | None) -> list[tuple[ast.AST, AV]]:
+        """Items from a lattice value (grid_spec resolved through a
+        local); findings anchor on the grid_spec expression node."""
+        if av is None or anchor is None:
+            return []
+        if av.kind == "tuple" and av.value is not None:
+            return [(anchor, el) for el in av.value]
+        if av.kind in ("blockspec", "array", "sds", "sem"):
+            return [(anchor, av)]
+        return []
+
+    def spec_rows(self):
+        """Yield (spec_node, block AV, operand AV | None, label) for
+        every BlockSpec paired positionally with its ref."""
+        ops = self.call_args[self.nsp:]
+        for i, (sn, sv) in enumerate(self.in_specs):
+            if sv.kind != "blockspec":
+                continue
+            op = ops[i][1] if i < len(ops) else None
+            yield sn, sv, op, f"in_specs[{i}]"
+        outs = [av for _, av in self.out_shapes
+                if av.kind in ("sds", "array")]
+        for i, (sn, sv) in enumerate(self.out_specs):
+            if sv.kind != "blockspec":
+                continue
+            op = outs[i] if i < len(outs) else None
+            yield sn, sv, op, f"out_specs[{i}]"
+
+
+@register
+class PallasBlockTiling(Rule):
+    id = "GT023"
+    name = "pallas-block-tiling"
+    description = (
+        "Pallas BlockSpec tiling contract (TPU v5e). The last block "
+        "dimension must be a multiple of 128 (one vector-lane row) "
+        "and the second-to-last a multiple of the dtype's sublane "
+        "tile (8 for 4-byte, 16 for 2-byte, 32 for 1-byte types) — "
+        "unless the block spans the WHOLE array dimension, where "
+        "Mosaic masks the edge. A narrower block still compiles but "
+        "buys an implicit relayout/padding on every grid step; the "
+        "CPU interpreter tier-1 runs never shows it. Deliberate "
+        "narrow blocks (per-column gathers) carry a suppression with "
+        "a contract comment. Unknown block dims never fire."
+    )
+    example_pos = """\
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def call(x, interpret):
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 100), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 100), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 400), jnp.float32),
+        interpret=interpret,
+    )(x)
+"""
+    example_neg = """\
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def call(x, interpret):
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 512), jnp.float32),
+        interpret=interpret,
+    )(x)
+"""
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        if not _is_pallas_call(node):
+            return
+        g = _Geom(node, ctx)
+        for sn, sv, op, label in g.spec_rows():
+            bs = sv.shape
+            if bs is None or not bs:
+                continue
+            arr = (op.shape if op is not None
+                   and op.kind in ("array", "sds") else None)
+            dtype = (op.dtype if op is not None
+                     and op.kind in ("array", "sds") else None)
+            last = bs[-1]
+            if (last is not None and last % dm.LANE != 0
+                    and not (arr is not None and arr
+                             and arr[-1] == last)):
+                ctx.report(self, sn,
+                           f"{label} block shape {bs} — last dim "
+                           f"{last} is not a multiple of {dm.LANE} "
+                           f"(TPU lane tile) and does not span the "
+                           f"whole array dim: Mosaic pads/relayouts "
+                           f"every grid step")
+                continue
+            if len(bs) >= 2:
+                sub = dm.sublane(dtype)
+                sl = bs[-2]
+                if (sl is not None and sub is not None
+                        and sl % sub != 0
+                        and not (arr is not None and len(arr) >= 2
+                                 and arr[-2] == sl)):
+                    ctx.report(self, sn,
+                               f"{label} block shape {bs} — dim "
+                               f"{sl} is not a multiple of the "
+                               f"{dtype} sublane tile ({sub})")
+
+
+@register
+class PallasVmemBudget(Rule):
+    id = "GT024"
+    name = "pallas-vmem-budget"
+    description = (
+        "Static VMEM overcommit per pallas_call. Sums the tile-padded "
+        "bytes of every ref the kernel holds resident — block-spec "
+        "blocks (×2 when gridded: Pallas double-buffers pipelined "
+        "refs), whole-array refs without a spec, and VMEM scratch — "
+        "and flags when the KNOWN contributions alone exceed the "
+        "~16 MiB v5e core budget. Unknown shapes only ever add, so "
+        "this is a sound lower bound; a kernel that trips it spills "
+        "or fails to compile on hardware while the CPU interpreter "
+        "runs it happily."
+    )
+    example_pos = """\
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def kernel(x_ref, o_ref, scratch):
+    o_ref[...] = x_ref[...]
+
+def call(x, interpret):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1024, 8192), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((512, 8192), jnp.float32)],
+        interpret=interpret,
+    )(x)
+"""
+    example_neg = """\
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def kernel(x_ref, o_ref, scratch):
+    o_ref[...] = x_ref[...]
+
+def call(x, interpret):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((256, 1024), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
+        interpret=interpret,
+    )(x)
+"""
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        if not _is_pallas_call(node):
+            return
+        g = _Geom(node, ctx)
+        total = 0
+        parts: list[str] = []
+
+        def add(shape, dtype, what, double=False):
+            nonlocal total
+            b = dm.buffer_bytes(shape, dtype)
+            if b is None:
+                return
+            if double:
+                b *= 2
+            total += b
+            parts.append(f"{what}={dm.fmt_bytes(b)}")
+
+        specs = dict(enumerate(g.in_specs))
+        ops = g.call_args[g.nsp:]
+        for i, (_, op) in enumerate(ops):
+            if op.kind not in ("array", "sds"):
+                # no static fact for this ref: contributes unknown>=0
+                continue
+            spec = specs.get(i)
+            if spec is not None and spec[1].kind == "blockspec" \
+                    and spec[1].shape is not None:
+                bshape = tuple(d for d in spec[1].shape)
+                add(bshape, op.dtype, f"in[{i}]", double=g.has_grid)
+            else:
+                add(op.shape, op.dtype, f"in[{i}]")
+        outs = [av for _, av in g.out_shapes
+                if av.kind in ("sds", "array")]
+        ospecs = dict(enumerate(g.out_specs))
+        for i, out in enumerate(outs):
+            spec = ospecs.get(i)
+            if spec is not None and spec[1].kind == "blockspec" \
+                    and spec[1].shape is not None:
+                add(spec[1].shape, out.dtype, f"out[{i}]",
+                    double=g.has_grid)
+            else:
+                add(out.shape, out.dtype, f"out[{i}]")
+        for i, (_, sc) in enumerate(g.scratch):
+            if sc.kind == "array":
+                add(sc.shape, sc.dtype, f"scratch[{i}]")
+            # sem scratch is VMEM-free
+        if total > dm.VMEM_BYTES:
+            ctx.report(self, node,
+                       f"pallas_call holds ≥{dm.fmt_bytes(total)} "
+                       f"resident in VMEM ({', '.join(parts)}), over "
+                       f"the ~{dm.fmt_bytes(dm.VMEM_BYTES)} v5e core "
+                       f"budget — shrink blocks/scratch or raise the "
+                       f"grid")
+
+
+@register
+class PallasGridDivisibility(Rule):
+    id = "GT025"
+    name = "pallas-grid-divisibility"
+    description = (
+        "Block-vs-array divisibility per pallas_call ref. When a "
+        "known array dim is not a multiple of the known block dim, "
+        "the last grid step reads a partial block: Mosaic masks it, "
+        "but every twin in this codebase relies on EXACT division "
+        "(the FOLD_BLOCKS padding contract pads inputs up front "
+        "precisely so device and host fold bit-identically). A "
+        "non-dividing block means the padding contract was skipped. "
+        "Unknown dims never fire."
+    )
+    example_pos = """\
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def call(interpret):
+    x = jnp.zeros((8, 320), dtype=jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        grid=(3,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((8, 384), jnp.float32),
+        interpret=interpret,
+    )(x)
+"""
+    example_neg = """\
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def call(interpret):
+    x = jnp.zeros((8, 384), dtype=jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        grid=(3,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((8, 384), jnp.float32),
+        interpret=interpret,
+    )(x)
+"""
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        if not _is_pallas_call(node):
+            return
+        g = _Geom(node, ctx)
+        for sn, sv, op, label in g.spec_rows():
+            bs = sv.shape
+            arr = (op.shape if op is not None
+                   and op.kind in ("array", "sds") else None)
+            if bs is None or arr is None or len(bs) != len(arr):
+                continue
+            for d, (b, a) in enumerate(zip(bs, arr)):
+                if (b is not None and a is not None and b > 0
+                        and a % b != 0):
+                    ctx.report(self, sn,
+                               f"{label} block dim {d} ({b}) does "
+                               f"not divide the array dim ({a}): the "
+                               f"last grid step reads a partial "
+                               f"block — pad the input first "
+                               f"(FOLD_BLOCKS contract) or pick a "
+                               f"dividing block")
+
+
+@register
+class DevicePromotionHazard(Rule):
+    id = "GT026"
+    name = "device-promotion-hazard"
+    description = (
+        "Dataflow-precise dtype-promotion hazard in device scope "
+        "(subsumes the pattern-only GT009 wherever the lattice has "
+        "facts). Flags: an arithmetic op whose inferred result is a "
+        "64-bit dtype while an operand is narrower (a float32 "
+        "accumulator silently becomes float64 — doubled VMEM and no "
+        "f64 on the v5e datapath); an int literal outside int32 "
+        "range meeting a ≤32-bit int array (trace-time overflow "
+        "under x64-disabled, wrong dtype under x64); creation/astype "
+        "whose dtype RESOLVES to a 64-bit type through the dataflow "
+        "even when no 64-bit token appears at the call site; and a "
+        "pallas_call operand/out_shape in a 64-bit dtype (Mosaic "
+        "compile error). Unknown dtypes never fire."
+    )
+    example_pos = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(n):
+    acc = jnp.zeros((8, 128), dtype=jnp.float32)
+    wide = jnp.asarray(n, dtype=jnp.float64)
+    return acc + wide
+"""
+    example_neg = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(n):
+    acc = jnp.zeros((8, 128), dtype=jnp.float32)
+    return acc + jnp.asarray(n, dtype=jnp.float32) * 1.5
+"""
+
+    _ARITH = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv,
+              ast.Mod, ast.Pow, ast.MatMult)
+    # dtype spellings GT009 already flags syntactically: skip them
+    # here so one bug reports under one rule
+    _GT009_TOKENS = ("int64", "uint64")
+
+    def visit_BinOp(self, node: ast.BinOp, ctx: FileContext):
+        if ctx.device_func is None or not isinstance(
+                node.op, self._ARITH):
+            return
+        an = ctx.dataflow_scope()
+        left, right = an.value(node.left), an.value(node.right)
+        ld, lw = self._as_operand(left)
+        rd, rw = self._as_operand(right)
+        if ld is None or rd is None:
+            return
+        res = promote(ld, rd, lw, rw)
+        if res in _WIDE or res == "complex128":
+            if ld not in _WIDE or rd not in _WIDE:
+                narrow = ld if ld not in _WIDE else rd
+                ctx.report(self, node,
+                           f"{ld} ⊕ {rd} silently promotes to {res} "
+                           f"in device scope — the {narrow} side is "
+                           f"widened (doubled VMEM; no 64-bit "
+                           f"datapath on TPU): cast explicitly")
+            return
+        for scalar, arr_d in ((left, rd), (right, ld)):
+            if (scalar.kind == "int" and isinstance(scalar.value, int)
+                    and arr_d in _NARROW_INTS
+                    and not -2 ** 31 <= scalar.value < 2 ** 31):
+                ctx.report(self, node,
+                           f"int literal {scalar.value} does not fit "
+                           f"int32 but meets a {arr_d} array in "
+                           f"device scope: trace-time overflow (or a "
+                           f"silent 64-bit upcast under x64)")
+                return
+
+    @staticmethod
+    def _as_operand(v: AV):
+        """(dtype, weak) for one binop side; (None, _) = no fact."""
+        if v.kind in ("array", "sds") and v.dtype is not None:
+            return v.dtype, v.weak
+        if v.kind == "int" or v.kind == "bool":
+            return "int32", True
+        if v.kind == "float":
+            return "float32", True
+        return None, False
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        if _is_pallas_call(node):
+            self._check_pallas_refs(node, ctx)
+            return
+        if ctx.device_func is None:
+            return
+        f = dotted_name(node.func)
+        short = (f or "").split(".")[-1]
+        is_creation = short in (
+            "zeros", "ones", "full", "empty", "asarray", "array",
+            "arange", "zeros_like", "ones_like", "full_like")
+        is_astype = (isinstance(node.func, ast.Attribute)
+                     and node.func.attr == "astype")
+        if not (is_creation or is_astype):
+            return
+        dt_node = None
+        if is_astype and node.args:
+            dt_node = node.args[0]
+        else:
+            for k in node.keywords:
+                if k.arg == "dtype":
+                    dt_node = k.value
+            if dt_node is None:
+                # positional dtype: zeros(shape, dt) / full(shape, v, dt)
+                pos = 2 if short in ("full", "full_like") else 1
+                if len(node.args) > pos:
+                    dt_node = node.args[pos]
+        if dt_node is None:
+            return
+        # GT009 owns the syntactic int64 spellings
+        txt = dotted_name(dt_node) or (
+            dt_node.value if isinstance(dt_node, ast.Constant) else "")
+        if any(t in str(txt) for t in self._GT009_TOKENS):
+            return
+        an = ctx.dataflow_scope()
+        v = an.value(dt_node)
+        dt = v.value if v.kind == "dtype" else (
+            v.value if v.kind == "str" else None)
+        if dt in _WIDE:
+            ctx.report(self, node,
+                       f"array created/cast to {dt} in device scope "
+                       f"(dtype resolves through the dataflow): no "
+                       f"64-bit datapath on TPU — use the 32-bit "
+                       f"dtype")
+
+    def _check_pallas_refs(self, node: ast.Call, ctx: FileContext):
+        g = _Geom(node, ctx)
+        for an_node, av in g.call_args + g.out_shapes:
+            if av.kind in ("array", "sds") and av.dtype in _WIDE:
+                ctx.report(self, an_node,
+                           f"pallas_call ref carries dtype "
+                           f"{av.dtype}: 64-bit refs do not exist on "
+                           f"the TPU datapath (Mosaic compile "
+                           f"error) — cast before the kernel "
+                           f"boundary")
+
+
+@register
+class CtxvarReadUnderPool(Rule):
+    id = "GT027"
+    name = "ctxvar-read-under-pool"
+    description = (
+        "Request contextvar read under a pool/Thread. Request state "
+        "here rides contextvars (deadline, tracing span, query/stmt "
+        "stats, session `since`); a function submitted to a pool or "
+        "Thread runs with EMPTY context, so a transitive read sees "
+        "'no deadline'/'no trace' instead of the submitting "
+        "request's state — the bug class PRs 8/9/13 each re-fixed "
+        "by hand. The taint follows module-local calls (closures "
+        "included); an explicit rebind breaks it: pass a captured "
+        "parent (`child_span(..., _parent=parent)` — the "
+        "engine.open_region idiom), bind the family inside the "
+        "worker, or wrap with contextvars.copy_context().run."
+    )
+    example_pos = """\
+from greptimedb_tpu.telemetry import tracing
+
+def job():
+    with tracing.span("work"):
+        pass
+
+def schedule(pool):
+    return pool.submit(job)
+"""
+    example_neg = """\
+from greptimedb_tpu.telemetry import tracing
+
+def job(parent):
+    with tracing.child_span("work", _parent=parent):
+        pass
+
+def schedule(pool):
+    return pool.submit(job, tracing.current_span())
+"""
+
+    _SUBMIT_ATTRS = {"submit", "map", "apply_async"}
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext):
+        cand = None
+        how = None
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in self._SUBMIT_ATTRS and node.args:
+                cand, how = node.args[0], f".{f.attr}()"
+        d = dotted_name(f)
+        if d and d.split(".")[-1] == "Thread":
+            for k in node.keywords:
+                if k.arg == "target":
+                    cand, how = k.value, "Thread(target=...)"
+        if cand is None:
+            return
+        name = None
+        if isinstance(cand, ast.Name):
+            name = cand.id
+        elif (isinstance(cand, ast.Attribute)
+                and isinstance(cand.value, ast.Name)
+                and cand.value.id in ("self", "cls")):
+            name = cand.attr
+        if name is None:
+            return
+        eff = ctx.ctxvars().effective_reads(name, node.lineno)
+        if not eff:
+            return
+        fams = sorted(eff)
+        chain = " -> ".join(eff[fams[0]])
+        ctx.report(self, cand,
+                   f"`{name}` runs via {how} with an empty context "
+                   f"but reads request contextvar "
+                   f"famil{'ies' if len(fams) > 1 else 'y'} "
+                   f"{', '.join(fams)} ({chain}): capture the state "
+                   f"at submit time and rebind explicitly "
+                   f"(`_parent=`/bind/copy_context().run)")

@@ -162,6 +162,43 @@ def lint_paths(paths: list[str], *, baseline: Baseline | None = None,
     }
 
 
+def explain_rule(rule_id: str, *, out=None) -> int:
+    """`lint --explain GTxxx`: the rule's doc, its firing/clean
+    examples (the same snippets the explain meta-test validates), and
+    how to suppress it. Exit 2 on an unknown id."""
+    import textwrap
+
+    out = out or sys.stdout
+    rid = rule_id.strip().upper()
+    rule = all_rules().get(rid)
+    if rule is None:
+        known = ", ".join(all_rules())
+        print(f"gtlint: unknown rule id {rule_id!r} (known: {known})",
+              file=sys.stderr)
+        return 2
+    print(f"{rid} — {rule.name}", file=out)
+    print("", file=out)
+    print(textwrap.fill(rule.description, width=72), file=out)
+    if rule.example_pos:
+        print("\nFires on:\n", file=out)
+        print(textwrap.indent(rule.example_pos.rstrip(), "    "),
+              file=out)
+    if rule.example_neg:
+        print("\nStays silent on:\n", file=out)
+        print(textwrap.indent(rule.example_neg.rstrip(), "    "),
+              file=out)
+    print(f"""
+Suppression:
+
+    <line>  # gtlint: disable={rid}        (this line)
+    # gtlint: disable-next-line={rid}      (the next line)
+    # gtlint: disable-file={rid}           (whole file; first 10 lines)
+
+A suppression must carry an inline comment stating the contract that
+makes the flagged code correct.""", file=out)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="gtlint",
@@ -189,12 +226,19 @@ def main(argv=None) -> int:
                          "runs, e.g. --changed HEAD or --changed "
                          "origin/main")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--explain", default=None, metavar="GTxxx",
+                    help="print one rule's doc, a minimal firing and "
+                         "clean example, and the suppression syntax; "
+                         "exit 2 on an unknown id")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rid, rule in all_rules().items():
             print(f"{rid} {rule.name}: {rule.description}")
         return 0
+
+    if args.explain:
+        return explain_rule(args.explain)
 
     paths = args.paths or [os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))]

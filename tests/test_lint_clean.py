@@ -38,6 +38,27 @@ def test_package_is_lint_clean():
     )
 
 
+def test_dataflow_rules_in_gate():
+    """GT023-GT027 (the device-contract verifier) must be registered
+    and enabled in the default run — the tier-1 gate covers them with
+    EMPTY baselines, not as an opt-in select."""
+    from greptimedb_tpu.tools.lint import Baseline
+    from greptimedb_tpu.tools.lint.core import all_rules
+    from greptimedb_tpu.tools.lint.runner import DEFAULT_BASELINE
+
+    rules = all_rules()
+    for rid in ("GT023", "GT024", "GT025", "GT026", "GT027"):
+        assert rid in rules, f"{rid} missing from the registry"
+        assert rules[rid].example_pos and rules[rid].example_neg
+    base = Baseline.load(DEFAULT_BASELINE)
+    dataflow_debt = [e for e in base.entries
+                     if e.get("rule", "") >= "GT023"]
+    assert dataflow_debt == [], (
+        "GT023-GT027 ship with empty baselines — fix or suppress "
+        f"with a contract comment instead: {dataflow_debt}"
+    )
+
+
 def test_baseline_stays_near_empty():
     """The baseline exists to absorb grandfathered debt during a rule
     rollout, not to grow. Keep it near-empty; raising this cap needs
