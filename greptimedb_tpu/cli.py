@@ -78,6 +78,10 @@ def build_parser() -> argparse.ArgumentParser:
                       help="lint only files differing from this git "
                            "ref (fast pre-commit runs)")
     lint.add_argument("--list-rules", action="store_true")
+    lint.add_argument("--contracts-dump", action="store_true",
+                      help="emit the extracted whole-program contract "
+                           "model (tickets/actions/errors/knobs/"
+                           "metrics) as sorted JSON and exit 0")
     lint.add_argument("--explain", default=None, metavar="GTxxx",
                       help="print one rule's doc, examples, and "
                            "suppression syntax (exit 2 on unknown id)")
@@ -126,7 +130,8 @@ def main(argv=None):
         fwd += ["--format", args.format]
         if args.baseline:
             fwd += ["--baseline", args.baseline]
-        for flag in ("no_baseline", "write_baseline", "list_rules"):
+        for flag in ("no_baseline", "write_baseline", "list_rules",
+                     "contracts_dump"):
             if getattr(args, flag):
                 fwd.append("--" + flag.replace("_", "-"))
         if args.select:
@@ -196,6 +201,11 @@ def main(argv=None):
             "flow.enable": False if args.no_flows else None,
         },
     )
+    from greptimedb_tpu.session import set_default_timezone
+
+    # top-level `default_timezone` knob: the timezone new sessions start
+    # in until a `SET time_zone` overrides it
+    set_default_timezone(opts.get("default_timezone", "UTC"))
     san_sec = opts.section("sanitizer")
     if san_sec.get("enable"):
         # [sanitizer] TOML: enable BEFORE any server builds its locks
@@ -268,6 +278,8 @@ def _http_server(inst, opts, closers):
         inst, addr=hh, port=hp,
         tls_cert=opts.get("http.tls.cert_path") or None,
         tls_key=opts.get("http.tls.key_path") or None,
+        influxdb_enable=bool(opts.get("influxdb.enable", True)),
+        opentsdb_enable=bool(opts.get("opentsdb.enable", True)),
     ).start()
     closers.append(server.stop)
     return server

@@ -34,7 +34,11 @@ DEFAULTS: dict = {
     # anonymous usage reporting (ref src/common/greptimedb-telemetry);
     # nothing is sent unless enable=true AND an endpoint is configured
     "telemetry": {"enable": False, "endpoint": "", "interval_s": 1800.0},
-    "grpc": {"addr": "127.0.0.1:4001", "enable": True},   # arrow flight
+    # arrow flight; advertise_addr overrides the address peers dial
+    # (bind-addr with the resolved port otherwise — port-0 binds and
+    # wildcard hosts need it)
+    "grpc": {"addr": "127.0.0.1:4001", "enable": True,
+             "advertise_addr": ""},
     "mysql": {"addr": "127.0.0.1:4002", "enable": True},
     "postgres": {"addr": "127.0.0.1:4003", "enable": True},
     "opentsdb": {"enable": True},
@@ -164,6 +168,9 @@ DEFAULTS: dict = {
     "frontend": {
         # flight addresses of the datanodes this frontend fans out to
         "datanode_addrs": [],
+        # flight address of the flownode continuous-aggregation flows
+        # run on ("" = run flows in-process on the frontend)
+        "flownode_addr": "",
     },
     "metasrv": {
         "addr": "127.0.0.1:4010", "selector": "round_robin",

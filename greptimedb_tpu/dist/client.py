@@ -198,6 +198,15 @@ class DatanodeClient:
             "stats", {}
         )
 
+    def list_regions(self) -> list[int]:
+        """Region ids this datanode currently serves — the
+        reconciliation probe (metasrv route-table repair compares the
+        intended assignment against what the node actually hosts)."""
+        return [int(r) for r in
+                self.action("list_regions", {},
+                            timeout=_op_timeout(15.0))
+                .get("region_ids", [])]
+
     def data_versions(self, region_ids: list[int]) -> dict:
         return self.action(
             "data_versions", {"region_ids": region_ids},

@@ -445,11 +445,20 @@ class FlightServer(flight.FlightServerBase):
             ("close_region", "close a region"),
             ("drop_region", "drop a region"),
             ("flush_region", "flush a region's memtable"),
+            ("compact_region", "compact a region's SSTs"),
             ("truncate_region", "truncate a region"),
             ("alter_region", "apply a schema change to a region"),
+            ("set_region_writable", "toggle a region's writable flag"),
             ("region_stats", "per-region row/byte statistics"),
             ("data_versions", "per-region logical data versions"),
+            ("physical_versions", "per-region physical storage versions"),
             ("list_regions", "region ids served by this datanode"),
+            ("create_flow", "create a continuous-aggregation flow"),
+            ("drop_flow", "drop a flow"),
+            ("flow_infos", "flow definitions hosted by this node"),
+            ("flow_sources", "source tables mirrored into flows"),
+            ("flow_epoch", "flownode liveness epoch"),
+            ("flush_flow", "force-evaluate a flow's pending windows"),
             ("node_telemetry", "node stats / telemetry docs / metrics "
                                "text / deep health for the fleet plane"),
         ]

@@ -82,7 +82,9 @@ def result_to_json(res) -> dict:
 class HttpServer:
     def __init__(self, instance, *, addr: str = "127.0.0.1", port: int = 4000,
                  user_provider=None, enable_scripts: bool = False,
-                 tls_cert: str | None = None, tls_key: str | None = None):
+                 tls_cert: str | None = None, tls_key: str | None = None,
+                 influxdb_enable: bool = True,
+                 opentsdb_enable: bool = True):
         self.instance = instance
         self.addr = addr
         self.port = port
@@ -98,13 +100,19 @@ class HttpServer:
         if enable_scripts and user_provider is None:
             raise ValueError("enable_scripts requires a user_provider")
         self.enable_scripts = enable_scripts
+        # [influxdb]/[opentsdb] enable knobs: line-protocol ingestion
+        # endpoints can be switched off per node
+        self.influxdb_enable = influxdb_enable
+        self.opentsdb_enable = opentsdb_enable
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
     # ------------------------------------------------------------------
     def start(self):
         handler = _make_handler(self.instance, self.user_provider,
-                                enable_scripts=self.enable_scripts)
+                                enable_scripts=self.enable_scripts,
+                                influxdb_enable=self.influxdb_enable,
+                                opentsdb_enable=self.opentsdb_enable)
         if self.tls_cert:
             import ssl
 
@@ -159,7 +167,8 @@ class HttpServer:
             self._thread.join(timeout=5)
 
 
-def _make_handler(instance, user_provider=None, *, enable_scripts=False):
+def _make_handler(instance, user_provider=None, *, enable_scripts=False,
+                  influxdb_enable=True, opentsdb_enable=True):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -317,7 +326,6 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 http_code = {
                     StatusCode.RATE_LIMITED: 429,
                     StatusCode.QUERY_OVERLOADED: 429,
-                    StatusCode.RUNTIME_RESOURCES_EXHAUSTED: 429,
                     StatusCode.QUERY_QUEUE_TIMEOUT: 503,
                     StatusCode.DEADLINE_EXCEEDED: 503,
                     StatusCode.STORAGE_UNAVAILABLE: 503,
@@ -545,9 +553,15 @@ def _make_handler(instance, user_provider=None, *, enable_scripts=False):
                 return self._handle_remote_read()
             if path in ("/v1/influxdb/write", "/v1/influxdb/api/v2/write",
                         "/influxdb/write"):
+                if not influxdb_enable:
+                    return self._send(
+                        404, b'{"error":"influxdb protocol disabled"}')
                 return self._handle_influx_write()
             if path in ("/v1/opentsdb/api/put", "/opentsdb/api/put",
                         "/api/put"):
+                if not opentsdb_enable:
+                    return self._send(
+                        404, b'{"error":"opentsdb protocol disabled"}')
                 return self._handle_opentsdb_put()
             if path == "/v1/otlp/v1/metrics":
                 return self._handle_otlp_metrics()

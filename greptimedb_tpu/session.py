@@ -10,10 +10,32 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 
+#: process-wide default timezone new sessions start in; the
+#: `default_timezone` TOML knob sets it at role startup (cli.py), and
+#: `SET time_zone` overrides it per session
+_DEFAULT_TIMEZONE = "UTC"
+
+
+def set_default_timezone(tz: str) -> None:
+    """Apply the `default_timezone` config knob: new QueryContexts and
+    the SHOW VARIABLES defaults report `tz` until a session overrides
+    it."""
+    global _DEFAULT_TIMEZONE
+    tz = tz or "UTC"
+    _DEFAULT_TIMEZONE = tz
+    DEFAULT_VARIABLES["time_zone"] = tz
+    DEFAULT_VARIABLES["system_time_zone"] = tz
+
+
+def default_timezone() -> str:
+    return _DEFAULT_TIMEZONE
+
+
 @dataclass
 class QueryContext:
     database: str = "public"
-    timezone: str = "UTC"
+    timezone: str = field(
+        default_factory=lambda: _DEFAULT_TIMEZONE)
     channel: str = "http"
     username: str = ""
     extensions: dict = field(default_factory=dict)

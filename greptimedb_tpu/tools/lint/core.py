@@ -56,8 +56,10 @@ def register(cls: type[Rule]) -> type[Rule]:
 
 
 def all_rules() -> dict[str, Rule]:
-    # rules live in rules.py (AST rules) and rules_device.py (dataflow
-    # device-contract rules); importing them populates the registry
+    # rules live in rules.py (AST rules), rules_device.py (dataflow
+    # device-contract rules), and contracts.py (whole-program wire/
+    # config/metric contracts); importing them populates the registry
+    from greptimedb_tpu.tools.lint import contracts as _contracts  # noqa: F401,E501
     from greptimedb_tpu.tools.lint import rules as _rules  # noqa: F401
     from greptimedb_tpu.tools.lint import (  # noqa: F401
         rules_device as _rules_device,

@@ -45,18 +45,43 @@ def _norm_path(path: str) -> str:
     return ap.replace("\\", "/")
 
 
-def lint_source(path: str, source: str, *, select: set[str] | None = None
-                ) -> tuple[list[Finding], list[Finding]]:
-    """Lint one file's text. Returns (active, suppressed) findings."""
+def _select_rules(select: set[str] | None):
     rules = all_rules()
     if select:
         rules = {k: v for k, v in rules.items() if k in select}
-    tree = ast.parse(source, filename=path)
+    return rules
+
+
+def _walk_findings(path: str, source: str, tree: ast.Module,
+                   rules) -> list[Finding]:
+    """The per-file AST walk over an already-parsed tree."""
     ctx = FileContext(path, source, tree)
     ModuleLinter(ctx, rules).run()
+    return ctx.findings
+
+
+def lint_source(path: str, source: str, *, select: set[str] | None = None
+                ) -> tuple[list[Finding], list[Finding]]:
+    """Lint one file's text. Returns (active, suppressed) findings.
+
+    Runs the per-file walk AND the contracts pass over a one-file
+    forest: fixture mini-projects and the `--explain` examples carry
+    both sides of their contract in a single module, so the cross-file
+    rules are testable here too (checks whose counterpart surface is
+    absent stay silent by construction)."""
+    from greptimedb_tpu.tools.lint.contracts import (
+        contract_findings,
+        extract_model,
+    )
+
+    rules = _select_rules(select)
+    tree = ast.parse(source, filename=path)
+    findings = _walk_findings(path, source, tree, rules)
+    findings = findings + contract_findings(
+        extract_model({path: (source, tree)}), rules)
     sup = Suppressions(source)
-    active = [f for f in ctx.findings if not sup.covers(f.rule, f.line)]
-    suppressed = [f for f in ctx.findings if sup.covers(f.rule, f.line)]
+    active = [f for f in findings if not sup.covers(f.rule, f.line)]
+    suppressed = [f for f in findings if sup.covers(f.rule, f.line)]
     return active, suppressed
 
 
@@ -99,15 +124,109 @@ def changed_files(ref: str) -> set[str] | None:
             for n in out}
 
 
+def _aux_paths(done: set[str]) -> list[str]:
+    """Harvest-only files for the whole-program contracts pass: the
+    rest of the package plus the repo's reference surfaces (tests and
+    bench.py hold metric-name references and action dispatches the
+    contract model must see). Returns paths not already in `done`."""
+    out: list[str] = []
+    roots = [os.path.join(_REPO_ROOT, "greptimedb_tpu"),
+             os.path.join(_REPO_ROOT, "tests")]
+    for root in roots:
+        if os.path.isdir(root):
+            out.extend(iter_py_files([root]))
+    bench = os.path.join(_REPO_ROOT, "bench.py")
+    if os.path.isfile(bench):
+        out.append(bench)
+    return [p for p in out if _norm_path(p) not in done]
+
+
+# text markers covering every construct the contract harvesters match:
+# a scanned set containing NONE of these contributes nothing to the
+# contract model, so the whole-repo aux harvest (which exists to supply
+# the missing half of a contract whose other half IS in the scan) can
+# be skipped and the pass run scan-only. Keeps `gtlint <tmp-fixture>`
+# runs from re-parsing the repo to check fixtures that cannot
+# participate in any contract.
+_CONTRACT_MARKERS = (
+    '"rpc":', "'rpc':", "_decode_ticket",            # tickets
+    ".action(", "Action(", "do_action", "list_actions",  # actions
+    "StatusCode", "_CODE_CLASSES",                   # errors
+    "DEFAULTS", ".get(", ".section(",                # knobs
+    "gtpu_", "greptime_", "registry",                # metrics
+)
+
+
+def _scan_has_contract_markers(
+        forest: dict[str, tuple[str, ast.Module]]) -> bool:
+    return any(any(m in text for m in _CONTRACT_MARKERS)
+               for text, _ in forest.values())
+
+
+# harvest-only files are parsed for the contract model, never walked
+# by per-file rules, so their (text, tree, suppressions) triples are
+# safe to reuse across lint_paths calls in one process — the test
+# suite runs dozens, each of which would otherwise re-read and
+# re-parse the whole repo. Keyed by (mtime_ns, size); an edit
+# invalidates.
+_AUX_CACHE: dict[str, tuple[int, int, str, ast.Module,
+                            Suppressions]] = {}
+
+
+def _load_aux(path: str, norm: str
+              ) -> tuple[str, ast.Module, Suppressions] | None:
+    try:
+        st = os.stat(path)
+        hit = _AUX_CACHE.get(norm)
+        if hit is not None and hit[0] == st.st_mtime_ns \
+                and hit[1] == st.st_size:
+            return hit[2], hit[3], hit[4]
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        tree = ast.parse(text, filename=norm)
+    except (SyntaxError, UnicodeDecodeError, OSError):
+        return None
+    sup = Suppressions(text)
+    _AUX_CACHE[norm] = (st.st_mtime_ns, st.st_size, text, tree, sup)
+    return text, tree, sup
+
+
+def _readme_text() -> str | None:
+    readme = os.path.join(_REPO_ROOT, "README.md")
+    try:
+        with open(readme, encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
 def lint_paths(paths: list[str], *, baseline: Baseline | None = None,
                select: set[str] | None = None,
                only: set[str] | None = None) -> dict:
     """Lint every .py under `paths`; returns the report document.
-    `only` (absolute paths) restricts the walk — the --changed mode."""
+    `only` (absolute paths) restricts the walk — the --changed mode.
+
+    Each file is parsed exactly ONCE: the tree feeds both the per-file
+    walk and the whole-program contracts pass (GT028-GT032). The
+    contracts pass is whole-program by construction — besides the
+    scanned files it harvests the rest of the package, tests/, bench.py
+    and README.md, so a subdirectory run still checks against the full
+    contract surfaces. `--changed` runs skip it (a partial forest
+    cannot decide cross-file contracts; the full gate run catches the
+    drift)."""
+    from greptimedb_tpu.tools.lint.contracts import (
+        CONTRACT_RULE_IDS,
+        contract_findings,
+        extract_model,
+    )
+
+    rules = _select_rules(select)
     findings: list[Finding] = []
     suppressed: list[Finding] = []
     errors: list[tuple[str, str]] = []
     sources: dict[str, list[str]] = {}
+    forest: dict[str, tuple[str, ast.Module]] = {}
+    sup_cache: dict[str, Suppressions] = {}
     nfiles = 0
     for p in paths:
         if not os.path.exists(p):
@@ -122,13 +241,37 @@ def lint_paths(paths: list[str], *, baseline: Baseline | None = None,
         try:
             with open(path, encoding="utf-8") as f:
                 text = f.read()
-            act, sup = lint_source(norm, text, select=select)
+            tree = ast.parse(text, filename=norm)
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             errors.append((norm, str(e)))
             continue
         sources[norm] = text.splitlines()
-        findings.extend(act)
-        suppressed.extend(sup)
+        forest[norm] = (text, tree)
+        sup = sup_cache[norm] = Suppressions(text)
+        for f in _walk_findings(norm, text, tree, rules):
+            (suppressed if sup.covers(f.rule, f.line)
+             else findings).append(f)
+
+    if only is None and any(r in rules for r in CONTRACT_RULE_IDS):
+        harvest = dict(forest)
+        aux = (_aux_paths(set(forest))
+               if _scan_has_contract_markers(forest) else [])
+        for path in aux:
+            norm = _norm_path(path)
+            loaded = _load_aux(path, norm)
+            if loaded is None:
+                continue    # per-file lint of it reports the error
+            text, tree, sup = loaded
+            harvest[norm] = (text, tree)
+            sources[norm] = text.splitlines()
+            sup_cache[norm] = sup
+        model = extract_model(harvest, readme_text=_readme_text())
+        for f in contract_findings(model, rules):
+            sup = sup_cache.get(f.path)
+            if sup is not None and sup.covers(f.rule, f.line):
+                suppressed.append(f)
+            else:
+                findings.append(f)
 
     def line_text(path: str, lineno: int) -> str:
         lines = sources.get(path, [])
@@ -160,6 +303,31 @@ def lint_paths(paths: list[str], *, baseline: Baseline | None = None,
         "_line_text": line_text,
         "_scanned_paths": list(sources),
     }
+
+
+def contracts_dump(paths: list[str], *, out=None) -> int:
+    """`lint --contracts-dump`: emit the extracted whole-program
+    contract model (tickets, actions, error codes, knobs, metric
+    families, each with source locations) as JSON with stable key
+    order. Debugging aid and docs-generation input; always exits 0."""
+    import json
+
+    from greptimedb_tpu.tools.lint.contracts import extract_model
+
+    out = out or sys.stdout
+    forest: dict[str, tuple[str, ast.Module]] = {}
+    scan = list(iter_py_files(paths))
+    scan += _aux_paths({_norm_path(p) for p in scan})
+    for path in scan:
+        norm = _norm_path(path)
+        loaded = _load_aux(path, norm)
+        if loaded is None:
+            continue
+        forest[norm] = (loaded[0], loaded[1])
+    model = extract_model(forest, readme_text=_readme_text())
+    print(json.dumps(model.to_doc(), indent=2, sort_keys=True),
+          file=out)
+    return 0
 
 
 def explain_rule(rule_id: str, *, out=None) -> int:
@@ -226,6 +394,11 @@ def main(argv=None) -> int:
                          "runs, e.g. --changed HEAD or --changed "
                          "origin/main")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--contracts-dump", action="store_true",
+                    help="emit the extracted whole-program contract "
+                         "model (tickets, actions, error codes, knobs, "
+                         "metric families with source locations) as "
+                         "JSON and exit 0")
     ap.add_argument("--explain", default=None, metavar="GTxxx",
                     help="print one rule's doc, a minimal firing and "
                          "clean example, and the suppression syntax; "
@@ -242,6 +415,9 @@ def main(argv=None) -> int:
 
     paths = args.paths or [os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))]
+
+    if args.contracts_dump:
+        return contracts_dump(paths)
     select = ({s.strip().upper() for s in args.select.split(",")
                if s.strip()} if args.select else None)
     baseline = None

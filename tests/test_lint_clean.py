@@ -59,6 +59,28 @@ def test_dataflow_rules_in_gate():
     )
 
 
+def test_contract_rules_in_gate():
+    """GT028-GT032 (the whole-program wire/config/metric contract
+    verifier) must be registered and enabled in the default run — the
+    tier-1 gate covers them with EMPTY baselines, not as an opt-in
+    select."""
+    from greptimedb_tpu.tools.lint import Baseline
+    from greptimedb_tpu.tools.lint.core import all_rules
+    from greptimedb_tpu.tools.lint.runner import DEFAULT_BASELINE
+
+    rules = all_rules()
+    for rid in ("GT028", "GT029", "GT030", "GT031", "GT032"):
+        assert rid in rules, f"{rid} missing from the registry"
+        assert rules[rid].example_pos and rules[rid].example_neg
+    base = Baseline.load(DEFAULT_BASELINE)
+    contract_debt = [e for e in base.entries
+                     if e.get("rule", "") >= "GT028"]
+    assert contract_debt == [], (
+        "GT028-GT032 ship with empty baselines — fix or suppress "
+        f"with a contract comment instead: {contract_debt}"
+    )
+
+
 def test_baseline_stays_near_empty():
     """The baseline exists to absorb grandfathered debt during a rule
     rollout, not to grow. Keep it near-empty; raising this cap needs

@@ -1,0 +1,724 @@
+"""gtcontract (GT028-GT032): the whole-program wire/config/metric
+contract verifier.
+
+Fixture mini-projects live in triple-quoted strings (never in this
+module's own AST — the full-package lint harvests tests/ as a
+consumer surface, so real `.action(...)` calls or `gtpu_*`-suffixed
+string literals here would leak into the live contract model).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+
+import pytest
+
+from greptimedb_tpu.tools.lint.baseline import Baseline
+from greptimedb_tpu.tools.lint.contracts import (
+    CONTRACT_RULE_IDS,
+    ContractRule,
+    contract_findings,
+    extract_model,
+)
+from greptimedb_tpu.tools.lint.core import all_rules
+from greptimedb_tpu.tools.lint.runner import (
+    contracts_dump,
+    lint_paths,
+    lint_source,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "greptimedb_tpu")
+
+
+def _model(src_by_path, readme=None):
+    forest = {p: (s, ast.parse(s)) for p, s in src_by_path.items()}
+    return extract_model(forest, readme_text=readme)
+
+
+def _check(src_by_path, select=None, readme=None):
+    rules = all_rules()
+    if select:
+        rules = {k: v for k, v in rules.items() if k in select}
+    return contract_findings(_model(src_by_path, readme=readme), rules)
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _messages(findings):
+    return "\n".join(f.message for f in findings)
+
+
+# ----------------------------------------------------------------------
+# registration / framework shape
+# ----------------------------------------------------------------------
+
+def test_contract_rules_registered_and_cross_file():
+    rules = all_rules()
+    for rid in CONTRACT_RULE_IDS:
+        assert rid in rules
+        rule = rules[rid]
+        assert isinstance(rule, ContractRule)
+        assert rule.description and rule.example_pos and rule.example_neg
+        # contract rules are model-checked, not AST-walked: no visitor
+        # methods may shadow the per-file dispatch
+        assert not [m for m in dir(rule) if m.startswith("visit_")]
+
+
+# ----------------------------------------------------------------------
+# GT028 tickets
+# ----------------------------------------------------------------------
+
+_PRODUCER = '''\
+def encode(deadline, epoch):
+    dl_field = b'' if deadline is None \\
+        else b'"deadline_s":%.3f,' % deadline
+    ep_field = b'"epoch_ms":%d,' % epoch
+    return (b'{"rpc":"partial_sql",' + dl_field + ep_field
+            + b'"mode":"plan","plan":null}')
+'''
+
+_DECODER = '''\
+import re
+
+_DEADLINE_FIELD_RE = re.compile(r'"deadline_s":[0-9.eE+-]+,')
+_EPOCH_FIELD_RE = re.compile(r'"epoch_ms":-?\\d+,')
+
+def _decode_ticket(raw, doc):
+    return raw
+
+def exec_partial(raw, doc):
+    raw = _DEADLINE_FIELD_RE.sub("", raw, count=1)
+    raw = _EPOCH_FIELD_RE.sub("", raw, count=1)
+    plan = _decode_ticket(raw, doc)
+    return plan, (doc.get("deadline_s"), doc.get("epoch_ms"))
+'''
+
+
+def test_gt028_ticket_extraction_two_files():
+    model = _model({"a/encode.py": _PRODUCER, "a/decode.py": _DECODER})
+    assert model.has_producer_surface and model.has_decode_surface
+    assert set(model.ticket_producers) == {"deadline_s", "epoch_ms"}
+    assert set(model.ticket_strips) == {"deadline_s", "epoch_ms"}
+    assert {"deadline_s", "epoch_ms"} <= model.ticket_reanchors
+    # producer sites anchor in the producer module
+    assert model.ticket_producers["epoch_ms"][0].path == "a/encode.py"
+    assert not _check({"a/encode.py": _PRODUCER,
+                       "a/decode.py": _DECODER}, select={"GT028"})
+
+
+def test_gt028_produced_field_not_stripped():
+    decoder = _DECODER.replace(
+        "_EPOCH_FIELD_RE = re.compile(r'\"epoch_ms\":-?\\d+,')\n", ""
+    ).replace('    raw = _EPOCH_FIELD_RE.sub("", raw, count=1)\n', "")
+    fs = _check({"a/encode.py": _PRODUCER, "a/decode.py": decoder},
+                select={"GT028"})
+    assert _rules_of(fs) == ["GT028"]
+    assert "'epoch_ms'" in _messages(fs)
+    assert "strip" in _messages(fs)
+    # anchored at the producer splice, where the fix starts
+    assert fs[0].path == "a/encode.py"
+
+
+def test_gt028_stripped_but_never_reanchored():
+    decoder = _DECODER.replace(', doc.get("epoch_ms")', "")
+    fs = _check({"a/encode.py": _PRODUCER, "a/decode.py": decoder},
+                select={"GT028"})
+    assert len(fs) == 1 and "never read back" in fs[0].message
+    assert fs[0].path == "a/decode.py"
+
+
+def test_gt028_stale_strip_entry():
+    producer = _PRODUCER.replace(
+        "    ep_field = b'\"epoch_ms\":%d,' % epoch\n", ""
+    ).replace(" + ep_field", "")
+    fs = _check({"a/encode.py": producer, "a/decode.py": _DECODER},
+                select={"GT028"})
+    assert len(fs) == 1 and "stale" in fs[0].message
+
+
+def test_gt028_strip_compiled_but_never_applied():
+    decoder = _DECODER.replace(
+        '    raw = _EPOCH_FIELD_RE.sub("", raw, count=1)\n', "")
+    fs = _check({"a/encode.py": _PRODUCER, "a/decode.py": decoder},
+                select={"GT028"})
+    assert len(fs) == 1 and "never applied via .sub()" in fs[0].message
+
+
+def test_gt028_gated_on_both_surfaces():
+    # producer alone (no decode module in the forest): no findings,
+    # even though nothing is stripped anywhere
+    assert not _check({"a/encode.py": _PRODUCER}, select={"GT028"})
+    # decoder alone: its strips are not "stale" without a producer
+    assert not _check({"a/decode.py": _DECODER}, select={"GT028"})
+
+
+def test_gt028_seeded_regression_against_real_dataplane():
+    """Inject an unstripped volatile field into the REAL fan-out
+    encoder and lint it against the REAL decode module: the gate must
+    catch the drift. This pins the harvest against the live idiom
+    (conditional bytes fragments concatenated into the base literal),
+    not just the synthetic fixtures above."""
+    dq = os.path.join(PKG, "dist", "dist_query.py")
+    mg = os.path.join(PKG, "dist", "merge.py")
+    with open(dq, encoding="utf-8") as f:
+        dq_src = f.read()
+    with open(mg, encoding="utf-8") as f:
+        mg_src = f.read()
+    needle = "dl_field + tp_field"
+    assert needle in dq_src, "fan-out encoder idiom moved; update test"
+    seeded = dq_src.replace(
+        needle, "dl_field + b'\"epoch_ms\":123,' + tp_field", 1)
+    clean = _check({"greptimedb_tpu/dist/dist_query.py": dq_src,
+                    "greptimedb_tpu/dist/merge.py": mg_src},
+                   select={"GT028"})
+    assert not clean, f"live dataplane not clean: {_messages(clean)}"
+    fs = _check({"greptimedb_tpu/dist/dist_query.py": seeded,
+                 "greptimedb_tpu/dist/merge.py": mg_src},
+                select={"GT028"})
+    assert len(fs) == 1 and "'epoch_ms'" in fs[0].message
+    assert fs[0].path == "greptimedb_tpu/dist/dist_query.py"
+
+
+# ----------------------------------------------------------------------
+# GT029 config knobs
+# ----------------------------------------------------------------------
+
+_CONFIG = '''\
+DEFAULTS = {
+    "retry_budget": 3,
+    "server": {"port": 4000, "workers": 8, "tenants": {}},
+}
+'''
+
+
+def test_gt029_knob_extraction():
+    model = _model({"a/config.py": _CONFIG})
+    assert model.has_config_surface
+    assert model.knob_defaults["server.port"][0] == "4000"
+    assert "retry_budget" in model.knob_defaults
+    assert "server" in model.knob_sections
+    assert "server.tenants" in model.knob_dynamic
+
+
+def test_gt029_clean_when_everything_consumed():
+    src = _CONFIG + '''
+def serve(opts):
+    limit = opts.get("server.tenants.alice.rps")
+    return opts.get("server.port"), opts.get("server.workers"), \\
+        opts.get("retry_budget"), limit
+'''
+    assert not _check({"a/config.py": src}, select={"GT029"})
+
+
+def test_gt029_read_but_undeclared():
+    src = _CONFIG + '''
+def serve(opts):
+    return opts.get("server.port"), opts.get("server.backlog"), \\
+        opts.get("server.workers"), opts.get("retry_budget")
+'''
+    fs = _check({"a/config.py": src}, select={"GT029"})
+    assert len(fs) == 1
+    assert "'server.backlog'" in fs[0].message
+    assert "not declared" in fs[0].message
+
+
+def test_gt029_undeclared_ignores_plain_dict_gets():
+    # dotted .get on a non-config namespace ("cache" is no section)
+    src = _CONFIG + '''
+def serve(opts, cache):
+    cache.get("cache.hot.key")
+    return opts.get("server.port"), opts.get("server.workers"), \\
+        opts.get("retry_budget")
+'''
+    assert not _check({"a/config.py": src}, select={"GT029"})
+
+
+def test_gt029_section_never_consulted():
+    src = _CONFIG + '''
+def serve(opts):
+    return opts.get("retry_budget")
+'''
+    fs = _check({"a/config.py": src}, select={"GT029"})
+    assert any("[server]" in f.message
+               and "no code path consults" in f.message for f in fs)
+
+
+def test_gt029_knob_never_read_vs_name_pool():
+    # knobs consumed through config-object fields in another module —
+    # the name pool must count those as reads (no dotted get anywhere)
+    consumer = '''
+class ServerCfg:
+    def __init__(self, section):
+        self.port = section["port"]
+        self.workers = section["workers"]
+
+def serve(opts):
+    return ServerCfg(opts.section("server")), opts.get("retry_budget")
+'''
+    assert not _check({"a/config.py": _CONFIG, "a/app.py": consumer},
+                      select={"GT029"})
+    # a consulted section whose knob names appear NOWHERE: never-read
+    # fires per knob (the dynamic "tenants" table stays exempt)
+    no_field_reads = '''
+def serve(opts):
+    opts.section("server")
+    return opts.get("retry_budget")
+'''
+    fs = _check({"a/config.py": _CONFIG, "a/app.py": no_field_reads},
+                select={"GT029"})
+    flagged = {f.message.split("'")[1] for f in fs}
+    assert flagged == {"server.port", "server.workers"}
+
+
+def test_gt029_undocumented_only_with_readme_in_scope():
+    src = _CONFIG + '''
+def serve(opts):
+    return opts.get("server.port"), opts.get("server.workers"), \\
+        opts.get("retry_budget"), opts.get("server.tenants.x.rps")
+'''
+    # no README in scope (fixtures, lint_source): check skipped
+    assert not _check({"a/config.py": src}, select={"GT029"})
+    readme = "| `server.port` | 4000 | port |\n retry_budget, tenants"
+    fs = _check({"a/config.py": src}, select={"GT029"}, readme=readme)
+    assert len(fs) == 1
+    assert "'server.workers'" in fs[0].message
+    assert "not documented" in fs[0].message
+
+
+# ----------------------------------------------------------------------
+# GT030 error codes
+# ----------------------------------------------------------------------
+
+_ERRORS = '''\
+class StatusCode:
+    RATE_LIMITED = 6001
+    QUERY_TIMEOUT = 3002
+
+class RateLimitedError(Exception):
+    status_code = StatusCode.RATE_LIMITED
+
+class QueryTimeoutError(Exception):
+    status_code = StatusCode.QUERY_TIMEOUT
+
+_CODE_CLASSES = {
+    StatusCode.RATE_LIMITED: RateLimitedError,
+    StatusCode.QUERY_TIMEOUT: QueryTimeoutError,
+}
+'''
+
+
+def test_gt030_error_extraction():
+    model = _model({"a/errors.py": _ERRORS})
+    assert model.has_error_surface and model.has_code_map
+    assert model.status_codes["RATE_LIMITED"][0] == 6001
+    assert model.error_classes["RateLimitedError"][0] == "RATE_LIMITED"
+    assert model.code_classes["QUERY_TIMEOUT"][0] == "QueryTimeoutError"
+    assert not _check({"a/errors.py": _ERRORS}, select={"GT030"})
+
+
+def test_gt030_duplicate_code_number():
+    src = _ERRORS.replace("QUERY_TIMEOUT = 3002", "QUERY_TIMEOUT = 6001")
+    fs = _check({"a/errors.py": src}, select={"GT030"})
+    assert any("duplicates code number 6001" in f.message for f in fs)
+
+
+def test_gt030_missing_code_map_representative():
+    src = _ERRORS.replace(
+        "    StatusCode.QUERY_TIMEOUT: QueryTimeoutError,\n", "")
+    fs = _check({"a/errors.py": src}, select={"GT030"})
+    assert len(fs) == 1
+    assert "QueryTimeoutError" in fs[0].message
+    assert "no representative" in fs[0].message
+
+
+def test_gt030_inconsistent_representative():
+    src = _ERRORS.replace(
+        "StatusCode.QUERY_TIMEOUT: QueryTimeoutError",
+        "StatusCode.QUERY_TIMEOUT: RateLimitedError")
+    fs = _check({"a/errors.py": src}, select={"GT030"})
+    assert any("re-tags" in f.message for f in fs)
+
+
+def test_gt030_http_table_dead_row():
+    http = '''
+table = {
+    StatusCode.RATE_LIMITED: 429,
+    StatusCode.QUERY_TIMEOUT: 408,
+    StatusCode.CANCELLED: 499,
+}
+'''
+    src = _ERRORS.replace("QUERY_TIMEOUT = 3002",
+                          "QUERY_TIMEOUT = 3002\n    CANCELLED = 3003")
+    fs = _check({"a/errors.py": src, "a/http.py": http},
+                select={"GT030"})
+    assert len(fs) == 1
+    assert "CANCELLED" in fs[0].message
+    assert "dead mapping row" in fs[0].message
+    # a row for an undefined member is worse: different message
+    fs = _check({"a/errors.py": _ERRORS, "a/http.py": http},
+                select={"GT030"})
+    assert any("not a defined StatusCode member" in f.message
+               for f in fs)
+
+
+def test_gt030_http_check_gated_on_error_surface():
+    http = '''
+table = {
+    StatusCode.RATE_LIMITED: 429,
+    StatusCode.QUERY_TIMEOUT: 408,
+    StatusCode.CANCELLED: 499,
+}
+'''
+    assert not _check({"a/http.py": http}, select={"GT030"})
+
+
+# ----------------------------------------------------------------------
+# GT031 metric families
+# ----------------------------------------------------------------------
+
+_METRICS = '''\
+registry.counter("gtpu_rows_total", "rows", ("table",))
+registry.histogram("gtpu_scan_seconds", "scan wall", labels=("stage",))
+'''
+
+
+def test_gt031_metric_extraction():
+    model = _model({"a/metrics.py": _METRICS})
+    regs = model.metric_regs
+    assert set(regs) == {"gtpu_" + "rows_total", "gtpu_" + "scan_seconds"}
+    kind, labels, _ = regs["gtpu_" + "rows_total"][0]
+    assert (kind, labels) == ("counter", ("table",))
+    kind, labels, _ = regs["gtpu_" + "scan_seconds"][0]
+    assert (kind, labels) == ("histogram", ("stage",))
+    # the registration call's own name argument is not a reference
+    assert not model.metric_refs
+    assert not _check({"a/metrics.py": _METRICS}, select={"GT031"})
+
+
+def test_gt031_referenced_but_unregistered():
+    render = '''
+def render(registry):
+    return registry.get("gtpu_rows_total"), \\
+        registry.get("gtpu_cache_hits_total")
+'''
+    fs = _check({"a/metrics.py": _METRICS, "a/render.py": render},
+                select={"GT031"})
+    assert len(fs) == 1
+    assert "cache_hits_total" in fs[0].message
+    assert "never registered" in fs[0].message
+
+
+def test_gt031_bare_literal_reference_and_histogram_derived():
+    probe = '''
+def assert_families(text):
+    assert "gtpu_scan_seconds_bucket" in text
+    assert "gtpu_scan_seconds_count" in text
+    assert "gtpu_rows_total" in text
+'''
+    # _bucket/_count resolve to the registered base histogram: clean
+    assert not _check({"a/metrics.py": _METRICS, "a/probe.py": probe},
+                      select={"GT031"})
+    # same derived names with no registered base: flagged
+    fs = _check({"a/metrics.py": _METRICS.replace("histogram",
+                                                  "counter"),
+                 "a/probe.py": probe}, select={"GT031"})
+    assert len(fs) == 2
+    assert "scan_seconds" in _messages(fs)
+
+
+def test_gt031_contextvar_names_are_not_references():
+    src = '''
+import contextvars
+_SINCE = contextvars.ContextVar("gtpu_since_ms", default=None)
+'''
+    assert not _check({"a/metrics.py": _METRICS, "a/ctx.py": src},
+                      select={"GT031"})
+
+
+def test_gt031_inconsistent_registrations():
+    drift = _METRICS + \
+        'other_registry.counter("gtpu_rows_total", "rows", ("db",))\n'
+    fs = _check({"a/metrics.py": drift}, select={"GT031"})
+    assert len(fs) == 1 and "inconsistent label sets" in fs[0].message
+    drift = _METRICS + \
+        'other_registry.gauge("gtpu_rows_total", "rows", ("table",))\n'
+    fs = _check({"a/metrics.py": drift}, select={"GT031"})
+    assert len(fs) == 1 and "inconsistent kinds" in fs[0].message
+
+
+def test_gt031_gated_on_registration_surface():
+    render = '''
+def render(registry):
+    return registry.get("gtpu_rows_total")
+'''
+    assert not _check({"a/render.py": render}, select={"GT031"})
+
+
+# ----------------------------------------------------------------------
+# GT032 Flight actions
+# ----------------------------------------------------------------------
+
+_CLIENT = '''\
+def flush(client):
+    return client.action("flush_region", b"{}")
+
+def probe(flight, addr):
+    return flight.Action("node_probe", b"{}")
+
+def chained(self, addr):
+    return self._pool_for(addr).action("reset_region", b"{}")
+'''
+
+_SERVER = '''\
+class Server:
+    def do_action(self, kind, body):
+        if kind == "flush_region":
+            return b"ok"
+        if kind in ("reset_region", "node_probe"):
+            return b"ok"
+        raise KeyError(kind)
+
+    def list_actions(self, context):
+        return [("flush_region", "flush one region"),
+                ("reset_region", "reset one region"),
+                ("node_probe", "liveness probe")]
+'''
+
+
+def test_gt032_action_extraction():
+    model = _model({"a/client.py": _CLIENT, "a/server.py": _SERVER})
+    assert set(model.action_dispatches) == {"flush_region",
+                                            "node_probe",
+                                            "reset_region"}
+    assert set(model.action_handlers) == {"flush_region",
+                                          "reset_region", "node_probe"}
+    assert set(model.action_advertised) == set(model.action_handlers)
+    assert model.has_handler_surface and model.has_advertise_surface
+    assert not _check({"a/client.py": _CLIENT, "a/server.py": _SERVER},
+                      select={"GT032"})
+
+
+def test_gt032_dispatch_without_handler():
+    server = _SERVER.replace(', "node_probe"', "")
+    fs = _check({"a/client.py": _CLIENT, "a/server.py": server},
+                select={"GT032"})
+    assert any("'node_probe'" in f.message
+               and "no do_action handler" in f.message for f in fs)
+    assert fs[0].path == "a/client.py"
+
+
+def test_gt032_handler_without_dispatch():
+    client = _CLIENT.replace(
+        'def probe(flight, addr):\n'
+        '    return flight.Action("node_probe", b"{}")\n', "")
+    fs = _check({"a/client.py": client, "a/server.py": _SERVER},
+                select={"GT032"})
+    assert len(fs) == 1
+    assert "dead wire surface" in fs[0].message
+
+
+def test_gt032_advertisement_drift():
+    server = _SERVER.replace(
+        '                ("node_probe", "liveness probe")', "").replace(
+        '("reset_region", "reset one region"),\n',
+        '("reset_region", "reset one region")')
+    fs = _check({"a/client.py": _CLIENT, "a/server.py": server},
+                select={"GT032"})
+    assert any("not advertised" in f.message for f in fs)
+    server = _SERVER.replace('        if kind in ("reset_region", '
+                             '"node_probe"):\n            return b"ok"'
+                             '\n', "")
+    fs = _check({"a/client.py": _CLIENT, "a/server.py": server},
+                select={"GT032"})
+    assert any("advertises" in f.message and "no do_action branch"
+               in f.message for f in fs)
+
+
+def test_gt032_foreign_action_namespaces_ignored():
+    # `kind == "flush"` matching in a module WITHOUT a do_action entry
+    # point (e.g. a manifest's apply_action) is a different namespace
+    manifest = '''
+def apply_action(state, kind, doc):
+    if kind == "flush":
+        return state
+    if kind == "edit":
+        return doc
+    raise ValueError(kind)
+'''
+    model = _model({"a/client.py": _CLIENT, "a/server.py": _SERVER,
+                    "a/manifest.py": manifest})
+    assert "flush" not in model.action_handlers
+    assert "edit" not in model.action_handlers
+    assert not _check({"a/client.py": _CLIENT, "a/server.py": _SERVER,
+                       "a/manifest.py": manifest}, select={"GT032"})
+
+
+def test_gt032_gated_on_counterpart_surface():
+    # dispatches alone: no handler surface in the forest, stay silent
+    assert not _check({"a/client.py": _CLIENT}, select={"GT032"})
+    # handlers alone: no dispatch surface, stay silent
+    assert not _check({"a/server.py": _SERVER}, select={"GT032"})
+
+
+# ----------------------------------------------------------------------
+# runner integration: lint_source, suppressions, baseline, dump
+# ----------------------------------------------------------------------
+
+def test_lint_source_runs_contract_rules_single_file():
+    src = _ERRORS.replace(
+        "    StatusCode.QUERY_TIMEOUT: QueryTimeoutError,\n", "")
+    active, suppressed = lint_source("greptimedb_tpu/example.py", src,
+                                     select={"GT030"})
+    assert len(active) == 1 and active[0].rule == "GT030"
+    assert not suppressed
+
+
+def test_contract_finding_suppression_roundtrip():
+    src = _ERRORS.replace(
+        "class QueryTimeoutError(Exception):",
+        "class QueryTimeoutError(Exception):  # gtlint: disable=GT030"
+    ).replace(
+        "    StatusCode.QUERY_TIMEOUT: QueryTimeoutError,\n", "")
+    active, suppressed = lint_source("greptimedb_tpu/example.py", src,
+                                     select={"GT030"})
+    assert not active
+    assert len(suppressed) == 1 and suppressed[0].rule == "GT030"
+
+
+def test_contract_finding_baseline_roundtrip(tmp_path):
+    src = _ERRORS.replace(
+        "    StatusCode.QUERY_TIMEOUT: QueryTimeoutError,\n", "")
+    findings, _ = lint_source("greptimedb_tpu/example.py", src,
+                              select={"GT030"})
+    lines = src.splitlines()
+
+    def line_text(path, lineno):
+        return lines[lineno - 1].strip()
+
+    base = Baseline.from_findings(findings, line_text)
+    path = os.path.join(tmp_path, "baseline.json")
+    base.save(path)
+    loaded = Baseline.load(path)
+    new, old, stale = loaded.split(findings, line_text)
+    assert not new and not stale and len(old) == 1
+    # fixing the violation turns the entry stale (the file must shrink)
+    new, old, stale = loaded.split([], line_text)
+    assert not new and not old and len(stale) == 1
+
+
+def test_lint_paths_aux_harvest_catches_partial_forest(tmp_path):
+    """A run over one directory still checks against the WHOLE
+    program: the aux harvest pulls in the rest of the package, so a
+    fixture producing an unstripped ticket field is caught against the
+    real dist/merge.py decode surface."""
+    fix = tmp_path / "rogue.py"
+    fix.write_text(
+        _PRODUCER.replace("epoch_ms", "rogue_ms"), encoding="utf-8")
+    res = lint_paths([str(tmp_path)], select={"GT028"})
+    assert [f["rule"] for f in res["findings"]] == ["GT028"]
+    assert "rogue_ms" in res["findings"][0]["message"]
+    # the clean tree has no GT028 debt
+    res = lint_paths([os.path.join(PKG, "dist")], select={"GT028"})
+    assert res["findings"] == []
+
+
+def test_changed_mode_skips_contract_pass(tmp_path):
+    """--changed (a partial forest) must not run cross-file rules —
+    the same rogue producer is silent there and the full gate run is
+    what catches it."""
+    fix = tmp_path / "rogue.py"
+    fix.write_text(
+        _PRODUCER.replace("epoch_ms", "rogue_ms"), encoding="utf-8")
+    only = {os.path.normpath(str(fix))}
+    res = lint_paths([str(tmp_path)], select={"GT028"}, only=only)
+    assert res["findings"] == []
+
+
+def test_marker_free_scan_skips_aux_harvest(tmp_path, monkeypatch):
+    """A scanned set with no contract-relevant text cannot contribute
+    to the model, so the whole-repo aux harvest is skipped (this is
+    what keeps `gtlint <plain fixture dir>` at milliseconds); any
+    contract marker in the scan brings the harvest back."""
+    from greptimedb_tpu.tools.lint import runner
+
+    calls = []
+    monkeypatch.setattr(runner, "_aux_paths",
+                        lambda done: calls.append(1) or [])
+    (tmp_path / "a.py").write_text("def f():\n    return 1\n",
+                                   encoding="utf-8")
+    res = runner.lint_paths([str(tmp_path)])
+    assert res["clean"] and not calls
+    (tmp_path / "b.py").write_text(
+        "def g(opts):\n    return opts" + ".get('http.addr')\n",
+        encoding="utf-8")
+    runner.lint_paths([str(tmp_path)])
+    assert calls
+
+
+def test_partial_model_cache_invalidates_on_text_change():
+    """extract_model memoizes per-file partials by (path, text): the
+    same path re-extracted with different text must yield the new
+    file's model, not the cached one."""
+    src1 = "class StatusCode:\n    ALPHA = 9101\n"
+    src2 = "class StatusCode:\n    BETA = 9102\n"
+    m1 = _model({"e.py": src1})
+    assert "ALPHA" in m1.status_codes
+    m2 = _model({"e.py": src2})
+    assert "BETA" in m2.status_codes
+    assert "ALPHA" not in m2.status_codes
+    # unchanged text hits the cache and still merges fresh containers
+    m3 = _model({"e.py": src2})
+    assert m3.status_codes["BETA"][0] == 9102
+
+
+def test_contracts_dump_shape_and_stability():
+    out1, out2 = io.StringIO(), io.StringIO()
+    assert contracts_dump([PKG], out=out1) == 0
+    assert contracts_dump([PKG], out=out2) == 0
+    assert out1.getvalue() == out2.getvalue()  # stable key order
+    doc = json.loads(out1.getvalue())
+    assert set(doc) == {"tickets", "actions", "errors", "knobs",
+                        "metrics"}
+    # spot-check the live surfaces the five rules verify
+    assert "deadline_s" in doc["tickets"]["strips"]
+    assert "deadline_s" in doc["tickets"]["producers"]
+    assert "flush_region" in doc["actions"]["handlers"]
+    assert "flush_region" in doc["actions"]["advertised"]
+    assert "RATE_LIMITED" in doc["errors"]["codes"]
+    assert "http.addr" in doc["knobs"]["declared"]
+    assert any(k.endswith("requests_total")
+               for k in doc["metrics"]["registered"])
+
+
+def test_model_doc_json_round_trip():
+    model = _model({"a/client.py": _CLIENT, "a/server.py": _SERVER,
+                    "a/errors.py": _ERRORS, "a/config.py": _CONFIG,
+                    "a/metrics.py": _METRICS})
+    doc = model.to_doc()
+    # every site renders as {"path", "line"} and the doc is pure JSON
+    again = json.loads(json.dumps(doc, sort_keys=True))
+    assert again == json.loads(json.dumps(doc, sort_keys=True))
+    site = doc["actions"]["handlers"]["flush_region"][0]
+    assert set(site) == {"path", "line"}
+
+
+@pytest.mark.parametrize("rid", CONTRACT_RULE_IDS)
+def test_examples_are_self_contained_mini_projects(rid):
+    """Each contract rule's examples carry BOTH sides of their
+    contract in one module, so the shared explain meta-test (which
+    lints them through lint_source) exercises the cross-file logic."""
+    rule = all_rules()[rid]
+    pos, _ = lint_source("greptimedb_tpu/example.py", rule.example_pos,
+                         select={rid})
+    assert [f.rule for f in pos] == [rid], (
+        f"{rid} example_pos must fire exactly once: "
+        f"{[f.message for f in pos]}")
+    neg, _ = lint_source("greptimedb_tpu/example.py", rule.example_neg,
+                         select={rid})
+    assert not neg, f"{rid} example_neg must stay clean"
