@@ -4312,6 +4312,338 @@ def autotune_probe(base_dir: str | None = None):
 LINT_WALL_GATE_S = 20.0
 
 
+# ----------------------------------------------------------------------
+# secondary-tag-index probe (`python bench.py index`, ISSUE 20): the
+# inverted/dictionary index dataplane against the registry's linear
+# match and the unpruned scan. Four phases, all HARD gates:
+#   A  pruned scan    — matcher scan through sid-pruned SSTs/row groups
+#                       vs a forced full scan + post-filter, warm,
+#                       bit-identical, >= IDX_SPEEDUP_GATE x
+#   B  cardinality    — regex matcher at 1M+ series: dictionary-domain
+#                       evaluation (O(distinct values)) vs the full
+#                       label plane (O(series)), >= IDX_SPEEDUP_GATE x
+#   C  maintenance    — ingest with the index maintained vs disabled,
+#                       overhead <= IDX_MAINT_GATE_PCT %
+#   D  contract       — end-to-end SQL: planner stamps index_pruned,
+#                       gtpu_index_pruned_bytes_total moves, results
+#                       bit-identical with the index off, pools are
+#                       registered, census residue stays flat
+# Per-phase numbers ride the metric line AND the final JSON summary.
+# ----------------------------------------------------------------------
+
+IDX_SPEEDUP_GATE = 5.0       # pruned scan + dictionary-eval gates
+IDX_MAINT_GATE_PCT = 3.0     # index maintenance vs raw ingest
+IDX_BATCHES = 12             # phase A: one SST per batch
+IDX_HOSTS_PER_BATCH = 500    # fresh hosts per batch => disjoint sids
+IDX_POINTS = 12              # rows per host per batch
+IDX_CARD_SERIES = 1_200_000  # phase B series count
+IDX_CARD_LO = 2_000          # phase B distinct host values
+IDX_CARD_HI = 20_000         # reported (scaling evidence), not gated
+IDX_MAINT_ROWS = 800_000     # phase C ingest size
+
+
+def _idx_phase_scan(root: str) -> dict:
+    """Phase A: warm matcher scan, index-pruned vs forced full scan."""
+    from greptimedb_tpu.instance import Standalone
+    from greptimedb_tpu.telemetry.metrics import global_registry
+
+    def pruned_bytes(scope: str) -> float:
+        return global_registry.counter(
+            "gtpu_index_pruned_bytes_total", labels=("scope",)
+        ).labels(scope).value
+
+    inst = Standalone(root, prefer_device=False, warm_start=False)
+    try:
+        inst.execute_sql(
+            "create table idxt (ts timestamp time index, "
+            "host string primary key, v double)"
+        )
+        table = inst.catalog.table("public", "idxt")
+        for b in range(IDX_BATCHES):
+            hosts = np.repeat(np.asarray(
+                [f"b{b}_h{i}" for i in range(IDX_HOSTS_PER_BATCH)],
+                object), IDX_POINTS)
+            ts = (np.tile(np.arange(IDX_POINTS, dtype=np.int64) * 1000,
+                          IDX_HOSTS_PER_BATCH) + b)
+            table.write({"host": hosts}, ts,
+                        {"v": np.arange(len(ts), dtype=np.float64)})
+            table.flush()
+        region = table.regions[0]
+        target = f"b{IDX_BATCHES // 2}_h7"
+        sids = region.match_sids([("host", "eq", target)])
+        assert len(sids) == 1
+
+        def timed(fn, reps=5):
+            fn()  # warm page cache for this file set
+            best = float("inf")
+            out = None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                out = fn()
+                best = min(best, time.perf_counter() - t0)
+            return best * 1000.0, out
+
+        b0 = pruned_bytes("sst") + pruned_bytes("row_group")
+        pruned_ms, got = timed(lambda: region.scan(sids=sids))
+        bytes_moved = (pruned_bytes("sst") + pruned_bytes("row_group")
+                       - b0)
+        assert bytes_moved > 0, (
+            "gtpu_index_pruned_bytes_total did not move during the "
+            "pruned scans"
+        )
+        full_ms, full = timed(lambda: region.scan())
+        keep = np.isin(full.rows.sid, sids)
+        # bit-identical: the pruned scan == full scan post-filtered
+        assert got.rows.sid.tolist() == full.rows.sid[keep].tolist()
+        assert got.rows.ts.tolist() == full.rows.ts[keep].tolist()
+        assert got.rows.fields["v"].tolist() == \
+            full.rows.fields["v"][keep].tolist()
+        speedup = full_ms / pruned_ms
+        assert speedup >= IDX_SPEEDUP_GATE, (
+            f"index-pruned scan only {speedup:.1f}x over the full "
+            f"scan (target >= {IDX_SPEEDUP_GATE}x)"
+        )
+        return {"pruned_ms": pruned_ms, "full_ms": full_ms,
+                "speedup": speedup, "pruned_bytes": bytes_moved,
+                "ssts": IDX_BATCHES,
+                "rows": IDX_BATCHES * IDX_HOSTS_PER_BATCH * IDX_POINTS}
+    finally:
+        inst.close()
+
+
+def _idx_registry(n: int, card: int):
+    from greptimedb_tpu.storage.series import SeriesRegistry
+
+    reg = SeriesRegistry(["host", "id"])
+    hosts = np.asarray([f"v{i % card}" for i in range(n)], object)
+    ids = np.asarray([f"s{i}" for i in range(n)], object)
+    reg.intern_rows([hosts, ids])
+    return reg
+
+
+def _idx_phase_cardinality() -> dict:
+    """Phase B: regex matcher evaluation at 1M+ series — dictionary
+    domain vs the full label plane, bit-identical."""
+    import re as _re
+
+    from greptimedb_tpu import index as _index
+
+    m = [("host", "re", _re.compile(r"v17(00)?"))]
+
+    def one(card: int) -> tuple[float, float]:
+        reg = _idx_registry(IDX_CARD_SERIES, card)
+        ix = _index.index_for(reg)
+        ix.match_sids(m)  # build postings outside the timed region
+        t_ix = float("inf")
+        for _ in range(3):
+            ix._results.clear()  # force evaluation, not the cache
+            t0 = time.perf_counter()
+            got = ix.match_sids(m)
+            t_ix = min(t_ix, time.perf_counter() - t0)
+        t_lin = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            want = reg.match_sids(m)
+            t_lin = min(t_lin, time.perf_counter() - t0)
+        assert np.array_equal(got, want)
+        return t_ix * 1000.0, t_lin * 1000.0
+
+    ix_lo, lin_lo = one(IDX_CARD_LO)
+    ix_hi, lin_hi = one(IDX_CARD_HI)
+    speedup = lin_lo / ix_lo
+    assert speedup >= IDX_SPEEDUP_GATE, (
+        f"dictionary-domain evaluation only {speedup:.1f}x over the "
+        f"linear match at {IDX_CARD_SERIES} series / {IDX_CARD_LO} "
+        f"distinct values (target >= {IDX_SPEEDUP_GATE}x)"
+    )
+    return {"eval_ms_lo": ix_lo, "eval_ms_hi": ix_hi,
+            "linear_ms_lo": lin_lo, "linear_ms_hi": lin_hi,
+            "speedup": speedup, "series": IDX_CARD_SERIES,
+            "card_lo": IDX_CARD_LO, "card_hi": IDX_CARD_HI}
+
+
+def _idx_phase_maintenance() -> dict:
+    """Phase C: ingest with the index live (version bumps + periodic
+    incremental rebuilds on lookup) vs the index disabled."""
+    from greptimedb_tpu import index as _index
+
+    batches = 16
+    per = IDX_MAINT_ROWS // batches
+
+    def cols(b: int):
+        # half repeat series from the previous batch, half are new —
+        # a realistic churn mix for the intern path
+        lo = b * per // 2
+        hosts = np.asarray([f"v{i % 512}" for i in range(per)], object)
+        ids = np.asarray([f"s{lo + i // 2}" for i in range(per)],
+                         object)
+        return [hosts, ids]
+
+    def run(enabled: bool) -> float:
+        from greptimedb_tpu.storage.series import SeriesRegistry
+
+        _index.configure({"enable": enabled})
+        try:
+            reg = SeriesRegistry(["host", "id"])
+            t0 = time.perf_counter()
+            for b in range(batches):
+                reg.intern_rows(cols(b))
+                if enabled and b % 4 == 3:
+                    # periodic lookup drives the incremental rebuild
+                    _index.match_sids(reg, [("host", "eq", "v1")])
+            return time.perf_counter() - t0
+        finally:
+            _index.configure({"enable": True})
+
+    run(False)  # prime allocators/caches off the measurement
+    t_off = min(run(False) for _ in range(2))
+    t_on = min(run(True) for _ in range(2))
+    overhead_pct = max(0.0, (t_on / t_off - 1.0) * 100.0)
+    assert overhead_pct <= IDX_MAINT_GATE_PCT, (
+        f"index maintenance costs {overhead_pct:.1f}% of ingest "
+        f"(target <= {IDX_MAINT_GATE_PCT}%)"
+    )
+    return {"ingest_off_s": t_off, "ingest_on_s": t_on,
+            "overhead_pct": overhead_pct, "rows": IDX_MAINT_ROWS}
+
+
+def _idx_phase_contract(root: str) -> dict:
+    """Phase D: the end-to-end SQL contract — planner stamps the scan
+    path, counters move, results stay bit-identical with the index
+    off, pools are registered, census residue stays flat."""
+    from greptimedb_tpu import index as _index
+    from greptimedb_tpu.index import device_plane
+    from greptimedb_tpu.instance import Standalone
+    from greptimedb_tpu.telemetry import memory
+    from greptimedb_tpu.telemetry.metrics import global_registry
+
+    inst = Standalone(root, prefer_device=False, warm_start=False)
+    try:
+        inst.execute_sql(
+            "create table ct (ts timestamp time index, "
+            "host string primary key, v double)"
+        )
+        table = inst.catalog.table("public", "ct")
+        for b in range(4):
+            hosts = np.repeat(np.asarray(
+                [f"b{b}_h{i}" for i in range(64)], object), 8)
+            ts = np.tile(np.arange(8, dtype=np.int64) * 1000, 64) + b
+            table.write({"host": hosts}, ts,
+                        {"v": np.arange(len(ts), dtype=np.float64)})
+            table.flush()
+        census0 = memory.global_accountant.census()
+        q = ("select host, sum(v), count(*) from ct "
+             "where host = 'b2_h3' group by host")
+        lk = global_registry.counter(
+            "gtpu_index_lookups_total", labels=("path",))
+        sc = global_registry.counter(
+            "gtpu_index_scans_total", labels=("path",))
+        lk0 = lk.labels("postings").value + lk.labels("cache").value
+        sc0 = sc.labels("index_pruned").value
+        on_rows = inst.sql(q).rows()
+        explain = "\n".join(
+            str(r) for r in inst.sql("explain analyze " + q).rows())
+        assert "scan_path: index_pruned" in explain, explain
+        assert lk.labels("postings").value + lk.labels("cache").value \
+            > lk0
+        assert sc.labels("index_pruned").value > sc0
+        # bit-identical with the index disabled (oracle linear match)
+        inst.result_cache.clear()
+        _index.configure({"enable": False})
+        try:
+            off_rows = inst.sql(q).rows()
+        finally:
+            _index.configure({"enable": True})
+        assert on_rows == off_rows and on_rows
+        # pools registered with the accountant; device plane accounted
+        reg = table.regions[0].series
+        out = device_plane.matcher_mask_dev(
+            reg, [("host", "eq", "b2_h3")],
+            1 << (int(np.ceil(np.log2(reg.num_series))) + 1))
+        pools = {p.name for p in memory.global_accountant.snapshot()}
+        assert "tag_index" in pools and "tag_index_plane" in pools
+        census1 = memory.global_accountant.census()
+        residue = (census1["unaccounted_bytes"]
+                   - census0["unaccounted_bytes"])
+        # the plane + mask buffers this phase created must all be
+        # owner-tagged: census residue stays flat (<= 1 MiB of noise
+        # from unrelated jit scratch)
+        assert residue <= 1 << 20, (
+            f"census residue grew {residue} bytes — index device "
+            "buffers are not owner-tagged"
+        )
+        if out is not None:
+            assert census1["pools"].get("tag_index_plane", 0) > 0
+        return {"scan_path": "index_pruned",
+                "bit_identical": True,
+                "census_residue_bytes": int(residue),
+                "device_plane": bool(out is not None)}
+    finally:
+        inst.close()
+
+
+def index_probe(base_dir: str | None = None):
+    """`python bench.py index [dir]`: the secondary tag-index
+    dataplane probe — see the phase map above."""
+    import os
+
+    _assert_sanitizer_off()
+    own_tmp = base_dir is None
+    if own_tmp:
+        base_dir = tempfile.mkdtemp(prefix="gtpu_index_")
+    try:
+        a = _idx_phase_scan(os.path.join(base_dir, "scan"))
+        print(f"# index A scan: pruned {a['pruned_ms']:.2f}ms full "
+              f"{a['full_ms']:.2f}ms speedup {a['speedup']:.1f}x "
+              f"pruned_bytes {a['pruned_bytes']:.0f}",
+              file=sys.stderr)
+        b = _idx_phase_cardinality()
+        print(f"# index B card: eval {b['eval_ms_lo']:.2f}ms "
+              f"(card {IDX_CARD_LO}) / {b['eval_ms_hi']:.2f}ms "
+              f"(card {IDX_CARD_HI}) linear {b['linear_ms_lo']:.2f}ms "
+              f"speedup {b['speedup']:.1f}x", file=sys.stderr)
+        c = _idx_phase_maintenance()
+        print(f"# index C maint: on {c['ingest_on_s']:.2f}s off "
+              f"{c['ingest_off_s']:.2f}s overhead "
+              f"{c['overhead_pct']:.2f}%", file=sys.stderr)
+        d = _idx_phase_contract(os.path.join(base_dir, "contract"))
+        print(f"# index D contract: {d['scan_path']} bit_identical "
+              f"residue {d['census_residue_bytes']}B device_plane "
+              f"{d['device_plane']}", file=sys.stderr)
+        doc = {
+            "metric": "index_scan_speedup",
+            "value": round(a["speedup"], 2),
+            "unit": "x",
+            # target met when the pruned scan clears the gate
+            # (vs_baseline >= 1.0 == target met)
+            "vs_baseline": round(a["speedup"] / IDX_SPEEDUP_GATE, 2),
+            "pruned_ms": round(a["pruned_ms"], 3),
+            "full_ms": round(a["full_ms"], 3),
+            "pruned_bytes": int(a["pruned_bytes"]),
+            "eval_speedup": round(b["speedup"], 2),
+            "eval_ms_lo": round(b["eval_ms_lo"], 3),
+            "eval_ms_hi": round(b["eval_ms_hi"], 3),
+            "linear_ms_lo": round(b["linear_ms_lo"], 3),
+            "series": b["series"],
+            "maint_overhead_pct": round(c["overhead_pct"], 2),
+            "census_residue_bytes": d["census_residue_bytes"],
+            "scan_path": d["scan_path"],
+        }
+        print(json.dumps(doc, separators=(",", ":")))
+        print(json.dumps({**doc, "summary": {
+            "index_scan_speedup": {"v": doc["value"]},
+            "index_pruned_bytes": {"v": doc["pruned_bytes"]},
+            "index_eval_speedup": {"v": doc["eval_speedup"]},
+            "index_maint_overhead_pct": {
+                "v": doc["maint_overhead_pct"]},
+            "index_census_residue_bytes": {
+                "v": doc["census_residue_bytes"]},
+        }}, separators=(",", ":")))
+    finally:
+        if own_tmp:
+            shutil.rmtree(base_dir, ignore_errors=True)
+
+
 def lint_probe():
     """`python bench.py lint`: full-package gtlint wall time (all 26
     rules including the GT023-GT027 dataflow verifier) with a HARD
@@ -4376,6 +4708,8 @@ if __name__ == "__main__":
         fleet_probe()
     elif len(sys.argv) >= 2 and sys.argv[1] == "autotune":
         autotune_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "index":
+        index_probe(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "lint":
         lint_probe()
     else:

@@ -191,7 +191,7 @@ class Table:
             region = self.regions[0]
             sids = None
             if matchers:
-                sids = region.series.match_sids(matchers)
+                sids = region.match_sids(matchers)
                 if len(sids) == 0:
                     return TableScanData(None, region.series, names)
             res = region.scan(ts_min=ts_min, ts_max=ts_max,
@@ -211,7 +211,7 @@ class Table:
             cancellation.checkpoint()
             sids = None
             if matchers:
-                sids = region.series.match_sids(matchers)
+                sids = region.match_sids(matchers)
                 if len(sids) == 0:
                     continue
             res = region.scan(ts_min=ts_min, ts_max=ts_max,

@@ -419,6 +419,11 @@ def _make_instance(opts):
     from greptimedb_tpu.telemetry import device_programs as _dev_prog
 
     _dev_prog.configure(opts.section("profiling"))
+    # [index] knobs: secondary tag-index dataplane (postings caches +
+    # the HBM-resident label plane)
+    from greptimedb_tpu import index as _index
+
+    _index.configure(opts.section("index"))
     prefer_device = opts.get("query.prefer_device")
     inst = Standalone(
         mesh=mesh, mesh_opts=mesh_opts,
@@ -540,6 +545,11 @@ def _start_frontend(opts):
     # frontends rarely dispatch programs themselves, but the registry
     # still profiles any local device path ([profiling] knobs)
     _dev_prog.configure(opts.section("profiling"))
+    # [index] knobs: the frontend's merged-registry matcher lookups
+    # ride the same secondary-index path as the datanodes
+    from greptimedb_tpu import index as _index
+
+    _index.configure(opts.section("index"))
     meta_addr = opts.get("metasrv.addr") or ""
     if meta_addr:
         # distributed frontend: catalog in the metasrv kv, regions on

@@ -165,6 +165,18 @@ DEFAULTS: dict = {
         "pallas_min_rows": 262144,      # fused merge-gather row floor
         "pallas_max_k": 128,            # topk merge kernel O(k^2) cap
     },
+    # secondary tag-index dataplane (index/): per-region inverted
+    # tag-value -> sid postings over the dictionary-coded label plane,
+    # version-validated, with a memoized per-matcher-set sid cache and
+    # (device_plane) the label plane HBM-resident so matcher masks are
+    # computed on device. enable=false falls every matcher back to the
+    # full label-plane compare (the bit-identical oracle).
+    "index": {
+        "enable": True,
+        "device_plane": True,
+        "result_cache_entries": 256,   # per-index memoized matcher sets
+        "rebuild_threshold": 4096,     # delta series before CSR rebuild
+    },
     "frontend": {
         # flight addresses of the datanodes this frontend fans out to
         "datanode_addrs": [],

@@ -77,7 +77,9 @@ def decode(v):
     if "__dt" in v:
         return ConcreteDataType.from_name(v["__dt"])
     if "__re" in v:
-        return re.compile(v["__re"], v.get("fl", 0))
+        from greptimedb_tpu.query.expr import compile_matcher
+
+        return compile_matcher(v["__re"], v.get("fl", 0))
     if "__t" in v:
         return tuple(decode(x) for x in v["__t"])
     if "__m" in v:

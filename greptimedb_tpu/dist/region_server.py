@@ -400,7 +400,7 @@ class RegionServer:
         def one(region):
             sids = None
             if matchers:
-                sids = region.series.match_sids(
+                sids = region.match_sids(
                     [tuple(m) for m in matchers]
                 )
                 if len(sids) == 0:

@@ -71,6 +71,13 @@ class LogicalTable(Table):
             fulltext=fulltext,
         )
 
+    def scoped_sids(self, region) -> np.ndarray:
+        """This table's sids on one physical region: an O(1) posting
+        lookup on the __table_id tag through the secondary index —
+        per-table scoping stays flat as logical tables multiply onto
+        the shared region (engine.rs's tsid-prefix analog)."""
+        return region.match_sids([(TABLE_ID_TAG, "eq", self._tid)])
+
     def flush(self):
         self.physical.flush()
 
@@ -80,10 +87,21 @@ class LogicalTable(Table):
         if data.rows is None or len(data.rows) == 0:
             return
         rows = data.rows
-        tags = {
-            t: data.registry.tag_values(t)[rows.sid]
-            for t in self.physical.tag_names
-        }
+        reg = data.registry
+        # decode tag values for the DISTINCT matched series only —
+        # registry-wide tag_values() gathers are O(total series) per
+        # tag, which a shared physical region hosting a million
+        # logical tables cannot afford per-table
+        uniq, inv = np.unique(rows.sid, return_inverse=True)
+        codes = reg.codes_matrix()
+        tags = {}
+        for t in self.physical.tag_names:
+            i = reg.tag_names.index(t)
+            d = reg.dicts[i]
+            vals = np.asarray(
+                [d.decode(int(c)) for c in codes[uniq, i]], dtype=object
+            )
+            tags[t] = vals[inv]
         self.physical.write(tags, rows.ts, {}, op=1)
 
     def row_count(self) -> int:

@@ -27,6 +27,7 @@ from greptimedb_tpu.errors import (
     UnsupportedError,
 )
 from greptimedb_tpu.promql import parser as P
+from greptimedb_tpu.query.expr import compile_matcher
 from greptimedb_tpu.promql.parser import (
     Agg,
     Binary,
@@ -258,9 +259,9 @@ class PromEngine:
             elif m.op == "!=":
                 out.append((m.name, "ne", m.value))
             elif m.op == "=~":
-                out.append((m.name, "re", re.compile(m.value)))
+                out.append((m.name, "re", compile_matcher(m.value)))
             else:
-                out.append((m.name, "nre", re.compile(m.value)))
+                out.append((m.name, "nre", compile_matcher(m.value)))
         return out
 
     def _scan_grid(self, sel: VectorSelector, ev: EvalParams,

@@ -511,23 +511,41 @@ def _matcher_mask_dev(entry: _Entry, matchers):
     hit = entry.match_cache.get(key)
     if hit is not None:
         return hit
-    mask = np.zeros(entry.s_pad, bool)
+    out = None
     if matchers:
-        mask[: entry.num_series] = entry.registry.match_mask(matchers)
-    else:
-        mask[: entry.num_series] = True
-    any_match = bool(mask.any())
-    sh = _series_sharding(getattr(entry, "mesh", None), 1)
-    if sh is not None:
-        import jax
+        # HBM-resident label plane (index/device_plane): the mask is a
+        # gather+AND over the device codes matrix — only the per-
+        # distinct-value ok-tables cross the tunnel
+        from greptimedb_tpu.index import device_plane
 
-        dev = jax.device_put(mask, sh)
-    else:
-        dev = jnp.asarray(mask)
+        out = device_plane.matcher_mask_dev(
+            entry.registry, matchers, entry.s_pad,
+            mesh=getattr(entry, "mesh", None),
+            num_series=entry.num_series,
+        )
+    if out is None:
+        mask = np.zeros(entry.s_pad, bool)
+        if matchers:
+            from greptimedb_tpu import index as _index
+
+            mask[: entry.num_series] = _index.match_mask(
+                entry.registry, matchers
+            )[: entry.num_series]
+        else:
+            mask[: entry.num_series] = True
+        any_match = bool(mask.any())
+        sh = _series_sharding(getattr(entry, "mesh", None), 1)
+        if sh is not None:
+            import jax
+
+            dev = jax.device_put(mask, sh)
+        else:
+            dev = jnp.asarray(mask)
+        out = (dev, any_match)
     if len(entry.match_cache) >= 128:
         entry.match_cache.pop(next(iter(entry.match_cache)))
-    entry.match_cache[key] = (dev, any_match)
-    return dev, any_match
+    entry.match_cache[key] = out
+    return out
 
 
 def _grouping_dev(entry: _Entry, table, grouping, without: bool):

@@ -1922,12 +1922,24 @@ def execute_range_device(engine, plan, table):
     names = [nm for _, nm in plan.post_items]
     empty = engine._empty_result(names)
     sid_mask = None
+    mask_key = None
+    from greptimedb_tpu.query.planner import record_scan_path
+
     if s.matchers:
-        sids = entry.registry.match_sids(s.matchers)
+        from greptimedb_tpu import index as _index
+
+        record_scan_path(_index.enabled())
+        sids = _index.match_sids(entry.registry, s.matchers)
         if len(sids) == 0:
             return empty
         sid_mask = np.zeros(entry.num_series, bool)
-        sid_mask[sids] = True
+        sid_mask[sids[sids < entry.num_series]] = True
+        # memo on the canonical matcher key + registry version instead
+        # of hashing an O(num_series) mask per query
+        mask_key = (_index.matcher_key(s.matchers),
+                    entry.registry.version)
+    else:
+        record_scan_path(False)
 
     active, ts_min_f, ts_max_f = run_prelude(entry, sid_mask, lo, hi)
     if ts_min_f is None:
@@ -1955,7 +1967,7 @@ def execute_range_device(engine, plan, table):
     hi_c = _clamp_i32(hi)
 
     memo_key = (
-        sid_mask.tobytes() if sid_mask is not None else None,
+        mask_key,
         tuple(k.expr.name for k in plan.keys),
         delta, lo_c, hi_c,
     )

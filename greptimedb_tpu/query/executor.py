@@ -373,12 +373,17 @@ class QueryEngine:
                     matchers=plan.scan.matchers or None,
                     fulltext=ft,
                 )
+        from greptimedb_tpu import index as _index
+        from greptimedb_tpu.query.planner import record_scan_path
+
+        record_scan_path(bool(plan.scan.matchers) and _index.enabled())
         stats.add("rows_scanned", data.num_rows)
         stats.add("series_total", data.registry.num_series)
         if stats.active() is not None and plan.scan.matchers:
             # selectivity is worth a re-match under EXPLAIN ANALYZE only
+            # (the index result cache makes this a dict hit, not a scan)
             stats.add("series_matched", sum(
-                len(r.series.match_sids(plan.scan.matchers))
+                len(r.match_sids(plan.scan.matchers))
                 for r in table.regions
                 if not getattr(r, "remote", False)
             ))

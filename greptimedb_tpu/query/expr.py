@@ -14,6 +14,7 @@ yields null, and filters treat null as false — SQL three-valued logic.
 from __future__ import annotations
 
 import datetime as _dt
+import functools
 import re
 from dataclasses import dataclass
 
@@ -71,6 +72,16 @@ class EmptySource(ColumnSource):
         raise ColumnNotFoundError(f"column not found: {name}")
 
 
+@functools.lru_cache(maxsize=1024)
+def compile_matcher(pattern: str, flags: int = 0) -> re.Pattern:
+    """Memoized regex compile for tag matchers: dashboards repeat the
+    same =~ patterns every poll, and re.compile per matcher per query
+    was measurable at fleet query rates. Keyed on (pattern, flags) —
+    compiled patterns are immutable, so sharing is safe."""
+    return re.compile(pattern, flags)
+
+
+@functools.lru_cache(maxsize=1024)
 def like_to_regex(pattern: str) -> re.Pattern:
     out = []
     for ch in pattern:

@@ -281,6 +281,25 @@ def record_kernel_decision(kind: str, kernel: str, reason: str) -> None:
     ).labels(f"{kind}_kernel", kernel, reason).inc()
 
 
+def record_scan_path(pruned: bool) -> None:
+    """Surface whether a statement's scan rode the secondary tag index
+    (matched-sid set threaded down to SST/row-group pruning) or read
+    the full table, in EXPLAIN ANALYZE, the statement-statistics row,
+    and gtpu_index_scans_total."""
+    from greptimedb_tpu.query import stats
+    from greptimedb_tpu.telemetry import stmt_stats
+    from greptimedb_tpu.telemetry.metrics import global_registry
+
+    path = "index_pruned" if pruned else "full_scan"
+    stats.note("scan_path", path)
+    stmt_stats.note("scan_path", path)
+    global_registry.counter(
+        "gtpu_index_scans_total",
+        "Statement scans by path (index_pruned | full_scan)",
+        labels=("path",),
+    ).labels(path).inc()
+
+
 def record_mesh_decision(decision: MeshDecision, kind: str) -> None:
     """Surface one decision in EXPLAIN ANALYZE + gtpu_mesh_* metrics.
     No-op counters-wise when no mesh is configured (devices == 1) so the

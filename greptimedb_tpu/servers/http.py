@@ -1084,9 +1084,7 @@ def _table_label_values(t, label: str) -> set:
         return out
     if isinstance(t, ME.LogicalTable):
         for region in t.regions:
-            sids = region.series.match_sids(
-                [(ME.TABLE_ID_TAG, "eq", t._tid)]
-            )
+            sids = t.scoped_sids(region)
             if len(sids) == 0:
                 continue
             vals = region.series.tag_values(label)

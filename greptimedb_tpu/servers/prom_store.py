@@ -239,6 +239,8 @@ def remote_read(instance, body: bytes, *, db: str = "public") -> bytes:
     """Answer a remote-read request with a snappy-compressed ReadResponse."""
     import re as _re
 
+    from greptimedb_tpu.query.expr import compile_matcher
+
     data = snappy.decompress(body)
     queries = parse_read_request(data)
     query_results = []
@@ -250,7 +252,8 @@ def remote_read(instance, body: bytes, *, db: str = "public") -> bytes:
                 name_matchers.append((mtype, value))
                 continue
             op = {0: "eq", 1: "ne", 2: "re", 3: "nre"}[mtype]
-            val = _re.compile(value) if mtype in (2, 3) else value
+            val = (compile_matcher(value) if mtype in (2, 3)
+                   else value)
             reg_matchers.append((name, op, val))
         # resolve metric names: EQ narrows to one, RE/NEQ/NRE filter all.
         # The metric engine's shared physical table is internal — a

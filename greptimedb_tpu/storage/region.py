@@ -520,6 +520,15 @@ class Region:
     # ------------------------------------------------------------------
     # scan
     # ------------------------------------------------------------------
+    def match_sids(self, matchers) -> np.ndarray:
+        """Matched sids for a tag-matcher set, routed through the
+        secondary tag index (index/) — eq/in are posting lookups, re/ne
+        evaluate over the distinct-value dictionary; results memoized
+        per matcher set and validated against the registry version."""
+        from greptimedb_tpu import index as _index
+
+        return _index.match_sids(self.series, matchers)
+
     def scan(
         self,
         *,
@@ -567,7 +576,21 @@ class Region:
         # so pruning is sound there; everywhere else the residual
         # filter alone does the matching.
         ft = fulltext if self.meta.options.append_mode else None
+        smin = smax = None
+        if sids is not None and len(sids):
+            smin = int(sids.min())
+            smax = int(sids.max())
         for meta in ssts:
+            if smin is not None and (meta.sid_max < smin
+                                     or meta.sid_min > smax):
+                # manifest sid range can't intersect the matched set:
+                # the whole file is skipped without touching its footer
+                from greptimedb_tpu.index.tag_index import count_pruned
+                from greptimedb_tpu.query import stats as _stats
+
+                _stats.add("index_ssts_skipped", 1)
+                count_pruned(bytes_=meta.size_bytes, scope="sst")
+                continue
             r = read_sst(self.store_for(meta), meta,
                          ts_min=ts_min, ts_max=ts_max,
                          field_names=scan_names, sids=sids, fulltext=ft)

@@ -16,6 +16,55 @@ from greptimedb_tpu.datatypes.batch import Dictionary
 
 from greptimedb_tpu import concurrency
 
+
+def missing_tag_ok(op: str, value) -> bool:
+    """Constant matcher verdict for a tag name absent from the schema —
+    a missing tag behaves as the empty string on every series."""
+    if op == "eq":
+        return value == ""
+    if op == "ne":
+        return value != ""
+    if op == "in":
+        return "" in value
+    if op == "nin":
+        return "" not in value
+    if op == "re":
+        return bool(value.fullmatch(""))
+    if op == "nre":
+        return not value.fullmatch("")
+    raise ValueError(op)
+
+
+def ok_codes_for(vals: np.ndarray, op: str, value) -> np.ndarray:
+    """Per-distinct-value matcher verdicts over one tag dictionary:
+    (len(vals),) bool. All predicate string/regex work happens here —
+    O(distinct values) — and is broadcast through the int32 code
+    columns by match_mask and by the secondary index (index/)."""
+    if op == "eq":
+        ok_codes = vals == value
+    elif op == "ne":
+        ok_codes = vals != value
+    elif op == "in":
+        ok_codes = np.isin(vals.astype(str), list(value))
+    elif op == "nin":
+        ok_codes = ~np.isin(vals.astype(str), list(value))
+    elif op == "re":
+        # dtype=bool: an EMPTY comprehension defaults to float64
+        # and `keep &= ...` explodes on a zero-series region
+        ok_codes = np.asarray(
+            [bool(value.fullmatch(str(v))) for v in vals],
+            dtype=bool,
+        )
+    elif op == "nre":
+        ok_codes = np.asarray(
+            [not value.fullmatch(str(v)) for v in vals],
+            dtype=bool,
+        )
+    else:
+        raise ValueError(op)
+    return np.asarray(ok_codes, dtype=bool)
+
+
 class SeriesRegistry:
     def __init__(self, tag_names: list[str]):
         self.tag_names = list(tag_names)
@@ -24,6 +73,15 @@ class SeriesRegistry:
         self._rows: list[tuple] = []
         self._lock = concurrency.Lock()
         self._codes_cache: np.ndarray | None = None
+        # bumped on every mutation that can change matcher results (new
+        # series, ALTER ADD TAG). Secondary indexes and matcher-result
+        # caches validate against this the same way the scan cache
+        # validates against region.data_version().
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        return self._version
 
     def __len__(self) -> int:
         return len(self._rows)
@@ -59,6 +117,7 @@ class SeriesRegistry:
                     self._series[()] = 0
                     self._rows.append(())
                     new.append((0, []))
+                    self._version += 1
                 return np.zeros(n, dtype=np.int32), new
             codes = [d.intern_array(c) for d, c in zip(self.dicts, tag_columns)]
             series = self._series
@@ -97,6 +156,8 @@ class SeriesRegistry:
                         d.decode(c) for d, c in zip(self.dicts, key_t)
                     ]))
                 uniq_sids[i] = sid
+            if new:
+                self._version += 1
             return uniq_sids[np.ravel(inv)], new
 
     def ensure_series(self, sid: int, tag_values: list[str]) -> None:
@@ -119,6 +180,7 @@ class SeriesRegistry:
             )
             self._series[key] = sid
             self._rows.append(key)
+            self._version += 1
 
     def add_tag(self, name: str) -> None:
         """Add a tag column; existing series get "" for it. Sids are stable
@@ -137,6 +199,7 @@ class SeriesRegistry:
             self._series = {r: i for i, r in enumerate(self._rows)}
             self.dicts.append(d)
             self.tag_names.append(name)
+            self._version += 1
 
     def lookup_series(self, tags: dict[str, str]) -> int | None:
         """Exact-match lookup of one series by full tag set."""
@@ -186,49 +249,13 @@ class SeriesRegistry:
         codes = self.codes_matrix()
         for name, op, value in matchers:
             if name not in self.tag_names:
-                # a missing tag behaves as the empty string on every series
-                if op == "eq":
-                    ok = value == ""
-                elif op == "ne":
-                    ok = value != ""
-                elif op == "in":
-                    ok = "" in value
-                elif op == "nin":
-                    ok = "" not in value
-                elif op == "re":
-                    ok = bool(value.fullmatch(""))
-                elif op == "nre":
-                    ok = not value.fullmatch("")
-                else:
-                    raise ValueError(op)
-                if not ok:
+                if not missing_tag_ok(op, value):
                     keep[:] = False
                 continue
             i = self.tag_names.index(name)
             d = self.dicts[i]
             vals = np.asarray(list(d.values), dtype=object)
-            if op == "eq":
-                ok_codes = vals == value
-            elif op == "ne":
-                ok_codes = vals != value
-            elif op == "in":
-                ok_codes = np.isin(vals.astype(str), list(value))
-            elif op == "nin":
-                ok_codes = ~np.isin(vals.astype(str), list(value))
-            elif op == "re":
-                # dtype=bool: an EMPTY comprehension defaults to float64
-                # and `keep &= ...` explodes on a zero-series region
-                ok_codes = np.asarray(
-                    [bool(value.fullmatch(str(v))) for v in vals],
-                    dtype=bool,
-                )
-            elif op == "nre":
-                ok_codes = np.asarray(
-                    [not value.fullmatch(str(v)) for v in vals],
-                    dtype=bool,
-                )
-            else:
-                raise ValueError(op)
+            ok_codes = ok_codes_for(vals, op, value)
             keep &= ok_codes[codes[:, i]]
         return keep
 
@@ -266,4 +293,5 @@ class SeriesRegistry:
         reg.dicts = [Dictionary(vals) for vals in obj["dicts"]]
         reg._rows = [tuple(r) for r in obj["rows"]]
         reg._series = {r: i for i, r in enumerate(reg._rows)}
+        reg._version = len(reg._rows)
         return reg

@@ -53,6 +53,11 @@ class SstMeta:
     sid_max: int
     size_bytes: int
     level: int = 0
+    # sid range floor for whole-SST index pruning (region.scan skips
+    # files whose [sid_min, sid_max] can't intersect the matched sid
+    # set); manifests written before the secondary index default to 0,
+    # which is always conservative
+    sid_min: int = 0
     # a <path>.puffin sidecar with flush-time fulltext term indexes
     fulltext: bool = False
     # storage tier; manifests written before tiering default to hot
@@ -230,6 +235,7 @@ def write_sst(
         ts_min=int(rows.ts.min()) if len(rows) else 0,
         ts_max=int(rows.ts.max()) if len(rows) else 0,
         sid_max=int(rows.sid.max()) if len(rows) else -1,
+        sid_min=int(rows.sid.min()) if len(rows) else 0,
         size_bytes=len(data),
         fulltext=sidecar is not None,
         level=level,
@@ -332,6 +338,8 @@ def read_sst(
     sids_sorted = np.sort(sids) if sids is not None else None
     groups = []
     ft_pruned = 0
+    sid_pruned = 0
+    sid_pruned_bytes = 0
     for g in range(md.num_row_groups):
         if ft_allowed is not None and g not in ft_allowed:
             ft_pruned += 1
@@ -349,6 +357,8 @@ def read_sst(
                 if not np.isin(
                     grp, sids_sorted, assume_unique=True
                 ).any():
+                    sid_pruned += 1
+                    sid_pruned_bytes += md.row_group(g).total_byte_size
                     continue
             else:
                 # older SSTs without the footer index: min/max stats on
@@ -357,12 +367,21 @@ def read_sst(
                 if sst is not None and sst.has_min_max:
                     lo = np.searchsorted(sids_sorted, sst.min, "left")
                     if lo >= len(sids_sorted) or sids_sorted[lo] > sst.max:
+                        sid_pruned += 1
+                        sid_pruned_bytes += (
+                            md.row_group(g).total_byte_size
+                        )
                         continue
         groups.append(g)
     stats.add("row_groups_total", md.num_row_groups)
     stats.add("row_groups_read", len(groups))
     if ft_pruned:
         stats.add("row_groups_pruned_fulltext", ft_pruned)
+    if sid_pruned:
+        from greptimedb_tpu.index.tag_index import count_pruned
+
+        count_pruned(row_groups=sid_pruned, bytes_=sid_pruned_bytes,
+                     scope="row_group")
     if not groups:
         return None
     # decoded row groups ride the page cache (SSTs are immutable;

@@ -1353,6 +1353,123 @@ class PallasCallHygiene(Rule):
                        f"shard_map (binds: {', '.join(shown)})")
 
 
+# Registry label-plane accessors whose full-column results a matcher
+# predicate must never compare directly (GT033). Gathers through them
+# (decode, subscript-by-sid) are fine — only boolean verdicts over the
+# whole column re-create the O(total series) scan the secondary index
+# exists to kill.
+_GT033_PLANE_FUNCS = {"tag_values", "codes_matrix"}
+_GT033_CMP_CALLS = {"equal", "not_equal", "isin", "in1d"}
+
+
+def _gt033_exempt_path(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return ("/index/" in p or p.startswith("index/")
+            or p.endswith("storage/series.py"))
+
+
+def _gt033_plane_root(node: ast.AST, tracked: set[str]) -> str | None:
+    """'tag_values' / 'codes_matrix' / a tracked local name when the
+    expression (through any Subscript chain) roots at a label-plane
+    call or a local bound to one; else None."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Call):
+        d = dotted_name(node.func)
+        if d is not None and d.split(".")[-1] in _GT033_PLANE_FUNCS:
+            return d.split(".")[-1]
+        return None
+    if isinstance(node, ast.Name) and node.id in tracked:
+        return node.id
+    return None
+
+
+@register
+class FullLabelPlanePredicate(Rule):
+    id = "GT033"
+    name = "full-label-plane-predicate"
+    description = (
+        "A boolean compare over a series-registry label column "
+        "(`tag_values()` / `codes_matrix()` results) outside the "
+        "index package re-creates the O(total series) linear match "
+        "the secondary tag index exists to kill: every evaluation "
+        "pays the full plane even when postings answer it in O(1). "
+        "Route matchers through index.match_sids / index.match_mask "
+        "(posting lookups for eq/in, dictionary-domain evaluation "
+        "for re/ne). Gathers — decoding values for matched sids, "
+        "subscripting by a sid set — are fine; only whole-column "
+        "predicates fire."
+    )
+
+    def _scopes(self, tree: ast.Module):
+        """(scope node, statements owned by it) pairs: module body plus
+        each def, with nested defs excluded from their enclosing
+        scope's statement set (their locals shadow)."""
+        defs = [n for n in ast.walk(tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        out = []
+        for scope in [tree] + defs:
+            owned = []
+            stack = list(scope.body)
+            while stack:
+                n = stack.pop()
+                owned.append(n)
+                for child in ast.iter_child_nodes(n):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        continue
+                    stack.append(child)
+            out.append((scope, owned))
+        return out
+
+    def visit_Module(self, node: ast.Module, ctx: FileContext):
+        if _gt033_exempt_path(ctx.path):
+            return
+        for _scope, owned in self._scopes(node):
+            # names bound ONLY from label-plane calls in this scope; a
+            # name also assigned from anything else is not tracked (it
+            # may no longer hold the plane at the compare)
+            tracked: set[str] = set()
+            dirty: set[str] = set()
+            for n in owned:
+                if not (isinstance(n, ast.Assign)
+                        and len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Name)):
+                    continue
+                name = n.targets[0].id
+                if _gt033_plane_root(n.value, set()) is not None:
+                    tracked.add(name)
+                else:
+                    dirty.add(name)
+            tracked -= dirty
+            for n in owned:
+                if isinstance(n, ast.Compare):
+                    if not all(isinstance(op, (ast.Eq, ast.NotEq,
+                                               ast.In, ast.NotIn))
+                               for op in n.ops):
+                        continue
+                    sides = [n.left] + list(n.comparators)
+                elif (isinstance(n, ast.Call)
+                        and (dotted_name(n.func) or "").split(".")[-1]
+                        in _GT033_CMP_CALLS):
+                    sides = list(n.args)
+                else:
+                    continue
+                for side in sides:
+                    root = _gt033_plane_root(side, tracked)
+                    if root is None:
+                        continue
+                    ctx.report(self, n,
+                               f"boolean predicate over the full "
+                               f"label plane (via {root!r}) — "
+                               "O(total series) per evaluation; "
+                               "route the matcher through "
+                               "index.match_sids / index.match_mask "
+                               "(postings + dictionary-domain "
+                               "evaluation)")
+                    break
+
+
 # ----------------------------------------------------------------------
 # --explain examples
 # ----------------------------------------------------------------------
@@ -1669,6 +1786,18 @@ def run(x, interpret):
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         interpret=interpret,
     )(x)
+'''),
+    "GT033": ('''\
+import numpy as np
+
+def match(reg, value):
+    vals = reg.tag_values("host")
+    return np.flatnonzero(vals == value)
+''', '''\
+from greptimedb_tpu import index
+
+def match(reg, value):
+    return index.match_sids(reg, [("host", "eq", value)])
 '''),
 }
 

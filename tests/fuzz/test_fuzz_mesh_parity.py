@@ -250,6 +250,7 @@ def _prom_exact(queries, rs1, rs2, tag=""):
         )
 
 
+@pytest.mark.slow  # tier-1 budget: SQL fuzz twins keep mesh-parity gated
 def test_mesh_parity_fuzz_promql(prom_setup):
     from greptimedb_tpu.promql import fast as F
     from greptimedb_tpu.promql.engine import PromEngine

@@ -180,6 +180,8 @@ def test_dml_and_ddl_round_trip(harness):
         assert inst.engine.regions() == []
 
 
+@pytest.mark.slow  # tier-1 budget: WAL replay gated by the wire-failover
+# + chaos process-kill replay tests
 def test_datanode_restart_replays_wal(harness, tmp_path):
     fe = harness.frontend
     _seed(fe)

@@ -232,6 +232,8 @@ def test_multiprocess_flow_mirroring(topology):
     assert rows == [["a", 2, 20.0], ["b", 1, 50.0]]
 
 
+@pytest.mark.slow  # tier-1 budget: HA failover exercised nightly; dist
+# process coverage stays via trace/flow/query tests in this module
 def test_metasrv_ha_leader_kill_and_failover(tmp_path):
     """3 metasrv PROCESSES share one kv (FsKv flock CAS = the etcd
     campaign analog, ref meta-srv/src/election/etcd.rs:161-206): exactly
@@ -408,6 +410,8 @@ def test_metasrv_ha_leader_kill_and_failover(tmp_path):
             log.close()
 
 
+@pytest.mark.slow  # tier-1 budget: flow mirroring gated by
+# test_multiprocess_flow_mirroring
 def test_flownode_crash_mirror_replay(tmp_path):
     """Kill the flownode PROCESS mid-stream: deltas inserted while it is
     down buffer on the frontend (bounded backlog) and replay in order
@@ -820,6 +824,8 @@ def test_dist_statement_statistics_fold_one_row(topology):
     assert top["exec_path"] == "dist"
 
 
+@pytest.mark.slow  # tier-1 budget: fleet fan-out gated by
+# test_fleet.py::test_wire_fleet_fanout_and_down_degradation
 def test_fleet_observability(tmp_path):
     """Fleet observability plane (ISSUE 15) on a REAL wire topology:
     metasrv + 2 datanodes + frontend + flownode, each its own process.

@@ -1,0 +1,276 @@
+"""Secondary tag index (index/): invalidation proof through the full
+frontend -> datanode path.
+
+The index answers matchers from per-region postings plus a
+(matcher-set, registry-version) result cache. A stale posting set or
+cached sid list after a data-mutating op — flush, compaction (incl.
+the device merge), ALTER, truncate, DROP, region migration — would
+ship wrong partials from the datanode. Every test runs the matcher
+query with the index on, then clears every dist cache and re-runs it
+with the index disabled (the registry's linear match is the oracle):
+results must be bit-identical. Mirrors tests/test_dist_scan_cache.py.
+"""
+
+import contextlib
+
+import pytest
+
+pytest.importorskip("pyarrow.flight")
+
+from greptimedb_tpu import index as _index
+from greptimedb_tpu.dist.client import MetaClient
+from greptimedb_tpu.dist.frontend import DistInstance
+from greptimedb_tpu.dist.region_server import RegionServer
+from greptimedb_tpu.instance import Standalone
+from greptimedb_tpu.servers.flight import FlightFrontend
+from greptimedb_tpu.servers.meta_http import MetasrvServer
+from greptimedb_tpu.storage.compaction import CompactionOptions
+from greptimedb_tpu.storage.engine import EngineConfig
+from greptimedb_tpu.telemetry.metrics import global_registry
+
+
+class _Harness:
+    def __init__(self, tmp_path, n_datanodes=2, *, store=None,
+                 compaction=None):
+        self.meta = MetasrvServer(
+            addr="127.0.0.1", port=0, data_home=str(tmp_path / "meta")
+        ).start()
+        self.meta_addr = f"127.0.0.1:{self.meta.port}"
+        self.datanodes = {}
+        for i in range(n_datanodes):
+            home = str(tmp_path / f"dn{i}")
+            cfg = EngineConfig(data_root=home, enable_background=False)
+            if compaction is not None:
+                cfg.compaction = compaction
+            inst = Standalone(
+                engine_config=cfg, prefer_device=False,
+                warm_start=False, store=store,
+            )
+            inst.region_server = RegionServer(inst.engine, home)
+            fs = FlightFrontend(inst, port=0).start()
+            MetaClient(self.meta_addr).register(
+                i, f"127.0.0.1:{fs.server.port}"
+            )
+            self.datanodes[i] = (inst, fs)
+        self.frontend = DistInstance(
+            str(tmp_path / "fe"), self.meta_addr, prefer_device=False
+        )
+
+    def region_servers(self):
+        return [inst.region_server for inst, _ in self.datanodes.values()]
+
+    def clear_caches(self):
+        """Drop every layer that could replay an index-era result to
+        the oracle run: the frontend result cache and the datanode
+        merged-scan caches."""
+        self.frontend.result_cache.clear()
+        for rs in self.region_servers():
+            rs.scan_cache.clear()
+
+    def close(self):
+        self.frontend.close()
+        for inst, fs in self.datanodes.values():
+            fs.close()
+            inst.close()
+        self.meta.close()
+
+
+@pytest.fixture()
+def harness(tmp_path):
+    h = _Harness(tmp_path)
+    yield h
+    h.close()
+
+
+@contextlib.contextmanager
+def index_disabled():
+    _index.configure({"enable": False})
+    try:
+        yield
+    finally:
+        _index.configure({"enable": True})
+
+
+# matcher-carrying queries: eq (a posting lookup), ne (dictionary-
+# domain evaluation), and LIKE (a regex matcher)
+QS = (
+    "select host, sum(v), count(*) from t1 where host = 'h1' "
+    "group by host order by host",
+    "select host, sum(v), count(*) from t1 where host != 'h0' "
+    "group by host order by host",
+    "select host, count(*) from t1 where host like 'h%' "
+    "group by host order by host",
+)
+
+
+def _assert_identical(h, queries=QS):
+    fe = h.frontend
+    got = [fe.sql(q).rows() for q in queries]
+    h.clear_caches()
+    with index_disabled():
+        want = [fe.sql(q).rows() for q in queries]
+    for g, w, q in zip(got, want, queries):
+        assert g == w, f"index-on result diverged for: {q}"
+    return got
+
+
+def _seed(fe, rows=40):
+    fe.execute_sql(
+        "create table t1 (ts timestamp time index, host string "
+        "primary key, v double) with (num_regions = 2)"
+    )
+    values = ", ".join(
+        f"('h{i % 4}', {1_000_000 + i * 1000}, {float(i)})"
+        for i in range(rows)
+    )
+    fe.execute_sql(f"insert into t1 (host, ts, v) values {values}")
+
+
+def test_seeded_matcher_queries_identical(harness):
+    _seed(harness.frontend)
+    got = _assert_identical(harness)
+    assert got[0]  # the eq query actually matched something
+
+
+def test_flush_and_new_series_invalidate(harness):
+    fe = harness.frontend
+    _seed(fe)
+    _assert_identical(harness)  # warm: result cache + postings built
+    fe.catalog.table("public", "t1").flush()
+    _assert_identical(harness)
+    # a NEW series after the warm lookups: the registry version bump
+    # must invalidate cached sid sets through the datanode path
+    fe.execute_sql(
+        "insert into t1 (host, ts, v) values ('h9', 99000000, 7.0)"
+    )
+    rows = fe.sql(
+        "select host, sum(v) from t1 where host = 'h9' group by host"
+    ).rows()
+    assert rows == [["h9", 7.0]]
+    _assert_identical(harness)
+
+
+def test_compaction_device_merge_invalidates(tmp_path):
+    """Compaction rewrites SSTs (fresh sid_min/sid_max footers) via the
+    DEVICE merge path; matcher scans across the swap stay identical."""
+    h = _Harness(
+        tmp_path,
+        compaction=CompactionOptions(device_merge_min_rows=1,
+                                     verify_device_merge=True),
+    )
+    try:
+        fe = h.frontend
+        _seed(fe, rows=20)
+        table = fe.catalog.table("public", "t1")
+        table.flush()
+        for round_ in range(4):  # enough L0 runs to trip the picker
+            fe.execute_sql(
+                "insert into t1 (host, ts, v) values "
+                + ", ".join(
+                    f"('h{i % 4}', "
+                    f"{2_000_000 + round_ * 40_000 + i * 1000},"
+                    f" {float(i)})"
+                    for i in range(20)
+                )
+            )
+            table.flush()
+        _assert_identical(h)  # warm across both datanodes
+        d0 = global_registry.get(
+            "gtpu_compaction_merge_total"
+        ).labels("device").value
+        compacted = sum(1 for rp in table.regions if rp.compact())
+        assert compacted > 0
+        assert global_registry.get(
+            "gtpu_compaction_merge_total"
+        ).labels("device").value > d0
+        _assert_identical(h)
+    finally:
+        h.close()
+
+
+def test_alter_add_tag_invalidates(harness):
+    """ALTER adding a tag widens the registry's tag set: the postings
+    must rebuild (k changed) and matchers on the new tag must work."""
+    fe = harness.frontend
+    _seed(fe)
+    _assert_identical(harness)  # warm with the old tag set
+    fe.execute_sql("alter table t1 add column dc string primary key")
+    fe.execute_sql(
+        "insert into t1 (host, dc, ts, v) values "
+        "('h0', 'east', 50000000, 1.0), ('h5', 'west', 50001000, 2.0)"
+    )
+    dc_qs = (
+        "select host, sum(v) from t1 where dc = 'east' "
+        "group by host order by host",
+        "select host, sum(v) from t1 where dc != 'east' "
+        "group by host order by host",
+    )
+    got = _assert_identical(harness, QS + dc_qs)
+    assert got[3] == [["h0", 1.0]]
+
+
+def test_truncate_then_refill_identical(harness):
+    fe = harness.frontend
+    _seed(fe)
+    _assert_identical(harness)  # warm
+    fe.catalog.table("public", "t1").truncate()
+    assert fe.sql(
+        "select count(*) from t1 where host = 'h1'"
+    ).rows() == [[0]]
+    _assert_identical(harness)
+    fe.execute_sql(
+        "insert into t1 (host, ts, v) values ('h1', 1000, 5.0)"
+    )
+    got = _assert_identical(harness)
+    assert got[0] == [["h1", 5.0, 1]]
+
+
+def test_drop_and_recreate_identical(harness):
+    fe = harness.frontend
+    _seed(fe)
+    _assert_identical(harness)  # warm against the first incarnation
+    fe.execute_sql("drop table t1")
+    fe.execute_sql(
+        "create table t1 (ts timestamp time index, host string "
+        "primary key, v double) with (num_regions = 2)"
+    )
+    fe.execute_sql(
+        "insert into t1 (host, ts, v) values ('h1', 1000, 42.0)"
+    )
+    got = _assert_identical(harness)
+    assert got[0] == [["h1", 42.0, 1]]
+
+
+def test_region_migration_identical(tmp_path):
+    from greptimedb_tpu.storage.object_store import FsObjectStore
+
+    shared = FsObjectStore(str(tmp_path / "shared_store"))
+    h = _Harness(tmp_path, n_datanodes=2, store=shared)
+    try:
+        fe = h.frontend
+        fe.execute_sql(
+            "create table gm (ts timestamp time index, host string "
+            "primary key, v double)"
+        )
+        fe.execute_sql(
+            "insert into gm (host, ts, v) values ('a', 1000, 1.0), "
+            "('b', 2000, 2.0)"
+        )
+        q = ("select host, sum(v) from gm where host = 'a' "
+             "group by host order by host",)
+        _assert_identical(h, q)  # warm on the source hosting
+        ms = h.meta.metasrv
+        rid = fe.catalog.table("public", "gm").info.region_ids()[0]
+        src = ms.route_of(rid)
+        ms.migrate_region(rid, 1 - src)
+        fe.catalog.refresh()
+        # the target hosting rebuilt its own registry + index
+        got = _assert_identical(h, q)
+        assert got[0] == [["a", 1.0]]
+        fe.execute_sql(
+            "insert into gm (host, ts, v) values ('a', 3000, 10.0)"
+        )
+        got = _assert_identical(h, q)
+        assert got[0] == [["a", 11.0]]
+    finally:
+        h.close()

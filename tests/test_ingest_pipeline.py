@@ -312,6 +312,8 @@ class _StalledFlightServer:
         self.server.shutdown()
 
 
+@pytest.mark.slow  # tier-1 budget: backpressure gated by the queue-bound
+# + crash-replay ingest tests in this module
 def test_stalled_datanode_bounds_memory_and_sheds():
     from greptimedb_tpu.errors import DatanodeUnavailableError
 

@@ -81,6 +81,25 @@ def test_contract_rules_in_gate():
     )
 
 
+def test_index_rule_in_gate():
+    """GT033 (full-label-plane predicate — the secondary-index
+    discipline) must be registered and enabled in the default run
+    with an EMPTY baseline."""
+    from greptimedb_tpu.tools.lint import Baseline
+    from greptimedb_tpu.tools.lint.core import all_rules
+    from greptimedb_tpu.tools.lint.runner import DEFAULT_BASELINE
+
+    rules = all_rules()
+    assert "GT033" in rules, "GT033 missing from the registry"
+    assert rules["GT033"].example_pos and rules["GT033"].example_neg
+    base = Baseline.load(DEFAULT_BASELINE)
+    debt = [e for e in base.entries if e.get("rule") == "GT033"]
+    assert debt == [], (
+        "GT033 ships with an empty baseline — route the matcher "
+        f"through the index package instead: {debt}"
+    )
+
+
 def test_baseline_stays_near_empty():
     """The baseline exists to absorb grandfathered debt during a rule
     rollout, not to grow. Keep it near-empty; raising this cap needs

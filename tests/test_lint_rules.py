@@ -37,7 +37,7 @@ def test_registry_has_all_rules():
     ids = sorted(all_rules())
     # GT020 is unassigned/reserved; the registry jumps to GT021.
     assert ids == ([f"GT{n:03d}" for n in range(1, 20)]
-                   + [f"GT{n:03d}" for n in range(21, 33)])
+                   + [f"GT{n:03d}" for n in range(21, 34)])
     for rule in all_rules().values():
         assert rule.name and rule.description
 
@@ -1890,6 +1890,94 @@ def test_gt022_negative_bound_or_computed_device_id():
             )
             rdma.start()
     """, select="GT022") == []
+
+
+# ---------------------------------------------------------------------------
+# GT033 full-label-plane predicate
+# ---------------------------------------------------------------------------
+
+def test_gt033_positive_compare_on_tag_values():
+    hits = rules_hit("""
+        import numpy as np
+
+        def match(reg, value):
+            vals = reg.tag_values("host")
+            return np.flatnonzero(vals == value)
+    """, select="GT033")
+    assert ("GT033", 6) in hits
+
+
+def test_gt033_positive_direct_call_and_codes_matrix():
+    # compare directly on the call result, no intermediate name
+    hits = rules_hit("""
+        def match(reg, value):
+            return reg.tag_values("host") != value
+    """, select="GT033")
+    assert ("GT033", 3) in hits
+    # subscripted codes_matrix column through a local
+    hits = rules_hit("""
+        def match(reg, code, i):
+            codes = reg.codes_matrix()
+            return codes[:, i] == code
+    """, select="GT033")
+    assert ("GT033", 4) in hits
+
+
+def test_gt033_positive_numpy_comparison_calls():
+    hits = rules_hit("""
+        import numpy as np
+
+        def match(reg, wanted):
+            vals = reg.tag_values("host")
+            return np.isin(vals, wanted)
+    """, select="GT033")
+    assert ("GT033", 6) in hits
+
+
+def test_gt033_negative_gathers_and_index_path():
+    # gathering values by sid (no predicate) is the sanctioned use
+    assert rules_hit("""
+        def decode(reg, sids):
+            return reg.tag_values("host")[sids]
+    """, select="GT033") == []
+    # routing through the index package is the fix, not a finding
+    assert rules_hit("""
+        from greptimedb_tpu import index
+
+        def match(reg, value):
+            return index.match_sids(reg, [("host", "eq", value)])
+    """, select="GT033") == []
+    # compares on unrelated arrays stay quiet
+    assert rules_hit("""
+        import numpy as np
+
+        def f(rows, value):
+            vals = rows.ts
+            return np.flatnonzero(vals == value)
+    """, select="GT033") == []
+
+
+def test_gt033_negative_reassigned_name_untracked():
+    # a name later rebound to something else is no longer the plane
+    assert rules_hit("""
+        def f(reg, other, value):
+            vals = reg.tag_values("host")
+            vals = other.column("host")
+            return vals == value
+    """, select="GT033") == []
+
+
+def test_gt033_negative_exempt_paths():
+    src = """\
+def match(reg, value):
+    vals = reg.tag_values("host")
+    return vals == value
+"""
+    from greptimedb_tpu.tools.lint import lint_source
+    for path in ("greptimedb_tpu/index/tag_index.py",
+                 "greptimedb_tpu/storage/series.py"):
+        act, _ = lint_source(path, src, select={"GT033"})
+        assert act == [], path
 
 
 if __name__ == "__main__":
